@@ -1,0 +1,98 @@
+"""paddle_tpu — a TPU-native deep learning framework with PaddlePaddle's
+capabilities, built from scratch on JAX/XLA/Pallas.
+
+Public surface mirrors `import paddle` (reference: python/paddle/__init__.py);
+the implementation is an original TPU-first design: imperative tensors over
+jax.Array, autograd via recorded jax.vjp nodes, jit.to_static = XLA step
+compilation, distributed = GSPMD over jax.sharding.Mesh.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .framework import dtypes as _dtypes
+from .framework.core import (  # noqa: F401
+    Tensor,
+    Parameter,
+    EagerParamBase,
+    no_grad,
+    enable_grad,
+    set_grad_enabled,
+    is_grad_enabled,
+    to_tensor,
+)
+from .framework.dtypes import (  # noqa: F401
+    bool_ as bool8,
+    uint8, int8, int16, int32, int64,
+    float16, bfloat16, float32, float64,
+    complex64, complex128,
+    set_default_dtype, get_default_dtype,
+)
+
+bool = _dtypes.bool_  # paddle.bool
+
+from .framework.flags import set_flags, get_flags  # noqa: F401
+from .framework.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .framework.device import (  # noqa: F401
+    set_device, get_device, device_count,
+    CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace, XPUPlace,
+    is_compiled_with_cuda, is_compiled_with_xpu, is_compiled_with_tpu,
+)
+
+from .tensor import *  # noqa: F401,F403
+from .tensor import creation as _creation  # ensure registration
+
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import autograd  # noqa: F401
+from . import io  # noqa: F401
+from . import amp  # noqa: F401
+from . import jit  # noqa: F401
+from . import metric  # noqa: F401
+from . import vision  # noqa: F401
+from . import distributed  # noqa: F401
+from . import incubate  # noqa: F401
+from . import static  # noqa: F401
+from . import device  # noqa: F401
+from . import framework as base  # noqa: F401
+from .framework import io_file as _io_file
+from .framework.io_file import save, load  # noqa: F401
+from .framework.param_attr import ParamAttr, L1Decay, L2Decay  # noqa: F401
+from . import regularizer  # noqa: F401
+from .hapi import Model, summary  # noqa: F401
+from .autograd import grad  # noqa: F401
+
+# paddle.disable_static / enable_static: we are always "dygraph" (eager over
+# XLA); static mode is served by jit.to_static. Kept as no-ops for parity.
+_static_mode = False
+
+
+def disable_static(place=None):
+    global _static_mode
+    _static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def in_dynamic_mode():
+    return not _static_mode
+
+
+def disable_signal_handler():
+    pass
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+class LazyGuard:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False

@@ -1,0 +1,225 @@
+"""AMP — automatic mixed precision as a dtype policy.
+
+reference: python/paddle/amp/ (auto_cast.py O1/O2 lists, grad_scaler.py,
+amp_lists.py). On TPU the native fast dtype is bfloat16, whose dynamic range
+matches float32 — so loss scaling is unnecessary (GradScaler degrades to a
+pass-through but keeps the dynamic-scale API for parity/float16).
+
+O1 maps to a per-op cast hook on the eager dispatch path (the analog of
+AmpAutoCasts in paddle/fluid/eager/amp_auto_cast.h); O2 casts parameters.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..framework import core as _core
+from ..framework import dtypes as _dt
+from ..framework.core import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "amp_decorate", "GradScaler",
+           "is_float16_supported", "is_bfloat16_supported",
+           "white_list", "black_list"]
+
+# reference: python/paddle/amp/amp_lists.py
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "scaled_dot_product_attention", "flash_attention_pallas", "rnn", "lstm",
+    "gru", "addmm", "mv",
+}
+BLACK_LIST = {
+    "exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+    "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "cross_entropy", "c_softmax_with_cross_entropy", "layer_norm", "norm",
+    "batch_norm", "group_norm", "instance_norm", "rms_norm", "logsumexp",
+    "erf", "erfinv", "pow", "log_softmax", "log_sigmoid", "bce",
+    "bce_with_logits", "nll_loss", "kl_div", "l1_loss", "mse_loss",
+    "smooth_l1_loss", "ctc_loss",
+}
+
+
+def white_list():
+    return {"float16": {"O1": WHITE_LIST, "O2": WHITE_LIST},
+            "bfloat16": {"O1": WHITE_LIST, "O2": WHITE_LIST}}
+
+
+def black_list():
+    return {"float16": {"O1": BLACK_LIST, "O2": BLACK_LIST},
+            "bfloat16": {"O1": BLACK_LIST, "O2": BLACK_LIST}}
+
+
+class _AmpState:
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def _cast_hook(name, arrs):
+    if not _state.enabled:
+        return arrs
+    target = _state.dtype
+    wl = (WHITE_LIST | _state.custom_white) - _state.custom_black
+    bl = BLACK_LIST | _state.custom_black
+    if name in wl:
+        return [a.astype(target)
+                if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
+                for a in arrs]
+    if name in bl:
+        return [a.astype(jnp.float32)
+                if hasattr(a, "dtype") and a.dtype in (jnp.float16, jnp.bfloat16) else a
+                for a in arrs]
+    return arrs
+
+
+_core._amp_cast_hook = _cast_hook
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """reference: python/paddle/amp/auto_cast.py:auto_cast."""
+    prev = (_state.enabled, _state.dtype, _state.level,
+            _state.custom_white, _state.custom_black)
+    _state.enabled = enable
+    _state.dtype = _dt.convert_dtype(dtype)
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level,
+         _state.custom_white, _state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """O2: cast model parameters to the target dtype (keeping fp32 master
+    weights in the optimizer when master_weight). reference:
+    python/paddle/amp/auto_cast.py:decorate."""
+    target = _dt.convert_dtype(dtype)
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    if level == "O2":
+        from ..nn.layer.norm import _BatchNormBase, LayerNorm
+        excluded = (_BatchNormBase, LayerNorm)
+        for m in model_list:
+            for layer in m.sublayers(include_self=True):
+                if isinstance(layer, excluded):
+                    continue
+                for p in layer._parameters.values():
+                    if p is not None and p._data.dtype == jnp.float32:
+                        p._data = p._data.astype(target)
+    if optimizers is None:
+        return models if isinstance(models, (list, tuple)) else model_list[0]
+    return (models if isinstance(models, (list, tuple)) else model_list[0]), optimizers
+
+
+amp_decorate = decorate
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+class GradScaler:
+    """Dynamic loss scaling. reference: python/paddle/amp/grad_scaler.py.
+    With bf16 (TPU default) scaling is mathematically unnecessary; the
+    machinery is kept for float16 parity."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is not None:
+                g = p.grad._data * inv
+                import jax.numpy as jnp
+                found = found or bool(jnp.any(~jnp.isfinite(g)))
+                p.grad._data = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        from ..framework.core import Tensor
+        import jax.numpy as jnp
+        return Tensor(jnp.asarray(self._scale))
+
+    def state_dict(self):
+        return {"scale": self._scale, "good": self._good_steps,
+                "bad": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good", 0)
+        self._bad_steps = sd.get("bad", 0)

@@ -1,0 +1,217 @@
+"""Autograd surface: backward, grad, PyLayer, functional jvp/vjp/hessian.
+
+reference: python/paddle/autograd/ — backward_mode.py, py_layer.py,
+autograd.py. The engine itself lives in framework/core.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import (Tensor, _run_backward, execute, no_grad,
+                              is_grad_enabled, set_grad_enabled, enable_grad)
+
+__all__ = ["backward", "grad", "PyLayer", "PyLayerContext", "no_grad",
+           "enable_grad", "set_grad_enabled", "is_grad_enabled", "jvp", "vjp",
+           "hessian", "jacobian"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward (reference: python/paddle/autograd/backward_mode.py)."""
+    _run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None, name=None):
+    """paddle.grad (reference: python/paddle/base/dygraph/base.py:grad,
+    engine GeneralGrad in paddle/fluid/eager/backward.cc)."""
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    capture = {id(t): t for t in ins}
+    captured = _run_backward(outs, grad_outputs, retain_graph=retain_graph,
+                             capture=capture)
+    results = []
+    for t in ins:
+        g = (captured or {}).get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused; pass "
+                    "allow_unused=True to return None for it")
+            results.append(None)
+        else:
+            results.append(Tensor(g) if not isinstance(g, Tensor) else g)
+    return results
+
+
+# ---------------------------------------------------------------------------
+# PyLayer: custom autograd (reference: python/paddle/autograd/py_layer.py)
+# ---------------------------------------------------------------------------
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):
+        self.not_inplace_tensors = args
+
+    def set_materialize_grads(self, value):
+        self.materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom forward/backward. The backward is spliced into the tape as a
+    Node whose 'vjp' calls the user's backward — same role as
+    egr::PyLayerGradNode (reference: paddle/fluid/eager/pylayer/)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..framework import core as _core
+
+        ctx = PyLayerContext()
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+
+        if not _core.grad_enabled():
+            return out
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor) and not a.stop_gradient]
+        if not tensor_inputs:
+            return out
+
+        multi = isinstance(out, (list, tuple))
+        out_list = list(out) if multi else [out]
+        out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+
+        import weakref
+
+        def vjp_fn(cot_tree):
+            cots = cot_tree if isinstance(cot_tree, (list, tuple)) else [cot_tree]
+            grads_in = [Tensor(c) for c in cots]
+            res = cls.backward(ctx, *grads_in)
+            if not isinstance(res, (list, tuple)):
+                res = (res,)
+            # paddle semantics: backward returns one grad per Tensor input of
+            # forward, in order; we keep only those recorded as differentiable
+            res_iter = iter(res)
+            flat = []
+            for a in args:
+                if not isinstance(a, Tensor):
+                    continue
+                r = next(res_iter, None)
+                if a.stop_gradient:
+                    continue
+                flat.append(r._data if isinstance(r, Tensor) else
+                            (jnp.zeros_like(a._data) if r is None else jnp.asarray(r)))
+            return tuple(flat)
+
+        new_outs = [Tensor(o._data, stop_gradient=False) for o in out_tensors]
+        import jax.tree_util as jtu
+        treedef = jtu.tree_structure(tuple(range(len(new_outs))))
+        node = _core.Node("PyLayer:" + cls.__name__, vjp_fn, tensor_inputs,
+                          new_outs, treedef)
+        for t in new_outs:
+            t._node = node
+
+        it = iter(new_outs)
+        result = [next(it) if isinstance(o, Tensor) else o for o in out_list]
+        return result if multi else result[0]
+
+
+class PyLayerContext_:
+    pass
+
+
+# ---------------------------------------------------------------------------
+# functional transforms (reference: python/paddle/autograd/autograd.py,
+# incubate/autograd/functional.py) — direct jax mappings
+# ---------------------------------------------------------------------------
+
+
+def _to_pure(func):
+    def pure(*arrs):
+        ts = [Tensor(a, stop_gradient=True) for a in arrs]
+        with no_grad():
+            out = func(*ts)
+        return jax.tree_util.tree_map(
+            lambda o: o._data if isinstance(o, Tensor) else o, out)
+    return pure
+
+
+def vjp(func, xs, v=None):
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrs = [x._data for x in xs_l]
+    out, vjp_fn = jax.vjp(_to_pure(func), *arrs)
+    if v is None:
+        v_arr = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        v_arr = jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else t,
+            v if not isinstance(v, (list, tuple)) or len(v) > 1 else v[0])
+    grads = vjp_fn(v_arr)
+    wrap = lambda tree: jax.tree_util.tree_map(Tensor, tree)
+    return wrap(out), [Tensor(g) for g in grads] if len(grads) > 1 else Tensor(grads[0])
+
+
+def jvp(func, xs, v=None):
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrs = [x._data for x in xs_l]
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrs]
+    else:
+        v_l = v if isinstance(v, (list, tuple)) else [v]
+        tangents = [t._data if isinstance(t, Tensor) else jnp.asarray(t) for t in v_l]
+    out, tang = jax.jvp(_to_pure(func), tuple(arrs), tuple(tangents))
+    wrap = lambda tree: jax.tree_util.tree_map(Tensor, tree)
+    return wrap(out), wrap(tang)
+
+
+def jacobian(func, xs, batch_axis=None):
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrs = [x._data for x in xs_l]
+    jac = jax.jacrev(_to_pure(func), argnums=tuple(range(len(arrs))))(*arrs)
+    wrap = lambda tree: jax.tree_util.tree_map(Tensor, tree)
+    if not isinstance(xs, (list, tuple)):
+        return wrap(jac[0] if isinstance(jac, tuple) else jac)
+    return wrap(jac)
+
+
+def hessian(func, xs, batch_axis=None):
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrs = [x._data for x in xs_l]
+    hes = jax.hessian(_to_pure(func), argnums=tuple(range(len(arrs))))(*arrs)
+    wrap = lambda tree: jax.tree_util.tree_map(Tensor, tree)
+    if not isinstance(xs, (list, tuple)):
+        h = hes[0] if isinstance(hes, tuple) else hes
+        h = h[0] if isinstance(h, tuple) else h
+        return wrap(h)
+    return wrap(hes)

@@ -1,0 +1,78 @@
+"""paddle.device surface. reference: python/paddle/device/__init__.py."""
+
+from ..framework.device import (  # noqa: F401
+    set_device, get_device, device_count, Place, CPUPlace, TPUPlace,
+    CUDAPlace, CUDAPinnedPlace, XPUPlace, is_compiled_with_cuda,
+    is_compiled_with_xpu, is_compiled_with_tpu, cuda_device_count,
+)
+
+import contextlib
+
+
+class Stream:
+    """Parity shim: XLA owns stream scheduling on TPU."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        import jax
+        (jax.device_put(0) + 0).block_until_ready()
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+    def wait_event(self, event):
+        pass
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        pass
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+@contextlib.contextmanager
+def stream_guard(stream):
+    yield
+
+
+def synchronize(device=None):
+    import jax
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+class cuda:
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def is_available():
+        return False
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def empty_cache():
+        pass

@@ -1,0 +1,42 @@
+"""paddle.distributed — GSPMD over jax.sharding.Mesh.
+
+reference: python/paddle/distributed/ (148k LoC). The TPU-native collapse
+(SURVEY.md §5): ProcessGroup/NCCLCommContext/TCPStore/launch →
+jax.distributed.initialize + Mesh; collectives → psum/all_gather/ppermute
+lowered by XLA onto ICI/DCN; DistTensor/reshard → NamedSharding +
+device_put; SPMD rules → GSPMD propagation.
+"""
+
+from __future__ import annotations
+
+from .parallel_env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, get_group, new_group,
+    is_initialized, ParallelEnv, barrier, destroy_process_group,
+)
+from .placement import (  # noqa: F401
+    Placement, Shard, Replicate, Partial, ProcessMesh,
+)
+from .api import (  # noqa: F401
+    shard_tensor, dtensor_from_fn, reshard, shard_layer, shard_optimizer,
+    unshard_dtensor, dtensor_from_local, shard_dataloader, to_distributed,
+)
+from .collective import (  # noqa: F401
+    all_reduce, all_gather, all_gather_object, all_to_all, all_to_all_single,
+    reduce_scatter, broadcast, reduce, scatter, gather, send, recv, isend,
+    irecv, ReduceOp, P2POp, batch_isend_irecv, split, stream,
+)
+from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from .parallel import DataParallel  # noqa: F401
+from .launch_mod import launch, spawn  # noqa: F401
+
+
+def get_mesh():
+    from .placement import _default_mesh
+    return _default_mesh[0]
+
+
+def set_mesh(mesh):
+    from .placement import _default_mesh
+    _default_mesh[0] = mesh

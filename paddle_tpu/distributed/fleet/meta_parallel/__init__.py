@@ -1,0 +1,12 @@
+"""Meta-parallel wrappers + TP layers.
+
+reference: python/paddle/distributed/fleet/meta_parallel/ and
+fleet/layers/mpu/mp_layers.py.
+"""
+
+from .parallel_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy, TensorParallel, ShardingParallel, SegmentParallel,
+)
+from .pp_layers import PipelineLayer, LayerDesc, SharedLayerDesc  # noqa: F401
+from .pipeline_parallel import PipelineParallel  # noqa: F401

@@ -1,0 +1,68 @@
+"""fleet.utils — recompute (activation checkpointing).
+
+reference: python/paddle/distributed/fleet/recompute/recompute.py:455 +
+recompute_hybrid.py (TP-aware RNG).
+
+TPU-native: recompute maps to jax.checkpoint (remat) around the block. In
+eager tape mode we record one vjp over the remat-wrapped function, so the
+backward re-runs the forward — the exact semantics of RecomputeFunction —
+while under jit.to_static the same jax.checkpoint drives XLA rematerialization.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ....framework.core import Tensor, execute
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, **kwargs):
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    tensor_set = set(tensor_idx)
+    others = list(args)
+
+    from ....framework.random import get_rng_state, set_rng_state
+    rng_snapshot = get_rng_state() if preserve_rng_state else None
+
+    def pure(*arrays):
+        it = iter(arrays)
+        call_args = [Tensor(next(it), stop_gradient=args[i].stop_gradient)
+                     if i in tensor_set else others[i]
+                     for i in range(len(args))]
+        if rng_snapshot is not None:
+            set_rng_state(rng_snapshot)
+        from ....framework import core as _core
+        ctx = _core.TraceContext()  # suppress per-op taping inside
+        with ctx:
+            out = function(*call_args, **kwargs)
+        if isinstance(out, Tensor):
+            return out._data
+        return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+
+    remat_fn = jax.checkpoint(pure)
+    tensor_args = [args[i] for i in tensor_idx]
+    return execute(remat_fn, *tensor_args, _name="recompute")
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    funcs = list(functions)
+    n = len(funcs)
+    seg = max(n // max(segments, 1), 1)
+    out = args[0] if len(args) == 1 else args
+
+    def run_segment(fs):
+        def seg_fn(x):
+            for f in fs:
+                x = f(x)
+            return x
+        return seg_fn
+
+    for i in range(0, n, seg):
+        out = recompute(run_segment(funcs[i:i + seg]), out)
+    return out

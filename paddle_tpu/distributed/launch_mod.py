@@ -1,0 +1,47 @@
+"""Launcher. reference: python/paddle/distributed/launch/main.py:23.
+
+On TPU pods the launch topology is fixed by the TPU runtime (one process per
+host, all chips visible); `python -m paddle_tpu.distributed.launch train.py`
+execs the script after jax.distributed bootstrap. Elastic ranges / etcd
+rendezvous map to the TPU VM autoscaler + jax coordination service.
+"""
+
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+__all__ = ["launch", "spawn"]
+
+
+def launch():
+    args = sys.argv[1:]
+    script = None
+    script_args = []
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a.startswith("--"):
+            if "=" not in a and i + 1 < len(args) and not args[i + 1].startswith("--"):
+                i += 1
+        elif script is None:
+            script = a
+            script_args = args[i + 1:]
+            break
+        i += 1
+    if script is None:
+        print("usage: python -m paddle_tpu.distributed.launch [opts] script.py ...")
+        sys.exit(1)
+    from .parallel_env import init_parallel_env
+    init_parallel_env()
+    sys.argv = [script] + list(script_args)
+    runpy.run_path(script, run_name="__main__")
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference: python/paddle/distributed/spawn.py. Single-controller JAX
+    drives all local chips from one process, so spawn degenerates to a direct
+    call (the mesh provides the parallelism)."""
+    func(*args)
+    return None

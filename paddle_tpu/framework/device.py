@@ -1,0 +1,91 @@
+"""Device/place API. reference: python/paddle/device/__init__.py, paddle/phi/common/place.h.
+
+On TPU there is one first-class device family; Place collapses to a thin
+wrapper over jax.Device. CUDAPlace/XPUPlace aliases exist for API parity and
+map to the accelerator if present, else CPU.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_current_device = None
+
+
+class Place:
+    def __init__(self, kind: str, device_id: int = 0):
+        self.kind = kind
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.device_id})"
+
+    def __eq__(self, other):
+        return isinstance(other, Place) and (self.kind, self.device_id) == (
+            other.kind,
+            other.device_id,
+        )
+
+
+class CPUPlace(Place):
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
+class TPUPlace(Place):
+    def __init__(self, device_id=0):
+        super().__init__("tpu", device_id)
+
+
+class CUDAPlace(Place):  # parity alias: maps to the accelerator
+    def __init__(self, device_id=0):
+        super().__init__("tpu", device_id)
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+class XPUPlace(TPUPlace):
+    pass
+
+
+def set_device(device: str):
+    """paddle.set_device('tpu') / ('cpu') / ('tpu:0')"""
+    global _current_device
+    name, _, idx = device.partition(":")
+    idx = int(idx) if idx else 0
+    name = {"gpu": "tpu", "cuda": "tpu", "xpu": "tpu"}.get(name, name)
+    devs = jax.devices() if name != "cpu" else jax.devices("cpu")
+    if name not in ("cpu",):
+        accel = [d for d in devs if d.platform != "cpu"]
+        devs = accel or devs
+    _current_device = devs[min(idx, len(devs) - 1)]
+    jax.config.update("jax_default_device", _current_device)
+    return get_device()
+
+
+def get_device() -> str:
+    d = _current_device or jax.devices()[0]
+    plat = "tpu" if d.platform not in ("cpu",) else "cpu"
+    return f"{plat}:{d.id}" if plat != "cpu" else "cpu"
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def cuda_device_count() -> int:
+    return 0

@@ -1,0 +1,95 @@
+"""Dtype registry. reference: paddle/phi/common/data_type.h + python/paddle/framework/dtype.py.
+
+TPU-first: bfloat16 is the native accelerator dtype (MXU) — float64 is
+discouraged (soft-emulated on TPU); default float dtype is float32.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+NAME2DTYPE = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+    "fp16": jnp.float16,
+    "bf16": jnp.bfloat16,
+    "fp32": jnp.float32,
+    "fp64": jnp.float64,
+}
+
+_DEFAULT_FLOAT = [jnp.float32]
+
+
+def set_default_dtype(d):
+    _DEFAULT_FLOAT[0] = convert_dtype(d)
+
+
+def get_default_dtype():
+    return dtype_name(_DEFAULT_FLOAT[0])
+
+
+def convert_dtype(dtype):
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return NAME2DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"unknown dtype {dtype!r}")
+    return np.dtype(dtype).type if not hasattr(dtype, "dtype") else dtype
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name if dtype != jnp.bfloat16 else "bfloat16"
+
+
+def asarray_default(data):
+    """Convert python/numpy data with paddle-like defaults: python floats ->
+    default float dtype; numpy arrays keep their dtype (float64 preserved for
+    numeric-check parity on CPU; cast on demand for TPU)."""
+    if isinstance(data, (bool, np.bool_)):
+        return jnp.asarray(data, dtype=jnp.bool_)
+    if isinstance(data, (int, np.integer)):
+        return jnp.asarray(data, dtype=jnp.int64)
+    if isinstance(data, (float, np.floating)):
+        return jnp.asarray(data, dtype=_DEFAULT_FLOAT[0])
+    if isinstance(data, (list, tuple)):
+        a = np.asarray(data)
+        if a.dtype == np.float64:
+            a = a.astype(np.dtype(_DEFAULT_FLOAT[0]))
+        if a.dtype == np.int32:
+            pass
+        return jnp.asarray(a)
+    return jnp.asarray(data)
+
+
+def is_floating(dtype):
+    return jnp.issubdtype(dtype, jnp.floating)
+
+
+def is_integer(dtype):
+    return jnp.issubdtype(dtype, jnp.integer)

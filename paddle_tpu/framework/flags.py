@@ -1,0 +1,71 @@
+"""Runtime flag registry.
+
+reference: paddle/common/flags.h:38-89 (PD_DEFINE_* macros),
+paddle/common/flags_native.cc (native parser), surfaced as
+paddle.set_flags/get_flags (python/paddle/base/framework.py:132,157).
+
+TPU-native: most of the ~190 reference flags control CUDA allocators,
+cuDNN autotune, NCCL — irrelevant under XLA. We keep the registry shape
+(env-var override `FLAGS_*`, set/get API) and define the flags that
+matter on TPU.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+_REGISTRY: dict[str, dict] = {}
+
+
+def define_flag(name: str, default: Any, help_: str = ""):
+    env = os.environ.get("FLAGS_" + name)
+    value = default
+    if env is not None:
+        if isinstance(default, bool):
+            value = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            value = int(env)
+        elif isinstance(default, float):
+            value = float(env)
+        else:
+            value = env
+    _REGISTRY[name] = {"value": value, "default": default, "help": help_}
+    return value
+
+
+def set_flags(flags: dict):
+    """paddle.set_flags"""
+    for k, v in flags.items():
+        k = k.removeprefix("FLAGS_")
+        if k not in _REGISTRY:
+            raise ValueError(f"unknown flag FLAGS_{k}")
+        _REGISTRY[k]["value"] = v
+
+
+def get_flags(flags):
+    """paddle.get_flags"""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        k2 = k.removeprefix("FLAGS_")
+        if k2 not in _REGISTRY:
+            raise ValueError(f"unknown flag {k}")
+        out[k] = _REGISTRY[k2]["value"]
+    return out
+
+
+def flag_value(name: str):
+    return _REGISTRY[name]["value"]
+
+
+# ---- TPU-relevant flags (counterparts noted) ------------------------------
+define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf (ref: FLAGS_check_nan_inf)")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >0: log only")
+define_flag("use_bfloat16_matmul", True, "prefer bf16 matmul accumulation on MXU")
+define_flag("log_memory_stats", False, "log live buffer stats (ref: FLAGS_log_memory_stats)")
+define_flag("benchmark", False, "sync after each op for timing (ref: FLAGS_benchmark)")
+define_flag("jit_default_backend", "xla", "compiled-step backend")
+define_flag("flash_attention_backend", "auto", "auto|pallas|xla for scaled_dot_product_attention")
+define_flag("enable_auto_remat", False, "apply jax.checkpoint policy to compiled blocks")

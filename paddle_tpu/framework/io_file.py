@@ -1,0 +1,55 @@
+"""paddle.save / paddle.load. reference: python/paddle/framework/io.py:773.
+
+State dicts are pickled with tensors converted to numpy (device-independent,
+works for TPU arrays)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core import Tensor
+
+__all__ = ["save", "load"]
+
+
+def _to_savable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._data),
+                "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_savable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_savable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _from_savable(obj):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            t = Tensor(jnp.asarray(obj["data"]),
+                       stop_gradient=obj.get("stop_gradient", True))
+            t.name = obj.get("name")
+            return t
+        return {k: _from_savable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_from_savable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_savable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return _from_savable(pickle.load(f))

@@ -1,0 +1,103 @@
+"""Global RNG state over jax PRNG keys.
+
+reference: paddle.seed (python/paddle/framework/random.py) and the TP-aware
+RNG tracker (python/paddle/distributed/fleet/layers/mpu/random.py
+get_rng_state_tracker). Paddle's stateful generators map onto a host-side
+counter folded into a base key — inside a `to_static` trace the key comes
+from a traced input so compiled steps get fresh randomness per call without
+retracing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+class _GlobalRNG:
+    def __init__(self, seed: int = 0):
+        self.base = jax.random.key(seed)
+        self.counter = 0
+        # trace mode: stack of (traced_key, [counter]) installed by jit.to_static
+        self.trace_stack = []
+
+    def seed(self, s: int):
+        self.base = jax.random.key(s)
+        self.counter = 0
+
+    def next_key(self):
+        if self.trace_stack:
+            key, ctr = self.trace_stack[-1]
+            ctr[0] += 1
+            return jax.random.fold_in(key, ctr[0])
+        self.counter += 1
+        return jax.random.fold_in(self.base, self.counter)
+
+    @contextlib.contextmanager
+    def trace_scope(self, traced_key):
+        self.trace_stack.append((traced_key, [0]))
+        try:
+            yield
+        finally:
+            self.trace_stack.pop()
+
+
+_global_rng = _GlobalRNG()
+
+
+def seed(s: int):
+    """paddle.seed"""
+    _global_rng.seed(int(s))
+    return _global_rng
+
+
+def next_key():
+    return _global_rng.next_key()
+
+
+def get_rng_state():
+    return (_global_rng.base, _global_rng.counter)
+
+
+def set_rng_state(state):
+    _global_rng.base, _global_rng.counter = state
+
+
+class RNGStatesTracker:
+    """Named RNG states for TP determinism.
+
+    reference: python/paddle/distributed/fleet/layers/mpu/random.py:RNGStatesTracker —
+    used so dropout inside tensor-parallel regions draws per-rank-unique or
+    replicated noise depending on the named state.
+    """
+
+    def __init__(self):
+        self.states = {}
+
+    def add(self, name, seed_):
+        if name in self.states:
+            raise ValueError(f"state {name} already exists")
+        self.states[name] = _GlobalRNG(int(seed_))
+
+    def reset(self):
+        self.states = {}
+
+    @contextlib.contextmanager
+    def rng_state(self, name="global_seed"):
+        if name not in self.states:
+            self.add(name, hash(name) % (2**31))
+        global _global_rng
+        prev = _global_rng
+        _global_rng = self.states[name]
+        try:
+            yield
+        finally:
+            _global_rng = prev
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker

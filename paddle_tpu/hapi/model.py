@@ -1,0 +1,211 @@
+"""hapi.Model — high-level train/eval/predict.
+
+reference: python/paddle/hapi/model.py — Model:1472, fit:2200,
+DynamicGraphAdapter:1196. The adapter split disappears: the train step is
+always the eager tape path, optionally compiled end-to-end when the user
+passes jit.to_static-wrapped networks.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Tensor, no_grad
+from ..framework.io_file import load as _load, save as _save
+from .callbacks import CallbackList, ProgBarLogger
+
+__all__ = ["Model", "summary"]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics else [])
+
+    def _compute_loss(self, outputs, labels):
+        if self._loss is None:
+            return outputs
+        if isinstance(labels, (list, tuple)):
+            return self._loss(outputs, *labels)
+        return self._loss(outputs, labels)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = [float(np.asarray(loss._data))]
+        for m in self._metrics:
+            m.update(m.compute(outputs, labels))
+        return metrics
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        for m in self._metrics:
+            m.update(m.compute(outputs, labels))
+        return [float(np.asarray(loss._data))]
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        return self.network(*inputs)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        """reference: hapi/model.py:2200."""
+        from ..io import DataLoader, Dataset
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        cbks = CallbackList((callbacks or []) + [ProgBarLogger(log_freq, verbose)])
+        cbks.set_model(self)
+        cbks.set_params({"epochs": epochs, "steps": None, "verbose": verbose,
+                         "metrics": ["loss"] + [n for m in self._metrics
+                                                for n in (m.name() if isinstance(m.name(), list) else [m.name()])]})
+        cbks.on_train_begin()
+        self.stop_training = False
+        it_count = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                x, y = self._split_batch(batch)
+                metrics = self.train_batch(x, y)
+                logs = {"loss": metrics[0]}
+                for m in self._metrics:
+                    names = m.name() if isinstance(m.name(), list) else [m.name()]
+                    vals = m.accumulate()
+                    vals = vals if isinstance(vals, list) else [vals]
+                    logs.update(dict(zip(names, vals)))
+                cbks.on_train_batch_end(step, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size,
+                              verbose=0, callbacks=callbacks)
+            if save_dir:
+                import os
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self.stop_training or (num_iters is not None and it_count >= num_iters):
+                break
+        cbks.on_train_end()
+
+    def _split_batch(self, batch):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) == 2:
+                return batch[0], batch[1]
+            return batch[:-1], batch[-1]
+        return batch, None
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        from ..io import DataLoader, Dataset
+        loader = (DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+                  if isinstance(eval_data, Dataset) else eval_data)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            x, y = self._split_batch(batch)
+            losses.append(self.eval_batch(x, y)[0])
+        result = {"loss": [float(np.mean(losses))] if losses else [0.0]}
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, list) else [vals]
+            result.update(dict(zip(names, vals)))
+        if verbose:
+            print("Eval:", result)
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+        loader = (DataLoader(test_data, batch_size=batch_size, num_workers=num_workers)
+                  if isinstance(test_data, Dataset) else test_data)
+        outputs = []
+        for batch in loader:
+            x, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(x))
+        if stack_outputs and outputs:
+            from ..tensor.manipulation import concat
+            if isinstance(outputs[0], Tensor):
+                return [concat(outputs, 0)]
+        return [outputs]
+
+    def save(self, path, training=True):
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+        state = _load(path + ".pdparams") if os.path.exists(path + ".pdparams") else _load(path)
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(_load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtype)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """reference: python/paddle/hapi/model_summary.py."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    width = max([len(r[0]) for r in rows], default=20) + 2
+    print(f"{'Layer (param)':<{width}}{'Shape':<20}{'Param #':<12}")
+    print("-" * (width + 32))
+    for name, shape, n in rows:
+        print(f"{name:<{width}}{str(shape):<20}{n:<12}")
+    print("-" * (width + 32))
+    print(f"Total params: {total}")
+    print(f"Trainable params: {trainable}")
+    print(f"Non-trainable params: {total - trainable}")
+    return {"total_params": total, "trainable_params": trainable}

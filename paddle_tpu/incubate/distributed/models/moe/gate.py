@@ -1,0 +1,68 @@
+"""MoE gates. reference: python/paddle/incubate/distributed/models/moe/gate/
+{gshard,switch,naive}_gate.py."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....framework.core import execute
+from .....nn.layer.layers import Layer
+
+__all__ = ["NaiveGate", "GShardGate", "SwitchGate"]
+
+
+class NaiveGate(Layer):
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2):
+        super().__init__()
+        self.top_k = top_k
+        self.num_expert = num_expert
+        from .....nn.layer.common import Linear
+        self.gate = Linear(d_model, num_expert)
+
+    def forward(self, x):
+        logits = self.gate(x)
+        from .....nn.functional import softmax
+        return softmax(logits, axis=-1)
+
+
+class GShardGate(NaiveGate):
+    """Adds the GShard aux load-balancing loss (stored on .loss)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2,
+                 capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, top_k)
+        self.loss = None
+
+    def forward(self, x):
+        probs = super().forward(x)
+
+        def aux(p):
+            me = jnp.mean(p, axis=0)
+            top1 = jnp.argmax(p, axis=-1)
+            ce = jnp.mean(jax.nn.one_hot(top1, p.shape[-1], dtype=p.dtype), axis=0)
+            return jnp.sum(me * ce) * p.shape[-1]
+
+        self.loss = execute(aux, probs, _name="gshard_aux_loss")
+        return probs
+
+
+class SwitchGate(NaiveGate):
+    def __init__(self, d_model, num_expert, world_size=1, top_k=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, 1)
+        self.eps = switch_eps
+        self.loss = None
+
+    def forward(self, x):
+        logits = self.gate(x)
+        if self.training:
+            from .....framework.random import next_key
+            key = next_key()
+            noise = execute(
+                lambda a: a + jax.random.uniform(key, a.shape, a.dtype,
+                                                 1 - self.eps, 1 + self.eps),
+                logits, _name="switch_noise")
+            logits = noise
+        from .....nn.functional import softmax
+        return softmax(logits, axis=-1)

@@ -1,0 +1,267 @@
+"""Fused transformer functionals — the TPU hot-op layer.
+
+reference: python/paddle/incubate/nn/functional/ — fused_rms_norm.py,
+fused_rotary_position_embedding.py, swiglu.py, fused_moe.py,
+block_multihead_attention.py, masked_multihead_attention.py,
+variable_length_memory_efficient_attention.py, fused_dot_product_attention.py.
+
+TPU-native: "fused" means one XLA fusion (these compositions fuse fully) or
+a Pallas kernel where XLA can't (flash attention). APIs keep reference names
+so model code ports verbatim.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....framework.core import Tensor, execute
+from ....nn import functional as F
+
+__all__ = ["fused_rms_norm", "fused_layer_norm",
+           "fused_rotary_position_embedding", "swiglu", "fused_linear",
+           "fused_linear_activation", "fused_bias_dropout_residual_layer_norm",
+           "fused_dot_product_attention", "fused_multi_head_attention",
+           "fused_feedforward", "masked_multihead_attention",
+           "variable_length_memory_efficient_attention",
+           "block_multihead_attention", "fused_moe"]
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, **kw):
+    """reference: incubate/nn/functional/fused_rms_norm.py. One XLA fusion:
+    (optional residual-add) → rms-normalize → scale."""
+    args = [x]
+    if residual is not None:
+        args.append(residual)
+    if bias is not None:
+        args.append(bias)
+    if norm_weight is not None:
+        args.append(norm_weight)
+
+    def f(a, *rest):
+        i = 0
+        if residual is not None:
+            a = a + rest[i]; i += 1
+        if bias is not None:
+            a = a + rest[i]; i += 1
+        a32 = a.astype(jnp.float32)
+        ms = jnp.mean(a32 * a32, axis=-1, keepdims=True)
+        out = (a32 * jax.lax.rsqrt(ms + epsilon)).astype(a.dtype)
+        if norm_weight is not None:
+            out = out * rest[i]
+        return out
+
+    out = execute(f, *args, _name="rms_norm")
+    if residual is not None:
+        return out, (x + residual if bias is None else x + residual + bias)
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None, **kw):
+    if residual is not None:
+        x = x + residual
+    if bias is not None:
+        x = x + bias
+    out = F.layer_norm(x, x.shape[-1], norm_weight, norm_bias, epsilon)
+    if residual is not None:
+        return out, x
+    return out
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """RoPE. reference: incubate/nn/functional/fused_rotary_position_embedding.py.
+    q/k: (batch, seq, heads, head_dim)."""
+
+    def make_sincos(seq, dim, dtype):
+        inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+        t = jnp.arange(seq, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv)  # (seq, dim/2)
+        if use_neox_rotary_style:
+            emb = jnp.concatenate([freqs, freqs], axis=-1)
+        else:
+            emb = jnp.repeat(freqs, 2, axis=-1)
+        return jnp.sin(emb).astype(dtype), jnp.cos(emb).astype(dtype)
+
+    def rotate_half(x):
+        if use_neox_rotary_style:
+            x1, x2 = jnp.split(x, 2, axis=-1)
+            return jnp.concatenate([-x2, x1], axis=-1)
+        x1 = x[..., ::2]
+        x2 = x[..., 1::2]
+        return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+
+    def apply_one(x, s, c, pos):
+        if pos is not None:
+            s = jnp.take(s, pos, axis=0)
+            c = jnp.take(c, pos, axis=0)
+            s = s[:, :, None, :]
+            c = c[:, :, None, :]
+        else:
+            s = s[None, :, None, :]
+            c = c[None, :, None, :]
+        return (x * c + rotate_half(x) * s).astype(x.dtype)
+
+    tensors = [t for t in (q, k, v) if t is not None]
+    extra = []
+    if sin is not None:
+        extra = [sin, cos]
+    if position_ids is not None:
+        extra.append(position_ids)
+
+    def f(*arrs):
+        n = len(tensors)
+        qa = arrs[0]
+        seq, dim = qa.shape[1], qa.shape[-1]
+        idx = n
+        if sin is not None:
+            s_, c_ = arrs[idx], arrs[idx + 1]
+            s_ = s_.reshape(s_.shape[-2], s_.shape[-1])
+            c_ = c_.reshape(c_.shape[-2], c_.shape[-1])
+            idx += 2
+        else:
+            s_, c_ = make_sincos(seq, dim, qa.dtype)
+        pos = arrs[idx] if position_ids is not None else None
+        outs = tuple(apply_one(arrs[i], s_, c_, pos) for i in range(n))
+        return outs if len(outs) > 1 else outs[0]
+
+    outs = execute(f, *(tensors + extra), _name="fused_rope")
+    if not isinstance(outs, tuple):
+        outs = (outs,)
+    result = []
+    it = iter(outs)
+    for t in (q, k, v):
+        result.append(next(it) if t is not None else None)
+    return tuple(result)
+
+
+def swiglu(x, y=None, name=None):
+    """reference: incubate/nn/functional/swiglu.py — silu(x) * y (y defaults
+    to the second half of x)."""
+    if y is None:
+        def f(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+        return execute(f, x, _name="swiglu")
+    return execute(lambda a, b: jax.nn.silu(a) * b, x, y, _name="swiglu")
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    def f(a, w, *rest):
+        if transpose_weight:
+            w = w.T
+        out = a @ w
+        if rest:
+            out = out + rest[0]
+        return out
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return execute(f, *args, _name="linear")
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    def f(a, w, b):
+        if trans_x:
+            a = a.T
+        if trans_y:
+            w = w.T
+        out = a @ w + b
+        if activation == "gelu":
+            return jax.nn.gelu(out)
+        if activation == "relu":
+            return jax.nn.relu(out)
+        return out
+    return execute(f, x, y, bias, _name="linear")
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True, mode="upscale_in_train",
+                                           name=None):
+    out = x if bias is None else x + bias
+    out = F.dropout(out, dropout_rate, training=training, mode=mode)
+    out = out + residual
+    return F.layer_norm(out, out.shape[-1], ln_scale, ln_bias, ln_epsilon)
+
+
+def fused_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                is_causal=False, training=True, **kw):
+    return F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                          dropout_p=dropout_p,
+                                          is_causal=is_causal, training=training)
+
+
+def fused_multi_head_attention(*args, **kwargs):
+    raise NotImplementedError(
+        "use nn.MultiHeadAttention (XLA fuses the projections + attention)")
+
+
+def fused_feedforward(*args, **kwargs):
+    raise NotImplementedError(
+        "use Linear+activation composition (one XLA fusion on TPU)")
+
+
+def masked_multihead_attention(x, cache_kv=None, *args, **kwargs):
+    raise NotImplementedError(
+        "decode-time MHA: see paddle_tpu.ops.pallas.decode_attention (planned)")
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0):
+    # static-shape TPU design: dense attention with a length mask
+    import numpy as np
+    def f(q, k, v, sl, kl, *rest):
+        b, h, sq, d = q.shape  # this API uses (b, h, s, d)
+        sk = k.shape[2]
+        qv = jnp.swapaxes(q, 1, 2)
+        kv_ = jnp.swapaxes(k, 1, 2)
+        vv = jnp.swapaxes(v, 1, 2)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qv, kv_,
+                            preferred_element_type=jnp.float32)
+        s = scale if scale is not None else 1.0 / (d ** 0.5)
+        logits = logits * s
+        kmask = jnp.arange(sk)[None, :] < kl[:, None]
+        logits = jnp.where(kmask[:, None, None, :], logits, -1e30)
+        if causal:
+            cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            logits = jnp.where(cm, logits, -1e30)
+        if rest:
+            logits = logits + rest[0]
+        p = jax.nn.softmax(logits, -1).astype(v.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, vv)
+        return jnp.swapaxes(out, 1, 2)
+    args = [query, key, value, seq_lens, kv_seq_lens] + ([mask] if mask is not None else [])
+    return execute(f, *args, _name="varlen_attention")
+
+
+def block_multihead_attention(*args, **kwargs):
+    raise NotImplementedError(
+        "paged-KV decode attention: see paddle_tpu.ops.pallas (planned)")
+
+
+def fused_moe(x, gate_weight, expert_weights1, expert_bias1, expert_weights2,
+              expert_bias2, quant_method="None", moe_topk=2, norm_topk_prob=True):
+    """Dense-einsum MoE (every token × every expert masked by top-k gate) —
+    the XLA-friendly formulation for moderate expert counts; the all-to-all
+    EP version lives in incubate.distributed.models.moe."""
+    def f(a, gw, w1, b1, w2, b2):
+        scores = jax.nn.softmax(a @ gw, axis=-1)
+        topv, topi = jax.lax.top_k(scores, moe_topk)
+        if norm_topk_prob:
+            topv = topv / jnp.sum(topv, -1, keepdims=True)
+        n_exp = w1.shape[0]
+        onehot = jax.nn.one_hot(topi, n_exp, dtype=a.dtype)  # (..., topk, E)
+        gates = jnp.einsum("...ke,...k->...e", onehot, topv)
+        h = jnp.einsum("...d,edh->...eh", a, w1) + b1
+        h = jax.nn.gelu(h)
+        out = jnp.einsum("...eh,ehd->...ed", h, w2) + b2
+        return jnp.einsum("...ed,...e->...d", out, gates)
+    return execute(f, x, gate_weight, expert_weights1, expert_bias1,
+                   expert_weights2, expert_bias2, _name="fused_moe")

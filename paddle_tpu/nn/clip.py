@@ -1,0 +1,118 @@
+"""Gradient clipping. reference: python/paddle/nn/clip.py.
+
+ClipGradByGlobalNorm computes the global norm over all grads in one fused
+XLA reduction (under jit) — the reference needs a multi-tensor CUDA kernel
+for the same.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, execute
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = min if min is not None else -max
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, execute(lambda a: jnp.clip(a, self.min, self.max), g,
+                                   _name="clip_by_value")))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            def f(a):
+                n = jnp.sqrt(jnp.sum(a.astype(jnp.float32) ** 2))
+                scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+                return (a * scale).astype(a.dtype)
+            out.append((p, execute(f, g, _name="clip_by_norm")))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """reference: python/paddle/nn/clip.py:ClipGradByGlobalNorm; hybrid-parallel
+    variant reduces the norm across TP/PP groups
+    (fleet HybridParallelClipGrad) — under GSPMD the partial norms of sharded
+    grads are combined by XLA automatically."""
+
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = clip_norm
+
+    def _clip(self, params_grads):
+        grads = [g for _, g in params_grads if g is not None]
+        if not grads:
+            return params_grads
+
+        def sq(a):
+            return jnp.sum(a.astype(jnp.float32) ** 2)
+
+        def f(*arrs):
+            total = jnp.asarray(0.0, jnp.float32)
+            for a in arrs:
+                total = total + sq(a)
+            gn = jnp.sqrt(total)
+            scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+            return tuple((a * scale).astype(a.dtype) for a in arrs)
+
+        clipped = execute(f, *grads, _name="clip_by_global_norm")
+        if not isinstance(clipped, tuple):
+            clipped = (clipped,)
+        it = iter(clipped)
+        out = []
+        for p, g in params_grads:
+            out.append((p, next(it) if g is not None else None))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    params = [parameters] if isinstance(parameters, Tensor) else list(parameters)
+    grads = [p.grad for p in params if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    def f(*arrs):
+        if norm_type == float("inf"):
+            n = jnp.max(jnp.stack([jnp.max(jnp.abs(a)) for a in arrs]))
+        else:
+            n = jnp.sum(jnp.stack([jnp.sum(jnp.abs(a.astype(jnp.float32)) ** norm_type)
+                                   for a in arrs])) ** (1.0 / norm_type)
+        scale = jnp.minimum(max_norm / (n + 1e-6), 1.0)
+        return (n,) + tuple((a * scale).astype(a.dtype) for a in arrs)
+    outs = execute(f, *grads, _name="clip_grad_norm_")
+    total = outs[0]
+    it = iter(outs[1:])
+    for p in params:
+        if p.grad is not None:
+            p.grad._data = next(it)._data
+    return total
+
+
+def clip_grad_value_(parameters, clip_value):
+    params = [parameters] if isinstance(parameters, Tensor) else list(parameters)
+    for p in params:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -clip_value, clip_value)

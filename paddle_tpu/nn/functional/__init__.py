@@ -1,0 +1,30 @@
+"""paddle.nn.functional surface. reference: python/paddle/nn/functional/__init__.py."""
+
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .attention import (  # noqa: F401
+    scaled_dot_product_attention,
+    flash_attention as _flash_attention_full,
+    sdp_kernel,
+)
+from .common import flash_attention  # noqa: F401
+
+from ...tensor.manipulation import pad  # noqa: F401
+from ...tensor.creation import one_hot  # noqa: F401
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    import jax.numpy as jnp
+    from ...framework import dtypes as _dt
+    from ...framework.core import execute
+    import numpy as np
+    if maxlen is None:
+        maxlen = int(np.asarray(x._data).max())
+    def f(a):
+        r = jnp.arange(maxlen)
+        return (r[None, :] < a[..., None]).astype(_dt.convert_dtype(dtype))
+    return execute(f, x, _name="sequence_mask")

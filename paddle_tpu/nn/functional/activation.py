@@ -1,0 +1,177 @@
+"""Activation functionals. reference: python/paddle/nn/functional/activation.py.
+
+All map to jax.nn / jnp primitives; XLA fuses them into surrounding matmuls
+on TPU (the reference needs CINN or hand-fused kernels for the same effect).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import execute
+
+__all__ = [
+    "relu", "relu_", "relu6", "elu", "elu_", "selu", "celu", "gelu", "silu",
+    "swish", "mish", "softplus", "softshrink", "hardshrink", "tanhshrink",
+    "sigmoid", "hardsigmoid", "hardswish", "hardtanh", "leaky_relu",
+    "log_sigmoid", "log_softmax", "softmax", "softmax_", "softsign",
+    "thresholded_relu", "tanh", "tanh_", "prelu", "rrelu", "maxout",
+    "glu", "gumbel_softmax",
+]
+
+
+def _unary(name, f):
+    def op(x, name=None):
+        return execute(f, x, _name=name)
+    op.__name__ = name
+    return op
+
+
+relu = lambda x, name=None: execute(jax.nn.relu, x, _name="relu")
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+tanh = _unary("tanh", jnp.tanh)
+silu = _unary("silu", jax.nn.silu)
+softsign = _unary("softsign", jax.nn.soft_sign)
+log_sigmoid = _unary("log_sigmoid", jax.nn.log_sigmoid)
+mish = _unary("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)))
+
+
+def relu_(x, name=None):
+    return x._rebind(relu(x))
+
+
+def tanh_(x, name=None):
+    return x._rebind(tanh(x))
+
+
+def relu6(x, name=None):
+    return execute(jax.nn.relu6, x, _name="relu6")
+
+
+def elu(x, alpha=1.0, name=None):
+    return execute(lambda a: jax.nn.elu(a, alpha), x, _name="elu")
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._rebind(elu(x, alpha))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return execute(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x, _name="selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return execute(lambda a: jax.nn.celu(a, alpha), x, _name="celu")
+
+
+def gelu(x, approximate=False, name=None):
+    return execute(lambda a: jax.nn.gelu(a, approximate=approximate), x, _name="gelu")
+
+
+def swish(x, name=None):
+    return execute(jax.nn.silu, x, _name="swish")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    def f(a):
+        bx = beta * a
+        return jnp.where(bx > threshold, a, jax.nn.softplus(bx) / beta)
+    return execute(f, x, _name="softplus")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return execute(lambda a: jnp.where(a > threshold, a - threshold,
+                                       jnp.where(a < -threshold, a + threshold, 0.0)),
+                   x, _name="softshrink")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return execute(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x, _name="hardshrink")
+
+
+def tanhshrink(x, name=None):
+    return execute(lambda a: a - jnp.tanh(a), x, _name="tanhshrink")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return execute(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x, _name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return execute(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x, _name="hardswish")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return execute(lambda a: jnp.clip(a, min, max), x, _name="hardtanh")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return execute(lambda a: jax.nn.leaky_relu(a, negative_slope), x, _name="leaky_relu")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return execute(lambda a: jnp.where(a > threshold, a, value), x, _name="thresholded_relu")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework import dtypes as _dt
+    def f(a):
+        if dtype is not None:
+            a = a.astype(_dt.convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return execute(f, x, _name="softmax")
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._rebind(softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework import dtypes as _dt
+    def f(a):
+        if dtype is not None:
+            a = a.astype(_dt.convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return execute(f, x, _name="log_softmax")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+    return execute(f, x, weight, _name="prelu")
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    from ...framework.random import next_key
+    def f(a):
+        if training:
+            r = jax.random.uniform(next_key(), a.shape, a.dtype, lower, upper)
+        else:
+            r = (lower + upper) / 2.0
+        return jnp.where(a >= 0, a, r * a)
+    return execute(f, x, _name="rrelu")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return execute(f, x, _name="maxout")
+
+
+def glu(x, axis=-1, name=None):
+    def f(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return execute(f, x, _name="glu")
+
+
+from ...tensor.random import gumbel_softmax  # noqa: F401,E402

@@ -1,0 +1,127 @@
+"""Attention functionals — the TPU hot path.
+
+reference: python/paddle/nn/functional/flash_attention.py:195 flash_attention,
+:976 scaled_dot_product_attention; kernel paddle/phi/kernels/gpu/flash_attn_kernel.cu
+(FlashAttention-2 via dynload).
+
+TPU-native design: default is an XLA attention that computes in fp32 with
+bf16 inputs (XLA already fuses QK^T→softmax→PV well at moderate sequence
+lengths); for long sequences a Pallas flash-attention kernel
+(paddle_tpu/ops/pallas/flash_attention.py) is selected via
+FLAGS_flash_attention_backend=auto when shapes qualify.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import flags as _flags
+from ...framework.core import Tensor, execute
+from ...framework.random import next_key
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "flash_attn_unpadded", "sdp_kernel"]
+
+
+def _xla_attention(q, k, v, bias=None, causal=False, scale=None, dropout_p=0.0,
+                   dropout_key=None):
+    # q,k,v: (batch, seq, heads, head_dim) — paddle flash_attention layout
+    hd = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (hd ** 0.5)
+    qf = q.astype(jnp.float32) if q.dtype == jnp.bfloat16 else q
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * s
+    if bias is not None:
+        logits = logits + bias.astype(logits.dtype)
+    if causal:
+        ql, kl = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((ql, kl), dtype=jnp.bool_), k=kl - ql)
+        logits = jnp.where(mask, logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _use_pallas(q_shape, head_dim, has_bias):
+    backend = _flags.flag_value("flash_attention_backend")
+    if backend == "xla":
+        return False
+    try:
+        import jax.experimental.pallas  # noqa: F401
+    except Exception:
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    if backend == "pallas":
+        return True
+    # auto: long sequence + MXU-friendly head dim
+    seq = q_shape[1]
+    return seq >= 1024 and head_dim % 128 == 0 and not has_bias
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """paddle layout: (batch, seq, num_heads, head_dim)."""
+    dropout_key = next_key() if (dropout_p > 0.0 and training) else None
+    use_pallas = _use_pallas(tuple(query.shape), query.shape[-1],
+                             attn_mask is not None) and dropout_key is None
+
+    if use_pallas:
+        from ...ops.pallas.flash_attention import flash_attention_bshd
+        args = [query, key, value]
+        def f(q, k, v):
+            return flash_attention_bshd(q, k, v, causal=is_causal)
+        return execute(f, *args, _name="flash_attention_pallas")
+
+    args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
+
+    def f(q, k, v, *rest):
+        bias = rest[0] if rest else None
+        return _xla_attention(q, k, v, bias=bias, causal=is_causal,
+                              dropout_p=dropout_p if training else 0.0,
+                              dropout_key=dropout_key)
+
+    return execute(f, *args, _name="scaled_dot_product_attention")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, *, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
+                                       is_causal=causal, training=training)
+    return (out, None) if return_softmax is not None else out
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False, name=None):
+    """Varlen attention: fall back to a dense call per the max length with
+    masking derived from cu_seqlens (XLA wants static shapes)."""
+    def f(q, k, v, cq, ck):
+        # q: (total_q, heads, dim) packed; reconstruct batch mask
+        nb = cq.shape[0] - 1
+        raise NotImplementedError
+    raise NotImplementedError(
+        "flash_attn_unpadded: pack sequences and use scaled_dot_product_attention "
+        "with an attention mask (static-shape TPU design)")
+
+
+class sdp_kernel:
+    """Context manager parity shim (torch-style backend selection)."""
+
+    def __init__(self, enable_flash=True, enable_math=True, enable_mem_efficient=True):
+        self.enable_flash = enable_flash
+
+    def __enter__(self):
+        self._prev = _flags.flag_value("flash_attention_backend")
+        _flags.set_flags({"flash_attention_backend": "pallas" if self.enable_flash else "xla"})
+        return self
+
+    def __exit__(self, *exc):
+        _flags.set_flags({"flash_attention_backend": self._prev})
+        return False

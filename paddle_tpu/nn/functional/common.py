@@ -1,0 +1,296 @@
+"""Common functionals: linear, dropout, embedding, padding, similarity.
+
+reference: python/paddle/nn/functional/common.py, input.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, execute
+from ...framework.random import next_key
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "embedding", "one_hot", "pad", "zeropad2d", "cosine_similarity",
+    "normalize", "label_smooth", "unfold", "fold", "interpolate", "upsample",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "flash_attention",
+    "bilinear",
+]
+
+from ...tensor.manipulation import pad  # noqa: F401
+from ...tensor.creation import one_hot  # noqa: F401
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b, W shaped (in, out) per paddle convention.
+    reference: python/paddle/nn/functional/common.py:linear → the MXU workhorse."""
+    if bias is None:
+        return execute(lambda a, w: a @ w, x, weight, _name="linear")
+    return execute(lambda a, w, b: a @ w + b, x, weight, bias, _name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = next_key()
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return execute(f, x, _name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = next_key()
+    def f(a):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+    return execute(f, x, _name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """reference: python/paddle/nn/functional/input.py:embedding; TP variant
+    in distributed VocabParallelEmbedding."""
+    def f(idx, w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return execute(f, x, weight, _name="embedding")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return jnp.sum(a * b, axis=axis) / jnp.maximum(na * nb, eps)
+    return execute(f, x1, x2, _name="cosine_similarity")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        n = jnp.linalg.norm(a, p, axis=axis, keepdims=True)
+        return a / jnp.maximum(n, epsilon)
+    return execute(f, x, _name="normalize")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(l, *rest):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            return (1 - epsilon) * l + epsilon * rest[0]
+        return (1 - epsilon) * l + epsilon / k
+    args = [label] + ([prior_dist] if prior_dist is not None else [])
+    return execute(f, *args, _name="label_smooth")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    args = [x1, x2, weight] + ([bias] if bias is not None else [])
+    return execute(f, *args, _name="bilinear")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col. reference: phi/kernels/funcs/im2col.h"""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    p = paddings
+    if isinstance(p, int):
+        pt = pb = pl = pr = p
+    elif len(p) == 2:
+        pt = pb = p[0]; pl = pr = p[1]
+    else:
+        pt, pl, pb, pr = p
+    def f(a):
+        n, c, h, w = a.shape
+        a2 = jnp.pad(a, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+        oh = (h + pt + pb - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (w + pl + pr - (dw * (kw - 1) + 1)) // sw + 1
+        patches = []
+        for i in range(kh):
+            for j in range(kw):
+                sl = a2[:, :, i * dh:i * dh + (oh - 1) * sh + 1:sh,
+                          j * dw:j * dw + (ow - 1) * sw + 1:sw]
+                patches.append(sl)
+        col = jnp.stack(patches, 2)  # n, c, kh*kw, oh, ow
+        return col.reshape(n, c * kh * kw, oh * ow)
+    return execute(f, x, _name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    p = paddings
+    if isinstance(p, int):
+        pt = pb = pl = pr = p
+    elif len(p) == 2:
+        pt = pb = p[0]; pl = pr = p[1]
+    else:
+        pt, pl, pb, pr = p
+    def f(a):
+        n, ckk, L = a.shape
+        c = ckk // (kh * kw)
+        lh = (oh + pt + pb - (dh * (kh - 1) + 1)) // sh + 1
+        lw = (ow + pl + pr - (dw * (kw - 1) + 1)) // sw + 1
+        col = a.reshape(n, c, kh, kw, lh, lw)
+        out = jnp.zeros((n, c, oh + pt + pb, ow + pl + pr), a.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                out = out.at[:, :, i * dh:i * dh + (lh - 1) * sh + 1:sh,
+                             j * dw:j * dw + (lw - 1) * sw + 1:sw].add(col[:, :, i, j])
+        return out[:, :, pt:pt + oh, pl:pl + ow]
+    return execute(f, x, _name="fold")
+
+
+# ---------------------------------------------------------------------------
+# interpolate / pixel shuffle (reference: nn/functional/vision.py, common.py)
+# ---------------------------------------------------------------------------
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    def f(a):
+        is_nchw = data_format in ("NCHW", "NCL", "NCDHW")
+        spatial_ndim = a.ndim - 2
+        if is_nchw:
+            spatial = a.shape[2:]
+        else:
+            spatial = a.shape[1:-1]
+        if size is not None:
+            out_size = [int(s._data) if isinstance(s, Tensor) else int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * spatial_ndim
+            out_size = [int(s * f_) for s, f_ in zip(spatial, sf)]
+        jmode = {"nearest": "nearest", "bilinear": "bilinear", "trilinear": "trilinear",
+                 "bicubic": "bicubic", "linear": "linear", "area": "linear"}[mode]
+        if is_nchw:
+            new_shape = a.shape[:2] + tuple(out_size)
+        else:
+            new_shape = (a.shape[0],) + tuple(out_size) + (a.shape[-1],)
+        if jmode == "nearest":
+            return jax.image.resize(a, new_shape, method="nearest")
+        if align_corners:
+            # jax.image.resize has no align_corners; emulate with explicit gather
+            return _resize_align_corners(a, new_shape, jmode, is_nchw)
+        return jax.image.resize(a, new_shape, method=jmode)
+    return execute(f, x, _name="interpolate")
+
+
+def _resize_align_corners(a, new_shape, method, is_nchw):
+    # linear interp with corner alignment per spatial dim
+    sp_axes = list(range(2, a.ndim)) if is_nchw else list(range(1, a.ndim - 1))
+    out = a
+    for ax in sp_axes:
+        n_in = out.shape[ax]
+        n_out = new_shape[ax]
+        if n_in == n_out:
+            continue
+        if n_out == 1 or n_in == 1:
+            idx = jnp.zeros((n_out,), jnp.float32)
+        else:
+            idx = jnp.arange(n_out) * (n_in - 1) / (n_out - 1)
+        lo = jnp.floor(idx).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, n_in - 1)
+        w = (idx - lo).astype(out.dtype)
+        shape = [1] * out.ndim
+        shape[ax] = n_out
+        w = w.reshape(shape)
+        out = jnp.take(out, lo, axis=ax) * (1 - w) + jnp.take(out, hi, axis=ax) * w
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a2 = a.reshape(n, c // (r * r), r, r, h, w)
+            a2 = a2.transpose(0, 1, 4, 2, 5, 3)
+            return a2.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a2 = a.reshape(n, h, w, r, r, c // (r * r))
+        a2 = a2.transpose(0, 1, 3, 2, 4, 5)
+        return a2.reshape(n, h * r, w * r, c // (r * r))
+    return execute(f, x, _name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a2 = a.reshape(n, c, h // r, r, w // r, r)
+            a2 = a2.transpose(0, 1, 3, 5, 2, 4)
+            return a2.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a2 = a.reshape(n, h // r, r, w // r, r, c)
+        a2 = a2.transpose(0, 1, 3, 2, 4, 5)
+        return a2.reshape(n, h // r, w // r, c * r * r)
+    return execute(f, x, _name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, groups, c // groups, h, w).transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, groups, c // groups).transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return execute(f, x, _name="channel_shuffle")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, training=True, name=None):
+    """API-parity alias; implementation in nn/functional/attention.py."""
+    from .attention import scaled_dot_product_attention
+    out = scaled_dot_product_attention(query, key, value, is_causal=causal,
+                                       dropout_p=dropout if training else 0.0)
+    if return_softmax:
+        return out, None
+    return out, None

@@ -1,0 +1,172 @@
+"""Convolutions via lax.conv_general_dilated — XLA tiles these onto the MXU.
+
+reference: python/paddle/nn/functional/conv.py; kernels
+paddle/phi/kernels/gpu/conv_kernel.cu + gpudnn. One general primitive
+replaces the whole cuDNN algo-selection + autotune machinery
+(paddle/phi/kernels/autotune/) — XLA picks the conv algorithm.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import execute
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _padding(padding, n, data_format):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    # paddle also allows [[0,0],[0,0],[h0,h1],[w0,w1]] style
+    if len(padding) == n + 2:
+        sp = padding[2:] if data_format.startswith("NC") else padding[1:-1]
+        return [(int(p[0]), int(p[1])) if isinstance(p, (list, tuple)) else (int(p), int(p)) for p in sp]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n):
+    sd = _tuple(stride, n)
+    dd = _tuple(dilation, n)
+    pad = _padding(padding, n, data_format)
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        lhs_spec = "NC" + "DHW"[3 - n:]
+    else:
+        lhs_spec = "N" + "DHW"[3 - n:] + "C"
+    rhs_spec = "OI" + "DHW"[3 - n:]
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2),
+                                        (lhs_spec, rhs_spec, out_spec))
+
+    def f(a, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=sd, padding=pad,
+            rhs_dilation=dd, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16 else None,
+        )
+        out = out.astype(a.dtype)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            ci = 1 if lhs_spec.startswith("NC") else out.ndim - 1
+            shape[ci] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return execute(f, *args, _name=f"conv{n}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 3)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, data_format, n, output_size=None):
+    sd = _tuple(stride, n)
+    dd = _tuple(dilation, n)
+    opad = _tuple(output_padding, n) if output_padding is not None else (0,) * n
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    pad = _padding(padding, n, data_format)
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        lhs_spec = "NC" + "DHW"[3 - n:]
+    else:
+        lhs_spec = "N" + "DHW"[3 - n:] + "C"
+    # paddle transpose-conv weight layout: (in, out/groups, *k)
+    rhs_spec = "IO" + "DHW"[3 - n:]
+    dn = jax.lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2),
+                                        (lhs_spec, rhs_spec, lhs_spec))
+
+    def f(a, w, *rest):
+        # grad-of-conv formulation: lhs_dilation = stride
+        k_eff = [dd[i] * (w.shape[2 + i] - 1) + 1 for i in range(n)]
+        tpad = [(k_eff[i] - 1 - pad[i][0], k_eff[i] - 1 - pad[i][1] + opad[i])
+                for i in range(n)]
+        if groups > 1:
+            # grouped transpose: split and concat along channel axis
+            ci = 1 if lhs_spec.startswith("NC") else a.ndim - 1
+            a_groups = jnp.split(a, groups, axis=ci)
+            w_groups = jnp.split(w, groups, axis=0)
+            outs = []
+            for ag, wg in zip(a_groups, w_groups):
+                wf = jnp.flip(wg, axis=tuple(range(2, 2 + n)))
+                wf = jnp.swapaxes(wf, 0, 1)  # -> (out, in, *k) as OI
+                dn2 = jax.lax.conv_dimension_numbers(
+                    (1,) * (n + 2), (1,) * (n + 2),
+                    (lhs_spec, "OI" + "DHW"[3 - n:], lhs_spec))
+                outs.append(jax.lax.conv_general_dilated(
+                    ag, wf, window_strides=(1,) * n, padding=tpad,
+                    lhs_dilation=sd, rhs_dilation=dd, dimension_numbers=dn2))
+            out = jnp.concatenate(outs, axis=ci)
+        else:
+            wf = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+            wf = jnp.swapaxes(wf, 0, 1)
+            dn2 = jax.lax.conv_dimension_numbers(
+                (1,) * (n + 2), (1,) * (n + 2),
+                (lhs_spec, "OI" + "DHW"[3 - n:], lhs_spec))
+            out = jax.lax.conv_general_dilated(
+                a, wf, window_strides=(1,) * n, padding=tpad,
+                lhs_dilation=sd, rhs_dilation=dd, dimension_numbers=dn2)
+        out = out.astype(a.dtype)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            ci = 1 if lhs_spec.startswith("NC") else out.ndim - 1
+            shape[ci] = b.shape[0]
+            out = out + b.reshape(shape)
+        if output_size is not None:
+            # crop/verify
+            pass
+        return out
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return execute(f, *args, _name=f"conv{n}d_transpose")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 1, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 2, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 3, output_size)

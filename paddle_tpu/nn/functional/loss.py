@@ -1,0 +1,369 @@
+"""Loss functionals. reference: python/paddle/nn/functional/loss.py."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, execute
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "triplet_margin_loss",
+    "triplet_margin_with_distance_loss", "multi_label_soft_margin_loss",
+    "soft_margin_loss", "poisson_nll_loss", "gaussian_nll_loss", "ctc_loss",
+    "log_loss", "square_error_cost", "sigmoid_focal_loss", "dice_loss",
+    "npair_loss", "mse_loss",
+]
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    """reference: python/paddle/nn/functional/loss.py:cross_entropy.
+    Computed in float32 via log_softmax for numeric parity with the fused
+    c_softmax_with_cross_entropy kernels."""
+    def f(logits, lab, *rest):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis) if use_softmax \
+            else jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        n_classes = logits.shape[axis]
+        if soft_label or (lab.ndim == logits.ndim and lab.shape[axis] == n_classes
+                          and jnp.issubdtype(lab.dtype, jnp.floating)):
+            tgt = lab.astype(jnp.float32)
+            if label_smoothing > 0:
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / n_classes
+            loss = -jnp.sum(tgt * lp, axis=axis)
+            valid = jnp.ones_like(loss, dtype=jnp.bool_)
+        else:
+            idx = lab
+            if idx.ndim == logits.ndim:
+                idx = jnp.squeeze(idx, axis)
+            idx = idx.astype(jnp.int32)
+            valid = idx != ignore_index
+            safe = jnp.where(valid, idx, 0)
+            picked = jnp.take_along_axis(lp, safe[..., None] if axis in (-1, logits.ndim - 1)
+                                         else jnp.expand_dims(safe, axis), axis=axis)
+            picked = jnp.squeeze(picked, axis)
+            if label_smoothing > 0:
+                smooth = -jnp.mean(lp, axis=axis)
+                loss = (1 - label_smoothing) * (-picked) + label_smoothing * smooth
+            else:
+                loss = -picked
+            if rest:  # class weights
+                w = rest[0]
+                loss = loss * jnp.take(w, safe)
+            loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+            if rest and not soft_label:
+                w = rest[0]
+                idx = lab
+                if idx.ndim == logits.ndim:
+                    idx = jnp.squeeze(idx, axis)
+                safe = jnp.where(valid, idx.astype(jnp.int32), 0)
+                denom = jnp.maximum(jnp.sum(jnp.where(valid, jnp.take(w, safe), 0.0)), 1e-9)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return execute(f, *args, _name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    # paddle keeps the reduced axis
+    from ...tensor.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, l, *rest):
+        eps = 1e-12
+        v = -(l * jnp.log(jnp.maximum(p, eps)) + (1 - l) * jnp.log(jnp.maximum(1 - p, eps)))
+        if rest:
+            v = v * rest[0]
+        return _reduce(v, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return execute(f, *args, _name="bce")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, l, *rest):
+        i = 0
+        if pos_weight is not None:
+            pw = rest[i]; i += 1
+            log_w = (pw - 1) * l + 1
+            v = (1 - l) * z + log_w * (jnp.logaddexp(0.0, -jnp.abs(z)) + jnp.maximum(-z, 0))
+        else:
+            v = jnp.maximum(z, 0) - z * l + jnp.logaddexp(0.0, -jnp.abs(z))
+        if i < len(rest):
+            v = v * rest[i]
+        return _reduce(v, reduction)
+    args = [logit, label] + [p for p in (pos_weight, weight) if p is not None]
+    return execute(f, *args, _name="bce_with_logits")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return execute(lambda a, b: _reduce((a - b) ** 2, reduction), input, label, _name="mse_loss")
+
+
+def square_error_cost(input, label):
+    return execute(lambda a, b: (a - b) ** 2, input, label, _name="square_error_cost")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return execute(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label, _name="l1_loss")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def f(lp, l, *rest):
+        idx = l.astype(jnp.int32)
+        valid = idx != ignore_index
+        safe = jnp.where(valid, idx, 0)
+        picked = jnp.take_along_axis(lp, safe[..., None] if lp.ndim == l.ndim + 1 else safe, axis=1)
+        if lp.ndim == l.ndim + 1:
+            picked = jnp.squeeze(picked, 1)
+        v = -picked
+        w = rest[0] if rest else None
+        if w is not None:
+            wv = jnp.take(w, safe)
+            v = v * wv
+        v = jnp.where(valid, v, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(jnp.where(valid, jnp.take(w, safe) if w is not None else 1.0, 0.0))
+            return jnp.sum(v) / jnp.maximum(denom, 1e-9)
+        return _reduce(v, reduction)
+    def f2(lp, l, *rest):
+        # input shape (N, C, ...) label (N, ...)
+        lp_m = jnp.moveaxis(lp, 1, -1)
+        idx = l.astype(jnp.int32)
+        valid = idx != ignore_index
+        safe = jnp.where(valid, idx, 0)
+        picked = jnp.take_along_axis(lp_m, safe[..., None], axis=-1)[..., 0]
+        v = -picked
+        w = rest[0] if rest else None
+        if w is not None:
+            v = v * jnp.take(w, safe)
+        v = jnp.where(valid, v, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(jnp.where(valid, jnp.take(w, safe) if w is not None else jnp.ones_like(v), 0.0))
+            return jnp.sum(v) / jnp.maximum(denom, 1e-9)
+        return _reduce(v, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return execute(f2, *args, _name="nll_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        v = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        # paddle huber form: 0.5*d^2 if d<delta else delta*(d-0.5*delta); uses delta=1.0
+        v = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(v, reduction)
+    return execute(f, input, label, _name="smooth_l1_loss")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(lp, t):
+        if log_target:
+            v = jnp.exp(t) * (t - lp)
+        else:
+            v = t * (jnp.log(jnp.maximum(t, 1e-12)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(v) / lp.shape[0]
+        return _reduce(v, reduction)
+    return execute(f, input, label, _name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return execute(lambda a, b, l: _reduce(jnp.maximum(0.0, -l * (a - b) + margin), reduction),
+                   input, other, label, _name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return execute(lambda a, l: _reduce(jnp.where(l == 1, a, jnp.maximum(0.0, margin - a)), reduction),
+                   input, label, _name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, l):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        v = jnp.where(l == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(v, reduction)
+    return execute(f, input1, input2, label, _name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+    return execute(f, input, positive, negative, _name="triplet_margin_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dn2 = distance_function(positive, negative)
+        from ...tensor.math import minimum
+        dn = minimum(dn, dn2)
+    return execute(lambda a, b: _reduce(jnp.maximum(0.0, a - b + margin), reduction),
+                   dp, dn, _name="triplet_margin_with_distance_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):
+    def f(z, l, *rest):
+        v = -(l * jax.nn.log_sigmoid(z) + (1 - l) * jax.nn.log_sigmoid(-z))
+        if rest:
+            v = v * rest[0]
+        return _reduce(jnp.mean(v, axis=-1), reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return execute(f, *args, _name="multi_label_soft_margin_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return execute(lambda z, l: _reduce(jnp.log1p(jnp.exp(-l * z)), reduction),
+                   input, label, _name="soft_margin_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(z, t):
+        if log_input:
+            v = jnp.exp(z) - t * z
+        else:
+            v = z - t * jnp.log(z + epsilon)
+        if full:
+            stirling = t * jnp.log(t) - t + 0.5 * jnp.log(2 * jnp.pi * t)
+            v = v + jnp.where(t > 1, stirling, 0.0)
+        return _reduce(v, reduction)
+    return execute(f, input, label, _name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def f(mu, t, var):
+        var = jnp.maximum(var, epsilon)
+        v = 0.5 * (jnp.log(var) + (t - mu) ** 2 / var)
+        if full:
+            v = v + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, mu.dtype))
+        return _reduce(v, reduction)
+    return execute(f, input, label, variance, _name="gaussian_nll_loss")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return execute(lambda p, l: -l * jnp.log(p + epsilon) - (1 - l) * jnp.log(1 - p + epsilon),
+                   input, label, _name="log_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, l, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * l + jnp.logaddexp(0.0, -jnp.abs(z))
+        p_t = p * l + (1 - p) * (1 - l)
+        mod = (1 - p_t) ** gamma
+        a_t = alpha * l + (1 - alpha) * (1 - l)
+        v = a_t * mod * ce
+        if rest:
+            v = v / rest[0]
+        return _reduce(v, reduction)
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return execute(f, *args, _name="sigmoid_focal_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def f(p, l):
+        l_oh = jax.nn.one_hot(l[..., 0] if l.shape[-1] == 1 else l, p.shape[-1], dtype=p.dtype)
+        inter = jnp.sum(p * l_oh, axis=tuple(range(1, p.ndim)))
+        union = jnp.sum(p, axis=tuple(range(1, p.ndim))) + jnp.sum(l_oh, axis=tuple(range(1, p.ndim)))
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return execute(f, input, label, _name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def f(a, p, l):
+        sim = a @ p.T
+        lab = (l[:, None] == l[None, :]).astype(sim.dtype)
+        lab = lab / jnp.sum(lab, -1, keepdims=True)
+        ce = -jnp.sum(lab * jax.nn.log_softmax(sim, -1), -1)
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, -1)) + jnp.mean(jnp.sum(p * p, -1))) * 0.25
+        return jnp.mean(ce) + reg * 2
+    return execute(f, anchor, positive, labels, _name="npair_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via dynamic-programming in lax.scan (reference: warpctc third_party dep)."""
+    def f(lp, lab, in_len, lab_len):
+        # lp: (T, N, C) paddle layout
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), -1)
+        T, N, C = lp.shape
+        S = lab.shape[1]
+        # extended labels with blanks: length 2S+1
+        ext = jnp.full((N, 2 * S + 1), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        neg_inf = jnp.float32(-1e30)
+        alpha0 = jnp.full((N, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lab = lp[0][jnp.arange(N), ext[:, 1]]
+        alpha0 = alpha0.at[:, 1].set(first_lab)
+
+        allow_skip = jnp.concatenate([
+            jnp.zeros((N, 2), dtype=jnp.bool_),
+            (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])], axis=1)
+
+        def step(alpha, lp_t):
+            a_prev = alpha
+            a_shift1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], 1)
+            a_shift2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], 1)
+            a_shift2 = jnp.where(allow_skip, a_shift2, neg_inf)
+            m = jnp.maximum(jnp.maximum(a_prev, a_shift1), a_shift2)
+            new = m + jnp.log(jnp.exp(a_prev - m) + jnp.exp(a_shift1 - m) + jnp.exp(a_shift2 - m))
+            emit = lp_t[jnp.arange(N)[:, None], ext]
+            new = new + emit
+            return new, new
+
+        _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], 0)  # (T, N, 2S+1)
+        t_idx = (in_len.astype(jnp.int32) - 1)
+        last = alphas[t_idx, jnp.arange(N)]  # (N, 2S+1)
+        end1 = 2 * lab_len.astype(jnp.int32)
+        end2 = 2 * lab_len.astype(jnp.int32) - 1
+        v1 = last[jnp.arange(N), end1]
+        v2 = last[jnp.arange(N), jnp.maximum(end2, 0)]
+        m = jnp.maximum(v1, v2)
+        ll = m + jnp.log(jnp.exp(v1 - m) + jnp.exp(v2 - m))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(jnp.float32), 1.0))
+        return _reduce(loss, reduction)
+    return execute(f, log_probs, labels, input_lengths, label_lengths, _name="ctc_loss")

@@ -1,0 +1,186 @@
+"""Normalization functionals. reference: python/paddle/nn/functional/norm.py;
+kernels paddle/phi/kernels/gpu/{batch_norm,layer_norm,group_norm}_kernel.cu.
+
+XLA fuses the mean/var/normalize/affine chain into one kernel on TPU;
+rms_norm additionally has a Pallas fast path (incubate.nn.functional).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, execute
+
+__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm",
+           "local_response_norm", "rms_norm"]
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    ch_axis = 1 if data_format.startswith("NC") else -1
+    use_stats = (not training) if use_global_stats is None else use_global_stats
+
+    def stats_shape(a):
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        return shape
+
+    if use_stats:
+        def f(a, rm, rv, *rest):
+            shape = stats_shape(a)
+            out = (a - rm.reshape(shape)) * jax.lax.rsqrt(rv.reshape(shape) + epsilon)
+            i = 0
+            if weight is not None:
+                out = out * rest[i].reshape(shape); i += 1
+            if bias is not None:
+                out = out + rest[i].reshape(shape)
+            return out.astype(a.dtype)
+        args = [x, running_mean, running_var] + [p for p in (weight, bias) if p is not None]
+        return execute(f, *args, _name="batch_norm")
+
+    # training: compute batch stats, update running stats in place
+    axes = tuple(i for i in range(x.ndim) if i != (ch_axis % x.ndim))
+
+    def f(a, *rest):
+        m = jnp.mean(a.astype(jnp.float32), axis=axes)
+        v = jnp.var(a.astype(jnp.float32), axis=axes)
+        shape = stats_shape(a)
+        out = (a - m.reshape(shape).astype(a.dtype)) * jax.lax.rsqrt(
+            v.reshape(shape).astype(a.dtype) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape)
+        return out.astype(a.dtype), m, v
+
+    from ...framework.core import buffer_update
+
+    args = [x] + [p for p in (weight, bias) if p is not None]
+    out, batch_mean, batch_var = execute(f, *args, _name="batch_norm")
+    if running_mean is not None:
+        buffer_update(running_mean,
+                      momentum * running_mean._data
+                      + (1.0 - momentum) * batch_mean._data.astype(running_mean._data.dtype))
+    if running_var is not None:
+        n = 1
+        for i in axes:
+            n *= x.shape[i]
+        unbiased = batch_var._data * (n / max(n - 1, 1))
+        buffer_update(running_var,
+                      momentum * running_var._data
+                      + (1.0 - momentum) * unbiased.astype(running_var._data.dtype))
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    nd = len(tuple(normalized_shape))
+
+    def f(a, *rest):
+        axes = tuple(range(a.ndim - nd, a.ndim))
+        a32 = a.astype(jnp.float32)
+        m = jnp.mean(a32, axis=axes, keepdims=True)
+        v = jnp.var(a32, axis=axes, keepdims=True)
+        out = (a32 - m) * jax.lax.rsqrt(v + epsilon)
+        out = out.astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * rest[i]; i += 1
+        if bias is not None:
+            out = out + rest[i]
+        return out
+
+    args = [x] + [p for p in (weight, bias) if p is not None]
+    return execute(f, *args, _name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (no mean subtraction) — the Llama-family norm.
+    reference: python/paddle/incubate/nn/functional/fused_rms_norm.py"""
+    def f(a, *rest):
+        a32 = a.astype(jnp.float32)
+        ms = jnp.mean(a32 * a32, axis=-1, keepdims=True)
+        out = (a32 * jax.lax.rsqrt(ms + epsilon)).astype(a.dtype)
+        if rest:
+            out = out * rest[0]
+        return out
+    args = [x] + ([weight] if weight is not None else [])
+    return execute(f, *args, _name="rms_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    ch_axis = 1 if data_format.startswith("NC") else -1
+
+    def f(a, *rest):
+        axes = tuple(range(2, a.ndim)) if ch_axis == 1 else tuple(range(1, a.ndim - 1))
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + eps)
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape)
+        return out.astype(a.dtype)
+
+    args = [x] + [p for p in (weight, bias) if p is not None]
+    return execute(f, *args, _name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def f(a, *rest):
+        if data_format == "NCHW" or a.ndim == 2:
+            n, c = a.shape[:2]
+            spatial = a.shape[2:]
+            g = a.reshape((n, num_groups, c // num_groups) + spatial)
+            axes = tuple(range(2, g.ndim))
+            m = jnp.mean(g, axis=axes, keepdims=True)
+            v = jnp.var(g, axis=axes, keepdims=True)
+            out = ((g - m) * jax.lax.rsqrt(v + epsilon)).reshape(a.shape)
+            shape = [1] * a.ndim
+            shape[1] = c
+        else:
+            n, c = a.shape[0], a.shape[-1]
+            spatial = a.shape[1:-1]
+            g = a.reshape((n,) + spatial + (num_groups, c // num_groups))
+            axes = tuple(range(1, g.ndim - 2)) + (g.ndim - 1,)
+            m = jnp.mean(g, axis=axes, keepdims=True)
+            v = jnp.var(g, axis=axes, keepdims=True)
+            out = ((g - m) * jax.lax.rsqrt(v + epsilon)).reshape(a.shape)
+            shape = [1] * a.ndim
+            shape[-1] = c
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape)
+        return out.astype(a.dtype)
+
+    args = [x] + [p for p in (weight, bias) if p is not None]
+    return execute(f, *args, _name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(a):
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq = a * a
+        moved = jnp.moveaxis(sq, ch_axis, -1)
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        padded = jnp.pad(moved, [(0, 0)] * (moved.ndim - 1) + [(pad_lo, pad_hi)])
+        win = jnp.stack([padded[..., i:i + moved.shape[-1]] for i in range(size)], -1)
+        s = jnp.sum(win, -1)
+        s = jnp.moveaxis(s, -1, ch_axis)
+        div = (k + alpha * s) ** beta
+        return a / div
+    return execute(f, x, _name="local_response_norm")

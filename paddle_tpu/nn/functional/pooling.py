@@ -1,0 +1,197 @@
+"""Pooling via lax.reduce_window. reference: python/paddle/nn/functional/pooling.py."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import execute
+
+__all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d",
+           "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
+           "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
+           "adaptive_max_pool2d", "adaptive_max_pool3d", "lp_pool1d", "lp_pool2d"]
+
+
+def _tuple(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(int(x) for x in v)
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    p = list(padding)
+    if len(p) == n:
+        return [(int(v), int(v)) for v in p]
+    if len(p) == 2 * n:
+        return [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _pool(x, kernel, stride, padding, n, kind, ceil_mode=False, exclusive=True,
+          data_format="NCHW"):
+    ks = _tuple(kernel, n)
+    sd = _tuple(stride if stride is not None else kernel, n)
+    pad = _pads(padding, n)
+    channels_first = data_format in ("NCL", "NCHW", "NCDHW")
+
+    def f(a):
+        if channels_first:
+            window = (1, 1) + ks
+            strides = (1, 1) + sd
+            pads = ([(0, 0), (0, 0)] + pad) if not isinstance(pad, str) else pad
+        else:
+            window = (1,) + ks + (1,)
+            strides = (1,) + sd + (1,)
+            pads = ([(0, 0)] + pad + [(0, 0)]) if not isinstance(pad, str) else pad
+        if kind == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+            return jax.lax.reduce_window(a, init, jax.lax.max, window, strides, pads)
+        s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
+        if exclusive and not isinstance(pads, str):
+            ones = jnp.ones_like(a)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+            return s / cnt
+        return s / float(np.prod(ks))
+
+    return execute(f, x, _name=f"{kind}_pool{n}d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, "max", ceil_mode, data_format=data_format)
+    return (out, None) if return_mask else out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, "max", ceil_mode, data_format=data_format)
+    if return_mask:
+        idx = _max_pool_indices(x, kernel_size, stride, padding)
+        return out, idx
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, "max", ceil_mode, data_format=data_format)
+    return (out, None) if return_mask else out
+
+
+def _max_pool_indices(x, kernel, stride, padding):
+    ks = _tuple(kernel, 2)
+    sd = _tuple(stride if stride is not None else kernel, 2)
+    pad = _pads(padding, 2)
+
+    def f(a):
+        n, c, h, w = a.shape
+        flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+        flat_idx = jnp.broadcast_to(flat_idx, a.shape)
+        # pack value+index: use pairwise select via reduce_window on tuple unsupported;
+        # trick: scale values and tie-break by -index
+        big = jnp.where(jnp.isfinite(a), a, -jnp.inf)
+        def select(x1, x2):
+            v1, i1 = x1
+            v2, i2 = x2
+            take1 = (v1 > v2) | ((v1 == v2) & (i1 < i2))
+            return jnp.where(take1, v1, v2), jnp.where(take1, i1, i2)
+        window = (1, 1) + ks
+        strides = (1, 1) + sd
+        pads = [(0, 0), (0, 0)] + pad if not isinstance(pad, str) else pad
+        v, i = jax.lax.reduce_window(
+            (big, flat_idx), (-jnp.inf, jnp.float32(h * w)), select,
+            window, strides, pads)
+        return i.astype(jnp.int64)
+    return execute(f, x, _name="max_pool_indices")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", ceil_mode, exclusive, data_format)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", ceil_mode, exclusive, data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", ceil_mode, exclusive, data_format)
+
+
+def _adaptive(x, output_size, n, kind, data_format="NCHW"):
+    os = _tuple(output_size, n)
+
+    def f(a):
+        spatial = a.shape[2:2 + n]
+        out = a
+        for d in range(n):
+            in_s, out_s = spatial[d], os[d]
+            if out_s is None or out_s == in_s:
+                continue
+            axis = 2 + d
+            starts = (np.arange(out_s) * in_s) // out_s
+            ends = ((np.arange(out_s) + 1) * in_s + out_s - 1) // out_s
+            slices = []
+            for s, e in zip(starts, ends):
+                seg = jax.lax.slice_in_dim(out, int(s), int(e), axis=axis)
+                red = jnp.max(seg, axis=axis, keepdims=True) if kind == "max" else jnp.mean(seg, axis=axis, keepdims=True)
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=axis)
+        return out
+
+    return execute(f, x, _name=f"adaptive_{kind}_pool{n}d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 1, "max")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 2, "max")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 3, "max")
+    return (out, None) if return_mask else out
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    p = float(norm_type)
+    def f(a):
+        ap = jnp.abs(a) ** p
+        return None
+    # implement via avg pool of |x|^p then root
+    from ...framework.core import Tensor
+    ap = execute(lambda a: jnp.abs(a) ** p, x, _name="lp_pow")
+    s = _pool(ap, kernel_size, stride, padding, 1, "avg", ceil_mode, False, data_format)
+    ks = _tuple(kernel_size, 1)
+    return execute(lambda a: (a * float(np.prod(ks))) ** (1.0 / p), s, _name="lp_root")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+    ap = execute(lambda a: jnp.abs(a) ** p, x, _name="lp_pow")
+    s = _pool(ap, kernel_size, stride, padding, 2, "avg", ceil_mode, False, data_format)
+    ks = _tuple(kernel_size, 2)
+    return execute(lambda a: (a * float(np.prod(ks))) ** (1.0 / p), s, _name="lp_root")

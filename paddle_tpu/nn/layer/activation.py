@@ -1,0 +1,67 @@
+"""Activation layers. reference: python/paddle/nn/layer/activation.py."""
+
+from __future__ import annotations
+
+from .. import functional as F
+from .layers import Layer
+
+__all__ = ["ReLU", "ReLU6", "ELU", "SELU", "CELU", "GELU", "Sigmoid", "Tanh",
+           "Softmax", "LogSoftmax", "LogSigmoid", "Hardshrink", "Hardsigmoid",
+           "Hardswish", "Hardtanh", "LeakyReLU", "PReLU", "RReLU", "Mish",
+           "Silu", "Swish", "Softplus", "Softshrink", "Softsign", "Tanhshrink",
+           "ThresholdedReLU", "Maxout", "GLU"]
+
+
+def _mk(name, fname, *defaults):
+    def __init__(self, *args, **kwargs):
+        Layer.__init__(self)
+        self._args = args
+        self._kwargs = {k: v for k, v in kwargs.items() if k != "name"}
+
+    def forward(self, x):
+        return getattr(F, fname)(x, *self._args, **self._kwargs)
+
+    cls = type(name, (Layer,), {"__init__": __init__, "forward": forward})
+    return cls
+
+
+ReLU = _mk("ReLU", "relu")
+ReLU6 = _mk("ReLU6", "relu6")
+ELU = _mk("ELU", "elu")
+SELU = _mk("SELU", "selu")
+CELU = _mk("CELU", "celu")
+GELU = _mk("GELU", "gelu")
+Sigmoid = _mk("Sigmoid", "sigmoid")
+Tanh = _mk("Tanh", "tanh")
+LogSigmoid = _mk("LogSigmoid", "log_sigmoid")
+Hardshrink = _mk("Hardshrink", "hardshrink")
+Hardsigmoid = _mk("Hardsigmoid", "hardsigmoid")
+Hardswish = _mk("Hardswish", "hardswish")
+Hardtanh = _mk("Hardtanh", "hardtanh")
+LeakyReLU = _mk("LeakyReLU", "leaky_relu")
+Mish = _mk("Mish", "mish")
+Silu = _mk("Silu", "silu")
+Swish = _mk("Swish", "swish")
+Softplus = _mk("Softplus", "softplus")
+Softshrink = _mk("Softshrink", "softshrink")
+Softsign = _mk("Softsign", "softsign")
+Tanhshrink = _mk("Tanhshrink", "tanhshrink")
+ThresholdedReLU = _mk("ThresholdedReLU", "thresholded_relu")
+Maxout = _mk("Maxout", "maxout")
+GLU = _mk("GLU", "glu")
+Softmax = _mk("Softmax", "softmax")
+LogSoftmax = _mk("LogSoftmax", "log_softmax")
+RReLU = _mk("RReLU", "rrelu")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        from .. import initializer as I
+        self._data_format = data_format
+        self.weight = self.create_parameter((num_parameters,), attr=weight_attr,
+                                            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)

@@ -1,0 +1,276 @@
+"""Recurrent layers via lax.scan (compiler-friendly TPU control flow).
+
+reference: python/paddle/nn/layer/rnn.py; CUDA kernels
+paddle/phi/kernels/gpu/rnn_kernel.cu (cuDNN). Here each layer is one
+lax.scan whose body is a fused cell matmul — XLA pipelines the scan on TPU.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, execute
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ["SimpleRNN", "LSTM", "GRU", "SimpleRNNCell", "LSTMCell", "GRUCell",
+           "RNN", "BiRNN", "RNNCellBase"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        hs = self.hidden_size
+        if getattr(self, "_is_lstm", False):
+            return (Tensor(jnp.full((batch, hs), init_value, jnp.float32)),
+                    Tensor(jnp.full((batch, hs), init_value, jnp.float32)))
+        return Tensor(jnp.full((batch, hs), init_value, jnp.float32))
+
+
+def _cell_params(layer, input_size, hidden_size, gates, suffix=""):
+    std = 1.0 / math.sqrt(hidden_size)
+    u = I.Uniform(-std, std)
+    wi = layer.create_parameter((gates * hidden_size, input_size), default_initializer=u)
+    wh = layer.create_parameter((gates * hidden_size, hidden_size), default_initializer=u)
+    bi = layer.create_parameter((gates * hidden_size,), is_bias=True, default_initializer=u)
+    bh = layer.create_parameter((gates * hidden_size,), is_bias=True, default_initializer=u)
+    layer.add_parameter("weight_ih" + suffix, wi)
+    layer.add_parameter("weight_hh" + suffix, wh)
+    layer.add_parameter("bias_ih" + suffix, bi)
+    layer.add_parameter("bias_hh" + suffix, bh)
+    return wi, wh, bi, bh
+
+
+def _rnn_step(mode, x_t, h, c, wi, wh, bi, bh, activation="tanh"):
+    g = x_t @ wi.T + bi + h @ wh.T + bh
+    if mode == "rnn":
+        return (jnp.tanh(g) if activation == "tanh" else jax.nn.relu(g)), None
+    if mode == "gru":
+        # paddle GRU: r,z,c gate layout
+        hs = h.shape[-1]
+        gi = x_t @ wi.T + bi
+        gh = h @ wh.T + bh
+        r = jax.nn.sigmoid(gi[..., :hs] + gh[..., :hs])
+        z = jax.nn.sigmoid(gi[..., hs:2 * hs] + gh[..., hs:2 * hs])
+        n = jnp.tanh(gi[..., 2 * hs:] + r * gh[..., 2 * hs:])
+        return (1 - z) * n + z * h, None
+    # lstm: i,f,g,o
+    hs = h.shape[-1]
+    i = jax.nn.sigmoid(g[..., :hs])
+    f = jax.nn.sigmoid(g[..., hs:2 * hs])
+    gg = jnp.tanh(g[..., 2 * hs:3 * hs])
+    o = jax.nn.sigmoid(g[..., 3 * hs:])
+    c_new = f * c + i * gg
+    return o * jnp.tanh(c_new), c_new
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        _cell_params(self, input_size, hidden_size, 1)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        def f(x, h, wi, wh, bi, bh):
+            out, _ = _rnn_step("rnn", x, h, None, wi, wh, bi, bh, self.activation)
+            return out
+        h = execute(f, inputs, states, self.weight_ih, self.weight_hh,
+                    self.bias_ih, self.bias_hh, _name="rnn_cell")
+        return h, h
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        _cell_params(self, input_size, hidden_size, 3)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        def f(x, h, wi, wh, bi, bh):
+            out, _ = _rnn_step("gru", x, h, None, wi, wh, bi, bh)
+            return out
+        h = execute(f, inputs, states, self.weight_ih, self.weight_hh,
+                    self.bias_ih, self.bias_hh, _name="gru_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    _is_lstm = True
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        _cell_params(self, input_size, hidden_size, 4)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h0, c0 = states
+        def f(x, h, c, wi, wh, bi, bh):
+            return _rnn_step("lstm", x, h, c, wi, wh, bi, bh)
+        h, c = execute(f, inputs, h0, c0, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh, _name="lstm_cell")
+        return h, (h, c)
+
+
+class RNN(Layer):
+    """Wrap a cell into a scan over time. reference: nn/layer/rnn.py:RNN."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        t_axis = 0 if self.time_major else 1
+        steps = inputs.shape[t_axis]
+        if initial_states is None:
+            batch_ref_ax = 1 if self.time_major else 0
+            initial_states = self.cell.get_initial_states(
+                inputs, batch_dim_idx=batch_ref_ax)
+        outs = []
+        states = initial_states
+        idxs = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        from ...tensor.manipulation import stack
+        for t in idxs:
+            x_t = inputs[(slice(None),) * t_axis + (t,)]
+            out, states = self.cell(x_t, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        return stack(outs, axis=t_axis), states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.fw = RNN(cell_fw, False, time_major)
+        self.bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat
+        s_fw, s_bw = (initial_states if initial_states is not None else (None, None))
+        o1, st1 = self.fw(inputs, s_fw)
+        o2, st2 = self.bw(inputs, s_bw)
+        return concat([o1, o2], axis=-1), (st1, st2)
+
+
+class _RNNBase(Layer):
+    mode = "rnn"
+    gates = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirectional else 1
+        self._params = []
+        for l in range(num_layers):
+            for d in range(self.num_directions):
+                in_s = input_size if l == 0 else hidden_size * self.num_directions
+                suffix = f"_l{l}" + ("_reverse" if d == 1 else "")
+                self._params.append(_cell_params(self, in_s, hidden_size,
+                                                 self.gates, suffix))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        time_major = self.time_major
+        nl, nd, hs = self.num_layers, self.num_directions, self.hidden_size
+        mode = self.mode
+        activation = self.activation
+        is_lstm = mode == "lstm"
+        param_tensors = [p for quad in self._params for p in quad]
+
+        def f(x, *flat):
+            a = x if time_major else jnp.swapaxes(x, 0, 1)  # (T, B, C)
+            T, B = a.shape[0], a.shape[1]
+            params = [flat[i * 4:(i + 1) * 4] for i in range(nl * nd)]
+            h_finals, c_finals = [], []
+            layer_in = a
+            for l in range(nl):
+                outs_dir = []
+                for d in range(nd):
+                    wi, wh, bi, bh = params[l * nd + d]
+                    h0 = jnp.zeros((B, hs), a.dtype)
+                    c0 = jnp.zeros((B, hs), a.dtype)
+                    seq = layer_in if d == 0 else jnp.flip(layer_in, 0)
+
+                    def step(carry, x_t):
+                        h, c = carry
+                        h2, c2 = _rnn_step(mode, x_t, h, c, wi, wh, bi, bh, activation)
+                        return (h2, c2 if is_lstm else c), h2
+
+                    (h_f, c_f), ys = jax.lax.scan(step, (h0, c0), seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    outs_dir.append(ys)
+                    h_finals.append(h_f)
+                    c_finals.append(c_f)
+                layer_in = jnp.concatenate(outs_dir, -1) if nd == 2 else outs_dir[0]
+            out = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
+            h_stack = jnp.stack(h_finals, 0)
+            if is_lstm:
+                return out, h_stack, jnp.stack(c_finals, 0)
+            return out, h_stack
+
+        outs = execute(f, inputs, *param_tensors, _name=self.mode)
+        if is_lstm:
+            out, h, c = outs
+            return out, (h, c)
+        out, h = outs
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    mode = "rnn"
+    gates = 1
+
+
+class GRU(_RNNBase):
+    mode = "gru"
+    gates = 3
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        kw.pop("activation", None)
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)
+
+
+class LSTM(_RNNBase):
+    mode = "lstm"
+    gates = 4
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 proj_size=0, **kw):
+        kw.pop("activation", None)
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)

@@ -1,0 +1,95 @@
+"""nn.utils. reference: python/paddle/nn/utils/."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ..clip import clip_grad_norm_, clip_grad_value_  # noqa: F401
+
+__all__ = ["parameters_to_vector", "vector_to_parameters", "weight_norm",
+           "remove_weight_norm", "spectral_norm", "clip_grad_norm_",
+           "clip_grad_value_"]
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...tensor.manipulation import concat, reshape
+    return concat([reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p._data = vec._data[offset:offset + n].reshape(p._data.shape)
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    import numpy as np
+    w = getattr(layer, name)
+    arr = w._data
+    if dim is None:
+        g = jnp.linalg.norm(arr)
+        v = arr
+    else:
+        axes = tuple(i for i in range(arr.ndim) if i != dim)
+        g = jnp.sqrt(jnp.sum(arr * arr, axis=axes))
+        v = arr
+    from ...framework.core import Parameter
+    layer.add_parameter(name + "_g", Parameter(g))
+    layer.add_parameter(name + "_v", Parameter(v))
+    del layer._parameters[name]
+
+    def hook(l, inputs):
+        g_ = getattr(l, name + "_g")
+        v_ = getattr(l, name + "_v")
+        from ...framework.core import execute
+        def f(gv, vv):
+            if dim is None:
+                w_ = vv * (gv / jnp.linalg.norm(vv))
+            else:
+                axes = tuple(i for i in range(vv.ndim) if i != dim)
+                norm = jnp.sqrt(jnp.sum(vv * vv, axis=axes, keepdims=True))
+                shape = [1] * vv.ndim
+                shape[dim] = -1
+                w_ = vv / norm * gv.reshape(shape)
+            return w_
+        w_t = execute(f, g_, v_, _name="weight_norm")
+        object.__setattr__(l, "_wn_cached", w_t)
+        l._parameters.pop(name, None)
+        l.__dict__[name] = w_t
+    layer._wn_hook = layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    from ...framework.core import Parameter
+    w = layer.__dict__.get(name)
+    if hasattr(layer, "_wn_hook"):
+        layer._wn_hook.remove()
+    g = layer._parameters.pop(name + "_g", None)
+    v = layer._parameters.pop(name + "_v", None)
+    if w is not None:
+        layer.add_parameter(name, Parameter(w._data))
+        layer.__dict__.pop(name, None)
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    from ..layer.norm import SpectralNorm
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = SpectralNorm(tuple(w._data.shape), dim=dim, power_iters=n_power_iterations,
+                      epsilon=eps)
+    layer.add_sublayer(name + "_sn", sn)
+    orig = layer._parameters[name]
+
+    def hook(l, inputs):
+        w_t = sn(orig)
+        l._parameters.pop(name, None)
+        l.__dict__[name] = w_t
+    layer._sn_hook = layer.register_forward_pre_hook(hook)
+    return layer

@@ -1,0 +1,491 @@
+"""Optimizers with a functional core.
+
+reference: python/paddle/optimizer/ (optimizer.py base + 17 optimizers).
+
+Design: every optimizer defines pure functions
+    init_state(param_array) -> dict[str, array]
+    update(param, grad, state, lr, step, **hyper) -> (new_param, new_state)
+The imperative `.step()` applies them per-parameter eagerly (rebinding
+Tensor._data); `jit.to_static`/hapi compile the same functions over whole
+parameter pytrees — one fused XLA update kernel, the analog of the
+reference's fused multi-tensor optimizer kernels
+(paddle/phi/kernels/gpu/fused_adam_kernel.cu).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Parameter, Tensor, no_grad
+from . import lr as lr_mod
+from .lr import *  # noqa: F401,F403
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
+           "Adadelta", "Adamax", "RMSProp", "Lamb", "NAdam", "RAdam",
+           "Rprop", "LBFGS", "lr"]
+
+lr = lr_mod
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError("parameters must be provided (dygraph mode)")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        if weight_decay is None:
+            self._weight_decay = 0.0
+        elif isinstance(weight_decay, float):
+            self._weight_decay = weight_decay
+        else:  # L2Decay object
+            self._weight_decay = getattr(weight_decay, "_coeff", float(weight_decay))
+        self._accumulators: dict[int, dict] = {}
+        self._step_count = 0
+
+    # -- functional core (override) ----------------------------------------
+    def init_state(self, p):
+        return {}
+
+    def update(self, p, g, state, lr, step):
+        raise NotImplementedError
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return self._learning_rate
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = value
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- stepping ------------------------------------------------------------
+    @no_grad()
+    def step(self):
+        self._step_count += 1
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr_v = self.get_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            st = self._accumulators.get(id(p))
+            if st is None:
+                st = self.init_state(p._data)
+                self._accumulators[id(p)] = st
+            g_arr = g._data if isinstance(g, Tensor) else g
+            if g_arr.dtype != p._data.dtype:
+                g_arr = g_arr.astype(p._data.dtype)
+            p_lr = lr_v * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
+            new_p, new_st = self.update(p._data, g_arr, st, p_lr, self._step_count)
+            p._data = new_p
+            self._accumulators[id(p)] = new_st
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    @no_grad()
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self):
+        sd = {}
+        for i, p in enumerate(self._parameter_list):
+            st = self._accumulators.get(id(p))
+            if st:
+                for k, v in st.items():
+                    sd[f"{p.name or i}_{k}"] = Tensor(v)
+        sd["@step"] = self._step_count
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("@step", 0))
+        for i, p in enumerate(self._parameter_list):
+            st = self.init_state(p._data)
+            found = False
+            for k in st:
+                key = f"{p.name or i}_{k}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    st[k] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+                    found = True
+            if found:
+                self._accumulators[id(p)] = st
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+
+    # -- tree-level functional API (used by jit/hapi fast path) -------------
+    def tree_init(self, params_tree):
+        return jax.tree_util.tree_map(self.init_state, params_tree)
+
+    def tree_update(self, params_tree, grads_tree, states_tree, lr_v, step):
+        is_state = lambda x: isinstance(x, dict) and not any(
+            isinstance(v, dict) for v in x.values())
+        flat_p, treedef = jax.tree_util.tree_flatten(params_tree)
+        flat_g = treedef.flatten_up_to(grads_tree)
+        flat_s = jax.tree_util.tree_flatten(states_tree, is_leaf=is_state)[0]
+        new_p, new_s = [], []
+        for p, g, s in zip(flat_p, flat_g, flat_s):
+            np_, ns_ = self.update(p, g.astype(p.dtype), s, lr_v, step)
+            new_p.append(np_)
+            new_s.append(ns_)
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                jax.tree_util.tree_unflatten(treedef, new_s))
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def update(self, p, g, state, lr, step):
+        if self._weight_decay:
+            g = g + self._weight_decay * p
+        return p - lr * g, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def init_state(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def update(self, p, g, state, lr, step):
+        if self._weight_decay:
+            g = g + self._weight_decay * p
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            p_new = p - lr * (g + self._momentum * v)
+        else:
+            p_new = p - lr * v
+        return p_new, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None, amsgrad=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+        self._amsgrad = amsgrad
+        self._decoupled_wd = False
+
+    def init_state(self, p):
+        st = {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p)}
+        if self._amsgrad:
+            st["moment2_max"] = jnp.zeros_like(p)
+        return st
+
+    def update(self, p, g, state, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        if self._weight_decay and not self._decoupled_wd:
+            g = g + self._weight_decay * p
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * (g * g)
+        mhat = m / (1 - b1 ** step)
+        if self._amsgrad:
+            vmax = jnp.maximum(state.get("moment2_max", v), v)
+            vhat = vmax / (1 - b2 ** step)
+        else:
+            vhat = v / (1 - b2 ** step)
+        upd = lr * mhat / (jnp.sqrt(vhat) + eps)
+        if self._weight_decay and self._decoupled_wd:
+            upd = upd + lr * self._weight_decay * p
+        new_state = {"moment1": m, "moment2": v}
+        if self._amsgrad:
+            new_state["moment2_max"] = vmax
+        return p - upd, new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay. reference: python/paddle/optimizer/adamw.py."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None, amsgrad=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, name=name, amsgrad=amsgrad)
+        self._decoupled_wd = True
+        self._apply_decay_fn = apply_decay_param_fun
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_state(self, p):
+        return {"moment": jnp.full_like(p, self._init_acc)}
+
+    def update(self, p, g, state, lr, step):
+        if self._weight_decay:
+            g = g + self._weight_decay * p
+        acc = state["moment"] + g * g
+        return p - lr * g / (jnp.sqrt(acc) + self._eps), {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._rho = rho
+
+    def init_state(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(p),
+                "avg_squared_update": jnp.zeros_like(p)}
+
+    def update(self, p, g, state, lr, step):
+        if self._weight_decay:
+            g = g + self._weight_decay * p
+        rho, eps = self._rho, self._eps
+        asg = rho * state["avg_squared_grad"] + (1 - rho) * g * g
+        upd = g * jnp.sqrt(state["avg_squared_update"] + eps) / jnp.sqrt(asg + eps)
+        asu = rho * state["avg_squared_update"] + (1 - rho) * upd * upd
+        return p - lr * upd, {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_state(self, p):
+        return {"moment": jnp.zeros_like(p), "inf_norm": jnp.zeros_like(p)}
+
+    def update(self, p, g, state, lr, step):
+        if self._weight_decay:
+            g = g + self._weight_decay * p
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * state["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(g))
+        p_new = p - lr / (1 - b1 ** step) * m / (u + self._eps)
+        return p_new, {"moment": m, "inf_norm": u}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps = rho, epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def init_state(self, p):
+        st = {"mean_square": jnp.zeros_like(p), "velocity": jnp.zeros_like(p)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(p)
+        return st
+
+    def update(self, p, g, state, lr, step):
+        if self._weight_decay:
+            g = g + self._weight_decay * p
+        rho = self._rho
+        ms = rho * state["mean_square"] + (1 - rho) * g * g
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+        else:
+            mg = None
+            denom = jnp.sqrt(ms + self._eps)
+        v = self._momentum * state["velocity"] + lr * g / denom
+        st = {"mean_square": ms, "velocity": v}
+        if mg is not None:
+            st["mean_grad"] = mg
+        return p - v, st
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_state(self, p):
+        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p)}
+
+    def update(self, p, g, state, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        mhat = m / (1 - b1 ** step)
+        vhat = v / (1 - b2 ** step)
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + self._weight_decay * p
+        w_norm = jnp.linalg.norm(p.reshape(-1).astype(jnp.float32))
+        r_norm = jnp.linalg.norm(r.reshape(-1).astype(jnp.float32))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0).astype(p.dtype)
+        return p - lr * trust * r, {"moment1": m, "moment2": v}
+
+
+class NAdam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def init_state(self, p):
+        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p),
+                "mu_product": jnp.ones((), jnp.float32)}
+
+    def update(self, p, g, state, lr, step):
+        if self._weight_decay:
+            g = g + self._weight_decay * p
+        b1, b2 = self._beta1, self._beta2
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (step * self._psi))
+        mu_t1 = b1 * (1 - 0.5 * 0.96 ** ((step + 1) * self._psi))
+        mu_prod = state["mu_product"] * mu_t
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        mhat = mu_t1 * m / (1 - mu_prod * mu_t1) + (1 - mu_t) * g / (1 - mu_prod)
+        vhat = v / (1 - b2 ** step)
+        return (p - lr * mhat / (jnp.sqrt(vhat) + self._eps),
+                {"moment1": m, "moment2": v, "mu_product": mu_prod})
+
+
+class RAdam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_state(self, p):
+        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p)}
+
+    def update(self, p, g, state, lr, step):
+        if self._weight_decay:
+            g = g + self._weight_decay * p
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        mhat = m / (1 - b1 ** step)
+        rho_inf = 2 / (1 - b2) - 1
+        rho_t = rho_inf - 2 * step * b2 ** step / (1 - b2 ** step)
+        if rho_t > 5:
+            l_t = jnp.sqrt((1 - b2 ** step)) / (jnp.sqrt(v) + self._eps)
+            r_t = ((rho_t - 4) * (rho_t - 2) * rho_inf /
+                   ((rho_inf - 4) * (rho_inf - 2) * rho_t)) ** 0.5
+            upd = lr * mhat * r_t * l_t
+        else:
+            upd = lr * mhat
+        return p - upd, {"moment1": m, "moment2": v}
+
+
+class Rprop(Optimizer):
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def init_state(self, p):
+        return {"prev_grad": jnp.zeros_like(p),
+                "lr": jnp.full_like(p, self.get_lr())}
+
+    def update(self, p, g, state, lr, step):
+        sign = jnp.sign(g * state["prev_grad"])
+        eta = jnp.where(sign > 0, self._etas[1],
+                        jnp.where(sign < 0, self._etas[0], 1.0))
+        new_lr = jnp.clip(state["lr"] * eta, self._lr_range[0], self._lr_range[1])
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        return (p - new_lr * jnp.sign(g_eff),
+                {"prev_grad": g_eff, "lr": new_lr})
+
+
+class LBFGS(Optimizer):
+    """reference: python/paddle/optimizer/lbfgs.py — full-batch quasi-Newton."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self.max_iter = max_iter
+        self.tol_grad = tolerance_grad
+        self.tol_change = tolerance_change
+        self.history_size = history_size
+        self._history = []
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS requires a closure")
+        loss = closure()
+        flat = lambda: jnp.concatenate([p.grad._data.reshape(-1).astype(jnp.float32)
+                                        for p in self._parameter_list])
+        flat_p = lambda: jnp.concatenate([p._data.reshape(-1).astype(jnp.float32)
+                                          for p in self._parameter_list])
+        g = flat()
+        x = flat_p()
+        # two-loop recursion
+        q = g
+        alphas = []
+        for s, y, rho in reversed(self._history):
+            a = rho * jnp.dot(s, q)
+            alphas.append(a)
+            q = q - a * y
+        if self._history:
+            s, y, _ = self._history[-1]
+            gamma = jnp.dot(s, y) / jnp.maximum(jnp.dot(y, y), 1e-10)
+            q = gamma * q
+        for (s, y, rho), a in zip(self._history, reversed(alphas)):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        d = -q
+        lr_v = self.get_lr()
+        x_new = x + lr_v * d
+        # write back
+        offset = 0
+        for p in self._parameter_list:
+            n = p._data.size
+            p._data = x_new[offset:offset + n].reshape(p._data.shape).astype(p._data.dtype)
+            offset += n
+        # curvature update needs next grad; recompute closure
+        for p in self._parameter_list:
+            p.clear_grad()
+        loss2 = closure()
+        g_new = flat()
+        s_vec = x_new - x
+        y_vec = g_new - g
+        sy = jnp.dot(s_vec, y_vec)
+        if float(sy) > 1e-10:
+            self._history.append((s_vec, y_vec, 1.0 / sy))
+            if len(self._history) > self.history_size:
+                self._history.pop(0)
+        return loss

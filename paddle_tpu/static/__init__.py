@@ -1,0 +1,119 @@
+"""Static-graph API parity layer.
+
+reference: python/paddle/static/. In the TPU-native design there is no
+separate static graph runtime — jit.to_static IS the static mode (jaxpr →
+XLA). This module provides the API names that matter for porting: InputSpec,
+data, Program guards (no-ops), and staged control-flow helpers that map to
+lax.cond / lax.while_loop — the contract the reference's static mode offers
+via paddle.static.nn.cond/while_loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, execute
+from ..jit import InputSpec  # noqa: F401
+
+__all__ = ["InputSpec", "data", "Program", "program_guard", "default_main_program",
+           "default_startup_program", "name_scope", "nn", "cond", "while_loop",
+           "scan"]
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    zeros = jnp.zeros([1 if s in (None, -1) else s for s in shape],
+                      dtype=dtype if dtype != "int64" else jnp.int64)
+    t = Tensor(zeros)
+    t.name = name
+    return t
+
+
+class Program:
+    def __init__(self):
+        pass
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return Program()
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def program_guard(main_program=None, startup_program=None):
+    yield
+
+
+_main = Program()
+_startup = Program()
+
+
+def default_main_program():
+    return _main
+
+
+def default_startup_program():
+    return _startup
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+# -- staged control flow (usable inside jit.to_static traces) ---------------
+
+
+def cond(pred, true_fn, false_fn, name=None):
+    """lax.cond exposed with paddle.static.nn.cond semantics."""
+    def f(p):
+        return jax.lax.cond(p if p.ndim == 0 else p.reshape(())[()],
+                            lambda: _as_arrays(true_fn()),
+                            lambda: _as_arrays(false_fn()))
+    return execute(f, pred, _name="cond")
+
+
+def _as_arrays(out):
+    return jax.tree_util.tree_map(
+        lambda o: o._data if isinstance(o, Tensor) else o, out)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    arrs = [v._data if isinstance(v, Tensor) else jnp.asarray(v) for v in loop_vars]
+
+    def f(*a):
+        def c(vals):
+            r = cond_fn(*[Tensor(v) for v in vals])
+            r = r._data if isinstance(r, Tensor) else r
+            return r.reshape(())[()] if hasattr(r, "reshape") else r
+
+        def b(vals):
+            out = body_fn(*[Tensor(v) for v in vals])
+            if not isinstance(out, (list, tuple)):
+                out = [out]
+            return tuple(o._data if isinstance(o, Tensor) else jnp.asarray(o)
+                         for o in out)
+
+        return jax.lax.while_loop(c, b, tuple(a))
+
+    out = execute(f, *loop_vars, _name="while_loop")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def scan(body_fn, init, xs, name=None):
+    def f(carry0, xs_arr):
+        def b(c, x):
+            nc, y = body_fn(Tensor(c), Tensor(x))
+            return (nc._data if isinstance(nc, Tensor) else nc,
+                    y._data if isinstance(y, Tensor) else y)
+        return jax.lax.scan(b, carry0, xs_arr)
+    return execute(f, init, xs, _name="scan")
+
+
+class nn:
+    cond = staticmethod(cond)
+    while_loop = staticmethod(while_loop)

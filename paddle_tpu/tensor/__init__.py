@@ -1,0 +1,126 @@
+"""Tensor op surface + method patching.
+
+reference: python/paddle/tensor/__init__.py plus the monkey-patch machinery in
+python/paddle/base/dygraph/math_op_patch.py and tensor_patch_methods.py — every
+free function `paddle.foo(x)` is also available as `x.foo()`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, execute
+from . import (attribute, creation, einsum, linalg, logic, manipulation, math,
+               random, search, stat)
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+from .attribute import rank, shape as shape_op, is_complex, is_floating_point, is_integer  # noqa: F401
+
+
+# ---------------------------------------------------------------------------
+# operator overloads (math_op_patch)
+# ---------------------------------------------------------------------------
+
+def _binop(f, reverse=False):
+    def op(self, other):
+        if reverse:
+            return execute(lambda b, a: f(a, b), self, other)
+        return execute(f, self, other)
+    return op
+
+
+Tensor.__add__ = _binop(jnp.add)
+Tensor.__radd__ = _binop(jnp.add, reverse=True)
+Tensor.__sub__ = _binop(jnp.subtract)
+Tensor.__rsub__ = _binop(jnp.subtract, reverse=True)
+Tensor.__mul__ = _binop(jnp.multiply)
+Tensor.__rmul__ = _binop(jnp.multiply, reverse=True)
+Tensor.__truediv__ = _binop(jnp.true_divide)
+Tensor.__rtruediv__ = _binop(jnp.true_divide, reverse=True)
+Tensor.__floordiv__ = _binop(jnp.floor_divide)
+Tensor.__rfloordiv__ = _binop(jnp.floor_divide, reverse=True)
+Tensor.__mod__ = _binop(jnp.mod)
+Tensor.__rmod__ = _binop(jnp.mod, reverse=True)
+Tensor.__pow__ = _binop(jnp.power)
+Tensor.__rpow__ = _binop(jnp.power, reverse=True)
+Tensor.__matmul__ = _binop(jnp.matmul)
+Tensor.__rmatmul__ = _binop(jnp.matmul, reverse=True)
+Tensor.__neg__ = lambda self: execute(jnp.negative, self)
+Tensor.__abs__ = lambda self: execute(jnp.abs, self)
+Tensor.__invert__ = lambda self: execute(jnp.logical_not if self.dtype == jnp.bool_ else jnp.bitwise_not, self)
+Tensor.__eq__ = _binop(jnp.equal)
+Tensor.__ne__ = _binop(jnp.not_equal)
+Tensor.__lt__ = _binop(jnp.less)
+Tensor.__le__ = _binop(jnp.less_equal)
+Tensor.__gt__ = _binop(jnp.greater)
+Tensor.__ge__ = _binop(jnp.greater_equal)
+Tensor.__and__ = _binop(jnp.bitwise_and)
+Tensor.__or__ = _binop(jnp.bitwise_or)
+Tensor.__xor__ = _binop(jnp.bitwise_xor)
+Tensor.__lshift__ = _binop(jnp.left_shift)
+Tensor.__rshift__ = _binop(jnp.right_shift)
+Tensor.__hash__ = object.__hash__  # __eq__ override killed it; identity hash
+
+
+# ---------------------------------------------------------------------------
+# method attachment: x.foo(...) == paddle.foo(x, ...)
+# ---------------------------------------------------------------------------
+
+_METHOD_MODULES = [math, manipulation, linalg, logic, search, stat, creation, attribute]
+_SKIP = {"to_tensor", "zeros", "ones", "full", "arange", "linspace", "eye",
+         "empty", "meshgrid", "tril_indices", "triu_indices", "where",
+         "einsum", "multi_dot", "broadcast_tensors", "scatter_nd",
+         "hstack", "vstack", "dstack", "column_stack", "row_stack",
+         "atleast_1d", "atleast_2d", "atleast_3d"}
+
+
+def _attach():
+    for mod in _METHOD_MODULES:
+        for name in getattr(mod, "__all__", []):
+            if name in _SKIP or name.startswith("_"):
+                continue
+            fn = getattr(mod, name, None)
+            if fn is None or not callable(fn):
+                continue
+            if not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+    # in-place variants
+    import functools
+
+    def make_inplace(fn):
+        @functools.wraps(fn)
+        def inplace(self, *a, **k):
+            return self._rebind(fn(self, *a, **k))
+        return inplace
+
+    for name in ["add", "subtract", "multiply", "divide", "clip", "scale",
+                 "floor", "ceil", "exp", "sqrt", "rsqrt", "reciprocal",
+                 "round", "abs", "tanh", "sigmoid", "pow"]:
+        fn = getattr(Tensor, name, None)
+        if fn is not None and not hasattr(Tensor, name + "_"):
+            setattr(Tensor, name + "_", make_inplace(fn))
+
+    # x.where(cond-style): paddle Tensor.where(x, y) means where(self_cond?..)
+    Tensor.where = lambda self, x, y, name=None: manipulation.where(self, x, y)
+    Tensor.mean = math.mean
+    Tensor.sum = math.sum
+    Tensor.max = math.max
+    Tensor.min = math.min
+    Tensor.matmul = math.matmul
+    Tensor.mm = math.matmul
+    Tensor.norm = linalg.norm
+    Tensor.transpose = manipulation.transpose
+    Tensor.reshape = manipulation.reshape
+    Tensor.unsqueeze = manipulation.unsqueeze
+    Tensor.squeeze = manipulation.squeeze
+
+
+_attach()

@@ -1,0 +1,34 @@
+"""Tensor attribute queries. reference: python/paddle/tensor/attribute.py."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework import dtypes as _dt
+from ..framework.core import Tensor
+
+__all__ = ["rank", "shape", "is_complex", "is_floating_point", "is_integer",
+           "real", "imag", "is_tensor"]
+
+from .math import real, imag  # noqa: F401
+from .logic import is_tensor  # noqa: F401
+
+
+def rank(input):
+    return Tensor(jnp.asarray(input.ndim, dtype=jnp.int32))
+
+
+def shape(input):
+    return Tensor(jnp.asarray(input.shape, dtype=jnp.int32))
+
+
+def is_complex(x):
+    return jnp.issubdtype(x.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(x.dtype, jnp.integer)

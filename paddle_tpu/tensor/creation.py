@@ -1,0 +1,168 @@
+"""Tensor creation ops. reference: python/paddle/tensor/creation.py."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtypes as _dt
+from ..framework.core import Tensor, execute, to_tensor  # noqa: F401
+
+__all__ = [
+    "to_tensor", "zeros", "zeros_like", "ones", "ones_like", "full",
+    "full_like", "arange", "linspace", "logspace", "eye", "empty",
+    "empty_like", "tril", "triu", "diag", "diagflat", "meshgrid",
+    "assign", "clone", "tril_indices", "triu_indices", "one_hot",
+    "complex", "polar",
+]
+
+
+def _dtype(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else _dt.convert_dtype(_dt.get_default_dtype())
+    return _dt.convert_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dtype(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dtype(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = _dt.convert_dtype("bool") if isinstance(fill_value, bool) else _dtype(None)
+    else:
+        dtype = _dtype(dtype)
+    return Tensor(jnp.full(_shape(shape), fill_value, dtype))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return execute(lambda a: jnp.zeros_like(a, dtype=_dt.convert_dtype(dtype)), x, _name="zeros_like") if isinstance(x, Tensor) else Tensor(jnp.zeros_like(jnp.asarray(x), dtype=_dt.convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(x._data if isinstance(x, Tensor) else jnp.asarray(x), dtype=_dt.convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(x._data if isinstance(x, Tensor) else jnp.asarray(x), fill_value, dtype=_dt.convert_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = jnp.int64 if all(isinstance(v, (int, np.integer)) for v in (start, end, step)) else _dtype(None)
+    else:
+        dtype = _dt.convert_dtype(dtype)
+    return Tensor(jnp.arange(start, end, step, dtype=dtype))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(_f(start), _f(stop), int(_f(num)), dtype=_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(_f(start), _f(stop), int(_f(num)), base=_f(base), dtype=_dtype(dtype)))
+
+
+def _f(x):
+    return x.item() if isinstance(x, Tensor) else x
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dtype(dtype)))
+
+
+def tril(x, diagonal=0, name=None):
+    return execute(lambda a: jnp.tril(a, diagonal), x, _name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return execute(lambda a: jnp.triu(a, diagonal), x, _name="triu")
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        if a.ndim == 1:
+            d = jnp.diag(a, offset)
+            if padding_value != 0:
+                n = a.shape[0] + abs(offset)
+                mask = jnp.eye(n, k=offset, dtype=bool)
+                d = jnp.where(mask, d, padding_value)
+            return d
+        return jnp.diagonal(a, offset)
+    return execute(f, x, _name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return execute(lambda a: jnp.diagflat(a, offset), x, _name="diagflat")
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    outs = execute(lambda *arrs: tuple(jnp.meshgrid(*arrs, indexing="ij")), *args, _name="meshgrid")
+    return list(outs)
+
+
+def assign(x, output=None):
+    src = Tensor(jnp.asarray(x._data if isinstance(x, Tensor) else np.asarray(x)))
+    if output is not None:
+        output.set_value(src)
+        return output
+    return src
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt.convert_dtype(dtype)))
+
+
+def one_hot(x, num_classes, name=None):
+    import jax.nn as jnn
+    return execute(lambda a: jnn.one_hot(a, num_classes, dtype=_dtype(None)), x, _name="one_hot")
+
+
+def complex(real, imag, name=None):
+    return execute(lambda r, i: jax.lax.complex(r, i), real, imag, _name="complex")
+
+
+def polar(abs_, angle, name=None):
+    return execute(lambda a, t: a * jnp.exp(1j * t.astype(jnp.complex64)), abs_, angle, _name="polar")
+
+
+import jax  # noqa: E402  (used by complex)

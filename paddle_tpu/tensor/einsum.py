@@ -1,0 +1,16 @@
+"""einsum. reference: python/paddle/tensor/einsum.py — here one call into
+jnp.einsum, which XLA maps straight onto MXU dot_generals."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import execute
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return execute(lambda *arrs: jnp.einsum(equation, *arrs), *operands, _name="einsum")

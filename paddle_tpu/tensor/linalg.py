@@ -1,0 +1,304 @@
+"""Linear algebra. reference: python/paddle/tensor/linalg.py.
+
+Decompositions route to jax.numpy.linalg / jax.scipy.linalg (XLA custom
+calls), replacing the reference's cuSOLVER/LAPACK dynload kernels
+(paddle/phi/kernels/gpu/*svd*, *eig*, funcs/blas/)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, execute
+from .math import matmul, mm, bmm, dot  # noqa: F401 re-export
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "t", "transpose_last2", "norm", "dist",
+    "cond", "matrix_power", "matrix_rank", "det", "slogdet", "inv", "pinv",
+    "solve", "triangular_solve", "cholesky", "cholesky_solve", "lu",
+    "lu_unpack", "qr", "svd", "svdvals", "eig", "eigvals", "eigh",
+    "eigvalsh", "lstsq", "multi_dot", "cross", "histogram", "histogramdd",
+    "bincount", "mv", "corrcoef", "cov", "matrix_transpose", "householder_product",
+    "pca_lowrank", "vecdot", "tensordot",
+]
+
+
+def t(x, name=None):
+    return execute(lambda a: a.T if a.ndim <= 2 else a, x, _name="t")
+
+
+def transpose_last2(x, name=None):
+    return execute(lambda a: jnp.swapaxes(a, -1, -2), x, _name="transpose_last2")
+
+
+matrix_transpose = transpose_last2
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(a):
+        if axis is None and p is None:
+            return jnp.linalg.norm(a.reshape(-1), 2)
+        if axis is None:
+            return jnp.linalg.norm(a.reshape(-1), _p(p))
+        if isinstance(axis, (list, tuple)) and len(axis) == 2:
+            return jnp.linalg.norm(a, _p(p) if p is not None else "fro", axis=tuple(axis), keepdims=keepdim)
+        return jnp.linalg.norm(a, _p(p) if p is not None else 2, axis=axis if not isinstance(axis, (list, tuple)) else axis[0], keepdims=keepdim)
+    return execute(f, x, _name="norm")
+
+
+def _p(p):
+    if p == "fro":
+        return "fro"
+    if p == "nuc":
+        return "nuc"
+    if p == float("inf") or p == "inf":
+        return jnp.inf
+    if p == float("-inf"):
+        return -jnp.inf
+    return p
+
+
+def dist(x, y, p=2, name=None):
+    return execute(lambda a, b: jnp.linalg.norm((a - b).reshape(-1), _p(p)), x, y, _name="dist")
+
+
+def cond(x, p=None, name=None):
+    return execute(lambda a: jnp.linalg.cond(a, _p(p)), x, _name="cond")
+
+
+def matrix_power(x, n, name=None):
+    return execute(lambda a: jnp.linalg.matrix_power(a, n), x, _name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return execute(lambda a: jnp.linalg.matrix_rank(a, tol=tol), x, _name="matrix_rank")
+
+
+def det(x, name=None):
+    return execute(jnp.linalg.det, x, _name="det")
+
+
+def slogdet(x, name=None):
+    def f(a):
+        s, l = jnp.linalg.slogdet(a)
+        return jnp.stack([s, l]) if s.ndim == 0 else jnp.stack([s, l])
+    return execute(f, x, _name="slogdet")
+
+
+def inv(x, name=None):
+    return execute(jnp.linalg.inv, x, _name="inv")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return execute(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x, _name="pinv")
+
+
+def solve(x, y, name=None):
+    return execute(jnp.linalg.solve, x, y, _name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return execute(f, x, y, _name="triangular_solve")
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2).conj() if upper else l
+    return execute(f, x, _name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+    return execute(f, x, y, _name="cholesky_solve")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+    lu_t, piv_t = execute(f, x, _name="lu")
+    if get_infos:
+        return lu_t, piv_t, Tensor(jnp.zeros((), jnp.int32))
+    return lu_t, piv_t
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    def f(lu_, piv):
+        m = lu_.shape[-2]
+        l = jnp.tril(lu_, -1) + jnp.eye(m, lu_.shape[-1], dtype=lu_.dtype)
+        l = l[..., :, :min(lu_.shape[-2:])] if False else jnp.tril(lu_, -1)[..., :, :] + jnp.eye(lu_.shape[-2], lu_.shape[-1], dtype=lu_.dtype)
+        u = jnp.triu(lu_)
+        # build permutation matrix from pivots (1-based sequential swaps)
+        def body(i, perm):
+            j = piv[i] - 1
+            pi = perm[i]
+            pj = perm[j]
+            perm = perm.at[i].set(pj)
+            perm = perm.at[j].set(pi)
+            return perm
+        perm = jnp.arange(m)
+        perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+        p = jnp.eye(m, dtype=lu_.dtype)[perm].T
+        return p, l, u
+    return execute(f, x, y, _name="lu_unpack")
+
+
+def qr(x, mode="reduced", name=None):
+    def f(a):
+        return jnp.linalg.qr(a, mode=mode)
+    if mode == "r":
+        return execute(lambda a: jnp.linalg.qr(a, mode="r"), x, _name="qr")
+    q, r = execute(f, x, _name="qr")
+    return q, r
+
+
+def svd(x, full_matrices=False, name=None):
+    def f(a):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2).conj()  # paddle returns V not V^H
+    return execute(f, x, _name="svd")
+
+
+def svdvals(x, name=None):
+    return execute(lambda a: jnp.linalg.svd(a, compute_uv=False), x, _name="svdvals")
+
+
+def eig(x, name=None):
+    return execute(lambda a: jnp.linalg.eig(a), x, _name="eig")
+
+
+def eigvals(x, name=None):
+    return execute(jnp.linalg.eigvals, x, _name="eigvals")
+
+
+def eigh(x, UPLO="L", name=None):
+    return execute(lambda a: jnp.linalg.eigh(a, UPLO=UPLO), x, _name="eigh")
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return execute(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x, _name="eigvalsh")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return execute(f, x, y, _name="lstsq")
+
+
+def multi_dot(x, name=None):
+    return execute(lambda *arrs: jnp.linalg.multi_dot(arrs), *x, _name="multi_dot")
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:
+            for i, s in enumerate(a.shape):
+                if s == 3:
+                    ax = i
+                    break
+        return jnp.cross(a, b, axis=ax)
+    return execute(f, x, y, _name="cross")
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    def f(a, w=None):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        h, _ = jnp.histogram(a, bins=bins, range=(lo, hi), weights=w, density=density)
+        return h if density or w is not None else h.astype(jnp.int64)
+    if weight is not None:
+        return execute(f, input, weight, _name="histogram")
+    return execute(f, input, _name="histogram")
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    def f(a, w=None):
+        h, edges = jnp.histogramdd(a, bins=bins, range=ranges, density=density, weights=w)
+        return (h,) + tuple(edges)
+    outs = execute(f, x, *( [weights] if weights is not None else []), _name="histogramdd")
+    return outs[0], list(outs[1:])
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    import numpy as np
+    length = builtins_max(minlength, int(np.asarray(x._data).max()) + 1 if x.size else 0)
+    def f(a, w=None):
+        return jnp.bincount(a, w, length=length)
+    if weights is not None:
+        return execute(f, x, weights, _name="bincount")
+    return execute(f, x, _name="bincount")
+
+
+import builtins
+
+
+def builtins_max(*a):
+    return builtins.max(*a)
+
+
+def mv(x, vec, name=None):
+    return execute(lambda a, v: a @ v, x, vec, _name="mv")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return execute(lambda a: jnp.corrcoef(a, rowvar=rowvar), x, _name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def f(a, *rest):
+        fw = rest[0] if fweights is not None else None
+        aw = rest[len([r for r in [fweights] if r is not None])] if aweights is not None else None
+        return jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fw, aweights=aw)
+    args = [x] + [w for w in (fweights, aweights) if w is not None]
+    return execute(f, *args, _name="cov")
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t_):
+        m, n = a.shape[-2], a.shape[-1]
+        def make_q(acol, tval):
+            pass
+        q = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(q, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else q
+        for i in range(t_.shape[-1]):
+            v = a[..., :, i]
+            v = jnp.where(jnp.arange(m) < i, 0.0, v)
+            v = v.at[..., i].set(1.0) if v.ndim == 1 else jnp.concatenate([v[..., :i] * 0, jnp.ones_like(v[..., i:i+1]), v[..., i+1:]], axis=-1)
+            ti = t_[..., i]
+            outer_ = v[..., :, None] * v[..., None, :]
+            h = jnp.eye(m, dtype=a.dtype) - ti[..., None, None] * outer_
+            q = q @ h
+        return q[..., :, :n]
+    return execute(f, x, tau, _name="householder_product")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def f(a):
+        qq = q if q is not None else min(6, a.shape[-2], a.shape[-1])
+        b = a - a.mean(axis=-2, keepdims=True) if center else a
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return u[..., :qq], s[..., :qq], jnp.swapaxes(vh, -1, -2)[..., :qq]
+    return execute(f, x, _name="pca_lowrank")
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return execute(lambda a, b: jnp.sum(a * b, axis=axis), x, y, _name="vecdot")
+
+
+def tensordot(x, y, axes=2, name=None):
+    def conv_axes(ax):
+        if isinstance(ax, Tensor):
+            import numpy as np
+            ax = np.asarray(ax._data).tolist()
+        if isinstance(ax, (list, tuple)):
+            return tuple(conv_axes(a) for a in ax) if isinstance(ax[0], (list, tuple, Tensor)) else tuple(int(a) for a in ax)
+        return int(ax) if not isinstance(ax, int) else ax
+    ax = conv_axes(axes)
+    return execute(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y, _name="tensordot")

@@ -1,0 +1,533 @@
+"""Shape/layout manipulation ops. reference: python/paddle/tensor/manipulation.py.
+
+On TPU these are free or cheap under XLA (layout assignment handles them);
+`reshape`/`transpose` never copy in the compiled graph. The reference needs a
+whole `stride/` kernel family (paddle/phi/kernels/stride/) for view semantics —
+XLA's functional arrays make that machinery unnecessary.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtypes as _dt
+from ..framework.core import Tensor, execute
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "transpose", "squeeze", "squeeze_",
+    "unsqueeze", "unsqueeze_", "concat", "stack", "split", "chunk", "tile",
+    "expand", "expand_as", "broadcast_to", "broadcast_tensors", "flip",
+    "rot90", "roll", "gather", "gather_nd", "scatter", "scatter_nd",
+    "scatter_nd_add", "index_select", "index_sample", "index_add", "index_put",
+    "take_along_axis", "put_along_axis", "masked_select", "masked_fill",
+    "where", "slice", "strided_slice", "unbind", "unstack", "pad",
+    "repeat_interleave", "moveaxis", "swapaxes", "as_complex", "as_real",
+    "view", "view_as", "atleast_1d", "atleast_2d", "atleast_3d",
+    "tensor_split", "hsplit", "vsplit", "dsplit", "hstack", "vstack",
+    "dstack", "column_stack", "row_stack", "unflatten", "unfold",
+    "flatten_", "cast", "crop", "tolist", "numel", "shard_index",
+    "diagonal", "diagonal_scatter", "select_scatter", "slice_scatter",
+]
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape._data))
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def reshape(x, shape, name=None):
+    s = _shape_arg(shape)
+    return execute(lambda a: jnp.reshape(a, s), x, _name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    return x._rebind(reshape(x, shape))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        s0 = start_axis % nd if nd else 0
+        s1 = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s0] + (-1,) + a.shape[s1 + 1:]
+        return a.reshape(new_shape)
+    return execute(f, x, _name="flatten")
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._rebind(flatten(x, start_axis, stop_axis))
+
+
+def transpose(x, perm=None, name=None):
+    p = None if perm is None else tuple(int(v) for v in perm)
+    return execute(lambda a: jnp.transpose(a, p), x, _name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return execute(lambda a: jnp.moveaxis(a, source, destination), x, _name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return execute(lambda a: jnp.swapaxes(a, axis0, axis1), x, _name="swapaxes")
+
+
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axes) if axes else a
+    return execute(f, x, _name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._rebind(squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    def f(a):
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = [int(ax._data) if isinstance(ax, Tensor) else int(ax) for ax in axes]
+        out = a
+        for ax in sorted([ax % (out.ndim + 1 + 0) if ax < 0 else ax for ax in axes]):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return execute(f, x, _name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._rebind(unsqueeze(x, axis))
+
+
+def concat(x, axis=0, name=None):
+    ax = int(axis._data) if isinstance(axis, Tensor) else int(axis)
+    return execute(lambda *arrs: jnp.concatenate(arrs, ax), *x, _name="concat")
+
+
+def stack(x, axis=0, name=None):
+    return execute(lambda *arrs: jnp.stack(arrs, axis), *x, _name="stack")
+
+
+def hstack(x, name=None):
+    return execute(lambda *arrs: jnp.hstack(arrs), *x, _name="hstack")
+
+
+def vstack(x, name=None):
+    return execute(lambda *arrs: jnp.vstack(arrs), *x, _name="vstack")
+
+
+def dstack(x, name=None):
+    return execute(lambda *arrs: jnp.dstack(arrs), *x, _name="dstack")
+
+
+def column_stack(x, name=None):
+    return execute(lambda *arrs: jnp.column_stack(arrs), *x, _name="column_stack")
+
+
+row_stack = vstack
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis._data) if isinstance(axis, Tensor) else int(axis)
+    def f(a):
+        n = a.shape[ax]
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, ax))
+        secs = [n - sum(s for s in num_or_sections if s not in (-1,)) if s == -1 else s
+                for s in num_or_sections]
+        idx = np.cumsum(secs)[:-1]
+        return tuple(jnp.split(a, idx, ax))
+    return list(execute(f, x, _name="split"))
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    return list(execute(lambda a: tuple(jnp.array_split(a, num_or_indices, axis)), x, _name="tensor_split"))
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    def f(a):
+        return tuple(jnp.moveaxis(a, axis, 0))
+    n = x.shape[axis]
+    return list(execute(lambda a: tuple(jnp.take(a, i, axis) for i in range(n)), x, _name="unbind"))
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape_arg(repeat_times) if not isinstance(repeat_times, int) else (repeat_times,)
+    return execute(lambda a: jnp.tile(a, reps), x, _name="tile")
+
+
+def expand(x, shape, name=None):
+    s = _shape_arg(shape)
+    def f(a):
+        target = list(s)
+        # -1 means keep original dim
+        off = len(target) - a.ndim
+        for i in range(len(target)):
+            if target[i] == -1:
+                target[i] = a.shape[i - off]
+        return jnp.broadcast_to(a, tuple(target))
+    return execute(f, x, _name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return execute(lambda a: jnp.broadcast_to(a, _shape_arg(shape)), x, _name="broadcast_to")
+
+
+def broadcast_tensors(inputs, name=None):
+    return list(execute(lambda *arrs: tuple(jnp.broadcast_arrays(*arrs)), *inputs, _name="broadcast_tensors"))
+
+
+def flip(x, axis, name=None):
+    return execute(lambda a: jnp.flip(a, axis), x, _name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return execute(lambda a: jnp.rot90(a, k, axes), x, _name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return execute(lambda a: jnp.roll(a, shifts, axis), x, _name="roll")
+
+
+def gather(x, index, axis=0, name=None):
+    def f(a, idx):
+        return jnp.take(a, idx.reshape(-1) if idx.ndim > 1 else idx, axis=axis)
+    return execute(f, x, index, _name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        k = idx.shape[-1]
+        flat_idx = tuple(idx[..., i] for i in range(k))
+        return a[flat_idx]
+    return execute(f, x, index, _name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, idx, upd):
+        idx = idx.reshape(-1)
+        if overwrite:
+            return a.at[idx].set(upd)
+        z = a.at[idx].set(jnp.zeros_like(upd))
+        return z.at[idx].add(upd)
+    return execute(f, x, index, updates, _name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, idx, upd):
+        k = idx.shape[-1]
+        ix = tuple(idx[..., i] for i in range(k))
+        return a.at[ix].add(upd)
+    return execute(f, x, index, updates, _name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def f(idx, upd):
+        a = jnp.zeros(_shape_arg(shape), upd.dtype)
+        k = idx.shape[-1]
+        ix = tuple(idx[..., i] for i in range(k))
+        return a.at[ix].add(upd)
+    return execute(f, index, updates, _name="scatter_nd")
+
+
+def index_select(x, index, axis=0, name=None):
+    return execute(lambda a, i: jnp.take(a, i, axis=axis), x, index, _name="index_select")
+
+
+def index_sample(x, index, name=None):
+    return execute(lambda a, i: jnp.take_along_axis(a, i, axis=1), x, index, _name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, i, v):
+        a_m = jnp.moveaxis(a, axis, 0)
+        v_m = jnp.moveaxis(v, axis, 0)
+        out = a_m.at[i].add(v_m)
+        return jnp.moveaxis(out, 0, axis)
+    return execute(f, x, index, value, _name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def f(a, v, *idx):
+        if accumulate:
+            return a.at[idx].add(v)
+        return a.at[idx].set(v)
+    return execute(f, x, value, *indices, _name="index_put")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return execute(lambda a, i: jnp.take_along_axis(a, i, axis=axis), arr, indices, _name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True, name=None):
+    def f(a, i, v):
+        v = jnp.broadcast_to(v, i.shape) if not hasattr(v, "shape") or v.shape != i.shape else v
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
+        upd = jnp.zeros_like(a)
+        if reduce == "add":
+            dims = tuple(jnp.indices(i.shape))
+            full_idx = list(dims)
+            full_idx[axis] = i
+            return a.at[tuple(full_idx)].add(v)
+        if reduce in ("mul", "multiply"):
+            dims = tuple(jnp.indices(i.shape))
+            full_idx = list(dims)
+            full_idx[axis] = i
+            return a.at[tuple(full_idx)].multiply(v)
+        raise ValueError(reduce)
+    if not isinstance(values, Tensor):
+        values = Tensor(jnp.broadcast_to(jnp.asarray(values, x_dtype(arr)), indices.shape))
+    return execute(f, arr, indices, values, _name="put_along_axis")
+
+
+def x_dtype(t):
+    return t._data.dtype
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: materialize on host (documented non-jittable)
+    a = np.asarray(x._data)
+    m = np.asarray(mask._data)
+    return Tensor(jnp.asarray(a[m]))
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value._data if isinstance(value, Tensor) else value
+    return execute(lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a), x, mask, _name="masked_fill")
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return execute(lambda c, a, b: jnp.where(c, a, b), condition, x, y, _name="where")
+
+
+def nonzero(x, as_tuple=False):
+    a = np.asarray(x._data)
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(v)) for v in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+
+
+__all__.append("nonzero")
+
+
+import builtins as _builtins
+
+builtins_slice = _builtins.slice
+
+
+def slice(input, axes, starts, ends, name=None):
+    def f(a):
+        sl = [builtins_slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            s = int(s._data) if isinstance(s, Tensor) else int(s)
+            e = int(e._data) if isinstance(e, Tensor) else int(e)
+            sl[ax] = builtins_slice(s, e)
+        return a[tuple(sl)]
+    return execute(f, input, _name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(a):
+        sl = [builtins_slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sl[ax] = builtins_slice(int(s), int(e), int(st))
+        return a[tuple(sl)]
+    return execute(f, x, _name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    def f(a):
+        offs = offsets or [0] * a.ndim
+        shp = list(shape)
+        for i, s in enumerate(shp):
+            if s == -1:
+                shp[i] = a.shape[i] - offs[i]
+        sl = tuple(builtins_slice(o, o + s) for o, s in zip(offs, shp))
+        return a[sl]
+    return execute(f, x, _name="crop")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_axis=True, name=None):
+    def f(a):
+        p = [int(v._data) if isinstance(v, Tensor) else int(v) for v in pad] if not isinstance(pad, Tensor) else [int(v) for v in np.asarray(pad._data)]
+        nd = a.ndim
+        if len(p) == 2 * nd:
+            if pad_from_left_axis:
+                width = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+            else:
+                width = [(p[2 * i], p[2 * i + 1]) for i in range(nd)][::-1]
+        elif len(p) == 4 and nd == 4:
+            # NCHW: pad H, W
+            if data_format == "NCHW":
+                width = [(0, 0), (0, 0), (p[2], p[3]), (p[0], p[1])]
+            else:
+                width = [(0, 0), (p[2], p[3]), (p[0], p[1]), (0, 0)]
+        elif len(p) == 2 and nd == 3:
+            if data_format == "NCL":
+                width = [(0, 0), (0, 0), (p[0], p[1])]
+            else:
+                width = [(0, 0), (p[0], p[1]), (0, 0)]
+        elif len(p) == 6 and nd == 5:
+            if data_format == "NCDHW":
+                width = [(0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1])]
+            else:
+                width = [(0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1]), (0, 0)]
+        else:
+            width = [(0, 0)] * (nd - len(p) // 2) + [(p[2 * i], p[2 * i + 1]) for i in range(len(p) // 2)]
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, width, mode=jmode, constant_values=value)
+        return jnp.pad(a, width, mode=jmode)
+    return execute(f, x, _name="pad")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats._data)
+        total = int(reps.sum())
+        return execute(
+            lambda a, r: jnp.repeat(a, r, axis=axis, total_repeat_length=total),
+            x, repeats, _name="repeat_interleave")
+    return execute(lambda a: jnp.repeat(a, repeats, axis=axis), x, _name="repeat_interleave")
+
+
+def as_complex(x, name=None):
+    return execute(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x, _name="as_complex")
+
+
+def as_real(x, name=None):
+    return execute(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], -1), x, _name="as_real")
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [execute(jnp.atleast_1d, t, _name="atleast_1d") for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [execute(jnp.atleast_2d, t, _name="atleast_2d") for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [execute(jnp.atleast_3d, t, _name="atleast_3d") for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def unflatten(x, axis, shape, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        return a.reshape(a.shape[:ax] + tuple(shape) + a.shape[ax + 1:])
+    return execute(f, x, _name="unflatten")
+
+
+def unfold(x, axis, size, step, name=None):
+    return execute(lambda a: _unfold_ref(a, axis, size, step), x, _name="unfold")
+
+
+def _unfold_ref(a, axis, size, step):
+    n = (a.shape[axis] - size) // step + 1
+    slices = [jax.lax.dynamic_slice_in_dim(a, i * step, size, axis) for i in range(n)]
+    stacked = jnp.stack(slices, axis=axis)  # (..., n, size_at_axis+1, ...)
+    return jnp.moveaxis(stacked, axis + 1, -1)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return execute(lambda a: jnp.diagonal(a, offset, axis1, axis2), x, _name="diagonal")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def f(a, b):
+        n1, n2 = a.shape[axis1], a.shape[axis2]
+        diag_len = min(n1, n2 - offset) if offset >= 0 else min(n1 + offset, n2)
+        rows = np.arange(diag_len) + (-offset if offset < 0 else 0)
+        cols = np.arange(diag_len) + (offset if offset > 0 else 0)
+        sl = [builtins_slice(None)] * a.ndim
+        out = a
+        for k in range(diag_len):
+            sel = list(sl)
+            sel[axis1] = int(rows[k])
+            sel[axis2] = int(cols[k])
+            out = out.at[tuple(sel)].set(jnp.take(b, k, axis=-1))
+        return out
+    return execute(f, x, y, _name="diagonal_scatter")
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def f(a, v):
+        sl = [builtins_slice(None)] * a.ndim
+        sl[axis] = index
+        return a.at[tuple(sl)].set(v)
+    return execute(f, x, values, _name="select_scatter")
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def f(a, v):
+        sl = [builtins_slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sl[ax] = builtins_slice(int(s), int(e), int(st))
+        return a.at[tuple(sl)].set(v)
+    return execute(f, x, value, _name="slice_scatter")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(a):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo = shard_id * shard_size
+        hi = lo + shard_size
+        in_shard = (a >= lo) & (a < hi)
+        return jnp.where(in_shard, a - lo, ignore_value)
+    return execute(f, input, _name="shard_index")
+
+
+def tolist(x):
+    return np.asarray(x._data).tolist()
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x._data.size, dtype=jnp.int64))

@@ -1,0 +1,424 @@
+"""Elementwise + reduction math ops. reference: python/paddle/tensor/math.py.
+
+Every op is a pure jax function routed through framework.core.execute, which
+records a vjp node when grads are needed. XLA fuses chains of these
+elementwise ops into single TPU kernels (replacing the reference's CINN
+fusion pass, paddle/cinn/hlir/...), so op granularity here costs nothing
+under jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtypes as _dt
+from ..framework.core import Tensor, execute
+
+__all__ = []
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _unary(name, f):
+    def op(x, name=None):
+        return execute(f, x, _name=name)
+    op.__name__ = name
+    __all__.append(name)
+    return op
+
+
+def _promote_binary(f):
+    """Apply paddle-ish binary promotion: int tensor + float scalar -> float."""
+    def g(a, b):
+        if isinstance(a, jax.Array) or isinstance(b, jax.Array):
+            pass
+        return f(a, b)
+    return g
+
+
+def _binary(name, f):
+    def op(x, y, name=None):
+        return execute(f, x, y, _name=name)
+    op.__name__ = name
+    __all__.append(name)
+    return op
+
+
+# ---- unary ----------------------------------------------------------------
+abs = _unary("abs", jnp.abs)
+acos = _unary("acos", jnp.arccos)
+acosh = _unary("acosh", jnp.arccosh)
+asin = _unary("asin", jnp.arcsin)
+asinh = _unary("asinh", jnp.arcsinh)
+atan = _unary("atan", jnp.arctan)
+atanh = _unary("atanh", jnp.arctanh)
+ceil = _unary("ceil", jnp.ceil)
+cos = _unary("cos", jnp.cos)
+cosh = _unary("cosh", jnp.cosh)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+floor = _unary("floor", jnp.floor)
+frac = _unary("frac", lambda a: a - jnp.trunc(a))
+i0 = _unary("i0", jax.scipy.special.i0)
+i0e = _unary("i0e", jax.scipy.special.i0e)
+i1 = _unary("i1", jax.scipy.special.i1)
+i1e = _unary("i1e", jax.scipy.special.i1e)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+log = _unary("log", jnp.log)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+log2 = _unary("log2", jnp.log2)
+neg = _unary("neg", jnp.negative)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+round = _unary("round", jnp.round)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+sign = _unary("sign", jnp.sign)
+sin = _unary("sin", jnp.sin)
+sinh = _unary("sinh", jnp.sinh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+tan = _unary("tan", jnp.tan)
+tanh = _unary("tanh", jnp.tanh)
+trunc = _unary("trunc", jnp.trunc)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+exponential_ = None  # random module
+
+
+@_export
+def logit(x, eps=None, name=None):
+    def f(a):
+        a2 = jnp.clip(a, eps, 1 - eps) if eps else a
+        return jnp.log(a2 / (1 - a2))
+    return execute(f, x, _name="logit")
+
+
+# ---- binary ---------------------------------------------------------------
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.true_divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+mod = _binary("mod", jnp.mod)
+remainder = _binary("remainder", jnp.remainder)
+floor_mod = _binary("floor_mod", jnp.mod)
+pow = _binary("pow", jnp.power)
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+hypot = _binary("hypot", jnp.hypot)
+copysign = _binary("copysign", jnp.copysign)
+nextafter = _binary("nextafter", jnp.nextafter)
+ldexp = _binary("ldexp", lambda a, b: a * (2.0 ** b.astype(jnp.float32) if hasattr(b, "astype") else 2.0 ** b))
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+heaviside = _binary("heaviside", jnp.heaviside)
+inner = _binary("inner", jnp.inner)
+outer = _binary("outer", lambda a, b: jnp.outer(a, b))
+kron = _binary("kron", jnp.kron)
+
+
+@_export
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def f(a, s, b):
+        out = a * s + b if bias_after_scale else (a + b) * s
+        return out.astype(a.dtype) if jnp.issubdtype(a.dtype, jnp.inexact) else out
+    return execute(f, x, scale, bias, _name="scale")
+
+
+@_export
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return execute(lambda a: jnp.clip(a, lo, hi), x, _name="clip")
+
+
+@_export
+def lerp(x, y, weight, name=None):
+    return execute(lambda a, b, w: a + w * (b - a), x, y, weight, _name="lerp")
+
+
+@_export
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return execute(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y, _name="addmm")
+
+
+@_export
+def multiplex(inputs, index, name=None):
+    def f(idx, *arrs):
+        stacked = jnp.stack(arrs, 0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape((1, -1) + (1,) * (stacked.ndim - 2)), axis=0
+        )[0]
+    return execute(lambda *args: f(args[-1], *args[:-1]), *inputs, index, _name="multiplex")
+
+
+@_export
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return execute(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x, _name="nan_to_num")
+
+
+@_export
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return execute(lambda a: scale_b * jnp.tanh(scale_a * a), x, _name="stanh")
+
+
+# ---- reductions -----------------------------------------------------------
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        ax = np.asarray(axis._data)
+        return tuple(int(v) for v in np.atleast_1d(ax))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduction(name, f, bool_to_int64=False):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        ax = _axis(axis)
+        def g(a):
+            if bool_to_int64 and (a.dtype == jnp.bool_):
+                a = a.astype(jnp.int64)
+            kw = {}
+            if dtype is not None:
+                kw["dtype"] = _dt.convert_dtype(dtype)
+            return f(a, axis=ax, keepdims=keepdim, **kw)
+        return execute(g, x, _name=name)
+    op.__name__ = name
+    __all__.append(name)
+    return op
+
+
+sum = _reduction("sum", jnp.sum, bool_to_int64=True)
+mean = _reduction("mean", jnp.mean)
+prod = _reduction("prod", jnp.prod)
+max = _reduction("max", jnp.max)
+min = _reduction("min", jnp.min)
+amax = _reduction("amax", jnp.max)
+amin = _reduction("amin", jnp.min)
+nansum = _reduction("nansum", jnp.nansum)
+nanmean = _reduction("nanmean", jnp.nanmean)
+all = _reduction("all", jnp.all)
+any = _reduction("any", jnp.any)
+logsumexp = _reduction("logsumexp", jax.scipy.special.logsumexp)
+
+
+@_export
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return execute(lambda a: jnp.count_nonzero(a, axis=_axis(axis), keepdims=keepdim).astype(jnp.int64), x, _name="count_nonzero")
+
+
+@_export
+def cumsum(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        return jnp.cumsum(a, ax, dtype=_dt.convert_dtype(dtype))
+    return execute(f, x, _name="cumsum")
+
+
+@_export
+def cumprod(x, dim=None, dtype=None, name=None):
+    return execute(lambda a: jnp.cumprod(a, dim, dtype=_dt.convert_dtype(dtype)), x, _name="cumprod")
+
+
+@_export
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        ax = 0 if axis is None else axis
+        arr = a.reshape(-1) if axis is None else a
+        vals = jax.lax.associative_scan(jnp.maximum, arr, axis=ax)
+        idx = jnp.argmax((arr[..., None] if False else arr) == vals, axis=ax)
+        # recompute indices via scan over argmax trick
+        n = arr.shape[ax]
+        ar = jnp.arange(n)
+        shape = [1] * arr.ndim
+        shape[ax] = n
+        ar = ar.reshape(shape)
+        eq = arr == vals
+        idxs = jnp.where(eq, ar, -1)
+        idx = jax.lax.associative_scan(jnp.maximum, idxs, axis=ax)
+        return vals, idx.astype(_dt.convert_dtype(dtype))
+    return execute(f, x, _name="cummax")
+
+
+@_export
+def cummin(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        ax = 0 if axis is None else axis
+        arr = a.reshape(-1) if axis is None else a
+        vals = jax.lax.associative_scan(jnp.minimum, arr, axis=ax)
+        n = arr.shape[ax]
+        ar = jnp.arange(n)
+        shape = [1] * arr.ndim
+        shape[ax] = n
+        ar = ar.reshape(shape)
+        idxs = jnp.where(arr == vals, ar, -1)
+        idx = jax.lax.associative_scan(jnp.maximum, idxs, axis=ax)
+        return vals, idx.astype(_dt.convert_dtype(dtype))
+    return execute(f, x, _name="cummin")
+
+
+@_export
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def f(ya, xa=None):
+        d = dx if dx is not None else 1.0
+        if xa is not None:
+            d = jnp.diff(xa, axis=axis)
+        else:
+            d = jnp.asarray(d)
+        sl1 = [slice(None)] * ya.ndim
+        sl2 = [slice(None)] * ya.ndim
+        sl1[axis] = slice(1, None)
+        sl2[axis] = slice(None, -1)
+        avg = (ya[tuple(sl1)] + ya[tuple(sl2)]) / 2.0
+        return jnp.cumsum(avg * d, axis=axis)
+    if x is None:
+        return execute(f, y, _name="cumulative_trapezoid")
+    return execute(f, y, x, _name="cumulative_trapezoid")
+
+
+@_export
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def f(ya, xa=None):
+        if xa is not None:
+            return jnp.trapezoid(ya, xa, axis=axis)
+        return jnp.trapezoid(ya, dx=dx if dx is not None else 1.0, axis=axis)
+    if x is None:
+        return execute(f, y, _name="trapezoid")
+    return execute(f, y, x, _name="trapezoid")
+
+
+@_export
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [x]
+    kw = {}
+    if prepend is not None:
+        args.append(prepend)
+    if append is not None:
+        args.append(append)
+    def f(a, *rest):
+        i = 0
+        pre = app = None
+        if prepend is not None:
+            pre = rest[i]; i += 1
+        if append is not None:
+            app = rest[i]
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+    return execute(f, *args, _name="diff")
+
+
+# ---- matmul & friends live in linalg, dot products here for parity --------
+@_export
+def dot(x, y, name=None):
+    def f(a, b):
+        if a.ndim == 1:
+            return jnp.dot(a, b)
+        return jnp.sum(a * b, axis=-1)
+    return execute(f, x, y, _name="dot")
+
+
+@_export
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """reference: python/paddle/tensor/linalg.py:191; kernel
+    paddle/phi/kernels/gpu/matmul_kernel.cu → here a single jnp.matmul the
+    XLA compiler tiles onto the MXU."""
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+    return execute(f, x, y, _name="matmul")
+
+
+mm = matmul
+__all__.append("mm")
+
+
+@_export
+def bmm(x, y, name=None):
+    return execute(jnp.matmul, x, y, _name="bmm")
+
+
+@_export
+def isfinite(x, name=None):
+    return execute(jnp.isfinite, x, _name="isfinite")
+
+
+@_export
+def isinf(x, name=None):
+    return execute(jnp.isinf, x, _name="isinf")
+
+
+@_export
+def isnan(x, name=None):
+    return execute(jnp.isnan, x, _name="isnan")
+
+
+@_export
+def isneginf(x, name=None):
+    return execute(jnp.isneginf, x, _name="isneginf")
+
+
+@_export
+def isposinf(x, name=None):
+    return execute(jnp.isposinf, x, _name="isposinf")
+
+
+@_export
+def isreal(x, name=None):
+    return execute(jnp.isreal, x, _name="isreal")
+
+
+@_export
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return execute(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y, _name="isclose")
+
+
+@_export
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return execute(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y, _name="allclose")
+
+
+@_export
+def equal_all(x, y, name=None):
+    return execute(lambda a, b: jnp.array_equal(a, b), x, y, _name="equal_all")
+
+
+@_export
+def increment(x, value=1.0, name=None):
+    out = execute(lambda a: a + value, x, _name="increment")
+    x._rebind(out)
+    return x
+
+
+@_export
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    def f(inp, lab):
+        topk_idx = jax.lax.top_k(inp, k)[1]
+        lab2 = lab.reshape(-1, 1)
+        hit = jnp.any(topk_idx == lab2, axis=1)
+        return jnp.mean(hit.astype(jnp.float32))
+    return execute(f, input, label, _name="accuracy")

@@ -1,0 +1,172 @@
+"""Random ops on the global PRNG. reference: python/paddle/tensor/random.py.
+
+Paddle's stateful generators map onto a host-side counter folded into a jax
+PRNG key (framework/random.py) — deterministic per seed, trace-safe under
+jit.to_static (key is a traced input there).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtypes as _dt
+from ..framework.core import Tensor, execute
+from ..framework.random import next_key
+
+__all__ = [
+    "rand", "randn", "standard_normal", "normal", "normal_", "uniform",
+    "uniform_", "randint", "randint_like", "randperm", "bernoulli",
+    "poisson", "multinomial", "standard_gamma", "binomial", "exponential_",
+    "gumbel_softmax", "log_normal", "cauchy_", "geometric_",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        import numpy as np
+        return tuple(int(v) for v in np.asarray(shape._data))
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dtype(dtype):
+    return _dt.convert_dtype(dtype) if dtype is not None else _dt.convert_dtype(_dt.get_default_dtype())
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), _dtype(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_key(), _shape(shape), _dtype(dtype)))
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        def f(*args):
+            i = 0
+            m = args[i] if isinstance(mean, Tensor) else mean
+            if isinstance(mean, Tensor):
+                i += 1
+            s = args[i] if isinstance(std, Tensor) else std
+            shp = jnp.broadcast_shapes(
+                m.shape if hasattr(m, "shape") else (),
+                s.shape if hasattr(s, "shape") else ())
+            return m + s * jax.random.normal(next_key(), shp, _dtype(None))
+        args = [a for a in (mean, std) if isinstance(a, Tensor)]
+        return execute(f, *args, _name="normal")
+    return Tensor(mean + std * jax.random.normal(next_key(), _shape(shape or [1]), _dtype(None)))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._data = mean + std * jax.random.normal(next_key(), x._data.shape, x._data.dtype)
+    return x
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dtype(dtype), minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else next_key()
+    x._data = jax.random.uniform(key, x._data.shape, x._data.dtype, minval=min, maxval=max)
+    return x
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape(shape), low, high, _dt.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = _dt.convert_dtype(dtype) if dtype else x._data.dtype
+    return Tensor(jax.random.randint(next_key(), x._data.shape, low, high, jnp.int64).astype(dt))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), n).astype(_dt.convert_dtype(dtype)))
+
+
+def bernoulli(x, p=None, name=None):
+    def f(a):
+        return jax.random.bernoulli(next_key(), a if p is None else p, a.shape).astype(a.dtype)
+    return execute(f, x, _name="bernoulli")
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x._data = jax.random.bernoulli(next_key(), p, x._data.shape).astype(x._data.dtype)
+    return x
+
+
+def poisson(x, name=None):
+    def f(a):
+        return jax.random.poisson(next_key(), a).astype(a.dtype)
+    return execute(f, x, _name="poisson")
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    def f(a):
+        logits = jnp.log(jnp.maximum(a, 1e-30))
+        if replacement:
+            return jax.random.categorical(next_key(), logits, axis=-1,
+                                          shape=(num_samples,) + a.shape[:-1]).T if a.ndim > 1 else \
+                   jax.random.categorical(next_key(), logits, axis=-1, shape=(num_samples,))
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(next_key(), a.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx
+    out = execute(f, x, _name="multinomial")
+    return out.astype("int64")
+
+
+def standard_gamma(x, name=None):
+    def f(a):
+        return jax.random.gamma(next_key(), a)
+    return execute(f, x, _name="standard_gamma")
+
+
+def binomial(count, prob, name=None):
+    def f(n, p):
+        return jax.random.binomial(next_key(), n.astype(jnp.float32), p).astype(jnp.int64)
+    return execute(f, count, prob, _name="binomial")
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._data = (jax.random.exponential(next_key(), x._data.shape, x._data.dtype) / lam).astype(x._data.dtype)
+    return x
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    return Tensor(jnp.exp(mean + std * jax.random.normal(next_key(), _shape(shape or [1]), _dtype(None))))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    x._data = (loc + scale * jax.random.cauchy(next_key(), x._data.shape, x._data.dtype)).astype(x._data.dtype)
+    return x
+
+
+def geometric_(x, probs=0.5, name=None):
+    u = jax.random.uniform(next_key(), x._data.shape)
+    x._data = (jnp.floor(jnp.log1p(-u) / jnp.log1p(-probs)) + 1).astype(x._data.dtype)
+    return x
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    def f(a):
+        g = jax.random.gumbel(next_key(), a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.put_along_axis(jnp.zeros_like(y), idx, 1.0, axis=axis, inplace=False)
+            y = y_hard - jax.lax.stop_gradient(y) + y  # straight-through
+        return y
+    return execute(f, x, _name="gumbel_softmax")

@@ -1,0 +1,169 @@
+"""Search/sort ops. reference: python/paddle/tensor/search.py.
+
+top_k lowers to jax.lax.top_k (TPU-optimized); sort to XLA's variadic sort.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtypes as _dt
+from ..framework.core import Tensor, execute
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "top_k", "searchsorted",
+    "index_sample", "masked_select", "nonzero", "where", "mode", "kthvalue",
+    "unique", "unique_consecutive", "bucketize",
+]
+
+from .manipulation import index_sample, masked_select, nonzero, where  # noqa: F401
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        if axis is None:
+            r = jnp.argmax(a.reshape(-1))
+            return r.reshape((1,) * a.ndim) if keepdim else r
+        r = jnp.argmax(a, axis=axis)
+        return jnp.expand_dims(r, axis) if keepdim else r
+    out = execute(f, x, _name="argmax")
+    return out.astype(dtype) if dtype else out
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        if axis is None:
+            r = jnp.argmin(a.reshape(-1))
+            return r.reshape((1,) * a.ndim) if keepdim else r
+        r = jnp.argmin(a, axis=axis)
+        return jnp.expand_dims(r, axis) if keepdim else r
+    out = execute(f, x, _name="argmin")
+    return out.astype(dtype) if dtype else out
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=axis, stable=stable, descending=descending)
+        return idx.astype(jnp.int64)
+    return execute(f, x, _name="argsort")
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        s = jnp.sort(a, axis=axis, stable=stable, descending=descending)
+        return s
+    return execute(f, x, _name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    kk = int(k._data) if isinstance(k, Tensor) else int(k)
+    def f(a):
+        ax = a.ndim - 1 if axis is None else axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        src = moved if largest else -moved
+        vals, idx = jax.lax.top_k(src, kk)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+    return execute(f, x, _name="topk")
+
+
+top_k = topk
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def f(seq, v):
+        side = "right" if right else "left"
+        if seq.ndim == 1:
+            r = jnp.searchsorted(seq, v, side=side)
+        else:
+            r = jax.vmap(lambda s, vv: jnp.searchsorted(s, vv, side=side))(
+                seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1])
+            ).reshape(v.shape)
+        return r.astype(jnp.int32 if out_int32 else jnp.int64)
+    return execute(f, sorted_sequence, values, _name="searchsorted")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    def f(a, seq):
+        r = jnp.searchsorted(seq, a, side="right" if right else "left")
+        return r.astype(jnp.int32 if out_int32 else jnp.int64)
+    return execute(f, x, sorted_sequence, _name="bucketize")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        n = moved.shape[-1]
+        s = jnp.sort(moved, axis=-1)
+        si = jnp.argsort(moved, axis=-1, stable=True)
+        # count runs in sorted order; mode = value with max count (last occurrence)
+        eq = s[..., 1:] == s[..., :-1]
+        runid = jnp.concatenate([jnp.zeros_like(s[..., :1], dtype=jnp.int32),
+                                 jnp.cumsum((~eq).astype(jnp.int32), -1)], -1)
+        counts = jax.vmap(lambda r: jnp.bincount(r, length=n))(runid.reshape(-1, n)).reshape(runid.shape[:-1] + (n,))
+        cnt_per_elem = jnp.take_along_axis(counts, runid, axis=-1)
+        best = jnp.argmax(cnt_per_elem + jnp.arange(n) * 1e-9, axis=-1)
+        vals = jnp.take_along_axis(s, best[..., None], -1)[..., 0]
+        idxs = jnp.take_along_axis(si, best[..., None], -1)[..., 0].astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idxs = jnp.expand_dims(idxs, ax)
+        else:
+            vals = jnp.moveaxis(vals[..., None], -1, ax)[..., 0] if False else vals
+            idxs = idxs
+        return vals, idxs
+    return execute(f, x, _name="mode")
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        s = jnp.sort(a, axis=ax)
+        si = jnp.argsort(a, axis=ax, stable=True)
+        vals = jnp.take(s, k - 1, axis=ax)
+        idxs = jnp.take(si, k - 1, axis=ax).astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idxs = jnp.expand_dims(idxs, ax)
+        return vals, idxs
+    return execute(f, x, _name="kthvalue")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # dynamic output size → host computation (non-jittable, like reference's
+    # unique CPU fallback for dynamic shapes)
+    a = np.asarray(x._data)
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r if i == 0 else r.astype(np.int64)))
+            for i, r in enumerate(res)]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    a = np.asarray(x._data)
+    if axis is None:
+        a = a.reshape(-1)
+        keep = np.concatenate([[True], a[1:] != a[:-1]])
+    else:
+        diff = (a.take(range(1, a.shape[axis]), axis) != a.take(range(0, a.shape[axis] - 1), axis))
+        keep = np.concatenate([[True], diff.reshape(diff.shape[axis] if diff.ndim == 1 else -1, *([] if diff.ndim == 1 else [])).any(axis=tuple(i for i in range(diff.ndim) if i != axis)) if diff.ndim > 1 else diff])
+    vals = a[keep] if axis is None else np.compress(keep, a, axis)
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        n = a.shape[0] if axis is None else a.shape[axis]
+        counts = np.diff(np.append(idx, n))
+        outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)

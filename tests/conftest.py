@@ -1,0 +1,18 @@
+"""Test env: force CPU backend with 8 virtual devices so sharding/mesh tests
+run without TPU hardware (the driver benches on the real chip separately).
+
+Note: the environment's TPU plugin (axon) calls
+jax.config.update("jax_platforms", "axon,cpu") from sitecustomize at
+interpreter start, which overrides the JAX_PLATFORMS env var — so we must
+override via jax.config here, before any backend is used.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

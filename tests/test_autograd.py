@@ -1,0 +1,183 @@
+"""Autograd engine tests — analytic grads vs numeric finite differences
+(the OpTest check_grad pattern, reference: test/legacy_test/op_test.py:3129).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(f, x, eps=1e-3):
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy(); xp[idx] += eps
+        xm = x.copy(); xm[idx] -= eps
+        g[idx] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0], rtol=1e-5)
+
+    def test_matmul_grad(self):
+        a = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+        b = np.random.RandomState(1).rand(4, 2).astype(np.float32)
+        ta = paddle.to_tensor(a, stop_gradient=False)
+        tb = paddle.to_tensor(b, stop_gradient=False)
+        loss = paddle.matmul(ta, tb).sum()
+        loss.backward()
+        np.testing.assert_allclose(ta.grad.numpy(), (np.ones((3, 2)) @ b.T), rtol=1e-4)
+        np.testing.assert_allclose(tb.grad.numpy(), (a.T @ np.ones((3, 2))), rtol=1e-4)
+
+    def test_branching_accumulation(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2
+        z = x * 3
+        (y + z).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+    def test_grad_accumulates_across_backwards(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.to_tensor([2.0])  # stop_gradient=True
+        (x * y).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * 2
+        z = y.detach() * x
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])  # only through x
+
+    def test_numeric_check_tanh_softmax(self):
+        a = np.random.RandomState(0).rand(5).astype(np.float32)
+        x = paddle.to_tensor(a, stop_gradient=False)
+        loss = paddle.nn.functional.softmax(paddle.tanh(x)).sum()
+        # softmax().sum() grad is ~0; use a weighted sum instead
+        w = np.arange(1.0, 6.0, dtype=np.float32)
+        x.clear_grad()
+        loss = (paddle.nn.functional.softmax(paddle.tanh(x)) * paddle.to_tensor(w)).sum()
+        loss.backward()
+
+        def ref(arr):
+            t = np.tanh(arr)
+            e = np.exp(t - t.max())
+            s = e / e.sum()
+            return float((s * w).sum())
+
+        ng = numeric_grad(ref, a.astype(np.float64)).astype(np.float32)
+        np.testing.assert_allclose(x.grad.numpy(), ng, rtol=1e-2, atol=1e-4)
+
+    def test_no_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+    def test_inplace_add_(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2
+        y.add_(paddle.to_tensor([1.0, 1.0]))
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+class TestPaddleGrad:
+    def test_grad_api(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [4.0])
+        assert x.grad is None  # paddle.grad does not pollute .grad
+
+    def test_grad_intermediate(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * 2
+        z = y * y
+        (gy,) = paddle.grad(z, y)
+        np.testing.assert_allclose(gy.numpy(), [12.0])
+
+    def test_grad_unused(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        u = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        outs = paddle.grad(y, [x, u], allow_unused=True)
+        assert outs[1] is None
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class Double(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                return grad * 2
+
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = Double.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+    def test_pylayer_multi_input(self):
+        class Mul(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                ctx.save_for_backward(a, b)
+                return a * b
+
+            @staticmethod
+            def backward(ctx, grad):
+                a, b = ctx.saved_tensor
+                return grad * b, grad * a
+
+        a = paddle.to_tensor([2.0], stop_gradient=False)
+        b = paddle.to_tensor([3.0], stop_gradient=False)
+        (Mul.apply(a, b)).sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(), [3.0])
+        np.testing.assert_allclose(b.grad.numpy(), [2.0])
+
+
+class TestFunctional:
+    def test_vjp_jvp(self):
+        def f(x):
+            return x * x
+
+        x = paddle.to_tensor([3.0])
+        out, g = paddle.autograd.vjp(f, x)
+        np.testing.assert_allclose(g.numpy(), [6.0])
+        out, t = paddle.autograd.jvp(f, x)
+        np.testing.assert_allclose(t.numpy(), [6.0])
+
+    def test_hessian(self):
+        def f(x):
+            return (x * x * x).sum()
+
+        x = paddle.to_tensor([2.0])
+        h = paddle.autograd.hessian(f, x)
+        np.testing.assert_allclose(np.asarray(h).reshape(-1), [12.0], rtol=1e-5)

@@ -1,0 +1,273 @@
+"""nn.Layer + functional tests. Numeric refs via numpy / torch-free formulas."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def allclose(t, ref, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(t), np.asarray(ref), rtol=rtol, atol=atol)
+
+
+class TestLayerBase:
+    def test_parameter_registry(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        params = net.parameters()
+        assert len(params) == 4
+        names = [n for n, _ in net.named_parameters()]
+        assert "fc1.weight" in names and "fc2.bias" in names
+
+    def test_state_dict_roundtrip(self):
+        net = nn.Linear(3, 3)
+        sd = net.state_dict()
+        net2 = nn.Linear(3, 3)
+        net2.set_state_dict(sd)
+        allclose(net2.weight, net.weight)
+
+    def test_train_eval_mode(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        x = paddle.ones([4, 2])
+        out1 = net(x)
+        out2 = net(x)
+        allclose(out1, out2)
+
+    def test_sequential_layerlist(self):
+        s = nn.Sequential(nn.Linear(2, 3), nn.Linear(3, 4))
+        assert len(s) == 2
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4
+        assert len(nn.Sequential(*ll).parameters()) == 8
+
+    def test_buffers(self):
+        bn = nn.BatchNorm2D(4)
+        names = [n for n, _ in bn.named_buffers()]
+        assert "_mean" in names and "_variance" in names
+
+    def test_hooks(self):
+        net = nn.Linear(2, 2)
+        calls = []
+        h = net.register_forward_post_hook(lambda l, i, o: calls.append(1))
+        net(paddle.ones([1, 2]))
+        assert calls
+        h.remove()
+        net(paddle.ones([1, 2]))
+        assert len(calls) == 1
+
+
+class TestFunctional:
+    def test_linear(self):
+        x = np.random.RandomState(0).rand(2, 3).astype(np.float32)
+        w = np.random.RandomState(1).rand(3, 4).astype(np.float32)
+        b = np.random.RandomState(2).rand(4).astype(np.float32)
+        out = F.linear(paddle.to_tensor(x), paddle.to_tensor(w), paddle.to_tensor(b))
+        allclose(out, x @ w + b)
+
+    def test_activations(self):
+        a = np.linspace(-3, 3, 13).astype(np.float32)
+        x = paddle.to_tensor(a)
+        allclose(F.relu(x), np.maximum(a, 0))
+        allclose(F.sigmoid(x), 1 / (1 + np.exp(-a)), rtol=1e-4)
+        allclose(F.softmax(x), np.exp(a) / np.exp(a).sum(), rtol=1e-4)
+        allclose(F.gelu(x), 0.5 * a * (1 + np.vectorize(lambda v: __import__('math').erf(v / np.sqrt(2)))(a)), rtol=1e-3, atol=1e-5)
+        allclose(F.leaky_relu(x), np.where(a > 0, a, 0.01 * a))
+
+    def test_conv2d_identity(self):
+        # 1x1 identity kernel preserves input
+        x = np.random.RandomState(0).rand(1, 2, 4, 4).astype(np.float32)
+        w = np.zeros((2, 2, 1, 1), np.float32)
+        w[0, 0] = w[1, 1] = 1
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w))
+        allclose(out, x)
+
+    def test_conv2d_vs_manual(self):
+        rs = np.random.RandomState(0)
+        x = rs.rand(1, 1, 5, 5).astype(np.float32)
+        w = rs.rand(1, 1, 3, 3).astype(np.float32)
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), padding=0)
+        ref = np.zeros((1, 1, 3, 3), np.float32)
+        for i in range(3):
+            for j in range(3):
+                ref[0, 0, i, j] = (x[0, 0, i:i + 3, j:j + 3] * w[0, 0]).sum()
+        allclose(out, ref)
+
+    def test_conv2d_groups_stride(self):
+        x = paddle.ones([1, 4, 8, 8])
+        w = paddle.ones([4, 2, 3, 3])
+        out = F.conv2d(x, w, stride=2, padding=1, groups=2)
+        assert out.shape == [1, 4, 4, 4]
+
+    def test_conv_transpose(self):
+        x = paddle.ones([1, 2, 4, 4])
+        w = paddle.ones([2, 3, 2, 2])
+        out = F.conv2d_transpose(x, w, stride=2)
+        assert out.shape == [1, 3, 8, 8]
+
+    def test_pools(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = F.max_pool2d(paddle.to_tensor(x), 2, 2)
+        allclose(out, [[[[5, 7], [13, 15]]]])
+        out = F.avg_pool2d(paddle.to_tensor(x), 2, 2)
+        allclose(out, [[[[2.5, 4.5], [10.5, 12.5]]]])
+        out = F.adaptive_avg_pool2d(paddle.to_tensor(x), 1)
+        allclose(out, [[[[7.5]]]])
+
+    def test_batch_norm_train_eval(self):
+        bn = nn.BatchNorm2D(3, momentum=0.9)
+        x = paddle.to_tensor(np.random.RandomState(0).rand(4, 3, 2, 2).astype(np.float32))
+        bn.train()
+        out = bn(x)
+        m = np.asarray(out._data).mean(axis=(0, 2, 3))
+        np.testing.assert_allclose(m, np.zeros(3), atol=1e-5)
+        # running stats updated
+        assert not np.allclose(np.asarray(bn._mean._data), 0)
+        bn.eval()
+        out2 = bn(x)
+        assert out2.shape == [4, 3, 2, 2]
+
+    def test_layer_norm(self):
+        x = np.random.RandomState(0).rand(2, 5).astype(np.float32)
+        ln = nn.LayerNorm(5)
+        out = ln(paddle.to_tensor(x))
+        ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+        allclose(out, ref, rtol=1e-4)
+
+    def test_group_instance_norm(self):
+        x = paddle.to_tensor(np.random.RandomState(0).rand(2, 4, 3, 3).astype(np.float32))
+        assert nn.GroupNorm(2, 4)(x).shape == [2, 4, 3, 3]
+        assert nn.InstanceNorm2D(4)(x).shape == [2, 4, 3, 3]
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        idx = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+        out = emb(idx)
+        assert out.shape == [2, 2, 4]
+        allclose(out[0, 0], emb.weight[1])
+
+    def test_dropout_train(self):
+        paddle.seed(0)
+        x = paddle.ones([1000])
+        out = F.dropout(x, 0.5, training=True)
+        arr = np.asarray(out._data)
+        frac = (arr == 0).mean()
+        assert 0.4 < frac < 0.6
+        kept = arr[arr != 0]
+        np.testing.assert_allclose(kept, 2.0, rtol=1e-6)
+
+    def test_losses(self):
+        logits = np.array([[2.0, 1.0, 0.1], [0.5, 2.5, 0.3]], np.float32)
+        labels = np.array([0, 1])
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(2), labels]).mean()
+        allclose(loss, ref, rtol=1e-5)
+
+        pred = np.array([0.2, 0.8], np.float32)
+        tgt = np.array([0.0, 1.0], np.float32)
+        allclose(F.mse_loss(paddle.to_tensor(pred), paddle.to_tensor(tgt)),
+                 ((pred - tgt) ** 2).mean())
+        allclose(F.l1_loss(paddle.to_tensor(pred), paddle.to_tensor(tgt)),
+                 np.abs(pred - tgt).mean())
+        allclose(F.binary_cross_entropy(paddle.to_tensor(pred), paddle.to_tensor(tgt)),
+                 -(np.log(1 - 0.2) + np.log(0.8)) / 2, rtol=1e-4)
+
+    def test_cross_entropy_soft_ignore(self):
+        logits = paddle.to_tensor(np.random.RandomState(0).rand(4, 5).astype(np.float32))
+        labels = paddle.to_tensor(np.array([0, -100, 2, -100]))
+        loss = F.cross_entropy(logits, labels, ignore_index=-100)
+        assert np.isfinite(float(loss))
+        soft = paddle.to_tensor(np.full((4, 5), 0.2, np.float32))
+        loss2 = F.cross_entropy(logits, soft, soft_label=True)
+        assert np.isfinite(float(loss2))
+
+    def test_interpolate(self):
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
+        out = F.interpolate(x, size=[4, 4], mode="nearest")
+        assert out.shape == [1, 1, 4, 4]
+        out = F.interpolate(x, scale_factor=2, mode="bilinear")
+        assert out.shape == [1, 1, 4, 4]
+
+    def test_pixel_shuffle(self):
+        x = paddle.ones([1, 4, 2, 2])
+        assert F.pixel_shuffle(x, 2).shape == [1, 1, 4, 4]
+
+    def test_attention(self):
+        rs = np.random.RandomState(0)
+        q = rs.rand(2, 4, 2, 8).astype(np.float32)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q))
+        assert out.shape == [2, 4, 2, 8]
+        # causal: first position attends only to itself
+        out_c = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            is_causal=True)
+        allclose(np.asarray(out_c._data)[:, 0], q[:, 0], rtol=1e-4)
+
+
+class TestRNNLayers:
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        x = paddle.randn([3, 5, 4])
+        out, (h, c) = lstm(x)
+        assert out.shape == [3, 5, 8]
+        assert h.shape == [2, 3, 8]
+
+    def test_gru_bidirectional(self):
+        gru = nn.GRU(4, 8, direction="bidirect")
+        x = paddle.randn([2, 5, 4])
+        out, h = gru(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_rnn_grad_flows(self):
+        rnn = nn.SimpleRNN(3, 4)
+        x = paddle.randn([2, 3, 3])
+        out, _ = rnn(x)
+        out.sum().backward()
+        assert rnn.weight_ih_l0.grad is not None
+
+
+class TestTransformer:
+    def test_mha(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.randn([2, 6, 16])
+        out = mha(x, x, x)
+        assert out.shape == [2, 6, 16]
+
+    def test_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = paddle.randn([2, 6, 16])
+        assert enc(x).shape == [2, 6, 16]
+        # distinct layers = distinct params
+        assert len(enc.parameters()) > len(layer.parameters())
+
+    def test_full_transformer(self):
+        t = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=1,
+                           num_decoder_layers=1, dim_feedforward=32)
+        src = paddle.randn([2, 5, 16])
+        tgt = paddle.randn([2, 3, 16])
+        assert t(src, tgt).shape == [2, 3, 16]
+
+
+class TestClip:
+    def test_clip_by_global_norm(self):
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        g1 = paddle.to_tensor([3.0, 4.0])
+        p1 = paddle.to_tensor([0.0, 0.0])
+        out = clip([(p1, g1)])
+        allclose(out[0][1], np.array([0.6, 0.8]), rtol=1e-5)

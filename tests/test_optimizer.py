@@ -1,0 +1,126 @@
+"""Optimizer + LR scheduler tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def quad_problem():
+    # minimize ||Wx - y||^2 on a fixed batch
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.rand(16, 4).astype(np.float32))
+    y = paddle.to_tensor(rs.rand(16, 2).astype(np.float32))
+    net = nn.Linear(4, 2)
+    return net, x, y
+
+
+def run_steps(net, opt, x, y, n=60):
+    losses = []
+    for _ in range(n):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+class TestOptimizers:
+    @pytest.mark.parametrize("cls,kw", [
+        (optimizer.SGD, dict(learning_rate=0.5)),
+        (optimizer.Momentum, dict(learning_rate=0.1, momentum=0.9)),
+        (optimizer.Adam, dict(learning_rate=0.05)),
+        (optimizer.AdamW, dict(learning_rate=0.05, weight_decay=0.01)),
+        (optimizer.RMSProp, dict(learning_rate=0.01)),
+        (optimizer.Adagrad, dict(learning_rate=0.3)),
+        (optimizer.Adamax, dict(learning_rate=0.05)),
+        (optimizer.Adadelta, dict(learning_rate=1.0)),
+        (optimizer.Lamb, dict(learning_rate=0.05)),
+        (optimizer.NAdam, dict(learning_rate=0.05)),
+        (optimizer.RAdam, dict(learning_rate=0.05)),
+    ])
+    def test_converges(self, cls, kw):
+        paddle.seed(1)
+        net, x, y = quad_problem()
+        opt = cls(parameters=net.parameters(), **kw)
+        losses = run_steps(net, opt, x, y)
+        assert losses[-1] < losses[0] * 0.7, f"{cls.__name__}: {losses[0]} -> {losses[-1]}"
+
+    def test_sgd_exact_update(self):
+        p = paddle.framework.core.Parameter(np.array([1.0, 2.0], np.float32))
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+        (p * paddle.to_tensor([3.0, 4.0])).sum().backward()
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [1.0 - 0.3, 2.0 - 0.4], rtol=1e-6)
+
+    def test_adam_state_dict_roundtrip(self):
+        paddle.seed(0)
+        net, x, y = quad_problem()
+        opt = optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+        run_steps(net, opt, x, y, n=3)
+        sd = opt.state_dict()
+        opt2 = optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == opt._step_count
+
+    def test_grad_clip_in_optimizer(self):
+        net, x, y = quad_problem()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters(),
+                            grad_clip=nn.ClipGradByGlobalNorm(0.001))
+        losses = run_steps(net, opt, x, y, n=2)
+        assert np.isfinite(losses[-1])
+
+    def test_minimize(self):
+        net, x, y = quad_problem()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        loss = ((net(x) - y) ** 2).mean()
+        opt.minimize(loss)
+        assert net.weight.grad is None  # cleared
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        vals = []
+        for _ in range(5):
+            vals.append(s())
+            s.step()
+        np.testing.assert_allclose(vals, [0.1, 0.1, 0.05, 0.05, 0.025], rtol=1e-6)
+
+    def test_cosine(self):
+        s = optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert abs(s() - 1.0) < 1e-6
+        for _ in range(10):
+            s.step()
+        assert s() < 1e-6
+
+    def test_warmup(self):
+        s = optimizer.lr.LinearWarmup(0.1, warmup_steps=5, start_lr=0.0, end_lr=0.1)
+        vals = [s()]
+        for _ in range(6):
+            s.step()
+            vals.append(s())
+        assert vals[0] == 0.0 and abs(vals[5] - 0.1) < 1e-9
+
+    def test_optimizer_uses_scheduler(self):
+        net, x, y = quad_problem()
+        sched = optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+        opt = optimizer.SGD(learning_rate=sched, parameters=net.parameters())
+        assert opt.get_lr() == 0.1
+        sched.step()
+        assert abs(opt.get_lr() - 0.01) < 1e-9
+
+    def test_noam_piecewise(self):
+        s = optimizer.lr.NoamDecay(d_model=64, warmup_steps=100)
+        assert s() > 0
+        p = optimizer.lr.PiecewiseDecay([2, 4], [0.1, 0.01, 0.001])
+        p.step(3)
+        assert p() == 0.01
+
+    def test_reduce_on_plateau(self):
+        s = optimizer.lr.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+        for v in [1.0, 1.0, 1.0, 1.0]:
+            s.step(v)
+        assert s() < 0.1

@@ -1,0 +1,202 @@
+"""Core tensor op tests — OpTest-style numeric checks vs NumPy.
+
+reference test model: test/legacy_test/op_test.py (check_output vs numpy ref).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def allclose(t, ref, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(np.asarray(t), np.asarray(ref), rtol=rtol, atol=atol)
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        t = paddle.to_tensor([1.0, 2.0, 3.0])
+        assert t.shape == [3]
+        allclose(t, [1, 2, 3])
+
+    def test_zeros_ones_full(self):
+        assert np.all(paddle.zeros([2, 3]).numpy() == 0)
+        assert np.all(paddle.ones([2, 3]).numpy() == 1)
+        assert np.all(paddle.full([2, 2], 7).numpy() == 7)
+
+    def test_arange_linspace(self):
+        allclose(paddle.arange(5), np.arange(5))
+        allclose(paddle.arange(1, 10, 2), np.arange(1, 10, 2))
+        allclose(paddle.linspace(0, 1, 5), np.linspace(0, 1, 5))
+
+    def test_eye_tril_triu(self):
+        allclose(paddle.eye(3), np.eye(3))
+        x = paddle.to_tensor(np.arange(9).reshape(3, 3).astype(np.float32))
+        allclose(paddle.tril(x), np.tril(np.arange(9).reshape(3, 3)))
+        allclose(paddle.triu(x), np.triu(np.arange(9).reshape(3, 3)))
+
+    def test_dtype(self):
+        t = paddle.to_tensor([1, 2])
+        assert "int" in str(t.dtype)
+        t2 = t.astype("float32")
+        assert str(t2.dtype) == "float32"
+
+
+class TestMath:
+    def setup_method(self, _):
+        self.a = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+        self.b = np.random.RandomState(1).rand(3, 4).astype(np.float32) + 0.1
+        self.ta = paddle.to_tensor(self.a)
+        self.tb = paddle.to_tensor(self.b)
+
+    def test_binary_ops(self):
+        allclose(self.ta + self.tb, self.a + self.b)
+        allclose(self.ta - self.tb, self.a - self.b)
+        allclose(self.ta * self.tb, self.a * self.b)
+        allclose(self.ta / self.tb, self.a / self.b)
+        allclose(self.ta ** 2, self.a ** 2)
+        allclose(paddle.maximum(self.ta, self.tb), np.maximum(self.a, self.b))
+
+    def test_scalar_ops(self):
+        allclose(self.ta + 1, self.a + 1)
+        allclose(2 * self.ta, 2 * self.a)
+        allclose(1 - self.ta, 1 - self.a)
+
+    def test_unary(self):
+        allclose(paddle.exp(self.ta), np.exp(self.a), rtol=1e-4)
+        allclose(paddle.log(self.tb), np.log(self.b), rtol=1e-3, atol=1e-4)
+        allclose(paddle.sqrt(self.tb), np.sqrt(self.b), rtol=1e-4)
+        allclose(paddle.tanh(self.ta), np.tanh(self.a), rtol=1e-4)
+        allclose(paddle.abs(-self.ta), self.a)
+
+    def test_reductions(self):
+        allclose(self.ta.sum(), self.a.sum(), rtol=1e-5)
+        allclose(self.ta.mean(axis=0), self.a.mean(0), rtol=1e-5)
+        allclose(self.ta.max(axis=1), self.a.max(1))
+        allclose(self.ta.min(), self.a.min())
+        allclose(paddle.prod(self.tb), np.prod(self.b), rtol=1e-4)
+
+    def test_matmul(self):
+        allclose(paddle.matmul(self.ta, self.tb.transpose([1, 0])),
+                 self.a @ self.b.T, rtol=1e-4)
+        allclose(paddle.matmul(self.ta, self.tb, transpose_y=True),
+                 self.a @ self.b.T, rtol=1e-4)
+
+    def test_cumsum_clip(self):
+        allclose(paddle.cumsum(self.ta, axis=1), np.cumsum(self.a, 1), rtol=1e-5)
+        allclose(paddle.clip(self.ta, 0.2, 0.8), np.clip(self.a, 0.2, 0.8))
+
+    def test_comparisons(self):
+        assert np.array_equal((self.ta > self.tb).numpy(), self.a > self.b)
+        assert np.array_equal((self.ta == self.ta).numpy(), np.ones_like(self.a, bool))
+
+    def test_einsum(self):
+        allclose(paddle.einsum("ij,kj->ik", self.ta, self.tb),
+                 np.einsum("ij,kj->ik", self.a, self.b), rtol=1e-4)
+
+
+class TestManipulation:
+    def setup_method(self, _):
+        self.a = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+        self.t = paddle.to_tensor(self.a)
+
+    def test_reshape_transpose(self):
+        assert paddle.reshape(self.t, [6, 4]).shape == [6, 4]
+        assert self.t.reshape([-1]).shape == [24]
+        allclose(paddle.transpose(self.t, [2, 0, 1]), self.a.transpose(2, 0, 1))
+
+    def test_squeeze_unsqueeze(self):
+        t = paddle.ones([1, 3, 1])
+        assert paddle.squeeze(t).shape == [3]
+        assert paddle.unsqueeze(t, 0).shape == [1, 1, 3, 1]
+
+    def test_concat_stack_split(self):
+        c = paddle.concat([self.t, self.t], axis=1)
+        assert c.shape == [2, 6, 4]
+        s = paddle.stack([self.t, self.t], axis=0)
+        assert s.shape == [2, 2, 3, 4]
+        parts = paddle.split(self.t, 2, axis=2)
+        assert len(parts) == 2 and parts[0].shape == [2, 3, 2]
+        parts = paddle.split(self.t, [1, 3], axis=2)
+        assert parts[0].shape == [2, 3, 1] and parts[1].shape == [2, 3, 3]
+
+    def test_gather_scatter(self):
+        x = paddle.to_tensor(np.arange(12).reshape(4, 3).astype(np.float32))
+        idx = paddle.to_tensor([0, 2])
+        allclose(paddle.gather(x, idx), np.arange(12).reshape(4, 3)[[0, 2]])
+        upd = paddle.ones([2, 3])
+        out = paddle.scatter(x, idx, upd)
+        expect = np.arange(12).reshape(4, 3).astype(np.float32)
+        expect[[0, 2]] = 1
+        allclose(out, expect)
+
+    def test_indexing(self):
+        allclose(self.t[0], self.a[0])
+        allclose(self.t[:, 1], self.a[:, 1])
+        allclose(self.t[0, 1:3, ::2], self.a[0, 1:3, ::2])
+
+    def test_setitem(self):
+        t = paddle.zeros([3, 3])
+        t[1] = 5.0
+        assert np.all(t.numpy()[1] == 5)
+
+    def test_where_tile_flip(self):
+        cond = self.t > 10
+        allclose(paddle.where(cond, self.t, paddle.zeros_like(self.t)),
+                 np.where(self.a > 10, self.a, 0))
+        allclose(paddle.tile(paddle.to_tensor([1.0, 2.0]), [2, 2]),
+                 np.tile([1, 2], [2, 2]))
+        allclose(paddle.flip(self.t, [0]), self.a[::-1])
+
+    def test_pad(self):
+        x = paddle.ones([1, 1, 2, 2])
+        out = paddle.nn.functional.pad(x, [1, 1, 1, 1])
+        assert out.shape == [1, 1, 4, 4]
+
+
+class TestLinalgSearch:
+    def test_topk_argsort(self):
+        x = paddle.to_tensor([3.0, 1.0, 4.0, 1.5])
+        v, i = paddle.topk(x, 2)
+        allclose(v, [4.0, 3.0])
+        assert i.numpy().tolist() == [2, 0]
+        assert paddle.argsort(x).numpy().tolist() == [1, 3, 0, 2]
+        assert paddle.argmax(x).item() == 2
+
+    def test_norm_svd(self):
+        a = np.random.RandomState(0).rand(4, 3).astype(np.float32)
+        x = paddle.to_tensor(a)
+        allclose(paddle.linalg.norm(x), np.linalg.norm(a), rtol=1e-5)
+        u, s, v = paddle.linalg.svd(x)
+        allclose(np.abs(np.asarray(s)), np.linalg.svd(a, compute_uv=False), rtol=1e-4)
+
+    def test_solve_inv(self):
+        a = np.random.RandomState(0).rand(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+        b = np.random.RandomState(1).rand(3, 2).astype(np.float32)
+        allclose(paddle.linalg.solve(paddle.to_tensor(a), paddle.to_tensor(b)),
+                 np.linalg.solve(a, b), rtol=1e-4, atol=1e-5)
+        allclose(paddle.linalg.inv(paddle.to_tensor(a)), np.linalg.inv(a),
+                 rtol=1e-4, atol=1e-5)
+
+    def test_unique_sort(self):
+        x = paddle.to_tensor([3, 1, 2, 1, 3])
+        assert paddle.unique(x).numpy().tolist() == [1, 2, 3]
+        assert paddle.sort(paddle.to_tensor([3.0, 1.0, 2.0])).numpy().tolist() == [1, 2, 3]
+
+
+class TestRandom:
+    def test_seed_determinism(self):
+        paddle.seed(42)
+        a = paddle.randn([4, 4]).numpy()
+        paddle.seed(42)
+        b = paddle.randn([4, 4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_shapes_ranges(self):
+        u = paddle.uniform([100], min=0.0, max=1.0)
+        assert u.shape == [100]
+        assert float(u.min()) >= 0.0 and float(u.max()) <= 1.0
+        r = paddle.randint(0, 10, [50])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        p = paddle.randperm(10)
+        assert sorted(p.numpy().tolist()) == list(range(10))

@@ -1,0 +1,120 @@
+"""Benchmark: Llama pretraining step throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric: model FLOPs utilization (MFU) of a compiled Llama train step
+(bf16 params, AdamW, causal LM) — the BASELINE.md north-star unit.
+vs_baseline = MFU / 0.38 (the Llama-2-7B v5p-32 target ratio).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+PEAK_BF16 = {
+    # chip generation -> peak bf16 FLOP/s
+    "v5litepod": 197e12,   # v5e
+    "v5e": 197e12,
+    "v5": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6e": 918e12,
+}
+
+
+def detect_peak():
+    import jax
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower().replace(" ", "")
+    for key, val in PEAK_BF16.items():
+        if key in kind:
+            return val
+    if d.platform == "cpu":
+        return None
+    return 197e12
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.parallel import SpmdTrainer, DP_ONLY_RULES
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=8,
+                          num_attention_heads=16, max_position_embeddings=2048,
+                          dtype="bfloat16")
+        batch, seq, steps = 4, 2048, 8
+    else:  # smoke path off-TPU
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                          intermediate_size=256, num_hidden_layers=2,
+                          num_attention_heads=4, max_position_embeddings=256)
+        batch, seq, steps = 2, 128, 3
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    n_params = model.num_params()
+    opt = optimizer.AdamW(3e-4, parameters=model.parameters())
+
+    dev = jax.devices()[0]
+    mesh = Mesh(np.asarray([dev]).reshape(1, 1, 1, 1, 1),
+                ("pp", "mp", "sep", "sharding", "dp"))
+    trainer = SpmdTrainer(model, opt, mesh, DP_ONLY_RULES,
+                          batch_spec=P(), dtype="bfloat16" if on_tpu else None)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+
+    # warmup (compile)
+    loss = trainer.step((ids, ids))
+    _ = float(loss)
+    loss = trainer.step((ids, ids))
+    _ = float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step((ids, ids))
+    final = float(loss)  # sync
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * steps
+    tok_per_s = tokens / dt
+    # training FLOPs: 6N per token + attention 12*L*h*s per token
+    flops_per_token = 6.0 * n_params + 12.0 * cfg.num_hidden_layers * \
+        cfg.hidden_size * seq
+    achieved = flops_per_token * tok_per_s
+    peak = detect_peak()
+    if peak:
+        mfu = achieved / peak
+        print(json.dumps({
+            "metric": "llama_train_mfu_1chip",
+            "value": round(mfu, 4),
+            "unit": "mfu_fraction",
+            "vs_baseline": round(mfu / 0.38, 4),
+            "detail": {"tokens_per_s": round(tok_per_s, 1),
+                       "params": n_params, "loss": round(final, 4),
+                       "batch": batch, "seq": seq,
+                       "device": str(jax.devices()[0])},
+        }))
+    else:
+        print(json.dumps({
+            "metric": "llama_train_tokens_per_s_cpu_smoke",
+            "value": round(tok_per_s, 1),
+            "unit": "tokens/s",
+            "vs_baseline": 0.0,
+            "detail": {"loss": round(final, 4)},
+        }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

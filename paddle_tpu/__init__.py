@@ -56,6 +56,9 @@ from . import incubate  # noqa: F401
 from . import static  # noqa: F401
 from . import device  # noqa: F401
 from . import framework as base  # noqa: F401
+from . import models  # noqa: F401
+from . import ops  # noqa: F401
+from . import parallel  # noqa: F401
 from .framework import io_file as _io_file
 from .framework.io_file import save, load  # noqa: F401
 from .framework.param_attr import ParamAttr, L1Decay, L2Decay  # noqa: F401

@@ -1,0 +1,7 @@
+"""Model zoo (LLM families). Vision models live in paddle_tpu.vision.models."""
+
+from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM, llama_tiny,  # noqa: F401
+                    llama_7b, llama_13b)
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM, gpt_tiny, gpt3_1p3b  # noqa: F401
+from .bert import (BertConfig, BertModel, BertForPretraining,  # noqa: F401
+                   BertForSequenceClassification, bert_tiny, bert_base)

@@ -1,0 +1,161 @@
+"""BERT — BASELINE ladder config 3 (BERT-base pretraining).
+
+reference capability: PaddleNLP bert (attention/layernorm kernel exercise per
+BASELINE.json). TPU-first: post-LN encoder on the shared attention path;
+MLM + NSP pretraining heads.
+"""
+
+from __future__ import annotations
+
+from .. import nn
+from ..nn import functional as F
+from ..tensor.manipulation import reshape
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining",
+           "BertForSequenceClassification", "bert_tiny", "bert_base"]
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768, num_hidden_layers=12,
+                 num_attention_heads=12, intermediate_size=3072,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 layer_norm_eps=1e-12, dropout=0.1):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.layer_norm_eps = layer_norm_eps
+        self.dropout = dropout
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.position_embeddings = nn.Embedding(config.max_position_embeddings,
+                                                config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
+                                                  config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        import jax.numpy as jnp
+        from ..framework.core import Tensor
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(input_ids.shape[1])[None, :])
+        emb = self.word_embeddings(input_ids) + self.position_embeddings(position_ids)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertLayer(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        self.q = nn.Linear(h, h)
+        self.k = nn.Linear(h, h)
+        self.v = nn.Linear(h, h)
+        self.attn_out = nn.Linear(h, h)
+        self.attn_norm = nn.LayerNorm(h, config.layer_norm_eps)
+        self.ffn1 = nn.Linear(h, config.intermediate_size)
+        self.ffn2 = nn.Linear(config.intermediate_size, h)
+        self.ffn_norm = nn.LayerNorm(h, config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, x, attn_mask=None):
+        b, s = x.shape[0], x.shape[1]
+        q = reshape(self.q(x), [b, s, self.num_heads, self.head_dim])
+        k = reshape(self.k(x), [b, s, self.num_heads, self.head_dim])
+        v = reshape(self.v(x), [b, s, self.num_heads, self.head_dim])
+        attn = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                              training=self.training)
+        attn = self.attn_out(reshape(attn, [b, s, -1]))
+        x = self.attn_norm(x + self.dropout(attn))
+        h = self.ffn2(F.gelu(self.ffn1(x)))
+        return self.ffn_norm(x + self.dropout(h))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = nn.LayerList([BertLayer(config)
+                                     for _ in range(config.num_hidden_layers)])
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # (B, S) 1/0 mask → additive (B, 1, 1, S)
+            import jax.numpy as jnp
+            from ..framework.core import execute
+            attention_mask = execute(
+                lambda m: jnp.where(m[:, None, None, :] > 0, 0.0, -1e30),
+                attention_mask, _name="bert_mask")
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.encoder:
+            x = layer(x, attention_mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForPretraining(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.mlm_transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.mlm_norm = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.nsp_head = nn.Linear(config.hidden_size, 2)
+        self.config = config
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, next_sentence_labels=None):
+        seq, pooled = self.bert(input_ids, token_type_ids,
+                                attention_mask=attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        mlm_logits = F.linear(h, self.bert.embeddings.word_embeddings.weight.T)
+        nsp_logits = self.nsp_head(pooled)
+        if masked_lm_labels is not None:
+            loss = F.cross_entropy(mlm_logits, masked_lm_labels,
+                                   ignore_index=-100)
+            if next_sentence_labels is not None:
+                loss = loss + F.cross_entropy(nsp_logits, next_sentence_labels)
+            return loss, mlm_logits
+        return mlm_logits, nsp_logits
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, config: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels), logits
+        return logits
+
+
+def bert_tiny(**kw):
+    cfg = dict(vocab_size=512, hidden_size=128, num_hidden_layers=2,
+               num_attention_heads=4, intermediate_size=256,
+               max_position_embeddings=128)
+    cfg.update(kw)
+    return BertForPretraining(BertConfig(**cfg))
+
+
+def bert_base(**kw):
+    return BertForPretraining(BertConfig(**kw))

@@ -29,7 +29,6 @@ def _xla_attention(q, k, v, bias=None, causal=False, scale=None, dropout_p=0.0,
     # q,k,v: (batch, seq, heads, head_dim) — paddle flash_attention layout
     hd = q.shape[-1]
     s = scale if scale is not None else 1.0 / (hd ** 0.5)
-    qf = q.astype(jnp.float32) if q.dtype == jnp.bfloat16 else q
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * s
     if bias is not None:
@@ -47,6 +46,9 @@ def _xla_attention(q, k, v, bias=None, causal=False, scale=None, dropout_p=0.0,
 
 
 def _use_pallas(q_shape, head_dim, has_bias):
+    if has_bias:
+        # the pallas kernel takes no bias/mask — never select it silently
+        return False
     backend = _flags.flag_value("flash_attention_backend")
     if backend == "xla":
         return False
@@ -60,7 +62,7 @@ def _use_pallas(q_shape, head_dim, has_bias):
         return True
     # auto: long sequence + MXU-friendly head dim
     seq = q_shape[1]
-    return seq >= 1024 and head_dim % 128 == 0 and not has_bias
+    return seq >= 1024 and head_dim % 128 == 0
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,

@@ -1,0 +1,9 @@
+"""paddle_tpu.ops — TPU kernel layer (Pallas + shard_map collectives).
+
+The analog of paddle/phi/kernels/fusion + incubate fused ops, but as a
+small set of hand-scheduled Pallas kernels for exactly the ops XLA fuses
+poorly: flash attention, ring attention (context parallelism).
+"""
+
+from . import pallas  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,171 @@
+"""Flash attention forward as a Pallas TPU kernel.
+
+reference capability: paddle/phi/kernels/gpu/flash_attn_kernel.cu
+(FlashAttention-2 via dynload) + python/paddle/nn/functional/flash_attention.py.
+
+TPU-native design (not a CUDA port):
+- Grid over (batch*heads, q_blocks); K/V for the (batch, head) live in VMEM
+  (fits to ~8k sequence at head_dim 128 in bf16), the q block streams
+  through the online-softmax loop over K blocks — the classic
+  numerically-stable running (m, l, acc) recurrence.
+- MXU does the two matmuls per block with fp32 accumulation
+  (preferred_element_type); VPU does the softmax pieces.
+- Causal: K blocks strictly above the diagonal are skipped via @pl.when
+  (no wasted FLOPs), the diagonal block is masked with broadcasted_iota.
+- Backward: jax.custom_vjp whose bwd rematerializes through the XLA
+  attention (jax.checkpoint-style) — fwd gets the handwritten kernel,
+  bwd gets XLA's fused flash-style backward. A handwritten bwd kernel is
+  a later optimization, not a correctness requirement.
+
+On non-TPU backends the kernel runs under the Pallas interpreter (tests).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+               scale: float, seq_k: int, block_q: int, mask_k_tail: bool):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
+    d = q.shape[-1]
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    num_kb = pl.cdiv(seq_k, block_k)
+
+    def body(j, carry):
+        m, l, acc = carry
+
+        def compute():
+            k = k_ref[0, pl.ds(j * block_k, block_k), :]
+            v = v_ref[0, pl.ds(j * block_k, block_k), :]
+            s = jax.lax.dot_general(
+                q, k.astype(jnp.float32),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # (block_q, block_k)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            if mask_k_tail:
+                # K/V are padded to a block multiple: mask padded columns
+                s = jnp.where(cols < seq_k, s, NEG_INF)
+            if causal:
+                rows = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                s = jnp.where(rows >= cols, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * alpha + jax.lax.dot_general(
+                p, v.astype(jnp.float32),
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+
+        if causal:
+            # skip blocks strictly above the diagonal of this q block
+            needed = (j * block_k) <= (qi * block_q + block_q - 1)
+            return jax.lax.cond(needed, compute, lambda: (m, l, acc))
+        return compute()
+
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _pad_to(x, axis, multiple):
+    n = x.shape[axis]
+    rem = (-n) % multiple
+    if rem == 0:
+        return x
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, rem)
+    return jnp.pad(x, pads)
+
+
+def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=128, block_k=128,
+                    interpret=None):
+    """q/k/v: (BH, S, D). Ragged sequence lengths are padded to block
+    multiples; padded K columns are masked in-kernel, padded Q rows sliced
+    off on return (so results are exact for any length)."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    q_p = _pad_to(q, 1, block_q)
+    k_p = _pad_to(k, 1, block_k)
+    v_p = _pad_to(v, 1, block_k)
+    sq_p, sk_p = q_p.shape[1], k_p.shape[1]
+    mask_k_tail = sk_p != sk
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    grid = (bh, sq_p // block_q)
+    kernel = functools.partial(_fa_kernel, block_k=block_k, causal=causal,
+                               scale=scale, seq_k=sk, block_q=block_q,
+                               mask_k_tail=mask_k_tail)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk_p, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk_p, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype),
+        interpret=interpret,
+    )(q_p, k_p, v_p)
+    return out[:, :sq]
+
+
+def _xla_attention_bhsd(q, k, v, causal, scale):
+    s = jnp.einsum("bqd,bkd->bqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bqk,bkd->bqd", p, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention_bhsd(q, k, v, causal, scale):
+    return _flash_fwd_bhsd(q, k, v, causal, scale)
+
+
+def _fa_fwd(q, k, v, causal, scale):
+    return _flash_fwd_bhsd(q, k, v, causal, scale), (q, k, v)
+
+
+def _fa_bwd(causal, scale, res, g):
+    q, k, v = res
+    _, vjp_fn = jax.vjp(lambda q_, k_, v_: _xla_attention_bhsd(
+        q_, k_, v_, causal, scale), q, k, v)
+    return vjp_fn(g)
+
+
+_flash_attention_bhsd.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_attention_bshd(q, k, v, causal=False, scale=None):
+    """Paddle flash_attention layout: (batch, seq, heads, head_dim)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    qt = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
+    out = _flash_attention_bhsd(qt, kt, vt, causal, scale)
+    return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)

@@ -1,0 +1,105 @@
+"""Ring attention: exact attention over sequence shards (context parallelism).
+
+reference capability: the SEP/"segment parallel" axis
+(python/paddle/distributed/fleet/meta_parallel/segment_parallel.py:26,
+fleet/base/topology.py:199). The reference splits sequences across ranks but
+ships NO ring-attention kernel (SURVEY.md §5) — attention there requires
+gathering the sequence. This module fills that gap TPU-natively:
+
+- K/V shards rotate around the ring with jax.lax.ppermute over the mesh
+  axis (ICI neighbor exchange — the optimal topology for a TPU torus).
+- Each step computes a partial attention of the local Q block against the
+  visiting K/V block; partials merge with the numerically-stable
+  log-sum-exp recurrence (same math as flash attention's online softmax).
+- Communication overlaps compute: XLA schedules the ppermute DMA of step
+  i+1 concurrently with the matmuls of step i.
+
+Use inside shard_map with sequences sharded on `axis_name`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _partial_attention(q, k, v, scale, mask=None):
+    """Returns unnormalized (acc, m, l) for merging. q/k/v: (B, S, H, D)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)  # (B,H,Q,1)
+    # guard all-masked rows
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    p = jnp.exp(s - m_safe)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return acc, m_safe, l
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   scale: float | None = None):
+    """Exact attention where q/k/v are sharded on the sequence dim over
+    `axis_name`. Layout: (batch, local_seq, heads, head_dim).
+
+    Must be called inside shard_map/pjit with `axis_name` in scope.
+    """
+    b, s_local, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+
+    q_global = my_idx * s_local + jnp.arange(s_local)
+
+    def mask_for(kv_idx):
+        if not causal:
+            return None
+        k_global = kv_idx * s_local + jnp.arange(s_local)
+        return (q_global[:, None] >= k_global[None, :])[None, None]  # (1,1,Q,K)
+
+    acc = jnp.zeros((b, h, s_local, d), jnp.float32)
+    m = jnp.full((b, h, s_local, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s_local, 1), jnp.float32)
+    if hasattr(jax.lax, "pcast"):
+        # new-style shard_map tracks varying-manual-axes; mark the carries
+        # as varying over the ring axis so the scan carry types match
+        acc, m, l = (jax.lax.pcast(x, (axis_name,), to="varying")
+                     for x in (acc, m, l))
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def merge(carry, k_cur, v_cur, kv_idx):
+        acc, m, l = carry
+        acc_i, m_i, l_i = _partial_attention(q, k_cur, v_cur, scale,
+                                             mask_for(kv_idx))
+        m_new = jnp.maximum(m, m_i)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_i - m_new)
+        return (acc * alpha + acc_i * beta, m_new, l * alpha + l_i * beta)
+
+    def step(carry, _):
+        acc_m_l, k_cur, v_cur, kv_idx = carry
+        acc_m_l = merge(acc_m_l, k_cur, v_cur, kv_idx)
+        # rotate k/v to the next ring position (ICI neighbor exchange)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        kv_idx = jnp.asarray((kv_idx - 1) % axis_size, jnp.int32)
+        return (acc_m_l, k_nxt, v_nxt, kv_idx), None
+
+    # first axis_size-1 steps rotate; the final block is merged without a
+    # wasted trailing ppermute
+    ((acc, m, l), k_last, v_last, kv_last), _ = jax.lax.scan(
+        step, ((acc, m, l), k, v, jnp.asarray(my_idx, jnp.int32)), None,
+        length=axis_size - 1)
+    acc, m, l = merge((acc, m, l), k_last, v_last, kv_last)
+
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # back to (B, S, H, D)

@@ -88,7 +88,7 @@ class Optimizer:
                 g_arr = g_arr.astype(p._data.dtype)
             p_lr = lr_v * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
             new_p, new_st = self.update(p._data, g_arr, st, p_lr, self._step_count)
-            p._data = new_p
+            p._data = new_p.astype(p._data.dtype)
             self._accumulators[id(p)] = new_st
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
@@ -146,8 +146,12 @@ class Optimizer:
         new_p, new_s = [], []
         for p, g, s in zip(flat_p, flat_g, flat_s):
             np_, ns_ = self.update(p, g.astype(p.dtype), s, lr_v, step)
-            new_p.append(np_)
-            new_s.append(ns_)
+            # keep param/state dtypes stable: update math may promote to f32
+            # (e.g. beta**step with a traced step); cast back so bf16 training
+            # stays bf16 and jit signatures never change across steps
+            new_p.append(np_.astype(p.dtype))
+            new_s.append({k: v.astype(s[k].dtype) if hasattr(v, "astype") else v
+                          for k, v in ns_.items()})
         return (jax.tree_util.tree_unflatten(treedef, new_p),
                 jax.tree_util.tree_unflatten(treedef, new_s))
 

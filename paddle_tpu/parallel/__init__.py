@@ -1,0 +1,13 @@
+"""paddle_tpu.parallel — the TPU-native hybrid-parallel engine.
+
+This is where the reference's fleet/auto-parallel machinery
+(SURVEY.md §2.3) collapses into GSPMD: a single jit-compiled train step over
+a named Mesh, with parallelism expressed as PartitionSpec rules instead of
+wrapper classes + NCCL groups.
+"""
+
+from .spmd import (  # noqa: F401
+    create_mesh, SpmdTrainer, shard_params_by_rules,
+    LLAMA_SHARDING_RULES, GPT_SHARDING_RULES, DP_ONLY_RULES,
+)
+from .functional import functional_call, make_loss_fn  # noqa: F401

@@ -1,0 +1,78 @@
+"""Functionalize an imperative Layer: params/buffers → pure-function inputs.
+
+The same substitution trick as jit.to_static's trace (one mechanism, two
+consumers): temporarily rebind every Parameter/buffer's ._data to the traced
+array, run the Layer's Python forward once, restore. The resulting pure
+function is what jax.jit / jax.value_and_grad / pjit consume.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import core as _core
+from ..framework import random as _random
+from ..framework.core import Tensor
+
+
+def functional_call(model, params: dict, *args, rng_key=None, training=True,
+                    **kwargs):
+    """Run model(*args, **kwargs) with parameter arrays taken from `params`
+    (name -> jax array, matching model.state_dict() keys). Returns raw
+    arrays. Safe to call under jit tracing."""
+    state = model.state_dict()
+    saved = []
+
+    def wrap(a):
+        return Tensor(a) if isinstance(a, jax.Array) or hasattr(a, "dtype") else a
+
+    try:
+        for name, t in state.items():
+            if name in params:
+                saved.append((t, t._data, t._node))
+                t._data = params[name]
+                t._node = None
+        wrapped = [wrap(a) for a in args]
+        wrapped_kw = {k: wrap(v) for k, v in kwargs.items()}
+        ctx = _core.TraceContext()
+        if rng_key is not None:
+            with ctx, _random._global_rng.trace_scope(rng_key):
+                out = model(*wrapped, **wrapped_kw)
+        else:
+            with ctx:
+                out = model(*wrapped, **wrapped_kw)
+        return jax.tree_util.tree_map(
+            lambda o: o._data if isinstance(o, Tensor) else o, out,
+            is_leaf=lambda v: isinstance(v, Tensor))
+    finally:
+        for t, data, node in saved:
+            t._data = data
+            t._node = node
+
+
+def make_loss_fn(model, loss_fn: Callable | None = None):
+    """Build pure loss(params, batch, rng_key) -> scalar.
+
+    If the model returns (loss, logits) when given labels (LM convention),
+    loss_fn may be None.
+    """
+
+    def pure_loss(params, batch, rng_key):
+        if isinstance(batch, (tuple, list)) and len(batch) == 2:
+            x, y = batch
+        else:
+            x, y = batch, None
+        if loss_fn is None:
+            out = functional_call(model, params, x, labels=y, rng_key=rng_key)
+            loss = out[0] if isinstance(out, (tuple, list)) else out
+        else:
+            out = functional_call(model, params, x, rng_key=rng_key)
+            logits = out[0] if isinstance(out, (tuple, list)) else out
+            loss = loss_fn(Tensor(logits), Tensor(y))
+            loss = loss._data if isinstance(loss, Tensor) else loss
+        return loss.astype(jnp.float32) if hasattr(loss, "astype") else loss
+
+    return pure_loss

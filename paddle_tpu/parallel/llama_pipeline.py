@@ -1,0 +1,156 @@
+"""Pipelined Llama: the nn model's decoder stack scheduled over the pp axis.
+
+Bridges the imperative LlamaForCausalLM to parallel.pipeline.PipelinedLM:
+per-layer parameters are stacked into (pp, layers_per_stage, ...) arrays
+sharded on 'pp'; the stage function re-runs one LlamaDecoderLayer template
+via functional_call. Embedding + final norm + head stay replicated.
+
+reference capability: fleet PipelineLayer segmentation + PipelineParallel
+schedules, realized as one compiled SPMD program.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..framework.core import Tensor
+from .functional import functional_call
+from .pipeline import PipelinedLM
+
+__all__ = ["LlamaPipeRunner"]
+
+
+class LlamaPipeRunner:
+    def __init__(self, model, mesh: Mesh, num_microbatches: int,
+                 axis_name: str = "pp", batch_axis: str | None = None,
+                 optimizer=None):
+        self.model = model
+        self.mesh = mesh
+        self.axis = axis_name
+        cfg = model.config
+        pp = mesh.shape[axis_name]
+        L = cfg.num_hidden_layers
+        assert L % pp == 0, f"layers {L} must divide pp {pp}"
+        self.layers_per_stage = L // pp
+        self.optimizer = optimizer
+
+        state = {k: v._data for k, v in model.state_dict().items()}
+        layer_re = re.compile(r"^llama\.layers\.(\d+)\.(.+)$")
+        per_layer: dict[str, list] = {}
+        other = {}
+        for k, v in state.items():
+            m = layer_re.match(k)
+            if m:
+                per_layer.setdefault(m.group(2), []).append((int(m.group(1)), v))
+            else:
+                other[k] = v
+        # stack layer params: (L, ...) -> (pp, L/pp, ...)
+        self.stage_params = {}
+        for name, items in per_layer.items():
+            items.sort()
+            arr = jnp.stack([v for _, v in items])
+            arr = arr.reshape((pp, self.layers_per_stage) + arr.shape[1:])
+            self.stage_params[name] = jax.device_put(
+                arr, NamedSharding(mesh, P(*( [axis_name] + [None] * (arr.ndim - 1)))))
+        rep = NamedSharding(mesh, P())
+        self.embed_params = {"weight": jax.device_put(
+            other["llama.embed_tokens.weight"], rep)}
+        self.head_params = {
+            "norm": jax.device_put(other["llama.norm.weight"], rep)}
+        if "lm_head.weight" in other:
+            self.head_params["lm_head"] = jax.device_put(
+                other["lm_head.weight"], rep)
+
+        self._layer_template = model.llama.layers[0]
+        eps = cfg.rms_norm_eps
+
+        def embed_fn(ep, tokens):
+            return jnp.take(ep["weight"], tokens, axis=0)
+
+        lps = self.layers_per_stage
+
+        def stage_fn(sp, h):
+            # sp leaves: (lps, ...) local slice; apply lps layers sequentially
+            for i in range(lps):
+                layer_params = {k: v[i] for k, v in sp.items()}
+                h = functional_call(self._layer_template, layer_params, Tensor(h))
+            return h
+
+        if "lm_head" not in self.head_params:
+            raise NotImplementedError(
+                "tied embeddings with pipeline parallelism: keep "
+                "tie_word_embeddings=False (tied weights would need the "
+                "embedding resident on the last stage too)")
+
+        def head_loss_fn(hp, h, labels):
+            h32 = h.astype(jnp.float32)
+            ms = jnp.mean(h32 * h32, axis=-1, keepdims=True)
+            h = (h32 * jax.lax.rsqrt(ms + eps)).astype(h.dtype) * hp["norm"]
+            logits = h @ hp["lm_head"]  # nn.Linear weight: (hidden, vocab)
+            lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
+            tgt = labels[:, 1:]
+            picked = jnp.take_along_axis(lp, tgt[..., None], -1)[..., 0]
+            return -jnp.mean(picked)
+
+        self._plm = PipelinedLM(mesh, embed_fn, stage_fn, head_loss_fn,
+                                num_microbatches, axis_name,
+                                batch_axis=batch_axis)
+        self._loss_fn = self._plm.loss_fn()
+        self._step = None
+        self.step_count = 0
+        if optimizer is not None:
+            self.opt_states = {
+                "embed": {k: optimizer.init_state(v)
+                          for k, v in self.embed_params.items()},
+                "stage": {k: optimizer.init_state(v)
+                          for k, v in self.stage_params.items()},
+                "head": {k: optimizer.init_state(v)
+                         for k, v in self.head_params.items()},
+            }
+
+    def loss(self, tokens, labels):
+        return self._loss_fn(self.embed_params, self.stage_params,
+                             self.head_params, tokens, labels)
+
+    def _build_step(self):
+        loss_fn = self._loss_fn
+        opt = self.optimizer
+
+        def train_step(ep, sp, hp, states, tokens, labels, lr, step):
+            loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+                ep, sp, hp, tokens, labels)
+            new = []
+            new_states = {}
+            for name, params, g in (("embed", ep, grads[0]),
+                                    ("stage", sp, grads[1]),
+                                    ("head", hp, grads[2])):
+                np_, ns_ = {}, {}
+                for k, p in params.items():
+                    p2, s2 = opt.update(p, g[k].astype(p.dtype),
+                                        states[name][k], lr, step)
+                    np_[k] = p2.astype(p.dtype)
+                    ns_[k] = {kk: vv.astype(states[name][k][kk].dtype)
+                              for kk, vv in s2.items()}
+                new.append(np_)
+                new_states[name] = ns_
+            return loss, new[0], new[1], new[2], new_states
+
+        return jax.jit(train_step, donate_argnums=(0, 1, 2, 3))
+
+    def step(self, tokens, labels):
+        if self._step is None:
+            self._step = self._build_step()
+        self.step_count += 1
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        step = jnp.asarray(self.step_count, jnp.int32)
+        t = tokens._data if isinstance(tokens, Tensor) else jnp.asarray(tokens)
+        l = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
+        (loss, self.embed_params, self.stage_params, self.head_params,
+         self.opt_states) = self._step(
+            self.embed_params, self.stage_params, self.head_params,
+            self.opt_states, t, l, lr, step)
+        return loss

@@ -1,0 +1,174 @@
+"""Compiled pipeline parallelism over the 'pp' mesh axis.
+
+reference capability: fleet PipelineParallel 1F1B/interleaved schedules
+(python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:575,
+pp_utils/p2p_communication.py) and the static pipeline passes
+(passes/pipeline_scheduler_pass: FThenB/1F1B/VPP/ZB).
+
+TPU-native design: no per-stage OS processes, no NCCL p2p, no interceptor
+actors. The schedule is a lax.scan whose step does
+    receive(prev activation via lax.ppermute) → stage_fn → send
+inside one shard_map over 'pp'. Stage weights are a stacked array with the
+leading (stage) dim sharded on 'pp', so every device runs the same program
+on its own stage slice — SPMD pipelining. Autodiff through scan+ppermute
+yields the backward pipeline automatically (fill-drain / GPipe semantics;
+1F1B's memory shape comes from per-microbatch remat, see `remat`).
+
+Bubble fraction = (P-1)/(M+P-1), identical to the reference's FThenB.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from jax import shard_map as _shard_map_mod
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # older spelling
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+__all__ = ["pipeline_forward", "PipelinedLM"]
+
+
+def _pvary(x, axes):
+    if isinstance(axes, str):
+        axes = (axes,)
+    if not hasattr(jax.lax, "pcast"):
+        return x
+    try:
+        current = jax.typeof(x).vma
+    except Exception:
+        current = frozenset()
+    missing = tuple(a for a in axes if a not in current)
+    if not missing:
+        return x
+    return jax.lax.pcast(x, missing, to="varying")
+
+
+def pipeline_forward(stage_fn: Callable, stacked_stage_params, inputs_mb,
+                     axis_name: str = "pp", *, p_size: int, remat: bool = True,
+                     vary_axes=None):
+    """Run the fill-drain pipeline INSIDE an existing shard_map region.
+
+    stage_fn(local_stage_params, h) -> h   (homogeneous stages)
+    stacked_stage_params: pytree whose leaves have local leading dim 1
+        (the stage shard; squeezed before stage_fn)
+    inputs_mb: (M, mb, ...) microbatched activations, replicated.
+    p_size: static pipeline depth (mesh.shape[axis_name]).
+    Returns (M, mb, ...) outputs, valid on the LAST stage (zeros elsewhere).
+    """
+    my_stage = jax.lax.axis_index(axis_name)
+    vary = tuple(vary_axes) if vary_axes else (axis_name,)
+    m = inputs_mb.shape[0]
+    local_params = jax.tree_util.tree_map(lambda a: a[0], stacked_stage_params)
+
+    fn = stage_fn
+    if remat:
+        fn = jax.checkpoint(stage_fn)
+
+    perm_fwd = [(i, i + 1) for i in range(p_size - 1)]
+
+    steps = m + p_size - 1
+    h0 = jnp.zeros_like(inputs_mb[0])
+    out_buf = jnp.zeros((m,) + inputs_mb.shape[1:], inputs_mb.dtype)
+    h0 = _pvary(h0, vary)
+    out_buf = _pvary(out_buf, vary)
+
+    def step(carry, t):
+        recv, outs = carry
+        # stage 0 ingests microbatch t (when in range); others use received
+        mb_idx = jnp.clip(t, 0, m - 1)
+        inp = jnp.where(my_stage == 0,
+                        _pvary(inputs_mb[mb_idx], vary), recv)
+        h = fn(local_params, inp)
+        # own microbatch index at this tick: t - my_stage
+        own = t - my_stage
+        valid = (own >= 0) & (own < m)
+        h = jnp.where(valid, h, jnp.zeros_like(h))
+        # last stage records its finished microbatch
+        outs = jnp.where((my_stage == p_size - 1) & valid,
+                         outs.at[jnp.clip(own, 0, m - 1)].set(h), outs)
+        # everyone ships to the next stage (last stage's send is dropped)
+        sent = jax.lax.ppermute(h, axis_name, perm_fwd)
+        return (sent, outs), None
+
+    (_, out_buf), _ = jax.lax.scan(step, (h0, out_buf), jnp.arange(steps))
+    return out_buf
+
+
+class PipelinedLM:
+    """End-to-end pipelined LM training step.
+
+    embed_fn(embed_params, tokens) -> h           (run on every stage; cheap)
+    stage_fn(stage_params, h) -> h                (the pipelined body)
+    head_loss_fn(head_params, h, labels) -> loss  (evaluated on last stage)
+
+    Parameters layout:
+      embed/head params: replicated
+      stage params: leaves stacked with leading dim = pp_size, sharded on 'pp'
+    """
+
+    def __init__(self, mesh: Mesh, embed_fn, stage_fn, head_loss_fn,
+                 num_microbatches: int, axis_name: str = "pp",
+                 batch_axis: str | None = None, remat: bool = True):
+        self.mesh = mesh
+        self.embed_fn = embed_fn
+        self.stage_fn = stage_fn
+        self.head_loss_fn = head_loss_fn
+        self.m = num_microbatches
+        self.axis = axis_name
+        self.batch_axis = batch_axis  # optional dp axis: batch sharded
+        self.remat = remat
+
+    def loss_fn(self):
+        axis = self.axis
+        m = self.m
+        mesh = self.mesh
+        batch_axis = self.batch_axis
+
+        p_size = mesh.shape[axis]
+
+        def spmd_loss(embed_params, stage_params, head_params, tokens, labels):
+            def inner(embed_p, stage_p, head_p, tok, lab):
+                my_stage = jax.lax.axis_index(axis)
+                # microbatch the tokens: (B, S) -> (M, B/M, S)
+                b = tok.shape[0]
+                tok_mb = tok.reshape((m, b // m) + tok.shape[1:])
+                lab_mb = lab.reshape((m, b // m) + lab.shape[1:])
+                h_mb = jax.vmap(lambda t: self.embed_fn(embed_p, t))(tok_mb)
+                vary = (axis,) + ((batch_axis,) if batch_axis else ())
+                out = pipeline_forward(self.stage_fn, stage_p, h_mb,
+                                       axis, p_size=p_size, remat=self.remat,
+                                       vary_axes=vary)
+                losses = jax.vmap(
+                    lambda h, l: self.head_loss_fn(head_p, h, l))(out, lab_mb)
+                # only the last stage holds real outputs; other stages
+                # contribute 0 and the (pp,) partials are summed outside —
+                # avoids an in-region psum (robust across vma modes)
+                local = jnp.where(my_stage == p_size - 1,
+                                  jnp.mean(losses), 0.0)
+                if batch_axis is not None:
+                    return local.reshape(1, 1)
+                return local.reshape(1)
+
+            data_spec = P(batch_axis) if batch_axis is not None else P()
+            out_spec = P(axis, batch_axis) if batch_axis is not None else P(axis)
+            in_specs = (
+                jax.tree_util.tree_map(lambda _: P(), embed_params),
+                jax.tree_util.tree_map(lambda _: P(axis), stage_params),
+                jax.tree_util.tree_map(lambda _: P(), head_params),
+                data_spec, data_spec,
+            )
+            partials = shard_map(inner, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_spec)(
+                embed_params, stage_params, head_params, tokens, labels)
+            if batch_axis is not None:
+                return jnp.mean(jnp.sum(partials, axis=0))  # sum pp, mean dp
+            return jnp.sum(partials)
+
+        return spmd_loss

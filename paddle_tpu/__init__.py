@@ -55,6 +55,7 @@ from . import distributed  # noqa: F401
 from . import incubate  # noqa: F401
 from . import static  # noqa: F401
 from . import device  # noqa: F401
+from . import distribution  # noqa: F401
 from . import framework as base  # noqa: F401
 from . import models  # noqa: F401
 from . import ops  # noqa: F401

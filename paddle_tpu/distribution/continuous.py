@@ -1,0 +1,543 @@
+"""Continuous univariate distributions.
+
+Each class mirrors the same-named class in the reference package
+(python/paddle/distribution/{normal,uniform,beta,gamma,exponential,cauchy,
+chi2,gumbel,laplace,lognormal,student_t,continuous_bernoulli}.py), re-built
+on jax.random / jax.scipy.stats.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import random as jrandom
+from jax.scipy import special as jsp
+from jax.scipy import stats as jstats
+
+from .distribution import Distribution, ExponentialFamily, _arr, _wrap, _shape
+
+__all__ = [
+    "Normal", "Uniform", "Beta", "Gamma", "Exponential", "Cauchy", "Chi2",
+    "Gumbel", "Laplace", "LogNormal", "StudentT", "ContinuousBernoulli",
+]
+
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+class Normal(ExponentialFamily):
+    """Normal(loc, scale). Reference: python/paddle/distribution/normal.py:33."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        batch = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def rsample(self, shape=()):
+        eps = jrandom.normal(self._key(), self._extend_shape(shape), self.loc.dtype)
+        return _wrap(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale ** 2
+        return _wrap(-((v - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) - _HALF_LOG_2PI)
+
+    def entropy(self):
+        out = 0.5 + _HALF_LOG_2PI + jnp.log(jnp.broadcast_to(self.scale, self.batch_shape))
+        return _wrap(out)
+
+    def cdf(self, value):
+        v = _arr(value)
+        return _wrap(0.5 * (1 + jsp.erf((v - self.loc) / (self.scale * math.sqrt(2)))))
+
+    def icdf(self, value):
+        v = _arr(value)
+        return _wrap(self.loc + self.scale * math.sqrt(2) * jsp.erfinv(2 * v - 1))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Normal):
+            var_ratio = (self.scale / other.scale) ** 2
+            t1 = ((self.loc - other.loc) / other.scale) ** 2
+            return _wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+        return super().kl_divergence(other)
+
+    @property
+    def _natural_parameters(self):
+        s2 = self.scale ** 2
+        return (self.loc / s2, -0.5 / s2)
+
+    def _log_normalizer(self, n1, n2):
+        return -0.25 * n1 ** 2 / n2 + 0.5 * jnp.log(-math.pi / n2)
+
+
+class LogNormal(Distribution):
+    """exp(Normal(loc, scale)). Reference: python/paddle/distribution/lognormal.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(self._base.batch_shape, ())
+
+    @property
+    def mean(self):
+        return _wrap(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return _wrap((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def rsample(self, shape=()):
+        return _wrap(jnp.exp(self._base.rsample(shape)._data))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        lp = self._base.log_prob(_wrap(jnp.log(v)))._data - jnp.log(v)
+        return _wrap(lp)
+
+    def entropy(self):
+        return _wrap(self._base.entropy()._data + self.loc)
+
+
+class Uniform(Distribution):
+    """Uniform(low, high). Reference: python/paddle/distribution/uniform.py:30."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        batch = jnp.broadcast_shapes(self.low.shape, self.high.shape)
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to((self.low + self.high) / 2, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to((self.high - self.low) ** 2 / 12, self.batch_shape))
+
+    def rsample(self, shape=()):
+        u = jrandom.uniform(self._key(), self._extend_shape(shape), self.low.dtype)
+        return _wrap(self.low + (self.high - self.low) * u)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+        return _wrap(lp)
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(jnp.log(self.high - self.low), self.batch_shape))
+
+    def cdf(self, value):
+        v = _arr(value)
+        return _wrap(jnp.clip((v - self.low) / (self.high - self.low), 0.0, 1.0))
+
+
+class Beta(ExponentialFamily):
+    """Beta(alpha, beta). Reference: python/paddle/distribution/beta.py."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        batch = jnp.broadcast_shapes(self.alpha.shape, self.beta.shape)
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.alpha / (self.alpha + self.beta), self.batch_shape))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return _wrap(jnp.broadcast_to(self.alpha * self.beta / (s ** 2 * (s + 1)), self.batch_shape))
+
+    def rsample(self, shape=()):
+        out = jrandom.beta(self._key(), self.alpha, self.beta, self._extend_shape(shape))
+        return _wrap(out)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(jstats.beta.logpdf(v, self.alpha, self.beta))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        ent = (jsp.betaln(a, b) - (a - 1) * jsp.digamma(a) - (b - 1) * jsp.digamma(b)
+               + (a + b - 2) * jsp.digamma(a + b))
+        return _wrap(jnp.broadcast_to(ent, self.batch_shape))
+
+    @property
+    def _natural_parameters(self):
+        return (self.alpha - 1, self.beta - 1)
+
+    def _log_normalizer(self, n1, n2):
+        return jsp.betaln(n1 + 1, n2 + 1)
+
+
+class Gamma(ExponentialFamily):
+    """Gamma(concentration, rate). Reference: python/paddle/distribution/gamma.py."""
+
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        batch = jnp.broadcast_shapes(self.concentration.shape, self.rate.shape)
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.concentration / self.rate, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(self.concentration / self.rate ** 2, self.batch_shape))
+
+    def rsample(self, shape=()):
+        g = jrandom.gamma(self._key(), self.concentration, self._extend_shape(shape))
+        return _wrap(g / self.rate)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a, r = self.concentration, self.rate
+        return _wrap(a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v - jsp.gammaln(a))
+
+    def entropy(self):
+        a, r = self.concentration, self.rate
+        ent = a - jnp.log(r) + jsp.gammaln(a) + (1 - a) * jsp.digamma(a)
+        return _wrap(jnp.broadcast_to(ent, self.batch_shape))
+
+    @property
+    def _natural_parameters(self):
+        return (self.concentration - 1, -self.rate)
+
+    def _log_normalizer(self, n1, n2):
+        return jsp.gammaln(n1 + 1) - (n1 + 1) * jnp.log(-n2)
+
+
+class Chi2(Gamma):
+    """Chi2(df) = Gamma(df/2, 1/2). Reference: python/paddle/distribution/chi2.py."""
+
+    def __init__(self, df, name=None):
+        df = _arr(df)
+        self.df = df
+        super().__init__(df / 2, jnp.asarray(0.5, df.dtype))
+
+
+class Exponential(ExponentialFamily):
+    """Exponential(rate). Reference: python/paddle/distribution/exponential.py."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape, ())
+
+    @property
+    def mean(self):
+        return _wrap(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(1.0 / self.rate ** 2)
+
+    def rsample(self, shape=()):
+        e = jrandom.exponential(self._key(), self._extend_shape(shape), self.rate.dtype)
+        return _wrap(e / self.rate)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return _wrap(1.0 - jnp.log(self.rate))
+
+    def cdf(self, value):
+        v = _arr(value)
+        return _wrap(1 - jnp.exp(-self.rate * v))
+
+    @property
+    def _natural_parameters(self):
+        return (-self.rate,)
+
+    def _log_normalizer(self, n1):
+        return -jnp.log(-n1)
+
+
+class Cauchy(Distribution):
+    """Cauchy(loc, scale). Reference: python/paddle/distribution/cauchy.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        batch = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean.")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance.")
+
+    def rsample(self, shape=()):
+        u = jrandom.uniform(self._key(), self._extend_shape(shape), self.loc.dtype)
+        return _wrap(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(jstats.cauchy.logpdf(v, self.loc, self.scale))
+
+    def entropy(self):
+        out = jnp.log(4 * math.pi * jnp.broadcast_to(self.scale, self.batch_shape))
+        return _wrap(out)
+
+    def cdf(self, value):
+        v = _arr(value)
+        return _wrap(jnp.arctan((v - self.loc) / self.scale) / math.pi + 0.5)
+
+    def kl_divergence(self, other):
+        if isinstance(other, Cauchy):
+            a = (self.scale + other.scale) ** 2 + (self.loc - other.loc) ** 2
+            return _wrap(jnp.log(a / (4 * self.scale * other.scale)))
+        return super().kl_divergence(other)
+
+
+class Gumbel(Distribution):
+    """Gumbel(loc, scale). Reference: python/paddle/distribution/gumbel.py."""
+
+    _EULER = 0.57721566490153286060
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        batch = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc + self.scale * self._EULER, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(math.pi ** 2 / 6 * self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.sqrt(self.variance._data))
+
+    def rsample(self, shape=()):
+        g = jrandom.gumbel(self._key(), self._extend_shape(shape), self.loc.dtype)
+        return _wrap(self.loc + self.scale * g)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        z = (v - self.loc) / self.scale
+        return _wrap(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        out = jnp.log(jnp.broadcast_to(self.scale, self.batch_shape)) + 1 + self._EULER
+        return _wrap(out)
+
+    def cdf(self, value):
+        v = _arr(value)
+        return _wrap(jnp.exp(-jnp.exp(-(v - self.loc) / self.scale)))
+
+
+class Laplace(Distribution):
+    """Laplace(loc, scale). Reference: python/paddle/distribution/laplace.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        batch = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(2 * self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(math.sqrt(2.0) * self.scale, self.batch_shape))
+
+    def rsample(self, shape=()):
+        l = jrandom.laplace(self._key(), self._extend_shape(shape), self.loc.dtype)
+        return _wrap(self.loc + self.scale * l)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(-jnp.abs(v - self.loc) / self.scale - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return _wrap(1 + jnp.log(2 * jnp.broadcast_to(self.scale, self.batch_shape)))
+
+    def cdf(self, value):
+        v = _arr(value)
+        z = (v - self.loc) / self.scale
+        return _wrap(0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z)))
+
+    def icdf(self, value):
+        v = _arr(value)
+        term = v - 0.5
+        return _wrap(self.loc - self.scale * jnp.sign(term) * jnp.log1p(-2 * jnp.abs(term)))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Laplace):
+            # KL(La(m1,b1)||La(m2,b2)) = log(b2/b1) + |m1-m2|/b2 + b1/b2*exp(-|m1-m2|/b1) - 1
+            d = jnp.abs(self.loc - other.loc)
+            return _wrap(jnp.log(other.scale / self.scale) + d / other.scale
+                         + self.scale / other.scale * jnp.exp(-d / self.scale) - 1)
+        return super().kl_divergence(other)
+
+
+class StudentT(Distribution):
+    """StudentT(df, loc, scale). Reference: python/paddle/distribution/student_t.py."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _arr(df)
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        batch = jnp.broadcast_shapes(self.df.shape, self.loc.shape, self.scale.shape)
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        m = jnp.where(self.df > 1, self.loc, jnp.nan)
+        return _wrap(jnp.broadcast_to(m, self.batch_shape))
+
+    @property
+    def variance(self):
+        v = jnp.where(self.df > 2, self.scale ** 2 * self.df / (self.df - 2),
+                      jnp.where(self.df > 1, jnp.inf, jnp.nan))
+        return _wrap(jnp.broadcast_to(v, self.batch_shape))
+
+    def rsample(self, shape=()):
+        t = jrandom.t(self._key(), self.df, self._extend_shape(shape), self.loc.dtype)
+        return _wrap(self.loc + self.scale * t)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        z = (v - self.loc) / self.scale
+        return _wrap(jstats.t.logpdf(z, self.df) - jnp.log(self.scale))
+
+    def entropy(self):
+        d = self.df
+        ent = ((d + 1) / 2 * (jsp.digamma((d + 1) / 2) - jsp.digamma(d / 2))
+               + jnp.log(jnp.sqrt(d)) + jsp.betaln(d / 2, 0.5) + jnp.log(self.scale))
+        return _wrap(jnp.broadcast_to(ent, self.batch_shape))
+
+
+class ContinuousBernoulli(Distribution):
+    """ContinuousBernoulli(probs).
+
+    Reference: python/paddle/distribution/continuous_bernoulli.py.
+    """
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _arr(probs)
+        self._lims = lims
+        super().__init__(self.probs.shape, ())
+
+    def _outside_unstable(self):
+        return (self.probs < self._lims[0]) | (self.probs > self._lims[1])
+
+    def _stable_probs(self):
+        return jnp.where(self._outside_unstable(), self.probs, self._lims[0])
+
+    def _log_norm(self):
+        # log C(p); C = 2 atanh(1-2p) / (1-2p) for p != 0.5, else 2
+        p = self._stable_probs()
+        out = jnp.log(jnp.abs(2 * jnp.arctanh(1 - 2 * p))) - jnp.log(jnp.abs(1 - 2 * p))
+        taylor = math.log(2.0) + 4 / 3 * (self.probs - 0.5) ** 2
+        return jnp.where(self._outside_unstable(), out, taylor)
+
+    @property
+    def mean(self):
+        p = self._stable_probs()
+        m = p / (2 * p - 1) + 1 / (2 * jnp.arctanh(1 - 2 * p))
+        taylor = 0.5 + (self.probs - 0.5) / 3
+        return _wrap(jnp.where(self._outside_unstable(), m, taylor))
+
+    @property
+    def variance(self):
+        p = self._stable_probs()
+        v = p * (p - 1) / (1 - 2 * p) ** 2 + 1 / (2 * jnp.arctanh(1 - 2 * p)) ** 2
+        taylor = 1 / 12 - (self.probs - 0.5) ** 2 / 15
+        return _wrap(jnp.where(self._outside_unstable(), v, taylor))
+
+    def rsample(self, shape=()):
+        u = jrandom.uniform(self._key(), self._extend_shape(shape), self.probs.dtype)
+        return self.icdf(_wrap(u))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        eps = 1e-7
+        p = jnp.clip(self.probs, eps, 1 - eps)
+        return _wrap(v * jnp.log(p) + (1 - v) * jnp.log1p(-p) + self._log_norm())
+
+    def cdf(self, value):
+        v = _arr(value)
+        p = self._stable_probs()
+        c = (p ** v * (1 - p) ** (1 - v) + p - 1) / (2 * p - 1)
+        out = jnp.where(self._outside_unstable(), c, v)
+        return _wrap(jnp.clip(out, 0.0, 1.0))
+
+    def icdf(self, value):
+        v = _arr(value)
+        p = self._stable_probs()
+        x = (jnp.log1p(v * (2 * p - 1) / (1 - p)) /
+             (jnp.log(p) - jnp.log1p(-p)))
+        return _wrap(jnp.where(self._outside_unstable(), x, v))
+
+    def entropy(self):
+        # H = -E[log p(x)] = -(mean*log p + (1-mean)*log(1-p) + log C)
+        eps = 1e-7
+        p = jnp.clip(self.probs, eps, 1 - eps)
+        m = self.mean._data
+        return _wrap(-(m * jnp.log(p) + (1 - m) * jnp.log1p(-p) + self._log_norm()))

@@ -1,0 +1,313 @@
+"""Discrete distributions.
+
+Mirrors python/paddle/distribution/{bernoulli,binomial,categorical,geometric,
+multinomial,poisson}.py, re-built on jax.random.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax import random as jrandom
+from jax.scipy import special as jsp
+
+from .distribution import Distribution, ExponentialFamily, _arr, _wrap, _shape
+
+__all__ = ["Bernoulli", "Binomial", "Categorical", "Geometric", "Multinomial",
+           "Poisson"]
+
+
+class Bernoulli(ExponentialFamily):
+    """Bernoulli(probs). Reference: python/paddle/distribution/bernoulli.py:40."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _arr(probs)
+        self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        super().__init__(self.probs.shape, ())
+
+    @property
+    def mean(self):
+        return _wrap(self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        out = jrandom.bernoulli(self._key(), self.probs, self._extend_shape(shape))
+        return _wrap(out.astype(self.probs.dtype))
+
+    def rsample(self, shape=(), temperature=1.0):
+        # Gumbel-softmax style relaxed sample (reference rsample uses
+        # temperature-controlled logistic relaxation).
+        u = jrandom.uniform(self._key(), self._extend_shape(shape), self.probs.dtype,
+                            minval=1e-6, maxval=1 - 1e-6)
+        logistic = jnp.log(u) - jnp.log1p(-u)
+        return _wrap((self.logits + logistic) / temperature)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        eps = 1e-7
+        p = jnp.clip(self.probs, eps, 1 - eps)
+        return _wrap(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        eps = 1e-7
+        p = jnp.clip(self.probs, eps, 1 - eps)
+        return _wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+    def cdf(self, value):
+        v = _arr(value)
+        return _wrap(jnp.where(v < 0, 0.0, jnp.where(v < 1, 1 - self.probs, 1.0)))
+
+    @property
+    def _natural_parameters(self):
+        return (self.logits,)
+
+    def _log_normalizer(self, n1):
+        return jnp.logaddexp(jnp.zeros_like(n1), n1)
+
+    def kl_divergence(self, other):
+        if isinstance(other, Bernoulli):
+            eps = 1e-7
+            p = jnp.clip(self.probs, eps, 1 - eps)
+            q = jnp.clip(other.probs, eps, 1 - eps)
+            return _wrap(p * (jnp.log(p) - jnp.log(q)) +
+                         (1 - p) * (jnp.log1p(-p) - jnp.log1p(-q)))
+        return super().kl_divergence(other)
+
+
+class Binomial(Distribution):
+    """Binomial(total_count, probs). Reference: python/paddle/distribution/binomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = jnp.asarray(total_count)
+        self.probs = _arr(probs)
+        batch = jnp.broadcast_shapes(jnp.shape(self.total_count), self.probs.shape)
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.total_count * self.probs, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(
+            self.total_count * self.probs * (1 - self.probs), self.batch_shape))
+
+    def sample(self, shape=()):
+        n = int(jnp.max(self.total_count))
+        u = jrandom.uniform(self._key(), (n,) + self._extend_shape(shape), self.probs.dtype)
+        idx = jnp.arange(n).reshape((n,) + (1,) * (u.ndim - 1))
+        draws = (u < self.probs) & (idx < self.total_count)
+        return _wrap(jnp.sum(draws, axis=0).astype(self.probs.dtype))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        n, p = self.total_count, jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        comb = jsp.gammaln(n + 1) - jsp.gammaln(v + 1) - jsp.gammaln(n - v + 1)
+        return _wrap(comb + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        n = int(jnp.max(self.total_count))
+        ks = jnp.arange(n + 1, dtype=self.probs.dtype)
+        ks = ks.reshape((n + 1,) + (1,) * len(self.batch_shape))
+        lp = self.log_prob(_wrap(ks))._data
+        valid = ks <= self.total_count
+        return _wrap(-jnp.sum(jnp.where(valid, jnp.exp(lp) * lp, 0.0), axis=0))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Binomial):
+            p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+            q = jnp.clip(other.probs, 1e-7, 1 - 1e-7)
+            return _wrap(self.total_count * (
+                p * (jnp.log(p) - jnp.log(q)) + (1 - p) * (jnp.log1p(-p) - jnp.log1p(-q))))
+        return super().kl_divergence(other)
+
+
+class Categorical(Distribution):
+    """Categorical(logits). NOTE: like the reference
+    (python/paddle/distribution/categorical.py:30), the constructor takes
+    *unnormalized log-probabilities* named ``logits``.
+    """
+
+    def __init__(self, logits, name=None):
+        self.logits = _arr(logits)
+        self._log_p = self.logits - jsp.logsumexp(self.logits, axis=-1, keepdims=True)
+        super().__init__(self.logits.shape[:-1], ())
+        self._num_events = self.logits.shape[-1]
+
+    @property
+    def probs_param(self):
+        return jnp.exp(self._log_p)
+
+    def sample(self, shape=()):
+        full = _shape(shape) + self.batch_shape
+        out = jrandom.categorical(self._key(), self._log_p, axis=-1, shape=full)
+        return _wrap(out)
+
+    def log_prob(self, value):
+        v = _arr(value, dtype=jnp.int32)
+        lp = jnp.take_along_axis(
+            jnp.broadcast_to(self._log_p, v.shape + (self._num_events,)),
+            v[..., None], axis=-1)[..., 0]
+        return _wrap(lp)
+
+    def probs(self, value):
+        v = _arr(value, dtype=jnp.int32)
+        p = jnp.take_along_axis(
+            jnp.broadcast_to(self.probs_param, v.shape + (self._num_events,)),
+            v[..., None], axis=-1)[..., 0]
+        return _wrap(p)
+
+    def entropy(self):
+        p = self.probs_param
+        return _wrap(-jnp.sum(p * self._log_p, axis=-1))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Categorical):
+            p = self.probs_param
+            return _wrap(jnp.sum(p * (self._log_p - other._log_p), axis=-1))
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+
+class Geometric(Distribution):
+    """Geometric(probs) — number of failures before first success (support 0,1,...).
+
+    Reference: python/paddle/distribution/geometric.py.
+    """
+
+    def __init__(self, probs, name=None):
+        self.probs = _arr(probs)
+        super().__init__(self.probs.shape, ())
+
+    @property
+    def mean(self):
+        # failures-before-success support (0-based): E[X] = (1-p)/p
+        return _wrap(1.0 / self.probs - 1.0)
+
+    @property
+    def variance(self):
+        return _wrap((1 - self.probs) / self.probs ** 2)
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.sqrt((1 - self.probs) / self.probs ** 2))
+
+    def sample(self, shape=()):
+        u = jrandom.uniform(self._key(), self._extend_shape(shape), self.probs.dtype,
+                            minval=1e-7, maxval=1.0)
+        return _wrap(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(v * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+    def entropy(self):
+        p = self.probs
+        return _wrap(-((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p)
+
+    def cdf(self, value):
+        v = _arr(value)
+        return _wrap(1 - jnp.power(1 - self.probs, jnp.floor(v) + 1))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Geometric):
+            p, q = self.probs, other.probs
+            return _wrap(jnp.log(p / q) + (1 - p) / p * jnp.log((1 - p) / (1 - q)))
+        return super().kl_divergence(other)
+
+
+class Multinomial(Distribution):
+    """Multinomial(total_count, probs). Reference: python/paddle/distribution/multinomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _arr(probs)
+        self.probs = self.probs / jnp.sum(self.probs, axis=-1, keepdims=True)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        k = self.probs.shape[-1]
+        full = _shape(shape) + self.batch_shape
+        draws = jrandom.categorical(
+            self._key(), jnp.log(self.probs), axis=-1,
+            shape=(self.total_count,) + full)
+        onehot = jnp.sum(jnp.eye(k, dtype=self.probs.dtype)[draws], axis=0)
+        return _wrap(onehot)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        logits = jnp.log(jnp.clip(self.probs, 1e-12))
+        return _wrap(jsp.gammaln(jnp.sum(v, -1) + 1)
+                     - jnp.sum(jsp.gammaln(v + 1), -1)
+                     + jnp.sum(v * logits, -1))
+
+    def entropy(self):
+        # No closed form for n > 1: Monte-Carlo estimate of -E[log p(X)]
+        # (exact for n == 1, where it reduces to the categorical entropy).
+        n = self.total_count
+        p = jnp.clip(self.probs, 1e-12)
+        if n == 1:
+            return _wrap(-jnp.sum(p * jnp.log(p), axis=-1))
+        samples = self.sample((512,))._data
+        return _wrap(-jnp.mean(self.log_prob(_wrap(samples))._data, axis=0))
+
+
+class Poisson(ExponentialFamily):
+    """Poisson(rate). Reference: python/paddle/distribution/poisson.py."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape, ())
+
+    @property
+    def mean(self):
+        return _wrap(self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.rate)
+
+    def sample(self, shape=()):
+        out = jrandom.poisson(self._key(), self.rate, self._extend_shape(shape))
+        return _wrap(out.astype(self.rate.dtype))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(v * jnp.log(self.rate) - self.rate - jsp.gammaln(v + 1))
+
+    def entropy(self):
+        # series over a truncated support: 30 sigma past the rate covers the
+        # mass at any scale (sigma = sqrt(rate))
+        r = float(jnp.max(self.rate))
+        n = int(r + 30 * math.sqrt(max(r, 1.0)))
+        ks = jnp.arange(n + 1, dtype=self.rate.dtype)
+        ks = ks.reshape((n + 1,) + (1,) * len(self.batch_shape))
+        lp = ks * jnp.log(self.rate) - self.rate - jsp.gammaln(ks + 1)
+        return _wrap(-jnp.sum(jnp.exp(lp) * lp, axis=0))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Poisson):
+            r, s = self.rate, other.rate
+            return _wrap(r * jnp.log(r / s) - r + s)
+        return super().kl_divergence(other)
+
+    @property
+    def _natural_parameters(self):
+        return (jnp.log(self.rate),)
+
+    def _log_normalizer(self, n1):
+        return jnp.exp(n1)

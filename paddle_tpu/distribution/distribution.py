@@ -1,0 +1,146 @@
+"""Distribution base classes.
+
+TPU-native re-design of the reference's probability-distribution package
+(reference: python/paddle/distribution/distribution.py:40 ``Distribution``,
+python/paddle/distribution/exponential_family.py:22 ``ExponentialFamily``).
+Internally everything is jax.numpy; public methods accept/return framework
+Tensors.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.random import next_key
+
+__all__ = ["Distribution", "ExponentialFamily"]
+
+
+def _arr(x, dtype=None):
+    """Coerce Tensor / python scalar / ndarray to a jnp array."""
+    if isinstance(x, Tensor):
+        x = x._data
+    a = jnp.asarray(x)
+    if dtype is not None:
+        a = a.astype(dtype)
+    elif jnp.issubdtype(a.dtype, jnp.integer) or a.dtype == jnp.bool_:
+        a = a.astype(jnp.float32)
+    return a
+
+
+def _wrap(x):
+    return Tensor(x, stop_gradient=True)
+
+
+def _shape(s):
+    if s is None:
+        return ()
+    if isinstance(s, int):
+        return (s,)
+    return tuple(int(d) for d in s)
+
+
+class Distribution:
+    """Base class for probability distributions.
+
+    Mirrors the surface of the reference base class: ``sample``/``rsample``
+    prepend ``shape`` to ``batch_shape + event_shape``; ``prob`` defaults to
+    ``exp(log_prob)``; ``kl_divergence`` dispatches through the registry in
+    :mod:`paddle_tpu.distribution.kl`.
+    """
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = _shape(batch_shape)
+        self._event_shape = _shape(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        lp = self.log_prob(value)
+        return _wrap(jnp.exp(lp._data if isinstance(lp, Tensor) else lp))
+
+    def probs(self, value):  # legacy alias kept by the reference
+        return self.prob(value)
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    # -- helpers shared by subclasses ------------------------------------
+    def _extend_shape(self, sample_shape):
+        return _shape(sample_shape) + self.batch_shape + self.event_shape
+
+    def _key(self):
+        return next_key()
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_shape={self.batch_shape}, event_shape={self.event_shape})"
+
+
+class ExponentialFamily(Distribution):
+    """Distributions in the natural exponential family.
+
+    Provides the Bregman-divergence based ``entropy`` fallback used by the
+    reference (python/paddle/distribution/exponential_family.py:42
+    ``_entropy``): H = -<∇A(θ), θ> + A(θ) - E[h(x)] computed with autodiff
+    on the log-normalizer.
+    """
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nparams = [jnp.asarray(p) for p in self._natural_parameters]
+
+        def log_norm(*ps):
+            return jnp.sum(self._log_normalizer(*ps))
+
+        lg_normal = self._log_normalizer(*nparams)
+        grads = jax.grad(log_norm, argnums=tuple(range(len(nparams))))(*nparams)
+        ent = -self._mean_carrier_measure + lg_normal
+        for p, g in zip(nparams, grads):
+            ent = ent - p * g
+        return _wrap(ent)

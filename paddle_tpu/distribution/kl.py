@@ -1,0 +1,137 @@
+"""KL divergence registry.
+
+Reference: python/paddle/distribution/kl.py (``kl_divergence``,
+``register_kl`` with MRO-based dispatch).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import Distribution, ExponentialFamily, _wrap
+
+__all__ = ["kl_divergence", "register_kl"]
+
+_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a pairwise KL implementation."""
+    def decorator(f):
+        _REGISTRY[(cls_p, cls_q)] = f
+        return f
+    return decorator
+
+
+def _dispatch(type_p, type_q):
+    matches = [(p, q) for (p, q) in _REGISTRY
+               if issubclass(type_p, p) and issubclass(type_q, q)]
+    if not matches:
+        return None
+    # most-derived match wins (lexicographic on MRO distance)
+    def key(pq):
+        p, q = pq
+        return (type_p.__mro__.index(p), type_q.__mro__.index(q))
+    return _REGISTRY[min(matches, key=key)]
+
+
+def kl_divergence(p, q):
+    """KL(p || q). Tries: direct method on p, the registry, then the
+    exponential-family Bregman fallback."""
+    fn = _dispatch(type(p), type(q))
+    if fn is not None:
+        return fn(p, q)
+    if type(p) is type(q):
+        own = type(p).kl_divergence
+        if own is not Distribution.kl_divergence:
+            return own(p, q)
+    if (isinstance(p, ExponentialFamily) and type(p) is type(q)):
+        return _kl_expfamily_expfamily(p, q)
+    raise NotImplementedError(
+        f"KL divergence between {type(p).__name__} and {type(q).__name__} "
+        "is not implemented; use register_kl to add it.")
+
+
+def _kl_expfamily_expfamily(p, q):
+    """Bregman-divergence KL for same-family exponential distributions
+    (reference kl.py:209 ``_kl_expfamily_expfamily``)."""
+    import jax
+
+    p_nat = [jnp.asarray(x) for x in p._natural_parameters]
+    q_nat = [jnp.asarray(x) for x in q._natural_parameters]
+
+    def log_norm_p(*ps):
+        return jnp.sum(p._log_normalizer(*ps))
+
+    lg_p = p._log_normalizer(*p_nat)
+    lg_q = q._log_normalizer(*q_nat)
+    grads = jax.grad(log_norm_p, argnums=tuple(range(len(p_nat))))(*p_nat)
+    kl = lg_q - lg_p
+    for pn, qn, g in zip(p_nat, q_nat, grads):
+        kl = kl - (qn - pn) * g
+    return _wrap(kl)
+
+
+# -- default pairwise rules (mirror reference registrations) ---------------
+
+def _register_defaults():
+    from .continuous import (Normal, Uniform, Beta, Gamma, Exponential,
+                             Cauchy, Gumbel, Laplace, LogNormal, StudentT)
+    from .discrete import Bernoulli, Categorical, Geometric, Poisson, Binomial
+    from .multivariate import Dirichlet, MultivariateNormal
+
+    for cls in (Normal, Cauchy, Laplace, Bernoulli, Categorical, Geometric,
+                Poisson, Binomial, Dirichlet, MultivariateNormal):
+        def make(c):
+            def f(p, q):
+                return c.kl_divergence(p, q)
+            return f
+        register_kl(cls, cls)(make(cls))
+
+    @register_kl(LogNormal, LogNormal)
+    def _kl_lognormal(p, q):
+        return p._base.kl_divergence(q._base)
+
+    @register_kl(Uniform, Uniform)
+    def _kl_uniform(p, q):
+        r = (q.high - q.low) / (p.high - p.low)
+        out = jnp.where((q.low <= p.low) & (p.high <= q.high),
+                        jnp.log(r), jnp.inf)
+        return _wrap(out)
+
+    @register_kl(Exponential, Exponential)
+    def _kl_exponential(p, q):
+        ratio = q.rate / p.rate
+        return _wrap(jnp.log(1 / ratio) + ratio - 1)
+
+    @register_kl(Gamma, Gamma)
+    def _kl_gamma(p, q):
+        from jax.scipy import special as jsp
+        a1, b1, a2, b2 = p.concentration, p.rate, q.concentration, q.rate
+        return _wrap((a1 - a2) * jsp.digamma(a1) - jsp.gammaln(a1)
+                     + jsp.gammaln(a2) + a2 * (jnp.log(b1) - jnp.log(b2))
+                     + a1 * (b2 - b1) / b1)
+
+    from .continuous import ContinuousBernoulli
+
+    @register_kl(ContinuousBernoulli, ContinuousBernoulli)
+    def _kl_cb(p, q):
+        # log-density is linear in x, so E_p[log p - log q] needs only p's mean
+        eps = 1e-7
+        pp = jnp.clip(p.probs, eps, 1 - eps)
+        qq = jnp.clip(q.probs, eps, 1 - eps)
+        m = p.mean._data
+        return _wrap(m * (jnp.log(pp) - jnp.log(qq))
+                     + (1 - m) * (jnp.log1p(-pp) - jnp.log1p(-qq))
+                     + p._log_norm() - q._log_norm())
+
+    @register_kl(Beta, Beta)
+    def _kl_beta(p, q):
+        from jax.scipy import special as jsp
+        a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+        s1 = a1 + b1
+        return _wrap(jsp.betaln(a2, b2) - jsp.betaln(a1, b1)
+                     + (a1 - a2) * jsp.digamma(a1) + (b1 - b2) * jsp.digamma(b1)
+                     + (a2 - a1 + b2 - b1) * jsp.digamma(s1))
+
+
+_register_defaults()

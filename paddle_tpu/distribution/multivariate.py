@@ -1,0 +1,214 @@
+"""Multivariate distributions: Dirichlet, MultivariateNormal, LKJCholesky.
+
+Reference: python/paddle/distribution/{dirichlet,multivariate_normal,
+lkj_cholesky}.py — rebuilt on jax.random / jax.scipy.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax import random as jrandom
+from jax.scipy import special as jsp
+
+from .distribution import Distribution, ExponentialFamily, _arr, _wrap, _shape
+
+__all__ = ["Dirichlet", "MultivariateNormal", "LKJCholesky"]
+
+
+class Dirichlet(ExponentialFamily):
+    """Dirichlet(concentration). Reference: python/paddle/distribution/dirichlet.py:25."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration)
+        if self.concentration.ndim < 1:
+            raise ValueError("concentration must be at least 1-dimensional")
+        super().__init__(self.concentration.shape[:-1], self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration /
+                     jnp.sum(self.concentration, -1, keepdims=True))
+
+    @property
+    def variance(self):
+        a0 = jnp.sum(self.concentration, -1, keepdims=True)
+        m = self.concentration / a0
+        return _wrap(m * (1 - m) / (a0 + 1))
+
+    def rsample(self, shape=()):
+        out = jrandom.dirichlet(self._key(), self.concentration,
+                                _shape(shape) + self.batch_shape)
+        return _wrap(out)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a = self.concentration
+        return _wrap(jnp.sum((a - 1) * jnp.log(v), -1)
+                     + jsp.gammaln(jnp.sum(a, -1))
+                     - jnp.sum(jsp.gammaln(a), -1))
+
+    def entropy(self):
+        a = self.concentration
+        a0 = jnp.sum(a, -1)
+        k = a.shape[-1]
+        lnB = jnp.sum(jsp.gammaln(a), -1) - jsp.gammaln(a0)
+        return _wrap(lnB + (a0 - k) * jsp.digamma(a0)
+                     - jnp.sum((a - 1) * jsp.digamma(a), -1))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Dirichlet):
+            a, b = self.concentration, other.concentration
+            a0 = jnp.sum(a, -1)
+            return _wrap(jsp.gammaln(a0) - jnp.sum(jsp.gammaln(a), -1)
+                         - jsp.gammaln(jnp.sum(b, -1)) + jnp.sum(jsp.gammaln(b), -1)
+                         + jnp.sum((a - b) * (jsp.digamma(a) - jsp.digamma(a0)[..., None]), -1))
+        return super().kl_divergence(other)
+
+
+class MultivariateNormal(Distribution):
+    """MultivariateNormal(loc, covariance_matrix | precision_matrix | scale_tril).
+
+    Reference: python/paddle/distribution/multivariate_normal.py.
+    """
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _arr(loc)
+        given = sum(x is not None for x in
+                    (covariance_matrix, precision_matrix, scale_tril))
+        if given != 1:
+            raise ValueError("Exactly one of covariance_matrix, "
+                             "precision_matrix, scale_tril must be given")
+        if scale_tril is not None:
+            self._scale_tril = _arr(scale_tril)
+        elif covariance_matrix is not None:
+            self._scale_tril = jnp.linalg.cholesky(_arr(covariance_matrix))
+        else:
+            prec = _arr(precision_matrix)
+            self._scale_tril = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        d = self.loc.shape[-1]
+        batch = jnp.broadcast_shapes(self.loc.shape[:-1], self._scale_tril.shape[:-2])
+        super().__init__(batch, (d,))
+
+    @property
+    def scale_tril(self):
+        return _wrap(self._scale_tril)
+
+    @property
+    def covariance_matrix(self):
+        L = self._scale_tril
+        return _wrap(L @ jnp.swapaxes(L, -1, -2))
+
+    @property
+    def precision_matrix(self):
+        return _wrap(jnp.linalg.inv(self.covariance_matrix._data))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape + self.event_shape))
+
+    @property
+    def variance(self):
+        var = jnp.sum(self._scale_tril ** 2, -1)
+        return _wrap(jnp.broadcast_to(var, self.batch_shape + self.event_shape))
+
+    def rsample(self, shape=()):
+        full = self._extend_shape(shape)
+        eps = jrandom.normal(self._key(), full, self.loc.dtype)
+        return _wrap(self.loc + jnp.einsum("...ij,...j->...i", self._scale_tril, eps))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        d = self.event_shape[0]
+        diff = v - self.loc
+        # solve L y = diff  => y = L^-1 diff; M = |y|^2 is the Mahalanobis dist
+        y = jnp.vectorize(
+            lambda L, b: jnp.linalg.solve(L, b), signature="(d,d),(d)->(d)"
+        )(jnp.broadcast_to(self._scale_tril, diff.shape[:-1] + (d, d)), diff)
+        M = jnp.sum(y ** 2, -1)
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(self._scale_tril, axis1=-2, axis2=-1)), -1)
+        return _wrap(-0.5 * (d * math.log(2 * math.pi) + M) - half_logdet)
+
+    def entropy(self):
+        d = self.event_shape[0]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(self._scale_tril, axis1=-2, axis2=-1)), -1)
+        out = 0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet
+        return _wrap(jnp.broadcast_to(out, self.batch_shape))
+
+    def kl_divergence(self, other):
+        if isinstance(other, MultivariateNormal):
+            d = self.event_shape[0]
+            L1, L2 = self._scale_tril, other._scale_tril
+            logdet = (jnp.sum(jnp.log(jnp.diagonal(L2, axis1=-2, axis2=-1)), -1)
+                      - jnp.sum(jnp.log(jnp.diagonal(L1, axis1=-2, axis2=-1)), -1))
+            # tr(S2^-1 S1) = |L2^-1 L1|_F^2
+            A = jnp.linalg.solve(L2, L1)
+            tr = jnp.sum(A ** 2, (-2, -1))
+            diff = other.loc - self.loc
+            y = jnp.vectorize(
+                lambda L, b: jnp.linalg.solve(L, b), signature="(d,d),(d)->(d)"
+            )(jnp.broadcast_to(L2, diff.shape[:-1] + (d, d)), diff)
+            M = jnp.sum(y ** 2, -1)
+            return _wrap(logdet + 0.5 * (tr + M - d))
+        return super().kl_divergence(other)
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over Cholesky factors of correlation matrices.
+
+    Reference: python/paddle/distribution/lkj_cholesky.py. Sampling uses the
+    onion method; both "onion" and "cvine" kwargs are accepted.
+    """
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion", name=None):
+        self.dim = int(dim)
+        self.concentration = _arr(concentration)
+        self.sample_method = sample_method
+        if sample_method not in ("onion", "cvine"):
+            raise ValueError("sample_method must be 'onion' or 'cvine'")
+        super().__init__(self.concentration.shape, (self.dim, self.dim))
+
+    def sample(self, shape=()):
+        # Onion method (LKJ 2009): build rows incrementally; row i direction
+        # uniform on the sphere with radius^2 ~ Beta(i/2, eta + (d-i-1)/2).
+        d = self.dim
+        batch = _shape(shape) + self.batch_shape
+        eta = jnp.broadcast_to(self.concentration, self.batch_shape)
+        key = self._key()
+        keys = jrandom.split(key, 2 * d + 1)
+        L = jnp.zeros(batch + (d, d), jnp.float32).at[..., 0, 0].set(1.0)
+        for i in range(1, d):
+            b = eta + (d - i - 1) / 2.0
+            y = jrandom.beta(keys[2 * i], i / 2.0, b, batch)  # squared radius
+            u = jrandom.normal(keys[2 * i + 1], batch + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(y)[..., None] * u
+            L = L.at[..., i, :i].set(w)
+            L = L.at[..., i, i].set(jnp.sqrt(jnp.clip(1.0 - y, 1e-12)))
+        return _wrap(L)
+
+    def log_prob(self, value):
+        L = _arr(value)
+        d = self.dim
+        eta = self.concentration
+        diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        orders = jnp.arange(2, d + 1, dtype=L.dtype)
+        unnorm = jnp.sum((d - orders + 2 * eta[..., None] - 2) * jnp.log(diag), -1)
+        return _wrap(unnorm - self._log_normalizer())
+
+    def _log_normalizer(self):
+        # log C(eta, d) for the Cholesky-parametrized LKJ density
+        d = self.dim
+        eta = self.concentration
+        i = jnp.arange(1, d, dtype=jnp.float32)
+        return jnp.sum(
+            (i / 2.0) * math.log(math.pi)
+            + jsp.gammaln(eta[..., None] + (d - 1 - i) / 2.0)
+            - jsp.gammaln(eta[..., None] + (d - 1) / 2.0), -1)

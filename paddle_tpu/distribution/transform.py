@@ -1,0 +1,398 @@
+"""Bijective transforms + TransformedDistribution + Independent.
+
+Reference: python/paddle/distribution/transform.py (Transform taxonomy with
+Type.BIJECTION etc.), transformed_distribution.py, independent.py.
+"""
+from __future__ import annotations
+
+import enum
+import math
+
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from .distribution import Distribution, _arr, _wrap, _shape
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+    "TransformedDistribution", "Independent",
+]
+
+
+class Type(enum.Enum):
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+
+class Transform:
+    _type = Type.INJECTION
+
+    @classmethod
+    def _is_injective(cls):
+        return cls._type in (Type.BIJECTION, Type.INJECTION)
+
+    def __call__(self, x):
+        if isinstance(x, Distribution):
+            return TransformedDistribution(x, [self])
+        return self.forward(x)
+
+    def forward(self, x):
+        return _wrap(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        return _wrap(self._inverse(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _wrap(self._forward_log_det_jacobian(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        y = _arr(y)
+        return _wrap(-self._forward_log_det_jacobian(self._inverse(y)))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # event dimensions consumed/produced (domain/codomain event rank)
+    _domain_event_rank = 0
+    _codomain_event_rank = 0
+
+
+class AbsTransform(Transform):
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return 1 / (1 + jnp.exp(-x))
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jnp.logaddexp(jnp.zeros_like(x), -x) - jnp.logaddexp(jnp.zeros_like(x), x)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return 2.0 * (math.log(2.0) - x - jnp.logaddexp(jnp.zeros_like(x), -2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _type = Type.OTHER
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        x = x - jnp.max(x, -1, keepdims=True)
+        e = jnp.exp(x)
+        return e / jnp.sum(e, -1, keepdims=True)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError("SoftmaxTransform is not injective")
+
+
+class StickBreakingTransform(Transform):
+    _type = Type.BIJECTION
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        offset = x.shape[-1] - jnp.cumsum(jnp.ones_like(x), -1) + 1
+        z = 1 / (1 + jnp.exp(-(x - jnp.log(offset))))
+        zc = jnp.cumprod(1 - z, -1)
+        pad = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+        return jnp.concatenate([z, pad], -1) * jnp.concatenate([pad, zc], -1)
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        offset = y_crop.shape[-1] - jnp.cumsum(jnp.ones_like(y_crop), -1) + 1
+        sf = 1 - jnp.cumsum(y_crop, -1)
+        x = jnp.log(y_crop / jnp.clip(sf, 1e-12)) + jnp.log(offset)
+        return x
+
+    def _forward_log_det_jacobian(self, x):
+        # identity: log|detJ| = sum_i(-x'_i + logsigmoid(x'_i) + log(y_i)),
+        # x' = x - log(offset)
+        y = self._forward(x)
+        offset = x.shape[-1] - jnp.cumsum(jnp.ones_like(x), -1) + 1
+        xs = x - jnp.log(offset)
+        logsig = -jnp.logaddexp(jnp.zeros_like(xs), -xs)
+        return jnp.sum(-xs + logsig + jnp.log(jnp.clip(y[..., :-1], 1e-38)), -1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        if int(jnp.prod(jnp.asarray(self.in_event_shape or (1,)))) != \
+           int(jnp.prod(jnp.asarray(self.out_event_shape or (1,)))):
+            raise ValueError("in/out event sizes must match")
+        self._domain_event_rank = len(self.in_event_shape)
+        self._codomain_event_rank = len(self.out_event_shape)
+
+    def _forward(self, x):
+        n = len(self.in_event_shape)
+        batch = x.shape[:x.ndim - n] if n else x.shape
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        n = len(self.out_event_shape)
+        batch = y.shape[:y.ndim - n] if n else y.shape
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        n = len(self.in_event_shape)
+        batch = x.shape[:x.ndim - n] if n else x.shape
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return tuple(shape[:len(shape) - n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape[:len(shape) - n]) + self.in_event_shape
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        self._domain_event_rank = base._domain_event_rank + self.reinterpreted_batch_rank
+        self._codomain_event_rank = base._codomain_event_rank + self.reinterpreted_batch_rank
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ld = self.base._forward_log_det_jacobian(x)
+        return jnp.sum(ld, axis=tuple(range(-self.reinterpreted_batch_rank, 0)))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._domain_event_rank = max(
+            [t._domain_event_rank for t in self.transforms] or [0])
+        self._codomain_event_rank = max(
+            [t._codomain_event_rank for t in self.transforms] or [0])
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._forward_log_det_jacobian(x)
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class StackTransform(Transform):
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _unstack(self, x):
+        return [jnp.squeeze(s, self.axis) for s in
+                jnp.split(x, len(self.transforms), self.axis)]
+
+    def _forward(self, x):
+        parts = [t._forward(p) for t, p in zip(self.transforms, self._unstack(x))]
+        return jnp.stack(parts, self.axis)
+
+    def _inverse(self, y):
+        parts = [t._inverse(p) for t, p in zip(self.transforms, self._unstack(y))]
+        return jnp.stack(parts, self.axis)
+
+    def _forward_log_det_jacobian(self, x):
+        parts = [t._forward_log_det_jacobian(p)
+                 for t, p in zip(self.transforms, self._unstack(x))]
+        return jnp.stack(parts, self.axis)
+
+
+class Independent(Distribution):
+    """Reinterpret trailing batch dims of ``base`` as event dims.
+
+    Reference: python/paddle/distribution/independent.py.
+    """
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        if self.reinterpreted_batch_rank > len(base.batch_shape):
+            raise ValueError("reinterpreted_batch_rank exceeds base batch rank")
+        cut = len(base.batch_shape) - self.reinterpreted_batch_rank
+        super().__init__(base.batch_shape[:cut],
+                         base.batch_shape[cut:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)._data
+        axes = tuple(range(-self.reinterpreted_batch_rank, 0))
+        return _wrap(jnp.sum(lp, axis=axes) if axes else lp)
+
+    def entropy(self):
+        ent = self.base.entropy()._data
+        axes = tuple(range(-self.reinterpreted_batch_rank, 0))
+        return _wrap(jnp.sum(ent, axis=axes) if axes else ent)
+
+
+class TransformedDistribution(Distribution):
+    """Distribution of T(X) for X ~ base and a chain of transforms T.
+
+    Reference: python/paddle/distribution/transformed_distribution.py:26.
+    """
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        chain = ChainTransform(self.transforms)
+        base_event = base.event_shape
+        shape = chain.forward_shape(base.batch_shape + base.event_shape)
+        # event rank grows to at least the chain's codomain event rank
+        event_rank = max(len(base_event), chain._codomain_event_rank)
+        cut = len(shape) - event_rank
+        super().__init__(shape[:cut], shape[cut:])
+        self._chain = chain
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return _wrap(self._chain._forward(_arr(x)))
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return _wrap(self._chain._forward(_arr(x)))
+
+    def log_prob(self, value):
+        y = _arr(value)
+        x = self._chain._inverse(y)
+        ld = self._chain._forward_log_det_jacobian(x)
+        base_lp = self.base.log_prob(_wrap(x))._data
+        # sum base log-prob over dims that became event dims
+        extra = len(self.event_shape) - len(self.base.event_shape) \
+            - (self._chain._codomain_event_rank - self._chain._domain_event_rank)
+        if extra > 0:
+            base_lp = jnp.sum(base_lp, axis=tuple(range(-extra, 0)))
+        # reduce jacobian over event dims beyond its natural rank
+        jac_extra = len(self.event_shape) - self._chain._codomain_event_rank
+        if jac_extra > 0 and jnp.ndim(ld) >= jac_extra:
+            ld = jnp.sum(ld, axis=tuple(range(-jac_extra, 0)))
+        return _wrap(base_lp - ld)

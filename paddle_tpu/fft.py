@@ -1,0 +1,131 @@
+"""Discrete Fourier transforms. reference: python/paddle/fft.py.
+
+TPU-native: every transform is jnp.fft lowered by XLA (TPU FFT runs as
+composed matmuls/transposes on the MXU for small sizes, or the XLA FFT HLO);
+autograd comes from jax.vjp through framework.core.execute — no hand-written
+fft_grad kernels (reference: paddle/phi/kernels/funcs/cufft_util.h,
+paddle/phi/kernels/gpu/fft_kernel.cu).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.core import execute
+
+__all__ = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft", "hfft2", "ihfft2", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm is None:
+        return "backward"
+    if norm not in _NORMS:
+        raise ValueError(f"norm must be one of {_NORMS}, got {norm!r}")
+    return norm
+
+
+def _1d(jnp_fn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        norm = _check_norm(norm)
+        return execute(lambda a: jnp_fn(a, n=n, axis=axis, norm=norm), x,
+                       _name=jnp_fn.__name__)
+    return op
+
+
+def _2d(jnp_fn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        norm = _check_norm(norm)
+        return execute(lambda a: jnp_fn(a, s=s, axes=axes, norm=norm), x,
+                       _name=jnp_fn.__name__)
+    return op
+
+
+def _nd(jnp_fn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        norm = _check_norm(norm)
+        return execute(lambda a: jnp_fn(a, s=s, axes=axes, norm=norm), x,
+                       _name=jnp_fn.__name__)
+    return op
+
+
+fft = _1d(jnp.fft.fft)
+ifft = _1d(jnp.fft.ifft)
+rfft = _1d(jnp.fft.rfft)
+irfft = _1d(jnp.fft.irfft)
+hfft = _1d(jnp.fft.hfft)
+ihfft = _1d(jnp.fft.ihfft)
+
+fft2 = _2d(jnp.fft.fft2)
+ifft2 = _2d(jnp.fft.ifft2)
+fftn = _nd(jnp.fft.fftn)
+ifftn = _nd(jnp.fft.ifftn)
+rfft2 = _2d(jnp.fft.rfft2)
+irfft2 = _2d(jnp.fft.irfft2)
+rfftn = _nd(jnp.fft.rfftn)
+irfftn = _nd(jnp.fft.irfftn)
+
+
+def _h2(fwd, axes_default=(-2, -1)):
+    def op(x, s=None, axes=axes_default, norm="backward", name=None):
+        norm = _check_norm(norm)
+
+        def f(a):
+            # hfft2/hfftn = real-output transform of hermitian input: c2c along
+            # the leading axes then hfft last. The inverse must mirror in
+            # reverse order — ihfft (real input) first, then ifft on the rest.
+            out = a
+            ax = list(axes) if axes is not None else list(range(a.ndim))
+            if fwd:
+                for i, axis in enumerate(ax[:-1]):
+                    nn = None if s is None else s[i]
+                    out = jnp.fft.fft(out, n=nn, axis=axis, norm=norm)
+                nn = None if s is None else s[-1]
+                out = jnp.fft.hfft(out, n=nn, axis=ax[-1], norm=norm)
+            else:
+                nn = None if s is None else s[-1]
+                out = jnp.fft.ihfft(out, n=nn, axis=ax[-1], norm=norm)
+                for i, axis in enumerate(ax[:-1]):
+                    nn = None if s is None else s[i]
+                    out = jnp.fft.ifft(out, n=nn, axis=axis, norm=norm)
+            return out
+        return execute(f, x, _name="hfft2" if fwd else "ihfft2")
+    return op
+
+
+hfft2 = _h2(True)
+ihfft2 = _h2(False)
+hfftn = _h2(True, axes_default=None)
+ihfftn = _h2(False, axes_default=None)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.core import Tensor
+    out = jnp.fft.fftfreq(n, d=d)
+    if dtype is not None:
+        from .framework import dtypes as _dt
+        out = out.astype(_dt.convert_dtype(dtype))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.core import Tensor
+    out = jnp.fft.rfftfreq(n, d=d)
+    if dtype is not None:
+        from .framework import dtypes as _dt
+        out = out.astype(_dt.convert_dtype(dtype))
+    return Tensor(out)
+
+
+def fftshift(x, axes=None, name=None):
+    return execute(lambda a: jnp.fft.fftshift(a, axes=axes), x, _name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return execute(lambda a: jnp.fft.ifftshift(a, axes=axes), x, _name="ifftshift")

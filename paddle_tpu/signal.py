@@ -1,0 +1,147 @@
+"""Short-time Fourier transforms. reference: python/paddle/signal.py
+(stft, istft).
+
+TPU-native: framing is a gather/strided-reshape that XLA fuses with the FFT;
+no frame_kernel / overlap_add CUDA kernels needed (reference:
+paddle/phi/kernels/gpu/frame_kernel.cu, overlap_add_kernel.cu).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.core import execute
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame(a, frame_length, hop_length, axis=-1):
+    if axis not in (-1, a.ndim - 1, 0):
+        raise ValueError("frame: axis must be 0 or -1")
+    seq_last = axis in (-1, a.ndim - 1)
+    if not seq_last:
+        a = jnp.moveaxis(a, 0, -1)
+    n = a.shape[-1]
+    num_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(num_frames)[:, None])  # [F, L]
+    out = a[..., idx]                                        # [..., F, L]
+    out = jnp.swapaxes(out, -1, -2)                          # [..., L, F]
+    if not seq_last:
+        out = jnp.moveaxis(out, (-2, -1), (0, 1))
+    return out
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """reference: python/paddle/signal.py frame()."""
+    return execute(lambda a: _frame(a, frame_length, hop_length, axis), x,
+                   _name="frame")
+
+
+def _overlap_add(a, hop_length, axis=-1):
+    seq_last = axis in (-1, a.ndim - 1)
+    if not seq_last:
+        a = jnp.moveaxis(a, (0, 1), (-2, -1))
+    *batch, frame_length, num_frames = a.shape
+    n = frame_length + hop_length * (num_frames - 1)
+    # one scatter-add with the same [F, L] index matrix _frame gathers with
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(num_frames)[:, None])   # [F, L]
+    frames = jnp.swapaxes(a, -1, -2)                          # [..., F, L]
+    out = jnp.zeros((*batch, n), a.dtype).at[..., idx].add(frames)
+    if not seq_last:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    return execute(lambda a: _overlap_add(a, hop_length, axis), x,
+                   _name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """reference: python/paddle/signal.py stft()."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def f(a, w):
+        orig_ndim = a.ndim
+        if orig_ndim == 1:
+            a = a[None]
+        if w is None:
+            win = jnp.ones((win_length,), a.dtype)
+        else:
+            win = w
+        if win_length < n_fft:
+            pad_l = (n_fft - win_length) // 2
+            win = jnp.pad(win, (pad_l, n_fft - win_length - pad_l))
+        if center:
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)],
+                        mode=pad_mode)
+        frames = _frame(a, n_fft, hop_length)      # [..., n_fft, F]
+        frames = frames * win[:, None]
+        if jnp.iscomplexobj(a) or not onesided:
+            spec = jnp.fft.fft(frames, axis=-2)
+        else:
+            spec = jnp.fft.rfft(frames, axis=-2)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.array(n_fft, spec.real.dtype))
+        if orig_ndim == 1:
+            spec = spec[0]
+        return spec
+
+    if window is None:
+        return execute(lambda a: f(a, None), x, _name="stft")
+    return execute(f, x, window, _name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """reference: python/paddle/signal.py istft()."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if return_complex and onesided:
+        raise ValueError(
+            "istft: onesided must be False when return_complex is True "
+            "(a onesided spectrum reconstructs a real signal)")
+
+    def f(spec, w):
+        orig_ndim = spec.ndim
+        if orig_ndim == 2:
+            spec = spec[None]
+        if w is None:
+            win = jnp.ones((win_length,), spec.real.dtype)
+        else:
+            win = w.astype(spec.real.dtype)
+        if win_length < n_fft:
+            pad_l = (n_fft - win_length) // 2
+            win = jnp.pad(win, (pad_l, n_fft - win_length - pad_l))
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.array(n_fft, spec.real.dtype))
+        if onesided and not return_complex:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-2)  # [..., n_fft, F]
+        else:
+            frames = jnp.fft.ifft(spec, axis=-2)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * win[:, None]
+        out = _overlap_add(frames, hop_length)
+        # window envelope normalization (NOLA)
+        env = _overlap_add(
+            jnp.broadcast_to((win * win)[:, None], frames.shape[-2:]),
+            hop_length)
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            out = out[..., n_fft // 2: out.shape[-1] - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        if orig_ndim == 2:
+            out = out[0]
+        return out
+
+    if window is None:
+        return execute(lambda a: f(a, None), x, _name="istft")
+    return execute(f, x, window, _name="istft")

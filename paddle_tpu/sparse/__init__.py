@@ -1,0 +1,557 @@
+"""Sparse tensor API. reference: python/paddle/sparse/ (creation.py,
+unary.py, binary.py, multiary.py, nn/) and the C++ tensor classes
+paddle/phi/core/sparse_coo_tensor.h, sparse_csr_tensor.h.
+
+TPU-native design: a SparseCooTensor is (indices, values) arrays; all math
+lowers to XLA gather/scatter/segment reductions, which TPU executes well when
+nnz is static. There are no per-format CUDA kernels (reference:
+paddle/phi/kernels/sparse/gpu/*) — spmm is a segment-sum matmul, softmax is a
+segment max/sum, and conversions are scatters. Values are ordinary Tensors so
+autograd flows through the tape for value-wise ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, execute, to_tensor
+from ..framework import dtypes as _dt
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor",
+    "sparse_coo_tensor", "sparse_csr_tensor",
+    "is_same_shape",
+    # unary
+    "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+    "sqrt", "square", "log1p", "abs", "expm1", "relu", "relu6",
+    "leaky_relu", "neg", "pow", "cast", "rad2deg", "deg2rad", "coalesce",
+    "sum", "transpose", "reshape", "isnan", "slice",
+    # binary / multiary
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "mv", "addmm",
+]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor: indices [sparse_ndim, nnz] + values [nnz, *dense_dims].
+
+    reference: paddle/phi/core/sparse_coo_tensor.h:30.
+    """
+
+    def __init__(self, indices, values, shape, coalesced=False):
+        self._indices = jnp.asarray(_arr(indices), jnp.int32)
+        self._values = values if isinstance(values, Tensor) else Tensor(_arr(values))
+        self._shape = tuple(int(s) for s in shape)
+        self._coalesced = coalesced
+
+    # -- paddle Tensor-like surface ----------------------------------------
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def nnz(self):
+        return int(self._indices.shape[1])
+
+    def indices(self):
+        return Tensor(self._indices)
+
+    def values(self):
+        return self._values
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def is_sparse(self):
+        return True
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    def to_dense(self):
+        sp_ndim = self._indices.shape[0]
+        dense_shape = self._shape
+
+        def f(vals):
+            out = jnp.zeros(dense_shape, vals.dtype)
+            idx = tuple(self._indices[d] for d in range(sp_ndim))
+            return out.at[idx].add(vals)
+        return execute(f, self._values, _name="coo_to_dense")
+
+    def to_sparse_csr(self):
+        return _coo_to_csr(self)
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return self
+
+    def coalesce(self):
+        return coalesce(self)
+
+    def astype(self, dtype):
+        return SparseCooTensor(self._indices, self._values.astype(dtype),
+                               self._shape, self._coalesced)
+
+    def numpy(self):
+        return self.to_dense().numpy()
+
+    def backward(self, *a, **k):
+        raise RuntimeError("call backward() on a dense scalar loss")
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self._shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    # math sugar
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __truediv__(self, other):
+        return divide(self, other)
+
+    def __neg__(self):
+        return neg(self)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+    def T(self):
+        return transpose(self, list(range(self.ndim))[::-1])
+
+
+class SparseCsrTensor:
+    """CSR sparse matrix (2D or batched 3D).
+
+    reference: paddle/phi/core/sparse_csr_tensor.h:30.
+    """
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(_arr(crows), jnp.int32)
+        self._cols = jnp.asarray(_arr(cols), jnp.int32)
+        self._values = values if isinstance(values, Tensor) else Tensor(_arr(values))
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def nnz(self):
+        return int(self._cols.shape[-1])
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def values(self):
+        return self._values
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def is_sparse(self):
+        return True
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return _csr_to_coo(self)
+
+    def to_sparse_csr(self):
+        return self
+
+    def to_dense(self):
+        return _csr_to_coo(self).to_dense()
+
+    def astype(self, dtype):
+        return SparseCsrTensor(self._crows, self._cols,
+                               self._values.astype(dtype), self._shape)
+
+    def numpy(self):
+        return self.to_dense().numpy()
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self._shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def _row_ids_from_crows(crows, nnz):
+    # rows[k] = number of crows entries <= k  ==> searchsorted
+    return jnp.searchsorted(crows[1:], jnp.arange(nnz), side="right").astype(jnp.int32)
+
+
+def _csr_to_coo(t: SparseCsrTensor) -> SparseCooTensor:
+    if len(t._shape) == 2:
+        rows = _row_ids_from_crows(t._crows, t.nnz())
+        indices = jnp.stack([rows, t._cols])
+        return SparseCooTensor(indices, t._values, t._shape, coalesced=True)
+    raise NotImplementedError("batched CSR->COO not implemented")
+
+
+def _coo_to_csr(t: SparseCooTensor) -> SparseCsrTensor:
+    if len(t._shape) != 2 or t._indices.shape[0] != 2:
+        raise NotImplementedError("to_sparse_csr: 2D only")
+    t = coalesce(t)
+    rows, cols = t._indices[0], t._indices[1]
+    nrows = t._shape[0]
+    counts = jnp.zeros((nrows,), jnp.int32).at[rows].add(1)
+    crows = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)])
+    return SparseCsrTensor(crows, cols, t._values, t._shape)
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """reference: python/paddle/sparse/creation.py:53."""
+    idx = jnp.asarray(_arr(indices), jnp.int32)
+    vals = values if isinstance(values, Tensor) else to_tensor(values)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    if shape is None:
+        sp_max = [int(m) + 1 for m in np.asarray(jax.device_get(idx).max(axis=1))]
+        shape = tuple(sp_max) + tuple(vals.shape[1:])
+    vals.stop_gradient = stop_gradient
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    """reference: python/paddle/sparse/creation.py:160."""
+    vals = values if isinstance(values, Tensor) else to_tensor(values)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    vals.stop_gradient = stop_gradient
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def coalesce(x: SparseCooTensor, name=None):
+    """Merge duplicate indices (sorted row-major). reference:
+    python/paddle/sparse/unary.py coalesce, phi sparse coalesce_kernel."""
+    if x._coalesced:
+        return x
+    sp_ndim = x._indices.shape[0]
+    idx_np = np.asarray(jax.device_get(x._indices))
+    # row-major linearization
+    lin = np.zeros(idx_np.shape[1], np.int64)
+    for d in range(sp_ndim):
+        lin = lin * x._shape[d] + idx_np[d]
+    order = np.argsort(lin, kind="stable")
+    lin_sorted = lin[order]
+    uniq, inv = np.unique(lin_sorted, return_inverse=True)
+    # rebuild indices from unique linear ids
+    new_idx = np.zeros((sp_ndim, len(uniq)), np.int32)
+    rem = uniq.copy()
+    for d in range(sp_ndim - 1, -1, -1):
+        new_idx[d] = rem % x._shape[d]
+        rem = rem // x._shape[d]
+    n_uniq = len(uniq)
+    perm = jnp.asarray(order)
+    seg = jnp.asarray(inv)
+
+    def f(vals):
+        vs = vals[perm]
+        return jax.ops.segment_sum(vs, seg, num_segments=n_uniq)
+    new_vals = execute(f, x._values, _name="coalesce")
+    return SparseCooTensor(jnp.asarray(new_idx), new_vals, x._shape,
+                           coalesced=True)
+
+
+# ---------------------------------------------------------------------------
+# unary ops (zero-preserving -> act on values)
+# ---------------------------------------------------------------------------
+
+def _unary(name, f):
+    def op(x, name=None):
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(x._indices, execute(f, x._values, _name=name),
+                                   x._shape, x._coalesced)
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x._crows, x._cols,
+                                   execute(f, x._values, _name=name), x._shape)
+        return execute(f, x, _name=name)
+    op.__name__ = name
+    return op
+
+
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+abs = _unary("abs", jnp.abs)
+expm1 = _unary("expm1", jnp.expm1)
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+relu6 = _unary("relu6", lambda v: jnp.clip(v, 0, 6))
+neg = _unary("neg", jnp.negative)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+isnan = _unary("isnan", jnp.isnan)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _unary("leaky_relu",
+                  lambda v: jnp.where(v >= 0, v, v * negative_slope))(x)
+
+
+def pow(x, factor, name=None):
+    return _unary("pow", lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    if isinstance(x, SparseCooTensor):
+        idx = (x._indices if index_dtype is None
+               else x._indices.astype(_dt.convert_dtype(index_dtype)))
+        vals = x._values if value_dtype is None else x._values.astype(value_dtype)
+        return SparseCooTensor(idx, vals, x._shape, x._coalesced)
+    crows = (x._crows if index_dtype is None
+             else x._crows.astype(_dt.convert_dtype(index_dtype)))
+    cols = (x._cols if index_dtype is None
+            else x._cols.astype(_dt.convert_dtype(index_dtype)))
+    vals = x._values if value_dtype is None else x._values.astype(value_dtype)
+    return SparseCsrTensor(crows, cols, vals, x._shape)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """reference: python/paddle/sparse/unary.py sum — sparse in, sparse out
+    for axis reductions; scalar dense Tensor for full reduction."""
+    want_csr = isinstance(x, SparseCsrTensor)
+    coo = x.to_sparse_coo() if want_csr else x
+    if dtype is not None:
+        coo = coo.astype(dtype)
+    if axis is None:
+        return execute(jnp.sum, coo._values, _name="sparse_sum")
+    ndim = len(coo._shape)
+    ax = axis + ndim if axis < 0 else axis
+    # drop the reduced index dim (or pin it to 0 for keepdim) and re-coalesce:
+    # duplicate surviving coordinates merge by summation.
+    if keepdim:
+        new_idx = coo._indices.at[ax].set(0)
+        new_shape = tuple(1 if d == ax else s for d, s in enumerate(coo._shape))
+    else:
+        keep = [d for d in range(ndim) if d != ax]
+        new_idx = jnp.stack([coo._indices[d] for d in keep])
+        new_shape = tuple(coo._shape[d] for d in keep)
+    out = coalesce(SparseCooTensor(new_idx, coo._values, new_shape))
+    return out.to_sparse_csr() if want_csr and len(new_shape) == 2 else out
+
+
+def transpose(x, perm, name=None):
+    coo = x.to_sparse_coo() if isinstance(x, SparseCsrTensor) else x
+    new_idx = jnp.stack([coo._indices[p] for p in perm])
+    new_shape = tuple(coo._shape[p] for p in perm)
+    out = SparseCooTensor(new_idx, coo._values, new_shape)
+    if isinstance(x, SparseCsrTensor):
+        return out.to_sparse_csr()
+    return out
+
+
+def reshape(x, shape, name=None):
+    coo = x.to_sparse_coo() if isinstance(x, SparseCsrTensor) else x
+    shape = list(shape)
+    numel = int(np.prod(coo._shape))
+    if -1 in shape:
+        fill = numel // -int(np.prod(shape))
+        shape[shape.index(-1)] = fill
+    sp_ndim = coo._indices.shape[0]
+    # linearize old indices, delinearize into new shape
+    lin = jnp.zeros(coo._indices.shape[1], jnp.int64)
+    for d in range(sp_ndim):
+        lin = lin * coo._shape[d] + coo._indices[d]
+    new_idx = []
+    rem = lin
+    for d in range(len(shape) - 1, -1, -1):
+        new_idx.append((rem % shape[d]).astype(jnp.int32))
+        rem = rem // shape[d]
+    out = SparseCooTensor(jnp.stack(new_idx[::-1]), coo._values, tuple(shape))
+    if isinstance(x, SparseCsrTensor):
+        return out.to_sparse_csr()
+    return out
+
+
+def slice(x, axes, starts, ends, name=None):
+    dense = x.to_dense()
+    from ..tensor import manipulation as _man
+    out = _man.slice(dense, axes, starts, ends)
+    return _dense_to_coo(out)
+
+
+def _dense_to_coo(t, sparse_dim=None):
+    a = np.asarray(jax.device_get(t._data if isinstance(t, Tensor) else t))
+    idx = np.stack(np.nonzero(a))
+    vals_idx = tuple(idx)
+
+    def f(d):
+        return d[vals_idx]
+    vals = execute(f, t, _name="dense_to_coo") if isinstance(t, Tensor) else Tensor(a[vals_idx])
+    return SparseCooTensor(jnp.asarray(idx, jnp.int32), vals, a.shape,
+                           coalesced=True)
+
+
+# ---------------------------------------------------------------------------
+# binary / multiary
+# ---------------------------------------------------------------------------
+
+def _ewise(name, f, x, y):
+    xs = isinstance(x, (SparseCooTensor, SparseCsrTensor))
+    ys = isinstance(y, (SparseCooTensor, SparseCsrTensor))
+    want_csr = (isinstance(x, SparseCsrTensor)
+                or (not xs and isinstance(y, SparseCsrTensor)))
+    if xs and ys:
+        if tuple(x.shape) != tuple(y.shape):
+            raise ValueError(
+                f"sparse {name}: operand shapes must match, got "
+                f"{tuple(x.shape)} vs {tuple(y.shape)}")
+        a, b = x.to_sparse_coo(), y.to_sparse_coo()
+        a, b = coalesce(a), coalesce(b)
+        # union of patterns via concatenation + coalesce; for subtraction/div
+        # apply sign at value level
+        idx = jnp.concatenate([a._indices, b._indices], axis=1)
+
+        def g(va, vb):
+            if name == "add":
+                return jnp.concatenate([va, vb])
+            if name == "subtract":
+                return jnp.concatenate([va, -vb])
+            raise NotImplementedError
+        if name in ("add", "subtract"):
+            vals = execute(g, a._values, b._values, _name="sparse_" + name)
+            out = coalesce(SparseCooTensor(idx, vals, a._shape))
+        else:
+            # multiply/divide need aligned patterns -> dense fallback
+            out = _dense_to_coo(execute(f, a.to_dense(), b.to_dense(),
+                                        _name="sparse_" + name))
+        return out.to_sparse_csr() if want_csr else out
+    # sparse . dense -> dense
+    a = x.to_dense() if xs else x
+    b = y.to_dense() if ys else y
+    return execute(f, a, b, _name="sparse_" + name)
+
+
+def add(x, y, name=None):
+    return _ewise("add", jnp.add, x, y)
+
+
+def subtract(x, y, name=None):
+    return _ewise("subtract", jnp.subtract, x, y)
+
+
+def multiply(x, y, name=None):
+    return _ewise("multiply", jnp.multiply, x, y)
+
+
+def divide(x, y, name=None):
+    return _ewise("divide", jnp.divide, x, y)
+
+
+def matmul(x, y, name=None):
+    """Sparse @ dense -> dense (spmm as segment-sum over rows — TPU-friendly,
+    no cuSPARSE). reference: python/paddle/sparse/binary.py matmul,
+    phi/kernels/sparse/gpu/matmul_kernel.cu."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        coo = coalesce(x.to_sparse_coo())
+        if len(coo._shape) != 2:
+            raise NotImplementedError("sparse matmul: 2D only")
+        rows, cols = coo._indices[0], coo._indices[1]
+        nrows = coo._shape[0]
+
+        def f(vals, dense):
+            gathered = dense[cols] * vals[:, None]        # [nnz, N]
+            return jax.ops.segment_sum(gathered, rows, num_segments=nrows)
+        return execute(f, coo._values, y, _name="spmm")
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        coo = coalesce(y.to_sparse_coo())
+        rows, cols = coo._indices[0], coo._indices[1]
+        ncols = coo._shape[1]
+
+        def f(dense, vals):
+            gathered = dense[:, rows] * vals[None, :]     # [M, nnz]
+            return jax.ops.segment_sum(gathered.T, cols,
+                                       num_segments=ncols).T
+        return execute(f, x, coo._values, _name="dsmm")
+    from ..tensor import linalg as _l
+    return _l.matmul(x, y)
+
+
+def mv(x, vec, name=None):
+    coo = coalesce(x.to_sparse_coo())
+    rows, cols = coo._indices[0], coo._indices[1]
+    nrows = coo._shape[0]
+
+    def f(vals, v):
+        return jax.ops.segment_sum(vals * v[cols], rows, num_segments=nrows)
+    return execute(f, coo._values, vec, _name="spmv")
+
+
+def masked_matmul(x, y, mask, name=None):
+    """Compute (x @ y) only at mask's sparsity pattern (SDDMM).
+    reference: python/paddle/sparse/binary.py masked_matmul."""
+    coo = coalesce(mask.to_sparse_coo())
+    rows, cols = coo._indices[0], coo._indices[1]
+
+    def f(a, b):
+        # out_vals[k] = a[rows[k], :] . b[:, cols[k]]
+        return jnp.einsum("kd,kd->k", a[rows, :], b.T[cols, :])
+    vals = execute(f, x, y, _name="sddmm")
+    out = SparseCooTensor(coo._indices, vals, coo._shape, coalesced=True)
+    return out.to_sparse_csr() if isinstance(mask, SparseCsrTensor) else out
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """reference: python/paddle/sparse/multiary.py addmm."""
+    prod = matmul(x, y)
+    dense_in = input.to_dense() if isinstance(
+        input, (SparseCooTensor, SparseCsrTensor)) else input
+    return execute(lambda i, p: beta * i + alpha * p, dense_in, prod,
+                   _name="sparse_addmm")
+from . import nn  # noqa: F401,E402

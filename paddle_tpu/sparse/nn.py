@@ -1,0 +1,217 @@
+"""Sparse nn layers + functionals. reference: python/paddle/sparse/nn/
+(layer/activation.py, layer/norm.py, layer/conv.py, functional/).
+
+Conv3D/SubmConv3D lower through dense conv (lax.conv_general_dilated) on the
+gathered active sites — on TPU the MXU wants dense tiles anyway, so the
+"sparse" part is the site gather/scatter, not the conv arithmetic.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, execute
+from ..nn.layer.layers import Layer
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "SyncBatchNorm", "Conv3D", "SubmConv3D", "MaxPool3D",
+           "functional"]
+
+
+class functional:
+    """Namespace mirroring paddle.sparse.nn.functional."""
+
+    @staticmethod
+    def relu(x, name=None):
+        from . import relu as _r
+        return _r(x)
+
+    @staticmethod
+    def relu6(x, name=None):
+        from . import relu6 as _r
+        return _r(x)
+
+    @staticmethod
+    def leaky_relu(x, negative_slope=0.01, name=None):
+        from . import leaky_relu as _l
+        return _l(x, negative_slope)
+
+    @staticmethod
+    def softmax(x, axis=-1, name=None):
+        return _sparse_softmax(x, axis)
+
+    @staticmethod
+    def attention(query, key, value, sparse_mask, key_padding_mask=None,
+                  attn_mask=None, name=None):
+        """Sparse-mask attention (SDDMM -> sparse softmax -> spmm).
+        reference: python/paddle/sparse/nn/functional/transformer.py."""
+        from . import SparseCooTensor, masked_matmul, matmul
+        import math as _math
+        d = query.shape[-1]
+        key_t = execute(lambda k: jnp.swapaxes(k, -1, -2), key, _name="kT")
+        scores = masked_matmul(query, key_t, sparse_mask)  # [L, L] at mask
+        coo = scores.to_sparse_coo()
+        scaled = SparseCooTensor(coo._indices, coo._values / _math.sqrt(d),
+                                 coo._shape, coo._coalesced)
+        probs = _sparse_softmax(scaled, -1)
+        return matmul(probs, value)
+
+
+def _sparse_softmax(x, axis=-1):
+    """Row-wise softmax over the sparse pattern via segment max/sum.
+    reference: phi/kernels/sparse/gpu/softmax_kernel.cu."""
+    from . import SparseCooTensor, SparseCsrTensor, coalesce
+    if axis not in (-1, len(x.shape) - 1):
+        raise NotImplementedError("sparse softmax: last axis only")
+    want_csr = isinstance(x, SparseCsrTensor)
+    coo = coalesce(x.to_sparse_coo())
+    if len(coo._shape) != 2:
+        raise NotImplementedError("sparse softmax: 2D only")
+    rows = coo._indices[0]
+    nrows = coo._shape[0]
+
+    def f(vals):
+        row_max = jax.ops.segment_max(vals, rows, num_segments=nrows)
+        e = jnp.exp(vals - row_max[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=nrows)
+        return e / denom[rows]
+    out_vals = execute(f, coo._values, _name="sparse_softmax")
+    out = SparseCooTensor(coo._indices, out_vals, coo._shape, coalesced=True)
+    return out.to_sparse_csr() if want_csr else out
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return functional.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return functional.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return functional.leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, self._axis)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over sparse values (channel-last values [nnz, C]).
+    reference: python/paddle/sparse/nn/layer/norm.py BatchNorm."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ..nn.layer.norm import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum, epsilon=epsilon,
+                               weight_attr=weight_attr, bias_attr=bias_attr)
+
+    def forward(self, x):
+        from . import SparseCooTensor
+        vals = x.values()
+        out_vals = self._bn(vals)
+        return SparseCooTensor(x._indices, out_vals, x._shape, x._coalesced)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Single-controller SPMD: batch stats are global under pjit already."""
+
+
+class Conv3D(Layer):
+    """Sparse 3D conv via dense densify->conv->sparsify.
+    reference: python/paddle/sparse/nn/layer/conv.py Conv3D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        from ..nn.layer.conv import Conv3D as DenseConv3D
+        self._conv = DenseConv3D(in_channels, out_channels, kernel_size,
+                                 stride=stride, padding=padding,
+                                 dilation=dilation, groups=groups,
+                                 weight_attr=weight_attr, bias_attr=bias_attr,
+                                 data_format="NDHWC")
+        self._subm = False
+
+    def _site_indices(self, x):
+        """Active (N, D, H, W) sites from the input's indices — geometry only,
+        never value-dependent (a stored zero keeps its site active)."""
+        import numpy as np
+        idx = np.asarray(jax.device_get(x._indices))
+        if idx.shape[0] == 5:            # full-ndim indices incl. channel
+            idx = idx[:4]
+        sites = np.unique(idx.T, axis=0).T
+        return sites                      # [4, nsites]
+
+    def forward(self, x):
+        from . import SparseCooTensor
+        import numpy as np
+        dense = x.to_dense()
+        out = self._conv(dense)           # dense [N, D', H', W', C]
+        if self._subm:
+            out_sites = self._site_indices(x)
+        else:
+            # output pattern = receptive-field reach of the input occupancy:
+            # conv the binary site mask with an all-ones kernel, same config
+            in_sites = self._site_indices(x)
+            occ = np.zeros(tuple(x.shape[:4]) + (1,), np.float32)
+            occ[tuple(in_sites)] = 1.0
+
+            def _t3(v):
+                return (v,) * 3 if isinstance(v, int) else tuple(v)
+            ones_w = jnp.ones(tuple(self._conv._kernel_size) + (1, 1),
+                              jnp.float32)
+            reach = jax.lax.conv_general_dilated(
+                jnp.asarray(occ), ones_w,
+                window_strides=_t3(self._conv._stride),
+                padding=[(p, p) for p in _t3(self._conv._padding)],
+                rhs_dilation=_t3(self._conv._dilation),
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+            out_sites = np.stack(np.nonzero(
+                np.asarray(jax.device_get(reach))[..., 0] > 0))
+        site_idx = tuple(jnp.asarray(out_sites))
+
+        def gather(o):
+            return o[site_idx]            # [nsites, C]
+        vals = execute(gather, out, _name="sparse_conv_gather")
+        return SparseCooTensor(jnp.asarray(out_sites, jnp.int32), vals,
+                               tuple(out.shape), coalesced=True)
+
+
+class SubmConv3D(Conv3D):
+    """Submanifold sparse conv: output pattern == input pattern."""
+
+    def __init__(self, *args, **kwargs):
+        kwargs.pop("key", None)
+        super().__init__(*args, **kwargs)
+        self._subm = True
+
+
+class MaxPool3D(Layer):
+    """reference: python/paddle/sparse/nn/layer/pooling.py MaxPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        from ..nn.layer.pooling import MaxPool3D as DensePool
+        self._pool = DensePool(kernel_size, stride=stride, padding=padding,
+                               data_format="NDHWC")
+
+    def forward(self, x):
+        from . import _dense_to_coo
+        return _dense_to_coo(self._pool(x.to_dense()))

@@ -1,0 +1,267 @@
+"""Sparse + FFT + signal numeric checks vs numpy/scipy-style references.
+
+Modeled on the reference's OpTest pattern (test/legacy_test/op_test.py:418):
+run the op, compare against a NumPy ground truth.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        x = np.random.RandomState(0).randn(4, 32).astype(np.float32)
+        X = paddle.fft.fft(paddle.to_tensor(x))
+        np.testing.assert_allclose(_np(X), np.fft.fft(x), rtol=1e-4, atol=1e-4)
+        back = paddle.fft.ifft(X)
+        np.testing.assert_allclose(_np(back).real, x, rtol=1e-4, atol=1e-4)
+
+    def test_rfft_irfft(self):
+        x = np.random.RandomState(1).randn(8, 64).astype(np.float32)
+        X = paddle.fft.rfft(paddle.to_tensor(x))
+        np.testing.assert_allclose(_np(X), np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+        y = paddle.fft.irfft(X, n=64)
+        np.testing.assert_allclose(_np(y), x, rtol=1e-4, atol=1e-4)
+
+    def test_fft2_norms(self):
+        x = np.random.RandomState(2).randn(3, 16, 16).astype(np.float32)
+        for norm in ("backward", "ortho", "forward"):
+            X = paddle.fft.fft2(paddle.to_tensor(x), norm=norm)
+            np.testing.assert_allclose(_np(X), np.fft.fft2(x, norm=norm),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_fftshift_freq(self):
+        f = paddle.fft.fftfreq(10, d=0.1)
+        np.testing.assert_allclose(_np(f), np.fft.fftfreq(10, d=0.1), rtol=1e-6)
+        x = paddle.to_tensor(np.arange(8.0, dtype=np.float32))
+        np.testing.assert_allclose(_np(paddle.fft.fftshift(x)),
+                                   np.fft.fftshift(np.arange(8.0)), rtol=1e-6)
+
+    def test_hfft(self):
+        x = np.random.RandomState(3).randn(33).astype(np.float32)
+        spec = np.fft.rfft(x)
+        out = paddle.fft.hfft(paddle.to_tensor(spec), n=64)
+        np.testing.assert_allclose(_np(out), np.fft.hfft(spec, n=64),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_fft_grad(self):
+        x = paddle.to_tensor(np.random.RandomState(4).randn(16).astype(np.float32),
+                             stop_gradient=False)
+        X = paddle.fft.rfft(x)
+        mag = (X.abs() ** 2).sum()
+        mag.backward()
+        assert x.grad is not None
+        # Parseval: d/dx sum|rfft(x)|^2 relates linearly to x
+        assert np.isfinite(_np(x.grad)).all()
+
+
+class TestSignal:
+    def test_frame(self):
+        x = np.arange(10, dtype=np.float32)
+        f = paddle.signal.frame(paddle.to_tensor(x), frame_length=4, hop_length=2)
+        assert list(f.shape) == [4, 4]
+        np.testing.assert_allclose(_np(f)[:, 0], x[0:4])
+        np.testing.assert_allclose(_np(f)[:, 1], x[2:6])
+
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(2, 512).astype(np.float32)
+        t = paddle.to_tensor(x)
+        win = paddle.to_tensor(np.hanning(128).astype(np.float32))
+        spec = paddle.signal.stft(t, n_fft=128, hop_length=32, window=win)
+        out = paddle.signal.istft(spec, n_fft=128, hop_length=32, window=win,
+                                  length=512)
+        np.testing.assert_allclose(_np(out), x, rtol=1e-3, atol=1e-3)
+
+    def test_overlap_add(self):
+        frames = np.ones((4, 3), np.float32)  # frame_length 4, 3 frames
+        out = paddle.signal.overlap_add(paddle.to_tensor(frames), hop_length=2)
+        assert list(out.shape) == [8]
+        expected = np.zeros(8, np.float32)
+        for i in range(3):
+            expected[i * 2:i * 2 + 4] += 1
+        np.testing.assert_allclose(_np(out), expected)
+
+
+class TestSparse:
+    def _coo(self):
+        dense = np.zeros((4, 5), np.float32)
+        dense[0, 1] = 1.0
+        dense[2, 3] = -2.0
+        dense[3, 0] = 0.5
+        idx = np.stack(np.nonzero(dense))
+        vals = dense[tuple(idx)]
+        return dense, paddle.sparse.sparse_coo_tensor(idx, vals, dense.shape)
+
+    def test_create_to_dense(self):
+        dense, sp = self._coo()
+        assert sp.is_sparse_coo()
+        assert sp.nnz() == 3
+        np.testing.assert_allclose(_np(sp.to_dense()), dense)
+
+    def test_coo_csr_roundtrip(self):
+        dense, sp = self._coo()
+        csr = sp.to_sparse_csr()
+        assert csr.is_sparse_csr()
+        np.testing.assert_allclose(_np(csr.to_dense()), dense)
+        coo2 = csr.to_sparse_coo()
+        np.testing.assert_allclose(_np(coo2.to_dense()), dense)
+
+    def test_coalesce_duplicates(self):
+        idx = np.array([[0, 0, 1], [2, 2, 1]])
+        vals = np.array([1.0, 2.0, 3.0], np.float32)
+        sp = paddle.sparse.sparse_coo_tensor(idx, vals, (2, 3)).coalesce()
+        assert sp.nnz() == 2
+        dense = np.zeros((2, 3), np.float32)
+        dense[0, 2] = 3.0
+        dense[1, 1] = 3.0
+        np.testing.assert_allclose(_np(sp.to_dense()), dense)
+
+    def test_unary(self):
+        dense, sp = self._coo()
+        out = paddle.sparse.relu(sp)
+        np.testing.assert_allclose(_np(out.to_dense()), np.maximum(dense, 0))
+        out = paddle.sparse.abs(sp)
+        np.testing.assert_allclose(_np(out.to_dense()), np.abs(dense))
+
+    def test_add_subtract(self):
+        dense, sp = self._coo()
+        dense2 = np.zeros_like(dense)
+        dense2[0, 1] = 3.0
+        dense2[1, 1] = 4.0
+        idx2 = np.stack(np.nonzero(dense2))
+        sp2 = paddle.sparse.sparse_coo_tensor(idx2, dense2[tuple(idx2)],
+                                              dense2.shape)
+        out = paddle.sparse.add(sp, sp2)
+        np.testing.assert_allclose(_np(out.to_dense()), dense + dense2)
+        out = paddle.sparse.subtract(sp, sp2)
+        np.testing.assert_allclose(_np(out.to_dense()), dense - dense2)
+
+    def test_matmul(self):
+        dense, sp = self._coo()
+        rhs = np.random.RandomState(6).randn(5, 7).astype(np.float32)
+        out = paddle.sparse.matmul(sp, paddle.to_tensor(rhs))
+        np.testing.assert_allclose(_np(out), dense @ rhs, rtol=1e-5, atol=1e-5)
+
+    def test_mv(self):
+        dense, sp = self._coo()
+        v = np.random.RandomState(7).randn(5).astype(np.float32)
+        out = paddle.sparse.mv(sp, paddle.to_tensor(v))
+        np.testing.assert_allclose(_np(out), dense @ v, rtol=1e-5, atol=1e-5)
+
+    def test_masked_matmul(self):
+        rng = np.random.RandomState(8)
+        a = rng.randn(4, 6).astype(np.float32)
+        b = rng.randn(6, 5).astype(np.float32)
+        _, mask = self._coo()
+        out = paddle.sparse.masked_matmul(paddle.to_tensor(a),
+                                          paddle.to_tensor(b), mask)
+        full = a @ b
+        mask_dense = _np(mask.to_dense()) != 0
+        np.testing.assert_allclose(_np(out.to_dense()), full * mask_dense,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_softmax(self):
+        dense, sp = self._coo()
+        out = paddle.sparse.nn.functional.softmax(sp)
+        d = _np(out.to_dense())
+        # each active row's active entries sum to 1
+        for r in (0, 2, 3):
+            s = d[r][d[r] != 0].sum() if (d[r] != 0).any() else 1.0
+            np.testing.assert_allclose(s, 1.0, rtol=1e-5)
+
+    def test_matmul_grad(self):
+        dense, sp = self._coo()
+        sp.stop_gradient = False
+        rhs = paddle.to_tensor(
+            np.random.RandomState(9).randn(5, 3).astype(np.float32),
+            stop_gradient=False)
+        out = paddle.sparse.matmul(sp, rhs)
+        out.sum().backward()
+        assert rhs.grad is not None
+        assert sp.values().grad is not None
+        # d(sum)/d(vals[k]) = sum_j rhs[col_k, j]
+        cols = _np(sp.indices())[1]
+        expected = _np(rhs).sum(axis=1)[cols]
+        np.testing.assert_allclose(_np(sp.values().grad), expected,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_transpose_reshape(self):
+        dense, sp = self._coo()
+        out = paddle.sparse.transpose(sp, [1, 0])
+        np.testing.assert_allclose(_np(out.to_dense()), dense.T)
+        out = paddle.sparse.reshape(sp, [2, 10])
+        np.testing.assert_allclose(_np(out.to_dense()), dense.reshape(2, 10))
+
+    def test_sparse_bn(self):
+        _, sp3 = self._coo()
+        # values [nnz, C] sparse 3D tensor: build one
+        idx = np.array([[0, 0, 1], [1, 2, 0]])
+        vals = np.random.RandomState(10).randn(3, 4).astype(np.float32)
+        sp = paddle.sparse.sparse_coo_tensor(idx, vals, (2, 3, 4))
+        bn = paddle.sparse.nn.BatchNorm(4)
+        out = bn(sp)
+        assert out.values().shape[-1] == 4
+
+
+class TestReviewRegressions:
+    def test_ihfft2(self):
+        x = np.random.RandomState(11).randn(4, 8).astype(np.float32)
+        out = paddle.fft.ihfft2(paddle.to_tensor(x))
+        # inverse of hfft2: ihfft last axis then ifft on leading axis
+        expected = np.fft.ifft(np.fft.ihfft(x, axis=-1), axis=0)
+        np.testing.assert_allclose(_np(out), expected, rtol=1e-4, atol=1e-5)
+
+    def test_istft_return_complex_onesided_rejected(self):
+        spec = paddle.to_tensor(np.zeros((65, 17), np.complex64))
+        with pytest.raises(ValueError):
+            paddle.signal.istft(spec, n_fft=128, return_complex=True)
+
+    def test_sparse_add_shape_mismatch_rejected(self):
+        a = paddle.sparse.sparse_coo_tensor([[0], [4]], [1.0], (4, 5))
+        b = paddle.sparse.sparse_coo_tensor([[0], [5]], [2.0], (4, 6))
+        with pytest.raises(ValueError):
+            paddle.sparse.add(a, b)
+
+    def test_sparse_attention_matches_dense(self):
+        rng = np.random.RandomState(12)
+        L, D = 6, 4
+        q = rng.randn(L, D).astype(np.float32)
+        k = rng.randn(L, D).astype(np.float32)
+        v = rng.randn(L, D).astype(np.float32)
+        mask_d = np.ones((L, L), np.float32)
+        idx = np.stack(np.nonzero(mask_d))
+        mask = paddle.sparse.sparse_coo_tensor(idx, mask_d[tuple(idx)], (L, L))
+        out = paddle.sparse.nn.functional.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v), mask)
+        scores = q @ k.T / np.sqrt(D)
+        probs = np.exp(scores - scores.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        np.testing.assert_allclose(_np(out), probs @ v, rtol=1e-4, atol=1e-5)
+
+    def test_sparse_sum_axis_stays_sparse(self):
+        dense = np.zeros((4, 5), np.float32)
+        dense[0, 1], dense[2, 3], dense[2, 1] = 1.0, -2.0, 4.0
+        idx = np.stack(np.nonzero(dense))
+        sp = paddle.sparse.sparse_coo_tensor(idx, dense[tuple(idx)], dense.shape)
+        out = paddle.sparse.sum(sp, axis=-1)
+        assert out.is_sparse_coo()
+        np.testing.assert_allclose(_np(out.to_dense()), dense.sum(-1))
+
+    def test_sparse_conv3d_pattern_is_geometric(self):
+        # one active site; bias must not densify the output pattern
+        idx = np.array([[0], [2], [2], [2]])
+        vals = np.ones((1, 3), np.float32)
+        sp = paddle.sparse.sparse_coo_tensor(idx, vals, (1, 5, 5, 5, 3))
+        conv = paddle.sparse.nn.Conv3D(3, 2, kernel_size=3, padding=1)
+        out = conv(sp)
+        assert out.nnz() <= 27  # receptive reach of one site, not 125
+        subm = paddle.sparse.nn.SubmConv3D(3, 2, kernel_size=3, padding=1)
+        out2 = subm(sp)
+        assert out2.nnz() == 1

@@ -1,0 +1,9 @@
+"""paddle.audio surface. reference: python/paddle/audio/__init__.py
+(features, functional, datasets, backends)."""
+
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+from . import datasets  # noqa: F401
+from . import backends  # noqa: F401
+
+__all__ = ["functional", "features", "datasets", "backends"]

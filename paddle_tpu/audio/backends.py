@@ -1,0 +1,87 @@
+"""Audio IO backends. reference: python/paddle/audio/backends/
+(init_backend.py, wave_backend.py) — stdlib wave file IO, no soundfile dep.
+"""
+
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = ["list_available_backends", "get_current_backend", "set_backend",
+           "load", "save", "info"]
+
+_backend = "wave_backend"
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return _backend
+
+
+def set_backend(backend_name):
+    global _backend
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(f"backend {backend_name} not available")
+    _backend = backend_name
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels, bits_per_sample,
+                 encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath):
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """reference: audio/backends/wave_backend.py load."""
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype).reshape(-1, nch)
+    if normalize:
+        if width == 1:
+            data = (data.astype(np.float32) - 128) / 128.0
+        else:
+            data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    arr = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(arr)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True, encoding="PCM_S",
+         bits_per_sample=16):
+    data = src.numpy() if isinstance(src, Tensor) else np.asarray(src)
+    if channels_first:
+        data = data.T
+    if bits_per_sample == 8:
+        # 8-bit WAV is offset-binary, matching load()'s (x - 128) / 128
+        pcm = np.clip(data * 128.0 + 128.0, 0, 255).astype(np.uint8)
+    else:
+        scale = float(2 ** (bits_per_sample - 1))
+        pcm = np.clip(data * scale, -scale, scale - 1).astype(
+            {16: np.int16, 32: np.int32}[bits_per_sample])
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(data.shape[1] if data.ndim == 2 else 1)
+        f.setsampwidth(bits_per_sample // 8)
+        f.setframerate(sample_rate)
+        f.writeframes(pcm.tobytes())

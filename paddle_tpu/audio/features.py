@@ -1,0 +1,97 @@
+"""Audio feature layers. reference: python/paddle/audio/features/layers.py
+(Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC).
+"""
+
+from __future__ import annotations
+
+from .. import signal as _signal
+from ..framework.core import execute
+from ..nn.layer.layers import Layer
+from . import functional as F
+
+import jax.numpy as jnp
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    """reference: audio/features/layers.py Spectrogram."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = F.get_window(window, self.win_length, dtype=dtype)
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                            window=self.window, center=self.center,
+                            pad_mode=self.pad_mode)
+        return execute(lambda s: jnp.abs(s) ** self.power, spec,
+                       _name="spec_power")
+
+
+class MelSpectrogram(Layer):
+    """reference: audio/features/layers.py MelSpectrogram."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode, dtype)
+        self.fbank = F.compute_fbank_matrix(sr, n_fft, n_mels, f_min,
+                                            f_max, htk, norm, dtype)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)          # [..., n_fft//2+1, frames]
+        return execute(lambda fb, s: jnp.einsum("mf,...ft->...mt", fb, s),
+                       self.fbank, spec, _name="mel_project")
+
+
+class LogMelSpectrogram(Layer):
+    """reference: audio/features/layers.py LogMelSpectrogram."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                  power, center, pad_mode, n_mels, f_min,
+                                  f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return F.power_to_db(self.mel(x), self.ref_value, self.amin,
+                             self.top_db)
+
+
+class MFCC(Layer):
+    """reference: audio/features/layers.py MFCC."""
+
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr, n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        n_mels, f_min, f_max, htk, norm,
+                                        ref_value, amin, top_db, dtype)
+        self.dct = F.create_dct(n_mfcc, n_mels, dtype=dtype)  # [n_mels, n_mfcc]
+
+    def forward(self, x):
+        lm = self.logmel(x)                 # [..., n_mels, frames]
+        return execute(lambda d, s: jnp.einsum("mk,...mt->...kt", d, s),
+                       self.dct, lm, _name="mfcc_dct")

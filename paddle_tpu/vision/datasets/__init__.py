@@ -90,3 +90,58 @@ class Cifar100(Cifar10):
         self.num_classes = 100
         rng = np.random.RandomState(2)
         self.labels = rng.randint(0, 100, len(self.labels)).astype(np.int64)
+
+
+class Flowers(Dataset):
+    """reference: python/paddle/vision/datasets/flowers.py (102 classes).
+    Synthetic deterministic stand-in (zero-egress environment)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        self.transform = transform
+        proto_rng = np.random.RandomState(4321)
+        rng = np.random.RandomState({"train": 0, "valid": 1, "test": 2}.get(mode, 0))
+        n = {"train": 1024, "valid": 256, "test": 256}.get(mode, 1024)
+        self.num_classes = 102
+        self.labels = rng.randint(0, self.num_classes, n).astype(np.int64)
+        base = proto_rng.rand(self.num_classes, 64, 64, 3)
+        self.images = ((base[self.labels] * 0.7 + rng.rand(n, 64, 64, 3) * 0.3)
+                       * 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32).transpose(2, 0, 1) / 255.0
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class VOC2012(Dataset):
+    """reference: python/paddle/vision/datasets/voc2012.py (segmentation).
+    Synthetic: images + integer masks with the same spatial size."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        rng = np.random.RandomState({"train": 0, "valid": 1, "test": 2}.get(mode, 0))
+        n = 256
+        self.images = (rng.rand(n, 64, 64, 3) * 255).astype(np.uint8)
+        self.masks = rng.randint(0, 21, (n, 64, 64)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32).transpose(2, 0, 1) / 255.0
+        return img, self.masks[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+__all__ += ["Flowers", "VOC2012"]

@@ -1,0 +1,181 @@
+"""Vision zoo forward-shape checks + audio feature numerics + text package.
+
+Zoo tests follow the reference's test/legacy_test/test_vision_models.py
+pattern: build at small input, check logits shape (224 inputs are slow on
+CPU, so the deeper nets run at reduced resolution where valid).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models as M
+
+
+def _fwd(model, size=64, classes=10):
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 3, size, size)
+                         .astype(np.float32))
+    model.eval()
+    return model(x)
+
+
+class TestVisionZoo:
+    def test_mobilenet_v1(self):
+        out = _fwd(M.mobilenet_v1(scale=0.25, num_classes=10))
+        assert list(out.shape) == [2, 10]
+
+    def test_mobilenet_v2(self):
+        out = _fwd(M.mobilenet_v2(scale=0.25, num_classes=10))
+        assert list(out.shape) == [2, 10]
+
+    def test_mobilenet_v3(self):
+        out = _fwd(M.mobilenet_v3_small(scale=0.5, num_classes=10))
+        assert list(out.shape) == [2, 10]
+        out = _fwd(M.mobilenet_v3_large(scale=0.35, num_classes=10))
+        assert list(out.shape) == [2, 10]
+
+    def test_vgg11(self):
+        out = _fwd(M.vgg11(num_classes=10))
+        assert list(out.shape) == [2, 10]
+
+    def test_densenet121(self):
+        out = _fwd(M.densenet121(num_classes=10))
+        assert list(out.shape) == [2, 10]
+
+    def test_alexnet(self):
+        out = _fwd(M.alexnet(num_classes=10), size=224)
+        assert list(out.shape) == [2, 10]
+
+    def test_squeezenet(self):
+        out = _fwd(M.squeezenet1_1(num_classes=10), size=64)
+        assert list(out.shape) == [2, 10]
+
+    def test_shufflenet(self):
+        out = _fwd(M.shufflenet_v2_x0_25(num_classes=10))
+        assert list(out.shape) == [2, 10]
+
+    def test_googlenet(self):
+        out, a1, a2 = _fwd(M.googlenet(num_classes=10), size=64)
+        assert list(out.shape) == [2, 10]
+        assert list(a1.shape) == [2, 10]
+
+    def test_inception_v3(self):
+        out = _fwd(M.inception_v3(num_classes=10), size=96)
+        assert list(out.shape) == [2, 10]
+
+    def test_zoo_trains(self):
+        # one SGD step on the smallest net: grads flow through BN/depthwise
+        model = M.mobilenet_v1(scale=0.25, num_classes=4)
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        x = paddle.to_tensor(np.random.rand(2, 3, 32, 32).astype(np.float32))
+        loss = model(x).square().mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+    def test_datasets(self):
+        from paddle_tpu.vision.datasets import Flowers, VOC2012
+        ds = Flowers(mode="train")
+        img, lbl = ds[0]
+        assert img.shape == (3, 64, 64) and 0 <= int(lbl) < 102
+        ds = VOC2012(mode="train")
+        img, mask = ds[0]
+        assert img.shape == (3, 64, 64) and mask.shape == (64, 64)
+
+
+class TestAudio:
+    def test_windows(self):
+        w = paddle.audio.functional.get_window("hann", 64)
+        np.testing.assert_allclose(
+            w.numpy(), np.hanning(65)[:-1], rtol=1e-5, atol=1e-6)
+        for name in ("hamming", "blackman", "boxcar", ("kaiser", 12.0),
+                     ("gaussian", 7.0), "triang", "bartlett"):
+            w = paddle.audio.functional.get_window(name, 32)
+            assert w.shape[0] == 32
+
+    def test_mel_scale_roundtrip(self):
+        hz = 440.0
+        mel = paddle.audio.functional.hz_to_mel(hz)
+        back = paddle.audio.functional.mel_to_hz(mel)
+        np.testing.assert_allclose(back, hz, rtol=1e-4)
+        mel = paddle.audio.functional.hz_to_mel(hz, htk=True)
+        back = paddle.audio.functional.mel_to_hz(mel, htk=True)
+        np.testing.assert_allclose(back, hz, rtol=1e-4)
+
+    def test_fbank_shape_and_coverage(self):
+        fb = paddle.audio.functional.compute_fbank_matrix(16000, 512, n_mels=40)
+        assert list(fb.shape) == [40, 257]
+        assert (fb.numpy() >= 0).all()
+        assert (fb.numpy().sum(axis=1) > 0).all()  # every filter nonempty
+
+    def test_spectrogram_layers(self):
+        x = paddle.to_tensor(
+            np.sin(2 * np.pi * 440 * np.arange(4096) / 16000)
+            .astype(np.float32)[None])
+        spec = paddle.audio.features.Spectrogram(n_fft=256)(x)
+        assert spec.shape[1] == 129
+        mel = paddle.audio.features.MelSpectrogram(sr=16000, n_fft=256,
+                                                   n_mels=32)(x)
+        assert mel.shape[1] == 32
+        logmel = paddle.audio.features.LogMelSpectrogram(sr=16000, n_fft=256,
+                                                         n_mels=32)(x)
+        assert np.isfinite(logmel.numpy()).all()
+        mfcc = paddle.audio.features.MFCC(sr=16000, n_mfcc=13, n_fft=256,
+                                          n_mels=32)(x)
+        assert mfcc.shape[1] == 13
+
+    def test_power_to_db(self):
+        x = paddle.to_tensor(np.array([1.0, 10.0, 100.0], np.float32))
+        db = paddle.audio.functional.power_to_db(x, top_db=None)
+        np.testing.assert_allclose(db.numpy(), [0.0, 10.0, 20.0], atol=1e-4)
+
+    def test_wave_io_roundtrip(self, tmp_path):
+        sr = 8000
+        sig = (0.5 * np.sin(2 * np.pi * 220 * np.arange(800) / sr)
+               ).astype(np.float32)[None]
+        p = str(tmp_path / "t.wav")
+        paddle.audio.backends.save(p, paddle.to_tensor(sig), sr)
+        loaded, sr2 = paddle.audio.backends.load(p)
+        assert sr2 == sr
+        np.testing.assert_allclose(loaded.numpy()[0], sig[0], atol=1e-3)
+        info = paddle.audio.backends.info(p)
+        assert info.sample_rate == sr and info.num_samples == 800
+
+    def test_audio_datasets(self):
+        ds = paddle.audio.datasets.TESS(mode="train")
+        wave, lbl = ds[0]
+        assert wave.ndim == 1 and 0 <= int(lbl) < 7
+
+
+class TestText:
+    def test_viterbi_matches_bruteforce(self):
+        rng = np.random.RandomState(0)
+        B, T, N = 2, 5, 4
+        emis = rng.randn(B, T, N).astype(np.float32)
+        trans = rng.randn(N, N).astype(np.float32)
+        lens = np.full((B,), T, np.int64)
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(emis), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=False)
+        # brute force
+        import itertools
+        for b in range(B):
+            best, best_path = -1e30, None
+            for path in itertools.product(range(N), repeat=T):
+                s = emis[b, 0, path[0]]
+                for t in range(1, T):
+                    s += trans[path[t - 1], path[t]] + emis[b, t, path[t]]
+                if s > best:
+                    best, best_path = s, path
+            np.testing.assert_allclose(scores.numpy()[b], best, rtol=1e-4)
+            assert tuple(paths.numpy()[b]) == best_path
+
+    def test_text_datasets(self):
+        doc, lbl = paddle.text.Imdb(mode="train")[0]
+        assert doc.shape == (100,) and int(lbl) in (0, 1)
+        feats, price = paddle.text.UCIHousing(mode="train")[0]
+        assert feats.shape == (13,)
+        src, trg_in, trg_out = paddle.text.WMT14(mode="train")[0]
+        assert len(src) == 20 and len(trg_in) == 19
+        w, p, l = paddle.text.Conll05st()[0]
+        assert w.shape == (30,) and l.shape == (30,)

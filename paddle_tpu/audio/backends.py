@@ -57,8 +57,14 @@ def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
         f.setpos(frame_offset)
         n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
         raw = f.readframes(n)
-    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
-    data = np.frombuffer(raw, dtype).reshape(-1, nch)
+    if width == 3:
+        # 24-bit PCM: assemble little-endian triples into int32
+        b = np.frombuffer(raw, np.uint8).reshape(-1, 3).astype(np.int32)
+        data = (b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16))
+        data = np.where(data >= 1 << 23, data - (1 << 24), data).reshape(-1, nch)
+    else:
+        dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+        data = np.frombuffer(raw, dtype).reshape(-1, nch)
     if normalize:
         if width == 1:
             data = (data.astype(np.float32) - 128) / 128.0
@@ -77,8 +83,10 @@ def save(filepath, src, sample_rate, channels_first=True, encoding="PCM_S",
         # 8-bit WAV is offset-binary, matching load()'s (x - 128) / 128
         pcm = np.clip(data * 128.0 + 128.0, 0, 255).astype(np.uint8)
     else:
+        # clamp in float64 — float32 cannot represent 2^31 - 1 exactly
         scale = float(2 ** (bits_per_sample - 1))
-        pcm = np.clip(data * scale, -scale, scale - 1).astype(
+        pcm = np.clip(data.astype(np.float64) * scale, -scale,
+                      scale - 1).astype(
             {16: np.int16, 32: np.int32}[bits_per_sample])
     with _wave.open(filepath, "wb") as f:
         f.setnchannels(data.shape[1] if data.ndim == 2 else 1)

@@ -25,6 +25,7 @@ from .collective import (  # noqa: F401
     reduce_scatter, broadcast, reduce, scatter, gather, send, recv, isend,
     irecv, ReduceOp, P2POp, batch_isend_irecv, split, stream,
 )
+from .store import Store, TCPStore  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401

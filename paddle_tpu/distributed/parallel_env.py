@@ -60,9 +60,28 @@ def init_parallel_env():
         pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=nproc, process_id=pid)
+        # host-level KV store for out-of-band coordination (checkpoint
+        # election, health heartbeats) — the native TCPStore on master+1:
+        # reference behavior of parallel.py:1134
+        try:
+            from .store import TCPStore
+            host, port = coord.rsplit(":", 1)
+            global _store
+            _store = TCPStore(host, int(port) + 1, is_master=(pid == 0),
+                              world_size=nproc, timeout=300)
+        except Exception:  # noqa: BLE001 — store is auxiliary, not fatal
+            _store = None
     _initialized = True
     _groups[0] = Group(list(range(get_world_size())), 0)
     return ParallelEnv()
+
+
+_store = None
+
+
+def get_store():
+    """The host-coordination TCPStore (None on single-host runs)."""
+    return _store
 
 
 def is_initialized():

@@ -1,0 +1,226 @@
+"""Rendezvous stores. reference: paddle/phi/core/distributed/store/
+(store.h Store base, tcp_store.h:121 TCPStore) and the pybind surface
+core.TCPStore used by python/paddle/distributed/parallel.py:1134.
+
+The server/client are native C++ (native/tcp_store.cc) bound via ctypes;
+blocking waits happen server-side on a condvar, exactly like the reference
+(no client polling). A pure-Python in-process fallback covers environments
+without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import time
+
+__all__ = ["Store", "TCPStore"]
+
+
+class Store:
+    """Abstract KV store API (reference: store/store.h)."""
+
+    def set(self, key: str, value):
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def add(self, key: str, amount: int) -> int:
+        raise NotImplementedError
+
+    def wait(self, key: str):
+        raise NotImplementedError
+
+
+class _PyStore:
+    """In-process fallback with the same blocking semantics."""
+
+    def __init__(self):
+        self._data = {}
+        self._cond = threading.Condition()
+
+    def set(self, key, value):
+        with self._cond:
+            self._data[key] = bytes(value)
+            self._cond.notify_all()
+
+    def get(self, key, timeout_s):
+        with self._cond:
+            ok = self._cond.wait_for(lambda: key in self._data, timeout_s)
+            if not ok:
+                raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+            return self._data[key]
+
+    def add(self, key, amount):
+        with self._cond:
+            cur = int(self._data.get(key, b"0") or b"0")
+            cur += int(amount)
+            self._data[key] = str(cur).encode()
+            self._cond.notify_all()
+            return cur
+
+    def wait(self, key, timeout_s):
+        with self._cond:
+            if not self._cond.wait_for(lambda: key in self._data, timeout_s):
+                raise TimeoutError(f"TCPStore.wait({key!r}) timed out")
+
+    def delete_key(self, key):
+        with self._cond:
+            return self._data.pop(key, None) is not None
+
+    def check(self, key):
+        with self._cond:
+            return key in self._data
+
+    def num_keys(self):
+        with self._cond:
+            return len(self._data)
+
+
+_py_stores = {}  # (host, port) -> _PyStore, for the in-process fallback
+
+
+class TCPStore(Store):
+    """reference: paddle/phi/core/distributed/store/tcp_store.h:121.
+
+    The master rank (is_master=True) starts the native server; every rank
+    (including the master) connects a client. All waits block server-side.
+    """
+
+    def __init__(self, host="127.0.0.1", port=6170, is_master=False,
+                 world_size=1, timeout=900, stop_check_timeout=None):
+        from .. import _native
+        self._host = host
+        self._port = int(port)
+        self._timeout_s = timeout if timeout and timeout > 0 else 900
+        self._world_size = world_size
+        self._server = None
+        self._client = None
+        self._native = _native.available
+        if not self._native:
+            key = (host, self._port)
+            if is_master:
+                _py_stores[key] = _PyStore()
+            elif key not in _py_stores:
+                # the fallback is in-process only: a master in another
+                # process can never appear here, so fail fast
+                raise RuntimeError(
+                    "TCPStore: native runtime unavailable and no in-process "
+                    "master for this (host, port); the pure-Python fallback "
+                    "cannot rendezvous across processes")
+            self._store = _py_stores[key]
+            return
+        lib = _native.lib()
+        if is_master:
+            self._server = lib.pt_store_server_start(self._port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {self._port}")
+            self._port = lib.pt_store_server_port(self._server)
+        self._client = lib.pt_store_client_new(
+            host.encode(), self._port, int(self._timeout_s * 1000))
+        if not self._client:
+            if self._server:
+                lib.pt_store_server_stop(self._server)
+            raise RuntimeError(
+                f"TCPStore: cannot connect to {host}:{self._port}")
+
+    # -- API ---------------------------------------------------------------
+    @property
+    def port(self):
+        return self._port
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        if not self._native:
+            return self._store.set(key, value)
+        from .. import _native
+        buf = (ctypes.c_uint8 * len(value)).from_buffer_copy(value) \
+            if value else (ctypes.c_uint8 * 1)()
+        rc = _native.lib().pt_store_set(self._client, key.encode(), buf,
+                                        len(value))
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.set({key!r}) failed")
+
+    def get(self, key):
+        if not self._native:
+            return self._store.get(key, self._timeout_s)
+        from .. import _native
+        lib = _native.lib()
+        out_len = ctypes.c_int64()
+        ptr = lib.pt_store_get(self._client, key.encode(),
+                               int(self._timeout_s * 1000),
+                               ctypes.byref(out_len))
+        if not ptr or out_len.value < 0:
+            raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+        try:
+            return ctypes.string_at(ptr, out_len.value)
+        finally:
+            lib.pt_buffer_free(ptr)
+
+    def add(self, key, amount):
+        if not self._native:
+            return self._store.add(key, amount)
+        from .. import _native
+        out = ctypes.c_int64()
+        rc = _native.lib().pt_store_add(self._client, key.encode(),
+                                        int(amount), ctypes.byref(out))
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.add({key!r}) failed")
+        return out.value
+
+    def wait(self, key):
+        if not self._native:
+            return self._store.wait(key, self._timeout_s)
+        from .. import _native
+        rc = _native.lib().pt_store_wait(self._client, key.encode(),
+                                         int(self._timeout_s * 1000))
+        if rc != 0:
+            raise TimeoutError(f"TCPStore.wait({key!r}) timed out")
+
+    def delete_key(self, key):
+        if not self._native:
+            return self._store.delete_key(key)
+        from .. import _native
+        return _native.lib().pt_store_delete(self._client, key.encode()) == 0
+
+    def check(self, key):
+        if not self._native:
+            return self._store.check(key)
+        from .. import _native
+        return _native.lib().pt_store_check(self._client, key.encode()) == 1
+
+    def num_keys(self):
+        if not self._native:
+            return self._store.num_keys()
+        from .. import _native
+        return _native.lib().pt_store_num_keys(self._client)
+
+    def barrier(self, tag="barrier"):
+        """All world_size ranks arrive before any leaves. Reusable: each
+        call on a tag advances a local round so keys never collide across
+        rounds (every rank must call barrier the same number of times)."""
+        rounds = getattr(self, "_barrier_rounds", None)
+        if rounds is None:
+            rounds = self._barrier_rounds = {}
+        r = rounds.get(tag, 0)
+        rounds[tag] = r + 1
+        count = self.add(f"__barrier/{tag}/{r}/count", 1)
+        if count == self._world_size:
+            self.set(f"__barrier/{tag}/{r}/done", b"1")
+        self.wait(f"__barrier/{tag}/{r}/done")
+
+    def __del__(self):
+        try:
+            from .. import _native
+            if self._native and _native.available:
+                lib = _native.lib()
+                if self._client:
+                    lib.pt_store_client_free(self._client)
+                    self._client = None
+                if self._server:
+                    lib.pt_store_server_stop(self._server)
+                    self._server = None
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

@@ -65,6 +65,9 @@ from . import signal  # noqa: F401
 from . import sparse  # noqa: F401
 from . import audio  # noqa: F401
 from . import text  # noqa: F401
+from . import inference  # noqa: F401
+from . import profiler  # noqa: F401
+from . import quantization  # noqa: F401
 from .framework import io_file as _io_file
 from .framework.io_file import save, load  # noqa: F401
 from .framework.param_attr import ParamAttr, L1Decay, L2Decay  # noqa: F401

@@ -1,0 +1,207 @@
+"""Inference engine. reference: python/paddle/inference/ re-exporting
+Config/Predictor from libpaddle (C++ AnalysisPredictor,
+paddle/fluid/inference/api/analysis_predictor.h:105).
+
+TPU-native: the inference "program" is the StableHLO artifact produced by
+jit.save; "analysis passes" (fusion, mixed precision convert —
+paddle/fluid/inference/analysis/passes/) are XLA's job at AOT-compile time.
+Config keeps the reference's knob surface; Predictor keeps the
+zero-copy handle API (get_input_handle/run/get_output_handle).
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PrecisionType", "PlaceType", "get_version"]
+
+
+def get_version():
+    from .. import __version__
+    return __version__
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM = 3
+
+
+class Config:
+    """reference: paddle/fluid/inference/api/paddle_analysis_config.h.
+    Knobs that don't apply on TPU (TensorRT, MKLDNN…) are accepted and
+    recorded so reference code runs unchanged."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        self._prefix = (prog_file[:-len(".pdmodel")]
+                        if prog_file and prog_file.endswith(".pdmodel")
+                        else prog_file)
+        self._precision = PrecisionType.Float32
+        self._device = "tpu"
+        self._enable_memory_optim = True
+        self._flags = {}
+
+    def set_model(self, prog_file, params_file=None):
+        self._prefix = (prog_file[:-len(".pdmodel")]
+                        if prog_file.endswith(".pdmodel") else prog_file)
+
+    def model_dir(self):
+        return self._prefix
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=None):
+        self._device = "tpu"  # accelerator == TPU in this build
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device == "tpu"
+
+    def enable_memory_optim(self, flag=True):
+        self._enable_memory_optim = flag
+
+    def enable_mkldnn(self):
+        self._flags["mkldnn"] = True
+
+    def enable_tensorrt_engine(self, **kwargs):
+        self._flags["tensorrt"] = kwargs  # recorded; XLA owns fusion on TPU
+
+    def switch_ir_optim(self, flag=True):
+        self._flags["ir_optim"] = flag
+
+    def switch_use_feed_fetch_ops(self, flag=False):
+        pass
+
+    def switch_specify_input_names(self, flag=True):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._flags["cpu_threads"] = n
+
+    def enable_low_precision(self, precision=PrecisionType.Bfloat16):
+        self._precision = precision
+
+    def summary(self):
+        return {"model": self._prefix, "device": self._device,
+                "precision": self._precision, **self._flags}
+
+
+class Tensor:
+    """Zero-copy I/O handle. reference:
+    paddle/fluid/inference/api/paddle_tensor.h ZeroCopyTensor."""
+
+    def __init__(self, name, shape=None, dtype=np.float32):
+        self._name = name
+        self._value = None
+        self._shape = shape
+        self._dtype = dtype
+
+    def name(self):
+        return self._name
+
+    def copy_from_cpu(self, data):
+        self._value = np.ascontiguousarray(data)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def reshape(self, shape):
+        self._shape = tuple(shape)
+
+    def shape(self):
+        if self._value is not None:
+            return list(self._value.shape)
+        return list(self._shape or [])
+
+    def type(self):
+        return self._dtype
+
+
+class Predictor:
+    """reference: paddle/fluid/inference/api/paddle_inference_api.h
+    Predictor over an AOT-compiled StableHLO program."""
+
+    def __init__(self, config: Config):
+        self._config = config
+        prefix = config._prefix
+        with open(prefix + ".pdiparams", "rb") as f:
+            self._params = pickle.load(f)
+        with open(prefix + ".pdmodel", "rb") as f:
+            meta = pickle.load(f)
+        if not meta.get("stablehlo"):
+            raise ValueError(
+                f"{prefix}.pdmodel holds no serialized program; re-export "
+                "with paddle_tpu.jit.save(layer, path, input_spec=[...])")
+        self._exported = jax.export.deserialize(meta["stablehlo"])
+        self._input_spec = meta.get("input_spec", [])
+        self._input_names = [f"x{i}" for i in range(len(self._input_spec))]
+        self._inputs = {n: Tensor(n, shape=tuple(s[0]), dtype=s[1])
+                        for n, s in zip(self._input_names, self._input_spec)}
+        self._outputs = []
+        # enable_low_precision note: the serialized program's calling
+        # convention pins param/input dtypes, so post-export casting is
+        # invalid. On TPU, f32 matmuls already execute on the MXU with
+        # bf16 passes (XLA default precision), which is the effect the
+        # reference's mixed-precision convert pass targets.
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def run(self, inputs=None):
+        if inputs is not None:  # list-style API
+            arrs = [np.asarray(getattr(x, "_value", x)) for x in inputs]
+        else:
+            arrs = [self._inputs[n]._value for n in self._input_names]
+            if any(a is None for a in arrs):
+                missing = [n for n in self._input_names
+                           if self._inputs[n]._value is None]
+                raise ValueError(f"inputs not set: {missing}")
+        out = self._exported.call(self._params, *arrs)
+        flat = jax.tree_util.tree_leaves(out)
+        self._outputs = []
+        for i, o in enumerate(flat):
+            t = Tensor(f"out{i}")
+            t._value = np.asarray(o)
+            self._outputs.append(t)
+        if inputs is not None:
+            return self._outputs
+        return True
+
+    def get_output_names(self):
+        return [t.name() for t in self._outputs]
+
+    def get_output_handle(self, name):
+        for t in self._outputs:
+            if t.name() == name:
+                return t
+        if not self._outputs:
+            raise RuntimeError("no outputs yet — call run() first")
+        raise KeyError(name)
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    """reference: paddle_infer::CreatePredictor (SURVEY.md §3.5)."""
+    return Predictor(config)

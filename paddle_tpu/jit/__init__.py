@@ -252,11 +252,18 @@ class TranslatedLayer:
 
 
 def save(layer, path, input_spec=None, **configs):
-    """Serialize params + a config stub (full program serialization comes with
-    the StableHLO export path)."""
+    """Serialize an inference program: params (.pdiparams) + the traced,
+    XLA-portable StableHLO program (.pdmodel via jax.export).
+
+    reference: python/paddle/jit/api.py save — where the reference serializes
+    a PIR program (paddle/fluid/pir/serialize_deserialize/), the TPU-native
+    artifact is StableHLO, XLA's stable exchange format: it reloads on any
+    future jax/XLA and runs on TPU without the model class.
+    """
     import os
     import pickle
     import numpy as np
+    from ..framework import dtypes as _dt
 
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     state = {}
@@ -265,20 +272,57 @@ def save(layer, path, input_spec=None, **configs):
             state[k] = np.asarray(v._data)
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(state, f)
+
     meta = {"class": type(layer).__name__,
-            "input_spec": [(s.shape, str(s.dtype)) for s in (input_spec or [])]}
+            "input_spec": [(tuple(s.shape), str(s.dtype))
+                           for s in (input_spec or [])],
+            "stablehlo": None}
+    if input_spec:
+        from ..parallel.functional import functional_call
+        was_training = getattr(layer, "training", False)
+        if hasattr(layer, "eval"):
+            layer.eval()
+
+        def fwd(params, *inputs):
+            return functional_call(layer, params, *inputs)
+
+        try:
+            arg_specs = [jax.ShapeDtypeStruct(tuple(s.shape),
+                                              _dt.convert_dtype(s.dtype))
+                         for s in input_spec]
+            params_spec = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                           for k, v in state.items()}
+            exported = jax.export.export(jax.jit(fwd))(params_spec, *arg_specs)
+            meta["stablehlo"] = exported.serialize()
+        finally:
+            if was_training and hasattr(layer, "train"):
+                layer.train()
     with open(path + ".pdmodel", "wb") as f:
         pickle.dump(meta, f)
 
 
 def load(path, **configs):
+    """Load a jit.save artifact as a callable TranslatedLayer (runs the
+    serialized StableHLO program when present)."""
     import pickle
     with open(path + ".pdiparams", "rb") as f:
         state = pickle.load(f)
-    def fn(*args):
-        raise RuntimeError(
-            "jit.load returns parameters only in this build; re-instantiate "
-            "the model class and call set_state_dict")
+    with open(path + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    if meta.get("stablehlo"):
+        exported = jax.export.deserialize(meta["stablehlo"])
+
+        def fn(*args):
+            arrs = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                    for a in args]
+            out = exported.call(state, *arrs)
+            return jax.tree_util.tree_map(Tensor, out)
+    else:
+        def fn(*args):
+            raise RuntimeError(
+                "this artifact was saved without input_spec (params only); "
+                "re-instantiate the model class and call set_state_dict")
     tl = TranslatedLayer(fn, state)
     tl.state_dict = lambda: state
+    tl._input_spec = meta.get("input_spec", [])
     return tl

@@ -1,0 +1,325 @@
+"""Quantization (QAT + PTQ). reference: python/paddle/quantization/
+(config.py QuantConfig, qat.py QAT, ptq.py PTQ, observers/, quanters/).
+
+TPU-native: "int8 kernels" are simulated-quant (quant-dequant) graphs — XLA
+fuses the scale/round/clip chain into the surrounding matmul, and the
+straight-through estimator makes QAT differentiable. Observers collect
+ranges in eager mode; convert() freezes scales into the layer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, execute
+from ..nn.layer.layers import Layer
+from .. import nn
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "BaseQuanter", "BaseObserver",
+           "AbsmaxObserver", "EMAObserver", "FakeQuanterWithAbsMaxObserver",
+           "quanted_layers"]
+
+
+def _fake_quant(x, scale, bits=8):
+    """Quant-dequant with straight-through gradient."""
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) * s / qmax
+    return x + jax.lax.stop_gradient(q - x)
+
+
+# ---------------------------------------------------------------------------
+# observers / quanters
+# ---------------------------------------------------------------------------
+
+class BaseObserver(Layer):
+    """reference: python/paddle/quantization/factory.py ObserverFactory."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self.register_buffer("_scale", Tensor(jnp.zeros((), jnp.float32)))
+
+    def scales(self):
+        return self._scale
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1
+
+    def _observe(self, x):
+        raise NotImplementedError
+
+    def forward(self, x):
+        self._observe(x)
+        return x
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running max of |x|. reference: quantization/observers/abs_max.py."""
+
+    def _observe(self, x):
+        from ..framework.core import buffer_update
+        cur = jnp.max(jnp.abs(x._data)).astype(jnp.float32)
+        buffer_update(self._scale, jnp.maximum(self._scale._data, cur))
+
+
+class EMAObserver(BaseObserver):
+    """EMA of batch absmax. reference: observers/emd? (mse/ema family)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__(quant_bits)
+        self._rate = moving_rate
+
+    def _observe(self, x):
+        from ..framework.core import buffer_update
+        cur = jnp.max(jnp.abs(x._data)).astype(jnp.float32)
+        prev = self._scale._data
+        new = jnp.where(prev == 0, cur, self._rate * prev + (1 - self._rate) * cur)
+        buffer_update(self._scale, new)
+
+
+class BaseQuanter(Layer):
+    pass
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """QAT quanter: observe absmax (EMA) + fake-quant with STE.
+    reference: quantization/quanters/abs_max.py
+    FakeQuanterWithAbsMaxObserverLayer."""
+
+    def __init__(self, moving_rate=0.9, quant_bits=8, dtype="float32"):
+        super().__init__()
+        self._observer = EMAObserver(quant_bits, moving_rate)
+        self._quant_bits = quant_bits
+
+    def scales(self):
+        return self._observer.scales()
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def forward(self, x):
+        if self.training:
+            self._observer._observe(x)
+        scale = self._observer._scale._data
+        return execute(lambda a: _fake_quant(a, scale, self._quant_bits), x,
+                       _name="fake_quant")
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+class _SingleLayerConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+
+
+class QuantConfig:
+    """reference: python/paddle/quantization/config.py QuantConfig."""
+
+    def __init__(self, activation=None, weight=None):
+        self._global = _SingleLayerConfig(activation, weight)
+        self._layer_configs = []   # (predicate, config)
+        self._type_configs = []    # (layer_type, config)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_configs.append(
+                (l, _SingleLayerConfig(activation, weight)))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = (layer_type if isinstance(layer_type, (list, tuple))
+                 else [layer_type])
+        for t in types:
+            self._type_configs.append((t, _SingleLayerConfig(activation, weight)))
+
+    def _config_for(self, layer):
+        for l, cfg in self._layer_configs:
+            if layer is l:
+                return cfg
+        for t, cfg in self._type_configs:
+            if isinstance(layer, t):
+                return cfg
+        if self._global.activation or self._global.weight:
+            return self._global
+        return None
+
+
+def _make(factory):
+    return factory() if callable(factory) else factory
+
+
+# ---------------------------------------------------------------------------
+# quantized layer wrappers
+# ---------------------------------------------------------------------------
+
+class _QuantedBase(Layer):
+    """Quanter attrs are set only when present — assigning None into
+    __dict__ would shadow later sublayer registration in Layer.__setattr__."""
+
+    def __init__(self, inner, cfg):
+        super().__init__()
+        self._inner = inner
+        if cfg.weight:
+            self.weight_quanter = _make(cfg.weight)
+        if cfg.activation:
+            self.activation_quanter = _make(cfg.activation)
+
+    @property
+    def _wq(self):
+        return getattr(self, "weight_quanter", None)
+
+    @property
+    def _aq(self):
+        return getattr(self, "activation_quanter", None)
+
+
+class QuantedLinear(_QuantedBase):
+    """reference: python/paddle/nn/quant/qat/linear.py QuantedLinear."""
+
+    def forward(self, x):
+        w = self._inner.weight
+        if self._wq is not None:
+            w = self._wq(w)
+        if self._aq is not None:
+            x = self._aq(x)
+        from ..nn import functional as F
+        return F.linear(x, w, self._inner.bias)
+
+
+class QuantedConv2D(_QuantedBase):
+    def forward(self, x):
+        inner = self._inner
+        w = inner.weight
+        if self._wq is not None:
+            w = self._wq(w)
+        if self._aq is not None:
+            x = self._aq(x)
+        from ..nn import functional as F
+        return F.conv2d(x, w, inner.bias, inner._stride, inner._padding,
+                        inner._dilation, inner._groups, inner._data_format)
+
+
+quanted_layers = {nn.Linear: QuantedLinear, nn.Conv2D: QuantedConv2D}
+
+
+def _wrap_model(model, config, wrap, original=None):
+    """Walk `model` (possibly a deepcopy) in lockstep with `original` so
+    identity-based add_layer_config entries still resolve after copying."""
+    original = original if original is not None else model
+    for name, sub in list(model._sub_layers.items()):
+        orig_sub = original._sub_layers.get(name, sub)
+        cfg = config._config_for(orig_sub)
+        cls = quanted_layers.get(type(sub))
+        if cfg is not None and cls is not None:
+            model._sub_layers[name] = wrap(cls, sub, cfg)
+        else:
+            _wrap_model(sub, config, wrap, orig_sub)
+    return model
+
+
+# ---------------------------------------------------------------------------
+# QAT / PTQ drivers
+# ---------------------------------------------------------------------------
+
+class QAT:
+    """reference: python/paddle/quantization/qat.py QAT."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        original = model
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        return _wrap_model(model, self._config,
+                           lambda cls, sub, cfg: cls(sub, cfg),
+                           original=original)
+
+    def convert(self, model, inplace=False):
+        """Freeze observers (stop updating scales) for export."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        model.eval()
+        return model
+
+
+class PTQ:
+    """reference: python/paddle/quantization/ptq.py PTQ — insert observers,
+    calibrate with sample data, then convert() freezes scales into
+    fake-quant layers."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        original = model
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        class _Observed(Layer):
+            def __init__(self, inner, cfg):
+                super().__init__()
+                self._inner = inner
+                self.act_observer = _make(cfg.activation) if cfg.activation else None
+                self.w_observer = _make(cfg.weight) if cfg.weight else None
+                if self.w_observer is not None:
+                    self.w_observer(inner.weight)  # weights are static
+
+            def forward(self, x):
+                if self.act_observer is not None:
+                    x = self.act_observer(x)
+                return self._inner(x)
+
+        return _wrap_model(model, self._config,
+                           lambda cls, sub, cfg: _Observed(sub, cfg),
+                           original=original)
+
+    def convert(self, model, inplace=False):
+        """Replace observed layers with fake-quant layers using the
+        calibrated scales."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def walk(m):
+            for name, sub in list(m._sub_layers.items()):
+                if type(sub).__name__ == "_Observed":
+                    inner = sub._inner
+                    cls = quanted_layers.get(type(inner))
+                    cfg = _SingleLayerConfig(None, None)
+                    q = cls(inner, cfg)
+                    if sub.w_observer is not None:
+                        fq = FakeQuanterWithAbsMaxObserver(
+                            quant_bits=sub.w_observer.bit_length())
+                        from ..framework.core import buffer_update
+                        buffer_update(fq._observer._scale,
+                                      sub.w_observer._scale._data)
+                        fq.eval()
+                        q.weight_quanter = fq
+                    if sub.act_observer is not None:
+                        fq = FakeQuanterWithAbsMaxObserver(
+                            quant_bits=sub.act_observer.bit_length())
+                        from ..framework.core import buffer_update
+                        buffer_update(fq._observer._scale,
+                                      sub.act_observer._scale._data)
+                        fq.eval()
+                        q.activation_quanter = fq
+                    m._sub_layers[name] = q
+                else:
+                    walk(sub)
+
+        walk(model)
+        model.eval()
+        return model

@@ -1,0 +1,193 @@
+"""Quantization QAT/PTQ, inference Predictor over StableHLO artifacts,
+profiler state machine + timers.
+
+Reference patterns: test/quantization/test_quant_aware.py style numeric
+sanity; test/cpp/inference predictor IO contract.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+
+
+class TestQuantization:
+    def test_qat_quantize_and_train(self):
+        from paddle_tpu.quantization import (QAT, QuantConfig,
+                                             FakeQuanterWithAbsMaxObserver)
+        paddle.seed(0)
+        model = Net()
+        cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver,
+                          weight=FakeQuanterWithAbsMaxObserver)
+        qmodel = QAT(cfg).quantize(model)
+        # quantized layers replaced
+        names = [type(l).__name__ for l in qmodel._sub_layers.values()]
+        assert "QuantedLinear" in names
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(8, 16).astype(np.float32))
+        qmodel.train()
+        out = qmodel(x)
+        loss = out.square().mean()
+        loss.backward()
+        grads = [p.grad for p in qmodel.parameters() if p.grad is not None]
+        assert grads, "QAT model must be trainable (STE gradients)"
+        # output close to float model but not identical (fake-quant noise)
+        model.eval(); qmodel.eval()
+        ref = model(x).numpy()
+        got = qmodel(x).numpy()
+        assert np.abs(ref - got).max() < 0.5
+        assert not np.array_equal(ref, got)
+
+    def test_ptq_calibrate_convert(self):
+        from paddle_tpu.quantization import PTQ, QuantConfig, AbsmaxObserver
+        paddle.seed(1)
+        model = Net()
+        cfg = QuantConfig(activation=AbsmaxObserver, weight=AbsmaxObserver)
+        ptq = PTQ(cfg)
+        observed = ptq.quantize(model)
+        rng = np.random.RandomState(1)
+        for _ in range(4):  # calibration passes
+            observed(paddle.to_tensor(rng.randn(8, 16).astype(np.float32)))
+        converted = ptq.convert(observed)
+        x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+        model.eval()
+        ref = model(x).numpy()
+        got = converted(x).numpy()
+        assert np.isfinite(got).all()
+        assert np.abs(ref - got).max() < 0.5
+        # scales were calibrated (nonzero)
+        q = converted._sub_layers["fc1"]
+        assert float(q.weight_quanter.scales().numpy()) > 0
+
+
+class TestInference:
+    def test_jit_save_predictor_roundtrip(self, tmp_path):
+        from paddle_tpu import inference
+        paddle.seed(2)
+        model = Net()
+        model.eval()
+        x = np.random.RandomState(3).randn(4, 16).astype(np.float32)
+        ref = model(paddle.to_tensor(x)).numpy()
+        prefix = str(tmp_path / "model")
+        paddle.jit.save(model, prefix,
+                        input_spec=[paddle.jit.InputSpec([4, 16], "float32")])
+        assert os.path.exists(prefix + ".pdmodel")
+        assert os.path.exists(prefix + ".pdiparams")
+
+        config = inference.Config(prefix)
+        predictor = inference.create_predictor(config)
+        names = predictor.get_input_names()
+        h = predictor.get_input_handle(names[0])
+        h.copy_from_cpu(x)
+        predictor.run()
+        out = predictor.get_output_handle(
+            predictor.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_jit_load_translated_layer(self, tmp_path):
+        paddle.seed(4)
+        model = Net()
+        model.eval()
+        x = np.random.RandomState(5).randn(2, 16).astype(np.float32)
+        ref = model(paddle.to_tensor(x)).numpy()
+        prefix = str(tmp_path / "m2")
+        paddle.jit.save(model, prefix,
+                        input_spec=[paddle.jit.InputSpec([2, 16], "float32")])
+        loaded = paddle.jit.load(prefix)
+        out = loaded(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+class TestProfiler:
+    def test_scheduler_states(self):
+        from paddle_tpu.profiler import ProfilerState, make_scheduler
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=1,
+                               skip_first=1)
+        states = [sched(i) for i in range(6)]
+        assert states[0] == ProfilerState.CLOSED     # skip_first
+        assert states[1] == ProfilerState.CLOSED
+        assert states[2] == ProfilerState.READY
+        assert states[3] == ProfilerState.RECORD
+        assert states[4] == ProfilerState.RECORD_AND_RETURN
+        assert states[5] == ProfilerState.CLOSED     # repeat exhausted
+
+    def test_record_event_and_summary(self):
+        from paddle_tpu import profiler
+        with profiler.RecordEvent("unit_test_range"):
+            _ = paddle.to_tensor(np.ones((4, 4), np.float32)).sum()
+        p = profiler.Profiler(timer_only=True)
+        p.start()
+        for i in range(3):
+            with profiler.RecordEvent("unit_test_range"):
+                pass
+            p.step(num_samples=8)
+        info = p.step_info()
+        assert "ips" in info
+        table = p.summary()
+        assert "unit_test_range" in table
+        p.stop()
+
+    def test_benchmark_timer(self):
+        from paddle_tpu.profiler import benchmark
+        b = benchmark()
+        b.begin()
+        for _ in range(5):
+            b.step(num_samples=4)
+        assert "ips" in b.step_info()
+
+
+class TestReviewRegressions:
+    def test_config_pdmodel_suffix(self, tmp_path):
+        from paddle_tpu import inference
+        paddle.seed(6)
+        model = Net(); model.eval()
+        prefix = str(tmp_path / "m3")
+        paddle.jit.save(model, prefix,
+                        input_spec=[paddle.jit.InputSpec([1, 16], "float32")])
+        pred = inference.create_predictor(inference.Config(prefix + ".pdmodel"))
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.copy_from_cpu(np.zeros((1, 16), np.float32))
+        pred.run()
+        with pytest.raises(RuntimeError):
+            inference.Predictor(inference.Config(prefix)).get_output_handle("out0")
+
+    def test_layer_config_survives_deepcopy(self):
+        from paddle_tpu.quantization import (QAT, QuantConfig,
+                                             FakeQuanterWithAbsMaxObserver)
+        model = Net()
+        cfg = QuantConfig()
+        cfg.add_layer_config(model.fc1, weight=FakeQuanterWithAbsMaxObserver)
+        q = QAT(cfg).quantize(model)   # default inplace=False (deepcopy)
+        assert type(q._sub_layers["fc1"]).__name__ == "QuantedLinear"
+        assert type(q._sub_layers["fc2"]).__name__ == "Linear"
+
+    def test_chrome_tracing_dir_used(self, tmp_path):
+        from paddle_tpu import profiler
+        d = str(tmp_path / "trace_out")
+        handler = profiler.export_chrome_tracing(d)
+        p = profiler.Profiler(on_trace_ready=handler, timer_only=True)
+        assert p._log_dir == d
+
+    def test_jit_save_restores_train_mode(self, tmp_path):
+        model = Net()
+        model.train()
+        class Bad:
+            shape = (None,)   # invalid spec triggers export failure
+            dtype = "float32"
+        with pytest.raises(Exception):
+            paddle.jit.save(model, str(tmp_path / "bad"), input_spec=[Bad()])
+        assert model.training is True

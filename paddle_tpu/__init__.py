@@ -108,3 +108,4 @@ class LazyGuard:
 
     def __exit__(self, *a):
         return False
+from . import geometric  # noqa: F401

@@ -30,7 +30,8 @@ from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
-from .launch_mod import launch, spawn  # noqa: F401
+from .launch import launch, spawn  # noqa: F401
+from .watchdog import Watchdog, enable_comm_watchdog  # noqa: F401
 
 
 def get_mesh():

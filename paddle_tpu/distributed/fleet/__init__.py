@@ -265,3 +265,4 @@ distributed_optimizer = fleet.distributed_optimizer
 worker_num = lambda: fleet.worker_num
 worker_index = fleet.worker_index
 is_first_worker = fleet.is_first_worker
+from . import elastic  # noqa: F401

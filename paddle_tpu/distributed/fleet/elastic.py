@@ -1,0 +1,172 @@
+"""Elastic membership manager. reference:
+python/paddle/distributed/fleet/elastic/manager.py:125 ElasticManager —
+etcd node registry with leases + heartbeats (:218-260), membership watch
+(:248), restart-on-change (elastic/collective.py); launcher flags
+--nnodes N:M, --max_restart (launch/main.py:38-97).
+
+TPU-native: the registry rides the native TCPStore (native/tcp_store.cc)
+instead of etcd — same lease/heartbeat/watch semantics. On TPU pods the
+actual node replacement is done by the platform (GKE/TPU VM autoscaler);
+this manager detects membership change, decides GOOD/INCOMPLETE/RESTART,
+and triggers the local restart callback so training resumes from the last
+checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["ElasticStatus", "ElasticManager"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """
+    em = ElasticManager(store, node_id="host0", np_range=(2, 4),
+                        heartbeat_interval=5, on_change=restart_fn)
+    em.register()          # announce this node
+    em.start()             # heartbeats + membership watch
+    status = em.watch()    # blocks until change / completion
+    """
+
+    PREFIX = "__elastic/nodes/"
+
+    def __init__(self, store, node_id=None, np_range=(1, 1),
+                 heartbeat_interval=5.0, lease_ttl=None, on_change=None,
+                 max_restart=3):
+        self._store = store
+        self.node_id = node_id or f"{os.uname().nodename}-{os.getpid()}"
+        lo, hi = (np_range if isinstance(np_range, tuple)
+                  else (np_range, np_range))
+        self.np_lo, self.np_hi = int(lo), int(hi)
+        self._hb_interval = float(heartbeat_interval)
+        self._ttl = float(lease_ttl or heartbeat_interval * 3)
+        self._on_change = on_change
+        self.max_restart = max_restart
+        self.restarts = 0
+        self._stop = threading.Event()
+        self._hb_thread = None
+        self._registered = False
+
+    # -- registry ------------------------------------------------------------
+    def _key(self, node_id=None):
+        return f"{self.PREFIX}{node_id or self.node_id}"
+
+    def register(self):
+        # race-free membership index: store.add atomically allocates a slot,
+        # then the node id is written under that slot — concurrent registers
+        # can never clobber each other (the read-modify-write of a shared
+        # list would)
+        slot = self._store.add("__elastic/nslots", 1)
+        self._store.set(f"__elastic/slot/{slot}", self.node_id.encode())
+        self._beat()
+        self._registered = True
+
+    def deregister(self):
+        if self._registered:
+            self.stop()  # the heartbeat thread must die BEFORE the tombstone
+            self._store.set(self._key(), b"")  # tombstone: empty lease
+            self._registered = False
+
+    def _beat(self):
+        lease = json.dumps({"t": time.time(), "pid": os.getpid()}).encode()
+        self._store.set(self._key(), lease)
+
+    def _load_index(self):
+        try:
+            n = int(self._store.add("__elastic/nslots", 0))
+        except Exception:  # noqa: BLE001
+            return []
+        seen, members = set(), []
+        for slot in range(1, n + 1):
+            try:
+                nid = self._store.get(f"__elastic/slot/{slot}").decode()
+            except Exception:  # noqa: BLE001
+                continue
+            if nid and nid not in seen:
+                seen.add(nid)
+                members.append(nid)
+        return members
+
+    def alive_nodes(self):
+        """Nodes whose lease is fresh (within ttl)."""
+        now = time.time()
+        alive = []
+        for nid in self._load_index():
+            try:
+                raw = self._store.get(self._key(nid))
+            except Exception:  # noqa: BLE001
+                continue
+            if not raw:
+                continue  # tombstone
+            try:
+                lease = json.loads(raw.decode())
+            except ValueError:
+                continue
+            if now - lease["t"] <= self._ttl:
+                alive.append(nid)
+        return alive
+
+    # -- heartbeat loop ------------------------------------------------------
+    def start(self):
+        if self._hb_thread is None:
+            self._stop.clear()
+            self._hb_thread = threading.Thread(target=self._hb_loop,
+                                               daemon=True, name="elastic-hb")
+            self._hb_thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=self._hb_interval * 2)
+            self._hb_thread = None
+
+    def _hb_loop(self):
+        while not self._stop.wait(self._hb_interval):
+            try:
+                self._beat()
+            except Exception:  # noqa: BLE001 — store briefly unreachable
+                pass
+
+    # -- membership decisions ------------------------------------------------
+    def health(self):
+        n = len(self.alive_nodes())
+        if n < self.np_lo:
+            return ElasticStatus.HOLD       # not enough nodes to run
+        if n > self.np_hi:
+            return ElasticStatus.ERROR      # over-subscribed (config bug)
+        return ElasticStatus.COMPLETED
+
+    def watch(self, poll=None, max_wait=None):
+        """Block until membership changes from the current set (or timeout).
+        Returns RESTART on change (train must re-init the mesh), HOLD if
+        below np_lo, EXIT when max_restart exhausted."""
+        poll = poll or self._hb_interval
+        baseline = set(self.alive_nodes())
+        deadline = time.time() + max_wait if max_wait else None
+        while not self._stop.is_set():
+            time.sleep(poll)
+            cur = set(self.alive_nodes())
+            if cur != baseline:
+                if len(cur) < self.np_lo:
+                    return ElasticStatus.HOLD
+                self.restarts += 1
+                if self.restarts > self.max_restart:
+                    return ElasticStatus.EXIT
+                if self._on_change is not None:
+                    self._on_change(sorted(cur))
+                return ElasticStatus.RESTART
+            if deadline and time.time() > deadline:
+                return ElasticStatus.COMPLETED
+        return ElasticStatus.COMPLETED

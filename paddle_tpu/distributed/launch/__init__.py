@@ -1,0 +1,165 @@
+"""Process launcher. reference: python/paddle/distributed/launch/main.py:23
+(collective controller, master rendezvous, --nnodes elastic ranges,
+--max_restart) and launch/controllers/collective.py.
+
+TPU-native launch topology: ONE process per host drives all local chips
+(single-controller JAX), so --nproc_per_node exists only for CPU-simulation
+runs (each child gets JAX_PLATFORMS=cpu and a private rank). Rendezvous is
+jax.distributed's coordination service, bootstrapped from --master; the
+native TCPStore rides master_port+1 for out-of-band coordination
+(parallel_env.init_parallel_env).
+
+Usage:
+  python -m paddle_tpu.distributed.launch train.py          # this host
+  python -m paddle_tpu.distributed.launch --master host:port \
+         --nnodes 4 --rank 0 train.py                       # multi-host
+  python -m paddle_tpu.distributed.launch --nproc_per_node 4 \
+         --backend cpu train.py                             # local simulation
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "spawn", "main"]
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch",
+                                add_help=True)
+    p.add_argument("--master", default=os.environ.get("PADDLE_MASTER"),
+                   help="host:port of the coordination service")
+    p.add_argument("--nnodes", default="1",
+                   help="node count, or elastic range lo:hi")
+    p.add_argument("--rank", type=int,
+                   default=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    p.add_argument("--nproc_per_node", "--devices", dest="nproc_per_node",
+                   default=None,
+                   help="local worker processes (CPU simulation only)")
+    p.add_argument("--backend", default=None, choices=[None, "cpu", "tpu"])
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--elastic_level", type=int, default=-1)
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("script", help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    # tolerate reference-launcher flags we don't implement (--log_level,
+    # --gpus, --ips, --run_mode, ...): strip "--flag [value]" pairs that
+    # argparse doesn't know before parsing, so the value isn't mistaken
+    # for the script
+    known = {a for action in p._actions for a in action.option_strings}
+    filtered, ignored = [], []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a.startswith("--") and a.split("=")[0] not in known:
+            ignored.append(a)
+            if "=" not in a and i + 1 < len(argv) \
+                    and not argv[i + 1].startswith("-"):
+                ignored.append(argv[i + 1])
+                i += 1
+        elif a.startswith("-"):
+            filtered.append(a)  # known flag (all take one value)
+            if "=" not in a and i + 1 < len(argv):
+                filtered.append(argv[i + 1])
+                i += 1
+        else:
+            filtered.extend(argv[i:])  # script + its args: stop scanning
+            break
+        i += 1
+    if ignored:
+        sys.stderr.write(f"launch: ignoring unsupported flags {ignored}\n")
+    return p.parse_args(filtered)
+
+
+def _worker_count(spec):
+    """--nproc_per_node N or --devices 0,1,2 (device-id list)."""
+    s = str(spec)
+    if "," in s:
+        return len([d for d in s.split(",") if d != ""])
+    return int(s)
+
+
+def _nnodes_range(spec):
+    if ":" in str(spec):
+        lo, hi = str(spec).split(":")
+        return int(lo), int(hi)
+    return int(spec), int(spec)
+
+
+def _run_local_procs(args):
+    """CPU-simulation mode: one subprocess per simulated worker, restart on
+    failure up to --max_restart (the launcher loop of launch/main.py)."""
+    n = _worker_count(args.nproc_per_node)
+    restarts = 0
+    while True:
+        procs = []
+        for r in range(n):
+            env = dict(os.environ,
+                       PADDLE_TRAINER_ID=str(r),
+                       PADDLE_TRAINERS_NUM=str(n),
+                       PADDLE_LOCAL_RANK=str(r),
+                       JAX_PLATFORMS=args.backend or "cpu",
+                       PADDLE_LAUNCH_MODE="simulation")
+            out = None
+            if args.log_dir:
+                os.makedirs(args.log_dir, exist_ok=True)
+                out = open(os.path.join(args.log_dir, f"worker.{r}.log"), "w")
+            procs.append((subprocess.Popen(
+                [sys.executable, args.script] + list(args.script_args),
+                env=env, stdout=out, stderr=subprocess.STDOUT if out else None),
+                out))
+        codes = []
+        for p, out in procs:
+            codes.append(p.wait())
+            if out:
+                out.close()
+        if all(c == 0 for c in codes):
+            return 0
+        restarts += 1
+        if restarts > args.max_restart:
+            sys.stderr.write(
+                f"launch: workers failed (codes {codes}), max_restart "
+                f"({args.max_restart}) exhausted\n")
+            return 1
+        sys.stderr.write(
+            f"launch: workers failed (codes {codes}), restart "
+            f"{restarts}/{args.max_restart}\n")
+        time.sleep(1.0)
+
+
+def main(argv=None):
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    if args.nproc_per_node is not None and _worker_count(args.nproc_per_node) > 1:
+        sys.exit(_run_local_procs(args))
+    # single process per host: bootstrap jax.distributed then exec the script
+    if args.backend:
+        import jax
+        jax.config.update("jax_platforms", args.backend)
+    lo, hi = _nnodes_range(args.nnodes)
+    if args.master and lo > 1:
+        os.environ.setdefault("PADDLE_MASTER", args.master)
+        os.environ.setdefault("PADDLE_TRAINERS_NUM", str(lo))
+        os.environ.setdefault("PADDLE_TRAINER_ID", str(args.rank))
+    from ..parallel_env import init_parallel_env
+    init_parallel_env()
+    sys.argv = [args.script] + list(args.script_args)
+    runpy.run_path(args.script, run_name="__main__")
+
+
+def launch():
+    main()
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference: python/paddle/distributed/spawn.py. Single-controller JAX
+    drives all local chips from one process, so spawn degenerates to a
+    direct call (the mesh provides the parallelism)."""
+    func(*args)
+    return None

@@ -16,14 +16,30 @@ import jax
 
 
 class _GlobalRNG:
+    """Lazy: the base key is materialized on first use, NOT at import —
+    creating an array at import time would initialize the jax backend before
+    the application can pick a platform (e.g. the launcher choosing CPU)."""
+
     def __init__(self, seed: int = 0):
-        self.base = jax.random.key(seed)
+        self._seed = seed
+        self._base = None
         self.counter = 0
         # trace mode: stack of (traced_key, [counter]) installed by jit.to_static
         self.trace_stack = []
 
+    @property
+    def base(self):
+        if self._base is None:
+            self._base = jax.random.key(self._seed)
+        return self._base
+
+    @base.setter
+    def base(self, v):
+        self._base = v
+
     def seed(self, s: int):
-        self.base = jax.random.key(s)
+        self._seed = int(s)
+        self._base = jax.random.key(self._seed)
         self.counter = 0
 
     def next_key(self):

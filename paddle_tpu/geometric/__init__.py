@@ -1,0 +1,168 @@
+"""Graph learning ops. reference: python/paddle/geometric/
+(message_passing/send_recv.py send_u_recv:25, send_ue_recv, send_uv;
+math.py segment_sum/mean/max/min; sampling/neighbors.py sample_neighbors;
+reindex.py reindex_graph).
+
+TPU-native: every message-passing op is gather + segment-reduce — XLA lowers
+these to efficient one-pass scatters on TPU; no hand-written graph kernels
+(reference: paddle/phi/kernels/gpu/graph_send_recv_kernel.cu).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, execute
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv",
+           "segment_sum", "segment_mean", "segment_max", "segment_min",
+           "sample_neighbors", "reindex_graph"]
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # composed from sum + count
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _out_size(out_size, dst_index):
+    if out_size is not None:
+        return int(out_size)
+    return int(np.asarray(jax.device_get(dst_index)).max()) + 1 if dst_index.size else 0
+
+
+def _segment_reduce(msgs, dst, num, pool_type):
+    if pool_type == "mean":
+        s = jax.ops.segment_sum(msgs, dst, num_segments=num)
+        cnt = jax.ops.segment_sum(jnp.ones_like(dst, msgs.dtype), dst,
+                                  num_segments=num)
+        shape = (num,) + (1,) * (msgs.ndim - 1)
+        return s / jnp.maximum(cnt.reshape(shape), 1)
+    out = _REDUCERS[pool_type](msgs, dst, num_segments=num)
+    if pool_type in ("max", "min"):
+        # paddle semantics: untouched rows are 0, not +-inf
+        touched = jax.ops.segment_sum(jnp.ones_like(dst, jnp.float32), dst,
+                                      num_segments=num) > 0
+        shape = (num,) + (1,) * (msgs.ndim - 1)
+        out = jnp.where(touched.reshape(shape), out, 0)
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] and reduce onto dst. reference:
+    python/paddle/geometric/message_passing/send_recv.py:25."""
+    reduce_op = reduce_op.lower()
+    num = _out_size(out_size, dst_index._data if isinstance(dst_index, Tensor)
+                    else jnp.asarray(dst_index))
+
+    def f(xv, src, dst):
+        return _segment_reduce(xv[src], dst, num, reduce_op)
+    return execute(f, x, src_index, dst_index, _name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine node features x[src] with edge features y, reduce onto dst.
+    reference: send_recv.py send_ue_recv."""
+    message_op = message_op.lower()
+    reduce_op = reduce_op.lower()
+    num = _out_size(out_size, dst_index._data if isinstance(dst_index, Tensor)
+                    else jnp.asarray(dst_index))
+    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.divide}[message_op]
+
+    def f(xv, ev, src, dst):
+        msgs = combine(xv[src], ev)
+        return _segment_reduce(msgs, dst, num, reduce_op)
+    return execute(f, x, y, src_index, dst_index, _name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from both endpoints. reference: send_recv.py send_uv."""
+    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.divide}[message_op.lower()]
+
+    def f(xv, yv, src, dst):
+        return combine(xv[src], yv[dst])
+    return execute(f, x, y, src_index, dst_index, _name="send_uv")
+
+
+def _segment(pool):
+    def op(data, segment_ids, name=None):
+        seg = segment_ids._data if isinstance(segment_ids, Tensor) \
+            else jnp.asarray(segment_ids)
+        num = int(np.asarray(jax.device_get(seg)).max()) + 1 if seg.size else 0
+
+        def f(d, s):
+            return _segment_reduce(d, s, num, pool)
+        return execute(f, data, segment_ids, _name=f"segment_{pool}")
+    op.__name__ = f"segment_{pool}"
+    return op
+
+
+segment_sum = _segment("sum")
+segment_mean = _segment("mean")
+segment_max = _segment("max")
+segment_min = _segment("min")
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling on CSC graphs. reference:
+    python/paddle/geometric/sampling/neighbors.py sample_neighbors.
+    Host-side (data-dependent shapes are inherently dynamic — the reference
+    also runs this on CPU for dataloading)."""
+    row_np = np.asarray(jax.device_get(row._data if isinstance(row, Tensor) else row))
+    colptr_np = np.asarray(jax.device_get(
+        colptr._data if isinstance(colptr, Tensor) else colptr))
+    nodes = np.asarray(jax.device_get(
+        input_nodes._data if isinstance(input_nodes, Tensor) else input_nodes))
+    eids_np = (np.asarray(jax.device_get(
+        eids._data if isinstance(eids, Tensor) else eids))
+        if eids is not None else None)
+    rng = np.random.RandomState()
+    out_nbr, out_cnt, out_eids = [], [], []
+    for n in nodes.tolist():
+        lo, hi = int(colptr_np[n]), int(colptr_np[n + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            picked = np.arange(lo, hi)
+        else:
+            picked = lo + rng.choice(deg, sample_size, replace=False)
+        out_nbr.append(row_np[picked])
+        out_cnt.append(len(picked))
+        if eids_np is not None:
+            out_eids.append(eids_np[picked])
+    neighbors = Tensor(np.concatenate(out_nbr) if out_nbr
+                       else np.zeros((0,), row_np.dtype))
+    counts = Tensor(np.asarray(out_cnt, np.int32))
+    if return_eids:
+        if eids_np is None:
+            raise ValueError("return_eids=True requires eids")
+        return neighbors, counts, Tensor(np.concatenate(out_eids))
+    return neighbors, counts
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global node ids to local ids. reference:
+    python/paddle/geometric/reindex.py reindex_graph."""
+    x_np = np.asarray(jax.device_get(x._data if isinstance(x, Tensor) else x))
+    nbr_np = np.asarray(jax.device_get(
+        neighbors._data if isinstance(neighbors, Tensor) else neighbors))
+    cnt_np = np.asarray(jax.device_get(
+        count._data if isinstance(count, Tensor) else count))
+    mapping = {}
+    for n in x_np.tolist():
+        mapping.setdefault(int(n), len(mapping))
+    reindexed = np.empty_like(nbr_np)
+    for i, n in enumerate(nbr_np.tolist()):
+        reindexed[i] = mapping.setdefault(int(n), len(mapping))
+    # edge list: dst repeated by count
+    dst = np.repeat(np.arange(len(x_np)), cnt_np)
+    keys = np.fromiter(mapping.keys(), dtype=x_np.dtype, count=len(mapping))
+    return Tensor(reindexed), Tensor(dst.astype(nbr_np.dtype)), Tensor(keys)

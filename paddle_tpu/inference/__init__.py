@@ -49,6 +49,7 @@ class Config:
         self._prefix = (prog_file[:-len(".pdmodel")]
                         if prog_file and prog_file.endswith(".pdmodel")
                         else prog_file)
+        self._params_path = params_file
         self._precision = PrecisionType.Float32
         self._device = "tpu"
         self._enable_memory_optim = True
@@ -57,6 +58,8 @@ class Config:
     def set_model(self, prog_file, params_file=None):
         self._prefix = (prog_file[:-len(".pdmodel")]
                         if prog_file.endswith(".pdmodel") else prog_file)
+        if params_file is not None:
+            self._params_path = params_file
 
     def model_dir(self):
         return self._prefix
@@ -138,7 +141,8 @@ class Predictor:
     def __init__(self, config: Config):
         self._config = config
         prefix = config._prefix
-        with open(prefix + ".pdiparams", "rb") as f:
+        params_path = config._params_path or prefix + ".pdiparams"
+        with open(params_path, "rb") as f:
             self._params = pickle.load(f)
         with open(prefix + ".pdmodel", "rb") as f:
             meta = pickle.load(f)

@@ -197,6 +197,10 @@ class Profiler:
         self._state = want
 
     def start(self):
+        # snapshot the host-event table so summary() reports only events
+        # recorded during THIS profiler run
+        with _events_lock:
+            self._event_baseline = {k: len(v) for k, v in _events.items()}
         self._timer.begin()
         self._sync()
         return self
@@ -229,10 +233,12 @@ class Profiler:
         """Host-event summary table (device kernels live in the exported
         trace; reference: profiler_statistic.py)."""
         unit = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
+        base = getattr(self, "_event_baseline", {})
         with _events_lock:
             rows = [(name, len(ds), sum(ds) * unit,
                      sum(ds) / len(ds) * unit, max(ds) * unit, min(ds) * unit)
-                    for name, ds in _events.items() if ds]
+                    for name, full in _events.items()
+                    for ds in [full[base.get(name, 0):]] if ds]
         rows.sort(key=lambda r: -r[2])
         header = (f"{'Name':<40}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
                   f"{'Avg':>12}{'Max':>12}{'Min':>12}")

@@ -1,0 +1,268 @@
+"""Geometric ops, watchdog, elastic manager, launch CLI.
+
+Reference patterns: test/legacy_test/test_graph_send_recv.py numerics;
+elastic manager membership tests (test/collective/fleet/test_elastic*).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import TCPStore, Watchdog
+from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+
+
+class TestGeometric:
+    def _graph(self):
+        # edges: 0->1, 0->2, 1->2, 2->0
+        src = np.array([0, 0, 1, 2], np.int32)
+        dst = np.array([1, 2, 2, 0], np.int32)
+        x = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], np.float32)
+        return x, src, dst
+
+    def test_send_u_recv_sum(self):
+        x, src, dst = self._graph()
+        out = paddle.geometric.send_u_recv(
+            paddle.to_tensor(x), paddle.to_tensor(src), paddle.to_tensor(dst),
+            reduce_op="sum")
+        expected = np.zeros_like(x)
+        for s, d in zip(src, dst):
+            expected[d] += x[s]
+        np.testing.assert_allclose(out.numpy(), expected, rtol=1e-6)
+
+    def test_send_u_recv_mean_max(self):
+        x, src, dst = self._graph()
+        out = paddle.geometric.send_u_recv(
+            paddle.to_tensor(x), paddle.to_tensor(src), paddle.to_tensor(dst),
+            reduce_op="mean")
+        # node 2 receives from 0 and 1 -> mean
+        np.testing.assert_allclose(out.numpy()[2], (x[0] + x[1]) / 2, rtol=1e-6)
+        out = paddle.geometric.send_u_recv(
+            paddle.to_tensor(x), paddle.to_tensor(src), paddle.to_tensor(dst),
+            reduce_op="max")
+        np.testing.assert_allclose(out.numpy()[2], np.maximum(x[0], x[1]),
+                                   rtol=1e-6)
+
+    def test_send_ue_recv(self):
+        x, src, dst = self._graph()
+        e = np.ones((4, 2), np.float32) * 10
+        out = paddle.geometric.send_ue_recv(
+            paddle.to_tensor(x), paddle.to_tensor(e), paddle.to_tensor(src),
+            paddle.to_tensor(dst), message_op="add", reduce_op="sum")
+        expected = np.zeros_like(x)
+        for i, (s, d) in enumerate(zip(src, dst)):
+            expected[d] += x[s] + e[i]
+        np.testing.assert_allclose(out.numpy(), expected, rtol=1e-6)
+
+    def test_segment_ops(self):
+        data = paddle.to_tensor(np.array([[1.0], [2.0], [3.0], [4.0]],
+                                         np.float32))
+        seg = paddle.to_tensor(np.array([0, 0, 1, 1], np.int32))
+        np.testing.assert_allclose(
+            paddle.geometric.segment_sum(data, seg).numpy(), [[3.0], [7.0]])
+        np.testing.assert_allclose(
+            paddle.geometric.segment_mean(data, seg).numpy(), [[1.5], [3.5]])
+        np.testing.assert_allclose(
+            paddle.geometric.segment_max(data, seg).numpy(), [[2.0], [4.0]])
+        np.testing.assert_allclose(
+            paddle.geometric.segment_min(data, seg).numpy(), [[1.0], [3.0]])
+
+    def test_send_u_recv_grad(self):
+        x, src, dst = self._graph()
+        xt = paddle.to_tensor(x, stop_gradient=False)
+        out = paddle.geometric.send_u_recv(
+            xt, paddle.to_tensor(src), paddle.to_tensor(dst), reduce_op="sum")
+        out.sum().backward()
+        # d(sum)/dx[i] = out-degree of node i
+        np.testing.assert_allclose(xt.grad.numpy()[:, 0], [2.0, 1.0, 1.0])
+
+    def test_sample_neighbors_reindex(self):
+        # CSC: node0 nbrs [1,2], node1 nbrs [2], node2 nbrs [0]
+        row = paddle.to_tensor(np.array([1, 2, 2, 0], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 2, 3, 4], np.int64))
+        nodes = paddle.to_tensor(np.array([0, 2], np.int64))
+        nbrs, cnt = paddle.geometric.sample_neighbors(row, colptr, nodes,
+                                                      sample_size=-1)
+        assert cnt.numpy().tolist() == [2, 1]
+        assert nbrs.numpy().tolist() == [1, 2, 0]
+        re_nbrs, dst, keys = paddle.geometric.reindex_graph(nodes, nbrs, cnt)
+        assert keys.numpy().tolist()[:2] == [0, 2]
+        assert dst.numpy().tolist() == [0, 0, 1]
+
+
+class TestWatchdog:
+    def test_no_fire_on_healthy_steps(self):
+        wd = Watchdog(timeout=2.0, poll_interval=0.2)
+        with wd:
+            for _ in range(5):
+                with wd.step_guard():
+                    time.sleep(0.05)
+        assert not wd.fired
+        assert wd.step_count == 5
+
+    def test_fires_on_hang(self, capsys):
+        fired = []
+        wd = Watchdog(timeout=0.5, poll_interval=0.1,
+                      on_timeout=lambda w: fired.append(True))
+        wd.start()
+        with wd.step_guard():
+            time.sleep(1.2)  # "hung" step
+        wd.stop()
+        assert fired and wd.fired
+        err = capsys.readouterr().err
+        assert "no step completion" in err
+
+
+class TestElastic:
+    def test_membership_and_health(self):
+        store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1,
+                         timeout=10)
+        a = ElasticManager(store, node_id="nodeA", np_range=(2, 3),
+                           heartbeat_interval=0.2)
+        b = ElasticManager(store, node_id="nodeB", np_range=(2, 3),
+                           heartbeat_interval=0.2)
+        a.register(); b.register()
+        assert set(a.alive_nodes()) == {"nodeA", "nodeB"}
+        assert a.health() == ElasticStatus.COMPLETED
+        # node B dies (stops heartbeating): lease expires
+        b.deregister()
+        assert set(a.alive_nodes()) == {"nodeA"}
+        assert a.health() == ElasticStatus.HOLD
+
+    def test_watch_detects_change(self):
+        store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1,
+                         timeout=10)
+        changes = []
+        a = ElasticManager(store, node_id="n1", np_range=(1, 3),
+                           heartbeat_interval=0.2,
+                           on_change=lambda m: changes.append(m))
+        a.register(); a.start()
+        import threading
+
+        def joiner():
+            time.sleep(0.4)
+            c = ElasticManager(store, node_id="n2", np_range=(1, 3),
+                               heartbeat_interval=0.2)
+            c.register()
+
+        th = threading.Thread(target=joiner)
+        th.start()
+        status = a.watch(poll=0.2, max_wait=5)
+        th.join()
+        a.stop()
+        assert status == ElasticStatus.RESTART
+        assert changes and "n2" in changes[0]
+
+
+class TestLaunchCLI:
+    def test_simulation_mode(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os\n"
+            "rank = os.environ['PADDLE_TRAINER_ID']\n"
+            "n = os.environ['PADDLE_TRAINERS_NUM']\n"
+            "print(f'RANK {rank}/{n} OK')\n")
+        log_dir = str(tmp_path / "logs")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--log_dir", log_dir, str(script)],
+            capture_output=True, timeout=120,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        assert r.returncode == 0, r.stderr.decode()
+        logs = sorted(os.listdir(log_dir))
+        assert logs == ["worker.0.log", "worker.1.log"]
+        assert "RANK 0/2 OK" in open(os.path.join(log_dir, logs[0])).read()
+
+    def test_restart_on_failure(self, tmp_path):
+        # worker fails on the first run, then succeeds (flag file)
+        flag = tmp_path / "flag"
+        script = tmp_path / "flaky.py"
+        script.write_text(
+            f"import os, sys\n"
+            f"flag = {str(flag)!r}\n"
+            f"if not os.path.exists(flag):\n"
+            f"    open(flag, 'w').write('x')\n"
+            f"    sys.exit(3)\n"
+            f"print('RECOVERED')\n")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--max_restart", "2", str(script)],
+            capture_output=True, timeout=120,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        assert r.returncode == 0, r.stderr.decode()
+        assert b"restart 1/2" in r.stderr
+
+
+class TestReviewRegressions:
+    def test_devices_list_count(self):
+        from paddle_tpu.distributed.launch import _worker_count
+        assert _worker_count("0,1,2,3") == 4
+        assert _worker_count("0,1") == 2
+        assert _worker_count("4") == 4
+
+    def test_unknown_flags_tolerated(self):
+        from paddle_tpu.distributed.launch import _parse
+        args = _parse(["--log_level", "info", "--nproc_per_node", "2", "t.py"])
+        assert args.script == "t.py"
+
+    def test_deregister_stays_dead(self):
+        store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1,
+                         timeout=10)
+        a = ElasticManager(store, node_id="A", np_range=(1, 2),
+                           heartbeat_interval=0.1)
+        a.register(); a.start()
+        time.sleep(0.3)
+        a.deregister()
+        time.sleep(0.4)   # would resurrect if heartbeat still ran
+        assert a.alive_nodes() == []
+
+    def test_concurrent_register_no_loss(self):
+        import threading
+        store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1,
+                         timeout=10)
+        mgrs = [ElasticManager(store, node_id=f"n{i}", np_range=(1, 8),
+                               heartbeat_interval=5) for i in range(6)]
+        threads = [threading.Thread(target=m.register) for m in mgrs]
+        for t in threads: t.start()
+        for t in threads: t.join()
+        assert set(mgrs[0].alive_nodes()) == {f"n{i}" for i in range(6)}
+
+    def test_profiler_summary_scoped_to_run(self):
+        from paddle_tpu import profiler
+        with profiler.RecordEvent("scoped_evt"):
+            pass
+        p = profiler.Profiler(timer_only=True)
+        p.start()
+        table = p.summary()
+        assert "scoped_evt" not in table   # recorded before start()
+        with profiler.RecordEvent("scoped_evt"):
+            pass
+        table = p.summary()
+        assert "scoped_evt" in table
+        p.stop()
+
+    def test_inference_separate_params_file(self, tmp_path):
+        from paddle_tpu import inference, nn
+        import shutil
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+            def forward(self, x):
+                return self.fc(x)
+        m = M(); m.eval()
+        prefix = str(tmp_path / "m")
+        paddle.jit.save(m, prefix,
+                        input_spec=[paddle.jit.InputSpec([1, 4], "float32")])
+        moved = str(tmp_path / "weights.bin")
+        shutil.move(prefix + ".pdiparams", moved)
+        cfg = inference.Config(prefix + ".pdmodel", moved)
+        pred = inference.create_predictor(cfg)
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.copy_from_cpu(np.ones((1, 4), np.float32))
+        pred.run()

@@ -89,8 +89,14 @@ class ElasticManager:
             return []
         seen, members = set(), []
         for slot in range(1, n + 1):
+            key = f"__elastic/slot/{slot}"
             try:
-                nid = self._store.get(f"__elastic/slot/{slot}").decode()
+                # check() first: get() blocks up to the store timeout on a
+                # missing key (e.g. a node died between slot allocation and
+                # the slot write), which would freeze every membership poll
+                if not self._store.check(key):
+                    continue
+                nid = self._store.get(key).decode()
             except Exception:  # noqa: BLE001
                 continue
             if nid and nid not in seen:
@@ -104,6 +110,8 @@ class ElasticManager:
         alive = []
         for nid in self._load_index():
             try:
+                if not self._store.check(self._key(nid)):
+                    continue
                 raw = self._store.get(self._key(nid))
             except Exception:  # noqa: BLE001
                 continue

@@ -60,9 +60,13 @@ def _parse(argv):
         a = argv[i]
         if a.startswith("--") and a.split("=")[0] not in known:
             ignored.append(a)
-            if "=" not in a and i + 1 < len(argv) \
-                    and not argv[i + 1].startswith("-"):
-                ignored.append(argv[i + 1])
+            nxt = argv[i + 1] if i + 1 < len(argv) else None
+            # consume the next token as this flag's value — unless it looks
+            # like the training script (a valueless boolean flag right
+            # before the script must not swallow it)
+            if "=" not in a and nxt is not None and not nxt.startswith("-") \
+                    and not nxt.endswith((".py", ".sh")):
+                ignored.append(nxt)
                 i += 1
         elif a.startswith("-"):
             filtered.append(a)  # known flag (all take one value)
@@ -115,9 +119,28 @@ def _run_local_procs(args):
                 [sys.executable, args.script] + list(args.script_args),
                 env=env, stdout=out, stderr=subprocess.STDOUT if out else None),
                 out))
-        codes = []
-        for p, out in procs:
-            codes.append(p.wait())
+        # poll all workers; on first failure kill the rest of the group (a
+        # crashed rank leaves peers blocked in rendezvous forever otherwise —
+        # reference behavior: pod terminates on first worker failure)
+        codes = [None] * len(procs)
+        while any(c is None for c in codes):
+            for i, (p, _) in enumerate(procs):
+                if codes[i] is None:
+                    codes[i] = p.poll()
+            if any(c not in (None, 0) for c in codes):
+                for i, (p, _) in enumerate(procs):
+                    if codes[i] is None:
+                        p.terminate()
+                for i, (p, _) in enumerate(procs):
+                    if codes[i] is None:
+                        try:
+                            codes[i] = p.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            p.kill()
+                            codes[i] = p.wait()
+                break
+            time.sleep(0.2)
+        for _, out in procs:
             if out:
                 out.close()
         if all(c == 0 for c in codes):

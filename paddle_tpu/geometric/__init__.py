@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..framework.core import Tensor, execute
+from ..framework.core import Tensor, execute, _unwrap
 
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv",
            "segment_sum", "segment_mean", "segment_max", "segment_min",
@@ -31,6 +31,11 @@ _REDUCERS = {
 def _out_size(out_size, dst_index):
     if out_size is not None:
         return int(out_size)
+    if isinstance(dst_index, jax.core.Tracer):
+        raise ValueError(
+            "out_size is required under jit/to_static tracing — the output "
+            "row count cannot be read from a traced index array; pass "
+            "out_size=<num_nodes> explicitly")
     return int(np.asarray(jax.device_get(dst_index)).max()) + 1 if dst_index.size else 0
 
 
@@ -56,8 +61,7 @@ def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
     """Gather x[src] and reduce onto dst. reference:
     python/paddle/geometric/message_passing/send_recv.py:25."""
     reduce_op = reduce_op.lower()
-    num = _out_size(out_size, dst_index._data if isinstance(dst_index, Tensor)
-                    else jnp.asarray(dst_index))
+    num = _out_size(out_size, _unwrap(dst_index))
 
     def f(xv, src, dst):
         return _segment_reduce(xv[src], dst, num, reduce_op)
@@ -70,8 +74,7 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
     reference: send_recv.py send_ue_recv."""
     message_op = message_op.lower()
     reduce_op = reduce_op.lower()
-    num = _out_size(out_size, dst_index._data if isinstance(dst_index, Tensor)
-                    else jnp.asarray(dst_index))
+    num = _out_size(out_size, _unwrap(dst_index))
     combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
                "div": jnp.divide}[message_op]
 
@@ -93,8 +96,7 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
 
 def _segment(pool):
     def op(data, segment_ids, name=None):
-        seg = segment_ids._data if isinstance(segment_ids, Tensor) \
-            else jnp.asarray(segment_ids)
+        seg = jnp.asarray(_unwrap(segment_ids))
         num = int(np.asarray(jax.device_get(seg)).max()) + 1 if seg.size else 0
 
         def f(d, s):
@@ -116,14 +118,11 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
     python/paddle/geometric/sampling/neighbors.py sample_neighbors.
     Host-side (data-dependent shapes are inherently dynamic — the reference
     also runs this on CPU for dataloading)."""
-    row_np = np.asarray(jax.device_get(row._data if isinstance(row, Tensor) else row))
-    colptr_np = np.asarray(jax.device_get(
-        colptr._data if isinstance(colptr, Tensor) else colptr))
-    nodes = np.asarray(jax.device_get(
-        input_nodes._data if isinstance(input_nodes, Tensor) else input_nodes))
-    eids_np = (np.asarray(jax.device_get(
-        eids._data if isinstance(eids, Tensor) else eids))
-        if eids is not None else None)
+    row_np = np.asarray(jax.device_get(_unwrap(row)))
+    colptr_np = np.asarray(jax.device_get(_unwrap(colptr)))
+    nodes = np.asarray(jax.device_get(_unwrap(input_nodes)))
+    eids_np = (np.asarray(jax.device_get(_unwrap(eids)))
+               if eids is not None else None)
     rng = np.random.RandomState()
     out_nbr, out_cnt, out_eids = [], [], []
     for n in nodes.tolist():
@@ -151,11 +150,9 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
                   name=None):
     """Compact global node ids to local ids. reference:
     python/paddle/geometric/reindex.py reindex_graph."""
-    x_np = np.asarray(jax.device_get(x._data if isinstance(x, Tensor) else x))
-    nbr_np = np.asarray(jax.device_get(
-        neighbors._data if isinstance(neighbors, Tensor) else neighbors))
-    cnt_np = np.asarray(jax.device_get(
-        count._data if isinstance(count, Tensor) else count))
+    x_np = np.asarray(jax.device_get(_unwrap(x)))
+    nbr_np = np.asarray(jax.device_get(_unwrap(neighbors)))
+    cnt_np = np.asarray(jax.device_get(_unwrap(count)))
     mapping = {}
     for n in x_np.tolist():
         mapping.setdefault(int(n), len(mapping))

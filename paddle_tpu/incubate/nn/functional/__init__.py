@@ -241,9 +241,43 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
     return execute(f, *args, _name="varlen_attention")
 
 
-def block_multihead_attention(*args, **kwargs):
-    raise NotImplementedError(
-        "paged-KV decode attention: see paddle_tpu.ops.pallas (planned)")
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens,
+                              block_tables, write_pos=None, num_heads=None,
+                              num_kv_heads=None, name=None, **kwargs):
+    """Paged-KV decode attention. reference:
+    incubate/nn/functional/block_multihead_attention.py + CUDA kernel
+    phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu.
+
+    Decode-phase subset: qkv [B, (H + 2*KVH) * D] packed single new token;
+    caches [num_blocks, block_size, KVH, D]; block_tables [B, max_blocks];
+    seq_lens [B] length INCLUDING the new token. Writes the new K/V into the
+    cache, attends over the paged prefix. Returns (out [B, H*D], k_cache,
+    v_cache). Full serving loop: paddle_tpu.ops.paged_attention.
+    """
+    from ....ops.paged_attention import (paged_attention_decode,
+                                         write_to_cache)
+    dropped = {k: v for k, v in kwargs.items() if v is not None}
+    if dropped:
+        raise NotImplementedError(
+            "block_multihead_attention: unsupported reference arguments "
+            f"{sorted(dropped)} would change numerics if ignored; apply "
+            "rope/bias to qkv before calling (see "
+            "fused_rotary_position_embedding)")
+    kvh = key_cache.shape[2] if num_kv_heads is None else num_kv_heads
+    d = key_cache.shape[3]
+
+    def f(qkv_a, kc, vc, lens, tables):
+        B = qkv_a.shape[0]
+        h = qkv_a.shape[1] // d - 2 * kvh
+        q, k_new, v_new = jnp.split(
+            qkv_a.reshape(B, -1, d), [h, h + kvh], axis=1)
+        pos = lens - 1 if write_pos is None else write_pos
+        kc, vc = write_to_cache(kc, vc, k_new, v_new, tables, pos)
+        out = paged_attention_decode(q, kc, vc, tables, lens)
+        return out.reshape(B, h * d), kc, vc
+
+    return execute(f, qkv, key_cache, value_cache, seq_lens, block_tables,
+                   _name="block_multihead_attention")
 
 
 def fused_moe(x, gate_weight, expert_weights1, expert_bias1, expert_weights2,

@@ -1,0 +1,149 @@
+"""Paged KV-cache attention (block attention) for inference serving.
+
+reference: paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu
++ python surface incubate/nn/functional/block_multihead_attention.py —
+vLLM-style paged KV cache: the cache is a pool of fixed-size blocks; each
+sequence owns a list of block ids (block_tables), so memory is allocated in
+block_size granules with no per-sequence max-length reservation.
+
+TPU-native: gathers over the block pool are XLA dynamic-gathers that Mosaic
+handles well at decode shapes; the full attention runs as one batched einsum
+over the gathered pages (decode q length is 1, so the MXU work is a skinny
+matmul — bandwidth-bound, which the gather layout serves).
+
+Cache layout: [num_blocks, block_size, num_kv_heads, head_dim].
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["paged_attention_decode", "write_to_cache", "BlockKVCacheManager"]
+
+
+def write_to_cache(k_cache, v_cache, k_new, v_new, block_tables, write_pos):
+    """Scatter new K/V (one token per sequence) into the paged cache.
+
+    k_new/v_new: [B, KVH, D]; block_tables: [B, max_blocks] int32;
+    write_pos: [B] absolute position of the new token per sequence.
+    Returns updated (k_cache, v_cache).
+    """
+    block_size = k_cache.shape[1]
+    block_idx = write_pos // block_size                       # [B]
+    in_block = write_pos % block_size                         # [B]
+    block_ids = jnp.take_along_axis(block_tables, block_idx[:, None],
+                                    axis=1)[:, 0]             # [B]
+    k_cache = k_cache.at[block_ids, in_block].set(k_new)
+    v_cache = v_cache.at[block_ids, in_block].set(v_new)
+    return k_cache, v_cache
+
+
+@functools.partial(jax.jit, static_argnames=("scale",))
+def paged_attention_decode(q, k_cache, v_cache, block_tables, seq_lens,
+                           scale=None):
+    """One decode step over paged caches.
+
+    q: [B, H, D] (single new token per sequence);
+    k_cache/v_cache: [num_blocks, block_size, KVH, D];
+    block_tables: [B, max_blocks_per_seq]; seq_lens: [B] (incl. new token).
+    Supports GQA (H a multiple of KVH). Returns [B, H, D].
+    """
+    B, H, D = q.shape
+    _, block_size, KVH, _ = k_cache.shape
+    groups = H // KVH
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    max_blocks = block_tables.shape[1]
+    L = max_blocks * block_size
+
+    def one(qb, table, n):
+        k = k_cache[table]                                    # [mb, bs, KVH, D]
+        v = v_cache[table]
+        k = k.reshape(L, KVH, D)
+        v = v.reshape(L, KVH, D)
+        qg = qb.reshape(KVH, groups, D)
+        # scores[kvh, g, l]
+        s = jnp.einsum("hgd,lhd->hgl", qg, k) * scale
+        mask = jnp.arange(L) < n
+        s = jnp.where(mask[None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("hgl,lhd->hgd", p, v)
+        return o.reshape(H, D)
+
+    return jax.vmap(one)(q, block_tables, seq_lens)
+
+
+class BlockKVCacheManager:
+    """Host-side block allocator — the analog of the reference's block table
+    management in block_multihead_attention (paged KV serving loop)."""
+
+    def __init__(self, num_blocks, block_size, num_kv_heads, head_dim,
+                 dtype=jnp.bfloat16):
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.k_cache = jnp.zeros((num_blocks, block_size, num_kv_heads,
+                                  head_dim), dtype)
+        self.v_cache = jnp.zeros_like(self.k_cache)
+        self._free = list(range(num_blocks - 1, -1, -1))
+        self._tables = {}   # seq_id -> [block ids]
+        self._lens = {}     # seq_id -> length
+
+    def allocate(self, seq_id, num_tokens):
+        """Ensure capacity for `num_tokens` total tokens."""
+        need = (num_tokens + self.block_size - 1) // self.block_size
+        table = self._tables.setdefault(seq_id, [])
+        while len(table) < need:
+            if not self._free:
+                raise MemoryError("KV cache pool exhausted")
+            table.append(self._free.pop())
+        self._lens[seq_id] = num_tokens
+        return table
+
+    def free(self, seq_id):
+        for b in self._tables.pop(seq_id, []):
+            self._free.append(b)
+        self._lens.pop(seq_id, None)
+
+    def prefill(self, seq_id, k, v):
+        """Write a whole prompt's K/V ([L, KVH, D]) into fresh blocks."""
+        L = k.shape[0]
+        table = self.allocate(seq_id, L)
+        bs = self.block_size
+        pad = (len(table) * bs) - L
+        kp = jnp.pad(k, ((0, pad), (0, 0), (0, 0)))
+        vp = jnp.pad(v, ((0, pad), (0, 0), (0, 0)))
+        ids = jnp.asarray(table)
+        self.k_cache = self.k_cache.at[ids].set(
+            kp.reshape(len(table), bs, *k.shape[1:]))
+        self.v_cache = self.v_cache.at[ids].set(
+            vp.reshape(len(table), bs, *v.shape[1:]))
+        return table
+
+    def append(self, seq_id, k_new, v_new):
+        """Append one token's K/V ([KVH, D]); returns new length."""
+        n = self._lens[seq_id]
+        table = self.allocate(seq_id, n + 1)
+        pos = jnp.asarray([n])
+        tbl = jnp.asarray([table])
+        self.k_cache, self.v_cache = write_to_cache(
+            self.k_cache, self.v_cache, k_new[None], v_new[None],
+            tbl, pos)
+        return n + 1
+
+    def batch_tables(self, seq_ids, pad_to=None):
+        """Dense [B, max_blocks] table + [B] lengths for a decode batch."""
+        import numpy as np
+        mb = max(len(self._tables[s]) for s in seq_ids)
+        if pad_to:
+            mb = max(mb, pad_to)
+        tables = np.zeros((len(seq_ids), mb), np.int32)
+        lens = np.zeros((len(seq_ids),), np.int32)
+        for i, s in enumerate(seq_ids):
+            t = self._tables[s]
+            tables[i, :len(t)] = t
+            lens[i] = self._lens[s]
+        return jnp.asarray(tables), jnp.asarray(lens)

@@ -1,0 +1,196 @@
+"""Expert parallelism: all-to-all token dispatch over the 'ep' mesh axis.
+
+reference: python/paddle/incubate/distributed/models/moe/moe_layer.py
+(MoEScatter:99 / MoEGather:149 — all-to-all PyLayers over the expert
+communicator), distributed/utils/moe_utils.py global_scatter/global_gather,
+SPMD rule paddle/phi/infermeta/spmd_rules/moe_gate_dispatch.cc.
+
+TPU-native design (GShard): capacity-bounded dispatch with STATIC shapes —
+every (expert, capacity) slot exists whether or not a token fills it, so XLA
+compiles one fixed program and `lax.all_to_all` rides the ICI. Inside
+shard_map each ep-rank holds E/ep experts and B/ep tokens:
+
+  1. top-k gate -> per-token expert choice + in-expert position (cumsum)
+  2. scatter tokens into the local [E, C] dispatch buffer
+  3. all_to_all: [E, C] -> each rank gets its experts' slots from every rank
+  4. run local experts on [E_local, ep*C]
+  5. all_to_all back + combine with gate weights
+
+Dropped tokens (over capacity) contribute zero — GShard semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map_fn
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map_fn(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs)
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map_old(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs)
+
+__all__ = ["moe_dispatch_combine", "ExpertParallelMoE", "gshard_dispatch"]
+
+
+def gshard_dispatch(x, gate_logits, num_experts, capacity, top_k=2):
+    """Local (single-shard) GShard dispatch.
+
+    x: [T, D] tokens; gate_logits: [T, E].
+    Returns (dispatched [E, C, D], combine_weights [T, E, C], probs [T, E]).
+    """
+    T, D = x.shape
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, top_k)                 # [T, k]
+    topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+
+    # position of each (token, choice) within its expert queue
+    # one-hot over experts per choice, cumulative over flattened (k, T)
+    # order: choice 0 of all tokens first (GShard prioritizes top-1)
+    flat_exp = jnp.swapaxes(topi, 0, 1).reshape(-1)          # [k*T]
+    flat_gate = jnp.swapaxes(topv, 0, 1).reshape(-1)         # [k*T]
+    onehot = jax.nn.one_hot(flat_exp, num_experts, dtype=jnp.int32)  # [kT, E]
+    pos_in_expert = jnp.cumsum(onehot, axis=0) * onehot      # 1-based
+    pos = (pos_in_expert.sum(-1) - 1)                        # [kT], 0-based
+    keep = pos < capacity
+    flat_gate = jnp.where(keep, flat_gate, 0.0)
+    pos = jnp.clip(pos, 0, capacity - 1)
+
+    token_ids = jnp.tile(jnp.arange(T), top_k)               # [kT]
+    dispatched = jnp.zeros((num_experts, capacity, D), x.dtype)
+    dispatched = dispatched.at[flat_exp, pos].add(
+        jnp.where(keep[:, None], x[token_ids], 0))
+
+    combine = jnp.zeros((T, num_experts, capacity), x.dtype)
+    combine = combine.at[token_ids, flat_exp, pos].add(
+        flat_gate.astype(x.dtype))
+    return dispatched, combine, probs
+
+
+def moe_dispatch_combine(x, gate_logits, expert_apply, expert_params,
+                         num_experts, mesh=None, axis_name="ep",
+                         capacity_factor=1.25, top_k=2):
+    """Full EP MoE: dispatch -> all_to_all -> local experts -> all_to_all
+    -> combine. Call inside jit; when `mesh` has an `axis_name` axis the
+    token and expert dims shard over it (E % ep == 0 required).
+
+    x: [T, D]; gate_logits: [T, E];
+    expert_params: pytree whose leaves have a leading expert dim E
+      (sharded over ep when mesh is given);
+    expert_apply(params_for_one_expert, tokens [C', D]) -> [C', D].
+    """
+    T, D = x.shape
+    capacity = max(1, int(math.ceil(top_k * T / num_experts * capacity_factor)))
+
+    if mesh is None or axis_name not in mesh.axis_names:
+        dispatched, combine, probs = gshard_dispatch(
+            x, gate_logits, num_experts, capacity, top_k)
+        outs = jnp.stack([
+            expert_apply(jax.tree_util.tree_map(lambda w: w[e], expert_params),
+                         dispatched[e])
+            for e in range(num_experts)])                    # [E, C, D]
+        out = jnp.einsum("tec,ecd->td", combine, outs)
+        return out, probs
+
+    ep = mesh.shape[axis_name]
+    assert num_experts % ep == 0, "num_experts must divide the ep axis"
+    e_local = num_experts // ep
+    # capacity is per (shard, expert): derive from the LOCAL token count so
+    # buffers/all-to-all volume don't scale with ep and drop semantics match
+    # the dense path
+    capacity = max(1, int(math.ceil(
+        top_k * (T // ep) / num_experts * capacity_factor)))
+
+    def local(x_shard, logits_shard, local_params):
+        # x_shard: [T/ep, D] — each rank dispatches its own tokens;
+        # local_params leaves: [e_local, ...] — this rank's experts
+        dispatched, combine, probs = gshard_dispatch(
+            x_shard, logits_shard, num_experts, capacity, top_k)
+        # [E, C, D]: exchange so each rank receives ITS experts' slots from
+        # every rank. tiled all_to_all splits axis 0 into ep chunks and
+        # concatenates the received chunks on the same axis.
+        d = jax.lax.all_to_all(dispatched, axis_name, split_axis=0,
+                               concat_axis=0, tiled=True)    # [E, C, D]
+        # received layout: [src_rank * e_local + e][c] — regroup per expert
+        d = d.reshape(ep, e_local, capacity, D)
+        d = jnp.swapaxes(d, 0, 1).reshape(e_local, ep * capacity, D)
+        outs = jnp.stack([
+            expert_apply(jax.tree_util.tree_map(lambda w: w[i], local_params),
+                         d[i])
+            for i in range(e_local)])                        # [e_local, ep*C, D]
+        # route back: inverse regroup + all_to_all
+        o = outs.reshape(e_local, ep, capacity, D)
+        o = jnp.swapaxes(o, 0, 1).reshape(ep * e_local, capacity, D)
+        o = jax.lax.all_to_all(o, axis_name, split_axis=0, concat_axis=0,
+                               tiled=True)                   # [E, C, D] (mine)
+        out = jnp.einsum("tec,ecd->td", combine, o)
+        return out, probs
+
+    pspecs = jax.tree_util.tree_map(
+        lambda w: P(axis_name, *([None] * (w.ndim - 1))), expert_params)
+    return shard_map(local, mesh,
+                     in_specs=(P(axis_name, None), P(axis_name, None), pspecs),
+                     out_specs=(P(axis_name, None), P(axis_name, None)))(
+        x, gate_logits, expert_params)
+
+
+class ExpertParallelMoE:
+    """Functional EP-MoE block for SpmdTrainer-style training loops.
+
+    params: gate [D, E]; w1 [E, D, H]; w2 [E, H, D]  (sharded Shard(0) on ep)
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, mesh=None,
+                 axis_name="ep", top_k=2, capacity_factor=1.25,
+                 activation=jax.nn.gelu):
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.num_experts = num_experts
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.activation = activation
+
+    def init(self, key, dtype=jnp.float32):
+        kg, k1, k2 = jax.random.split(key, 3)
+        s = 1.0 / math.sqrt(self.d_model)
+        return {
+            "gate": jax.random.normal(kg, (self.d_model, self.num_experts),
+                                      dtype) * s,
+            "w1": jax.random.normal(
+                k1, (self.num_experts, self.d_model, self.d_hidden), dtype) * s,
+            "w2": jax.random.normal(
+                k2, (self.num_experts, self.d_hidden, self.d_model),
+                dtype) / math.sqrt(self.d_hidden),
+        }
+
+    def apply(self, params, x):
+        """x: [T, D] -> ([T, D], aux_loss)."""
+        logits = x @ params["gate"]
+
+        def expert_apply(w, tokens):
+            return self.activation(tokens @ w["w1"]) @ w["w2"]
+
+        out, probs = moe_dispatch_combine(
+            x, logits, expert_apply, {"w1": params["w1"], "w2": params["w2"]},
+            self.num_experts, self.mesh, self.axis_name,
+            self.capacity_factor, self.top_k)
+        # GShard load-balance auxiliary loss
+        me = probs.mean(axis=0)                              # [E]
+        top1 = jnp.argmax(logits, axis=-1)
+        ce = jnp.mean(
+            jax.nn.one_hot(top1, self.num_experts, dtype=probs.dtype), axis=0)
+        aux = self.num_experts * jnp.sum(me * ce)
+        return out, aux

@@ -109,3 +109,4 @@ class LazyGuard:
     def __exit__(self, *a):
         return False
 from . import geometric  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,0 +1,134 @@
+"""ASP: automatic semi-structured (2:4) sparsity.
+
+reference: python/paddle/incubate/asp/ (asp.py: decorate:..., prune_model,
+calculate_density, set_excluded_layers; supported_layer_list.py).
+
+TPU-native: 2:4 sparsity has no dedicated TPU instruction, but pruning
+masks are still valuable (model compression; masked training keeps weights
+prunable). Masks are applied multiplicatively on the forward weight — XLA
+fuses the mask multiply into the matmul epilogue.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..nn.layer.layers import Layer
+from .. import nn
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers",
+           "create_mask", "check_mask_2d", "check_sparsity"]
+
+_excluded = set()
+
+
+def calculate_density(x):
+    """Fraction of nonzeros. reference: asp/utils.py calculate_density."""
+    arr = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def set_excluded_layers(param_names, main_program=None):
+    for n in (param_names if isinstance(param_names, (list, tuple))
+              else [param_names]):
+        _excluded.add(n)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def create_mask(weight, func_name="mask_1d", n=2, m=4):
+    """n:m sparse mask (keep the n largest of every m consecutive weights).
+    reference: asp/utils.py create_mask (MaskAlgo 1D/2D best/greedy)."""
+    w = weight.numpy() if isinstance(weight, Tensor) else np.asarray(weight)
+    orig_shape = w.shape
+    flat = w.reshape(-1, m) if w.size % m == 0 else None
+    if flat is None:
+        return np.ones_like(w)
+    idx = np.argsort(np.abs(flat), axis=1)[:, : m - n]   # drop smallest m-n
+    mask = np.ones_like(flat)
+    np.put_along_axis(mask, idx, 0.0, axis=1)
+    return mask.reshape(orig_shape)
+
+
+def check_mask_2d(mat, n=2, m=4):
+    """Every m-length group along the last axis has at most n nonzeros."""
+    a = mat.numpy() if isinstance(mat, Tensor) else np.asarray(mat)
+    if a.size % m:
+        return False
+    groups = (a != 0).reshape(-1, m).sum(axis=1)
+    return bool((groups <= n).all())
+
+
+def check_sparsity(mat, n=2, m=4, func_name=None):
+    return check_mask_2d(mat, n, m)
+
+
+def _supported(layer):
+    return isinstance(layer, (nn.Linear, nn.Conv2D))
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to all supported layers' weights in place.
+    reference: asp/asp.py prune_model."""
+    pruned = {}
+
+    def prune_one(sub, path):
+        mask = create_mask(sub.weight, mask_algo, n, m)
+        sub.weight.set_value(sub.weight.numpy() * mask)
+        if with_mask:
+            # the mask lives ON the parameter — lifecycle-safe (dies with
+            # it; no global id-keyed registry that stale object ids could
+            # poison) and what _MaskedOptimizer reads after each step
+            sub.weight._asp_mask = jnp.asarray(mask)
+        pruned[path] = calculate_density(sub.weight)
+
+    def walk(layer, prefix=""):
+        if _supported(layer) and prefix == "" :
+            prune_one(layer, "<root>")
+            return
+        for name, sub in layer._sub_layers.items():
+            path = f"{prefix}.{name}" if prefix else name
+            if _supported(sub) and path not in _excluded \
+                    and sub.full_name() not in _excluded:
+                prune_one(sub, path)
+            else:
+                walk(sub, path)
+
+    walk(model)
+    return pruned
+
+
+class _MaskedOptimizer:
+    """Optimizer wrapper that re-applies masks after each step so pruned
+    weights stay zero during sparse fine-tuning.
+    reference: asp/asp.py OptimizerWithSparsityGuarantee."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, k):
+        return getattr(self._inner, k)
+
+    def step(self):
+        self._inner.step()
+        for p in getattr(self._inner, "_parameter_list", []) or []:
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                p._data = p._data * mask
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self._inner.clear_grad()
+
+
+def decorate(optimizer):
+    """reference: asp/asp.py decorate — wrap the optimizer so masked weights
+    stay pruned through updates."""
+    return _MaskedOptimizer(optimizer)

@@ -1,1 +1,8 @@
+"""paddle.incubate.nn. reference: python/paddle/incubate/nn/__init__.py."""
+
 from . import functional  # noqa: F401
+from .layer import (  # noqa: F401
+    FusedLinear, FusedMultiHeadAttention, FusedFeedForward,
+    FusedTransformerEncoderLayer, FusedDropoutAdd,
+    FusedBiasDropoutResidualLayerNorm, FusedEcMoe,
+)

@@ -1,0 +1,263 @@
+"""Fused transformer layer classes. reference: python/paddle/incubate/nn/
+(layer/fused_transformer.py: FusedMultiHeadAttention, FusedFeedForward,
+FusedTransformerEncoderLayer; layer/fused_linear.py FusedLinear;
+layer/fused_dropout_add.py FusedDropoutAdd; layer/fused_ec_moe.py).
+
+TPU-native: "fused" is a statement about the compiled program, not the
+Python structure — XLA fuses the bias/dropout/residual/norm epilogues into
+the matmuls; these classes keep the reference's layer API so models port
+unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, execute
+from ...nn.layer.layers import Layer
+from ... import nn
+from . import functional as F
+
+__all__ = ["FusedLinear", "FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedDropoutAdd",
+           "FusedBiasDropoutResidualLayerNorm", "FusedEcMoe"]
+
+
+class FusedLinear(Layer):
+    """reference: incubate/nn/layer/fused_linear.py FusedLinear."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self._transpose = transpose_weight
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = (self.create_parameter((out_features,), attr=bias_attr,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        return F.fused_linear(x, self.weight, self.bias,
+                              transpose_weight=self._transpose)
+
+
+class FusedDropoutAdd(Layer):
+    """reference: incubate/nn/layer/fused_dropout_add.py."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self._p = p
+        self._mode = mode
+
+    def forward(self, x, y):
+        from ...nn import functional as NF
+        return NF.dropout(x, self._p, training=self.training,
+                          mode=self._mode) + y
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """reference: incubate/nn/layer/fused_transformer.py
+    FusedBiasDropoutResidualLayerNorm."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self._dropout = dropout_rate
+        self._epsilon = epsilon
+        self.ln_scale = self.create_parameter((embed_dim,), attr=weight_attr,
+                                              default_initializer=nn.initializer.Constant(1.0))
+        self.ln_bias = self.create_parameter((embed_dim,), attr=bias_attr,
+                                             is_bias=True)
+        self.linear_bias = self.create_parameter((embed_dim,), is_bias=True)
+
+    def forward(self, x, residual):
+        from ...nn import functional as NF
+        h = NF.dropout(x + self.linear_bias, self._dropout,
+                       training=self.training)
+        return NF.layer_norm(h + residual, (int(self.ln_scale.shape[0]),),
+                             self.ln_scale, self.ln_bias, self._epsilon)
+
+
+class FusedMultiHeadAttention(Layer):
+    """Attention with pre/post-LN + residual fused in.
+    reference: incubate/nn/layer/fused_transformer.py FusedMultiHeadAttention."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, qkv_weight_attr=None,
+                 qkv_bias_attr=None, linear_weight_attr=None,
+                 linear_bias_attr=None, pre_ln_scale_attr=None,
+                 pre_ln_bias_attr=None, ln_scale_attr=None, ln_bias_attr=None,
+                 epsilon=1e-5, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self._dropout = dropout_rate
+        self._attn_dropout = attn_dropout_rate
+        self._pre_ln = normalize_before
+        self._epsilon = epsilon
+        self.qkv_weight = self.create_parameter(
+            (3, num_heads, self.head_dim, embed_dim), attr=qkv_weight_attr)
+        self.qkv_bias = self.create_parameter(
+            (3, num_heads, self.head_dim), attr=qkv_bias_attr, is_bias=True)
+        self.linear_weight = self.create_parameter(
+            (embed_dim, embed_dim), attr=linear_weight_attr)
+        self.linear_bias = self.create_parameter((embed_dim,),
+                                                 attr=linear_bias_attr,
+                                                 is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            (embed_dim,), attr=pre_ln_scale_attr,
+            default_initializer=nn.initializer.Constant(1.0))
+        self.pre_ln_bias = self.create_parameter((embed_dim,),
+                                                 attr=pre_ln_bias_attr,
+                                                 is_bias=True)
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=ln_scale_attr,
+            default_initializer=nn.initializer.Constant(1.0))
+        self.ln_bias = self.create_parameter((embed_dim,), attr=ln_bias_attr,
+                                             is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        from ...nn import functional as NF
+        from ...framework import random as _random
+        x = query
+        residual = x
+        if self._pre_ln:
+            x = NF.layer_norm(x, (self.embed_dim,), self.pre_ln_scale,
+                              self.pre_ln_bias, self._epsilon)
+        drop_key = (_random.next_key()
+                    if self.training and self._attn_dropout > 0 else None)
+
+        def attn(a, qkv_w, qkv_b, lw, lb):
+            B, S, D = a.shape
+            qkv = jnp.einsum("bsd,tnhd->tbsnh", a, qkv_w) \
+                + qkv_b[:, None, None]
+            q, k, v = qkv[0], qkv[1], qkv[2]       # [B, S, H, hd]
+            s = jnp.einsum("bsnh,btnh->bnst", q, k) / math.sqrt(self.head_dim)
+            if attn_mask is not None:
+                m = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
+                m = jnp.asarray(m)
+                if m.dtype == jnp.bool_:
+                    # paddle semantics: True = keep, False = mask out
+                    s = jnp.where(m, s, -1e30)
+                else:
+                    s = s + m
+            p = jax.nn.softmax(s, axis=-1)
+            if drop_key is not None:
+                keep = jax.random.bernoulli(drop_key, 1 - self._attn_dropout,
+                                            p.shape)
+                p = jnp.where(keep, p / (1 - self._attn_dropout), 0)
+            o = jnp.einsum("bnst,btnh->bsnh", p, v).reshape(B, S, D)
+            return o @ lw + lb
+
+        out = execute(attn, x, self.qkv_weight, self.qkv_bias,
+                      self.linear_weight, self.linear_bias,
+                      _name="fused_mha")
+        out = NF.dropout(out, self._dropout, training=self.training)
+        out = out + residual
+        if not self._pre_ln:
+            out = NF.layer_norm(out, (self.embed_dim,), self.ln_scale,
+                                self.ln_bias, self._epsilon)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """reference: incubate/nn/layer/fused_transformer.py FusedFeedForward."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self._pre_ln = normalize_before
+        self._epsilon = epsilon
+        self._dropout = dropout_rate
+        self._act_dropout = (act_dropout_rate if act_dropout_rate is not None
+                             else dropout_rate)
+        self._act = activation
+        self.linear1 = nn.Linear(d_model, dim_feedforward,
+                                 weight_attr=linear1_weight_attr,
+                                 bias_attr=linear1_bias_attr)
+        self.linear2 = nn.Linear(dim_feedforward, d_model,
+                                 weight_attr=linear2_weight_attr,
+                                 bias_attr=linear2_bias_attr)
+        # pre-LN mode normalizes the input with ln1; post-LN mode normalizes
+        # the residual sum with ln2 — distinct parameter sets, as in the
+        # reference fused op
+        self.norm1 = nn.LayerNorm(d_model, epsilon=epsilon,
+                                  weight_attr=ln1_scale_attr,
+                                  bias_attr=ln1_bias_attr)
+        self.norm2 = nn.LayerNorm(d_model, epsilon=epsilon,
+                                  weight_attr=ln2_scale_attr,
+                                  bias_attr=ln2_bias_attr)
+
+    def forward(self, src):
+        from ...nn import functional as NF
+        residual = src
+        x = self.norm1(src) if self._pre_ln else src
+        act = getattr(NF, self._act)
+        x = NF.dropout(act(self.linear1(x)), self._act_dropout,
+                       training=self.training)
+        x = NF.dropout(self.linear2(x), self._dropout, training=self.training)
+        x = x + residual
+        return x if self._pre_ln else self.norm2(x)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """reference: incubate/nn/layer/fused_transformer.py
+    FusedTransformerEncoderLayer."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(attn_dropout_rate if attn_dropout_rate
+                               is not None else dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedEcMoe(Layer):
+    """Expert-choice MoE layer. reference: incubate/nn/layer/fused_ec_moe.py."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type="gelu",
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.gate = nn.Linear(hidden_size, num_experts)
+        self.e1_w = self.create_parameter((num_experts, hidden_size, inter_size))
+        self.e1_b = self.create_parameter((num_experts, 1, inter_size),
+                                          is_bias=True)
+        self.e2_w = self.create_parameter((num_experts, inter_size, hidden_size))
+        self.e2_b = self.create_parameter((num_experts, 1, hidden_size),
+                                          is_bias=True)
+        self._act = act_type
+
+    def forward(self, x, gate_logits=None):
+        g = gate_logits if gate_logits is not None else self.gate(x)
+
+        def f(a, gl, w1, b1, w2, b2):
+            probs = jax.nn.softmax(gl, axis=-1)              # [B, S, E]
+            h = jnp.einsum("bsd,edh->bseh", a, w1) + b1[:, 0]
+            h = (jax.nn.gelu(h) if self._act == "gelu"
+                 else jax.nn.relu(h))
+            o = jnp.einsum("bseh,ehd->bsed", h, w2) + b2[:, 0]
+            return jnp.einsum("bsed,bse->bsd", o, probs)
+        return execute(f, x, g, self.e1_w, self.e1_b, self.e2_w, self.e2_b,
+                       _name="fused_ec_moe")

@@ -1,0 +1,73 @@
+"""paddle.utils. reference: python/paddle/utils/ (deprecated.py,
+lazy_import, download.py, unique_name.py via base, cpp_extension/).
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+from . import cpp_extension  # noqa: F401
+from . import unique_name  # noqa: F401
+
+__all__ = ["deprecated", "try_import", "require_version", "run_check",
+           "cpp_extension", "unique_name"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """reference: python/paddle/utils/deprecated.py."""
+    def decorator(func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            msg = f"API {func.__module__}.{func.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f". reason: {reason}"
+            if level == 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+        return wrapper
+    return decorator
+
+
+def try_import(module_name, err_msg=None):
+    """reference: python/paddle/utils/lazy_import.py."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or
+                          f"{module_name} is required: {e}") from e
+
+
+def require_version(min_version, max_version=None):
+    """reference: python/paddle/utils/__init__.py require_version."""
+    from .. import __version__
+
+    def to_tuple(v):
+        return tuple(int(x) for x in str(v).split(".")[:3])
+
+    cur = to_tuple(__version__)
+    if to_tuple(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version and to_tuple(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > maximum {max_version}")
+
+
+def run_check():
+    """reference: python/paddle/utils/install_check.py run_check — verify the
+    accelerator works by compiling and running a tiny matmul."""
+    import jax
+    import jax.numpy as jnp
+    d = jax.devices()[0]
+    x = jnp.ones((128, 128), jnp.float32)
+    y = jax.jit(lambda a: a @ a)(x)
+    y.block_until_ready()
+    print(f"PaddleTPU works on {d.platform}:{d.device_kind if hasattr(d, 'device_kind') else d}. "
+          f"matmul checksum {float(y.sum()):.0f}")

@@ -1,0 +1,228 @@
+"""cpp_extension custom ops, ASP sparsity, fused incubate layers, utils."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestCppExtension:
+    def test_custom_op_roundtrip(self, tmp_path):
+        src = tmp_path / "my_ops.cc"
+        src.write_text(r"""
+#include <cstdint>
+#include <cmath>
+extern "C" void my_gelu(const void** inputs, void** outputs,
+                        const int64_t* const* in_shapes, const int* in_ndims,
+                        int num_inputs) {
+  const float* x = static_cast<const float*>(inputs[0]);
+  float* y = static_cast<float*>(outputs[0]);
+  int64_t n = 1;
+  for (int d = 0; d < in_ndims[0]; ++d) n *= in_shapes[0][d];
+  for (int64_t i = 0; i < n; ++i)
+    y[i] = 0.5f * x[i] * (1.0f + std::erf(x[i] * 0.70710678f));
+}
+""")
+        lib = paddle.utils.cpp_extension.load("my_ops", [str(src)])
+        gelu = lib.op("my_gelu")
+        x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        out = gelu(paddle.to_tensor(x))
+        # reference via erf
+        import math
+        expected = 0.5 * x * (1 + np.vectorize(math.erf)(x * 0.70710678))
+        np.testing.assert_allclose(out.numpy(), expected, rtol=1e-5, atol=1e-6)
+
+    def test_custom_op_under_jit(self, tmp_path):
+        src = tmp_path / "sq.cc"
+        src.write_text(r"""
+#include <cstdint>
+extern "C" void square_op(const void** inputs, void** outputs,
+                          const int64_t* const* in_shapes, const int* in_ndims,
+                          int num_inputs) {
+  const float* x = static_cast<const float*>(inputs[0]);
+  float* y = static_cast<float*>(outputs[0]);
+  int64_t n = 1;
+  for (int d = 0; d < in_ndims[0]; ++d) n *= in_shapes[0][d];
+  for (int64_t i = 0; i < n; ++i) y[i] = x[i] * x[i];
+}
+""")
+        import jax
+        import jax.numpy as jnp
+        lib = paddle.utils.cpp_extension.load("sq", [str(src)])
+        sq = lib.op("square_op")
+        # compose inside jax.jit via the raw path (pure_callback)
+        f = jax.jit(lambda a: sq.raw(a) + 1.0)
+        out = f(jnp.asarray([1.0, 2.0, 3.0], jnp.float32))
+        np.testing.assert_allclose(np.asarray(out), [2.0, 5.0, 10.0])
+
+
+class TestUtils:
+    def test_deprecated_warns(self):
+        @paddle.utils.deprecated(update_to="new_api", since="2.0")
+        def old_api():
+            return 42
+        with pytest.warns(DeprecationWarning):
+            assert old_api() == 42
+
+    def test_unique_name(self):
+        with paddle.utils.unique_name.guard():
+            a = paddle.utils.unique_name.generate("fc")
+            b = paddle.utils.unique_name.generate("fc")
+        assert a == "fc_0" and b == "fc_1"
+
+    def test_require_version(self):
+        paddle.utils.require_version("0.0.1")
+        with pytest.raises(Exception):
+            paddle.utils.require_version("99.0.0")
+
+    def test_run_check(self, capsys):
+        paddle.utils.run_check()
+        assert "works" in capsys.readouterr().out
+
+
+class TestASP:
+    def test_create_mask_2_4(self):
+        from paddle_tpu.incubate import asp
+        w = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+        mask = asp.create_mask(w, n=2, m=4)
+        assert asp.check_mask_2d(mask, 2, 4)
+        # exactly half the weights survive
+        assert mask.sum() == w.size // 2
+        # kept entries are the 2 largest |w| of each group of 4
+        groups = np.abs(w).reshape(-1, 4)
+        kept = mask.reshape(-1, 4).astype(bool)
+        for g, k in zip(groups, kept):
+            assert set(np.argsort(g)[-2:]) == set(np.nonzero(k)[0])
+
+    def test_prune_and_finetune_keeps_sparsity(self):
+        from paddle_tpu.incubate import asp
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+        densities = asp.prune_model(model, n=2, m=4)
+        assert densities  # something was pruned
+        for d in densities.values():
+            assert abs(d - 0.5) < 1e-6
+        opt = asp.decorate(
+            paddle.optimizer.SGD(0.1, parameters=model.parameters()))
+        x = paddle.to_tensor(np.random.RandomState(1)
+                             .randn(4, 16).astype(np.float32))
+        loss = model(x).square().mean()
+        loss.backward()
+        opt.step()
+        # sparsity preserved through the update
+        from paddle_tpu.incubate.asp import check_mask_2d
+        lin = model._sub_layers["0"]
+        assert check_mask_2d(lin.weight.numpy(), 2, 4)
+        assert abs(asp.calculate_density(lin.weight) - 0.5) < 1e-6
+
+
+class TestFusedLayers:
+    def test_fused_linear(self):
+        paddle.seed(0)
+        fl = paddle.incubate.nn.FusedLinear(8, 16)
+        x = paddle.to_tensor(np.random.RandomState(2)
+                             .randn(4, 8).astype(np.float32))
+        out = fl(x)
+        assert list(out.shape) == [4, 16]
+        ref = x.numpy() @ fl.weight.numpy() + fl.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    def test_fused_encoder_layer(self):
+        paddle.seed(1)
+        enc = paddle.incubate.nn.FusedTransformerEncoderLayer(
+            d_model=32, nhead=4, dim_feedforward=64, dropout_rate=0.0)
+        enc.eval()
+        x = paddle.to_tensor(np.random.RandomState(3)
+                             .randn(2, 10, 32).astype(np.float32))
+        out = enc(x)
+        assert list(out.shape) == [2, 10, 32]
+        assert np.isfinite(out.numpy()).all()
+        # trains
+        enc.train()
+        loss = enc(x).square().mean()
+        loss.backward()
+        assert any(p.grad is not None for p in enc.parameters())
+
+    def test_fused_ec_moe(self):
+        paddle.seed(2)
+        moe = paddle.incubate.nn.FusedEcMoe(16, 32, num_experts=4)
+        x = paddle.to_tensor(np.random.RandomState(4)
+                             .randn(2, 6, 16).astype(np.float32))
+        out = moe(x)
+        assert list(out.shape) == [2, 6, 16]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_fused_dropout_add(self):
+        fda = paddle.incubate.nn.FusedDropoutAdd(p=0.0)
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        y = paddle.to_tensor(np.full((2, 3), 2.0, np.float32))
+        np.testing.assert_allclose(fda(x, y).numpy(), 3.0)
+
+
+class TestReviewRegressions:
+    def test_prune_bare_layer(self):
+        from paddle_tpu.incubate import asp
+        lin = nn.Linear(16, 8)
+        dens = asp.prune_model(lin)
+        assert dens and abs(list(dens.values())[0] - 0.5) < 1e-6
+
+    def test_mask_dies_with_param(self):
+        from paddle_tpu.incubate import asp
+        m = nn.Linear(16, 8)
+        asp.prune_model(m)
+        assert hasattr(m.weight, "_asp_mask")
+        m2 = nn.Linear(16, 8)   # fresh model: no mask
+        assert not hasattr(m2.weight, "_asp_mask")
+
+    def test_bool_attn_mask(self):
+        paddle.seed(5)
+        mha = paddle.incubate.nn.FusedMultiHeadAttention(
+            8, 2, dropout_rate=0.0, attn_dropout_rate=0.0)
+        mha.eval()
+        x = paddle.to_tensor(np.random.RandomState(6)
+                             .randn(1, 4, 8).astype(np.float32))
+        # mask out position 3 for every query
+        mask = np.ones((1, 1, 4, 4), bool)
+        mask[..., 3] = False
+        out_masked = mha(x, attn_mask=paddle.to_tensor(mask))
+        # same result as physically removing position 3's key/value requires
+        # full recompute; minimal check: masked output differs from unmasked
+        # and masking everything except self gives finite results
+        out_full = mha(x)
+        assert not np.allclose(out_masked.numpy(), out_full.numpy())
+        assert np.isfinite(out_masked.numpy()).all()
+
+    def test_build_error_surfaces_diagnostics(self, tmp_path):
+        src = tmp_path / "broken.cc"
+        src.write_text("this is not C++")
+        with pytest.raises(RuntimeError, match="error"):
+            paddle.utils.cpp_extension.load("broken", [str(src)])
+
+    def test_flags_invalidate_cache(self, tmp_path):
+        src = tmp_path / "flagged.cc"
+        src.write_text(r"""
+#include <cstdint>
+extern "C" void get_flag(const void** in, void** out,
+                         const int64_t* const* sh, const int* nd, int n) {
+#ifdef MY_FLAG
+  static_cast<float*>(out[0])[0] = 1.0f;
+#else
+  static_cast<float*>(out[0])[0] = 0.0f;
+#endif
+}
+""")
+        import numpy as np
+        lib0 = paddle.utils.cpp_extension.load("flagged", [str(src)])
+        lib1 = paddle.utils.cpp_extension.load(
+            "flagged", [str(src)], extra_cxx_cflags=["-DMY_FLAG"])
+        x = paddle.to_tensor(np.zeros((1,), np.float32))
+        assert float(lib0.op("get_flag")(x).numpy()[0]) == 0.0
+        assert float(lib1.op("get_flag")(x).numpy()[0]) == 1.0
+
+    def test_ffn_post_ln_uses_ln2(self):
+        ffn = paddle.incubate.nn.FusedFeedForward(8, 16, dropout_rate=0.0,
+                                                  normalize_before=False)
+        assert ffn.norm1 is not ffn.norm2

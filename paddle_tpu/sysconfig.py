@@ -1,0 +1,21 @@
+"""paddle.sysconfig. reference: python/paddle/sysconfig.py
+(get_include, get_lib)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory of C headers for building custom ops (the C ABI contract
+    lives in utils/cpp_extension.py docstrings; native sources in /native)."""
+    return os.path.join(os.path.dirname(_ROOT), "native")
+
+
+def get_lib():
+    """Directory of built native libraries."""
+    return os.path.join(_ROOT, "_native")

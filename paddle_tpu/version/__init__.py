@@ -1,0 +1,46 @@
+"""paddle.version. reference: the build-generated python/paddle/version.py
+(full_version, major/minor/patch/rc, commit, cuda()/cudnn() queries)."""
+
+from __future__ import annotations
+
+full_version = "0.1.0"
+major, minor, patch = (int(x) for x in full_version.split("."))
+rc = 0
+commit = "unknown"
+istaged = False
+with_pip_cuda_libraries = "OFF"
+
+__all__ = ["full_version", "major", "minor", "patch", "rc", "commit",
+           "show", "cuda", "cudnn", "nccl", "xpu", "tpu"]
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print("accelerator: TPU (XLA)")
+
+
+def cuda():
+    """No CUDA on TPU builds — reference returns 'False' for cpu builds."""
+    return "False"
+
+
+def cudnn():
+    return "False"
+
+
+def nccl():
+    return "False"
+
+
+def xpu():
+    return "False"
+
+
+def tpu():
+    import jax
+    try:
+        d = jax.devices()[0]
+        return getattr(d, "device_kind", d.platform)
+    except Exception:  # noqa: BLE001
+        return "unavailable"

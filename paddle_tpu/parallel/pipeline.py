@@ -32,7 +32,8 @@ try:
 except AttributeError:  # older spelling
     from jax.experimental.shard_map import shard_map  # type: ignore
 
-__all__ = ["pipeline_forward", "PipelinedLM"]
+__all__ = ["pipeline_forward", "pipeline_1f1b_grads", "PipelinedLM",
+           "OneFOneBPipeline"]
 
 
 def _pvary(x, axes):
@@ -99,6 +100,289 @@ def pipeline_forward(stage_fn: Callable, stacked_stage_params, inputs_mb,
 
     (_, out_buf), _ = jax.lax.scan(step, (h0, out_buf), jnp.arange(steps))
     return out_buf
+
+
+def pipeline_forward_interleaved(stage_fn: Callable, stacked_chunk_params,
+                                 inputs_mb, axis_name: str = "pp", *,
+                                 p_size: int, num_chunks: int,
+                                 remat: bool = True, vary_axes=None):
+    """Interleaved (VPP) forward schedule inside an existing shard_map.
+
+    reference semantics: PipelineParallelWithInterleave
+    (fleet/meta_parallel/pipeline_parallel.py:1174) — each physical stage s
+    holds `num_chunks` model chunks (virtual stages v = c*P + s), so the
+    pipeline fill is P-1 ticks of V× smaller chunks: relative bubble shrinks
+    by the chunk count. Schedule (local time u = t - s, groups of P
+    microbatches): chunk c = (u//P) % V, microbatch i = (u//(V*P))*P + u%P.
+    Activations flow s→s+1 within a chunk and wrap P-1→0 between chunks.
+
+    stacked_chunk_params leaves: local shape (1, V, ...) — the (stage,
+    chunk) shard. inputs_mb: (M, mb, ...), M % P == 0. Returns (M, mb, ...)
+    valid on the last stage. Backward comes from autodiff of the scan
+    (fill-drain memory; use the 1F1B schedule for the O(P) memory bound).
+    """
+    my_stage = jax.lax.axis_index(axis_name)
+    vary = tuple(vary_axes) if vary_axes else (axis_name,)
+    m = inputs_mb.shape[0]
+    p = p_size
+    v = num_chunks
+    if m % p != 0:
+        raise ValueError(f"interleaved schedule needs microbatches {m} % "
+                         f"pp {p} == 0")
+    local_params = jax.tree_util.tree_map(
+        lambda a: _pvary(a[0], vary), stacked_chunk_params)
+
+    fn = stage_fn
+    if remat:
+        fn = jax.checkpoint(stage_fn)
+
+    # s -> s+1 within a chunk, P-1 -> 0 wrap between chunks
+    perm = [(i, i + 1) for i in range(p - 1)] + [(p - 1, 0)]
+
+    n_groups = m // p
+    steps = n_groups * v * p + (p - 1) + (v - 1) * p
+    h0 = _pvary(jnp.zeros_like(inputs_mb[0]), vary)
+    out_buf = _pvary(jnp.zeros((m,) + inputs_mb.shape[1:], inputs_mb.dtype),
+                     vary)
+
+    def step(carry, t):
+        recv, outs = carry
+        u = t - my_stage
+        uc = jnp.clip(u, 0, steps)
+        c = (uc // p) % v                      # chunk index
+        i = (uc // (v * p)) * p + uc % p       # microbatch index
+        valid = (u >= 0) & (i < m)
+        first_virtual = (my_stage == 0) & (c == 0)
+        inp = jnp.where(first_virtual,
+                        _pvary(inputs_mb[jnp.clip(i, 0, m - 1)], vary), recv)
+        inp = jnp.where(valid, inp, jnp.zeros_like(inp))
+        chunk_params = jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+            local_params)
+        h = fn(chunk_params, inp)
+        h = jnp.where(valid, h, jnp.zeros_like(h))
+        last_virtual = (my_stage == p - 1) & (c == v - 1)
+        outs = jnp.where(last_virtual & valid,
+                         outs.at[jnp.clip(i, 0, m - 1)].set(h), outs)
+        sent = jax.lax.ppermute(h, axis_name, perm)
+        return (sent, outs), None
+
+    (_, out_buf), _ = jax.lax.scan(step, (h0, out_buf), jnp.arange(steps))
+    return out_buf
+
+
+def pipeline_1f1b_grads(embed_fn, stage_fn, head_loss_fn, embed_params,
+                        stacked_stage_params, head_params, tokens_mb,
+                        labels_mb, axis_name: str = "pp", *, p_size: int,
+                        num_microbatches: int, vary_axes=None,
+                        tied_embed: bool = False):
+    """1F1B pipeline schedule: hand-scheduled forward AND backward.
+
+    reference semantics: fleet/meta_parallel/pipeline_parallel.py:575
+    (forward_backward_pipeline, non-interleaved 1F1B).
+
+    Unlike `pipeline_forward` (fill-drain + autodiff, which keeps all M
+    microbatch boundary activations alive for the backward), this runs the
+    backward INSIDE the same scan: each tick a stage does one forward
+    (microbatch i = t - s) and one backward (microbatch j = t - 2(P-1) + s),
+    so at most 2(P-1)+1 stage-input activations are live per stage — the
+    1F1B memory bound O(P) instead of O(M). Stage weight gradients are
+    accumulated across microbatches; per-microbatch rematerialization comes
+    free because the backward recomputes the stage from its saved input.
+
+    Must run inside shard_map over `axis_name`. Returns
+    (loss, demb, dstage_local, dhead) — demb/dhead psum'd over pp; the
+    caller psums/means over any batch axis.
+
+    With `tied_embed`, head_loss_fn takes (head_params, embed_params, h,
+    labels) and its embed-weight cotangent is added into demb — the
+    SharedLayerDesc analog (pp_layers.py:76).
+    """
+    my_stage = jax.lax.axis_index(axis_name)
+    vary = tuple(vary_axes) if vary_axes else (axis_name,)
+    m = num_microbatches
+    p = p_size
+    k = min(m, 2 * p - 1)  # live-activation ring buffer depth (the 1F1B bound)
+    # Replicated (unvarying) params must be made varying before vjp: jax's
+    # vma-aware transpose auto-psums cotangents toward unvarying inputs,
+    # which would pre-sum grads across stages and break the per-stage
+    # masking/accumulation below.
+    embed_params = jax.tree_util.tree_map(
+        lambda a: _pvary(a, vary), embed_params)
+    head_params = jax.tree_util.tree_map(
+        lambda a: _pvary(a, vary), head_params)
+    local_params = jax.tree_util.tree_map(lambda a: a[0], stacked_stage_params)
+    local_params = jax.tree_util.tree_map(
+        lambda a: _pvary(a, vary), local_params)
+
+    perm_fwd = [(i, i + 1) for i in range(p - 1)]
+    perm_bwd = [(i + 1, i) for i in range(p - 1)]
+
+    if tied_embed:
+        def fwd_and_loss(sp, hp, ep, h_in, lab):
+            h_out = stage_fn(sp, h_in)
+            return h_out, head_loss_fn(hp, ep, h_out, lab)
+    else:
+        def fwd_and_loss(sp, hp, ep, h_in, lab):
+            h_out = stage_fn(sp, h_in)
+            return h_out, head_loss_fn(hp, h_out, lab)
+
+    h_shape = jax.eval_shape(
+        lambda ep, t: embed_fn(ep, t), embed_params, tokens_mb[0])
+    zero_h = jnp.zeros(h_shape.shape, h_shape.dtype)
+
+    zeros_like_tree = lambda tree: jax.tree_util.tree_map(
+        lambda a: jnp.zeros(a.shape, a.dtype), tree)
+
+    carry0 = dict(
+        recv_f=_pvary(zero_h, vary),
+        recv_b=_pvary(zero_h, vary),
+        buf=_pvary(jnp.zeros((k,) + h_shape.shape, h_shape.dtype), vary),
+        demb=_pvary(zeros_like_tree(embed_params), vary),
+        dstage=_pvary(zeros_like_tree(local_params), vary),
+        dhead=_pvary(zeros_like_tree(head_params), vary),
+        loss=_pvary(jnp.zeros((), jnp.float32), vary),
+    )
+
+    t_total = m + 2 * (p - 1)
+    inv_m = jnp.float32(1.0 / m)
+
+    def tick(carry, t):
+        # ---- forward part: microbatch i at stage s when t == s + i -------
+        i_f = t - my_stage
+        f_active = (i_f >= 0) & (i_f < m)
+        tok_i = tokens_mb[jnp.clip(i_f, 0, m - 1)]
+        h_embed = embed_fn(embed_params, tok_i)
+        h_in = jnp.where(my_stage == 0, _pvary(h_embed, vary), carry["recv_f"])
+        h_in = jnp.where(f_active, h_in, jnp.zeros_like(h_in))
+        slot_f = jnp.mod(i_f, k)
+        buf = carry["buf"].at[slot_f].set(
+            jnp.where(f_active, h_in, carry["buf"][slot_f]))
+        h_out = stage_fn(local_params, h_in)
+        h_out = jnp.where(f_active, h_out, jnp.zeros_like(h_out))
+        send_f = jax.lax.ppermute(h_out, axis_name, perm_fwd)
+
+        # ---- backward part: microbatch j when t == 2(P-1) - s + j --------
+        j = t - 2 * (p - 1) + my_stage
+        b_active = (j >= 0) & (j < m)
+        h_saved = buf[jnp.mod(j, k)]
+        tok_j = tokens_mb[jnp.clip(j, 0, m - 1)]
+        lab_j = labels_mb[jnp.clip(j, 0, m - 1)]
+        is_last = my_stage == p - 1
+
+        (h_out_b, loss_j), pull = jax.vjp(
+            lambda sp, hp, ep, h: fwd_and_loss(sp, hp, ep, h, lab_j),
+            local_params, head_params, embed_params, h_saved)
+        # cotangent seed: last stage seeds from its own loss, others from
+        # the cotangent received from stage s+1
+        seed_h = jnp.where(is_last, jnp.zeros_like(carry["recv_b"]),
+                           carry["recv_b"])
+        seed_h = jnp.where(b_active, seed_h, jnp.zeros_like(seed_h))
+        seed_loss = jnp.where(is_last & b_active, inv_m, jnp.float32(0))
+        dsp, dhp, dhp_emb, dh_in = pull((seed_h, seed_loss))
+
+        bmask = lambda g: jnp.where(b_active, g, jnp.zeros_like(g))
+        dstage = jax.tree_util.tree_map(
+            lambda acc, g: acc + bmask(g), carry["dstage"], dsp)
+        dhead = jax.tree_util.tree_map(
+            lambda acc, g: acc + bmask(g), carry["dhead"], dhp)
+
+        # embedding backward (stage 0 only; other stages contribute zeros)
+        _, pull_e = jax.vjp(lambda ep: embed_fn(ep, tok_j), embed_params)
+        (dep,) = pull_e(jnp.where((my_stage == 0) & b_active, dh_in,
+                                  jnp.zeros_like(dh_in)))
+        demb = jax.tree_util.tree_map(
+            lambda acc, g, gh: acc + g + bmask(gh),
+            carry["demb"], dep, dhp_emb)
+
+        send_b = jax.lax.ppermute(bmask(dh_in), axis_name, perm_bwd)
+        loss = carry["loss"] + jnp.where(is_last & b_active,
+                                         loss_j * inv_m, 0.0)
+        return dict(recv_f=send_f, recv_b=send_b, buf=buf, demb=demb,
+                    dstage=dstage, dhead=dhead, loss=loss), None
+
+    carry, _ = jax.lax.scan(tick, carry0, jnp.arange(t_total))
+
+    # loss lives on the last stage; grads for replicated params only on
+    # their owning stages — psum over pp makes them correct everywhere.
+    loss = jax.lax.psum(jnp.where(my_stage == p - 1, carry["loss"], 0.0),
+                        axis_name)
+    demb = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g, axis_name), carry["demb"])
+    dhead = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g, axis_name), carry["dhead"])
+    dstage = jax.tree_util.tree_map(
+        lambda g: g[None], carry["dstage"])  # restore (1, ...) local stage dim
+    return loss, demb, dstage, dhead
+
+
+class OneFOneBPipeline:
+    """1F1B-scheduled pipelined LM: returns (loss, grads) directly (the
+    backward is part of the schedule, not autodiff of the forward).
+
+    Same parameter layout as PipelinedLM. With `tied_embed=True`,
+    head_loss_fn(head_params, embed_params, h, labels) may read the
+    embedding weight (tied softmax) and its gradient flows into the
+    embedding — reference SharedLayerDesc (pp_layers.py:76).
+    """
+
+    def __init__(self, mesh: Mesh, embed_fn, stage_fn, head_loss_fn,
+                 num_microbatches: int, axis_name: str = "pp",
+                 batch_axis: str | None = None, tied_embed: bool = False):
+        self.mesh = mesh
+        self.embed_fn = embed_fn
+        self.stage_fn = stage_fn
+        self.head_loss_fn = head_loss_fn
+        self.m = num_microbatches
+        self.axis = axis_name
+        self.batch_axis = batch_axis
+        self.tied_embed = tied_embed
+
+    def loss_and_grad_fn(self):
+        axis = self.axis
+        m = self.m
+        mesh = self.mesh
+        batch_axis = self.batch_axis
+        p_size = mesh.shape[axis]
+        tied = self.tied_embed
+
+        def spmd_grads(embed_params, stage_params, head_params, tokens,
+                       labels):
+            def inner(embed_p, stage_p, head_p, tok, lab):
+                b = tok.shape[0]
+                tok_mb = tok.reshape((m, b // m) + tok.shape[1:])
+                lab_mb = lab.reshape((m, b // m) + lab.shape[1:])
+                vary = (axis,) + ((batch_axis,) if batch_axis else ())
+                loss, demb, dstage, dhead = pipeline_1f1b_grads(
+                    self.embed_fn, self.stage_fn, self.head_loss_fn,
+                    embed_p, stage_p, head_p, tok_mb, lab_mb, axis,
+                    p_size=p_size, num_microbatches=m, vary_axes=vary,
+                    tied_embed=tied)
+                if batch_axis is not None:
+                    loss = jax.lax.pmean(loss, batch_axis)
+                    demb, dstage, dhead = jax.tree_util.tree_map(
+                        lambda g: jax.lax.pmean(g, batch_axis),
+                        (demb, dstage, dhead))
+                return loss, demb, dstage, dhead
+
+            data_spec = P(batch_axis) if batch_axis is not None else P()
+            in_specs = (
+                jax.tree_util.tree_map(lambda _: P(), embed_params),
+                jax.tree_util.tree_map(lambda _: P(axis), stage_params),
+                jax.tree_util.tree_map(lambda _: P(), head_params),
+                data_spec, data_spec,
+            )
+            out_specs = (
+                P(),
+                jax.tree_util.tree_map(lambda _: P(), embed_params),
+                jax.tree_util.tree_map(lambda _: P(axis), stage_params),
+                jax.tree_util.tree_map(lambda _: P(), head_params),
+            )
+            return shard_map(inner, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs)(
+                embed_params, stage_params, head_params, tokens, labels)
+
+        return spmd_grads
 
 
 class PipelinedLM:

@@ -1,0 +1,103 @@
+"""Sharded distributed checkpoint: dedup on save, reshard-on-load.
+
+reference capability: python/paddle/distributed/checkpoint/save_state_dict.py:145
+(per-rank shard files + metadata), :117 (replica dedup),
+load_state_dict.py (reshard onto a different mesh).
+"""
+
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.checkpoint import load_state_dict, save_state_dict
+
+
+def _mesh(shape, names):
+    devs = np.asarray(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+def _put(arr, mesh, spec):
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def test_save_dedups_replicated_chunks(tmp_path):
+    mesh = _mesh((4,), ("dp",))
+    x = _put(np.arange(16, dtype=np.float32).reshape(4, 4), mesh, P())  # replicated
+    save_state_dict({"w": x}, str(tmp_path))
+    with open(tmp_path / "shard_r0.data", "rb") as f:
+        chunks = pickle.load(f)
+    # replicated on 4 devices -> exactly ONE saved chunk
+    assert len(chunks["w"]) == 1
+    meta = json.load(open(tmp_path / "metadata.json"))
+    assert len(meta["arrays"]["w"]["chunks"]) == 1
+
+
+def test_sharded_save_writes_each_chunk_once(tmp_path):
+    mesh = _mesh((4, 2), ("dp", "mp"))
+    x = _put(np.arange(64, dtype=np.float32).reshape(8, 8), mesh, P("dp", "mp"))
+    save_state_dict({"w": x}, str(tmp_path))
+    with open(tmp_path / "shard_r0.data", "rb") as f:
+        chunks = pickle.load(f)
+    assert len(chunks["w"]) == 8  # 4x2 distinct chunks, one copy each
+    total = sum(c.size for c in chunks["w"].values())
+    assert total == 64  # no overlap / duplication
+
+
+def test_reshard_on_load_different_mesh(tmp_path):
+    src = _mesh((8,), ("dp",))
+    w = np.random.RandomState(0).randn(16, 8).astype(np.float32)
+    b = np.random.RandomState(1).randn(16).astype(np.float32)
+    state = {"w": _put(w, src, P("dp", None)), "b": _put(b, src, P())}
+    save_state_dict(state, str(tmp_path))
+
+    dst = _mesh((2, 2), ("dp", "mp"))
+    target = {"w": _put(jnp.zeros((16, 8), jnp.float32), dst, P("mp", "dp")),
+              "b": _put(jnp.zeros((16,), jnp.float32), dst, P("dp"))}
+    load_state_dict(target, str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(target["w"]), w)
+    np.testing.assert_array_equal(np.asarray(target["b"]), b)
+    assert target["w"].sharding.spec == P("mp", "dp")
+
+
+def test_load_onto_single_device(tmp_path):
+    src = _mesh((4,), ("dp",))
+    w = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    save_state_dict({"w": _put(w, src, P("dp", None))}, str(tmp_path))
+    target = {"w": jnp.zeros((8, 4), jnp.float32)}
+    load_state_dict(target, str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(target["w"]), w)
+
+
+def test_model_state_dict_roundtrip(tmp_path):
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    m = paddle.nn.Linear(4, 3)
+    sd = m.state_dict()
+    save_state_dict(sd, str(tmp_path), async_save=True)
+
+    paddle.seed(1)
+    m2 = paddle.nn.Linear(4, 3)
+    load_state_dict(m2.state_dict(), str(tmp_path))
+    for k, v in m.state_dict().items():
+        np.testing.assert_array_equal(np.asarray(v._data),
+                                      np.asarray(m2.state_dict()[k]._data))
+
+
+def test_shape_mismatch_raises(tmp_path):
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    m = paddle.nn.Linear(4, 3)
+    save_state_dict(m.state_dict(), str(tmp_path))
+    m3 = paddle.nn.Linear(5, 3)
+    with pytest.raises((ValueError, KeyError)):
+        load_state_dict(m3.state_dict(), str(tmp_path))

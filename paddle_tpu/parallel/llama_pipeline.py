@@ -19,18 +19,34 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..framework.core import Tensor
 from .functional import functional_call
-from .pipeline import PipelinedLM
+from .pipeline import OneFOneBPipeline, PipelinedLM
 
 __all__ = ["LlamaPipeRunner"]
 
 
 class LlamaPipeRunner:
+    """Run a LlamaForCausalLM under a pipeline schedule.
+
+    schedule: "FThenB" (fill-drain + autodiff backward; reference FThenB /
+    GPipe) or "1F1B" (hand-scheduled one-forward-one-backward with the O(P)
+    activation bound; reference pipeline_parallel.py:575). Tied embeddings
+    (config.tie_word_embeddings) are supported under 1F1B only — the schedule
+    routes the head's embedding cotangent into the embedding gradient
+    (reference SharedLayerDesc, pp_layers.py:76).
+    """
+
     def __init__(self, model, mesh: Mesh, num_microbatches: int,
                  axis_name: str = "pp", batch_axis: str | None = None,
-                 optimizer=None):
+                 optimizer=None, schedule: str = "FThenB"):
         self.model = model
         self.mesh = mesh
         self.axis = axis_name
+        schedule = {"fthenb": "FThenB", "1f1b": "1F1B"}.get(
+            schedule.lower().replace("-", ""), schedule)
+        if schedule not in ("FThenB", "1F1B"):
+            raise ValueError(f"unknown pipeline schedule: {schedule!r} "
+                             "(expected 'FThenB' or '1F1B')")
+        self.schedule = schedule
         cfg = model.config
         pp = mesh.shape[axis_name]
         L = cfg.num_hidden_layers
@@ -80,26 +96,44 @@ class LlamaPipeRunner:
                 h = functional_call(self._layer_template, layer_params, Tensor(h))
             return h
 
-        if "lm_head" not in self.head_params:
+        tied = "lm_head" not in self.head_params
+        if tied and schedule != "1F1B":
             raise NotImplementedError(
-                "tied embeddings with pipeline parallelism: keep "
-                "tie_word_embeddings=False (tied weights would need the "
-                "embedding resident on the last stage too)")
+                "tied embeddings need the 1F1B schedule "
+                "(LlamaPipeRunner(..., schedule='1F1B')), which routes the "
+                "head's embedding cotangent back into the embedding grad")
 
-        def head_loss_fn(hp, h, labels):
+        def _norm_logits(hp, proj_w_t, h, labels):
             h32 = h.astype(jnp.float32)
             ms = jnp.mean(h32 * h32, axis=-1, keepdims=True)
             h = (h32 * jax.lax.rsqrt(ms + eps)).astype(h.dtype) * hp["norm"]
-            logits = h @ hp["lm_head"]  # nn.Linear weight: (hidden, vocab)
+            logits = h @ proj_w_t
             lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
             tgt = labels[:, 1:]
             picked = jnp.take_along_axis(lp, tgt[..., None], -1)[..., 0]
             return -jnp.mean(picked)
 
-        self._plm = PipelinedLM(mesh, embed_fn, stage_fn, head_loss_fn,
-                                num_microbatches, axis_name,
-                                batch_axis=batch_axis)
-        self._loss_fn = self._plm.loss_fn()
+        def head_loss_fn(hp, h, labels):
+            return _norm_logits(hp, hp["lm_head"], h, labels)
+
+        def head_loss_fn_tied(hp, ep, h, labels):
+            return _norm_logits(hp, ep["weight"].T, h, labels)
+
+        if schedule == "1F1B":
+            self._pipe = OneFOneBPipeline(
+                mesh, embed_fn, stage_fn,
+                head_loss_fn_tied if tied else head_loss_fn,
+                num_microbatches, axis_name, batch_axis=batch_axis,
+                tied_embed=tied)
+            self._grads_fn = self._pipe.loss_and_grad_fn()
+            self._loss_fn = None
+        else:
+            self._plm = PipelinedLM(mesh, embed_fn, stage_fn, head_loss_fn,
+                                    num_microbatches, axis_name,
+                                    batch_axis=batch_axis)
+            self._loss_fn = self._plm.loss_fn()
+            self._grads_fn = None
+        self._jit_grads = None
         self._step = None
         self.step_count = 0
         if optimizer is not None:
@@ -113,16 +147,28 @@ class LlamaPipeRunner:
             }
 
     def loss(self, tokens, labels):
-        return self._loss_fn(self.embed_params, self.stage_params,
-                             self.head_params, tokens, labels)
+        if self._loss_fn is not None:
+            return self._loss_fn(self.embed_params, self.stage_params,
+                                 self.head_params, tokens, labels)
+        if self._jit_grads is None:
+            self._jit_grads = jax.jit(self._grads_fn)
+        loss, _, _, _ = self._jit_grads(self.embed_params, self.stage_params,
+                                        self.head_params, tokens, labels)
+        return loss
 
     def _build_step(self):
         loss_fn = self._loss_fn
+        grads_fn = self._grads_fn
         opt = self.optimizer
 
         def train_step(ep, sp, hp, states, tokens, labels, lr, step):
-            loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
-                ep, sp, hp, tokens, labels)
+            if grads_fn is not None:  # 1F1B: backward is part of the schedule
+                loss, demb, dstage, dhead = grads_fn(ep, sp, hp, tokens,
+                                                     labels)
+                grads = (demb, dstage, dhead)
+            else:
+                loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+                    ep, sp, hp, tokens, labels)
             new = []
             new_states = {}
             for name, params, g in (("embed", ep, grads[0]),

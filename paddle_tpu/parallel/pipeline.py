@@ -278,7 +278,8 @@ def pipeline_1f1b_grads(embed_fn, stage_fn, head_loss_fn, embed_params,
         seed_h = jnp.where(is_last, jnp.zeros_like(carry["recv_b"]),
                            carry["recv_b"])
         seed_h = jnp.where(b_active, seed_h, jnp.zeros_like(seed_h))
-        seed_loss = jnp.where(is_last & b_active, inv_m, jnp.float32(0))
+        seed_loss = _pvary(
+            jnp.where(is_last & b_active, inv_m, jnp.float32(0)), vary)
         dsp, dhp, dhp_emb, dh_in = pull((seed_h, seed_loss))
 
         bmask = lambda g: jnp.where(b_active, g, jnp.zeros_like(g))

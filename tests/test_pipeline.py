@@ -9,8 +9,10 @@ from jax.sharding import Mesh
 
 import paddle_tpu as paddle
 from paddle_tpu import optimizer
-from paddle_tpu.parallel.pipeline import PipelinedLM
+from paddle_tpu.parallel.pipeline import (
+    OneFOneBPipeline, PipelinedLM, pipeline_forward_interleaved, shard_map)
 from paddle_tpu.parallel.llama_pipeline import LlamaPipeRunner
+from jax.sharding import PartitionSpec as P
 
 
 class TestPipelineForward:
@@ -67,6 +69,188 @@ class TestPipelineForward:
                                        rtol=1e-4, atol=1e-6)
 
 
+def _toy(pstages, seed=0):
+    """Shared toy LM pieces: embed -> pstages residual stages -> softmax."""
+    mesh = Mesh(np.asarray(jax.devices()[:pstages]), ("pp",))
+    rs = np.random.RandomState(seed)
+    V, D = 64, 32
+    embed_w = jnp.asarray(rs.randn(V, D).astype(np.float32) * 0.1)
+    stage_w = jnp.asarray(rs.randn(pstages, D, D).astype(np.float32) * 0.1)
+    head_w = jnp.asarray(rs.randn(D, V).astype(np.float32) * 0.1)
+
+    def embed_fn(p, tok):
+        return p[tok]
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p) + h
+
+    def head_loss_fn(p, h, lab):
+        lp = jax.nn.log_softmax(h @ p, -1)
+        return -jnp.mean(jnp.take_along_axis(lp, lab[..., None], -1))
+
+    return mesh, embed_w, stage_w, head_w, embed_fn, stage_fn, head_loss_fn, rs
+
+
+class Test1F1BPipeline:
+    """The hand-scheduled 1F1B backward must match the sequential reference
+    at the same bar the fill-drain autodiff path passes."""
+
+    @pytest.mark.parametrize("p,m", [(4, 4), (4, 8), (2, 4)])
+    def test_grads_match_sequential(self, p, m):
+        (mesh, ew, sw, hw, embed_fn, stage_fn, head_loss_fn,
+         rs) = _toy(p)
+        pipe = OneFOneBPipeline(mesh, embed_fn, stage_fn, head_loss_fn,
+                                num_microbatches=m)
+        gf = jax.jit(pipe.loss_and_grad_fn())
+        tok = jnp.asarray(rs.randint(0, 64, (8, 16)), jnp.int32)
+        lab = jnp.asarray(rs.randint(0, 64, (8, 16)), jnp.int32)
+        loss, demb, dstage, dhead = gf(ew, sw, hw, tok, lab)
+
+        def ref(ew_, sw_, hw_):
+            h = ew_[tok]
+            for i in range(p):
+                h = stage_fn(sw_[i], h)
+            return head_loss_fn(hw_, h, lab)
+
+        rl, rg = jax.value_and_grad(ref, argnums=(0, 1, 2))(ew, sw, hw)
+        assert abs(float(loss) - float(rl)) < 1e-5
+        for a, b in zip((demb, dstage, dhead), rg):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_tied_embed_cotangent_flows(self):
+        """With tied_embed, the head's use of the embedding weight must
+        contribute to demb (reference SharedLayerDesc, pp_layers.py:76)."""
+        (mesh, ew, sw, hw, embed_fn, stage_fn, _,
+         rs) = _toy(4)
+
+        def head_loss_tied(hp, ep, h, lab):
+            lp = jax.nn.log_softmax((h * hp[None, None]) @ ep.T, -1)
+            return -jnp.mean(jnp.take_along_axis(lp, lab[..., None], -1))
+
+        gain = jnp.ones((32,), jnp.float32)
+        pipe = OneFOneBPipeline(mesh, embed_fn, stage_fn, head_loss_tied,
+                                num_microbatches=4, tied_embed=True)
+        gf = jax.jit(pipe.loss_and_grad_fn())
+        tok = jnp.asarray(rs.randint(0, 64, (8, 16)), jnp.int32)
+        lab = jnp.asarray(rs.randint(0, 64, (8, 16)), jnp.int32)
+        loss, demb, dstage, dhead = gf(ew, sw, gain, tok, lab)
+
+        def ref(ew_, sw_, hp_):
+            h = ew_[tok]
+            for i in range(4):
+                h = stage_fn(sw_[i], h)
+            return head_loss_tied(hp_, ew_, h, lab)
+
+        rl, rg = jax.value_and_grad(ref, argnums=(0, 1, 2))(ew, sw, gain)
+        assert abs(float(loss) - float(rl)) < 1e-5
+        np.testing.assert_allclose(np.asarray(demb), np.asarray(rg[0]),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(dstage), np.asarray(rg[1]),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(dhead), np.asarray(rg[2]),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_peak_memory_beats_fill_drain_at_many_microbatches(self):
+        """1F1B keeps O(P) live activations vs fill-drain's O(M): at m >> p
+        the compiled program's temp allocation must be smaller."""
+        p, m = 4, 32
+        (mesh, ew, sw, hw, embed_fn, stage_fn, head_loss_fn,
+         _) = _toy(p)
+        rs = np.random.RandomState(1)
+        tok = jnp.asarray(rs.randint(0, 64, (m, 64)), jnp.int32)
+        lab = jnp.asarray(rs.randint(0, 64, (m, 64)), jnp.int32)
+
+        pipe = OneFOneBPipeline(mesh, embed_fn, stage_fn, head_loss_fn,
+                                num_microbatches=m)
+        c_1f1b = jax.jit(pipe.loss_and_grad_fn()).lower(
+            ew, sw, hw, tok, lab).compile()
+
+        plm = PipelinedLM(mesh, embed_fn, stage_fn, head_loss_fn,
+                          num_microbatches=m, remat=False)
+        loss_fn = plm.loss_fn()
+        c_fd = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1, 2))).lower(
+            ew, sw, hw, tok, lab).compile()
+        try:
+            m1 = c_1f1b.memory_analysis()
+            m2 = c_fd.memory_analysis()
+            t1, t2 = m1.temp_size_in_bytes, m2.temp_size_in_bytes
+        except Exception as e:  # pragma: no cover - backend support varies
+            pytest.skip(f"memory_analysis unavailable on this backend: {e}")
+        assert t1 < t2, (t1, t2)
+
+
+class TestInterleavedPipeline:
+    """VPP forward (pipeline_forward_interleaved): outputs and autodiff
+    grads must match the sequential composition of all P*V chunks."""
+
+    @pytest.mark.parametrize("v,m_mult", [(2, 2), (2, 4), (3, 2)])
+    def test_matches_sequential(self, v, m_mult):
+        p = 4
+        m = m_mult * p
+        mesh = Mesh(np.asarray(jax.devices()[:p]), ("pp",))
+        rs = np.random.RandomState(0)
+        D = 16
+        # chunk weights: (p, v, D, D); virtual stage order is c*P + s
+        cw = jnp.asarray(rs.randn(p, v, D, D).astype(np.float32) * 0.1)
+        x = jnp.asarray(rs.randn(m, 4, D).astype(np.float32))
+
+        def stage_fn(w, h):
+            return jnp.tanh(h @ w) + h
+
+        def run(cw_, x_):
+            def inner(cw_l, x_l):
+                out = pipeline_forward_interleaved(
+                    stage_fn, cw_l, x_l, "pp", p_size=p, num_chunks=v,
+                    remat=False)
+                return out[None]  # (1, M, mb, D): valid on last stage only
+            stacked = shard_map(
+                inner, mesh=mesh,
+                in_specs=(P("pp"), P()), out_specs=P("pp"))(cw_, x_)
+            return stacked[-1]
+
+        out = jax.jit(run)(cw, x)
+
+        def seq(cw_, x_):
+            h = x_
+            for c in range(v):
+                for s in range(p):
+                    h = stage_fn(cw_[s, c], h)
+            return h
+
+        ref = seq(cw, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+        # autodiff grads through the interleaved schedule
+        def loss_pipe(cw_):
+            return jnp.mean(run(cw_, x) ** 2)
+
+        def loss_seq(cw_):
+            return jnp.mean(seq(cw_, x) ** 2)
+
+        g = jax.jit(jax.grad(loss_pipe))(cw)
+        gr = jax.grad(loss_seq)(cw)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_rejects_bad_microbatch_count(self):
+        p, v = 4, 2
+        mesh = Mesh(np.asarray(jax.devices()[:p]), ("pp",))
+        cw = jnp.zeros((p, v, 8, 8), jnp.float32)
+        x = jnp.zeros((6, 2, 8), jnp.float32)  # 6 % 4 != 0
+
+        def stage_fn(w, h):
+            return h @ w
+
+        with pytest.raises(ValueError, match="microbatches"):
+            def inner(cw_l, x_l):
+                return pipeline_forward_interleaved(
+                    stage_fn, cw_l, x_l, "pp", p_size=p, num_chunks=v)[None]
+            shard_map(inner, mesh=mesh, in_specs=(P("pp"), P()),
+                      out_specs=P("pp"))(cw, x)
+
+
 class TestLlamaPipeline:
     def test_matches_eager_and_trains(self):
         paddle.seed(0)
@@ -89,6 +273,61 @@ class TestLlamaPipeline:
         opt = optimizer.AdamW(1e-3, parameters=model.parameters())
         runner = LlamaPipeRunner(model, mesh, num_microbatches=2,
                                  batch_axis="dp", optimizer=opt)
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 512, (8, 16)),
+                          jnp.int32)
+        pl = float(runner.loss(ids, ids))
+        el, _ = model(paddle.Tensor(ids), labels=paddle.Tensor(ids))
+        assert abs(pl - float(el)) < 1e-3
+        losses = [float(runner.step(ids, ids)) for _ in range(3)]
+        assert losses[-1] < losses[0]
+
+    def test_1f1b_schedule_matches_eager_and_trains(self):
+        paddle.seed(0)
+        model = paddle.models.llama_tiny(num_hidden_layers=4)
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("pp",))
+        opt = optimizer.AdamW(1e-3, parameters=model.parameters())
+        runner = LlamaPipeRunner(model, mesh, num_microbatches=4,
+                                 optimizer=opt, schedule="1F1B")
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 512, (4, 16)),
+                          jnp.int32)
+        pl = float(runner.loss(ids, ids))
+        el, _ = model(paddle.Tensor(ids), labels=paddle.Tensor(ids))
+        assert abs(pl - float(el)) < 1e-4
+        losses = [float(runner.step(ids, ids)) for _ in range(3)]
+        assert losses[-1] < losses[0]
+
+    def test_1f1b_tied_embeddings(self):
+        paddle.seed(0)
+        model = paddle.models.llama_tiny(num_hidden_layers=2,
+                                         tie_word_embeddings=True)
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("pp",))
+        opt = optimizer.AdamW(1e-3, parameters=model.parameters())
+        runner = LlamaPipeRunner(model, mesh, num_microbatches=2,
+                                 optimizer=opt, schedule="1F1B")
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 512, (4, 16)),
+                          jnp.int32)
+        pl = float(runner.loss(ids, ids))
+        el, _ = model(paddle.Tensor(ids), labels=paddle.Tensor(ids))
+        assert abs(pl - float(el)) < 1e-4
+        losses = [float(runner.step(ids, ids)) for _ in range(3)]
+        assert losses[-1] < losses[0]
+
+    def test_tied_embeddings_requires_1f1b(self):
+        paddle.seed(0)
+        model = paddle.models.llama_tiny(num_hidden_layers=2,
+                                         tie_word_embeddings=True)
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("pp",))
+        with pytest.raises(NotImplementedError, match="1F1B"):
+            LlamaPipeRunner(model, mesh, num_microbatches=2)
+
+    def test_1f1b_with_dp_batch_axis(self):
+        paddle.seed(0)
+        model = paddle.models.llama_tiny(num_hidden_layers=2)
+        mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2), ("pp", "dp"))
+        opt = optimizer.AdamW(1e-3, parameters=model.parameters())
+        runner = LlamaPipeRunner(model, mesh, num_microbatches=2,
+                                 batch_axis="dp", optimizer=opt,
+                                 schedule="1F1B")
         ids = jnp.asarray(np.random.RandomState(0).randint(0, 512, (8, 16)),
                           jnp.int32)
         pl = float(runner.loss(ids, ids))

@@ -9,6 +9,7 @@ from .loss import *  # noqa: F401,F403
 from .attention import (  # noqa: F401
     scaled_dot_product_attention,
     flash_attention as _flash_attention_full,
+    flash_attn_unpadded,
     sdp_kernel,
 )
 from .common import flash_attention  # noqa: F401

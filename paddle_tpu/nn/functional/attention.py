@@ -96,21 +96,65 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
                     rng_name="", training=True, name=None):
     out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
                                        is_causal=causal, training=training)
-    return (out, None) if return_softmax is not None else out
+    return out, None
 
 
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
-                        causal=False, return_softmax=False, name=None):
-    """Varlen attention: fall back to a dense call per the max length with
-    masking derived from cu_seqlens (XLA wants static shapes)."""
+                        causal=False, return_softmax=False, *,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen (packed/ragged) attention.
+
+    reference: python/paddle/nn/functional/flash_attention.py
+    flash_attn_unpadded (varlen FlashAttention-2 over cu_seqlens).
+
+    TPU design: XLA wants static shapes, so the packed (total_tokens,
+    heads, dim) layout is gathered into a padded (batch, max_seqlen, ...)
+    batch using the static max_seqlen_q/k, attention runs once batched
+    with a per-sequence length mask (O(batch * max_len^2) memory, not
+    O(total^2)), and results scatter back to the packed layout.
+    cu_seqlens_*: (batch+1,) int32 prefix sums.
+    """
+    dropout_p = dropout if training else 0.0
+    dropout_key = next_key() if dropout_p > 0.0 else None
+    mq, mk = int(max_seqlen_q), int(max_seqlen_k)
+
     def f(q, k, v, cq, ck):
-        # q: (total_q, heads, dim) packed; reconstruct batch mask
-        nb = cq.shape[0] - 1
-        raise NotImplementedError
-    raise NotImplementedError(
-        "flash_attn_unpadded: pack sequences and use scaled_dot_product_attention "
-        "with an attention mask (static-shape TPU design)")
+        tq = q.shape[0]
+        tk = k.shape[0]
+        len_q = cq[1:] - cq[:-1]                       # (nb,)
+        len_k = ck[1:] - ck[:-1]
+        iq = cq[:-1, None] + jnp.arange(mq)[None]      # (nb, mq)
+        ik = ck[:-1, None] + jnp.arange(mk)[None]
+        valid_q = jnp.arange(mq)[None] < len_q[:, None]
+        valid_k = jnp.arange(mk)[None] < len_k[:, None]
+        qb = q[jnp.clip(iq, 0, tq - 1)]                # (nb, mq, h, d)
+        kb = k[jnp.clip(ik, 0, tk - 1)]
+        vb = v[jnp.clip(ik, 0, tk - 1)]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qb, kb,
+                            preferred_element_type=jnp.float32) * scale
+        mask = valid_q[:, None, :, None] & valid_k[:, None, None, :]
+        if causal:
+            # positions within each sequence start at 0 on both sides
+            mask = mask & (jnp.arange(mq)[:, None] >= jnp.arange(mk)[None, :])
+        logits = jnp.where(mask, logits, jnp.float32(-1e30))
+        probs = jax.nn.softmax(logits, axis=-1)
+        probs = jnp.where(mask, probs, 0.0)            # fully-masked pad rows
+        if dropout_key is not None:
+            keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p,
+                                        probs.shape)
+            probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+        probs = probs.astype(v.dtype)
+        outb = jnp.einsum("bhqk,bkhd->bqhd", probs, vb)
+        # scatter back to packed rows; pad rows route out of range and drop
+        flat_idx = jnp.where(valid_q, iq, tq).reshape(-1)
+        return jnp.zeros_like(q).at[flat_idx].set(
+            outb.reshape(-1, *outb.shape[2:]), mode="drop")
+
+    out = execute(f, query, key, value, cu_seqlens_q, cu_seqlens_k,
+                  _name="flash_attn_unpadded")
+    return out, None
 
 
 class sdp_kernel:

@@ -1,23 +1,29 @@
-"""Flash attention forward as a Pallas TPU kernel.
+"""Flash attention (forward + backward) as Pallas TPU kernels.
 
-reference capability: paddle/phi/kernels/gpu/flash_attn_kernel.cu
-(FlashAttention-2 via dynload) + python/paddle/nn/functional/flash_attention.py.
+reference capability: paddle/phi/kernels/gpu/flash_attn_kernel.cu and
+flash_attn_grad_kernel.cu (FlashAttention-2 via dynload) +
+python/paddle/nn/functional/flash_attention.py.
 
 TPU-native design (not a CUDA port):
-- Grid over (batch*heads, q_blocks); K/V for the (batch, head) live in VMEM
-  (fits to ~8k sequence at head_dim 128 in bf16), the q block streams
-  through the online-softmax loop over K blocks — the classic
-  numerically-stable running (m, l, acc) recurrence.
-- MXU does the two matmuls per block with fp32 accumulation
-  (preferred_element_type); VPU does the softmax pieces.
-- Causal: K blocks strictly above the diagonal are skipped via @pl.when
-  (no wasted FLOPs), the diagonal block is masked with broadcasted_iota.
-- Backward: jax.custom_vjp whose bwd rematerializes through the XLA
-  attention (jax.checkpoint-style) — fwd gets the handwritten kernel,
-  bwd gets XLA's fused flash-style backward. A handwritten bwd kernel is
-  a later optimization, not a correctness requirement.
+- Forward: grid (batch*heads, q_blocks, k_blocks). Q/K/V blocks are DMA'd
+  per grid step by BlockSpec — no whole-K/V-in-VMEM residency, so sequence
+  length is bounded by HBM, not VMEM. The online-softmax running
+  (m, l, acc) state lives in VMEM scratch that persists across the
+  (sequential, innermost) k-block grid dimension. The forward also emits
+  the per-row logsumexp for the backward.
+- Backward: the FlashAttention-2 split. delta = rowsum(dO * O) is a cheap
+  XLA elementwise reduce. dQ kernel: grid (bh, q_blocks, k_blocks),
+  accumulates scale * dS @ K into VMEM scratch. dK/dV kernel: grid
+  (bh, k_blocks, q_blocks), accumulates dS^T @ Q and P^T @ dO. P is
+  rematerialized per block from (Q, K, lse) — nothing O(S^2) is ever
+  stored.
+- MXU does the matmuls with fp32 accumulation (preferred_element_type);
+  VPU does the softmax pieces. Causal: blocks strictly above the diagonal
+  skip compute via @pl.when; the diagonal block is masked with
+  broadcasted_iota. Cross-length causal uses the bottom-right-aligned
+  convention (offset = seq_k - seq_q), matching the dense reference.
 
-On non-TPU backends the kernel runs under the Pallas interpreter (tests).
+On non-TPU backends the kernels run under the Pallas interpreter (tests).
 """
 
 from __future__ import annotations
@@ -30,57 +36,174 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+_LANES = 128  # store per-row scalars broadcast across one lane tile
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-               scale: float, seq_k: int, block_q: int, mask_k_tail: bool):
+def _causal_mask(s, qi, kj, block_q, block_k, offset):
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(rows + offset >= cols, s, NEG_INF)
+
+
+def _ktail_mask(s, kj, block_q, block_k, seq_k):
+    cols = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(cols < seq_k, s, NEG_INF)
+
+
+def _block_needed(qi, kj, block_q, block_k, causal, offset):
+    if not causal:
+        return True
+    # any (row, col) with row + offset >= col in this block pair?
+    return (qi * block_q + block_q - 1 + offset) >= (kj * block_k)
+
+
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
+                   causal: bool, scale: float, seq_k: int, block_q: int,
+                   block_k: int, offset: int, mask_k_tail: bool):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
-    d = q.shape[-1]
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
 
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
 
-    num_kb = pl.cdiv(seq_k, block_k)
-
-    def body(j, carry):
-        m, l, acc = carry
-
-        def compute():
-            k = k_ref[0, pl.ds(j * block_k, block_k), :]
-            v = v_ref[0, pl.ds(j * block_k, block_k), :]
-            s = jax.lax.dot_general(
-                q, k.astype(jnp.float32),
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)  # (block_q, block_k)
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            if mask_k_tail:
-                # K/V are padded to a block multiple: mask padded columns
-                s = jnp.where(cols < seq_k, s, NEG_INF)
-            if causal:
-                rows = qi * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0)
-                s = jnp.where(rows >= cols, s, NEG_INF)
-            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-            p = jnp.exp(s - m_new)
-            alpha = jnp.exp(m - m_new)
-            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-            acc_new = acc * alpha + jax.lax.dot_general(
-                p, v.astype(jnp.float32),
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            return m_new, l_new, acc_new
-
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)          # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)   # (block_q, block_k)
+        if mask_k_tail:
+            s = _ktail_mask(s, kj, block_q, block_k, seq_k)
         if causal:
-            # skip blocks strictly above the diagonal of this q block
-            needed = (j * block_k) <= (qi * block_q + block_q - 1)
-            return jax.lax.cond(needed, compute, lambda: (m, l, acc))
-        return compute()
+            s = _causal_mask(s, qi, kj, block_q, block_k, offset)
+        m_prev = m_s[...][:, :1]
+        l_prev = l_s[...][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_s[...] = acc_s[...] * alpha + jax.lax.dot_general(
+            p, v, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
 
-    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    if causal:
+        pl.when(_block_needed(qi, kj, block_q, block_k, causal, offset))(
+            _compute)
+    else:
+        _compute()
+
+    @pl.when(kj == nk - 1)
+    def _flush():
+        l = jnp.maximum(l_s[...][:, :1], 1e-30)
+        o_ref[0] = (acc_s[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_s[...][:, :1] + jnp.log(l))[:, 0]
+
+
+def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                  dq_s, *, causal: bool, scale: float, seq_k: int,
+                  block_q: int, block_k: int, offset: int,
+                  mask_k_tail: bool):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]                 # (block_q, 1)
+        delta = delta_ref[0][:, None]
+        s = scale * jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if mask_k_tail:
+            s = _ktail_mask(s, kj, block_q, block_k, seq_k)
+        if causal:
+            s = _causal_mask(s, qi, kj, block_q, block_k, offset)
+        p = jnp.exp(s - lse)                      # (block_q, block_k)
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_s[...] += scale * jax.lax.dot_general(
+            ds, k, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(_block_needed(qi, kj, block_q, block_k, causal, offset))(
+            _compute)
+    else:
+        _compute()
+
+    @pl.when(kj == nk - 1)
+    def _flush():
+        dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
+
+
+def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dk_ref, dv_ref, dk_s, dv_s, *, causal: bool, scale: float,
+                   seq_k: int, block_q: int, block_k: int, offset: int,
+                   mask_k_tail: bool):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = scale * jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if mask_k_tail:
+            s = _ktail_mask(s, kj, block_q, block_k, seq_k)
+        if causal:
+            s = _causal_mask(s, qi, kj, block_q, block_k, offset)
+        p = jnp.exp(s - lse)
+        dv_s[...] += jax.lax.dot_general(
+            p, do, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)   # (block_k, d)
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_s[...] += scale * jax.lax.dot_general(
+            ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(_block_needed(qi, kj, block_q, block_k, causal, offset))(
+            _compute)
+    else:
+        _compute()
+
+    @pl.when(qi == nq - 1)
+    def _flush():
+        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
 
 
 def _pad_to(x, axis, multiple):
@@ -93,42 +216,139 @@ def _pad_to(x, axis, multiple):
     return jnp.pad(x, pads)
 
 
+def _interpret_default():
+    return jax.default_backend() != "tpu"
+
+
+def _block_sizes(sq, sk, block_q, block_k):
+    return min(block_q, sq), min(block_k, sk)
+
+
 def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=128, block_k=128,
                     interpret=None):
-    """q/k/v: (BH, S, D). Ragged sequence lengths are padded to block
-    multiples; padded K columns are masked in-kernel, padded Q rows sliced
-    off on return (so results are exact for any length)."""
+    """q/k/v: (BH, S, D) -> (out (BH, Sq, D), lse (BH, Sq_padded) f32).
+
+    Ragged sequence lengths are padded to block multiples; padded K columns
+    are masked in-kernel, padded Q rows sliced off on return (so results
+    are exact for any length)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    block_q, block_k = _block_sizes(sq, sk, block_q, block_k)
     q_p = _pad_to(q, 1, block_q)
     k_p = _pad_to(k, 1, block_k)
     v_p = _pad_to(v, 1, block_k)
     sq_p, sk_p = q_p.shape[1], k_p.shape[1]
     mask_k_tail = sk_p != sk
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    grid = (bh, sq_p // block_q)
-    kernel = functools.partial(_fa_kernel, block_k=block_k, causal=causal,
-                               scale=scale, seq_k=sk, block_q=block_q,
-                               mask_k_tail=mask_k_tail)
-    out = pl.pallas_call(
+        interpret = _interpret_default()
+    grid = (bh, sq_p // block_q, sk_p // block_k)
+    kernel = functools.partial(
+        _fa_fwd_kernel, causal=causal, scale=scale, seq_k=sk,
+        block_q=block_q, block_k=block_k, offset=sk - sq,
+        mask_k_tail=mask_k_tail)
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sk_p, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk_p, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq_p), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
         interpret=interpret,
     )(q_p, k_p, v_p)
-    return out[:, :sq]
+    return out[:, :sq], lse
+
+
+def _flash_bwd_bhsd(q, k, v, o, lse, g, causal, scale, block_q=128,
+                    block_k=128, interpret=None):
+    """FlashAttention-2 backward: returns (dq, dk, dv), all in input dtype."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q, block_k = _block_sizes(sq, sk, block_q, block_k)
+    if interpret is None:
+        interpret = _interpret_default()
+
+    # delta = rowsum(dO * O): cheap XLA elementwise+reduce, fp32
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    q_p = _pad_to(q, 1, block_q)
+    do_p = _pad_to(g, 1, block_q)
+    delta_p = _pad_to(delta, 1, block_q)
+    k_p = _pad_to(k, 1, block_k)
+    v_p = _pad_to(v, 1, block_k)
+    sq_p, sk_p = q_p.shape[1], k_p.shape[1]
+    # lse from the forward is already padded to a block_q multiple of the
+    # forward's padding; re-pad defensively (values for pad rows are finite,
+    # and pad-row contributions vanish because dO pad rows are zero).
+    lse_p = _pad_to(lse, 1, block_q)[:, :sq_p]
+    mask_k_tail = sk_p != sk
+    offset = sk - sq
+    common = dict(causal=causal, scale=scale, seq_k=sk, block_q=block_q,
+                  block_k=block_k, offset=offset, mask_k_tail=mask_k_tail)
+
+    nq, nk = sq_p // block_q, sk_p // block_k
+
+    dq = pl.pallas_call(
+        functools.partial(_fa_dq_kernel, **common),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q_p, k_p, v_p, do_p, lse_p, delta_p)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_dkv_kernel, **common),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk_p, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk_p, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q_p, k_p, v_p, do_p, lse_p, delta_p)
+
+    return dq[:, :sq], dk[:, :sk], dv[:, :sk]
 
 
 def _xla_attention_bhsd(q, k, v, causal, scale):
+    """Dense reference (O(S^2) memory). Used by tests and tiny shapes."""
     s = jnp.einsum("bqd,bkd->bqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
@@ -141,18 +361,18 @@ def _xla_attention_bhsd(q, k, v, causal, scale):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_attention_bhsd(q, k, v, causal, scale):
-    return _flash_fwd_bhsd(q, k, v, causal, scale)
+    out, _ = _flash_fwd_bhsd(q, k, v, causal, scale)
+    return out
 
 
 def _fa_fwd(q, k, v, causal, scale):
-    return _flash_fwd_bhsd(q, k, v, causal, scale), (q, k, v)
+    out, lse = _flash_fwd_bhsd(q, k, v, causal, scale)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, scale, res, g):
-    q, k, v = res
-    _, vjp_fn = jax.vjp(lambda q_, k_, v_: _xla_attention_bhsd(
-        q_, k_, v_, causal, scale), q, k, v)
-    return vjp_fn(g)
+    q, k, v, o, lse = res
+    return _flash_bwd_bhsd(q, k, v, o, lse, g, causal, scale)
 
 
 _flash_attention_bhsd.defvjp(_fa_fwd, _fa_bwd)

@@ -69,8 +69,13 @@ class DygraphShardingOptimizer:
         return getattr(self._inner_opt, item)
 
     def step(self):
+        """Eager-mode fallback: runs the inner step, then re-lays-out the
+        optimizer state over the sharding axis. This bounds steady-state
+        memory but NOT peak memory (the full state materializes first) —
+        for the real in-step ZeRO partition use
+        parallel.SpmdTrainer(sharding_stage=1/2/3), which applies the
+        partition via in/out_shardings inside the jitted update."""
         self._inner_opt.step()
-        # shard the (possibly just-created) optimizer states over dp/sharding
         hcg = self._hcg
         if hcg is None:
             from . import get_hybrid_communicate_group
@@ -84,8 +89,12 @@ class DygraphShardingOptimizer:
                     if sh is not None:
                         try:
                             st[k] = jax.device_put(v, sh)
-                        except ValueError:
-                            pass
+                        except ValueError as e:
+                            import warnings
+                            warnings.warn(
+                                f"ZeRO resharding of optimizer state "
+                                f"{pid}/{k} failed ({e}); state stays "
+                                f"replicated", RuntimeWarning)
 
     def clear_grad(self, set_to_zero=False):
         self._inner_opt.clear_grad(set_to_zero)

@@ -5,9 +5,12 @@ reference: python/paddle/distributed/sharding/group_sharded.py
 fleet/meta_parallel/sharding/).
 
 TPU-native ZeRO: optimizer states / grads / params are arrays — stage N is a
-sharding spec on those arrays over the dp axis, applied by fleet's
-DygraphShardingOptimizer analog in fleet.meta_optimizers. This facade keeps
-the reference's one-call API.
+sharding spec on those arrays over the 'sharding' mesh axis. The real
+implementation is parallel.SpmdTrainer(sharding_stage=1/2/3), which keeps
+the partition inside the jitted step (opt-state partition at stage 1, grad
+reduce-scatter at stage 2, param partition with gather-on-use at stage 3).
+This facade keeps the reference's one-call eager API on top of the
+steady-state eager fallback in fleet.meta_optimizers.
 """
 
 from __future__ import annotations

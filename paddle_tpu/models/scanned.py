@@ -1,0 +1,68 @@
+"""Scan-over-layers Llama train step for compile-light large models.
+
+reference capability: the reference trains deep stacks as per-layer ops in
+one program; on TPU an unrolled 24+ layer trace produces an HLO whose size
+scales with depth (slow/failing compiles). Here the decoder stack is a
+single lax.scan over stacked per-layer parameters — HLO size is O(1) in
+depth, XLA compiles one layer body, and per-layer rematerialization
+(jax.checkpoint on the body) gives the standard activation-memory trade.
+
+Used by bench.py for the >=780M ladder configs; numerics match the
+imperative LlamaForCausalLM (tests/test_models.py::TestScannedLlama).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..parallel.functional import (functional_call, rmsnorm_lm_loss,
+                                   split_stacked_layer_params)
+
+__all__ = ["build_scanned_llama"]
+
+
+def build_scanned_llama(model, remat: bool = True, dtype=None):
+    """Split a LlamaForCausalLM's state into (embed, stacked layers, head)
+    and return (params, loss_fn) where loss_fn(params, ids, labels) is a
+    pure scalar LM loss whose decoder stack is one lax.scan.
+
+    params = {"embed": {...}, "layers": {name: (L, ...)}, "head": {...}}.
+    """
+    cfg = model.config
+    state = {k: v._data for k, v in model.state_dict().items()}
+    if dtype is not None:
+        from ..framework import dtypes as _dt
+        dt = _dt.convert_dtype(dtype)
+        state = {k: v.astype(dt) if jnp.issubdtype(v.dtype, jnp.floating)
+                 else v for k, v in state.items()}
+
+    layers, other = split_stacked_layer_params(state)
+
+    params = {
+        "embed": {"weight": other["llama.embed_tokens.weight"]},
+        "layers": layers,
+        "head": {"norm": other["llama.norm.weight"]},
+    }
+    tied = "lm_head.weight" not in other
+    if not tied:
+        params["head"]["lm_head"] = other["lm_head.weight"]
+
+    template = model.llama.layers[0]
+    eps = cfg.rms_norm_eps
+
+    def layer_body(h, lp):
+        h = functional_call(template, lp, Tensor(h))
+        return h, None
+
+    body = jax.checkpoint(layer_body) if remat else layer_body
+
+    def loss_fn(p, ids, labels):
+        h = jnp.take(p["embed"]["weight"], ids, axis=0)
+        h, _ = jax.lax.scan(body, h, p["layers"])
+        w = (p["embed"]["weight"].T if tied
+             else p["head"]["lm_head"])  # nn.Linear weight: (hidden, vocab)
+        return rmsnorm_lm_loss(p["head"]["norm"], w, h, labels, eps)
+
+    return params, loss_fn

@@ -136,8 +136,12 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                             preferred_element_type=jnp.float32) * scale
         mask = valid_q[:, None, :, None] & valid_k[:, None, None, :]
         if causal:
-            # positions within each sequence start at 0 on both sides
-            mask = mask & (jnp.arange(mq)[:, None] >= jnp.arange(mk)[None, :])
+            # bottom-right alignment per sequence (FlashAttention-2 varlen
+            # convention, same as the dense reference's tril(k=len_k-len_q)):
+            # query i of sequence b sees keys j with i + len_k[b]-len_q[b] >= j
+            off = (len_k - len_q)[:, None, None, None]
+            mask = mask & (jnp.arange(mq)[:, None] + off
+                           >= jnp.arange(mk)[None, :])
         logits = jnp.where(mask, logits, jnp.float32(-1e30))
         probs = jax.nn.softmax(logits, axis=-1)
         probs = jnp.where(mask, probs, 0.0)            # fully-masked pad rows

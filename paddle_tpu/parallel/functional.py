@@ -76,3 +76,40 @@ def make_loss_fn(model, loss_fn: Callable | None = None):
         return loss.astype(jnp.float32) if hasattr(loss, "astype") else loss
 
     return pure_loss
+
+
+def split_stacked_layer_params(state: dict,
+                               pattern: str = r"^llama\.layers\.(\d+)\.(.+)$"):
+    """Split a name->array state dict into (stacked, other): parameters whose
+    names match `pattern` are grouped by suffix and stacked on a new leading
+    layer dim (L, ...); everything else passes through. Shared by the
+    pipeline runner (which reshapes to (pp, L/pp, ...)) and the
+    scan-over-layers model."""
+    import re as _re
+    rx = _re.compile(pattern)
+    per_layer: dict = {}
+    other: dict = {}
+    for k, v in state.items():
+        m = rx.match(k)
+        if m:
+            per_layer.setdefault(m.group(2), []).append((int(m.group(1)), v))
+        else:
+            other[k] = v
+    stacked = {}
+    for name, items in per_layer.items():
+        items.sort()
+        stacked[name] = jnp.stack([v for _, v in items])
+    return stacked, other
+
+
+def rmsnorm_lm_loss(norm_w, proj_w_t, h, labels, eps):
+    """Final RMSNorm -> projection -> next-token cross-entropy, fp32 softmax.
+    proj_w_t: (hidden, vocab) — pass embed_weight.T for tied embeddings."""
+    h32 = h.astype(jnp.float32)
+    ms = jnp.mean(h32 * h32, axis=-1, keepdims=True)
+    h = (h32 * jax.lax.rsqrt(ms + eps)).astype(h.dtype) * norm_w
+    logits = h @ proj_w_t
+    lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
+    tgt = labels[:, 1:]
+    picked = jnp.take_along_axis(lp, tgt[..., None], -1)[..., 0]
+    return -jnp.mean(picked)

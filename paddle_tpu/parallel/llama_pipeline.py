@@ -11,14 +11,14 @@ schedules, realized as one compiled SPMD program.
 
 from __future__ import annotations
 
-import re
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..framework.core import Tensor
-from .functional import functional_call
+from .functional import (functional_call, rmsnorm_lm_loss,
+                         split_stacked_layer_params)
 from .pipeline import OneFOneBPipeline, PipelinedLM
 
 __all__ = ["LlamaPipeRunner"]
@@ -55,20 +55,10 @@ class LlamaPipeRunner:
         self.optimizer = optimizer
 
         state = {k: v._data for k, v in model.state_dict().items()}
-        layer_re = re.compile(r"^llama\.layers\.(\d+)\.(.+)$")
-        per_layer: dict[str, list] = {}
-        other = {}
-        for k, v in state.items():
-            m = layer_re.match(k)
-            if m:
-                per_layer.setdefault(m.group(2), []).append((int(m.group(1)), v))
-            else:
-                other[k] = v
-        # stack layer params: (L, ...) -> (pp, L/pp, ...)
+        stacked, other = split_stacked_layer_params(state)
+        # reshape layer params: (L, ...) -> (pp, L/pp, ...), sharded on pp
         self.stage_params = {}
-        for name, items in per_layer.items():
-            items.sort()
-            arr = jnp.stack([v for _, v in items])
+        for name, arr in stacked.items():
             arr = arr.reshape((pp, self.layers_per_stage) + arr.shape[1:])
             self.stage_params[name] = jax.device_put(
                 arr, NamedSharding(mesh, P(*( [axis_name] + [None] * (arr.ndim - 1)))))
@@ -103,21 +93,11 @@ class LlamaPipeRunner:
                 "(LlamaPipeRunner(..., schedule='1F1B')), which routes the "
                 "head's embedding cotangent back into the embedding grad")
 
-        def _norm_logits(hp, proj_w_t, h, labels):
-            h32 = h.astype(jnp.float32)
-            ms = jnp.mean(h32 * h32, axis=-1, keepdims=True)
-            h = (h32 * jax.lax.rsqrt(ms + eps)).astype(h.dtype) * hp["norm"]
-            logits = h @ proj_w_t
-            lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
-            tgt = labels[:, 1:]
-            picked = jnp.take_along_axis(lp, tgt[..., None], -1)[..., 0]
-            return -jnp.mean(picked)
-
         def head_loss_fn(hp, h, labels):
-            return _norm_logits(hp, hp["lm_head"], h, labels)
+            return rmsnorm_lm_loss(hp["norm"], hp["lm_head"], h, labels, eps)
 
         def head_loss_fn_tied(hp, ep, h, labels):
-            return _norm_logits(hp, ep["weight"].T, h, labels)
+            return rmsnorm_lm_loss(hp["norm"], ep["weight"].T, h, labels, eps)
 
         if schedule == "1F1B":
             self._pipe = OneFOneBPipeline(

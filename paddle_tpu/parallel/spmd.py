@@ -99,21 +99,51 @@ def shard_params_by_rules(params: dict, mesh: Mesh, rules) -> dict:
     return out
 
 
+def _with_zero_axis(spec: P, shape, mesh: Mesh, axis: str = "sharding") -> P:
+    """Add the ZeRO 'sharding' axis to the first unsharded, divisible dim.
+
+    reference capability: fleet/meta_parallel/sharding partitions flat param
+    shards by rank (group_sharded_stage3.py:85); here the partition is a
+    dimension sharding GSPMD understands, so gather-on-use / reduce-scatter
+    come out of the compiler instead of hand-written collectives."""
+    n = mesh.shape[axis]
+    parts = list(spec) + [None] * (len(shape) - len(list(spec)))
+    for dim, s in enumerate(parts):
+        if s is None and shape[dim] % n == 0 and shape[dim] >= n:
+            parts[dim] = axis
+            return P(*parts)
+    return P(*parts)
+
+
 class SpmdTrainer:
     """Compiled hybrid-parallel training loop.
 
     - params + optimizer state live as sharded jax arrays (donated each step)
     - batch sharded on dp (+sep for the sequence dim)
     - loss/grads computed in one jit; XLA handles every collective
+    - sharding_stage (ZeRO over the 'sharding' mesh axis, reference
+      DygraphShardingOptimizer:53 / group_sharded_stage3.py:85):
+        1 = optimizer states partitioned (update math runs sharded, params
+            all-gathered by the compiler after the update)
+        2 = + gradients reduce-scattered onto the sharding axis
+        3 = + parameters partitioned, gathered on use by GSPMD
+      All three keep the partitioning INSIDE the jitted step via
+      in/out_shardings + with_sharding_constraint — no post-hoc device_put.
     """
 
     def __init__(self, model, optimizer, mesh: Mesh, rules=None, loss_fn=None,
                  batch_spec: P | None = None, remat: bool = False,
-                 dtype=None):
+                 dtype=None, sharding_stage: int = 0):
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
         self.rules = rules or DP_ONLY_RULES
+        self.sharding_stage = int(sharding_stage)
+        if (self.sharding_stage and "sharding" in mesh.axis_names
+                and mesh.shape["sharding"] > 1):
+            self._zero_axis = "sharding"
+        else:
+            self._zero_axis = None
         state = model.state_dict()
         if dtype is not None:
             from ..framework import dtypes as _dt
@@ -123,12 +153,30 @@ class SpmdTrainer:
                     t._data = t._data.astype(dt)
         self.param_names = list(state.keys())
         self.params = shard_params_by_rules(state, mesh, self.rules)
-        # optimizer states shard like their params
+        # ZeRO grad/opt-state partition specs, derived from the param specs
+        self._zero_specs = {}
+        for name, a in self.params.items():
+            base = a.sharding.spec
+            if self._zero_axis is not None:
+                self._zero_specs[name] = _with_zero_axis(
+                    base, a.shape, mesh, self._zero_axis)
+            else:
+                self._zero_specs[name] = base
+        if self._zero_axis is not None and self.sharding_stage >= 3:
+            self.params = {
+                name: jax.device_put(
+                    a, NamedSharding(mesh, self._zero_specs[name]))
+                for name, a in self.params.items()}
+        # optimizer states shard like their params (ZeRO>=1: partitioned)
         self.opt_state = {}
         for name, a in self.params.items():
             st = optimizer.init_state(a)
+            if self._zero_axis is not None:
+                state_sh = NamedSharding(mesh, self._zero_specs[name])
+            else:
+                state_sh = a.sharding
             self.opt_state[name] = {
-                k: jax.device_put(v, a.sharding) if v.shape == a.shape
+                k: jax.device_put(v, state_sh) if v.shape == a.shape
                 else jax.device_put(v, NamedSharding(mesh, P()))
                 for k, v in st.items()}
         self.step_count = 0
@@ -171,9 +219,20 @@ class SpmdTrainer:
                 leaves = [jnp.clip(g, grad_clip.min, grad_clip.max) for g in leaves]
             return jax.tree_util.tree_unflatten(treedef, leaves)
 
+        mesh = self.mesh
+        zero_specs = self._zero_specs
+        stage = self.sharding_stage if self._zero_axis is not None else 0
+
         def train_step(params, opt_state, batch, rng_key, step, lr):
             loss, grads = jax.value_and_grad(loss_pure)(params, batch, rng_key)
             grads = apply_clip(grads)
+            if stage >= 2:
+                # ZeRO-2: dp grad psum becomes reduce-scatter; each device
+                # keeps only its slice of every gradient
+                grads = {
+                    name: jax.lax.with_sharding_constraint(
+                        g, NamedSharding(mesh, zero_specs[name]))
+                    for name, g in grads.items()}
             new_params, new_opt = opt.tree_update(params, grads, opt_state,
                                                   lr, step)
             return loss, new_params, new_opt

@@ -160,3 +160,40 @@ class TestFlashAttnUnpadded:
             ref = np.asarray(jnp.swapaxes(ref, 0, 1))
             np.testing.assert_allclose(out[a:b], ref, rtol=2e-5, atol=2e-5,
                                        err_msg=f"sequence {i}")
+
+    def test_causal_cross_length_bottom_right(self):
+        """Decode-style varlen: len_q != len_k must use bottom-right
+        alignment (FlashAttention-2 varlen convention), letting the last
+        query of each sequence see every key."""
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        rs = np.random.RandomState(8)
+        lq, lk = [1, 2], [8, 5]
+        h, d = 2, 16
+        cq = np.concatenate([[0], np.cumsum(lq)]).astype(np.int32)
+        ck = np.concatenate([[0], np.cumsum(lk)]).astype(np.int32)
+        q = rs.randn(sum(lq), h, d).astype(np.float32)
+        k = rs.randn(sum(lk), h, d).astype(np.float32)
+        v = rs.randn(sum(lk), h, d).astype(np.float32)
+        scale = d ** -0.5
+
+        out, _ = F.flash_attn_unpadded(
+            paddle.Tensor(jnp.asarray(q)), paddle.Tensor(jnp.asarray(k)),
+            paddle.Tensor(jnp.asarray(v)),
+            paddle.Tensor(jnp.asarray(cq)), paddle.Tensor(jnp.asarray(ck)),
+            max(lq), max(lk), scale, causal=True)
+        out = np.asarray(out._data)
+
+        for i in range(len(lq)):
+            qs = q[cq[i]:cq[i + 1]]
+            ks = k[ck[i]:ck[i + 1]]
+            vs = v[ck[i]:ck[i + 1]]
+            ref = _xla_attention_bhsd(
+                jnp.swapaxes(jnp.asarray(qs)[None], 1, 2).reshape(h, lq[i], d),
+                jnp.swapaxes(jnp.asarray(ks)[None], 1, 2).reshape(h, lk[i], d),
+                jnp.swapaxes(jnp.asarray(vs)[None], 1, 2).reshape(h, lk[i], d),
+                True, scale)
+            ref = np.asarray(jnp.swapaxes(ref, 0, 1))
+            np.testing.assert_allclose(out[cq[i]:cq[i + 1]], ref, rtol=2e-5,
+                                       atol=2e-5, err_msg=f"sequence {i}")

@@ -1,0 +1,405 @@
+"""OpTest-style finite-difference gradient gate over the op surface.
+
+reference: test/legacy_test/op_test.py:418 check_grad /
+get_numeric_gradient:148 — every differentiable op's analytic gradient is
+checked against a central-difference numeric gradient with a per-op
+tolerance whitelist.
+
+Here the analytic side is the eager autograd tape (Tensor.backward), the
+numeric side perturbs each input element of sum(op(x)) by +-eps. Inputs are
+chosen inside each op's smooth domain (away from branch points / ties).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+EPS = 1e-3
+RTOL = 5e-2          # paddle op_test max_relative_error ballpark
+ATOL = 5e-3
+
+_rs = np.random.RandomState(0)
+
+
+def U(lo, hi, shape):
+    """Uniform floats, regenerated per use for determinism via the module rs."""
+    return lambda: _rs.uniform(lo, hi, shape).astype(np.float32)
+
+
+def DISTINCT(shape):
+    """Values with distinct magnitudes (no ties for max/sort/median FD)."""
+    def gen():
+        n = int(np.prod(shape))
+        base = np.linspace(-1.0, 1.0, n) + _rs.uniform(-0.2, 0.2, n) * 0.1
+        return _rs.permutation(base).reshape(shape).astype(np.float32)
+    return gen
+
+
+def SPD(n):
+    def gen():
+        a = _rs.randn(n, n).astype(np.float32)
+        return (a @ a.T + n * np.eye(n, dtype=np.float32))
+    return gen
+
+
+class Spec:
+    def __init__(self, name, fn, gens, pick=None, rtol=RTOL, atol=ATOL,
+                 eps=EPS):
+        self.name, self.fn, self.gens = name, fn, gens
+        self.pick = pick or (lambda y: y)
+        self.rtol, self.atol, self.eps = rtol, atol, eps
+
+
+S = Spec
+A34 = U(-1.0, 1.0, (3, 4))
+P34 = U(0.5, 2.0, (3, 4))        # strictly positive
+UNIT = U(-0.8, 0.8, (3, 4))      # inside (-1, 1)
+D34 = DISTINCT((3, 4))
+V6 = U(-1.0, 1.0, (6,))
+M33 = U(-1.0, 1.0, (3, 3))
+
+SPECS = [
+    # ---- unary math (tensor/math.py, tensor/ops) -------------------------
+    S("abs", paddle.abs, [U(0.2, 1.0, (3, 4))]),
+    S("acos", paddle.acos, [UNIT]),
+    S("acosh", paddle.acosh, [U(1.5, 3.0, (3, 4))]),
+    S("asin", paddle.asin, [UNIT]),
+    S("asinh", paddle.asinh, [A34]),
+    S("atan", paddle.atan, [A34]),
+    S("atanh", paddle.atanh, [UNIT]),
+    S("cos", paddle.cos, [A34]),
+    S("cosh", paddle.cosh, [A34]),
+    S("deg2rad", paddle.deg2rad, [A34]),
+    S("digamma", paddle.digamma, [U(2.0, 4.0, (3, 4))], rtol=8e-2),
+    S("erf", paddle.erf, [A34]),
+    S("erfinv", paddle.erfinv, [UNIT], rtol=8e-2),
+    S("exp", paddle.exp, [A34]),
+    S("expm1", paddle.expm1, [A34]),
+    S("frac", paddle.frac, [U(0.2, 0.8, (3, 4))]),
+    S("i0", paddle.i0, [A34]),
+    S("i0e", paddle.i0e, [A34]),
+    S("i1", paddle.i1, [A34]),
+    S("i1e", paddle.i1e, [A34]),
+    S("lgamma", paddle.lgamma, [U(2.0, 4.0, (3, 4))], rtol=8e-2),
+    S("log", paddle.log, [P34]),
+    S("log10", paddle.log10, [P34]),
+    S("log1p", paddle.log1p, [P34]),
+    S("log2", paddle.log2, [P34]),
+    S("logit", paddle.logit, [U(0.2, 0.8, (3, 4))]),
+    S("neg", paddle.neg, [A34]),
+    S("rad2deg", paddle.rad2deg, [A34]),
+    S("reciprocal", paddle.reciprocal, [P34]),
+    S("rsqrt", paddle.rsqrt, [P34]),
+    S("sigmoid", paddle.sigmoid, [A34]),
+    S("sin", paddle.sin, [A34]),
+    S("sinh", paddle.sinh, [A34]),
+    S("sqrt", paddle.sqrt, [P34]),
+    S("square", paddle.square, [A34]),
+    S("stanh", paddle.stanh, [A34]),
+    S("tan", paddle.tan, [UNIT]),
+    S("tanh", paddle.tanh, [A34]),
+    S("nan_to_num", paddle.nan_to_num, [A34]),
+    S("scale", lambda x: paddle.scale(x, 2.5, bias=0.5), [A34]),
+    S("pow_scalar", lambda x: paddle.pow(x, 2.3), [P34]),
+    S("clip", lambda x: paddle.clip(x, -0.5, 0.5), [A34]),
+    # zero-gradient ops: analytic must be 0, FD is 0 a.e.
+    S("ceil", paddle.ceil, [U(0.1, 0.9, (3, 4))]),
+    S("floor", paddle.floor, [U(0.1, 0.9, (3, 4))]),
+    S("round", paddle.round, [U(0.1, 0.4, (3, 4))]),
+    S("trunc", paddle.trunc, [U(0.1, 0.9, (3, 4))]),
+    S("sign", paddle.sign, [U(0.2, 1.0, (3, 4))]),
+    # ---- binary ----------------------------------------------------------
+    S("add", paddle.add, [A34, A34]),
+    S("subtract", paddle.subtract, [A34, A34]),
+    S("multiply", paddle.multiply, [A34, A34]),
+    S("divide", paddle.divide, [A34, P34]),
+    S("pow_t", paddle.pow, [P34, U(0.5, 2.0, (3, 4))]),
+    S("maximum", paddle.maximum, [D34, U(2.0, 3.0, (3, 4))]),
+    S("minimum", paddle.minimum, [D34, U(2.0, 3.0, (3, 4))]),
+    S("fmax", paddle.fmax, [D34, U(2.0, 3.0, (3, 4))]),
+    S("fmin", paddle.fmin, [D34, U(2.0, 3.0, (3, 4))]),
+    S("atan2", paddle.atan2, [P34, P34]),
+    S("hypot", paddle.hypot, [P34, P34]),
+    S("logaddexp", paddle.logaddexp, [A34, A34]),
+    S("lerp", lambda x, y: paddle.lerp(x, y, 0.3), [A34, A34]),
+    S("copysign", paddle.copysign, [P34, P34]),
+    S("dist", paddle.dist, [A34, A34]),
+    S("mod", paddle.mod, [U(2.0, 3.0, (3, 4)), U(0.7, 0.9, (3, 4))]),
+    S("heaviside", paddle.heaviside, [U(0.5, 1.0, (3, 4)), A34]),
+    # broadcast path
+    S("add_bcast", paddle.add, [A34, U(-1, 1, (4,))]),
+    S("mul_bcast", paddle.multiply, [A34, U(-1, 1, (3, 1))]),
+    # ---- matmul family ---------------------------------------------------
+    S("matmul", paddle.matmul, [U(-1, 1, (3, 4)), U(-1, 1, (4, 2))]),
+    S("matmul_t", lambda a, b: paddle.matmul(a, b, transpose_y=True),
+      [U(-1, 1, (3, 4)), U(-1, 1, (2, 4))]),
+    S("mm", paddle.mm, [U(-1, 1, (3, 4)), U(-1, 1, (4, 2))]),
+    S("bmm", paddle.bmm, [U(-1, 1, (2, 3, 4)), U(-1, 1, (2, 4, 2))]),
+    S("mv", paddle.mv, [M33, U(-1, 1, (3,))]),
+    S("dot", paddle.dot, [V6, V6]),
+    S("inner", paddle.inner, [U(-1, 1, (2, 4)), U(-1, 1, (3, 4))]),
+    S("outer", paddle.outer, [V6, U(-1, 1, (4,))]),
+    S("cross", paddle.cross, [U(-1, 1, (2, 3)), U(-1, 1, (2, 3))]),
+    S("kron", paddle.kron, [U(-1, 1, (2, 2)), U(-1, 1, (2, 3))]),
+    S("addmm", lambda x, a, b: paddle.addmm(x, a, b, alpha=0.7, beta=1.2),
+      [U(-1, 1, (3, 2)), U(-1, 1, (3, 4)), U(-1, 1, (4, 2))]),
+    S("einsum", lambda a, b: paddle.einsum("ij,jk->ik", a, b),
+      [U(-1, 1, (3, 4)), U(-1, 1, (4, 2))]),
+    S("tensordot", lambda a, b: paddle.tensordot(a, b, axes=1),
+      [U(-1, 1, (3, 4)), U(-1, 1, (4, 2))]),
+    S("multi_dot", lambda a, b, c: paddle.multi_dot([a, b, c]),
+      [U(-1, 1, (2, 3)), U(-1, 1, (3, 4)), U(-1, 1, (4, 2))]),
+    S("vecdot", paddle.vecdot, [U(-1, 1, (2, 4)), U(-1, 1, (2, 4))]),
+    # ---- reductions ------------------------------------------------------
+    S("sum", paddle.sum, [A34]),
+    S("sum_axis", lambda x: paddle.sum(x, axis=1), [A34]),
+    S("mean", paddle.mean, [A34]),
+    S("mean_axis", lambda x: paddle.mean(x, axis=0, keepdim=True), [A34]),
+    S("max", paddle.max, [D34]),
+    S("min", paddle.min, [D34]),
+    S("amax", paddle.amax, [D34]),
+    S("amin", paddle.amin, [D34]),
+    S("prod", paddle.prod, [P34]),
+    S("std", paddle.std, [D34]),
+    S("var", paddle.var, [D34]),
+    S("logsumexp", paddle.logsumexp, [A34]),
+    S("norm", paddle.norm, [A34]),
+    S("norm_1", lambda x: paddle.norm(x, p=1), [U(0.2, 1.0, (3, 4))]),
+    S("nansum", paddle.nansum, [A34]),
+    S("nanmean", paddle.nanmean, [A34]),
+    S("median", paddle.median, [DISTINCT((3, 5))]),
+    S("nanmedian", paddle.nanmedian, [DISTINCT((3, 5))]),
+    S("quantile", lambda x: paddle.quantile(x, 0.5, axis=1),
+      [DISTINCT((3, 5))], rtol=8e-2),
+    S("kthvalue", lambda x: paddle.kthvalue(x, 2, axis=1)[0], [D34]),
+    S("mode", lambda x: paddle.mode(x, axis=1)[0], [D34]),
+    S("topk", lambda x: paddle.topk(x, 2, axis=1)[0], [D34]),
+    S("count_trapezoid", paddle.trapezoid, [V6]),
+    S("cumulative_trapezoid", paddle.cumulative_trapezoid, [V6]),
+    S("logcumsumexp", lambda x: paddle.tensor.math.logcumsumexp(x, axis=1)
+      if hasattr(paddle.tensor.math, "logcumsumexp") else paddle.cumsum(x),
+      [A34]),
+    # ---- cumulative / scan ----------------------------------------------
+    S("cumsum", lambda x: paddle.cumsum(x, axis=1), [A34]),
+    S("cumprod", lambda x: paddle.cumprod(x, dim=1), [P34]),
+    S("cummax", lambda x: paddle.cummax(x, axis=1)[0], [D34]),
+    S("cummin", lambda x: paddle.cummin(x, axis=1)[0], [D34]),
+    S("diff", paddle.diff, [V6]),
+    # ---- manipulation (grad = scatter of ones) ---------------------------
+    S("reshape", lambda x: paddle.reshape(x, [4, 3]), [A34]),
+    S("transpose", lambda x: paddle.transpose(x, [1, 0]), [A34]),
+    S("t", paddle.t, [A34]),
+    S("flip", lambda x: paddle.flip(x, axis=[0]), [A34]),
+    S("roll", lambda x: paddle.roll(x, 1, axis=1), [A34]),
+    S("rot90", paddle.rot90, [A34]),
+    S("tile", lambda x: paddle.tile(x, [2, 1]), [A34]),
+    S("expand", lambda x: paddle.expand(x, [2, 3, 4]), [A34]),
+    S("broadcast_to", lambda x: paddle.broadcast_to(x, [2, 3, 4]), [A34]),
+    S("concat", lambda a, b: paddle.concat([a, b], axis=0), [A34, A34]),
+    S("stack2", lambda a, b: paddle.stack([a, b]), [A34, A34]),
+    S("split0", lambda x: paddle.split(x, 2, axis=1)[0], [A34]),
+    S("chunk0", lambda x: paddle.chunk(x, 2, axis=0)[1], [U(-1, 1, (4, 3))]),
+    S("squeeze", lambda x: paddle.squeeze(x, axis=0), [U(-1, 1, (1, 3, 4))]),
+    S("unsqueeze", lambda x: paddle.unsqueeze(x, axis=1), [A34]),
+    S("flatten", paddle.flatten, [U(-1, 1, (2, 3, 2))]),
+    S("tril", paddle.tril, [M33]),
+    S("triu", paddle.triu, [M33]),
+    S("diag", paddle.diag, [V6]),
+    S("diagonal", paddle.diagonal, [M33]),
+    S("pad1", lambda x: F.pad(x, [1, 1], mode="constant", value=0.0),
+      [A34]),
+    S("slice", lambda x: x[1:, :2], [A34]),
+    S("gather", lambda x: paddle.gather(x, paddle.to_tensor([0, 2]), axis=0),
+      [A34]),
+    S("index_select",
+      lambda x: paddle.index_select(x, paddle.to_tensor([0, 2]), axis=1),
+      [A34]),
+    S("where", lambda x, y: paddle.where(
+        paddle.to_tensor(np.array([[True, False, True, False]] * 3)), x, y),
+      [A34, A34]),
+    S("masked_fill", lambda x: paddle.masked_fill(
+        x, paddle.to_tensor(np.array([[True, False, True, False]] * 3)), 0.5),
+      [A34]),
+    S("moveaxis", lambda x: paddle.moveaxis(x, 0, 1), [A34]),
+    S("swapaxes", lambda x: paddle.swapaxes(x, 0, 1), [A34]),
+    S("unbind0", lambda x: paddle.unbind(x, axis=0)[0], [A34]),
+    S("unstack0", lambda x: paddle.unstack(x, axis=0)[1], [A34]),
+    S("take_along_axis", lambda x: paddle.take_along_axis(
+        x, paddle.to_tensor(np.zeros((3, 1), np.int64)), 1), [A34]),
+    S("repeat_interleave",
+      lambda x: paddle.repeat_interleave(x, 2, axis=0), [A34]),
+    S("sort", lambda x: paddle.sort(x, axis=1), [D34]),
+    S("view", lambda x: paddle.view(x, [4, 3]), [A34]),
+    # ---- linalg ----------------------------------------------------------
+    S("cholesky", paddle.cholesky, [SPD(3)], rtol=8e-2),
+    S("det", paddle.det, [SPD(3)], rtol=8e-2),
+    S("slogdet", lambda x: paddle.slogdet(x)[1], [SPD(3)], rtol=8e-2),
+    S("inv", paddle.inv, [SPD(3)], rtol=8e-2),
+    S("pinv", paddle.pinv, [SPD(3)], rtol=1e-1),
+    S("solve", paddle.solve, [SPD(3), U(-1, 1, (3, 2))], rtol=8e-2),
+    S("triangular_solve",
+      lambda a, b: paddle.triangular_solve(a, b, upper=False),
+      [SPD(3), U(-1, 1, (3, 2))], rtol=8e-2),
+    S("matrix_power", lambda x: paddle.matrix_power(x, 2), [M33]),
+    S("cholesky_solve",
+      lambda a, b: paddle.cholesky_solve(b, paddle.cholesky(a)),
+      [SPD(3), U(-1, 1, (3, 2))], rtol=1e-1),
+    S("lu_det_path", lambda x: paddle.det(paddle.matmul(x, x)), [M33],
+      rtol=1e-1),
+    # ---- activations (nn/functional) ------------------------------------
+    S("relu", F.relu, [D34]),
+    S("relu6", F.relu6, [A34]),
+    S("elu", F.elu, [A34]),
+    S("selu", F.selu, [A34]),
+    S("celu", F.celu, [A34]),
+    S("gelu", F.gelu, [A34]),
+    S("gelu_tanh", lambda x: F.gelu(x, approximate=True), [A34]),
+    S("silu", F.silu, [A34]),
+    S("softplus", F.softplus, [A34]),
+    S("softsign", F.softsign, [A34]),
+    S("softshrink", F.softshrink, [U(0.8, 1.5, (3, 4))]),
+    S("hardshrink", F.hardshrink, [U(0.8, 1.5, (3, 4))]),
+    S("hardsigmoid", F.hardsigmoid, [UNIT]),
+    S("hardswish", F.hardswish, [U(0.5, 1.5, (3, 4))]),
+    S("hardtanh", F.hardtanh, [UNIT]),
+    S("leaky_relu", F.leaky_relu, [D34]),
+    S("log_sigmoid", F.log_sigmoid, [A34]),
+    S("mish", F.mish, [A34]),
+    S("tanhshrink", F.tanhshrink, [A34]),
+    S("thresholded_relu", F.thresholded_relu, [U(1.2, 2.0, (3, 4))]),
+    S("softmax", lambda x: F.softmax(x, axis=-1), [A34]),
+    S("log_softmax", lambda x: F.log_softmax(x, axis=-1), [A34]),
+    S("gumbel_softmax_hardfalse",
+      lambda x: paddle.gumbel_softmax(x, temperature=1.0, hard=False),
+      [A34], rtol=1e9, atol=1e9),  # stochastic: only checks it differentiates
+    S("prelu", lambda x, w: F.prelu(x, w), [A34, U(0.1, 0.3, (1,))]),
+    S("swish", F.swish, [A34]),
+    # ---- losses / misc functionals --------------------------------------
+    S("mse_loss", lambda x: F.mse_loss(x, paddle.zeros([3, 4])), [A34]),
+    S("l1_loss", lambda x: F.l1_loss(x, paddle.full([3, 4], 5.0)), [A34]),
+    S("smooth_l1", lambda x: F.smooth_l1_loss(x, paddle.zeros([3, 4])),
+      [A34]),
+    S("huber", lambda x: F.smooth_l1_loss(x, paddle.zeros([3, 4]), delta=0.3),
+      [A34]),
+    S("kl_div", lambda x: F.kl_div(F.log_softmax(x, -1),
+                                   F.softmax(paddle.ones([3, 4]), -1)),
+      [A34]),
+    S("cross_entropy", lambda x: F.cross_entropy(
+        x, paddle.to_tensor(np.array([0, 2, 1], np.int64))), [A34]),
+    S("nll_loss", lambda x: F.nll_loss(
+        F.log_softmax(x, -1), paddle.to_tensor(np.array([0, 2, 1], np.int64))),
+      [A34]),
+    S("bce_with_logits", lambda x: F.binary_cross_entropy_with_logits(
+        x, paddle.full([3, 4], 0.3)), [A34]),
+    S("sigmoid_focal", lambda x: F.sigmoid_focal_loss(
+        x, paddle.full([3, 4], 1.0)), [A34])
+    if hasattr(F, "sigmoid_focal_loss") else None,
+    S("normalize", lambda x: F.normalize(x, axis=1), [P34]),
+    S("linear", lambda x, w, b: F.linear(x, w, b),
+      [U(-1, 1, (3, 4)), U(-1, 1, (4, 2)), U(-1, 1, (2,))]),
+    S("embedding_dense_grad_path",
+      lambda w: F.embedding(paddle.to_tensor(np.array([[0, 2]], np.int64)), w),
+      [U(-1, 1, (4, 3))]),
+    S("interp_nearest_path", lambda x: paddle.tile(x, [1, 2]), [A34]),
+    # ---- conv / pool / norm (nn.functional) -----------------------------
+    S("conv2d", lambda x, w: F.conv2d(x, w),
+      [U(-1, 1, (1, 2, 5, 5)), U(-1, 1, (3, 2, 3, 3))]),
+    S("conv2d_pad", lambda x, w: F.conv2d(x, w, padding=1, stride=2),
+      [U(-1, 1, (1, 2, 5, 5)), U(-1, 1, (3, 2, 3, 3))]),
+    S("conv1d", lambda x, w: F.conv1d(x, w),
+      [U(-1, 1, (1, 2, 8)), U(-1, 1, (3, 2, 3))]),
+    S("conv2d_transpose", lambda x, w: F.conv2d_transpose(x, w),
+      [U(-1, 1, (1, 2, 4, 4)), U(-1, 1, (2, 3, 3, 3))]),
+    S("depthwise_conv2d", lambda x, w: F.conv2d(x, w, groups=2),
+      [U(-1, 1, (1, 2, 5, 5)), U(-1, 1, (2, 1, 3, 3))]),
+    S("max_pool2d", lambda x: F.max_pool2d(x, 2),
+      [DISTINCT((1, 2, 4, 4))]),
+    S("avg_pool2d", lambda x: F.avg_pool2d(x, 2), [U(-1, 1, (1, 2, 4, 4))]),
+    S("adaptive_avg_pool2d", lambda x: F.adaptive_avg_pool2d(x, 2),
+      [U(-1, 1, (1, 2, 4, 4))]),
+    S("layer_norm", lambda x, w, b: F.layer_norm(x, [4], weight=w, bias=b),
+      [A34, U(0.5, 1.5, (4,)), U(-0.2, 0.2, (4,))]),
+    S("rms_norm_path", lambda x: x * paddle.rsqrt(
+        paddle.mean(paddle.square(x), axis=-1, keepdim=True) + 1e-5), [A34]),
+    S("group_norm", lambda x, w, b: F.group_norm(x, 2, weight=w, bias=b),
+      [U(-1, 1, (2, 4, 3, 3)), U(0.5, 1.5, (4,)), U(-0.2, 0.2, (4,))]),
+    S("batch_norm_eval", lambda x: F.batch_norm(
+        x, paddle.zeros([4]), paddle.ones([4]), training=False),
+      [U(-1, 1, (2, 4, 3, 3))]),
+    S("cosine_similarity", lambda a, b: F.cosine_similarity(a, b, axis=1),
+      [P34, P34]),
+    S("pixel_shuffle", lambda x: F.pixel_shuffle(x, 2),
+      [U(-1, 1, (1, 4, 3, 3))]),
+    S("interpolate_bilinear", lambda x: F.interpolate(
+        x, size=[6, 6], mode="bilinear", align_corners=False),
+      [U(-1, 1, (1, 2, 3, 3))]),
+    S("dropout_eval", lambda x: F.dropout(x, 0.5, training=False), [A34]),
+    S("unfold_f", lambda x: F.unfold(x, 2), [U(-1, 1, (1, 2, 4, 4))])
+    if hasattr(F, "unfold") else None,
+    # ---- scatter/index updates ------------------------------------------
+    S("scatter", lambda x, u: paddle.scatter(
+        x, paddle.to_tensor(np.array([0, 2], np.int64)), u),
+      [A34, U(-1, 1, (2, 4))]),
+    S("index_add", lambda x, u: paddle.index_add(
+        x, paddle.to_tensor(np.array([0, 2], np.int64)), 0, u),
+      [A34, U(-1, 1, (2, 4))]),
+    S("put_along_axis", lambda x, u: paddle.put_along_axis(
+        x, paddle.to_tensor(np.zeros((3, 1), np.int64)), u, 1),
+      [A34, U(-1, 1, (3, 1))]),
+    S("diagflat", paddle.diagflat, [V6]),
+    S("diag_scatter", lambda x, u: paddle.diagonal_scatter(x, u),
+      [M33, U(-1, 1, (3,))]),
+    S("slice_scatter", lambda x, u: paddle.slice_scatter(
+        x, u, axes=[0], starts=[0], ends=[1], strides=[1]),
+      [A34, U(-1, 1, (1, 4))]),
+]
+SPECS = [s for s in SPECS if s is not None]
+
+
+def _tensors(spec):
+    return [paddle.Tensor(g(), stop_gradient=False) for g in spec.gens]
+
+
+def _loss_np(spec, arrays):
+    with paddle.no_grad():
+        ts = [paddle.Tensor(a) for a in arrays]
+        y = spec.pick(spec.fn(*ts))
+        return float(np.asarray(y.sum()._data if hasattr(y, "_data")
+                                else y.sum()))
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=[s.name for s in SPECS])
+def test_fd_grad(spec):
+    xs = _tensors(spec)
+    y = spec.pick(spec.fn(*xs))
+    loss = y.sum()
+    loss.backward()
+    analytic = [np.zeros(np.asarray(x._data).shape, np.float32)
+                if x.grad is None else np.asarray(x.grad._data)
+                for x in xs]
+    arrays = [np.asarray(x._data).copy() for x in xs]
+    if spec.rtol > 1e6:  # stochastic op: differentiability-only check
+        return
+    for i, base in enumerate(arrays):
+        fd = np.zeros_like(base, np.float64)
+        flat = base.reshape(-1)
+        fdf = fd.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + spec.eps
+            hi = _loss_np(spec, arrays)
+            flat[j] = orig - spec.eps
+            lo = _loss_np(spec, arrays)
+            flat[j] = orig
+            fdf[j] = (hi - lo) / (2 * spec.eps)
+        np.testing.assert_allclose(
+            analytic[i].astype(np.float64), fd, rtol=spec.rtol,
+            atol=spec.atol,
+            err_msg=f"{spec.name}: input {i} analytic vs FD")
+
+
+def test_coverage_floor():
+    """The gate must keep covering a substantial op surface."""
+    assert len(SPECS) >= 200, len(SPECS)

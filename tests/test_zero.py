@@ -1,0 +1,120 @@
+"""ZeRO-1/2/3 inside the jitted SpmdTrainer step.
+
+reference capability: dygraph_sharding_optimizer.py:53 (stage 1),
+group_sharded_stage2/3.py (grad/param partition). Done-bar from the build
+plan: loss identical to unsharded, per-device bytes shrink by the sharding
+degree, partition applied in-step (not post-hoc device_put).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer
+from paddle_tpu.parallel import SpmdTrainer, create_mesh
+from paddle_tpu.parallel.spmd import DP_ONLY_RULES, _with_zero_axis
+
+
+def _model():
+    paddle.seed(0)
+    return paddle.models.llama_tiny(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4, vocab_size=256)
+
+
+def _batch():
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 256, (4, 16)), jnp.int32)
+    return (ids, ids)
+
+
+def _run(stage, steps=3):
+    mesh = create_mesh(dp=2, sharding=4)
+    model = _model()
+    opt = optimizer.AdamW(1e-3, parameters=model.parameters())
+    tr = SpmdTrainer(model, opt, mesh, DP_ONLY_RULES, batch_spec=P("dp"),
+                     sharding_stage=stage)
+    key = jax.random.key(0)
+    losses = [float(tr.step(_batch(), rng_key=key)) for _ in range(steps)]
+    return tr, losses
+
+
+def _frac(arr):
+    """Per-device bytes / global bytes."""
+    return arr.addressable_shards[0].data.nbytes / arr.nbytes
+
+
+class TestZeroParity:
+    @pytest.mark.parametrize("stage", [1, 2, 3])
+    def test_loss_identical_to_unsharded(self, stage):
+        _, base = _run(0)
+        _, got = _run(stage)
+        np.testing.assert_allclose(got, base, rtol=1e-5, atol=1e-6)
+
+
+class TestZeroPartition:
+    def test_stage1_opt_state_partitioned(self):
+        tr, _ = _run(1)
+        shrunk = total = 0
+        for name, st in tr.opt_state.items():
+            full = tr.params[name]
+            for k, v in st.items():
+                if v.shape != full.shape or not v.shape:
+                    continue
+                total += 1
+                if _frac(v) <= 1 / 4 + 1e-9:
+                    shrunk += 1
+            # params stay unpartitioned at stage 1
+            assert _frac(full) == 1.0, name
+        assert total and shrunk / total > 0.9, (shrunk, total)
+
+    def test_stage3_params_partitioned(self):
+        tr, _ = _run(3)
+        shrunk = total = 0
+        for name, a in tr.params.items():
+            if not a.shape:
+                continue
+            total += 1
+            if _frac(a) <= 1 / 4 + 1e-9:
+                shrunk += 1
+        assert total and shrunk / total > 0.9, (shrunk, total)
+
+    def test_stage2_grads_reduce_scattered_in_program(self):
+        """The compiled step must keep the ZeRO partition inside the program:
+        its per-device argument/output bytes for opt state shrink vs stage 0."""
+        mesh = create_mesh(dp=2, sharding=4)
+
+        def build(stage):
+            model = _model()
+            opt = optimizer.AdamW(1e-3, parameters=model.parameters())
+            tr = SpmdTrainer(model, opt, mesh, DP_ONLY_RULES,
+                             batch_spec=P("dp"), sharding_stage=stage)
+            batch = jax.tree_util.tree_map(jnp.asarray, _batch())
+            compiled = tr._build(batch).lower(
+                tr.params, tr.opt_state, batch, jax.random.key(0),
+                jnp.int32(1), jnp.float32(1e-3)).compile()
+            return compiled
+
+        try:
+            m0 = build(0).memory_analysis()
+            m2 = build(2).memory_analysis()
+            a0, a2 = m0.argument_size_in_bytes, m2.argument_size_in_bytes
+        except Exception as e:  # pragma: no cover
+            pytest.skip(f"memory_analysis unavailable: {e}")
+        assert a2 < a0, (a2, a0)
+
+
+class TestWithZeroAxis:
+    def test_spec_placement(self):
+        mesh = create_mesh(dp=2, sharding=4)
+        # dim0 divisible -> sharded on dim0
+        assert _with_zero_axis(P(), (8, 3), mesh) == P("sharding", None)
+        # dim0 taken by mp -> falls to next divisible dim
+        assert _with_zero_axis(P("mp", None), (8, 12), mesh) == \
+            P("mp", "sharding")
+        # nothing divisible -> unchanged
+        assert _with_zero_axis(P(), (3, 5), mesh) == P(None, None)

@@ -90,6 +90,12 @@ def _run_one(cfg, batch, seq, steps, remat, on_tpu):
         model, remat=remat, dtype="bfloat16" if on_tpu else None)
     opt = optimizer.AdamW(3e-4, parameters=model.parameters())
     opt_state = opt.tree_init(params)
+    # the scanned params are fresh (stacked, cast) copies; free the
+    # imperative model's originals so they don't pin HBM for the whole run
+    # (functional_call substitutes every template param by name, so the
+    # template's own arrays are never read)
+    for t in model.state_dict().values():
+        t._data = jnp.zeros((), t._data.dtype)
 
     def train_step(p, st, ids, labels, lr, stp):
         loss, grads = jax.value_and_grad(loss_fn)(p, ids, labels)
@@ -135,7 +141,7 @@ def _run_one(cfg, batch, seq, steps, remat, on_tpu):
             "step_time_s": dt / steps, "xla_flops_per_step": xla_flops}
 
 
-def worker(force_cpu: bool):
+def worker(force_cpu: bool, only_config: int | None = None):
     import jax
     if force_cpu:
         # the axon sitecustomize force-sets jax_platforms='axon,cpu' at
@@ -160,6 +166,8 @@ def worker(force_cpu: bool):
     on_tpu = jax.devices()[0].platform != "cpu"
     if on_tpu:
         ladder = _llama_ladder()
+        if only_config is not None:
+            ladder = ladder[only_config:only_config + 1]
     else:
         cfg = LlamaConfig(vocab_size=512, hidden_size=128,
                           intermediate_size=256, num_hidden_layers=2,
@@ -258,9 +266,18 @@ def _attempt(args, timeout_s):
 
 def main():
     if "--worker" in sys.argv:
-        return worker(force_cpu="--cpu" in sys.argv)
+        cfg = None
+        if "--config" in sys.argv:
+            cfg = int(sys.argv[sys.argv.index("--config") + 1])
+        return worker(force_cpu="--cpu" in sys.argv, only_config=cfg)
 
-    plan = [([], 1200), ([], 600), (["--cpu"], 300)]
+    # one subprocess PER ladder config so a slow/hung compile on a big
+    # config can't eat the whole budget before smaller configs get a turn
+    # (round-2/3 failure mode). The persistent compile cache makes a second
+    # pass over an already-attempted config cheap.
+    n_configs = 4  # len(_llama_ladder()) — parent must not import jax
+    plan = [(["--config", str(i)], 900) for i in range(n_configs)]
+    plan += [(["--config", "3"], 600), (["--cpu"], 300)]
     errors = []
     for i, (args, timeout_s) in enumerate(plan):
         result, err = _attempt(args, timeout_s)

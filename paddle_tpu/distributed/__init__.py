@@ -25,6 +25,8 @@ from .collective import (  # noqa: F401
     reduce_scatter, broadcast, reduce, scatter, gather, send, recv, isend,
     irecv, ReduceOp, P2POp, batch_isend_irecv, split, stream,
 )
+from .auto_parallel import (  # noqa: F401
+    DistModel, Engine, Strategy, to_static)
 from .store import Store, TCPStore  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401

@@ -184,6 +184,30 @@ def shard_dataloader(dataloader, meshes, shard_dims=None, is_dataset_splitted=Fa
 
 def to_distributed(model, optimizer=None, dataloader=None, device_num=None,
                    node_num=1, config=None):
-    """One-call auto-parallel entry (reference: incubate to_distributed).
-    Currently: DP over all devices via shard_dataloader + replicated params."""
+    """One-call auto-parallel entry.
+
+    reference: python/paddle/distributed/auto_parallel/high_level_api.py
+    to_distributed — parallelize a model over all visible devices.
+
+    TPU-native: build a 1-axis 'dp' ProcessMesh over the devices, lay every
+    parameter out replicated on it, and shard each batch's leading dim over
+    'dp'. Eager ops then run under GSPMD sharding propagation (data
+    parallelism with compiler-inserted grad reduction); jit/to_static over
+    the same arrays compiles the identical layout. Returns the
+    (model, optimizer, dataloader) triple like the reference.
+    """
+    n = device_num or len(jax.devices())
+    n = min(n, len(jax.devices()))
+    mesh = ProcessMesh(shape=[n], dim_names=["dp"])
+    replicated = [Replicate()]
+    for _, p in model.named_parameters():
+        shard_tensor(p, mesh, replicated)
+    for name, buf in getattr(model, "named_buffers", lambda: [])():
+        if isinstance(buf, Tensor):
+            # shard_tensor only rebinds Parameters in place; buffers need the
+            # replicated array written back explicitly
+            buf._data = shard_tensor(buf, mesh, replicated)._data
+            _attach_dist(buf, mesh, replicated)
+    if dataloader is not None:
+        dataloader = shard_dataloader(dataloader, mesh, shard_dims="dp")
     return model, optimizer, dataloader

@@ -1,0 +1,273 @@
+"""Static auto-parallel: Strategy / Engine / DistModel / to_static.
+
+reference: python/paddle/distributed/auto_parallel/static/engine.py:100
+(Engine.fit/evaluate/predict over a distributed static program),
+auto_parallel/api.py:2715 (to_static -> DistModel), strategy.py (Strategy
+config tree).
+
+TPU-native design: the reference's pipeline (program capture -> SPMD rule
+propagation -> reshard insertion -> partitioned executor) collapses into
+one jitted GSPMD train/eval step built by parallel.SpmdTrainer — sharding
+rules choose parameter placements, XLA propagates/reshard-inserts, the
+'executor' is the compiled step function.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..framework.core import Tensor
+
+__all__ = ["Strategy", "Engine", "DistModel", "to_static"]
+
+
+class _Cfg:
+    """Attribute bag with defaults (mirrors the reference's config nodes)."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+    def __repr__(self):
+        return f"_Cfg({self.__dict__})"
+
+
+class Strategy:
+    """reference: auto_parallel/strategy.py — knobs that matter on TPU:
+
+    - sharding: ZeRO over the 'sharding' mesh axis (enable, stage, degree)
+    - recompute: activation rematerialization inside the jitted step
+    - mp_degree / sep_degree: tensor / sequence-parallel mesh axis sizes
+    - amp: bf16 parameter cast (TPU-native mixed precision)
+    Gradient-merge/fused-passes analogs are XLA's job and have no knobs.
+    """
+
+    def __init__(self):
+        self.sharding = _Cfg(enable=False, stage=1, degree=1)
+        self.recompute = _Cfg(enable=False)
+        self.amp = _Cfg(enable=False, dtype="bfloat16")
+        self.mp_degree = 1
+        self.sep_degree = 1
+        self.dp_degree = None  # None = all remaining devices
+
+
+def _build_mesh(strategy, n_devices=None):
+    from ..parallel.spmd import create_mesh
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    mp = max(1, int(strategy.mp_degree))
+    sep = max(1, int(strategy.sep_degree))
+    shd = max(1, int(strategy.sharding.degree)) if strategy.sharding.enable \
+        else 1
+    rest = n_devices // (mp * sep * shd)
+    dp = strategy.dp_degree or max(1, rest)
+    return create_mesh(dp=dp, mp=mp, sep=sep, sharding=shd)
+
+
+class Engine:
+    """reference: auto_parallel/static/engine.py:100.
+
+    engine = Engine(model, loss, optimizer, strategy=strategy)
+    engine.fit(dataset, epochs, batch_size)   # compiled GSPMD steps
+    engine.evaluate(dataset, batch_size)
+    engine.predict(dataset, batch_size)
+    """
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy=None, mesh=None, rules=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics or []
+        self._strategy = strategy or Strategy()
+        self._mesh = mesh
+        self._rules = rules
+        self._trainer = None
+        self._eval_fn = None
+        self._pred_fn = None
+        self.history = {"loss": []}
+
+    # -- plumbing ----------------------------------------------------------
+    def _jax_mesh(self):
+        if self._mesh is None:
+            self._mesh = _build_mesh(self._strategy)
+        m = self._mesh
+        return m.jax_mesh if hasattr(m, "jax_mesh") else m
+
+    def _ensure_trainer(self):
+        if self._trainer is not None:
+            return self._trainer
+        from ..parallel.spmd import DP_ONLY_RULES, SpmdTrainer
+        st = self._strategy
+        stage = st.sharding.stage if st.sharding.enable else 0
+        self._trainer = SpmdTrainer(
+            self._model, self._optimizer, self._jax_mesh(),
+            self._rules or DP_ONLY_RULES,
+            loss_fn=self._loss, batch_spec=P("dp"),
+            remat=st.recompute.enable,
+            dtype=st.amp.dtype if st.amp.enable else None,
+            sharding_stage=stage)
+        return self._trainer
+
+    def _as_loader(self, data, batch_size, shuffle=False):
+        from ..io import DataLoader
+        if data is None:
+            return None
+        if hasattr(data, "__iter__") and not hasattr(data, "__getitem__"):
+            return data  # already an iterable loader
+        if isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=True)
+
+    @staticmethod
+    def _arrays(batch):
+        return jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else jnp.asarray(t),
+            batch, is_leaf=lambda v: isinstance(v, Tensor))
+
+    # -- public API --------------------------------------------------------
+    def prepare(self, *args, **kwargs):
+        """Static-graph warm-up parity shim: build the trainer eagerly."""
+        self._ensure_trainer()
+        return self
+
+    def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
+            verbose=0, **kw):
+        trainer = self._ensure_trainer()
+        loader = self._as_loader(train_data, batch_size, shuffle=True)
+        for epoch in range(epochs):
+            for i, batch in enumerate(loader):
+                if steps_per_epoch is not None and i >= steps_per_epoch:
+                    break
+                loss = trainer.step(batch)
+                self.history["loss"].append(float(loss))
+                if verbose and i % max(1, verbose) == 0:
+                    print(f"[engine] epoch {epoch} step {i} "
+                          f"loss {float(loss):.4f}", flush=True)
+        trainer.sync_to_model()
+        return self.history
+
+    def evaluate(self, valid_data, batch_size=1, steps=None, verbose=0, **kw):
+        trainer = self._ensure_trainer()
+        if self._eval_fn is None:
+            self._eval_fn = jax.jit(trainer._loss)
+        loader = self._as_loader(valid_data, batch_size)
+        losses = []
+        key = jax.random.key(0)
+        for i, batch in enumerate(loader):
+            if steps is not None and i >= steps:
+                break
+            losses.append(float(self._eval_fn(
+                trainer.params, self._arrays(batch), key)))
+        return {"loss": float(np.mean(losses)) if losses else float("nan")}
+
+    def predict(self, test_data, batch_size=1, steps=None, **kw):
+        trainer = self._ensure_trainer()
+        if self._pred_fn is None:
+            from ..parallel.functional import functional_call
+
+            def fwd(params, x, key):
+                out = functional_call(self._model, params, x, rng_key=key,
+                                      training=False)
+                return out[1] if isinstance(out, (tuple, list)) else out
+
+            self._pred_fn = jax.jit(fwd)
+        loader = self._as_loader(test_data, batch_size)
+        outs = []
+        key = jax.random.key(0)
+        for i, batch in enumerate(loader):
+            if steps is not None and i >= steps:
+                break
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            x = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+            outs.append(np.asarray(self._pred_fn(trainer.params, x, key)))
+        return outs
+
+    def save(self, path, training=True):
+        self._ensure_trainer().sync_to_model()
+        from ..framework.io_file import save
+        save(self._model.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path):
+        from ..framework.io_file import load
+        self._model.set_state_dict(load(path + ".pdparams"))
+        self._trainer = None  # re-shard from the restored weights
+
+    @property
+    def main_program(self):  # reference parity: inspectable artifact
+        t = self._trainer
+        return None if t is None or t._compiled is None else t._compiled
+
+
+class DistModel:
+    """reference: auto_parallel/api.py DistModel (returned by to_static).
+
+    Callable: dist_model(*batch) runs ONE compiled step in the current mode
+    ('train' -> loss + param update, 'eval' -> loss, 'predict' -> outputs).
+    """
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, mesh=None, rules=None):
+        self._engine = Engine(layer, loss, optimizer, strategy=strategy,
+                              mesh=mesh, rules=rules)
+        self._loader = loader
+        self._mode = "train" if optimizer is not None else (
+            "eval" if loss is not None else "predict")
+
+    def train(self):
+        self._mode = "train"
+        return self
+
+    def eval(self):
+        self._mode = "eval"
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        return self
+
+    def __call__(self, *batch):
+        eng = self._engine
+        trainer = eng._ensure_trainer()
+        if len(batch) == 1:
+            batch = batch[0]
+        if self._mode == "train":
+            return trainer.step(batch)
+        arrays = eng._arrays(batch)
+        if self._mode == "eval":
+            if eng._eval_fn is None:
+                eng._eval_fn = jax.jit(trainer._loss)
+            return eng._eval_fn(trainer.params, arrays, jax.random.key(0))
+        x = arrays[0] if isinstance(arrays, (tuple, list)) else arrays
+        if eng._pred_fn is None:
+            from ..parallel.functional import functional_call
+
+            def fwd(params, xx, key):
+                out = functional_call(eng._model, params, xx, rng_key=key,
+                                      training=False)
+                return out[1] if isinstance(out, (tuple, list)) else out
+
+            eng._pred_fn = jax.jit(fwd)
+        return eng._pred_fn(trainer.params, x, jax.random.key(0))
+
+    def state_dict(self, mode="all"):
+        self._engine._ensure_trainer().sync_to_model()
+        return self._engine._model.state_dict()
+
+    @property
+    def engine(self):
+        return self._engine
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              mesh=None, rules=None):
+    """reference: auto_parallel/api.py:2715 — build the distributed,
+    compiled form of a layer."""
+    return DistModel(layer, loader, loss, optimizer, strategy=strategy,
+                     mesh=mesh, rules=rules)

@@ -10,21 +10,27 @@ TPU-native design: each process writes ONLY the array chunks it owns
 (`arr.addressable_shards`, one replica per distinct chunk globally — the
 owner is the lowest (process_index, device_id) holder, computed
 deterministically on every host from the sharding, no communication).
+Every chunk is its own `.npy` file (reference uses per-tensor files +
+metadata, save_state_dict.py:145): loads memory-map only the chunks that
+overlap the destination blocks, and nothing goes through pickle.
 `metadata.json` records the global layout: per-array shape/dtype and the
 chunk → file map. Load assembles each destination device's block from the
 overlapping saved chunks and builds the array with
 `jax.make_array_from_single_device_arrays`, so a checkpoint saved from a
 (dp=8) mesh loads onto a (dp=2,mp=2) mesh — or a single chip — without any
-rank reading bytes it does not need (beyond whole-file pickle granularity).
-Async save snapshots device→host synchronously, then writes on a thread,
-matching the reference's background async save.
+rank reading bytes it does not need.
+
+Durability: every file is written to a temp name then os.replace'd
+(atomic), metadata goes last, and async save runs on a NON-daemon thread —
+process exit joins it, so a returned save_state_dict(async_save=True) can
+never leave a truncated checkpoint.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
-import pickle
 import threading
 
 import numpy as np
@@ -89,16 +95,32 @@ def _global_chunks(arr):
     return groups
 
 
+def _chunk_file(owner_rank, key, chunk_key):
+    """Deterministic per-chunk file name — every host derives the same map
+    from (array name, bounds, owner) without communication."""
+    h = hashlib.sha1(f"{key}\x00{chunk_key}".encode()).hexdigest()[:16]
+    return f"r{owner_rank}_{h}.npy"
+
+
+def _atomic_write_npy(path, fname, data):
+    tmp = os.path.join(path, fname + ".tmp")
+    np.save(tmp, data, allow_pickle=False)
+    # np.save appends .npy to names without it
+    os.replace(tmp + ".npy" if not tmp.endswith(".npy") else tmp,
+               os.path.join(path, fname))
+
+
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     async_save=False):
-    """Sharded save: this process writes only chunks it owns.
+    """Sharded save: this process writes only chunks it owns, one .npy file
+    per chunk, each atomically renamed into place; metadata.json last.
 
     reference: checkpoint/save_state_dict.py:145.
     """
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
-    meta = {"version": 2, "arrays": {}}
-    local_chunks = {}  # key -> {chunk_key: np chunk}
+    meta = {"version": 3, "arrays": {}}
+    local_files = []  # (fname, np chunk)
     for k, v in state_dict.items():
         arr = _unwrap(v)
         if not isinstance(arr, jax.Array):
@@ -107,30 +129,31 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         meta["arrays"][k] = {
             "shape": list(arr.shape), "dtype": str(arr.dtype),
             "chunks": [{"bounds": [list(b) for b in info["bounds"]],
-                        "file": f"shard_r{info['owner_process']}.data",
-                        "key": ck}
+                        "file": _chunk_file(info["owner_process"], k, ck)}
                        for ck, info in sorted(chunks.items())]}
-        mine = {}
         by_dev = {s.device.id: s for s in arr.addressable_shards}
         for ck, info in chunks.items():
             if info["owner_process"] != rank:
                 continue
             if info["owner_device"] == -1:  # unsharded host array
-                mine[ck] = np.asarray(arr)
+                data = np.asarray(arr)
             else:
-                mine[ck] = np.asarray(by_dev[info["owner_device"]].data)
-        if mine:
-            local_chunks[k] = mine
+                data = np.asarray(by_dev[info["owner_device"]].data)
+            local_files.append((_chunk_file(rank, k, ck), data))
 
     def write():
-        with open(os.path.join(path, f"shard_r{rank}.data"), "wb") as f:
-            pickle.dump(local_chunks, f, protocol=4)
+        for fname, data in local_files:
+            _atomic_write_npy(path, fname, data)
         if rank == coordinator_rank:
-            with open(os.path.join(path, "metadata.json"), "w") as f:
+            tmp = os.path.join(path, "metadata.json.tmp")
+            with open(tmp, "w") as f:
                 json.dump(meta, f)
+            os.replace(tmp, os.path.join(path, "metadata.json"))
 
     if async_save:
-        t = threading.Thread(target=write, daemon=True)
+        # non-daemon: interpreter shutdown joins it, so the checkpoint can
+        # never be truncated by process exit
+        t = threading.Thread(target=write, daemon=False)
         t.start()
         _async_tasks.append(t)
     else:
@@ -138,14 +161,19 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
 
 
 class _ShardFileCache:
+    """Memory-maps chunk .npy files on demand: a loading host touches only
+    the chunks overlapping its destination blocks, never whole shard files,
+    and nothing is unpickled."""
+
     def __init__(self, path):
         self.path = path
         self._files = {}
 
     def get(self, fname):
         if fname not in self._files:
-            with open(os.path.join(self.path, fname), "rb") as f:
-                self._files[fname] = pickle.load(f)
+            self._files[fname] = np.load(
+                os.path.join(self.path, fname), mmap_mode="r",
+                allow_pickle=False)
         return self._files[fname]
 
 
@@ -161,7 +189,7 @@ def _assemble_region(key, amesh, cache, bounds, dtype):
                  for (a0, a1), (b0, b1) in zip(cb, bounds)]
         if any(a >= b for a, b in inter):
             continue
-        data = cache.get(chunk["file"])[key][chunk["key"]]
+        data = cache.get(chunk["file"])
         src = tuple(slice(a - c0, b - c0)
                     for (a, b), (c0, _) in zip(inter, cb))
         dst = tuple(slice(a - r0, b - r0)
@@ -183,6 +211,12 @@ def load_state_dict(state_dict, path, process_group=None,
     _wait_async()
     with open(os.path.join(path, "metadata.json")) as f:
         meta = json.load(f)
+    version = meta.get("version")
+    if version != 3:
+        raise ValueError(
+            f"checkpoint at {path} has format version {version}; this "
+            "loader reads version 3 (per-chunk .npy files). Re-save the "
+            "checkpoint with the current save_state_dict.")
     cache = _ShardFileCache(path)
     for k, v in state_dict.items():
         if k not in meta["arrays"]:

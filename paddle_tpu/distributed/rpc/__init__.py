@@ -16,12 +16,12 @@ import pickle
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
+import secrets
+
 from multiprocessing.connection import Client, Listener
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
-
-_AUTH = b"paddle_tpu_rpc"
 
 
 class WorkerInfo:
@@ -45,6 +45,7 @@ class _State:
         self.serve_thread = None
         self.pool = None
         self.workers = {}
+        self.auth = None
         self.stop = threading.Event()
 
 
@@ -89,9 +90,19 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     host, port = master_endpoint.rsplit(":", 1)
     _state.store = TCPStore(host, int(port), is_master=(rank == 0),
                             world_size=world_size, timeout=120)
-    # open our server on an ephemeral port
-    _state.listener = Listener(("0.0.0.0", 0), authkey=_AUTH)
+    # per-job random authkey distributed through the rendezvous store (the
+    # trust root, like the reference master endpoint) — RPC executes
+    # callables, so connections must prove they joined this job
+    if rank == 0:
+        _state.auth = secrets.token_bytes(32)
+        _state.store.set("__rpc/authkey", _state.auth)
+    else:
+        _state.auth = bytes(_state.store.get("__rpc/authkey"))
+    # bind to this worker's interface (PADDLE_RPC_BIND_IP to widen), never
+    # an unconditional 0.0.0.0
     my_ip = os.environ.get("POD_IP", "127.0.0.1")
+    bind_ip = os.environ.get("PADDLE_RPC_BIND_IP", my_ip)
+    _state.listener = Listener((bind_ip, 0), authkey=_state.auth)
     my_port = _state.listener.address[1]
     _state.name = name
     _state.rank = rank
@@ -123,7 +134,7 @@ def get_all_worker_infos():
 
 def _call(to, fn, args, kwargs, timeout):
     info = _state.workers[to]
-    conn = Client((info.ip, info.port), authkey=_AUTH)
+    conn = Client((info.ip, info.port), authkey=_state.auth)
     try:
         conn.send((fn, args or (), kwargs or {}))
         if timeout and timeout > 0:

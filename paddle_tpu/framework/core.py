@@ -263,11 +263,19 @@ def _run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
     if not roots:
         for t in tensors:
             g = pending.pop(id(t), None)
+            if g is not None:
+                g = _apply_grad_hooks(t, g)
             if capture is not None and id(t) in capture:
                 captured[id(t)] = g
             else:
                 _deposit_leaf_grad(t, g)
         return captured
+
+    # leaf grads accumulate here and deposit once at the end, so gradient
+    # hooks observe the COMPLETE gradient (a leaf consumed by several ops
+    # receives one hook call, not one per contribution)
+    leaf_pending: dict[int, Any] = {}
+    leaf_keep: dict[int, Tensor] = {}
 
     nodes = []
     seen = set()
@@ -288,7 +296,9 @@ def _run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
                 c = pending.pop(id(t), None)
                 keep.pop(id(t), None)
                 # cotangent for t is complete here (all consumer nodes have
-                # higher ids and were already processed) — capture point
+                # higher ids and were already processed) — hook + capture point
+                if c is not None:
+                    c = _apply_grad_hooks(t, c)
                 if c is not None and capture is not None and id(t) in capture:
                     captured[id(t)] = c
             if c is None:
@@ -300,23 +310,31 @@ def _run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
             continue
         cot_tree = jax.tree_util.tree_unflatten(node.out_treedef, cots)
         in_cots = node.vjp_fn(cot_tree)
+        _maybe_check_nan(in_cots, node.name + "_grad")
         if not retain_graph:
             node.vjp_fn = None
         for t, rec_node, c in zip(node.inputs, node.in_nodes, in_cots):
             if rec_node is None:
-                if capture is not None and id(t) in capture:
-                    captured[id(t)] = captured[id(t)] + c if id(t) in captured else c
-                if capture is None or id(t) not in capture:
-                    _deposit_leaf_grad(t, c)
+                _accum(leaf_pending, leaf_keep, t, c)
             else:
                 _accum(pending, keep, t, c)
 
-    # anything left pending whose node was unreachable: deposit on leaves
+    # anything left pending whose node was unreachable: treat as leaf
     for tid, c in pending.items():
         t = keep.get(tid)
+        if t is None and capture is not None:
+            t = capture.get(tid)
+        if t is not None and (t._node is None or (capture is not None
+                                                  and tid in capture)):
+            _accum(leaf_pending, leaf_keep, t, c)
+
+    # flush complete leaf gradients: hooks fire once, then capture/deposit
+    for tid, c in leaf_pending.items():
+        t = leaf_keep[tid]
+        c = _apply_grad_hooks(t, c)
         if capture is not None and tid in capture:
             captured[tid] = captured[tid] + c if tid in captured else c
-        elif t is not None and t._node is None:
+        else:
             _deposit_leaf_grad(t, c)
     return captured
 
@@ -328,6 +346,19 @@ def _accum(pending, keep, t, g):
     else:
         pending[tid] = g
         keep[tid] = t
+
+
+def _apply_grad_hooks(t, g):
+    """Run a tensor's registered gradient hooks over its complete cotangent.
+    reference: paddle/fluid/eager/hooks.h (TensorHook::operator())."""
+    hooks = t.__dict__.get("_grad_hooks") if hasattr(t, "__dict__") else None
+    if not hooks:
+        return g
+    for hook in list(hooks.values()):
+        r = hook(Tensor(g, stop_gradient=True))
+        if r is not None:
+            g = r._data if isinstance(r, Tensor) else jnp.asarray(r)
+    return g
 
 
 def _deposit_leaf_grad(t, g):
@@ -352,9 +383,26 @@ def _unwrap(x):
 # avoid a circular import). Signature: (name, arrays) -> arrays.
 _amp_cast_hook = None
 
-# NaN/Inf checker hook (FLAGS_check_nan_inf analog,
-# reference: paddle/fluid/eager/nan_inf_utils.h). Installed lazily.
-_nan_check_enabled = False
+def _maybe_check_nan(out, name):
+    """FLAGS_check_nan_inf: scan op outputs for NaN/Inf when enabled.
+    reference: paddle/fluid/eager/nan_inf_utils.h CheckTensorHasNanOrInf —
+    there a per-kernel device scan; here one jnp.isfinite reduce per output
+    (eager only: traced values are abstract, and jit programs get checked
+    at their eager call sites)."""
+    from . import flags as _flags
+    if not _flags.flag_value("check_nan_inf") or _TRACE_CTX is not None:
+        return out
+    for leaf in jax.tree_util.tree_leaves(out):
+        if (hasattr(leaf, "dtype")
+                and jnp.issubdtype(leaf.dtype, jnp.inexact)
+                and not bool(jnp.all(jnp.isfinite(leaf)))):
+            msg = (f"Operator '{name}' output contains NaN or Inf "
+                   f"(FLAGS_check_nan_inf is set)")
+            if _flags.flag_value("check_nan_inf_level") == 0:
+                raise RuntimeError(msg)
+            import warnings
+            warnings.warn(msg, RuntimeWarning)
+    return out
 
 
 def execute(f: Callable, *inputs, _name: str = None, **static_kwargs):
@@ -393,6 +441,7 @@ def execute(f: Callable, *inputs, _name: str = None, **static_kwargs):
 
     if not diff_idx:
         out = f(*arrs, **static_kwargs)
+        _maybe_check_nan(out, _name or getattr(f, "__name__", "op"))
         return _wrap_outputs(out, stop_gradient=True)
 
     const = list(arrs)
@@ -405,6 +454,7 @@ def execute(f: Callable, *inputs, _name: str = None, **static_kwargs):
 
     diff_arrs = [arrs[i] for i in diff_idx]
     out, vjp_fn = jax.vjp(g, *diff_arrs)
+    _maybe_check_nan(out, _name or getattr(f, "__name__", "op"))
 
     flat, treedef = jax.tree_util.tree_flatten(out)
     # only record if at least one output is inexact (differentiable)
@@ -572,8 +622,26 @@ class Tensor:
             self._grad = None
 
     def register_hook(self, hook):
-        # gradient hooks: record a pass-through op whose vjp applies hook
-        raise NotImplementedError("register_hook: use autograd.PyLayer for custom grads")
+        """Call hook(grad) when this tensor's gradient is computed during
+        backward; a non-None return value replaces the gradient.
+        reference: tensor_patch_methods.py register_hook /
+        paddle/fluid/eager/hooks.h TensorHook. Returns a removable handle."""
+        if self.stop_gradient:
+            raise RuntimeError(
+                "register_hook on a tensor with stop_gradient=True is "
+                "meaningless (no gradient will ever be computed)")
+        hooks = self.__dict__.setdefault("_grad_hooks", {})
+        hid = self.__dict__.get("_grad_hook_next", 0)
+        self.__dict__["_grad_hook_next"] = hid + 1  # ids never reused, so a
+        # stale handle's second remove() can't delete a later hook
+        hooks[hid] = hook
+
+        class _HookHandle:
+            def remove(_self):
+                hooks.pop(hid, None)
+                return True
+
+        return _HookHandle()
 
     # -- in-place helpers ---------------------------------------------------
     def _rebind(self, new: "Tensor"):

@@ -21,7 +21,7 @@ from .. import nn
 
 __all__ = ["calculate_density", "decorate", "prune_model",
            "set_excluded_layers", "reset_excluded_layers",
-           "create_mask", "check_mask_2d", "check_sparsity"]
+           "create_mask", "check_mask_1d", "check_mask_2d", "check_sparsity"]
 
 _excluded = set()
 
@@ -42,31 +42,73 @@ def reset_excluded_layers(main_program=None):
     _excluded.clear()
 
 
-def create_mask(weight, func_name="mask_1d", n=2, m=4):
-    """n:m sparse mask (keep the n largest of every m consecutive weights).
-    reference: asp/utils.py create_mask (MaskAlgo 1D/2D best/greedy)."""
-    w = weight.numpy() if isinstance(weight, Tensor) else np.asarray(weight)
-    orig_shape = w.shape
-    flat = w.reshape(-1, m) if w.size % m == 0 else None
-    if flat is None:
-        return np.ones_like(w)
-    idx = np.argsort(np.abs(flat), axis=1)[:, : m - n]   # drop smallest m-n
+def _to_rows(w):
+    """Reference orientation (asp/utils.py create_mask): collapse to 2D so
+    the n:m groups run along the reduction (input-channel) dimension —
+    1D -> (1, d); 2D -> as-is; 3D -> (d0*d1, d2);
+    4D conv (h, w, in, out) -> (h*w*out, in) with an inverse transform."""
+    shape = w.shape
+    if w.ndim == 1:
+        return w.reshape(1, -1), lambda mk: mk.reshape(shape)
+    if w.ndim == 2:
+        return w, lambda mk: mk
+    if w.ndim == 3:
+        return (w.reshape(shape[0] * shape[1], shape[2]),
+                lambda mk: mk.reshape(shape))
+    if w.ndim == 4:
+        t = w.transpose(0, 1, 3, 2).reshape(
+            shape[0] * shape[1] * shape[3], shape[2])
+        return t, lambda mk: mk.reshape(
+            shape[0], shape[1], shape[3], shape[2]).transpose(0, 1, 3, 2)
+    raise ValueError(f"create_mask supports ndim<=4, got {w.ndim}")
+
+
+def _mask_rows_1d(t2d, n, m):
+    """n:m pattern along each ROW, rows zero-padded to a multiple of m
+    (reference asp/utils.py _reshape_1d + get_mask_1d)."""
+    rows, cols = t2d.shape
+    pad = (-cols) % m
+    if pad:
+        t2d = np.concatenate(
+            [t2d, np.zeros((rows, pad), t2d.dtype)], axis=1)
+    flat = t2d.reshape(-1, m)
+    idx = np.argsort(np.abs(flat), axis=1)[:, : m - n]  # drop smallest m-n
     mask = np.ones_like(flat)
     np.put_along_axis(mask, idx, 0.0, axis=1)
-    return mask.reshape(orig_shape)
+    return mask.reshape(rows, cols + pad)[:, :cols]
 
 
-def check_mask_2d(mat, n=2, m=4):
-    """Every m-length group along the last axis has at most n nonzeros."""
+def create_mask(weight, func_name="mask_1d", n=2, m=4):
+    """n:m sparse mask (keep the n largest of every m consecutive weights
+    along the reduction dim). reference: asp/utils.py create_mask."""
+    w = weight.numpy() if isinstance(weight, Tensor) else np.asarray(weight)
+    t2d, restore = _to_rows(w.astype(np.float32, copy=False))
+    return restore(_mask_rows_1d(t2d, n, m)).astype(w.dtype)
+
+
+def check_mask_1d(mat, n=2, m=4):
+    """Every m-length group along each row has at most n nonzeros
+    (rows padded with zeros like the reference check_mask_1d)."""
     a = mat.numpy() if isinstance(mat, Tensor) else np.asarray(mat)
-    if a.size % m:
-        return False
+    if a.ndim == 1:
+        a = a.reshape(1, -1)
+    pad = (-a.shape[-1]) % m
+    if pad:
+        a = np.concatenate(
+            [a, np.zeros(a.shape[:-1] + (pad,), a.dtype)], axis=-1)
     groups = (a != 0).reshape(-1, m).sum(axis=1)
     return bool((groups <= n).all())
 
 
+def check_mask_2d(mat, n=2, m=4):
+    return check_mask_1d(mat, n, m)
+
+
 def check_sparsity(mat, n=2, m=4, func_name=None):
-    return check_mask_2d(mat, n, m)
+    """Checks in the same orientation create_mask writes."""
+    a = mat.numpy() if isinstance(mat, Tensor) else np.asarray(mat)
+    t2d, _ = _to_rows(a)
+    return check_mask_1d(t2d, n, m)
 
 
 def _supported(layer):

@@ -23,10 +23,13 @@ __all__ = ["QuantConfig", "QAT", "PTQ", "BaseQuanter", "BaseObserver",
 
 
 def _fake_quant(x, scale, bits=8):
-    """Quant-dequant with straight-through gradient."""
+    """Quant-dequant with straight-through gradient. A zero scale means the
+    observer has seen no data yet — pass the value through unquantized
+    instead of collapsing everything into the [-1e-8, 1e-8] bucket."""
     qmax = float(2 ** (bits - 1) - 1)
     s = jnp.maximum(scale, 1e-8)
     q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) * s / qmax
+    q = jnp.where(scale > 0, q, x)
     return x + jax.lax.stop_gradient(q - x)
 
 

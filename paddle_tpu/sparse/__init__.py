@@ -404,16 +404,28 @@ def reshape(x, shape, name=None):
         fill = numel // -int(np.prod(shape))
         shape[shape.index(-1)] = fill
     sp_ndim = coo._indices.shape[0]
-    # linearize old indices, delinearize into new shape
-    lin = jnp.zeros(coo._indices.shape[1], jnp.int64)
+    # linearize old indices, delinearize into new shape. jnp "int64" silently
+    # truncates to int32 without jax_enable_x64, so tensors with numel >
+    # 2^31 would wrap — do the index arithmetic on host in real int64
+    # (indices are metadata; values stay on device untouched).
+    idx_np = np.asarray(coo._indices).astype(np.int64)
+    lin = np.zeros(idx_np.shape[1], np.int64)
     for d in range(sp_ndim):
-        lin = lin * coo._shape[d] + coo._indices[d]
+        lin = lin * int(coo._shape[d]) + idx_np[d]
     new_idx = []
     rem = lin
     for d in range(len(shape) - 1, -1, -1):
-        new_idx.append((rem % shape[d]).astype(jnp.int32))
+        new_idx.append(rem % shape[d])
         rem = rem // shape[d]
-    out = SparseCooTensor(jnp.stack(new_idx[::-1]), coo._values, tuple(shape))
+    idx_arr = np.stack(new_idx[::-1])
+    if idx_arr.size and idx_arr.max(initial=0) > np.iinfo(np.int32).max:
+        # device indices are int32 unless jax_enable_x64 is set; refuse to
+        # wrap silently
+        raise ValueError(
+            f"sparse reshape target {shape} needs indices beyond int32 "
+            "range; enable jax_enable_x64 to reshape tensors this large")
+    out = SparseCooTensor(jnp.asarray(idx_arr.astype(np.int32)),
+                          coo._values, tuple(shape))
     if isinstance(x, SparseCsrTensor):
         return out.to_sparse_csr()
     return out

@@ -1,0 +1,158 @@
+"""Static auto-parallel: Engine / DistModel / to_static / to_distributed.
+
+reference: auto_parallel/static/engine.py:100, auto_parallel/api.py:2715.
+Done-bar from the build plan: DistModel MLP fit on the 8-CPU mesh with loss
+parity vs single-device training; to_distributed stops being a stub.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn, optimizer
+
+
+def _mlp():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+class _XYDataset:
+    def __init__(self, n=64):
+        rs = np.random.RandomState(0)
+        self.x = rs.randn(n, 8).astype(np.float32)
+        w = rs.randn(8, 4).astype(np.float32)
+        self.y = np.argmax(self.x @ w, axis=1).astype(np.int64)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+class TestEngine:
+    def test_fit_loss_decreases_and_eval(self):
+        model = _mlp()
+        eng = dist.Engine(model, nn.CrossEntropyLoss(),
+                          optimizer.Adam(1e-2, parameters=model.parameters()))
+        hist = eng.fit(_XYDataset(), epochs=3, batch_size=16)
+        assert hist["loss"][-1] < hist["loss"][0]
+        res = eng.evaluate(_XYDataset(), batch_size=16)
+        assert np.isfinite(res["loss"])
+        preds = eng.predict(_XYDataset(), batch_size=16, steps=1)
+        assert preds[0].shape == (16, 4)
+
+    def test_loss_parity_vs_single_device(self):
+        """Same data, same init: the 8-device dp engine must reproduce the
+        single-device eager training losses."""
+        ds = _XYDataset(32)
+        xs = ds.x.reshape(2, 16, 8)
+        ys = ds.y.reshape(2, 16)
+
+        # single-device eager reference
+        model_ref = _mlp()
+        opt_ref = optimizer.Adam(1e-2, parameters=model_ref.parameters())
+        ce = nn.CrossEntropyLoss()
+        ref_losses = []
+        for e in range(2):
+            for x, y in zip(xs, ys):
+                loss = ce(model_ref(paddle.Tensor(jnp.asarray(x))),
+                          paddle.Tensor(jnp.asarray(y)))
+                ref_losses.append(float(loss))
+                loss.backward()
+                opt_ref.step()
+                opt_ref.clear_grad()
+
+        # engine on the full 8-device dp mesh
+        model = _mlp()
+        eng = dist.Engine(model, nn.CrossEntropyLoss(),
+                          optimizer.Adam(1e-2, parameters=model.parameters()))
+        trainer = eng._ensure_trainer()
+        got = []
+        for e in range(2):
+            for x, y in zip(xs, ys):
+                got.append(float(trainer.step(
+                    (jnp.asarray(x), jnp.asarray(y)))))
+        np.testing.assert_allclose(got, ref_losses, rtol=1e-4, atol=1e-5)
+
+    def test_strategy_sharding_and_recompute(self):
+        st = dist.Strategy()
+        st.sharding.enable = True
+        st.sharding.stage = 2
+        st.sharding.degree = 2
+        st.recompute.enable = True
+        model = _mlp()
+        eng = dist.Engine(model, nn.CrossEntropyLoss(),
+                          optimizer.Adam(1e-2,
+                                         parameters=model.parameters()),
+                          strategy=st)
+        hist = eng.fit(_XYDataset(), epochs=2, batch_size=16)
+        assert hist["loss"][-1] < hist["loss"][0]
+        mesh = eng._jax_mesh()
+        assert mesh.shape["sharding"] == 2
+
+    def test_save_load_roundtrip(self, tmp_path):
+        model = _mlp()
+        eng = dist.Engine(model, nn.CrossEntropyLoss(),
+                          optimizer.Adam(1e-2, parameters=model.parameters()))
+        eng.fit(_XYDataset(), epochs=1, batch_size=16)
+        path = str(tmp_path / "ckpt")
+        eng.save(path)
+        before = {k: np.asarray(v._data)
+                  for k, v in model.state_dict().items()}
+        model2 = _mlp()
+        eng2 = dist.Engine(model2, nn.CrossEntropyLoss(),
+                           optimizer.Adam(1e-2,
+                                          parameters=model2.parameters()))
+        eng2.load(path)
+        for k, v in model2.state_dict().items():
+            np.testing.assert_array_equal(np.asarray(v._data), before[k])
+
+
+class TestDistModel:
+    def test_to_static_train_eval_predict(self):
+        model = _mlp()
+        dm = dist.to_static(model, loss=nn.CrossEntropyLoss(),
+                            optimizer=optimizer.Adam(
+                                1e-2, parameters=model.parameters()))
+        ds = _XYDataset(32)
+        x = jnp.asarray(ds.x[:16])
+        y = jnp.asarray(ds.y[:16])
+        losses = [float(dm(x, y)) for _ in range(4)]
+        assert losses[-1] < losses[0]
+        dm.eval()
+        ev = float(dm(x, y))
+        assert np.isfinite(ev)
+        dm.predict()
+        out = dm(x)
+        assert out.shape == (16, 4)
+        sd = dm.state_dict()
+        assert "0.weight" in sd
+
+
+class TestToDistributed:
+    def test_shards_params_and_loader(self):
+        from paddle_tpu.io import DataLoader
+        model = _mlp()
+        opt = optimizer.Adam(1e-2, parameters=model.parameters())
+        dl = DataLoader(_XYDataset(32), batch_size=16)
+        model, opt, dl = dist.to_distributed(model, opt, dl)
+        # params replicated on a dp mesh (not the stub's untouched passthrough)
+        p = next(iter(model.parameters()))
+        assert getattr(p, "process_mesh", None) is not None
+        assert p.process_mesh.dim_names == ["dp"]
+        assert len(p._data.sharding.device_set) == len(jax.devices())
+        # batches come out sharded over dp
+        x, y = next(iter(dl))
+        assert len(x._data.sharding.device_set) == len(jax.devices())
+        # and eager training still works on the sharded layout
+        loss = nn.CrossEntropyLoss()(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        assert np.isfinite(float(loss))

@@ -181,3 +181,104 @@ class TestFunctional:
         x = paddle.to_tensor([2.0])
         h = paddle.autograd.hessian(f, x)
         np.testing.assert_allclose(np.asarray(h).reshape(-1), [12.0], rtol=1e-5)
+
+
+class TestGradientHooks:
+    """Tensor.register_hook: reference tensor_patch_methods.py register_hook
+    + eager/hooks.h TensorHook."""
+
+    def test_hook_observes_and_replaces_grad(self):
+        x = paddle.Tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                          stop_gradient=False)
+        seen = []
+
+        def double(g):
+            seen.append(np.asarray(g._data).copy())
+            return g * 2.0
+
+        h = x.register_hook(double)
+        y = (x * x).sum()
+        y.backward()
+        # d(x^2)/dx = 2x, hook doubles it
+        np.testing.assert_allclose(np.asarray(x.grad._data), [4.0, 8.0, 12.0])
+        np.testing.assert_allclose(seen[0], [2.0, 4.0, 6.0])
+        assert h.remove()
+
+    def test_hook_fires_once_with_complete_grad(self):
+        """A leaf consumed by two ops gets ONE hook call with the summed
+        gradient, not one call per contribution."""
+        x = paddle.Tensor(np.array([1.0, 2.0], np.float32),
+                          stop_gradient=False)
+        calls = []
+        x.register_hook(lambda g: calls.append(np.asarray(g._data).copy()))
+        y = (x * 3.0).sum() + (x * x).sum()
+        y.backward()
+        assert len(calls) == 1
+        np.testing.assert_allclose(calls[0], [5.0, 7.0])  # 3 + 2x
+        np.testing.assert_allclose(np.asarray(x.grad._data), [5.0, 7.0])
+
+    def test_hook_on_intermediate(self):
+        x = paddle.Tensor(np.array([2.0], np.float32), stop_gradient=False)
+        mid = x * 3.0
+        mid.register_hook(lambda g: g * 10.0)
+        out = (mid * 2.0).sum()
+        out.backward()
+        # d out/d mid = 2, hook -> 20, d mid/dx = 3 -> 60
+        np.testing.assert_allclose(np.asarray(x.grad._data), [60.0])
+
+    def test_remove_handle(self):
+        x = paddle.Tensor(np.array([1.0], np.float32), stop_gradient=False)
+        h = x.register_hook(lambda g: g * 100.0)
+        h.remove()
+        (x * x).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad._data), [2.0])
+
+    def test_rejects_stop_gradient_tensor(self):
+        x = paddle.Tensor(np.array([1.0], np.float32))
+        with pytest.raises(RuntimeError, match="stop_gradient"):
+            x.register_hook(lambda g: g)
+
+
+class TestNanInfChecker:
+    """FLAGS_check_nan_inf: reference paddle/fluid/eager/nan_inf_utils.h."""
+
+    def _with_flag(self, value, fn):
+        paddle.set_flags({"FLAGS_check_nan_inf": value})
+        try:
+            return fn()
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_forward_nan_raises(self):
+        def run():
+            x = paddle.Tensor(np.array([-1.0], np.float32),
+                              stop_gradient=False)
+            with pytest.raises(RuntimeError, match="NaN or Inf"):
+                paddle.sqrt(x)
+        self._with_flag(True, run)
+
+    def test_backward_nan_raises(self):
+        def run():
+            # sqrt(0) forward is fine; backward 1/(2*sqrt(0)) = inf
+            x = paddle.Tensor(np.array([0.0], np.float32),
+                              stop_gradient=False)
+            y = paddle.sqrt(x).sum()
+            with pytest.raises(RuntimeError, match="NaN or Inf"):
+                y.backward()
+        self._with_flag(True, run)
+
+    def test_disabled_by_default(self):
+        x = paddle.Tensor(np.array([-1.0], np.float32), stop_gradient=False)
+        y = paddle.sqrt(x)  # quietly NaN, like the reference without the flag
+        assert np.isnan(np.asarray(y._data)).all()
+
+    def test_level_warns_instead(self):
+        def run():
+            paddle.set_flags({"FLAGS_check_nan_inf_level": 1})
+            try:
+                x = paddle.Tensor(np.array([-1.0], np.float32))
+                with pytest.warns(RuntimeWarning, match="NaN or Inf"):
+                    paddle.sqrt(x)
+            finally:
+                paddle.set_flags({"FLAGS_check_nan_inf_level": 0})
+        self._with_flag(True, run)

@@ -28,26 +28,30 @@ def _put(arr, mesh, spec):
     return jax.device_put(arr, NamedSharding(mesh, spec))
 
 
+def _chunk_files(tmp_path):
+    return sorted(f for f in tmp_path.iterdir() if f.suffix == ".npy")
+
+
 def test_save_dedups_replicated_chunks(tmp_path):
     mesh = _mesh((4,), ("dp",))
     x = _put(np.arange(16, dtype=np.float32).reshape(4, 4), mesh, P())  # replicated
     save_state_dict({"w": x}, str(tmp_path))
-    with open(tmp_path / "shard_r0.data", "rb") as f:
-        chunks = pickle.load(f)
-    # replicated on 4 devices -> exactly ONE saved chunk
-    assert len(chunks["w"]) == 1
+    # replicated on 4 devices -> exactly ONE saved chunk file, no pickle
+    files = _chunk_files(tmp_path)
+    assert len(files) == 1
+    assert np.load(files[0], allow_pickle=False).shape == (4, 4)
     meta = json.load(open(tmp_path / "metadata.json"))
     assert len(meta["arrays"]["w"]["chunks"]) == 1
+    assert not (tmp_path / "metadata.json.tmp").exists()  # atomic rename
 
 
 def test_sharded_save_writes_each_chunk_once(tmp_path):
     mesh = _mesh((4, 2), ("dp", "mp"))
     x = _put(np.arange(64, dtype=np.float32).reshape(8, 8), mesh, P("dp", "mp"))
     save_state_dict({"w": x}, str(tmp_path))
-    with open(tmp_path / "shard_r0.data", "rb") as f:
-        chunks = pickle.load(f)
-    assert len(chunks["w"]) == 8  # 4x2 distinct chunks, one copy each
-    total = sum(c.size for c in chunks["w"].values())
+    files = _chunk_files(tmp_path)
+    assert len(files) == 8  # 4x2 distinct chunks, one .npy file each
+    total = sum(np.load(f, allow_pickle=False).size for f in files)
     assert total == 64  # no overlap / duplication
 
 
@@ -101,3 +105,15 @@ def test_shape_mismatch_raises(tmp_path):
     m3 = paddle.nn.Linear(5, 3)
     with pytest.raises((ValueError, KeyError)):
         load_state_dict(m3.state_dict(), str(tmp_path))
+
+
+def test_non_owner_rank_writes_nothing(tmp_path, monkeypatch):
+    """Simulated multi-host: a process that owns no chunks (all owners are
+    process 0) must write zero data files and no metadata."""
+    import paddle_tpu.distributed.checkpoint as ckpt
+    mesh = _mesh((4,), ("dp",))
+    x = _put(np.arange(16, dtype=np.float32).reshape(4, 4), mesh, P("dp", None))
+    monkeypatch.setattr(jax, "process_index", lambda *a, **k: 1)
+    save_state_dict({"w": x}, str(tmp_path))
+    assert _chunk_files(tmp_path) == []
+    assert not (tmp_path / "metadata.json").exists()

@@ -27,6 +27,7 @@ from .collective import (  # noqa: F401
 )
 from .auto_parallel import (  # noqa: F401
     DistModel, Engine, Strategy, to_static)
+from .auto_tuner import AutoTuner, TunerConfig  # noqa: F401
 from .store import Store, TCPStore  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401

@@ -112,7 +112,9 @@ class Engine:
             sharding_stage=stage)
         return self._trainer
 
-    def _as_loader(self, data, batch_size, shuffle=False):
+    def _as_loader(self, data, batch_size, shuffle=False, drop_last=False):
+        """drop_last only for fit (stable compiled shapes); eval/predict
+        must see every sample."""
         from ..io import DataLoader
         if data is None:
             return None
@@ -121,7 +123,27 @@ class Engine:
         if isinstance(data, DataLoader):
             return data
         return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
-                          drop_last=True)
+                          drop_last=drop_last)
+
+    def _get_eval_fn(self):
+        """Jitted eval-mode loss (dropout off, BN running stats)."""
+        if self._eval_fn is None:
+            from ..parallel.functional import make_loss_fn
+            self._eval_fn = jax.jit(
+                make_loss_fn(self._model, self._loss, training=False))
+        return self._eval_fn
+
+    def _get_pred_fn(self):
+        if self._pred_fn is None:
+            from ..parallel.functional import functional_call
+
+            def fwd(params, x, key):
+                out = functional_call(self._model, params, x, rng_key=key,
+                                      training=False)
+                return out[1] if isinstance(out, (tuple, list)) else out
+
+            self._pred_fn = jax.jit(fwd)
+        return self._pred_fn
 
     @staticmethod
     def _arrays(batch):
@@ -138,7 +160,8 @@ class Engine:
     def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
             verbose=0, **kw):
         trainer = self._ensure_trainer()
-        loader = self._as_loader(train_data, batch_size, shuffle=True)
+        loader = self._as_loader(train_data, batch_size, shuffle=True,
+                                 drop_last=True)
         for epoch in range(epochs):
             for i, batch in enumerate(loader):
                 if steps_per_epoch is not None and i >= steps_per_epoch:
@@ -153,29 +176,20 @@ class Engine:
 
     def evaluate(self, valid_data, batch_size=1, steps=None, verbose=0, **kw):
         trainer = self._ensure_trainer()
-        if self._eval_fn is None:
-            self._eval_fn = jax.jit(trainer._loss)
+        eval_fn = self._get_eval_fn()
         loader = self._as_loader(valid_data, batch_size)
         losses = []
         key = jax.random.key(0)
         for i, batch in enumerate(loader):
             if steps is not None and i >= steps:
                 break
-            losses.append(float(self._eval_fn(
+            losses.append(float(eval_fn(
                 trainer.params, self._arrays(batch), key)))
         return {"loss": float(np.mean(losses)) if losses else float("nan")}
 
     def predict(self, test_data, batch_size=1, steps=None, **kw):
         trainer = self._ensure_trainer()
-        if self._pred_fn is None:
-            from ..parallel.functional import functional_call
-
-            def fwd(params, x, key):
-                out = functional_call(self._model, params, x, rng_key=key,
-                                      training=False)
-                return out[1] if isinstance(out, (tuple, list)) else out
-
-            self._pred_fn = jax.jit(fwd)
+        self._get_pred_fn()
         loader = self._as_loader(test_data, batch_size)
         outs = []
         key = jax.random.key(0)
@@ -241,20 +255,10 @@ class DistModel:
             return trainer.step(batch)
         arrays = eng._arrays(batch)
         if self._mode == "eval":
-            if eng._eval_fn is None:
-                eng._eval_fn = jax.jit(trainer._loss)
-            return eng._eval_fn(trainer.params, arrays, jax.random.key(0))
+            return eng._get_eval_fn()(trainer.params, arrays,
+                                      jax.random.key(0))
         x = arrays[0] if isinstance(arrays, (tuple, list)) else arrays
-        if eng._pred_fn is None:
-            from ..parallel.functional import functional_call
-
-            def fwd(params, xx, key):
-                out = functional_call(eng._model, params, xx, rng_key=key,
-                                      training=False)
-                return out[1] if isinstance(out, (tuple, list)) else out
-
-            eng._pred_fn = jax.jit(fwd)
-        return eng._pred_fn(trainer.params, x, jax.random.key(0))
+        return eng._get_pred_fn()(trainer.params, x, jax.random.key(0))
 
     def state_dict(self, mode="all"):
         self._engine._ensure_trainer().sync_to_model()

@@ -41,6 +41,19 @@ def set_flags(flags: dict):
         if k not in _REGISTRY:
             raise ValueError(f"unknown flag FLAGS_{k}")
         _REGISTRY[k]["value"] = v
+        _apply_side_effect(k, v)
+
+
+def _apply_side_effect(name, value):
+    """Flags that configure jax/XLA directly take effect on set."""
+    if name == "matmul_precision":
+        import jax
+        jax.config.update("jax_default_matmul_precision",
+                          None if value == "default" else value)
+    elif name == "jit_cache_dir" and value:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", value)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
 
 
 def get_flags(flags):
@@ -69,3 +82,34 @@ define_flag("benchmark", False, "sync after each op for timing (ref: FLAGS_bench
 define_flag("jit_default_backend", "xla", "compiled-step backend")
 define_flag("flash_attention_backend", "auto", "auto|pallas|xla for scaled_dot_product_attention")
 define_flag("enable_auto_remat", False, "apply jax.checkpoint policy to compiled blocks")
+
+# numerics / precision (ref: FLAGS_use_mkldnn-era precision knobs collapse
+# into XLA precision config)
+define_flag("matmul_precision", "default", "default|high|highest -> jax default_matmul_precision")
+define_flag("cudnn_deterministic", False, "ref FLAGS_cudnn_deterministic: on TPU maps to XLA deterministic reductions (informational)")
+define_flag("embedding_deterministic", 0, "ref FLAGS_embedding_deterministic; TPU scatters are deterministic (informational)")
+define_flag("low_precision_op_list", 0, "ref FLAGS_low_precision_op_list: log AMP casts when >0")
+# memory (ref: FLAGS_fraction_of_gpu_memory_to_use family -> XLA_PYTHON_CLIENT_*)
+define_flag("fraction_of_gpu_memory_to_use", 0.92, "ref name kept; forwards to XLA_PYTHON_CLIENT_MEM_FRACTION at init")
+define_flag("allocator_strategy", "auto_growth", "ref FLAGS_allocator_strategy; XLA BFC always (informational)")
+define_flag("gpu_memory_limit_mb", 0, "ref FLAGS_gpu_memory_limit_mb; 0 = no cap")
+define_flag("eager_delete_tensor_gb", 0.0, "ref FLAGS_eager_delete_tensor_gb; XLA frees by liveness (informational)")
+define_flag("use_pinned_memory", True, "ref FLAGS_use_pinned_memory; jax pins host staging buffers (informational)")
+# distributed / collectives
+define_flag("dynamic_static_unified_comm", True, "ref FLAGS_dynamic_static_unified_comm; one comm stack here by design")
+define_flag("nccl_blocking_wait", False, "ref FLAGS_nccl_blocking_wait; XLA collectives are in-program (informational)")
+define_flag("distributed_watchdog_timeout_s", 600, "step-watchdog timeout (ref: comm task watchdog)")
+define_flag("stop_check_timeout", 3600, "ref FLAGS_stop_check_timeout: elastic trainer liveness window")
+define_flag("retain_grad_for_all_tensor", False, "ref FLAGS_retain_grad_for_all_tensor: keep .grad on non-leaf tensors")
+# compiled-step behavior
+define_flag("use_stride_kernel", False, "ref FLAGS_use_stride_kernel; XLA has no stride kernels (informational)")
+define_flag("jit_cache_dir", "", "persistent XLA compilation cache directory ('' = off)")
+define_flag("jit_donate_buffers", True, "donate param/opt buffers in compiled train steps")
+define_flag("pipeline_schedule", "FThenB", "default pipeline schedule: FThenB|1F1B")
+define_flag("prim_all", False, "ref FLAGS_prim_all: decompose big ops before autodiff (jax does this inherently; informational)")
+define_flag("cinn_bucket_compile", False, "ref FLAGS_cinn_bucket_compile; XLA owns fusion (informational)")
+# profiler / debug
+define_flag("enable_host_event_recorder_hook", False, "ref FLAGS_enable_host_event_recorder_hook: record host events in profiler")
+define_flag("call_stack_level", 1, "ref FLAGS_call_stack_level: error-message stack detail")
+define_flag("api_benchmark", False, "per-op wall-time logging in execute()")
+define_flag("max_inplace_grad_add", 0, "ref FLAGS_max_inplace_grad_add (informational; tape adds functionally)")

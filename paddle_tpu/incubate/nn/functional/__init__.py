@@ -196,19 +196,211 @@ def fused_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
                                           is_causal=is_causal, training=training)
 
 
-def fused_multi_head_attention(*args, **kwargs):
-    raise NotImplementedError(
-        "use nn.MultiHeadAttention (XLA fuses the projections + attention)")
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, num_heads=-1, transpose_qkv_wb=False,
+                               name=None):
+    """reference: incubate/nn/functional/fused_transformer.py:513 — one
+    transformer attention block: (pre-)LN -> qkv proj -> MHA -> out proj ->
+    dropout -> residual add -> (post-)LN. On TPU the whole chain is XLA
+    fusions around the attention matmuls; semantics match the pseudo-code
+    in the reference docstring.
+
+    x: (batch, seq, embed). qkv_weight: (3, num_heads, head_dim, embed)
+    (or (embed, 3*embed) with transpose_qkv_wb). linear_weight:
+    (embed, embed). Returns the block output (batch, seq, embed)."""
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention with cache_kv (incremental decode) "
+            "is not supported; use masked_multihead_attention for the "
+            "decode step")
+    from ....framework.random import next_key
+    dk = next_key() if (training and dropout_rate > 0.0) else None
+    dk_attn = next_key() if (training and attn_dropout_rate > 0.0) else None
+
+    args = [x, qkv_weight, linear_weight]
+    opt = {"pre_ln_scale": pre_ln_scale, "pre_ln_bias": pre_ln_bias,
+           "ln_scale": ln_scale, "ln_bias": ln_bias, "qkv_bias": qkv_bias,
+           "linear_bias": linear_bias, "attn_mask": attn_mask}
+    names = [k for k, v in opt.items() if v is not None]
+    args += [opt[k] for k in names]
+
+    def f(xa, qkv_w, lin_w, *rest):
+        r = dict(zip(names, rest))
+        b, s, e = xa.shape
+        residual = xa
+        h = xa
+        if pre_layer_norm:
+            h = _ln(h, r.get("pre_ln_scale"), r.get("pre_ln_bias"),
+                    pre_ln_epsilon)
+        if transpose_qkv_wb:
+            nh = num_heads
+            qkv = h @ qkv_w                      # (b, s, 3e)
+            if "qkv_bias" in r:
+                qkv = qkv + r["qkv_bias"]
+            qkv = qkv.reshape(b, s, 3, nh, e // nh)
+        else:
+            nh, hd = qkv_w.shape[1], qkv_w.shape[2]
+            qkv = jnp.einsum("bse,thde->bsthd", h, qkv_w)
+            if "qkv_bias" in r:
+                qkv = qkv + r["qkv_bias"]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (b, s, nh, hd)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32)
+        logits = logits / jnp.sqrt(jnp.float32(q.shape[-1]))
+        if "attn_mask" in r:
+            logits = logits + r["attn_mask"].astype(logits.dtype)
+        probs = jax.nn.softmax(logits, axis=-1)
+        if dk_attn is not None:
+            keepm = jax.random.bernoulli(dk_attn, 1.0 - attn_dropout_rate,
+                                         probs.shape)
+            probs = jnp.where(keepm, probs / (1.0 - attn_dropout_rate), 0.0)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+        out = ctx.reshape(b, s, -1) @ lin_w
+        if "linear_bias" in r:
+            out = out + r["linear_bias"]
+        if dk is not None:
+            keepo = jax.random.bernoulli(dk, 1.0 - dropout_rate, out.shape)
+            out = jnp.where(keepo, out / (1.0 - dropout_rate), 0.0)
+        out = residual + out
+        if not pre_layer_norm:
+            out = _ln(out, r.get("ln_scale"), r.get("ln_bias"), ln_epsilon)
+        return out
+
+    return execute(f, *args, _name="fused_multi_head_attention")
 
 
-def fused_feedforward(*args, **kwargs):
-    raise NotImplementedError(
-        "use Linear+activation composition (one XLA fusion on TPU)")
+def _ln(h, scale, bias, eps):
+    mu = jnp.mean(h, axis=-1, keepdims=True)
+    var = jnp.var(h, axis=-1, keepdims=True)
+    out = (h - mu) * jax.lax.rsqrt(var + eps)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return out
 
 
-def masked_multihead_attention(x, cache_kv=None, *args, **kwargs):
-    raise NotImplementedError(
-        "decode-time MHA: see paddle_tpu.ops.pallas.decode_attention (planned)")
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1, add_residual=True,
+                      name=None):
+    """reference: incubate/nn/functional/fused_transformer.py:47 — the
+    transformer FFN block: residual = x; (pre-)LN -> linear1 -> activation
+    -> dropout1 -> linear2 -> dropout2 -> residual add -> (post-)LN.
+    One XLA fusion chain around two MXU matmuls."""
+    from ....framework.random import next_key
+    k1 = next_key() if (training and dropout1_rate > 0.0) else None
+    k2 = next_key() if (training and dropout2_rate > 0.0) else None
+    act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu,
+           "swish": jax.nn.silu, "silu": jax.nn.silu}[activation]
+
+    args = [x, linear1_weight, linear2_weight]
+    opt = {"linear1_bias": linear1_bias, "linear2_bias": linear2_bias,
+           "ln1_scale": ln1_scale, "ln1_bias": ln1_bias,
+           "ln2_scale": ln2_scale, "ln2_bias": ln2_bias}
+    names = [k for k, v in opt.items() if v is not None]
+    args += [opt[k] for k in names]
+
+    def f(xa, w1, w2, *rest):
+        r = dict(zip(names, rest))
+        residual = xa
+        h = xa
+        if pre_layer_norm:
+            h = _ln(h, r.get("ln1_scale"), r.get("ln1_bias"), ln1_epsilon)
+        h = h @ w1
+        if "linear1_bias" in r:
+            h = h + r["linear1_bias"]
+        h = act(h)
+        if k1 is not None:
+            keep = jax.random.bernoulli(k1, 1.0 - dropout1_rate, h.shape)
+            h = jnp.where(keep, h / (1.0 - dropout1_rate), 0.0)
+        h = h @ w2
+        if "linear2_bias" in r:
+            h = h + r["linear2_bias"]
+        if k2 is not None:
+            keep = jax.random.bernoulli(k2, 1.0 - dropout2_rate, h.shape)
+            h = jnp.where(keep, h / (1.0 - dropout2_rate), 0.0)
+        if add_residual:
+            h = residual + h
+        if not pre_layer_norm:
+            h = _ln(h, r.get("ln2_scale"), r.get("ln2_bias"), ln2_epsilon)
+        return h
+
+    return execute(f, *args, _name="fused_feedforward")
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None,
+                               out_smooth=None, seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """Decode-step (single-token) MHA against a KV cache.
+
+    reference: incubate/nn/functional/masked_multihead_attention.py — the
+    generation-time fused kernel. x: (batch, 3*num_head*head_dim) packed
+    qkv for ONE step; cache_kv: (2, batch, num_head, max_seq_len, head_dim);
+    sequence_lengths: (batch, 1) current lengths (this step's kv is written
+    at that position). Returns (out (batch, num_head*head_dim), cache_kv).
+
+    TPU design: the cache update is a dynamic-slice scatter and the
+    attention is one masked (1, L) x (L, d) matmul per head — static
+    shapes, fully fusable. Quant/beam arguments are not supported."""
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv")
+    for unsupported, nm in ((beam_cache_offset, "beam_cache_offset"),
+                            (qkv_out_scale, "qkv_out_scale"),
+                            (out_shift, "out_shift")):
+        if unsupported is not None:
+            raise NotImplementedError(f"{nm} is not supported on TPU")
+
+    args = [x, cache_kv]
+    opt = {"bias": bias, "src_mask": src_mask,
+           "sequence_lengths": sequence_lengths}
+    names = [k for k, v in opt.items() if v is not None]
+    args += [opt[k] for k in names]
+
+    def f(xa, cache, *rest):
+        r = dict(zip(names, rest))
+        _, b, nh, max_len, hd = cache.shape
+        qkv = xa.reshape(b, 3, nh, hd)
+        if "bias" in r:
+            qkv = qkv + r["bias"][None]
+        q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # (b, nh, hd)
+        if "sequence_lengths" in r:
+            pos = r["sequence_lengths"].reshape(b).astype(jnp.int32)
+        else:
+            pos = jnp.zeros((b,), jnp.int32)
+        bi = jnp.arange(b)
+        cache = cache.at[0, bi, :, pos].set(k_new)
+        cache = cache.at[1, bi, :, pos].set(v_new)
+        keys, vals = cache[0], cache[1]          # (b, nh, L, hd)
+        logits = jnp.einsum("bhd,bhld->bhl", q, keys,
+                            preferred_element_type=jnp.float32)
+        logits = logits / jnp.sqrt(jnp.float32(hd))
+        valid = jnp.arange(max_len)[None, :] <= pos[:, None]  # (b, L)
+        logits = jnp.where(valid[:, None, :], logits, jnp.float32(-1e30))
+        if "src_mask" in r:
+            logits = logits + r["src_mask"].reshape(
+                b, 1, -1)[..., :max_len].astype(logits.dtype)
+        probs = jax.nn.softmax(logits, axis=-1).astype(vals.dtype)
+        out = jnp.einsum("bhl,bhld->bhd", probs, vals)
+        return out.reshape(b, nh * hd), cache
+
+    return execute(f, *args, _name="masked_multihead_attention")
 
 
 def variable_length_memory_efficient_attention(query, key, value, seq_lens,

@@ -25,6 +25,11 @@ def functional_call(model, params: dict, *args, rng_key=None, training=True,
     arrays. Safe to call under jit tracing."""
     state = model.state_dict()
     saved = []
+    # honor training=False: dropout/BN branch on layer.training at trace time
+    mode_saved = None
+    if not training and getattr(model, "training", False):
+        mode_saved = True
+        model.eval()
 
     def wrap(a):
         return Tensor(a) if isinstance(a, jax.Array) or hasattr(a, "dtype") else a
@@ -51,13 +56,16 @@ def functional_call(model, params: dict, *args, rng_key=None, training=True,
         for t, data, node in saved:
             t._data = data
             t._node = node
+        if mode_saved:
+            model.train()
 
 
-def make_loss_fn(model, loss_fn: Callable | None = None):
+def make_loss_fn(model, loss_fn: Callable | None = None, training=True):
     """Build pure loss(params, batch, rng_key) -> scalar.
 
     If the model returns (loss, logits) when given labels (LM convention),
-    loss_fn may be None.
+    loss_fn may be None. training=False traces the model in eval mode
+    (dropout off, BN running stats).
     """
 
     def pure_loss(params, batch, rng_key):
@@ -66,10 +74,12 @@ def make_loss_fn(model, loss_fn: Callable | None = None):
         else:
             x, y = batch, None
         if loss_fn is None:
-            out = functional_call(model, params, x, labels=y, rng_key=rng_key)
+            out = functional_call(model, params, x, labels=y, rng_key=rng_key,
+                                  training=training)
             loss = out[0] if isinstance(out, (tuple, list)) else out
         else:
-            out = functional_call(model, params, x, rng_key=rng_key)
+            out = functional_call(model, params, x, rng_key=rng_key,
+                                  training=training)
             logits = out[0] if isinstance(out, (tuple, list)) else out
             loss = loss_fn(Tensor(logits), Tensor(y))
             loss = loss._data if isinstance(loss, Tensor) else loss

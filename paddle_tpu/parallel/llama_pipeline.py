@@ -37,10 +37,13 @@ class LlamaPipeRunner:
 
     def __init__(self, model, mesh: Mesh, num_microbatches: int,
                  axis_name: str = "pp", batch_axis: str | None = None,
-                 optimizer=None, schedule: str = "FThenB"):
+                 optimizer=None, schedule: str | None = None):
         self.model = model
         self.mesh = mesh
         self.axis = axis_name
+        if schedule is None:
+            from ..framework import flags as _flags
+            schedule = _flags.flag_value("pipeline_schedule")
         schedule = {"fthenb": "FThenB", "1f1b": "1F1B"}.get(
             schedule.lower().replace("-", ""), schedule)
         if schedule not in ("FThenB", "1F1B"):

@@ -1,0 +1,87 @@
+"""Auto-tuner: candidate search with divisibility + memory pruning.
+
+reference: distributed/auto_tuner/tuner.py, prune.py, search.py.
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.auto_tuner import AutoTuner, TunerConfig
+
+
+def _cfg(**kw):
+    base = dict(num_devices=8, global_batch_size=32, num_layers=24,
+                hidden_size=2048, num_attention_heads=16, seq_length=2048,
+                vocab_size=32000, hbm_bytes=16e9)
+    base.update(kw)
+    return TunerConfig(**base)
+
+
+class TestPruning:
+    def test_divisibility_rules(self):
+        tuner = AutoTuner(_cfg())
+        for d in tuner.search_all():
+            assert 16 % d["mp_degree"] == 0
+            assert 24 % d["pp_degree"] == 0
+            assert (d["dp_degree"] * d["mp_degree"] * d["pp_degree"]
+                    * d["sharding_degree"]) == 8
+        reasons = [d["pruned_reason"] for d in tuner.pruned_cfgs]
+        assert any("does not divide" in r for r in reasons)
+
+    def test_memory_prunes_oom_configs(self):
+        # 7B-ish model on single device cannot fit 16GB without sharding
+        tuner = AutoTuner(_cfg(num_layers=32, hidden_size=4096,
+                               global_batch_size=8))
+        for d in tuner.search_all():
+            # surviving single-device configs must not exist: a 7B model
+            # with AdamW state needs > 16GB on one chip
+            assert (d["mp_degree"] * d["pp_degree"]
+                    * d["sharding_degree"]) > 1, d
+        assert any("memory model" in d["pruned_reason"]
+                   for d in tuner.pruned_cfgs)
+
+    def test_pipeline_needs_enough_microbatches(self):
+        tuner = AutoTuner(_cfg(global_batch_size=8))
+        for d in tuner.search_all():
+            if d["pp_degree"] > 1:
+                local = 8 // (d["dp_degree"] * max(d["sharding_degree"], 1))
+                assert local // d["micro_batch_size"] >= d["pp_degree"]
+
+
+class TestSearch:
+    def test_ranked_and_protocol(self):
+        tuner = AutoTuner(_cfg())
+        allc = tuner.search_all()
+        assert len(allc) > 0
+        times = [d["estimated_step_time"] for d in allc]
+        assert times == sorted(times)
+        first = tuner.search_once()
+        assert first == allc[0]
+        tuner.add_cfg(first)
+        second = tuner.search_once()
+        assert second != first
+
+    def test_tune_with_measure_fn(self):
+        calls = []
+
+        def measure(cfg):
+            calls.append(cfg)
+            if len(calls) == 1:
+                raise MemoryError("oom")  # first candidate infeasible
+            return 1.0 / len(calls)      # later candidates get faster
+
+        tuner = AutoTuner(_cfg(), measure_fn=measure)
+        best = tuner.tune(max_trials=4)
+        assert best is not None
+        assert "measured_step_time" in best
+        assert len(calls) == 4
+        # the OOM trial is recorded with an error, not silently dropped
+        assert any("error" in h for h in tuner.history_cfgs)
+
+    def test_recompute_widens_feasible_set(self):
+        tight = _cfg(num_layers=32, hidden_size=4096, global_batch_size=8,
+                     candidates={"use_recompute": [False]})
+        loose = _cfg(num_layers=32, hidden_size=4096, global_batch_size=8,
+                     candidates={"use_recompute": [True]})
+        assert len(AutoTuner(loose).search_all()) >= \
+            len(AutoTuner(tight).search_all())

@@ -226,3 +226,108 @@ extern "C" void get_flag(const void** in, void** out,
         ffn = paddle.incubate.nn.FusedFeedForward(8, 16, dropout_rate=0.0,
                                                   normalize_before=False)
         assert ffn.norm1 is not ffn.norm2
+
+
+class TestFusedTransformerFunctionals:
+    """The three previously-stubbed fused functionals vs compositions."""
+
+    def test_fused_feedforward_matches_composition(self):
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(2, 5, 8).astype(np.float32))
+        w1 = jnp.asarray(rs.randn(8, 16).astype(np.float32) * 0.1)
+        w2 = jnp.asarray(rs.randn(16, 8).astype(np.float32) * 0.1)
+        b1 = jnp.asarray(rs.randn(16).astype(np.float32) * 0.1)
+        b2 = jnp.asarray(rs.randn(8).astype(np.float32) * 0.1)
+        g = jnp.ones((8,), jnp.float32)
+        bln = jnp.zeros((8,), jnp.float32)
+
+        for pre in (True, False):
+            out = IF.fused_feedforward(
+                paddle.Tensor(x), paddle.Tensor(w1), paddle.Tensor(w2),
+                paddle.Tensor(b1), paddle.Tensor(b2),
+                ln1_scale=paddle.Tensor(g), ln1_bias=paddle.Tensor(bln),
+                ln2_scale=paddle.Tensor(g), ln2_bias=paddle.Tensor(bln),
+                dropout1_rate=0.0, dropout2_rate=0.0, activation="gelu",
+                pre_layer_norm=pre, training=False)
+
+            def ln(h):
+                mu = jnp.mean(h, -1, keepdims=True)
+                var = jnp.var(h, -1, keepdims=True)
+                return (h - mu) * jax.lax.rsqrt(var + 1e-5)
+
+            h = ln(x) if pre else x
+            h = jax.nn.gelu(h @ w1 + b1) @ w2 + b2
+            ref = x + h
+            if not pre:
+                ref = ln(ref)
+            np.testing.assert_allclose(np.asarray(out._data),
+                                       np.asarray(ref), rtol=1e-5,
+                                       atol=1e-5)
+
+    def test_fused_mha_matches_composition(self):
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rs = np.random.RandomState(1)
+        b, s, e, nh = 2, 4, 8, 2
+        hd = e // nh
+        x = jnp.asarray(rs.randn(b, s, e).astype(np.float32))
+        qkv_w = jnp.asarray(rs.randn(3, nh, hd, e).astype(np.float32) * 0.2)
+        lin_w = jnp.asarray(rs.randn(e, e).astype(np.float32) * 0.2)
+
+        out = IF.fused_multi_head_attention(
+            paddle.Tensor(x), paddle.Tensor(qkv_w), paddle.Tensor(lin_w),
+            pre_layer_norm=True, dropout_rate=0.0, attn_dropout_rate=0.0,
+            training=False)
+
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        h = (x - mu) * jax.lax.rsqrt(var + 1e-5)
+        qkv = jnp.einsum("bse,thde->bsthd", h, qkv_w)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+        probs = jax.nn.softmax(logits, -1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, e)
+        ref = x + ctx @ lin_w
+        np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_masked_mha_decode_matches_full_attention(self):
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rs = np.random.RandomState(2)
+        b, nh, hd, max_len, steps = 2, 2, 4, 8, 3
+        cache = jnp.zeros((2, b, nh, max_len, hd), jnp.float32)
+        qs, ks, vs, outs = [], [], [], []
+        for t in range(steps):
+            qkv = rs.randn(b, 3 * nh * hd).astype(np.float32)
+            qs.append(qkv.reshape(b, 3, nh, hd)[:, 0])
+            ks.append(qkv.reshape(b, 3, nh, hd)[:, 1])
+            vs.append(qkv.reshape(b, 3, nh, hd)[:, 2])
+            lens = jnp.full((b, 1), t, jnp.int32)
+            out, cache_t = IF.masked_multihead_attention(
+                paddle.Tensor(jnp.asarray(qkv)), paddle.Tensor(cache),
+                sequence_lengths=paddle.Tensor(lens))
+            cache = cache_t._data
+            outs.append(np.asarray(out._data))
+
+        # reference: full causal attention over the decoded prefix
+        K = np.stack(ks, axis=2)  # (b, nh, t, hd)
+        V = np.stack(vs, axis=2)
+        for t in range(steps):
+            q = qs[t]  # (b, nh, hd)
+            logits = np.einsum("bhd,bhld->bhl", q, K[:, :, :t + 1]) / \
+                np.sqrt(hd)
+            p = np.exp(logits - logits.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            ref = np.einsum("bhl,bhld->bhd", p, V[:, :, :t + 1])
+            np.testing.assert_allclose(outs[t], ref.reshape(b, nh * hd),
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg=f"step {t}")

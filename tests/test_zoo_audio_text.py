@@ -12,62 +12,63 @@ import paddle_tpu as paddle
 from paddle_tpu.vision import models as M
 
 
-def _fwd(model, size=64, classes=10):
-    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 3, size, size)
+def _fwd(model, size=32, classes=10, batch=1):
+    x = paddle.to_tensor(np.random.RandomState(0).rand(batch, 3, size, size)
                          .astype(np.float32))
     model.eval()
-    return model(x)
+    with paddle.no_grad():  # shape checks don't need the autograd tape
+        return model(x)
 
 
 class TestVisionZoo:
     def test_mobilenet_v1(self):
         out = _fwd(M.mobilenet_v1(scale=0.25, num_classes=10))
-        assert list(out.shape) == [2, 10]
+        assert list(out.shape) == [1, 10]
 
     def test_mobilenet_v2(self):
         out = _fwd(M.mobilenet_v2(scale=0.25, num_classes=10))
-        assert list(out.shape) == [2, 10]
+        assert list(out.shape) == [1, 10]
 
     def test_mobilenet_v3(self):
         out = _fwd(M.mobilenet_v3_small(scale=0.5, num_classes=10))
-        assert list(out.shape) == [2, 10]
+        assert list(out.shape) == [1, 10]
         out = _fwd(M.mobilenet_v3_large(scale=0.35, num_classes=10))
-        assert list(out.shape) == [2, 10]
+        assert list(out.shape) == [1, 10]
 
     def test_vgg11(self):
         out = _fwd(M.vgg11(num_classes=10))
-        assert list(out.shape) == [2, 10]
+        assert list(out.shape) == [1, 10]
 
     def test_densenet121(self):
         out = _fwd(M.densenet121(num_classes=10))
-        assert list(out.shape) == [2, 10]
+        assert list(out.shape) == [1, 10]
 
     def test_alexnet(self):
         out = _fwd(M.alexnet(num_classes=10), size=224)
-        assert list(out.shape) == [2, 10]
+        assert list(out.shape) == [1, 10]
 
     def test_squeezenet(self):
-        out = _fwd(M.squeezenet1_1(num_classes=10), size=64)
-        assert list(out.shape) == [2, 10]
+        out = _fwd(M.squeezenet1_1(num_classes=10), size=32)
+        assert list(out.shape) == [1, 10]
 
     def test_shufflenet(self):
         out = _fwd(M.shufflenet_v2_x0_25(num_classes=10))
-        assert list(out.shape) == [2, 10]
+        assert list(out.shape) == [1, 10]
 
     def test_googlenet(self):
         out, a1, a2 = _fwd(M.googlenet(num_classes=10), size=64)
-        assert list(out.shape) == [2, 10]
-        assert list(a1.shape) == [2, 10]
+        assert list(out.shape) == [1, 10]
+        assert list(a1.shape) == [1, 10]
 
     def test_inception_v3(self):
-        out = _fwd(M.inception_v3(num_classes=10), size=96)
-        assert list(out.shape) == [2, 10]
+        out = _fwd(M.inception_v3(num_classes=10), size=75)
+        assert list(out.shape) == [1, 10]
 
     def test_zoo_trains(self):
         # one SGD step on the smallest net: grads flow through BN/depthwise
         model = M.mobilenet_v1(scale=0.25, num_classes=4)
         opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
-        x = paddle.to_tensor(np.random.rand(2, 3, 32, 32).astype(np.float32))
+        x = paddle.to_tensor(np.random.rand(1, 3, 16, 16).astype(np.float32))
         loss = model(x).square().mean()
         loss.backward()
         opt.step()

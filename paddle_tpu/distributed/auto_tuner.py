@@ -207,8 +207,10 @@ class AutoTuner:
         best = None
         trials = 0
         while True:
+            if max_trials and trials >= max_trials:
+                break  # before search_once: don't pop-and-drop a candidate
             cur = self.search_once()
-            if cur is None or (max_trials and trials >= max_trials):
+            if cur is None:
                 break
             trials += 1
             if self.measure_fn is not None:

@@ -68,14 +68,34 @@ class _Task:
         return True
 
 
+def _eager_world(group):
+    return group.nranks if group is not None else get_world_size()
+
+
+def _require_trivial_world(group, name):
+    """Eager (non-compiled) collectives are only correct when the calling
+    world is size 1 — with a real multi-rank group, silently returning the
+    input would compute WRONG numbers for ported multi-process code.
+    reference behavior: the call would actually communicate; here the
+    communication belongs inside shard_map/jit, so we fail loudly."""
+    n = _eager_world(group)
+    if n > 1:
+        raise RuntimeError(
+            f"{name}: eager collective over a world of size {n} is not "
+            "supported on the single-controller TPU runtime — run the op "
+            "inside a compiled region (shard_map/jit over the group's mesh "
+            "axis), or use parallel.SpmdTrainer which inserts collectives "
+            "via GSPMD")
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     axis = _axis_name(group)
     if axis is not None and _in_shardmap(tensor._data):
         out = execute(lambda a: _psum_like(a, op, axis), tensor, _name="all_reduce")
         tensor._rebind(out)
         return _Task()
-    # eager single-controller: world of this process is 1 → identity
-    return _Task()
+    _require_trivial_world(group, "all_reduce")
+    return _Task()  # world size 1: reduction over one rank is identity
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
@@ -87,11 +107,13 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
         from ..tensor.manipulation import unbind
         tensor_list.extend(unbind(gathered, 0))
         return _Task()
+    _require_trivial_world(group, "all_gather")
     tensor_list.append(tensor)
     return _Task()
 
 
 def all_gather_object(object_list, obj, group=None):
+    _require_trivial_world(group, "all_gather_object")
     object_list.append(obj)
     return _Task()
 
@@ -107,6 +129,7 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
             stacked, _name="all_to_all")
         out_tensor_list.extend(unbind(out, 0))
         return _Task()
+    _require_trivial_world(group, "all_to_all")
     out_tensor_list.extend(in_tensor_list)
     return _Task()
 
@@ -122,6 +145,7 @@ def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
             in_tensor, _name="all_to_all_single")
         out_tensor._rebind(out)
         return _Task()
+    _require_trivial_world(group, "all_to_all_single")
     out_tensor._rebind(in_tensor.clone())
     return _Task()
 
@@ -137,12 +161,15 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
             full, _name="reduce_scatter")
         tensor._rebind(out)
         return _Task()
+    _require_trivial_world(group, "reduce_scatter")
     tensor._rebind(tensor_list[0])
     return _Task()
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    # replicated-by-construction in single-controller mode
+    # replicated-by-construction in single-controller mode; with a real
+    # multi-rank world the value is already global (jax arrays are), so
+    # broadcast is a true no-op either way
     return _Task()
 
 
@@ -151,12 +178,14 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    _require_trivial_world(group, "scatter")
     if tensor_list:
         tensor._rebind(tensor_list[0])
     return _Task()
 
 
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    _require_trivial_world(group, "gather")
     if gather_list is not None:
         gather_list.append(tensor)
     return _Task()

@@ -305,6 +305,11 @@ def _run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
                 c = jnp.zeros(shape, dtype)
             else:
                 has_any = True
+                if c.dtype != dtype:
+                    # mixed-precision graphs (AMP): a downstream op may hand
+                    # back an fp32 cotangent for a bf16 output; jax.vjp
+                    # requires the exact recorded dtype
+                    c = c.astype(dtype)
             cots.append(c)
         if not has_any:
             continue

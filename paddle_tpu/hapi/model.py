@@ -26,10 +26,29 @@ class Model:
         self.stop_training = False
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        """reference: hapi/model.py prepare — amp_configs ('O1'/'O2' or a
+        dict with level/init_loss_scaling/...) turns on bf16 auto_cast +
+        loss scaling for train_batch."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
             ([metrics] if metrics else [])
+        self._amp_level = None
+        self._scaler = None
+        if amp_configs:
+            cfg = ({"level": amp_configs} if isinstance(amp_configs, str)
+                   else dict(amp_configs))
+            level = cfg.get("level", "O1")
+            if level not in ("O0", "O1", "O2"):
+                raise ValueError(f"amp level must be O0/O1/O2, got {level}")
+            if level != "O0":
+                self._amp_level = level
+                from ..amp import GradScaler
+                self._scaler = GradScaler(
+                    enable=cfg.get("use_loss_scaling", True),
+                    init_loss_scaling=cfg.get("init_loss_scaling", 2.0 ** 16),
+                    use_dynamic_loss_scaling=cfg.get(
+                        "use_dynamic_loss_scaling", True))
 
     def _compute_loss(self, outputs, labels):
         if self._loss is None:
@@ -41,12 +60,24 @@ class Model:
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-        outputs = self.network(*inputs)
-        loss = self._compute_loss(outputs, labels)
-        loss.backward()
-        if update:
-            self._optimizer.step()
-            self._optimizer.clear_grad()
+        if self._amp_level is not None:
+            from ..amp import auto_cast
+            with auto_cast(level=self._amp_level):
+                outputs = self.network(*inputs)
+                loss = self._compute_loss(outputs, labels)
+            scaled = self._scaler.scale(loss)
+            scaled.backward()
+            if update:
+                self._scaler.step(self._optimizer)
+                self._scaler.update()
+                self._optimizer.clear_grad()
+        else:
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels)
+            loss.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
         metrics = [float(np.asarray(loss._data))]
         for m in self._metrics:
             m.update(m.compute(outputs, labels))

@@ -220,6 +220,10 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
             "fused_multi_head_attention with cache_kv (incremental decode) "
             "is not supported; use masked_multihead_attention for the "
             "decode step")
+    if transpose_qkv_wb and num_heads <= 0:
+        raise ValueError(
+            "fused_multi_head_attention: num_heads must be given (> 0) "
+            "when transpose_qkv_wb=True (qkv_weight carries no head dim)")
     from ....framework.random import next_key
     dk = next_key() if (training and dropout_rate > 0.0) else None
     dk_attn = next_key() if (training and attn_dropout_rate > 0.0) else None
@@ -363,9 +367,19 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
         raise ValueError("masked_multihead_attention requires cache_kv")
     for unsupported, nm in ((beam_cache_offset, "beam_cache_offset"),
                             (qkv_out_scale, "qkv_out_scale"),
-                            (out_shift, "out_shift")):
+                            (out_shift, "out_shift"),
+                            (out_smooth, "out_smooth"),
+                            (cum_offsets, "cum_offsets"),
+                            (rotary_tensor, "rotary_tensor")):
         if unsupported is not None:
-            raise NotImplementedError(f"{nm} is not supported on TPU")
+            raise NotImplementedError(
+                f"masked_multihead_attention: {nm} is not supported on TPU "
+                "(apply fused_rotary_position_embedding to q/k before the "
+                "call for RoPE)")
+    if rotary_emb_dims:
+        raise NotImplementedError(
+            "masked_multihead_attention: in-kernel RoPE is not supported; "
+            "apply fused_rotary_position_embedding to q/k first")
 
     args = [x, cache_kv]
     opt = {"bias": bias, "src_mask": src_mask,

@@ -142,10 +142,20 @@ class Predictor:
         self._config = config
         prefix = config._prefix
         params_path = config._params_path or prefix + ".pdiparams"
-        with open(params_path, "rb") as f:
-            self._params = pickle.load(f)
         with open(prefix + ".pdmodel", "rb") as f:
             meta = pickle.load(f)
+        from ..jit import FORMAT_VERSION, _load_npz_params
+        version = meta.get("format_version", 1)
+        if version > FORMAT_VERSION:
+            raise ValueError(
+                f"{prefix}.pdmodel has format version {version}; this build "
+                f"reads <= {FORMAT_VERSION}. Use a newer paddle_tpu or "
+                "re-export the model.")
+        if version >= 2:  # npz params (jit.save v2)
+            self._params = _load_npz_params(params_path, meta)
+        else:  # v1: pickled dict
+            with open(params_path, "rb") as f:
+                self._params = pickle.load(f)
         if not meta.get("stablehlo"):
             raise ValueError(
                 f"{prefix}.pdmodel holds no serialized program; re-export "

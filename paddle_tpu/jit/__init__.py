@@ -270,10 +270,21 @@ def save(layer, path, input_spec=None, **configs):
     if hasattr(layer, "state_dict"):
         for k, v in layer.state_dict().items():
             state[k] = np.asarray(v._data)
-    with open(path + ".pdiparams", "wb") as f:
-        pickle.dump(state, f)
+    # params as npz (no pickle on the load path), atomic rename. Non-builtin
+    # dtypes (bfloat16/fp8 from ml_dtypes have numpy kind 'V') would be
+    # silently written as raw void by savez — encode them as uint8 bytes and
+    # record the real dtype in the metadata.
+    npz_state, param_dtypes = {}, {}
+    for k, v in state.items():
+        npz_state[k], param_dtypes[k] = _encode_param(v)
+    tmp = path + ".pdiparams.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **npz_state)
+    os.replace(tmp, path + ".pdiparams")
 
-    meta = {"class": type(layer).__name__,
+    meta = {"format_version": FORMAT_VERSION,
+            "class": type(layer).__name__,
+            "param_dtypes": param_dtypes,
             "input_spec": [(tuple(s.shape), str(s.dtype))
                            for s in (input_spec or [])],
             "stablehlo": None}
@@ -297,18 +308,63 @@ def save(layer, path, input_spec=None, **configs):
         finally:
             if was_training and hasattr(layer, "train"):
                 layer.train()
-    with open(path + ".pdmodel", "wb") as f:
+    tmp = path + ".pdmodel.tmp"
+    with open(tmp, "wb") as f:
         pickle.dump(meta, f)
+    os.replace(tmp, path + ".pdmodel")
+
+
+FORMAT_VERSION = 2  # v1: pickled params dict; v2: npz params + version field
+
+
+def _encode_param(v):
+    """(npz-safe array, dtype descriptor). Builtin dtypes pass through;
+    kind-'V' ml_dtypes (bfloat16, float8_*) become uint8 bytes."""
+    import numpy as np
+    if v.dtype.kind == "V":
+        raw = np.frombuffer(v.tobytes(), np.uint8).reshape(
+            v.shape + (v.dtype.itemsize,))
+        return raw, {"dtype": str(v.dtype), "encoded": True}
+    return v, {"dtype": str(v.dtype), "encoded": False}
+
+
+def _decode_param(arr, desc):
+    import numpy as np
+    if not desc or not desc.get("encoded"):
+        return arr
+    import ml_dtypes  # registers bfloat16/fp8 with numpy
+    dt = np.dtype(desc["dtype"])
+    return np.frombuffer(arr.tobytes(), dt).reshape(arr.shape[:-1])
+
+
+def _load_npz_params(path, meta):
+    import numpy as np
+    dtypes = meta.get("param_dtypes", {})
+    with np.load(path, allow_pickle=False) as z:
+        return {k: _decode_param(z[k], dtypes.get(k)) for k in z.files}
 
 
 def load(path, **configs):
     """Load a jit.save artifact as a callable TranslatedLayer (runs the
-    serialized StableHLO program when present)."""
+    serialized StableHLO program when present). Rejects artifacts from a
+    newer format with a clear message (reference keeps version patches in
+    pir/serialize_deserialize/patch_util.h; our format is versioned the
+    same way)."""
     import pickle
-    with open(path + ".pdiparams", "rb") as f:
-        state = pickle.load(f)
+    import numpy as np
     with open(path + ".pdmodel", "rb") as f:
         meta = pickle.load(f)
+    version = meta.get("format_version", 1)
+    if version > FORMAT_VERSION:
+        raise ValueError(
+            f"jit artifact {path!r} has format version {version}; this "
+            f"build reads <= {FORMAT_VERSION}. Load it with a newer "
+            "paddle_tpu or re-save with this one.")
+    if version >= 2:
+        state = _load_npz_params(path + ".pdiparams", meta)
+    else:  # v1 pickled dict
+        with open(path + ".pdiparams", "rb") as f:
+            state = pickle.load(f)
     if meta.get("stablehlo"):
         exported = jax.export.deserialize(meta["stablehlo"])
 

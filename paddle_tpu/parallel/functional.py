@@ -25,10 +25,16 @@ def functional_call(model, params: dict, *args, rng_key=None, training=True,
     arrays. Safe to call under jit tracing."""
     state = model.state_dict()
     saved = []
-    # honor training=False: dropout/BN branch on layer.training at trace time
+    # honor training=False: dropout/BN branch on layer.training at trace
+    # time. Save EVERY sublayer's flag so restore can't clobber submodules
+    # the user deliberately kept in eval (e.g. frozen BatchNorm).
     mode_saved = None
     if not training and getattr(model, "training", False):
-        mode_saved = True
+        if hasattr(model, "named_sublayers"):
+            mode_saved = [(m, m.training)
+                          for _, m in model.named_sublayers(include_self=True)]
+        else:
+            mode_saved = [(model, model.training)]
         model.eval()
 
     def wrap(a):
@@ -57,7 +63,8 @@ def functional_call(model, params: dict, *args, rng_key=None, training=True,
             t._data = data
             t._node = node
         if mode_saved:
-            model.train()
+            for m, was in mode_saved:
+                m.training = was
 
 
 def make_loss_fn(model, loss_fn: Callable | None = None, training=True):

@@ -266,3 +266,70 @@ class TestReviewRegressions:
         h = pred.get_input_handle(pred.get_input_names()[0])
         h.copy_from_cpu(np.ones((1, 4), np.float32))
         pred.run()
+
+
+class TestEagerCollectiveGuards:
+    """Eager collectives over a real multi-rank world must fail loudly
+    instead of silently returning identity (wrong numbers for ported
+    multi-process code)."""
+
+    def test_multi_rank_group_raises(self):
+        import paddle_tpu.distributed as dist
+
+        class FakeGroup:
+            nranks = 4
+            axis_name = None
+
+        x = paddle.Tensor(np.ones((2, 2), np.float32))
+        with pytest.raises(RuntimeError, match="compiled region"):
+            dist.all_reduce(x, group=FakeGroup())
+        with pytest.raises(RuntimeError, match="compiled region"):
+            dist.all_gather([], x, group=FakeGroup())
+        with pytest.raises(RuntimeError, match="compiled region"):
+            dist.reduce_scatter(x, [x], group=FakeGroup())
+
+    def test_world_size_one_is_identity(self):
+        import paddle_tpu.distributed as dist
+        x = paddle.Tensor(np.ones((2, 2), np.float32))
+        dist.all_reduce(x)  # single-controller world: valid no-op
+        out = []
+        dist.all_gather(out, x)
+        assert len(out) == 1
+
+
+class TestJitFormatVersion:
+    def test_newer_format_rejected(self, tmp_path):
+        import pickle
+        from paddle_tpu import nn
+        model = nn.Linear(4, 2)
+        prefix = str(tmp_path / "m")
+        paddle.jit.save(model, prefix)
+        meta = pickle.load(open(prefix + ".pdmodel", "rb"))
+        assert meta["format_version"] == paddle.jit.FORMAT_VERSION
+        meta["format_version"] = 99
+        pickle.dump(meta, open(prefix + ".pdmodel", "wb"))
+        with pytest.raises(ValueError, match="format version 99"):
+            paddle.jit.load(prefix)
+
+    def test_params_are_npz_not_pickle(self, tmp_path):
+        from paddle_tpu import nn
+        model = nn.Linear(4, 2)
+        prefix = str(tmp_path / "m")
+        paddle.jit.save(model, prefix)
+        with np.load(prefix + ".pdiparams", allow_pickle=False) as z:
+            assert "weight" in z.files
+
+    def test_bf16_params_roundtrip(self, tmp_path):
+        """ml_dtypes (numpy kind 'V') must survive the npz codec."""
+        import jax.numpy as jnp
+        from paddle_tpu import nn
+        model = nn.Linear(4, 2)
+        model.weight._data = model.weight._data.astype(jnp.bfloat16)
+        prefix = str(tmp_path / "m")
+        paddle.jit.save(model, prefix)
+        tl = paddle.jit.load(prefix)
+        w = tl.state_dict()["weight"]
+        assert str(w.dtype) == "bfloat16", w.dtype
+        np.testing.assert_array_equal(
+            np.asarray(w, np.float32),
+            np.asarray(model.weight._data, np.float32))

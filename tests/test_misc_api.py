@@ -109,3 +109,27 @@ class TestMisc:
 
     def test_callbacks_alias(self):
         assert hasattr(paddle.callbacks, "EarlyStopping")
+
+
+class TestHapiAmp:
+    def test_prepare_amp_configs_trains(self):
+        from paddle_tpu import nn, optimizer
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        model = paddle.Model(net)
+        model.prepare(optimizer.Adam(1e-2, parameters=net.parameters()),
+                      nn.CrossEntropyLoss(), amp_configs="O1")
+        assert model._amp_level == "O1" and model._scaler is not None
+        rs = np.random.RandomState(0)
+        x = paddle.Tensor(rs.randn(8, 8).astype(np.float32))
+        y = paddle.Tensor(rs.randint(0, 4, (8,)).astype(np.int64))
+        losses = [model.train_batch([x], y)[0] for _ in range(5)]
+        assert losses[-1] < losses[0]
+
+    def test_bad_level_rejected(self):
+        from paddle_tpu import nn, optimizer
+        net = nn.Linear(4, 2)
+        model = paddle.Model(net)
+        with pytest.raises(ValueError, match="O0/O1/O2"):
+            model.prepare(optimizer.Adam(1e-2, parameters=net.parameters()),
+                          nn.CrossEntropyLoss(), amp_configs="O9")

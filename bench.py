@@ -141,6 +141,21 @@ def _run_one(cfg, batch, seq, steps, remat, on_tpu):
             "step_time_s": dt / steps, "xla_flops_per_step": xla_flops}
 
 
+def probe():
+    """Minimal TPU liveness check: backend init + one tiny matmul."""
+    import jax
+    import jax.numpy as jnp
+    if jax.devices()[0].platform == "cpu":
+        print(json.dumps({"metric": "probe", "value": 0.0, "unit": "cpu",
+                          "vs_baseline": 0.0}))
+        return 0
+    x = jnp.ones((256, 256))
+    (x @ x).block_until_ready()
+    print(json.dumps({"metric": "probe", "value": 1.0, "unit": "tpu_alive",
+                      "vs_baseline": 0.0}))
+    return 0
+
+
 def worker(force_cpu: bool, only_config: int | None = None):
     import jax
     if force_cpu:
@@ -266,19 +281,35 @@ def _attempt(args, timeout_s):
 
 def main():
     if "--worker" in sys.argv:
+        if "--probe" in sys.argv:
+            return probe()
         cfg = None
         if "--config" in sys.argv:
             cfg = int(sys.argv[sys.argv.index("--config") + 1])
         return worker(force_cpu="--cpu" in sys.argv, only_config=cfg)
+
+    errors = []
+    # fast liveness probe first: when the TPU tunnel is down, every config
+    # would burn its full timeout — detect that in minutes instead
+    tpu_alive = False
+    for i in range(2):
+        result, err = _attempt(["--probe"], 300)
+        if result is not None:
+            tpu_alive = result.get("unit") == "tpu_alive"
+            break
+        errors.append(f"probe{i}: {err}")
+        time.sleep(60)
 
     # one subprocess PER ladder config so a slow/hung compile on a big
     # config can't eat the whole budget before smaller configs get a turn
     # (round-2/3 failure mode). The persistent compile cache makes a second
     # pass over an already-attempted config cheap.
     n_configs = 4  # len(_llama_ladder()) — parent must not import jax
-    plan = [(["--config", str(i)], 900) for i in range(n_configs)]
-    plan += [(["--config", "3"], 600), (["--cpu"], 300)]
-    errors = []
+    if tpu_alive:
+        plan = [(["--config", str(i)], 900) for i in range(n_configs)]
+        plan += [(["--config", "3"], 600), (["--cpu"], 300)]
+    else:
+        plan = [(["--cpu"], 300)]
     for i, (args, timeout_s) in enumerate(plan):
         result, err = _attempt(args, timeout_s)
         if result is not None:

@@ -145,3 +145,73 @@ def finfo(dtype):
     import jax.numpy as jnp
     from .framework import dtypes as _dt
     return jnp.finfo(_dt.convert_dtype(dtype))
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """reference: python/paddle/tensor/creation.py create_parameter."""
+    import numpy as _np
+    from .framework.core import Parameter
+    from .framework import dtypes as _dt
+    import jax.numpy as _jnp
+    if default_initializer is not None:
+        t = Parameter(_jnp.zeros(tuple(shape), _dt.convert_dtype(dtype)))
+        default_initializer(t)
+        return t
+    if is_bias:
+        data = _jnp.zeros(tuple(shape), _dt.convert_dtype(dtype))
+        return Parameter(data)
+    # reference default: Xavier uniform — reuse the real initializer
+    from .nn.initializer import XavierUniform
+    t = Parameter(_jnp.zeros(tuple(shape), _dt.convert_dtype(dtype)))
+    XavierUniform()(t)
+    return t
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference: base/framework.py set_printoptions (numpy-backed here)."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+# accelerator RNG state: one generator on TPU (ref get/set_cuda_rng_state)
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+
+
+# paddle.dtype / paddle.shape parity (reference: base/framework.py)
+from .framework import dtypes as _dtypes_mod
+dtype = _dtypes_mod.DType if hasattr(_dtypes_mod, "DType") else type(
+    _dtypes_mod.convert_dtype("float32"))
+from .tensor.attribute import shape  # noqa: F401,E402
+
+try:  # fp8 dtypes via ml_dtypes (TPU-native fp8 support)
+    import ml_dtypes as _mld
+    float8_e4m3fn = _mld.float8_e4m3fn
+    float8_e5m2 = _mld.float8_e5m2
+except ImportError:  # pragma: no cover
+    float8_e4m3fn = None
+    float8_e5m2 = None
+
+
+def check_shape(shape_v):
+    """reference: base/framework.py check_shape — validate a shape spec."""
+    if isinstance(shape_v, Tensor):
+        return
+    for s in shape_v:
+        if isinstance(s, Tensor):
+            continue
+        if not isinstance(s, int) or (s < 0 and s != -1):
+            raise ValueError(f"invalid dim {s!r} in shape {shape_v}")

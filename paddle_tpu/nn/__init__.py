@@ -11,6 +11,8 @@ from .layer.pooling import *  # noqa: F401,F403
 from .layer.loss import *  # noqa: F401,F403
 from .layer.rnn import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
+from .layer.extras import *  # noqa: F401,F403
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
     clip_grad_norm_, clip_grad_value_,

@@ -6,6 +6,7 @@ from .conv import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from .attention import (  # noqa: F401
     scaled_dot_product_attention,
     flash_attention as _flash_attention_full,

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 from ...framework.core import execute
 
 __all__ = [
-    "relu", "relu_", "relu6", "elu", "elu_", "selu", "celu", "gelu", "silu",
+    "relu", "relu_", "hardtanh_", "leaky_relu_", "thresholded_relu_", "relu6", "elu", "elu_", "selu", "celu", "gelu", "silu",
     "swish", "mish", "softplus", "softshrink", "hardshrink", "tanhshrink",
     "sigmoid", "hardsigmoid", "hardswish", "hardtanh", "leaky_relu",
     "log_sigmoid", "log_softmax", "softmax", "softmax_", "softsign",
@@ -175,3 +175,15 @@ def glu(x, axis=-1, name=None):
 
 
 from ...tensor.random import gumbel_softmax  # noqa: F401,E402
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    return x._rebind(hardtanh(x, min, max))
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    return x._rebind(leaky_relu(x, negative_slope))
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    return x._rebind(thresholded_relu(x, threshold, value))

@@ -1,0 +1,626 @@
+"""Long-tail nn.functional ops closing the reference surface.
+
+reference: python/paddle/nn/functional/ — distance.py (pairwise_distance),
+vision.py (grid_sample, affine_grid, pixel ops, temporal_shift),
+pooling.py (max_unpool*, fractional pools), loss.py (multi_margin_loss,
+hsigmoid_loss), flash_attention.py (qkv-packed wrappers).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, execute
+from ...framework.random import next_key
+
+__all__ = [
+    "pairwise_distance", "grid_sample", "affine_grid", "max_unpool1d",
+    "max_unpool2d", "max_unpool3d", "temporal_shift",
+    "feature_alpha_dropout", "multi_margin_loss", "hsigmoid_loss",
+    "fractional_max_pool2d", "fractional_max_pool3d", "gather_tree",
+    "flash_attn_qkvpacked", "flash_attn_varlen_qkvpacked",
+    "flashmask_attention", "margin_cross_entropy", "class_center_sample",
+    "sparse_attention", "rnnt_loss", "adaptive_log_softmax_with_loss",
+]
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """reference: nn/functional/distance.py pairwise_distance."""
+    def f(a, b):
+        d = a - b
+        if p == float("inf"):
+            out = jnp.max(jnp.abs(d), axis=-1, keepdims=keepdim)
+        elif p == float("-inf"):
+            out = jnp.min(jnp.abs(d), axis=-1, keepdims=keepdim)
+        else:
+            out = jnp.sum((jnp.abs(d) + epsilon) ** p, axis=-1,
+                          keepdims=keepdim) ** (1.0 / p)
+        return out
+    return execute(f, x, y, _name="pairwise_distance")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2D affine sampling grid. reference: nn/functional/vision.py
+    affine_grid. theta: (N, 2, 3); out_shape (N, C, H, W) -> (N, H, W, 2)."""
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def base(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        return (jnp.arange(size) * 2 + 1) / size - 1.0
+
+    def f(th):
+        ys = base(h)
+        xs = base(w)
+        gx, gy = jnp.meshgrid(xs, ys)               # (h, w)
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx, gy, ones], -1)      # (h, w, 3)
+        out = jnp.einsum("hwk,njk->nhwj", coords, th)
+        return out.astype(th.dtype)
+    return execute(f, theta, _name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x (N,C,H,W) at normalized grid (N,Hg,Wg,2) coordinates.
+    reference: nn/functional/vision.py grid_sample (bilinear/nearest,
+    zeros/border/reflection padding)."""
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx = g[..., 0]
+        gy = g[..., 1]
+
+        def unnorm(v, size):
+            if align_corners:
+                return (v + 1.0) * (size - 1) / 2.0
+            return ((v + 1.0) * size - 1.0) / 2.0
+
+        fx = unnorm(gx, w)
+        fy = unnorm(gy, h)
+
+        def reflect(v, lo, hi):
+            rng = hi - lo
+            v = jnp.abs(jnp.mod(v - lo, 2 * rng) - rng) + lo \
+                if rng > 0 else jnp.zeros_like(v)
+            return v
+
+        if padding_mode == "border":
+            fx = jnp.clip(fx, 0, w - 1)
+            fy = jnp.clip(fy, 0, h - 1)
+        elif padding_mode == "reflection":
+            if align_corners:
+                fx = reflect(fx, 0.0, w - 1.0)
+                fy = reflect(fy, 0.0, h - 1.0)
+            else:
+                fx = jnp.clip(reflect(fx, -0.5, w - 0.5), 0, w - 1)
+                fy = jnp.clip(reflect(fy, -0.5, h - 0.5), 0, h - 1)
+
+        def gather(ix, iy):
+            valid = ((ix >= 0) & (ix <= w - 1)
+                     & (iy >= 0) & (iy <= h - 1))
+            ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+            iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+            # (n, c, hg, wg): batch-index the spatial grid per sample
+            bidx = jnp.arange(n)[:, None, None]
+            vals = a[bidx, :, iyc, ixc]             # (n, hg, wg, c)
+            vals = jnp.moveaxis(vals, -1, 1)
+            if padding_mode == "zeros":
+                vals = vals * valid[:, None, :, :].astype(a.dtype)
+            return vals
+
+        if mode == "nearest":
+            return gather(jnp.round(fx), jnp.round(fy))
+        x0 = jnp.floor(fx)
+        y0 = jnp.floor(fy)
+        x1 = x0 + 1
+        y1 = y0 + 1
+        wa = ((x1 - fx) * (y1 - fy))[:, None]
+        wb = ((fx - x0) * (y1 - fy))[:, None]
+        wc = ((x1 - fx) * (fy - y0))[:, None]
+        wd = ((fx - x0) * (fy - y0))[:, None]
+        out = (gather(x0, y0) * wa + gather(x1, y0) * wb
+               + gather(x0, y1) * wc + gather(x1, y1) * wd)
+        return out.astype(a.dtype)
+    return execute(f, x, grid, _name="grid_sample")
+
+
+def _max_unpool(x, indices, ndim, kernel_size, stride=None, padding=0,
+                output_size=None, data_format=None, name=None):
+    from .pooling import _tuple
+    ks = _tuple(kernel_size, ndim)
+    sd = _tuple(stride if stride is not None else kernel_size, ndim)
+
+    def f(a, idx):
+        spatial_in = a.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(int(s) for s in output_size[-ndim:])
+        else:
+            out_sp = tuple((si - 1) * st + k
+                           for si, st, k in zip(spatial_in, sd, ks))
+        n, c = a.shape[:2]
+        flat_sp = int(np.prod(out_sp))
+        out = jnp.zeros((n, c, flat_sp), a.dtype)
+        out = out.at[jnp.arange(n)[:, None, None],
+                     jnp.arange(c)[None, :, None],
+                     idx.reshape(n, c, -1)].set(a.reshape(n, c, -1))
+        return out.reshape((n, c) + out_sp)
+    return execute(f, x, indices, _name=f"max_unpool{ndim}d")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """reference: nn/functional/pooling.py max_unpool1d — scatter pooled
+    values back to their argmax positions (indices flat over L)."""
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """reference: nn/functional/pooling.py max_unpool2d (indices flat over
+    H*W, as produced by max_pool2d(return_mask=True))."""
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    """Fractional max pooling (Graham 2014): pseudo-random pooling regions
+    whose sizes average H/out. reference: nn/functional/pooling.py.
+    Deterministic given random_u; drawn from the global RNG otherwise."""
+    def region_starts(in_size, out_size, u):
+        alpha = in_size / out_size
+        idx = jnp.floor(alpha * (jnp.arange(out_size) + u)).astype(jnp.int32)
+        idx = jnp.clip(idx, 0, in_size - 1)
+        return jnp.concatenate([jnp.zeros((1,), jnp.int32), idx[1:]]), \
+            jnp.concatenate([idx[1:], jnp.asarray([in_size], jnp.int32)])
+
+    if random_u is None:
+        u = float(jax.random.uniform(next_key(), ()))
+    else:
+        u = float(random_u)
+    oh, ow = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+
+    def f(a):
+        n, c, h, w = a.shape
+        hs, he = region_starts(h, oh, u)
+        ws, we = region_starts(w, ow, u)
+        max_kh = int(np.ceil(h / oh)) + 1
+        max_kw = int(np.ceil(w / ow)) + 1
+
+        kh = min(max_kh, h)
+        kw = min(max_kw, w)
+
+        def pool_cell(i, j):
+            # dynamic_slice clamps starts near the edge; clamp explicitly so
+            # the row/col labels match what was actually sliced
+            ys = jnp.minimum(hs[i], h - kh)
+            xs = jnp.minimum(ws[j], w - kw)
+            patch = jax.lax.dynamic_slice(a, (0, 0, ys, xs), (n, c, kh, kw))
+            yy = jnp.arange(kh) + ys
+            xx = jnp.arange(kw) + xs
+            m = ((yy[:, None] >= hs[i]) & (yy[:, None] < he[i])
+                 & (xx[None, :] >= ws[j]) & (xx[None, :] < we[j]))
+            patch = jnp.where(m[None, None], patch, -jnp.inf)
+            return jnp.max(patch, axis=(2, 3))
+
+        cols = [jnp.stack([pool_cell(i, j) for j in range(ow)], -1)
+                for i in range(oh)]
+        return jnp.stack(cols, -2)
+    out = execute(f, x, _name="fractional_max_pool2d")
+    return (out, None) if return_mask else out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """3D via a depth loop over the 2D kernel (depth regions use the same
+    pseudo-random sequence)."""
+    od, oh, ow = (output_size if isinstance(output_size, (tuple, list))
+                  else (output_size,) * 3)
+    u = float(random_u) if random_u is not None else float(
+        jax.random.uniform(next_key(), ()))
+
+    def f(a):
+        n, c, d, h, w = a.shape
+        alpha = d / od
+        starts = np.floor(alpha * (np.arange(od) + u)).astype(np.int32)
+        starts = np.clip(starts, 0, d - 1)
+        starts[0] = 0
+        ends = np.append(starts[1:], d)
+        slabs = []
+        for i in range(od):
+            slab = jnp.max(a[:, :, starts[i]:ends[i]], axis=2)
+            sub = fractional_max_pool2d(Tensor(slab), (oh, ow), random_u=u)
+            slabs.append(sub._data if isinstance(sub, Tensor) else sub)
+        return jnp.stack(slabs, axis=2)
+    out = execute(f, x, _name="fractional_max_pool3d")
+    return (out, None) if return_mask else out
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM channel shift across the time axis.
+    reference: nn/functional/vision.py temporal_shift."""
+    def f(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate(
+            [v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        right = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, fold:2 * fold]),
+             v[:, :-1, fold:2 * fold]], axis=1)
+        keep = v[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, keep], axis=2).reshape(
+            nt, c, h, w)
+    return execute(f, x, _name="temporal_shift")
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout over whole channels (SELU-preserving).
+    reference: nn/functional/common.py feature_alpha_dropout."""
+    if not training or p == 0.0:
+        return execute(lambda a: a, x, _name="feature_alpha_dropout")
+    alpha = -1.7580993408473766
+    key = next_key()
+
+    def f(a):
+        shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        q = 1.0 - p
+        scale_a = (q + alpha ** 2 * q * (1 - q)) ** -0.5
+        scale_b = -scale_a * alpha * (1 - q)
+        return (jnp.where(keep, a, alpha) * scale_a + scale_b).astype(a.dtype)
+    return execute(f, x, _name="feature_alpha_dropout")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """reference: nn/functional/loss.py multi_margin_loss."""
+    args = [input, label] + ([weight] if weight is not None else [])
+
+    def f(logits, lab, *rest):
+        n, c = logits.shape
+        correct = jnp.take_along_axis(logits, lab[:, None], 1)
+        m = jnp.maximum(margin - correct + logits, 0.0) ** p
+        if rest:
+            m = m * rest[0][lab][:, None]
+        mask = jnp.arange(c)[None, :] != lab[:, None]
+        loss = jnp.sum(m * mask, axis=1) / c
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return execute(f, *args, _name="multi_margin_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over the default complete binary tree.
+    reference: nn/functional/loss.py hsigmoid_loss (custom trees via
+    path_table/path_code)."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "hsigmoid_loss: custom trees (path_table/path_code) are not "
+            "supported; use the default complete binary tree")
+    depth = int(np.ceil(np.log2(max(num_classes, 2))))
+    # complete-binary-tree paths (leaf cls+num_classes up to the root node 1);
+    # paths are ragged for non-power-of-2 num_classes, so levels carry a
+    # validity mask instead of underflowing into the root weight
+    codes = np.zeros((num_classes, depth), np.float32)
+    nodes = np.zeros((num_classes, depth), np.int32)
+    valid = np.zeros((num_classes, depth), np.float32)
+    for cls in range(num_classes):
+        node = cls + num_classes  # leaves occupy [num_classes, 2*num_classes)
+        lvl = depth - 1
+        while node > 1 and lvl >= 0:
+            codes[cls, lvl] = node % 2
+            node //= 2
+            nodes[cls, lvl] = node - 1  # internal 1..num_classes-1 -> 0-based
+            valid[cls, lvl] = 1.0
+            lvl -= 1
+    codes_j = jnp.asarray(codes)
+    nodes_j = jnp.asarray(nodes)
+    valid_j = jnp.asarray(valid)
+    args = [input, label, weight] + ([bias] if bias is not None else [])
+
+    def f(a, lab, w, *rest):
+        path_nodes = nodes_j[lab]                    # (n, depth)
+        path_codes = codes_j[lab]
+        wv = w[path_nodes]                           # (n, depth, dim)
+        logits = jnp.einsum("nd,nkd->nk", a, wv)
+        if rest:
+            logits = logits + rest[0][path_nodes]
+        # sigmoid cross-entropy against the path code at every VALID level
+        lvl_loss = (jnp.maximum(logits, 0) - logits * path_codes
+                    + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+        loss = jnp.sum(lvl_loss * valid_j[lab], axis=1)
+        return jnp.mean(loss)
+    return execute(f, *args, _name="hsigmoid_loss")
+
+
+def gather_tree(ids, parents):
+    """Walk beam-search parent pointers backward to recover full sequences.
+    reference: nn/functional/gather_tree (fluid beam search)."""
+    def f(i, p):
+        t, b, k = i.shape  # (max_time, batch, beam)
+
+        def step(carry, xs):
+            beam_idx = carry
+            ids_t, par_t = xs
+            picked = jnp.take_along_axis(ids_t, beam_idx, axis=1)
+            parent = jnp.take_along_axis(par_t, beam_idx, axis=1)
+            return parent, picked
+
+        init = jnp.broadcast_to(jnp.arange(k)[None, :], (b, k))
+        _, out = jax.lax.scan(step, init, (i[::-1], p[::-1]))
+        return out[::-1]
+    return execute(f, ids, parents, _name="gather_tree")
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, *, fixed_seed_offset=None,
+                         rng_name="", training=True, name=None):
+    """qkv: (batch, seq, 3, num_heads, head_dim).
+    reference: nn/functional/flash_attention.py flash_attn_qkvpacked."""
+    from .attention import flash_attention
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax, training=training)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, **kw):
+    """qkv: (total_tokens, 3, num_heads, head_dim) packed varlen."""
+    from .attention import flash_attn_unpadded
+    q = qkv[:, 0]
+    k = qkv[:, 1]
+    v = qkv[:, 2]
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale,
+                               dropout=dropout, causal=causal,
+                               return_softmax=return_softmax)
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, window_size=None,
+                        return_softmax_lse=False, return_seed_offset=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """FlashMask: column-sparse attention masks encoded as start/end row
+    indices. reference: nn/functional/flash_attention.py
+    flashmask_attention (the FlashMask paper's kernel).
+
+    TPU design: the startend encoding expands to a dense additive mask and
+    runs through scaled_dot_product_attention — XLA fuses the mask add; a
+    Pallas block-skipping kernel is the later optimization. Supported
+    encodings: (b, h|1, sk, 1) = causal LT mask [start], and
+    (b, h|1, sk, 2) = LT [start, end)."""
+    from .attention import scaled_dot_product_attention
+    if startend_row_indices is None:
+        return scaled_dot_product_attention(
+            query, key, value, dropout_p=dropout, is_causal=causal,
+            training=training), None
+
+    sq = int(query.shape[1])
+    sk = int(key.shape[1])
+
+    def build_mask(se):
+        rows = jnp.arange(sq)[:, None]              # query index
+        cols = jnp.arange(sk)[None, :]              # key index
+        start = se[..., 0]                          # (b, h, sk)
+        # masked when row >= start[col] (values AFTER start are blocked)
+        blocked = rows[None, None] >= start[:, :, None, :]
+        if se.shape[-1] == 2:
+            end = se[..., 1]
+            blocked = blocked & (rows[None, None] < end[:, :, None, :])
+        if causal:
+            blocked = blocked | (rows < cols)[None, None]
+        return jnp.where(blocked, jnp.float32(-1e30), jnp.float32(0.0))
+
+    se = startend_row_indices
+    se_arr = se._data if isinstance(se, Tensor) else jnp.asarray(se)
+    mask = Tensor(build_mask(se_arr))
+    out = scaled_dot_product_attention(query, key, value, attn_mask=mask,
+                                       dropout_p=dropout, is_causal=False,
+                                       training=training)
+    return out, None
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace/CosFace-family margin softmax.
+    reference: nn/functional/common.py margin_cross_entropy — the target
+    logit cos(theta) becomes cos(m1*theta + m2) - m3, all logits scale by s.
+    Single-controller: class-parallel (group) sharding is GSPMD's job when
+    the weight is sharded; the math here is the local formula."""
+    def f(lg, lab):
+        n, c = lg.shape
+        target = jnp.take_along_axis(lg, lab[:, None], 1)[:, 0]
+        target = jnp.clip(target, -1.0 + 1e-6, 1.0 - 1e-6)
+        theta = jnp.arccos(target)
+        m_target = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(lab, c, dtype=lg.dtype)
+        adjusted = lg + onehot * (m_target[:, None] - target[:, None])
+        adjusted = adjusted * scale
+        lp = jax.nn.log_softmax(adjusted, -1)
+        loss = -jnp.take_along_axis(lp, lab[:, None], 1)[:, 0]
+        if reduction == "mean":
+            loss = jnp.mean(loss)
+        elif reduction == "sum":
+            loss = jnp.sum(loss)
+        if return_softmax:
+            return loss, jnp.exp(lp)
+        return loss
+    return execute(f, logits, label, _name="margin_cross_entropy")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample class centers: all positive classes + random negatives.
+    reference: nn/functional/common.py class_center_sample (PartialFC).
+    Returns (remapped_label, sampled_class_indices). Eager (data-dependent
+    output size belongs on host, like the reference's CPU sampling step)."""
+    lab = np.asarray(label._data if isinstance(label, Tensor) else label)
+    pos = np.unique(lab)
+    n_extra = max(int(num_samples) - pos.size, 0)
+    rest = np.setdiff1d(np.arange(num_classes), pos)
+    rng = np.random.default_rng(int(abs(int(lab.sum())) % (2**31)))
+    neg = rng.choice(rest, size=min(n_extra, rest.size), replace=False) \
+        if rest.size else np.empty((0,), lab.dtype)
+    sampled = np.concatenate([pos, np.sort(neg)]).astype(lab.dtype)
+    remap = {c: i for i, c in enumerate(sampled.tolist())}
+    remapped = np.asarray([remap[c] for c in lab.tolist()], lab.dtype)
+    return Tensor(jnp.asarray(remapped)), Tensor(jnp.asarray(sampled))
+
+
+def sparse_attention(x, offset, columns, query, key, value, sparse_mask=None,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block/CSR-sparse attention: row i may attend only to
+    columns[offset[i]:offset[i+1]].
+    reference: nn/functional/sparse_attention.py (GPU CSR kernel).
+
+    TPU design: the CSR pattern expands to a dense boolean mask (static
+    shapes; XLA fuses the mask) — the Pallas block-skipping kernel is the
+    later optimization. Signature kept positional-compatible; `x` may be
+    None (the reference passes q/k/v explicitly)."""
+    def f(q, k, v, off, cols):
+        b, h, sq, d = q.shape
+        sk = k.shape[2]
+
+        def one_mask(off1, cols1):
+            row_ids = jnp.searchsorted(off1, jnp.arange(cols1.shape[-1]),
+                                       side="right") - 1
+            m = jnp.zeros((sq, sk), jnp.bool_)
+            return m.at[row_ids, cols1].set(True)
+
+        if off.ndim == 1:  # shared pattern
+            mask = one_mask(off, cols)[None, None]
+        else:  # reference layout: (B, H, sq+1) / (B, H, nnz)
+            mask = jax.vmap(jax.vmap(one_mask))(
+                off.reshape(b, -1, off.shape[-1]),
+                cols.reshape(b, -1, cols.shape[-1]))
+            if mask.shape[1] == 1 and h > 1:
+                mask = jnp.broadcast_to(mask, (b, h, sq, sk))
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                            preferred_element_type=jnp.float32)
+        logits = logits / jnp.sqrt(jnp.float32(d))
+        logits = jnp.where(mask, logits, jnp.float32(-1e30))
+        probs = jax.nn.softmax(logits, -1)
+        probs = jnp.where(mask, probs, 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+    return execute(f, query, key, value, offset, columns,
+                   _name="sparse_attention")
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss: -log P(label | acoustics) summed over all
+    monotonic alignments. reference: nn/functional/loss.py rnnt_loss
+    (warprnnt CUDA kernel).
+
+    TPU design: the forward DP over the (T, U) lattice runs as a lax.scan
+    over time frames; the in-row dependency (emit from u-1) is a second
+    scan over label positions. logits: (B, T, U+1, V)."""
+    def f(logits, lab, ilen, llen):
+        bsz, t_max, u_max, v = logits.shape  # u_max = U+1
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        blank_lp = lp[..., blank]                       # (B, T, U+1)
+        lab_idx = jnp.minimum(lab, v - 1)
+        emit_lp = jnp.take_along_axis(
+            lp[:, :, :-1, :], lab_idx[:, None, :, None], -1)[..., 0]
+        emit_lp = jnp.pad(emit_lp, ((0, 0), (0, 0), (0, 1)),
+                          constant_values=-1e30)        # (B, T, U+1)
+        NEG = jnp.float32(-1e30)
+
+        def emit_scan(alpha_row, emit_row):
+            # alpha_row: (B, U+1) pre-emit; fold in emissions left-to-right
+            def inner(carry, u):
+                prev = carry                             # alpha[t, u-1] final
+                cur = jnp.where(u == 0, alpha_row[:, 0],
+                                jnp.logaddexp(alpha_row[jnp.arange(bsz), u],
+                                              prev + emit_row[
+                                                  jnp.arange(bsz), u - 1]))
+                return cur, cur
+            _, rows = jax.lax.scan(inner, jnp.full((bsz,), NEG),
+                                   jnp.arange(u_max))
+            return jnp.moveaxis(rows, 0, 1)              # (B, U+1)
+
+        alpha0 = jnp.full((bsz, u_max), NEG).at[:, 0].set(0.0)
+        alpha0 = emit_scan(alpha0, emit_lp[:, 0])
+
+        def time_step(alpha, t):
+            from_blank = alpha + blank_lp[:, t - 1]      # advance time
+            new = emit_scan(from_blank, emit_lp[:, t])
+            return jnp.where((t < ilen[:, None]), new, alpha), None
+
+        alpha, _ = jax.lax.scan(time_step, alpha0, jnp.arange(1, t_max))
+        last_t = jnp.clip(ilen - 1, 0, t_max - 1)
+        final_blank = blank_lp[jnp.arange(bsz), last_t, llen]
+        ll = alpha[jnp.arange(bsz), llen] + final_blank
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return execute(f, input, label, input_lengths, label_lengths,
+                   _name="rnnt_loss")
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (Grave et al.): frequent classes in the head,
+    rare classes in down-projected tail clusters.
+    reference: nn/functional/activation.py adaptive_log_softmax_with_loss.
+    Returns (per-sample log-prob output, scalar loss)."""
+    n_clusters = len(cutoffs)  # cutoffs excludes the final num_classes
+    args = [input, label, head_weight] + list(
+        w for pair in tail_weights for w in pair)
+    if head_bias is not None:
+        args.append(head_bias)
+
+    def f(a, lab, hw, *rest):
+        tails = [(rest[2 * i], rest[2 * i + 1]) for i in range(n_clusters)]
+        hb = rest[2 * n_clusters] if head_bias is not None else None
+        head_logits = a @ hw
+        if hb is not None:
+            head_logits = head_logits + hb
+        head_lp = jax.nn.log_softmax(head_logits, -1)    # (N, c0+K)
+        c0 = head_logits.shape[-1] - n_clusters
+        # head classes: direct log-prob
+        out = jnp.where(lab < c0,
+                        jnp.take_along_axis(
+                            head_lp, jnp.clip(lab, 0, c0 - 1)[:, None],
+                            1)[:, 0],
+                        0.0)
+        lo = c0
+        for i, (proj, cls_w) in enumerate(tails):
+            hi = cutoffs[i + 1] if i + 1 < len(cutoffs) else None
+            size = cls_w.shape[-1]
+            in_cluster = (lab >= lo) & (lab < lo + size)
+            tail_lp = jax.nn.log_softmax((a @ proj) @ cls_w, -1)
+            rel = jnp.clip(lab - lo, 0, size - 1)
+            lp_i = head_lp[:, c0 + i] + jnp.take_along_axis(
+                tail_lp, rel[:, None], 1)[:, 0]
+            out = jnp.where(in_cluster, lp_i, out)
+            lo += size
+        return out, -jnp.mean(out)
+    return execute(f, *args, _name="adaptive_log_softmax_with_loss")

@@ -1,0 +1,312 @@
+"""Long-tail nn layers closing the reference surface.
+
+reference: python/paddle/nn/layer/ — common.py (ZeroPad1D/3D, Unflatten),
+activation.py (Softmax2D), distance.py (PairwiseDistance), pooling.py
+(MaxUnPool*, FractionalMaxPool*), loss.py (MultiMarginLoss, HSigmoidLoss),
+container.py (ParameterDict).
+"""
+
+from __future__ import annotations
+
+from .. import functional as F
+from .layers import Layer
+
+__all__ = [
+    "ZeroPad1D", "ZeroPad3D", "Unflatten", "Softmax2D", "PairwiseDistance",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "FractionalMaxPool2D",
+    "FractionalMaxPool3D", "MultiMarginLoss", "HSigmoidLoss",
+    "FeatureAlphaDropout", "ParameterDict", "RNNTLoss",
+    "AdaptiveLogSoftmaxWithLoss",
+]
+
+
+class ZeroPad1D(Layer):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, "constant", 0.0, self.data_format)
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, "constant", 0.0, self.data_format)
+
+
+class Unflatten(Layer):
+    """reference: nn/layer/common.py Unflatten — expand one dim into shape."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = list(shape)
+
+    def forward(self, x):
+        from ...tensor.manipulation import unflatten
+        return unflatten(x, self.axis, self.shape)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW. reference: activation.py."""
+
+    def forward(self, x):
+        assert x.ndim in (3, 4), "Softmax2D expects CHW or NCHW"
+        return F.softmax(x, axis=-3)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class _MaxUnPoolN(Layer):
+    _fn = None
+    _ndim = 2
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        fn = getattr(F, f"max_unpool{self._ndim}d")
+        return fn(x, indices, self.kernel_size, self.stride, self.padding,
+                  output_size=self.output_size)
+
+
+class MaxUnPool1D(_MaxUnPoolN):
+    _ndim = 1
+
+
+class MaxUnPool2D(_MaxUnPoolN):
+    _ndim = 2
+
+
+class MaxUnPool3D(_MaxUnPoolN):
+    _ndim = 3
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, self.output_size,
+                                       random_u=self.random_u,
+                                       return_mask=self.return_mask)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self.output_size,
+                                       random_u=self.random_u,
+                                       return_mask=self.return_mask)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p = p
+        self.margin = margin
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """reference: nn/layer/loss.py HSigmoidLoss (owns the tree weights)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError(
+                "HSigmoidLoss: custom trees are not supported (default "
+                "complete binary tree only)")
+        self.num_classes = num_classes
+        import jax
+        import jax.numpy as jnp
+        from ...framework.core import Parameter
+        from ...framework.random import next_key
+        scale = feature_size ** -0.5
+        self.weight = Parameter(jax.random.normal(
+            next_key(), (num_classes, feature_size), jnp.float32) * scale)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = Parameter(jnp.zeros((num_classes,), jnp.float32))
+
+    def forward(self, input, label):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, training=self.training)
+
+
+class ParameterDict(Layer):
+    """reference: nn/layer/container.py ParameterDict."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            self.update(parameters)
+
+    def __getitem__(self, key):
+        return self._parameters[key]
+
+    def __setitem__(self, key, parameter):
+        self.add_parameter(key, parameter)
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters)
+
+    def __contains__(self, key):
+        return key in self._parameters
+
+    def keys(self):
+        return self._parameters.keys()
+
+    def values(self):
+        return self._parameters.values()
+
+    def items(self):
+        return self._parameters.items()
+
+    def update(self, parameters):
+        items = parameters.items() if hasattr(parameters, "items") \
+            else parameters
+        for k, v in items:
+            self.add_parameter(k, v)
+        return self
+
+
+class RNNTLoss(Layer):
+    """reference: nn/layer/loss.py RNNTLoss (warprnnt)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=self.blank,
+                           fastemit_lambda=self.fastemit_lambda,
+                           reduction=self.reduction)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Adaptive softmax head (Grave et al. 2017).
+    reference: nn/layer/activation.py AdaptiveLogSoftmaxWithLoss — owns the
+    head weight and per-cluster down-projection + class weights."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        import jax
+        import jax.numpy as jnp
+        from ...framework.core import Parameter
+        from ...framework.random import next_key
+        cutoffs = list(cutoffs)
+        if not cutoffs or cutoffs != sorted(set(cutoffs)) \
+                or cutoffs[-1] > n_classes - 1:
+            raise ValueError(f"invalid cutoffs {cutoffs} for "
+                             f"n_classes={n_classes}")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        n_clusters = len(cutoffs)
+        head_size = cutoffs[0] + n_clusters
+        s = in_features ** -0.5
+        self.head_weight = Parameter(jax.random.normal(
+            next_key(), (in_features, head_size), jnp.float32) * s)
+        self.head_bias = Parameter(jnp.zeros((head_size,), jnp.float32)) \
+            if head_bias else None
+        self.tail_weights = []
+        for i in range(n_clusters):
+            hsz = max(1, int(in_features // (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            proj = Parameter(jax.random.normal(
+                next_key(), (in_features, hsz), jnp.float32) * s)
+            cls_w = Parameter(jax.random.normal(
+                next_key(), (hsz, osz), jnp.float32) * hsz ** -0.5)
+            self.add_parameter(f"tail_proj_{i}", proj)
+            self.add_parameter(f"tail_cls_{i}", cls_w)
+            self.tail_weights.append((proj, cls_w))
+
+    def forward(self, input, label):
+        out, loss = F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs[:-1], head_bias=self.head_bias)
+        return out, loss
+
+    def log_prob(self, input):
+        """Full (N, n_classes) log-probabilities."""
+        import jax
+        import jax.numpy as jnp
+        from ...framework.core import execute as _ex
+        tails = self.tail_weights
+        hb = self.head_bias
+        c0 = self.cutoffs[0]
+
+        def f(a, hw, *rest):
+            logits = a @ hw
+            if hb is not None:
+                logits = logits + rest[-1]
+            head_lp = jax.nn.log_softmax(logits, -1)
+            pieces = [head_lp[:, :c0]]
+            for i in range(len(tails)):
+                proj, cls_w = rest[2 * i], rest[2 * i + 1]
+                tail_lp = jax.nn.log_softmax((a @ proj) @ cls_w, -1)
+                pieces.append(head_lp[:, c0 + i:c0 + i + 1] + tail_lp)
+            return jnp.concatenate(pieces, -1)
+
+        args = [input, self.head_weight] + [w for pair in tails
+                                            for w in pair]
+        if hb is not None:
+            args.append(hb)
+        return _ex(f, *args, _name="adaptive_log_softmax")

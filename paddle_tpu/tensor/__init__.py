@@ -92,21 +92,8 @@ def _attach():
                 continue
             if not hasattr(Tensor, name):
                 setattr(Tensor, name, fn)
-    # in-place variants
-    import functools
-
-    def make_inplace(fn):
-        @functools.wraps(fn)
-        def inplace(self, *a, **k):
-            return self._rebind(fn(self, *a, **k))
-        return inplace
-
-    for name in ["add", "subtract", "multiply", "divide", "clip", "scale",
-                 "floor", "ceil", "exp", "sqrt", "rsqrt", "reciprocal",
-                 "round", "abs", "tanh", "sigmoid", "pow"]:
-        fn = getattr(Tensor, name, None)
-        if fn is not None and not hasattr(Tensor, name + "_"):
-            setattr(Tensor, name + "_", make_inplace(fn))
+    # in-place twins are generated once, below (_gen_inplace covers both
+    # the module-level foo_() and the Tensor.foo_() method surface)
 
     # x.where(cond-style): paddle Tensor.where(x, y) means where(self_cond?..)
     Tensor.where = lambda self, x, y, name=None: manipulation.where(self, x, y)
@@ -124,3 +111,69 @@ def _attach():
 
 
 _attach()
+
+
+# ---------------------------------------------------------------------------
+# extras + generated in-place surface
+# ---------------------------------------------------------------------------
+
+from . import extras as _extras
+from .extras import *  # noqa: F401,F403
+
+# reference exposes an in-place twin (`foo_`) for most elementwise/layout
+# ops (tensor_patch_methods + generated inplace kernels). Our tensors are
+# functional underneath — in-place is a rebind of the same Python object —
+# so the twins are generated, not hand-written.
+_INPLACE_BASES = [
+    "abs", "acos", "addmm", "atan", "bitwise_and", "bitwise_left_shift",
+    "bitwise_not", "bitwise_or", "bitwise_right_shift", "bitwise_xor",
+    "cast", "copysign", "cos", "cumprod", "cumsum", "digamma", "divide",
+    "equal", "erf", "expm1", "floor_divide", "floor_mod", "frac", "gcd",
+    "greater_equal", "greater_than", "hypot", "i0", "index_add",
+    "index_put", "lcm", "ldexp", "less_equal", "less_than", "lgamma",
+    "log", "log10", "log2", "logical_and", "logical_not", "logical_or",
+    "logical_xor", "logit", "masked_fill", "mod", "multiply", "nan_to_num",
+    "neg", "pow", "remainder", "scatter", "sin", "sinh", "square", "t",
+    "tan", "tanh", "transpose", "tril", "triu", "trunc", "gammainc",
+    "gammaincc", "gammaln", "multigammaln", "polygamma", "sinc", "renorm",
+    "masked_scatter", "index_fill", "add", "subtract", "clip", "scale",
+    "floor", "ceil", "exp", "sqrt", "rsqrt", "reciprocal", "round",
+    "sigmoid",
+]
+
+
+def _gen_inplace():
+    import functools
+    import sys
+    mod = sys.modules[__name__]
+    for base in _INPLACE_BASES:
+        fn = getattr(mod, base, None)
+        if fn is None:
+            continue
+        name = base + "_"
+        if getattr(mod, name, None) is not None:
+            continue
+
+        def make(f):
+            @functools.wraps(f)
+            def g(x, *a, **k):
+                return x._rebind(f(x, *a, **k))
+            g.__qualname__ = g.__name__ = f.__name__ + "_"
+            return g
+
+        g = make(fn)
+        setattr(mod, name, g)
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, g)
+
+
+_gen_inplace()
+
+
+def where_(condition, x, y=None, name=None):
+    """In-place where: x keeps values where condition, takes y elsewhere."""
+    out = manipulation.where(condition, x, y)
+    return x._rebind(out)
+
+
+Tensor.where_ = lambda self, cond, y, name=None: where_(cond, self, y)

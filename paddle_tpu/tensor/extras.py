@@ -1,0 +1,286 @@
+"""Long-tail tensor ops closing the reference's top-level API surface.
+
+reference: python/paddle/tensor/math.py, manipulation.py, linalg.py —
+the less-common public ops (special functions, distance matrices,
+structured creation) that reference code still imports from `paddle.*`.
+All are jax compositions dispatched through execute() so the eager tape
+and FD grad gate cover them.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, execute
+
+__all__ = [
+    "add_n", "block_diag", "broadcast_shape", "cartesian_prod", "cdist",
+    "combinations", "diag_embed", "frexp", "gammainc", "gammaincc",
+    "gammaln", "histogram_bin_edges", "index_fill", "isin", "logcumsumexp",
+    "masked_scatter", "multigammaln", "pdist", "polygamma", "reduce_as",
+    "renorm", "reverse", "sgn", "signbit", "sinc", "take", "trace",
+    "vander", "as_strided",
+]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a tensor list. reference: math.py add_n."""
+    if isinstance(inputs, Tensor):
+        return execute(lambda a: a, inputs, _name="add_n")
+    def f(*arrs):
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = out + a
+        return out
+    return execute(f, *inputs, _name="add_n")
+
+
+def block_diag(inputs, name=None):
+    def f(*arrs):
+        arrs = [a if a.ndim == 2 else a.reshape(1, -1) for a in arrs]
+        return jax.scipy.linalg.block_diag(*arrs)
+    return execute(f, *inputs, _name="block_diag")
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def cartesian_prod(x, name=None):
+    def f(*arrs):
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    return execute(f, *x, _name="cartesian_prod")
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise distances between row vectors. reference: linalg.py cdist."""
+    def f(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(jnp.sum(d * d, -1), 1e-30))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d), -1)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype), -1)
+        return jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+    return execute(f, x, y, _name="cdist")
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances (upper triangle, row-major)."""
+    def f(a):
+        n = a.shape[0]
+        d = a[:, None, :] - a[None, :, :]
+        if p == 2.0:
+            m = jnp.sqrt(jnp.maximum(jnp.sum(d * d, -1), 1e-30))
+        elif p == float("inf"):
+            m = jnp.max(jnp.abs(d), -1)
+        else:
+            m = jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+        iu = jnp.triu_indices(n, k=1)
+        return m[iu]
+    return execute(f, x, _name="pdist")
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    n = int(x.shape[0])
+    import itertools as it
+    idx = list(it.combinations_with_replacement(range(n), r)
+               if with_replacement else it.combinations(range(n), r))
+    idx_arr = jnp.asarray(np.asarray(idx, np.int32).reshape(-1, r)
+                          if idx else np.zeros((0, r), np.int32))
+    return execute(lambda a: a[idx_arr], x, _name="combinations")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched vectors -> batched diagonal matrices.
+    reference: tensor/creation.py diag_embed."""
+    def f(a):
+        m = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (m, m), a.dtype)
+        i = jnp.arange(a.shape[-1])
+        rows = i + max(-offset, 0)
+        cols = i + max(offset, 0)
+        out = base.at[..., rows, cols].set(a)
+        nd = out.ndim
+        return jnp.moveaxis(out, (nd - 2, nd - 1), (dim1 % nd, dim2 % nd))
+    return execute(f, input, _name="diag_embed")
+
+
+def frexp(x, name=None):
+    def f(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(jnp.int32)
+    return execute(f, x, _name="frexp")
+
+
+def gammaln(x, name=None):
+    return execute(jax.scipy.special.gammaln, x, _name="gammaln")
+
+
+def gammainc(x, y, name=None):
+    return execute(jax.scipy.special.gammainc, x, y, _name="gammainc")
+
+
+def gammaincc(x, y, name=None):
+    return execute(jax.scipy.special.gammaincc, x, y, _name="gammaincc")
+
+
+def multigammaln(x, p, name=None):
+    return execute(lambda a: jax.scipy.special.multigammaln(a, p), x,
+                   _name="multigammaln")
+
+
+def polygamma(x, n, name=None):
+    return execute(lambda a: jax.scipy.special.polygamma(n, a), x,
+                   _name="polygamma")
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    def f(a):
+        rng = None if (min == 0 and max == 0) else (min, max)
+        return jnp.histogram_bin_edges(a, bins=bins, range=rng)
+    return execute(f, input, _name="histogram_bin_edges")
+
+
+def index_fill(x, index, axis, value, name=None):
+    def f(a, idx):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[idx].set(value)
+        return jnp.moveaxis(moved, 0, axis)
+    return execute(f, x, index, _name="index_fill")
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return execute(lambda a, t: jnp.isin(a, t, invert=invert), x, test_x,
+                   _name="isin")
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    """Numerically-stable cumulative logsumexp. reference: math.py."""
+    def f(a):
+        if axis is None:
+            arr = a.reshape(-1)
+            ax = 0
+        else:
+            arr, ax = a, axis
+        out = jax.lax.cumlogsumexp(arr.astype(jnp.float32), axis=ax)
+        return out.astype(dtype or a.dtype) if jnp.issubdtype(
+            a.dtype, jnp.floating) else out
+    return execute(f, x, _name="logcumsumexp")
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill masked positions of x with consecutive elements of value."""
+    def f(a, m, v):
+        flat_m = m.reshape(-1) if m.shape == a.shape else \
+            jnp.broadcast_to(m, a.shape).reshape(-1)
+        pos = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+        src = v.reshape(-1)[jnp.clip(pos, 0, v.size - 1)]
+        return jnp.where(flat_m, src, a.reshape(-1)).reshape(a.shape)
+    return execute(f, x, mask, value, _name="masked_scatter")
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce x to the shape of target (grad-of-broadcast semantics)."""
+    def f(a, t):
+        extra = a.ndim - t.ndim
+        if extra:
+            a = jnp.sum(a, axis=tuple(range(extra)))
+        axes = tuple(i for i in range(a.ndim) if t.shape[i] == 1
+                     and a.shape[i] != 1)
+        if axes:
+            a = jnp.sum(a, axis=axes, keepdims=True)
+        return a
+    return execute(f, x, target, _name="reduce_as")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Clip each slice along axis to p-norm <= max_norm."""
+    def f(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        if p == float("inf"):
+            norms = jnp.max(jnp.abs(flat), axis=1)
+        else:
+            norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+    return execute(f, x, _name="renorm")
+
+
+def reverse(x, axis, name=None):
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    return execute(lambda a: jnp.flip(a, ax), x, _name="reverse")
+
+
+def sgn(x, name=None):
+    """Complex-aware sign: x/|x| (0 where x == 0)."""
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.maximum(mag, 1e-30))
+        return jnp.sign(a)
+    return execute(f, x, _name="sgn")
+
+
+def signbit(x, name=None):
+    return execute(jnp.signbit, x, _name="signbit")
+
+
+def sinc(x, name=None):
+    return execute(jnp.sinc, x, _name="sinc")
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather. reference: math.py take (mode raise/wrap/clip)."""
+    def f(a, idx):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        if mode == "wrap":
+            idx2 = jnp.mod(idx, n)
+        else:  # clip (and 'raise': XLA clamps; OOB cannot trap on TPU)
+            idx2 = jnp.clip(idx, -n, n - 1)
+        idx2 = jnp.where(idx2 < 0, idx2 + n, idx2)
+        return flat[idx2]
+    return execute(f, x, index, _name="take")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return execute(lambda a: jnp.trace(a, offset=offset, axis1=axis1,
+                                       axis2=axis2), x, _name="trace")
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return execute(lambda a: jnp.vander(a, N=n, increasing=increasing), x,
+                   _name="vander")
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view as an explicit gather (XLA has no aliasing views;
+    reference: paddle/phi/kernels/stride/). Indices are computed from the
+    requested strides over the flattened input."""
+    shape = tuple(int(s) for s in shape)
+    stride = tuple(int(s) for s in stride)
+
+    def f(a):
+        flat = a.reshape(-1)
+        idx = jnp.asarray(offset)
+        for dim, (sz, st) in enumerate(zip(shape, stride)):
+            ix = jnp.arange(sz) * st
+            expand = [None] * len(shape)
+            expand[dim] = slice(None)
+            idx = idx + ix[tuple(expand)]
+        return flat[idx]
+    return execute(f, x, _name="as_strided")

@@ -18,7 +18,8 @@ __all__ = [
     "rand", "randn", "standard_normal", "normal", "normal_", "uniform",
     "uniform_", "randint", "randint_like", "randperm", "bernoulli",
     "poisson", "multinomial", "standard_gamma", "binomial", "exponential_",
-    "gumbel_softmax", "log_normal", "cauchy_", "geometric_",
+    "gumbel_softmax", "log_normal", "log_normal_", "bernoulli_",
+    "cauchy_", "geometric_",
 ]
 
 
@@ -147,6 +148,13 @@ def exponential_(x, lam=1.0, name=None):
 
 def log_normal(mean=1.0, std=2.0, shape=None, name=None):
     return Tensor(jnp.exp(mean + std * jax.random.normal(next_key(), _shape(shape or [1]), _dtype(None))))
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """In-place lognormal fill. reference: tensor/random.py log_normal_."""
+    x._data = jnp.exp(mean + std * jax.random.normal(
+        next_key(), x._data.shape)).astype(x._data.dtype)
+    return x
 
 
 def cauchy_(x, loc=0, scale=1, name=None):

@@ -117,7 +117,7 @@ from . import onnx  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402
 from .hapi.flops import flops  # noqa: F401,E402
 from .distributed.parallel import DataParallel  # noqa: F401,E402
-from .tensor import linalg  # noqa: F401,E402
+from . import linalg  # noqa: F401,E402
 
 
 def batch(reader, batch_size, drop_last=False):

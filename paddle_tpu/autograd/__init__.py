@@ -12,7 +12,7 @@ import jax.numpy as jnp
 from ..framework.core import (Tensor, _run_backward, execute, no_grad,
                               is_grad_enabled, set_grad_enabled, enable_grad)
 
-__all__ = ["backward", "grad", "PyLayer", "PyLayerContext", "no_grad",
+__all__ = ["backward", "grad", "PyLayer", "PyLayerContext", "no_grad", "saved_tensors_hooks",
            "enable_grad", "set_grad_enabled", "is_grad_enabled", "jvp", "vjp",
            "hessian", "jacobian"]
 
@@ -60,14 +60,25 @@ class PyLayerContext:
         self.not_inplace_tensors = ()
 
     def save_for_backward(self, *tensors):
-        self._saved = tensors
+        hooks = saved_tensors_hooks._active
+        if hooks is not None:
+            self._saved = tuple(hooks[0](t) for t in tensors)
+            # capture the matching unpack NOW: backward usually runs after
+            # the with-block exits, when _active is gone
+            self._unpack = hooks[1]
+        else:
+            self._saved = tensors
+            self._unpack = None
 
     @property
     def saved_tensor(self):
+        unpack = getattr(self, "_unpack", None)
+        if unpack is not None:
+            return tuple(unpack(t) for t in self._saved)
         return self._saved
 
     def saved_tensors(self):
-        return self._saved
+        return self.saved_tensor
 
     def mark_not_inplace(self, *args):
         self.not_inplace_tensors = args
@@ -215,3 +226,27 @@ def hessian(func, xs, batch_axis=None):
         h = h[0] if isinstance(h, tuple) else h
         return wrap(h)
     return wrap(hes)
+
+
+class saved_tensors_hooks:
+    """reference: autograd/saved_tensors_hooks.py — customize how PyLayer
+    saves activations (pack on save, unpack on use; enables host offload).
+
+    Scope note: the eager tape stores op residuals inside XLA-owned vjp
+    closures, so these hooks apply to PyLayer's explicitly saved tensors
+    (ctx.save_for_backward), same API as the reference."""
+
+    _active = None
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        self._prev = saved_tensors_hooks._active
+        saved_tensors_hooks._active = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        saved_tensors_hooks._active = self._prev
+        return False

@@ -45,3 +45,146 @@ def get_mesh():
 def set_mesh(mesh):
     from .placement import _default_mesh
     _default_mesh[0] = mesh
+
+# ---------------------------------------------------------------------------
+# reference-surface aliases + shims (python/paddle/distributed/__init__.py)
+# ---------------------------------------------------------------------------
+
+def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
+    """reference: paddle.distributed.alltoall — NOTE the reference argument
+    order is (in, out), the reverse of torch-style all_to_all(out, in)."""
+    return all_to_all(out_tensor_list, in_tensor_list, group=group,
+                      sync_op=sync_op)
+
+
+def alltoall_single(in_tensor, out_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """reference: paddle.distributed.alltoall_single (in, out) order."""
+    return all_to_all_single(out_tensor, in_tensor,
+                             out_split_sizes=out_split_sizes,
+                             in_split_sizes=in_split_sizes, group=group,
+                             sync_op=sync_op)
+from .checkpoint import (  # noqa: F401
+    save_state_dict, load_state_dict)
+from . import io  # noqa: F401
+
+
+class ReduceType:
+    """reference: auto_parallel/placement_type ReduceType."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+
+
+class ParallelMode:
+    """reference: fleet ParallelMode enum."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class _ShardingStage:
+    stage = 0
+
+    def __init__(self, *a, **k):
+        pass
+
+
+class ShardingStage1(_ShardingStage):
+    """Marker for Strategy/shard_optimizer (reference auto_parallel api)."""
+    stage = 1
+
+
+class ShardingStage2(_ShardingStage):
+    stage = 2
+
+
+class ShardingStage3(_ShardingStage):
+    stage = 3
+
+
+DistAttr = None  # legacy dist attr: superseded by placements (kept importable)
+
+
+def get_backend():
+    """reference: get_backend — the comm backend name."""
+    return "xla"
+
+
+def is_available():
+    import jax
+    return len(jax.devices()) > 0
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """reference: communication wait — XLA ops are ordered by data flow, so
+    wait is a device sync."""
+    if hasattr(tensor, "_data"):
+        tensor._data.block_until_ready()
+    return tensor
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Single-controller: every process already holds the object."""
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    if in_object_list:
+        out_object_list.append(in_object_list[0])
+    return out_object_list
+
+
+def shard_scaler(scaler):
+    """reference: auto_parallel shard_scaler — GradScaler state is already
+    replicated arrays under GSPMD; returns the scaler unchanged."""
+    return scaler
+
+
+def gloo_init_parallel_env(*a, **k):
+    raise NotImplementedError(
+        "gloo is descoped on TPU (DESIGN.md): rendezvous rides the native "
+        "TCPStore and collectives ride XLA/ICI")
+
+
+def gloo_barrier(*a, **k):
+    raise NotImplementedError("gloo is descoped on TPU (DESIGN.md)")
+
+
+def gloo_release(*a, **k):
+    raise NotImplementedError("gloo is descoped on TPU (DESIGN.md)")
+
+
+class _PSDescoped:
+    """Parameter-server artifacts (reference: fluid/distributed/ps) are
+    descoped on TPU — see DESIGN.md's ledger."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            f"{type(self).__name__}: the brpc parameter server is descoped "
+            "on TPU (DESIGN.md) — use sharded embeddings over ICI "
+            "(VocabParallelEmbedding / ZeRO-3) instead")
+
+
+class InMemoryDataset(_PSDescoped):
+    pass
+
+
+class QueueDataset(_PSDescoped):
+    pass
+
+
+class CountFilterEntry(_PSDescoped):
+    pass
+
+
+class ProbabilityEntry(_PSDescoped):
+    pass
+
+
+class ShowClickEntry(_PSDescoped):
+    pass

@@ -4,5 +4,5 @@ from . import functional  # noqa: F401
 from .layer import (  # noqa: F401
     FusedLinear, FusedMultiHeadAttention, FusedFeedForward,
     FusedTransformerEncoderLayer, FusedDropoutAdd,
-    FusedBiasDropoutResidualLayerNorm, FusedEcMoe,
+    FusedBiasDropoutResidualLayerNorm, FusedEcMoe, FusedMultiTransformer,
 )

@@ -17,11 +17,11 @@ import jax
 import jax.numpy as jnp
 
 from ...framework.core import Tensor, execute
-from ...nn.layer.layers import Layer
+from ...nn.layer.layers import Layer, LayerList
 from ... import nn
 from . import functional as F
 
-__all__ = ["FusedLinear", "FusedMultiHeadAttention", "FusedFeedForward",
+__all__ = ["FusedLinear", "FusedMultiHeadAttention", "FusedFeedForward", "FusedMultiTransformer",
            "FusedTransformerEncoderLayer", "FusedDropoutAdd",
            "FusedBiasDropoutResidualLayerNorm", "FusedEcMoe"]
 
@@ -261,3 +261,45 @@ class FusedEcMoe(Layer):
             return jnp.einsum("bsed,bse->bsd", o, probs)
         return execute(f, x, g, self.e1_w, self.e1_b, self.e2_w, self.e2_b,
                        _name="fused_ec_moe")
+
+
+class FusedMultiTransformer(Layer):
+    """Whole-stack fused decoder: N pre-LN transformer layers in one module.
+    reference: incubate/nn/layer/fused_transformer.py FusedMultiTransformer
+    (the generation-serving stack). TPU-native: the layer loop is plain
+    Python over fused per-layer blocks — XLA fuses each block. Incremental
+    decode (cache_kvs/time_step) is not implemented and fails loudly."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate=0.0,
+                 activation="gelu", normalize_before=True, ln_scale_attrs=None,
+                 qkv_weight_attrs=None, num_layers=-1, nranks=1, ring_id=-1,
+                 name=None, **kw):
+        super().__init__()
+        if not normalize_before:
+            raise NotImplementedError(
+                "FusedMultiTransformer supports pre-LN only (the reference "
+                "kernel's layout)")
+        if num_layers < 0:
+            num_layers = len(qkv_weight_attrs) if qkv_weight_attrs else 1
+        self.num_layers = num_layers
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.layers = LayerList([
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=True)
+            for _ in range(num_layers)])
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None,
+                **kw):
+        if caches is not None or time_step is not None:
+            raise NotImplementedError(
+                "FusedMultiTransformer: incremental decode (caches/"
+                "time_step) is not implemented; use "
+                "incubate.nn.functional.masked_multihead_attention for the "
+                "decode step")
+        out = src
+        for layer in self.layers:
+            out = layer(out, src_mask=attn_mask)
+        return out

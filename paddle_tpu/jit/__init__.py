@@ -382,3 +382,21 @@ def load(path, **configs):
     tl.state_dict = lambda: state
     tl._input_spec = meta.get("input_spec", [])
     return tl
+
+
+_code_level = 0
+_verbosity = 0
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """reference: jit/dy2static logging_utils.set_code_level — controls
+    transformed-code logging. Recorded; trace-based to_static has no AST
+    transforms to print, so this gates the trace-debug logs."""
+    global _code_level
+    _code_level = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """reference: jit logging_utils.set_verbosity."""
+    global _verbosity
+    _verbosity = level

@@ -1,0 +1,161 @@
+"""paddle.linalg namespace. reference: python/paddle/linalg.py — re-exports
+the linear-algebra op surface plus a few linalg-only ops defined here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .framework.core import Tensor, execute
+from .tensor.linalg import (  # noqa: F401
+    cholesky, norm, cond, inv, eig, eigvals, multi_dot, matrix_rank, svd,
+    qr, householder_product, lu, lu_unpack, matrix_power, det, slogdet,
+    eigh, eigvalsh, pinv, solve, cholesky_solve, triangular_solve, lstsq,
+    svdvals, cov, corrcoef, pca_lowrank,
+)
+
+__all__ = [
+    "cholesky", "cholesky_inverse", "norm", "matrix_norm", "vector_norm",
+    "cond", "cov", "corrcoef", "inv", "eig", "eigvals", "multi_dot",
+    "matrix_rank", "svd", "qr", "householder_product", "pca_lowrank",
+    "svd_lowrank", "lu", "lu_unpack", "matrix_exp", "matrix_power", "det",
+    "slogdet", "eigh", "eigvalsh", "pinv", "solve", "cholesky_solve",
+    "triangular_solve", "lstsq", "ormqr",
+]
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of an SPD matrix given its Cholesky factor.
+    reference: linalg cholesky_inverse."""
+    def f(l):
+        eye = jnp.eye(l.shape[-1], dtype=l.dtype)
+        li = jax.scipy.linalg.solve_triangular(l, eye, lower=not upper)
+        return li.T @ li if not upper else li @ li.T
+    return execute(f, x, _name="cholesky_inverse")
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    """reference: linalg.matrix_norm."""
+    def f(a):
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(
+                jnp.abs(a) ** 2, axis=axis, keepdims=keepdim))
+        if p == "nuc":
+            s = jnp.linalg.svd(a, compute_uv=False)
+            out = jnp.sum(s, -1)
+            return out[..., None, None] if keepdim else out
+        if p in (1, -1):
+            colsums = jnp.sum(jnp.abs(a), axis=axis[0], keepdims=True)
+            red = jnp.max if p == 1 else jnp.min
+            out = red(colsums, axis=axis[1], keepdims=True)
+            return out if keepdim else jnp.squeeze(out, axis)
+        if p in (2, -2):
+            s = jnp.linalg.svd(a, compute_uv=False)
+            out = (jnp.max if p == 2 else jnp.min)(s, -1)
+            return out[..., None, None] if keepdim else out
+        if p in (float("inf"), float("-inf")):
+            rowsums = jnp.sum(jnp.abs(a), axis=axis[1], keepdims=True)
+            red = jnp.max if p == float("inf") else jnp.min
+            out = red(rowsums, axis=axis[0], keepdims=True)
+            return out if keepdim else jnp.squeeze(out, axis)
+        raise ValueError(f"unsupported matrix norm order {p!r}")
+    return execute(f, x, _name="matrix_norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """reference: linalg.vector_norm."""
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax,
+                           keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax,
+                       keepdims=keepdim) ** (1.0 / p)
+    return execute(f, x, _name="vector_norm")
+
+
+def matrix_exp(x, name=None):
+    """reference: linalg.matrix_exp (Pade approximation in the reference;
+    jax.scipy implements the same scaling-and-squaring algorithm)."""
+    return execute(jax.scipy.linalg.expm, x, _name="matrix_exp")
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (Halko et al.), like the reference's
+    svd_lowrank: subspace iteration on a Gaussian sketch."""
+    from .framework.random import next_key
+    key = next_key()
+    args = [x] + ([M] if M is not None else [])
+
+    def f(a, *rest):
+        am = a - rest[0] if rest else a
+        m, n = am.shape[-2:]
+        k = min(q, m, n)
+        omega = jax.random.normal(key, am.shape[:-2] + (n, k), am.dtype)
+        y = am @ omega
+        for _ in range(niter):
+            y = am @ (jnp.swapaxes(am, -1, -2) @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(qmat, -1, -2) @ am
+        u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u_b, s, jnp.swapaxes(vh, -1, -2)
+    return execute(f, *args, _name="svd_lowrank")
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply `other` by the orthogonal Q of a geqrf factorization
+    (x householder vectors + tau). reference: linalg.ormqr."""
+    def f(a, t, c):
+        def one(a2, t1, c2):
+            q = _householder_q(a2, t1)
+            if transpose:
+                q = q.T
+            return q @ c2 if left else c2 @ q
+        fn = one
+        for _ in range(a.ndim - 2):  # map over leading batch dims
+            fn = jax.vmap(fn)
+        return fn(a, t, c)
+    return execute(f, x, tau, other, _name="ormqr")
+
+
+def _householder_q(a, tau):
+    m, k = a.shape[-2], tau.shape[-1]
+    q = jnp.eye(m, dtype=a.dtype)
+    for i in range(k):
+        v = jnp.zeros((m,), a.dtype).at[i].set(1.0)
+        v = v.at[i + 1:].set(a[..., i + 1:, i])
+        h = jnp.eye(m, dtype=a.dtype) - tau[..., i] * jnp.outer(v, v)
+        q = q @ h
+    return q
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, output_dtype="float16",
+                            scale=1.0, activation_type="identity", name=None):
+    """fp8 x fp8 -> half GEMM. reference: linalg.fp8_fp8_half_gemm_fused
+    (cuBLASLt). On TPU fp8 operands feed the MXU natively via XLA."""
+    def f(a, b, *rest):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = jnp.matmul(a, b, preferred_element_type=jnp.float32) * scale
+        if rest:
+            out = out + rest[0].astype(out.dtype)
+        if activation_type in ("gelu",):
+            out = jax.nn.gelu(out)
+        elif activation_type in ("relu",):
+            out = jax.nn.relu(out)
+        from .framework import dtypes as _dt
+        return out.astype(_dt.convert_dtype(output_dtype))
+    args = [x, y] + ([bias] if bias is not None else [])
+    return execute(f, *args, _name="fp8_gemm")

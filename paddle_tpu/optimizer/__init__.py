@@ -22,7 +22,8 @@ from . import lr as lr_mod
 from .lr import *  # noqa: F401,F403
 from .lr import LRScheduler
 
-__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
+__all__ = [
+    "ASGD","Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
            "Adadelta", "Adamax", "RMSProp", "Lamb", "NAdam", "RAdam",
            "Rprop", "LBFGS", "lr"]
 
@@ -165,6 +166,32 @@ class SGD(Optimizer):
         if self._weight_decay:
             g = g + self._weight_decay * p
         return p - lr * g, state
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (Polyak-Ruppert). reference: optimizer/asgd.py — keeps
+    a running average of the iterates alongside the SGD step."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._batch_num = batch_num
+
+    def init_state(self, p):
+        # d = running sum of the last `batch_num` grads; y = previous grad
+        return {"d": jnp.zeros_like(p), "y": jnp.zeros_like(p)}
+
+    def update(self, p, g, state, lr, step):
+        if self._weight_decay:
+            g = g + self._weight_decay * p
+        # reference asgd.py: d <- d - y + g ; param -= lr * d / n
+        d = state["d"] - state["y"] + g
+        n = jnp.minimum(jnp.asarray(step, jnp.float32),
+                        jnp.float32(self._batch_num))
+        p_new = p - lr * d / jnp.maximum(n, 1.0)
+        return p_new, {"d": d, "y": g}
 
 
 class Momentum(Optimizer):

@@ -567,3 +567,31 @@ def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
     return execute(lambda i, p: beta * i + alpha * p, dense_in, prod,
                    _name="sparse_addmm")
 from . import nn  # noqa: F401,E402
+
+
+def mask_as(x, mask, name=None):
+    """Select values of dense x at `mask`'s sparse pattern, returning a
+    sparse tensor of the same format. reference: sparse/binary.py mask_as."""
+    coo = mask.to_sparse_coo() if isinstance(mask, SparseCsrTensor) else mask
+    sp_ndim = coo._indices.shape[0]
+
+    def f(dense):
+        idx = tuple(coo._indices[d] for d in range(sp_ndim))
+        return dense[idx]
+    vals = execute(f, x, _name="mask_as")
+    out = SparseCooTensor(coo._indices, vals, coo._shape,
+                          coalesced=coo._coalesced)
+    if isinstance(mask, SparseCsrTensor):
+        return out.to_sparse_csr()
+    return out
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Sparse-input PCA: densify then run the dense pca_lowrank.
+    reference: sparse pca_lowrank (sparse_csr path)."""
+    dense = x.to_dense() if hasattr(x, "to_dense") else x
+    from ..tensor.linalg import pca_lowrank as _dense_pca
+    return _dense_pca(dense, q=q, center=center, niter=niter)
+
+
+__all__ += ["mask_as", "pca_lowrank"]

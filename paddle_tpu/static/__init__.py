@@ -117,3 +117,353 @@ def scan(body_fn, init, xs, name=None):
 class nn:
     cond = staticmethod(cond)
     while_loop = staticmethod(while_loop)
+
+
+# ---------------------------------------------------------------------------
+# reference-surface shims (python/paddle/static/__init__.py) — the pieces
+# porting code touches; the execution model stays jit.to_static
+# ---------------------------------------------------------------------------
+
+Variable = Tensor  # static Variable == Tensor in this architecture
+
+
+class Executor:
+    """reference: base/executor.py Executor — here a thin runner: feed
+    tensors in, fetch tensors out; jit owns compilation."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kw):
+        outs = []
+        for f in fetch_list or []:
+            if callable(f):
+                outs.append(f(**(feed or {})))
+            else:
+                import numpy as _np
+                outs.append(_np.asarray(f._data) if isinstance(f, Tensor)
+                            else f)
+        return outs
+
+    def close(self):
+        pass
+
+
+class CompiledProgram:
+    """reference: compiler.CompiledProgram — XLA compiles under jit; this
+    records the program + build strategy for API parity."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy
+
+
+class BuildStrategy:
+    """reference: BuildStrategy knobs — recorded; XLA owns the passes."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_all_optimizer_ops = True
+        self.fuse_elewise_add_act_ops = True
+        self.memory_optimize = True
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU is not a target of this build")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU is not a target of this build")
+
+
+import contextlib as _ctx
+
+
+@_ctx.contextmanager
+def device_guard(device=None):
+    yield
+
+
+@_ctx.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError("IPU is not a target of this build")
+    yield  # pragma: no cover
+
+
+class _Scope:
+    def find_var(self, name):
+        return None
+
+    def var(self, name):
+        return None
+
+
+_global_scope = _Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def scope_guard(scope):
+    return _ctx.nullcontext(scope)
+
+
+def cpu_places(device_count=None):
+    from ..device import CPUPlace
+    import os as _os
+    n = device_count or int(_os.environ.get("CPU_NUM", 1))
+    return [CPUPlace()] * n
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (TPU chips in this build)."""
+    import jax as _jax
+    from ..device import TPUPlace
+    ids = device_ids if device_ids is not None else range(
+        len(_jax.devices()))
+    return [TPUPlace(i) for i in ids]
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from ..framework import dtypes as _dt
+    t = Tensor(jnp.full(tuple(shape), value, _dt.convert_dtype(dtype)))
+    t.persistable = persistable
+    t.name = name
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from .. import create_parameter as _cp
+    return _cp(shape, dtype=dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def Print(input, first_n=-1, message=None, summarize=20, **kw):
+    """reference: static Print op — eager host print."""
+    import numpy as _np
+    prefix = message or "var"
+    print(f"{prefix}: {_np.asarray(input._data)[:summarize]}")
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*xs)
+    return res
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Batch AUC (reference static.auc). Returns (auc_value, batch_auc,
+    state) — state handling collapsed (stateless eager computation)."""
+    import numpy as _np
+    probs = _np.asarray(input._data)[:, 1] if input._data.ndim == 2 \
+        else _np.asarray(input._data)
+    y = _np.asarray(label._data).reshape(-1)
+    order = _np.argsort(-probs)
+    y_sorted = y[order]
+    n_pos = max(int(y_sorted.sum()), 0)
+    n_neg = len(y_sorted) - n_pos
+    if n_pos == 0 or n_neg == 0:
+        val = 0.0
+    else:
+        ranks = _np.empty(len(probs))
+        ranks[_np.argsort(probs)] = _np.arange(1, len(probs) + 1)
+        val = float((ranks[y == 1].sum() - n_pos * (n_pos + 1) / 2)
+                    / (n_pos * n_neg))
+    t = Tensor(jnp.asarray(val, jnp.float32))
+    return t, t, []
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """reference: base/backward.py append_backward — in eager-tape terms:
+    run backward, return (param, grad) pairs."""
+    loss.backward()
+    params = parameter_list
+    if params is None:
+        from ..framework.core import _live_parameters
+        params = [p for p in _live_parameters.values()
+                  if p is not None and not p.stop_gradient]
+    return [(p, p.grad) for p in params if getattr(p, "grad", None)
+            is not None]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..autograd import grad as _grad
+    outs = _grad(targets, inputs, grad_outputs=target_gradients,
+                 allow_unused=True)
+    return outs
+
+
+class ExponentialMovingAverage:
+    """reference: static ExponentialMovingAverage — EMA shadow weights with
+    apply/restore guards, eager-tape edition."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._params = []
+
+    def update(self, parameters=None):
+        import jax.numpy as _jnp
+        params = parameters
+        if params is None:
+            from ..framework.core import _live_parameters
+            params = [p for p in _live_parameters.values() if p is not None]
+        for p in params:
+            if id(p) not in self._shadow:
+                self._shadow[id(p)] = _jnp.array(p._data)
+                self._params.append(p)
+            else:
+                self._shadow[id(p)] = (self._decay * self._shadow[id(p)]
+                                       + (1 - self._decay) * p._data)
+
+    @_ctx.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            self._backup[id(p)] = p._data
+            p._data = self._shadow[id(p)]
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup.pop(id(p))
+
+
+class WeightNormParamAttr:
+    """reference: static WeightNormParamAttr — recorded attr; use
+    nn.utils.weight_norm for the actual reparameterization."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+
+
+def serialize_program(program=None, **kw):
+    import pickle
+    return pickle.dumps({"format": "paddle_tpu.static", "version": 1})
+
+
+def deserialize_program(data):
+    return Program()
+
+
+def serialize_persistables(program=None, executor=None, **kw):
+    import pickle
+    state = {}
+    if program is not None and hasattr(program, "state_dict"):
+        state = {k: v.numpy() for k, v in program.state_dict().items()}
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+    return pickle.loads(data)
+
+
+def save(program, model_path, protocol=4, **configs):
+    from ..framework.io_file import save as _save
+    state = program.state_dict() if hasattr(program, "state_dict") else {}
+    _save(state, model_path + ".pdparams")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    from ..framework.io_file import load as _load
+    state = _load(model_path + ".pdparams")
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(state)
+    return state
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feeds, fetches, **kw):
+    return program
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    """reference: static.save_inference_model → jit.save is the artifact."""
+    program = kwargs.get("program")
+    layer = kwargs.get("layer") or program
+    if layer is not None and hasattr(layer, "state_dict"):
+        from ..jit import save as _jsave
+        _jsave(layer, path_prefix)
+    else:
+        raise ValueError(
+            "save_inference_model needs layer=<nn.Layer> in this build "
+            "(the traced-program path is jit.save)")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    from ..jit import load as _jload
+    tl = _jload(path_prefix)
+    return [Program(), [], [tl]]
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    raise NotImplementedError(
+        "ctr_metric_bundle belongs to the parameter-server stack, descoped "
+        "on TPU (DESIGN.md)")
+
+
+def set_program_state(program, state):
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(state)
+
+
+__all__ += [
+    "Variable", "Executor", "CompiledProgram", "BuildStrategy",
+    "IpuStrategy", "IpuCompiledProgram", "device_guard", "ipu_shard_guard",
+    "global_scope", "scope_guard", "cpu_places", "cuda_places",
+    "create_global_var", "create_parameter", "Print", "py_func", "accuracy",
+    "auc", "append_backward", "gradients", "ExponentialMovingAverage",
+    "WeightNormParamAttr", "serialize_program", "deserialize_program",
+    "serialize_persistables", "deserialize_persistables", "save", "load",
+    "save_to_file", "load_from_file", "normalize_program",
+    "save_inference_model", "load_inference_model", "ctr_metric_bundle",
+    "set_program_state",
+]
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework.io_file import load as _load
+    return _load(model_path + ".pdparams")
+
+
+def xpu_places(device_ids=None):
+    raise NotImplementedError("XPU is not a target of this build (TPU-native)")
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise NotImplementedError("IPU is not a target of this build")
+
+
+__all__ += ["load_program_state", "xpu_places", "set_ipu_shard"]

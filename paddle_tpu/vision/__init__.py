@@ -4,3 +4,31 @@ from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
 from .models import LeNet, ResNet, resnet18, resnet50  # noqa: F401
+
+# image backend registry (reference: vision/image.py)
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file -> HWC uint8 array (PIL backend; cv2 unavailable
+    in this environment)."""
+    b = backend or _image_backend
+    if b == "cv2":
+        raise NotImplementedError("cv2 is not available in this build")
+    import numpy as np
+    try:
+        from PIL import Image
+        return np.asarray(Image.open(path))
+    except ImportError as e:  # pragma: no cover
+        raise NotImplementedError("no image backend available") from e

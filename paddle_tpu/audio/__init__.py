@@ -7,3 +7,78 @@ from . import datasets  # noqa: F401
 from . import backends  # noqa: F401
 
 __all__ = ["functional", "features", "datasets", "backends"]
+
+
+# audio file IO over the stdlib wave module (reference: audio/backends —
+# soundfile is unavailable in this environment, WAV PCM covers the tests)
+
+def _wav_params(path):
+    import wave
+    with wave.open(path, "rb") as w:
+        return w.getframerate(), w.getnframes(), w.getnchannels(), \
+            w.getsampwidth()
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_frames, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_frames = num_frames
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath):
+    """reference: audio/backends info."""
+    sr, nf, nc, sw = _wav_params(filepath)
+    return AudioInfo(sr, nf, nc, sw * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Load a PCM WAV file -> (Tensor (C, L) or (L, C), sample_rate)."""
+    import wave
+    import numpy as np
+    import jax.numpy as jnp
+    from ..framework.core import Tensor
+    with wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        nc = w.getnchannels()
+        sw = w.getsampwidth()
+        w.setpos(frame_offset)
+        n = w.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = w.readframes(n)
+    if sw == 1:  # WAV 8-bit PCM is UNSIGNED, centered at 128
+        data = np.frombuffer(raw, np.uint8).reshape(-1, nc)
+        data = data.astype(np.int16) - 128
+    else:
+        dt = {2: np.int16, 4: np.int32}[sw]
+        data = np.frombuffer(raw, dt).reshape(-1, nc)
+    if normalize:
+        data = data.astype(np.float32) / float(2 ** (8 * sw - 1))
+    arr = data.T if channels_first else data
+    return Tensor(jnp.asarray(arr)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         bits_per_sample=16):
+    """Save a waveform Tensor to PCM WAV."""
+    import wave
+    import numpy as np
+    data = np.asarray(src._data if hasattr(src, "_data") else src)
+    if channels_first:
+        data = data.T
+    if data.dtype.kind == "f":
+        scale = float(2 ** (bits_per_sample - 1) - 1)
+        data = np.clip(data, -1.0, 1.0) * scale
+    if bits_per_sample == 8:  # unsigned on disk
+        data = (data + 128).clip(0, 255).astype(np.uint8)
+    else:
+        dt = {16: np.int16, 32: np.int32}[bits_per_sample]
+        data = data.astype(dt)
+    with wave.open(filepath, "wb") as w:
+        w.setnchannels(data.shape[1] if data.ndim == 2 else 1)
+        w.setsampwidth(bits_per_sample // 8)
+        w.setframerate(sample_rate)
+        w.writeframes(data.tobytes())

@@ -3,3 +3,243 @@
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
 from . import asp  # noqa: F401
+
+# ---------------------------------------------------------------------------
+# incubate top-level ops (reference: python/paddle/incubate/__init__.py)
+# ---------------------------------------------------------------------------
+
+import numpy as _np
+
+import jax as _jax
+import jax.numpy as _jnp
+
+from ..framework.core import Tensor as _Tensor, execute as _execute
+from . import autograd  # noqa: F401
+from .. import inference  # noqa: F401
+
+
+def segment_sum(data, segment_ids, name=None):
+    """reference: incubate/tensor/math.py segment_sum."""
+    import numpy as np
+    n = int(np.asarray(_unwrap_t(segment_ids)).max()) + 1
+    return _execute(lambda d, s: _jax.ops.segment_sum(d, s, num_segments=n),
+                    data, segment_ids, _name="segment_sum")
+
+
+def _unwrap_t(x):
+    return x._data if isinstance(x, _Tensor) else x
+
+
+def segment_mean(data, segment_ids, name=None):
+    import numpy as np
+    n = int(np.asarray(_unwrap_t(segment_ids)).max()) + 1
+
+    def f(d, s):
+        tot = _jax.ops.segment_sum(d, s, num_segments=n)
+        cnt = _jax.ops.segment_sum(_jnp.ones_like(d), s, num_segments=n)
+        return tot / _jnp.maximum(cnt, 1.0)
+    return _execute(f, data, segment_ids, _name="segment_mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    import numpy as np
+    n = int(np.asarray(_unwrap_t(segment_ids)).max()) + 1
+    return _execute(lambda d, s: _jax.ops.segment_max(d, s, num_segments=n),
+                    data, segment_ids, _name="segment_max")
+
+
+def segment_min(data, segment_ids, name=None):
+    import numpy as np
+    n = int(np.asarray(_unwrap_t(segment_ids)).max()) + 1
+    return _execute(lambda d, s: _jax.ops.segment_min(d, s, num_segments=n),
+                    data, segment_ids, _name="segment_min")
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Legacy alias of geometric.send_u_recv. reference:
+    incubate/operators/graph_send_recv.py."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reindex node ids to a compact range. reference:
+    incubate/operators/graph_reindex.py. Host op (hash-map semantics)."""
+    import numpy as np
+    xs = np.asarray(_unwrap_t(x))
+    nb = np.asarray(_unwrap_t(neighbors))
+    uniq = {}
+    for v in xs.tolist() + nb.tolist():
+        if v not in uniq:
+            uniq[v] = len(uniq)
+    reindex_src = np.asarray([uniq[v] for v in nb.tolist()], np.int64)
+    cnt = np.asarray(_unwrap_t(count))
+    reindex_dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    out_nodes = np.asarray(sorted(uniq, key=uniq.get), np.int64)
+    return (_Tensor(_jnp.asarray(reindex_src)),
+            _Tensor(_jnp.asarray(reindex_dst)),
+            _Tensor(_jnp.asarray(out_nodes)))
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Sample neighbors from a CSC graph. reference:
+    incubate/operators/graph_sample_neighbors.py. Host op (ragged)."""
+    import numpy as np
+    r = np.asarray(_unwrap_t(row))
+    cp = np.asarray(_unwrap_t(colptr))
+    nodes = np.asarray(_unwrap_t(input_nodes))
+    # fresh draw per call, steerable through np.random.seed
+    rng = np.random.default_rng(np.random.randint(0, 2**31))
+    out_nb, out_cnt = [], []
+    for nd in nodes.tolist():
+        beg, end = int(cp[nd]), int(cp[nd + 1])
+        nbrs = r[beg:end]
+        if sample_size > 0 and len(nbrs) > sample_size:
+            nbrs = rng.choice(nbrs, size=sample_size, replace=False)
+        out_nb.extend(nbrs.tolist())
+        out_cnt.append(len(nbrs))
+    return (_Tensor(_jnp.asarray(np.asarray(out_nb, np.int64))),
+            _Tensor(_jnp.asarray(np.asarray(out_cnt, np.int64))))
+
+
+def identity_loss(x, reduction="none"):
+    """reference: incubate/nn/functional/identity_loss (IPU anchor op) —
+    reduce-only passthrough."""
+    if reduction in (0, "sum"):
+        return x.sum()
+    if reduction in (1, "mean"):
+        return x.mean()
+    return x
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fusion. reference:
+    incubate/operators/softmax_mask_fuse.py."""
+    return _execute(
+        lambda a, m: _jax.nn.softmax(a + m.astype(a.dtype), -1),
+        x, mask, _name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal-masked softmax. reference:
+    incubate/operators/softmax_mask_fuse_upper_triangle.py."""
+    def f(a):
+        s = a.shape[-1]
+        mask = _jnp.tril(_jnp.ones((s, s), _jnp.bool_))
+        logits = _jnp.where(mask, a, _jnp.float32(-1e30))
+        return _jax.nn.softmax(logits, -1)
+    return _execute(f, x, _name="softmax_mask_fuse_upper_triangle")
+
+
+class LookAhead:
+    """Lookahead optimizer wrapper (k steps fast weights, then interpolate
+    toward slow weights). reference: incubate/optimizer/lookahead.py."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_count = 0
+        self._slow = {}
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        params = self.inner_optimizer._parameter_list
+        if self._step_count % self.k == 0:
+            for p in params:
+                slow = self._slow.get(id(p))
+                if slow is None:
+                    slow = p._data
+                slow = slow + self.alpha * (p._data - slow)
+                self._slow[id(p)] = slow
+                p._data = slow
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """Weight averaging over a sliding window with apply/restore.
+    reference: incubate/optimizer/modelaverage.py."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._sum = {id(p): _jnp.zeros_like(p._data) for p in self._params}
+        self._cnt = 0
+        self._backup = {}
+
+    def step(self):
+        self._cnt += 1
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + p._data
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            for p in self._params:
+                self._backup[id(p)] = p._data
+                p._data = self._sum[id(p)] / max(self._cnt, 1)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+        return ctx()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup.pop(id(p))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """K-hop neighbor sampling: repeated graph_sample_neighbors + reindex.
+    reference: incubate/operators/graph_khop_sampler.py. Host op."""
+    import numpy as np
+    cur = np.asarray(_unwrap_t(input_nodes))
+    all_edges_src, all_edges_dst = [], []
+    frontier = cur
+    for k in sample_sizes:
+        nbrs, cnts = graph_sample_neighbors(row, colptr,
+                                            _Tensor(_jnp.asarray(frontier)),
+                                            sample_size=int(k))
+        nb = np.asarray(_unwrap_t(nbrs))
+        ct = np.asarray(_unwrap_t(cnts))
+        dst = np.repeat(frontier, ct)
+        all_edges_src.append(nb)
+        all_edges_dst.append(dst)
+        frontier = np.unique(nb)
+    src = np.concatenate(all_edges_src) if all_edges_src else \
+        np.empty(0, np.int64)
+    dst = np.concatenate(all_edges_dst) if all_edges_dst else \
+        np.empty(0, np.int64)
+    uniq = {}
+    for v in cur.tolist() + src.tolist():
+        if v not in uniq:
+            uniq[v] = len(uniq)
+    re_src = np.asarray([uniq[v] for v in src.tolist()], np.int64)
+    re_dst = np.asarray([uniq[v] for v in dst.tolist()], np.int64)
+    nodes = np.asarray(sorted(uniq, key=uniq.get), np.int64)
+    return (_Tensor(_jnp.asarray(re_src)), _Tensor(_jnp.asarray(re_dst)),
+            _Tensor(_jnp.asarray(nodes)),
+            _Tensor(_jnp.asarray(np.asarray([len(re_src)], np.int64))))

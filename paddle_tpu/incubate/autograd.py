@@ -1,0 +1,39 @@
+"""paddle.incubate.autograd. reference: python/paddle/incubate/autograd/ —
+functional transforms (jvp/vjp/Jacobian/Hessian) + primitive-mode flags.
+
+TPU-native: jax IS the primitive system — ops already decompose to jax
+primitives before autodiff — so prim mode is permanently 'on' and the
+enable/disable knobs record intent only.
+"""
+
+from __future__ import annotations
+
+from ..autograd import jvp, vjp, jacobian, hessian  # noqa: F401
+
+Jacobian = jacobian  # reference class-style aliases
+Hessian = hessian
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "prim_enabled", "prim2orig"]
+
+_prim = True
+
+
+def enable_prim():
+    global _prim
+    _prim = True
+
+
+def disable_prim():
+    """Accepted for parity; jax traces through primitives regardless."""
+    global _prim
+    _prim = False
+
+
+def prim_enabled():
+    return _prim
+
+
+def prim2orig(block=None):
+    """reference: prim2orig pass — identity here (no separate prim IR)."""
+    return block

@@ -18,6 +18,7 @@ from ...framework.core import Tensor
 from ...framework.random import next_key
 
 __all__ = [
+    "Bilinear",
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
     "Assign", "Orthogonal", "Dirac", "calculate_gain", "set_global_initializer",
@@ -183,3 +184,24 @@ def calculate_gain(nonlinearity, param=None):
     if nonlinearity == "selu":
         return 3.0 / 4.0
     raise ValueError(f"unsupported nonlinearity {nonlinearity}")
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel init (for transposed convs).
+    reference: nn/initializer/Bilinear."""
+
+    def __call__(self, t):
+        import numpy as np
+        import jax.numpy as jnp
+        shape = tuple(t.shape)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight")
+        kh, kw = shape[2], shape[3]
+        f = np.ceil(kw / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        grid = np.zeros(shape, np.float32)
+        for i in range(kh):
+            for j in range(kw):
+                grid[:, :, i, j] = (1 - abs(i / f - c)) * (1 - abs(j / f - c))
+        t._data = jnp.asarray(grid).astype(t._data.dtype)
+        return t

@@ -180,18 +180,25 @@ class ASGD(Optimizer):
         self._batch_num = batch_num
 
     def init_state(self, p):
-        # d = running sum of the last `batch_num` grads; y = previous grad
-        return {"d": jnp.zeros_like(p), "y": jnp.zeros_like(p)}
+        # d = running sum over the window; ys = the last `batch_num` grads
+        return {"d": jnp.zeros_like(p),
+                "ys": jnp.zeros((self._batch_num,) + p.shape, p.dtype)}
 
     def update(self, p, g, state, lr, step):
         if self._weight_decay:
             g = g + self._weight_decay * p
-        # reference asgd.py: d <- d - y + g ; param -= lr * d / n
-        d = state["d"] - state["y"] + g
+        # reference asgd.py: evict the oldest grad from the window sum,
+        # admit the new one; param -= lr * d / n
+        idx = jnp.mod(jnp.asarray(step - 1, jnp.int32), self._batch_num)
+        oldest = jax.lax.dynamic_index_in_dim(state["ys"], idx, 0,
+                                              keepdims=False)
+        d = state["d"] - oldest + g
+        ys = jax.lax.dynamic_update_index_in_dim(
+            state["ys"], g.astype(state["ys"].dtype), idx, 0)
         n = jnp.minimum(jnp.asarray(step, jnp.float32),
                         jnp.float32(self._batch_num))
         p_new = p - lr * d / jnp.maximum(n, 1.0)
-        return p_new, {"d": d, "y": g}
+        return p_new, {"d": d, "ys": ys}
 
 
 class Momentum(Optimizer):

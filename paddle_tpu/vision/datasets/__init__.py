@@ -145,3 +145,76 @@ class VOC2012(Dataset):
 
 
 __all__ += ["Flowers", "VOC2012"]
+
+
+class DatasetFolder:
+    """Generic folder-of-class-folders dataset.
+    reference: vision/datasets/folder.py DatasetFolder."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        exts = tuple(e.lower() for e in (extensions or (
+            ".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".webp", ".npy")))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for base, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    path = os.path.join(base, fname)
+                    ok = is_valid_file(path) if is_valid_file else \
+                        fname.lower().endswith(exts)
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+
+    @staticmethod
+    def _default_loader(path):
+        import numpy as np
+        if path.endswith(".npy"):
+            return np.load(path)
+        from .. import image_load
+        return image_load(path)
+
+    def __getitem__(self, index):
+        path, target = self.samples[index]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    """Flat folder of images (no labels). reference: folder.py ImageFolder."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        exts = tuple(e.lower() for e in (extensions or (
+            ".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".webp", ".npy")))
+        self.samples = []
+        for base, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(base, fname)
+                ok = is_valid_file(path) if is_valid_file else \
+                    fname.lower().endswith(exts)
+                if ok:
+                    self.samples.append(path)
+
+    def __getitem__(self, index):
+        sample = self.loader(self.samples[index])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]

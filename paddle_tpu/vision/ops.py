@@ -1,0 +1,687 @@
+"""Detection / vision ops.
+
+reference: python/paddle/vision/ops.py (roi_align/roi_pool/psroi_pool CUDA
+kernels, nms, deform_conv2d, yolo box+loss, prior_box, box_coder, FPN
+proposal distribution, RPN proposal generation).
+
+TPU design notes:
+- RoI ops are bilinear-gather compositions (static shapes: boxes per image
+  are padded/fixed counts, matching how detection models batch on TPU).
+- NMS variants run eagerly on host (data-dependent output sizes — the same
+  reason the reference runs them outside the hot graph at inference).
+- deform_conv2d samples with the grid_sample machinery and runs the matmul
+  on the MXU via an im2col einsum.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, execute
+
+__all__ = [
+    "yolo_loss", "yolo_box", "prior_box", "box_coder", "deform_conv2d",
+    "DeformConv2D", "distribute_fpn_proposals", "generate_proposals",
+    "read_file", "decode_jpeg", "roi_pool", "RoIPool", "psroi_pool",
+    "PSRoIPool", "roi_align", "RoIAlign", "nms", "matrix_nms",
+]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# RoI ops
+# ---------------------------------------------------------------------------
+
+def _bilinear_at(feat, y, x):
+    """feat: (C, H, W); y/x: (...) float coords. Returns (C, ...)."""
+    c, h, w = feat.shape
+    y0 = jnp.clip(jnp.floor(y), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, w - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    wy1 = jnp.clip(y - y0, 0.0, 1.0)
+    wx1 = jnp.clip(x - x0, 0.0, 1.0)
+    wy0 = 1.0 - wy1
+    wx0 = 1.0 - wx1
+    y0i, y1i, x0i, x1i = (v.astype(jnp.int32) for v in (y0, y1, x0, x1))
+    v00 = feat[:, y0i, x0i]
+    v01 = feat[:, y0i, x1i]
+    v10 = feat[:, y1i, x0i]
+    v11 = feat[:, y1i, x1i]
+    return (v00 * (wy0 * wx0) + v01 * (wy0 * wx1)
+            + v10 * (wy1 * wx0) + v11 * (wy1 * wx1))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """reference: vision/ops.py roi_align (Mask R-CNN crop-and-resize).
+    x: (N, C, H, W); boxes: (R, 4) [x1, y1, x2, y2]; boxes_num: (N,)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+
+    def f(feat, bx, bn):
+        # map each roi to its image index from boxes_num
+        img_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                             total_repeat_length=bx.shape[0])
+        off = 0.5 if aligned else 0.0
+        x1 = bx[:, 0] * spatial_scale - off
+        y1 = bx[:, 1] * spatial_scale - off
+        x2 = bx[:, 2] * spatial_scale - off
+        y2 = bx[:, 3] * spatial_scale - off
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / oh
+        bin_w = rw / ow
+        # sample grid: (oh*sr, ow*sr) points per roi
+        gy = (jnp.arange(oh * sr) + 0.5) / sr  # in bin units
+        gx = (jnp.arange(ow * sr) + 0.5) / sr
+
+        def one_roi(i):
+            ys = y1[i] + gy * bin_h[i]              # (oh*sr,)
+            xs = x1[i] + gx * bin_w[i]
+            yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+            vals = _bilinear_at(feat[img_idx[i]], yy, xx)  # (C, oh*sr, ow*sr)
+            c = vals.shape[0]
+            vals = vals.reshape(c, oh, sr, ow, sr)
+            return vals.mean(axis=(2, 4))
+        return jax.vmap(one_roi)(jnp.arange(bx.shape[0]))
+    return execute(f, x, boxes, boxes_num, _name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """reference: vision/ops.py roi_pool (max pooling per bin)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(feat, bx, bn):
+        img_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                             total_repeat_length=bx.shape[0])
+        x1 = jnp.round(bx[:, 0] * spatial_scale)
+        y1 = jnp.round(bx[:, 1] * spatial_scale)
+        x2 = jnp.maximum(jnp.round(bx[:, 2] * spatial_scale), x1 + 1)
+        y2 = jnp.maximum(jnp.round(bx[:, 3] * spatial_scale), y1 + 1)
+        bin_h = (y2 - y1) / oh
+        bin_w = (x2 - x1) / ow
+        h_im, w_im = feat.shape[2], feat.shape[3]
+        # sample spacing <= 1 px even for the largest POSSIBLE bin (the
+        # whole image): every integer pixel of every bin is visited, so the
+        # bin max is exact
+        sr_h = int(np.ceil(h_im / oh)) + 1
+        sr_w = int(np.ceil(w_im / ow)) + 1
+        gy = (jnp.arange(oh * sr_h) + 0.5) / sr_h
+        gx = (jnp.arange(ow * sr_w) + 0.5) / sr_w
+
+        def one_roi(i):
+            # exact-bin max pooling reads INTEGER pixels (nearest), not
+            # bilinear samples — a lone peak must survive exactly
+            ys = jnp.clip(jnp.round(y1[i] + gy * bin_h[i] - 0.5), 0,
+                          h_im - 1).astype(jnp.int32)
+            xs = jnp.clip(jnp.round(x1[i] + gx * bin_w[i] - 0.5), 0,
+                          w_im - 1).astype(jnp.int32)
+            yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+            vals = feat[img_idx[i]][:, yy, xx]
+            c = vals.shape[0]
+            vals = vals.reshape(c, oh, sr_h, ow, sr_w)
+            return vals.max(axis=(2, 4))
+        return jax.vmap(one_roi)(jnp.arange(bx.shape[0]))
+    return execute(f, x, boxes, boxes_num, _name="roi_pool")
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (R-FCN). Channels split into
+    output_size^2 groups; bin (i, j) reads group i*ow+j."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(feat, bx, bn):
+        n, c, h, w = feat.shape
+        assert c % (oh * ow) == 0, "channels must divide output_size^2"
+        cg = c // (oh * ow)
+        pooled = _arr(roi_align(Tensor(feat), Tensor(bx), Tensor(bn),
+                                (oh, ow), spatial_scale, 2, False))
+        # pooled: (R, C, oh, ow) -> pick position-sensitive group per bin
+        r = pooled.shape[0]
+        grouped = pooled.reshape(r, oh * ow, cg, oh, ow)
+        bins = jnp.arange(oh * ow)
+        out = grouped[:, bins, :, bins // ow, bins % ow]  # (oh*ow, R, cg)
+        return jnp.moveaxis(out, 0, -1).reshape(r, cg, oh, ow)
+    return execute(f, x, boxes, boxes_num, _name="psroi_pool")
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+# ---------------------------------------------------------------------------
+# NMS family (host: data-dependent output sizes)
+# ---------------------------------------------------------------------------
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    areas = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    ix1 = np.maximum(x1[:, None], x1[None, :])
+    iy1 = np.maximum(y1[:, None], y1[None, :])
+    ix2 = np.minimum(x2[:, None], x2[None, :])
+    iy2 = np.minimum(y2[:, None], y2[None, :])
+    inter = np.maximum(ix2 - ix1, 0) * np.maximum(iy2 - iy1, 0)
+    union = areas[:, None] + areas[None, :] - inter
+    return inter / np.maximum(union, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Hard NMS. reference: vision/ops.py nms (multiclass via offsets)."""
+    b = np.asarray(_arr(boxes))
+    s = np.asarray(_arr(scores)) if scores is not None else None
+    if category_idxs is not None:
+        # shift boxes per category so classes never suppress each other
+        cat = np.asarray(_arr(category_idxs)).astype(np.int64)
+        offset = (b.max() + 1.0) * cat[:, None]
+        b = b + offset
+    order = np.argsort(-s) if s is not None else np.arange(len(b))
+    iou = _iou_matrix(b)
+    keep = []
+    suppressed = np.zeros(len(b), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        suppressed |= iou[i] > iou_threshold
+        suppressed[i] = True
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2): soft decay by max-IoU instead of hard
+    suppression. reference: vision/ops.py matrix_nms."""
+    bx = np.asarray(_arr(bboxes))
+    sc = np.asarray(_arr(scores))
+    n_img, n_cls = sc.shape[0], sc.shape[1]
+    all_out, all_idx, rois_num = [], [], []
+    for n in range(n_img):
+        dets = []
+        for c in range(n_cls):
+            if c == background_label:
+                continue
+            mask = sc[n, c] > score_threshold
+            idxs = np.nonzero(mask)[0]
+            if idxs.size == 0:
+                continue
+            s_c = sc[n, c, idxs]
+            order = np.argsort(-s_c)[:nms_top_k]
+            idxs = idxs[order]
+            s_c = s_c[order]
+            b_c = bx[n, idxs]
+            iou = _iou_matrix(b_c)
+            iou = np.triu(iou, 1)
+            max_iou = iou.max(axis=0, initial=0.0)  # vs higher-scored
+            # decay_j = min over higher-scored i of f(iou_ij) / f(maxiou_i)
+            # where maxiou_i is box i's own worst overlap with ITS superiors
+            tri = np.triu(np.ones_like(iou, bool), 1)
+            if use_gaussian:
+                comp = np.exp(-(iou ** 2 - max_iou[:, None] ** 2)
+                              / gaussian_sigma)
+            else:
+                comp = (1 - iou) / np.maximum(1 - max_iou[:, None], 1e-10)
+            comp = np.where(tri, comp, 1.0)
+            decay = np.minimum(comp.min(axis=0, initial=1.0), 1.0)
+            s_dec = s_c * decay
+            for j in range(len(idxs)):
+                if s_dec[j] >= post_threshold:
+                    dets.append((c, s_dec[j], *b_c[j], idxs[j]))
+        dets.sort(key=lambda d: -d[1])
+        dets = dets[:keep_top_k]
+        rois_num.append(len(dets))
+        for d in dets:
+            all_out.append(d[:-1])
+            all_idx.append(d[-1])
+    out = Tensor(jnp.asarray(np.asarray(all_out, np.float32).reshape(
+        -1, 2 + bx.shape[-1])))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.asarray(all_idx, np.int64))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(rois_num, np.int64))))
+    return tuple(res) if len(res) > 1 else out
+
+
+# ---------------------------------------------------------------------------
+# deformable conv
+# ---------------------------------------------------------------------------
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (mask => v2). reference: vision/ops.py
+    deform_conv2d. Sampling offsets feed the bilinear gather; the
+    contraction runs as one einsum on the MXU."""
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError(
+            "deform_conv2d: groups/deformable_groups > 1 not supported")
+    args = [x, offset, weight] + ([mask] if mask is not None else []) \
+        + ([bias] if bias is not None else [])
+    has_mask = mask is not None
+    has_bias = bias is not None
+
+    def f(a, off, w, *rest):
+        n, cin, h, wdt = a.shape
+        cout, _, kh, kw = w.shape
+        oh = (h + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        ow = (wdt + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        pad_a = jnp.pad(a, ((0, 0), (0, 0), pd, pd))
+        off = off.reshape(n, kh * kw, 2, oh, ow)
+        off_y = off[:, :, 0]
+        off_x = off[:, :, 1]
+
+        def one(img, oy, ox, *more):
+            k = 0
+            cols = jnp.zeros((kh * kw, cin, oh, ow))
+            for i in range(kh):
+                for j in range(kw):
+                    sy = (jnp.arange(oh) * st[0] + i * dl[0])[:, None] \
+                        + oy[k]
+                    sx = (jnp.arange(ow) * st[1] + j * dl[1])[None, :] \
+                        + ox[k]
+                    v = _bilinear_at(img, sy, sx)           # (cin, oh, ow)
+                    if more:
+                        v = v * more[0][k][None]
+                    cols = cols.at[k].set(v)
+                    k += 1
+            return cols
+        more = ()
+        idx = 0
+        if has_mask:
+            m = rest[idx].reshape(n, kh * kw, oh, ow)
+            idx += 1
+        outs = []
+        for b_i in range(n):
+            margs = (m[b_i],) if has_mask else ()
+            cols = one(pad_a[b_i], off_y[b_i], off_x[b_i], *margs)
+            outs.append(cols)
+        cols = jnp.stack(outs)                              # (n, khkw, cin, oh, ow)
+        w2 = w.reshape(cout, cin, kh * kw)
+        out = jnp.einsum("nkcij,ock->noij", cols, w2)
+        if has_bias:
+            out = out + rest[idx][None, :, None, None]
+        return out
+    return execute(f, *args, _name="deform_conv2d")
+
+
+class DeformConv2D:
+    """Layer wrapper owning weight/bias. reference: vision/ops.py
+    DeformConv2D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        from ..framework.core import Parameter
+        from ..framework.random import next_key
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        fan_in = in_channels * ks[0] * ks[1]
+        bound = float(np.sqrt(6.0 / fan_in))
+        self.weight = Parameter(jax.random.uniform(
+            next_key(), (out_channels, in_channels) + ks, jnp.float32,
+            -bound, bound))
+        self.bias = None if bias_attr is False else Parameter(
+            jnp.zeros((out_channels,), jnp.float32))
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+
+    def __call__(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, self.stride,
+                             self.padding, self.dilation, mask=mask)
+
+
+# ---------------------------------------------------------------------------
+# YOLO / anchors / proposals
+# ---------------------------------------------------------------------------
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head output into boxes+scores.
+    reference: vision/ops.py yolo_box."""
+    na = len(anchors) // 2
+    anc = jnp.asarray(np.asarray(anchors, np.float32).reshape(na, 2))
+
+    def f(feat, imsz):
+        n, c, h, w = feat.shape
+        iou_pred = None
+        if iou_aware:
+            # layout: first na channels are IoU logits, then na*(5+nc)
+            iou_pred = jax.nn.sigmoid(feat[:, :na].reshape(n, na, h, w))
+            feat = feat[:, na:]
+        feat = feat.reshape(n, na, -1, h, w)
+        tx, ty, tw, th = feat[:, :, 0], feat[:, :, 1], feat[:, :, 2], \
+            feat[:, :, 3]
+        obj = jax.nn.sigmoid(feat[:, :, 4])
+        if iou_pred is not None:  # reference: conf = obj^(1-f) * iou^f
+            obj = obj ** (1.0 - iou_aware_factor) * \
+                iou_pred ** iou_aware_factor
+        cls = jax.nn.sigmoid(feat[:, :, 5:5 + class_num])
+        gx = (jax.nn.sigmoid(tx) * scale_x_y - (scale_x_y - 1) / 2
+              + jnp.arange(w)[None, None, None, :]) / w
+        gy = (jax.nn.sigmoid(ty) * scale_x_y - (scale_x_y - 1) / 2
+              + jnp.arange(h)[None, None, :, None]) / h
+        input_h = downsample_ratio * h
+        input_w = downsample_ratio * w
+        bw = jnp.exp(tw) * anc[None, :, None, None, 0] / input_w
+        bh = jnp.exp(th) * anc[None, :, None, None, 1] / input_h
+        im_h = imsz[:, 0].astype(jnp.float32)[:, None, None, None]
+        im_w = imsz[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (gx - bw / 2) * im_w
+        y1 = (gy - bh / 2) * im_h
+        x2 = (gx + bw / 2) * im_w
+        y2 = (gy + bh / 2) * im_h
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, im_w - 1)
+            y1 = jnp.clip(y1, 0, im_h - 1)
+            x2 = jnp.clip(x2, 0, im_w - 1)
+            y2 = jnp.clip(y2, 0, im_h - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+        score = (obj[..., None] * cls.transpose(0, 1, 3, 4, 2)).reshape(
+            n, -1, class_num)
+        keep = (obj.reshape(n, -1) > conf_thresh)[..., None]
+        return boxes * keep, score * keep
+    return execute(f, x, img_size, _name="yolo_box")
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (coordinate + objectness + class).
+    reference: vision/ops.py yolo_loss. Simplified: every gt is matched to
+    its best anchor in `anchor_mask` at the cell containing its center."""
+    na = len(anchor_mask)
+    anc_all = np.asarray(anchors, np.float32).reshape(-1, 2)
+    anc = jnp.asarray(anc_all[np.asarray(anchor_mask)])
+
+    def f(feat, gtb, gtl, *rest):
+        n, c, h, w = feat.shape
+        feat = feat.reshape(n, na, 5 + class_num, h, w)
+        input_size = downsample_ratio * h
+        tx = jax.nn.sigmoid(feat[:, :, 0])
+        ty = jax.nn.sigmoid(feat[:, :, 1])
+        obj_logit = feat[:, :, 4]
+        cls_logit = feat[:, :, 5:]
+        # build targets per gt box (center cell + best anchor by wh IoU)
+        gx = gtb[..., 0] * w
+        gy = gtb[..., 1] * h
+        gw = gtb[..., 2]
+        gh = gtb[..., 3]
+        ci = jnp.clip(gx.astype(jnp.int32), 0, w - 1)
+        cj = jnp.clip(gy.astype(jnp.int32), 0, h - 1)
+        wh = jnp.stack([gw * input_size, gh * input_size], -1)  # pixels
+        inter = jnp.minimum(wh[..., None, 0], anc[None, None, :, 0]) * \
+            jnp.minimum(wh[..., None, 1], anc[None, None, :, 1])
+        union = wh[..., 0:1] * wh[..., 1:2] + anc[None, None, :, 0] \
+            * anc[None, None, :, 1] - inter
+        best_a = jnp.argmax(inter / jnp.maximum(union, 1e-10), -1)
+        valid = (gw > 0) & (gh > 0)
+        bidx = jnp.arange(n)[:, None] * jnp.ones_like(ci)
+        # scatter targets
+        t_obj = jnp.zeros((n, na, h, w))
+        t_obj = t_obj.at[bidx, best_a, cj, ci].max(valid.astype(jnp.float32))
+        sel = (bidx, best_a, cj, ci)
+        lam = valid.astype(jnp.float32)
+        lx = jnp.sum(lam * (tx[sel] - (gx - jnp.floor(gx))) ** 2)
+        ly = jnp.sum(lam * (ty[sel] - (gy - jnp.floor(gy))) ** 2)
+        tw_t = jnp.log(jnp.maximum(gw * input_size, 1e-9)
+                       / jnp.maximum(anc[best_a][..., 0], 1e-9))
+        th_t = jnp.log(jnp.maximum(gh * input_size, 1e-9)
+                       / jnp.maximum(anc[best_a][..., 1], 1e-9))
+        lw = jnp.sum(lam * (feat[:, :, 2][sel] - tw_t) ** 2)
+        lh = jnp.sum(lam * (feat[:, :, 3][sel] - th_t) ** 2)
+        bce = lambda lg, t: jnp.maximum(lg, 0) - lg * t \
+            + jnp.log1p(jnp.exp(-jnp.abs(lg)))
+        lobj = jnp.sum(bce(obj_logit, t_obj))
+        t_cls = jax.nn.one_hot(gtl, class_num)
+        if use_label_smooth:
+            delta = 1.0 / class_num
+            t_cls = t_cls * (1 - delta) + delta / class_num
+        lcls = jnp.sum(lam[..., None]
+                       * bce(jnp.moveaxis(cls_logit, 2, -1)[sel], t_cls))
+        return (lx + ly + lw + lh + lobj + lcls) / n
+    args = [x, gt_box, gt_label] + ([gt_score] if gt_score is not None
+                                    else [])
+    return execute(f, *args, _name="yolo_loss")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) boxes. reference: vision/ops.py prior_box."""
+    def f(feat, img):
+        h, w = feat.shape[2], feat.shape[3]
+        ih, iw = img.shape[2], img.shape[3]
+        sh = steps[1] or ih / h
+        sw = steps[0] or iw / w
+        ars = list(aspect_ratios)
+        if flip:
+            ars = ars + [1.0 / a for a in ars if a != 1.0]
+        boxes = []
+        for ms in min_sizes:
+            boxes.append((ms, ms))
+            if max_sizes:
+                for mx in max_sizes:
+                    s = float(np.sqrt(ms * mx))
+                    boxes.append((s, s))
+            for a in ars:
+                if abs(a - 1.0) < 1e-6:
+                    continue
+                boxes.append((ms * float(np.sqrt(a)),
+                              ms / float(np.sqrt(a))))
+        nb = len(boxes)
+        bw = jnp.asarray([b[0] for b in boxes]) / iw
+        bh = jnp.asarray([b[1] for b in boxes]) / ih
+        cx = (jnp.arange(w) + offset) * sw / iw
+        cy = (jnp.arange(h) + offset) * sh / ih
+        gcx, gcy = jnp.meshgrid(cx, cy)
+        out = jnp.stack([
+            gcx[..., None] - bw / 2, gcy[..., None] - bh / 2,
+            gcx[..., None] + bw / 2, gcy[..., None] + bh / 2], -1)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance), (h, w, nb, 4))
+        return out, var
+    return execute(f, input, image, _name="prior_box")
+
+
+def box_coder(prior_box_t, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    """Encode/decode boxes against priors. reference: vision/ops.py
+    box_coder."""
+    args = [prior_box_t, target_box] + (
+        [prior_box_var] if isinstance(prior_box_var, Tensor) else [])
+    var_const = None if isinstance(prior_box_var, Tensor) else \
+        jnp.asarray(prior_box_var if prior_box_var is not None
+                    else [1.0, 1.0, 1.0, 1.0])
+
+    def f(pb, tb, *rest):
+        var = rest[0] if rest else var_const
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw / 2
+        pcy = pb[:, 1] + ph / 2
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw / 2
+            tcy = tb[:, 1] + th / 2
+            dx = (tcx - pcx) / pw
+            dy = (tcy - pcy) / ph
+            dw = jnp.log(jnp.maximum(tw / pw, 1e-10))
+            dh = jnp.log(jnp.maximum(th / ph, 1e-10))
+            enc = jnp.stack([dx, dy, dw, dh], -1)
+            return enc / var.reshape(-1, 4) if var.ndim else enc / var
+        # decode
+        v = var if var is not None else jnp.ones((4,))
+        d = tb * (v.reshape(-1, 4) if v.ndim > 1 else v)
+        cx = d[..., 0] * pw + pcx
+        cy = d[..., 1] * ph + pcy
+        ww = jnp.exp(d[..., 2]) * pw
+        hh = jnp.exp(d[..., 3]) * ph
+        return jnp.stack([cx - ww / 2, cy - hh / 2,
+                          cx + ww / 2 - norm, cy + hh / 2 - norm], -1)
+    return execute(f, *args, _name="box_coder")
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (FPN paper eq. 1).
+    reference: vision/ops.py distribute_fpn_proposals. Host op (ragged)."""
+    rois = np.asarray(_arr(fpn_rois))
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.maximum(rois[:, 2] - rois[:, 0] + off, 0)
+                    * np.maximum(rois[:, 3] - rois[:, 1] + off, 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, restore = [], []
+    for l in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == l)[0]
+        outs.append(Tensor(jnp.asarray(rois[idx])))
+        restore.extend(idx.tolist())
+    restore_ind = np.empty(len(rois), np.int64)
+    restore_ind[np.asarray(restore, np.int64)] = np.arange(len(rois))
+    result = [outs, Tensor(jnp.asarray(restore_ind.reshape(-1, 1)))]
+    if rois_num is not None:
+        nums = [Tensor(jnp.asarray(np.asarray([len(np.nonzero(lvl == l)[0])],
+                                              np.int32)))
+                for l in range(min_level, max_level + 1)]
+        result.append(nums)
+    return tuple(result)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation: decode anchors, clip, filter, NMS.
+    reference: vision/ops.py generate_proposals. Host op (ragged)."""
+    sc = np.asarray(_arr(scores))
+    bd = np.asarray(_arr(bbox_deltas))
+    im = np.asarray(_arr(img_size))
+    an = np.asarray(_arr(anchors)).reshape(-1, 4)
+    va = np.asarray(_arr(variances)).reshape(-1, 4)
+    n = sc.shape[0]
+    all_rois, all_probs, rois_num = [], [], []
+    for b in range(n):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)
+        d = bd[b].transpose(1, 2, 0).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], an[order], va[order]
+        off = 1.0 if pixel_offset else 0.0
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        ww = np.exp(np.minimum(v[:, 2] * d[:, 2], 10)) * aw
+        hh = np.exp(np.minimum(v[:, 3] * d[:, 3], 10)) * ah
+        boxes = np.stack([cx - ww / 2, cy - hh / 2,
+                          cx + ww / 2 - off, cy + hh / 2 - off], -1)
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, im[b, 1] - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, im[b, 0] - off)
+        keep_sz = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+                   & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s = boxes[keep_sz], s[keep_sz]
+        keep = np.asarray(nms(Tensor(jnp.asarray(boxes)),
+                              iou_threshold=nms_thresh,
+                              scores=Tensor(jnp.asarray(s)))._data)
+        keep = keep[:post_nms_top_n]
+        all_rois.append(boxes[keep])
+        all_probs.append(s[keep])
+        rois_num.append(len(keep))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0)
+                              .astype(np.float32)))
+    probs = Tensor(jnp.asarray(np.concatenate(all_probs, 0)
+                               .astype(np.float32)))
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(np.asarray(rois_num,
+                                                          np.int32)))
+    return rois, probs
+
+
+# ---------------------------------------------------------------------------
+# file IO
+# ---------------------------------------------------------------------------
+
+def read_file(filename, name=None):
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    import io
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise NotImplementedError("decode_jpeg needs PIL") from e
+    raw = bytes(np.asarray(_arr(x)).astype(np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

@@ -486,6 +486,21 @@ class TestReferenceSurfaceGate:
         ("python/paddle/incubate/nn/__init__.py", "paddle_tpu.incubate.nn"),
         ("python/paddle/autograd/__init__.py", "paddle_tpu.autograd"),
         ("python/paddle/jit/__init__.py", "paddle_tpu.jit"),
+        ("python/paddle/vision/ops.py", "paddle_tpu.vision.ops"),
+        ("python/paddle/vision/models/__init__.py",
+         "paddle_tpu.vision.models"),
+        ("python/paddle/vision/transforms/__init__.py",
+         "paddle_tpu.vision.transforms"),
+        ("python/paddle/vision/datasets/__init__.py",
+         "paddle_tpu.vision.datasets"),
+        ("python/paddle/incubate/__init__.py", "paddle_tpu.incubate"),
+        ("python/paddle/nn/initializer/__init__.py",
+         "paddle_tpu.nn.initializer"),
+        ("python/paddle/nn/utils/__init__.py", "paddle_tpu.nn.utils"),
+        ("python/paddle/text/__init__.py", "paddle_tpu.text"),
+        ("python/paddle/audio/__init__.py", "paddle_tpu.audio"),
+        ("python/paddle/utils/__init__.py", "paddle_tpu.utils"),
+        ("python/paddle/optimizer/lr.py", "paddle_tpu.optimizer.lr"),
     ]
 
     @staticmethod

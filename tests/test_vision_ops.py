@@ -1,0 +1,201 @@
+"""Detection/vision ops. reference: python/paddle/vision/ops.py +
+test/legacy_test/test_roi_align_op.py, test_nms_op.py, test_yolo_box_op.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+import paddle_tpu.nn.functional as F
+
+rs = np.random.RandomState(0)
+T = lambda a: paddle.Tensor(a)
+
+
+class TestRoiOps:
+    def test_roi_align_constant_feature(self):
+        feat = np.full((1, 3, 8, 8), 5.0, np.float32)
+        boxes = np.array([[1.0, 1.0, 6.0, 6.0]], np.float32)
+        out = V.roi_align(T(feat), T(boxes), T(np.array([1], np.int32)), 2)
+        np.testing.assert_allclose(out.numpy(), np.full((1, 3, 2, 2), 5.0),
+                                   rtol=1e-5)
+
+    def test_roi_align_gradient_flows(self):
+        feat = paddle.Tensor(rs.randn(1, 2, 8, 8).astype(np.float32),
+                             stop_gradient=False)
+        boxes = T(np.array([[0.0, 0.0, 7.0, 7.0]], np.float32))
+        out = V.roi_align(feat, boxes, T(np.array([1], np.int32)), 4)
+        out.sum().backward()
+        assert feat.grad is not None
+        assert float(np.abs(np.asarray(feat.grad._data)).sum()) > 0
+
+    def test_roi_pool_picks_max(self):
+        feat = np.zeros((1, 1, 8, 8), np.float32)
+        feat[0, 0, 2, 2] = 9.0
+        boxes = np.array([[0.0, 0.0, 4.0, 4.0]], np.float32)
+        out = V.roi_pool(T(feat), T(boxes), T(np.array([1], np.int32)), 1)
+        np.testing.assert_allclose(float(out.numpy().max()), 9.0)
+
+    def test_multi_image_batching(self):
+        feat = np.stack([np.full((1, 6, 6), 1.0), np.full((1, 6, 6), 2.0)]
+                        ).astype(np.float32)
+        boxes = np.array([[0, 0, 5, 5], [0, 0, 5, 5]], np.float32)
+        out = V.roi_align(T(feat), T(boxes), T(np.array([1, 1], np.int32)),
+                          1)
+        np.testing.assert_allclose(out.numpy().reshape(-1), [1.0, 2.0],
+                                   rtol=1e-5)
+
+
+class TestNms:
+    def test_hard_nms(self):
+        b = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                     np.float32)
+        s = np.array([0.9, 0.8, 0.7], np.float32)
+        keep = V.nms(T(b), 0.5, T(s)).numpy()
+        assert keep.tolist() == [0, 2]
+
+    def test_categories_do_not_suppress_each_other(self):
+        b = np.array([[0, 0, 10, 10], [0, 0, 10, 10]], np.float32)
+        s = np.array([0.9, 0.8], np.float32)
+        cat = np.array([0, 1], np.int64)
+        keep = V.nms(T(b), 0.5, T(s), category_idxs=T(cat),
+                     categories=[0, 1]).numpy()
+        assert len(keep) == 2
+
+    def test_matrix_nms_decays_overlaps(self):
+        bboxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11]]], np.float32)
+        scores = np.array([[[0.0, 0.0], [0.9, 0.85]]], np.float32)
+        out, idx, num = V.matrix_nms(T(bboxes), T(scores), 0.1,
+                                     return_index=True)
+        arr = out.numpy()
+        assert arr.shape[1] == 6  # (cls, score, x1, y1, x2, y2)
+        assert arr[1, 1] < 0.85   # the overlapping box's score decayed
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_conv(self):
+        img = rs.randn(1, 2, 6, 6).astype(np.float32)
+        w = rs.randn(4, 2, 3, 3).astype(np.float32)
+        off = np.zeros((1, 18, 4, 4), np.float32)
+        out = V.deform_conv2d(T(img), T(off), T(w))
+        ref = F.conv2d(T(img), T(w))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_mask_scales_contribution(self):
+        img = rs.randn(1, 1, 5, 5).astype(np.float32)
+        w = np.ones((1, 1, 3, 3), np.float32)
+        off = np.zeros((1, 18, 3, 3), np.float32)
+        mask0 = np.zeros((1, 9, 3, 3), np.float32)
+        out = V.deform_conv2d(T(img), T(off), T(w), mask=T(mask0))
+        np.testing.assert_allclose(out.numpy(), 0.0, atol=1e-6)
+
+
+class TestYoloAndBoxes:
+    def test_yolo_box_shapes_and_range(self):
+        x = rs.randn(2, 3 * 7, 4, 4).astype(np.float32)
+        boxes, scores = V.yolo_box(T(x), T(np.array([[64, 64], [64, 64]],
+                                                    np.int32)),
+                                   [10, 14, 23, 27, 37, 58], 2, 0.0)
+        assert list(boxes.shape) == [2, 48, 4]
+        assert list(scores.shape) == [2, 48, 2]
+        b = boxes.numpy()
+        assert b.min() >= 0 and b.max() <= 63.0 + 1e-3
+
+    def test_yolo_loss_finite_and_differentiable(self):
+        x = paddle.Tensor(rs.randn(2, 3 * 7, 4, 4).astype(np.float32) * 0.1,
+                          stop_gradient=False)
+        gtb = np.zeros((2, 3, 4), np.float32)
+        gtb[:, 0] = [0.5, 0.5, 0.3, 0.4]
+        gtl = np.zeros((2, 3), np.int64)
+        loss = V.yolo_loss(x, T(gtb), T(gtl), [10, 14, 23, 27, 37, 58],
+                           [0, 1, 2], 2, 0.7, 16)
+        loss.backward()
+        assert np.isfinite(float(loss)) and x.grad is not None
+
+    def test_box_coder_roundtrip(self):
+        pb = np.array([[0, 0, 10, 10], [5, 5, 20, 20]], np.float32)
+        tb = np.array([[1, 1, 9, 9], [6, 6, 18, 22]], np.float32)
+        enc = V.box_coder(T(pb), [1, 1, 1, 1], T(tb))
+        dec = V.box_coder(T(pb), [1, 1, 1, 1], enc,
+                          code_type="decode_center_size")
+        np.testing.assert_allclose(dec.numpy(), tb, rtol=1e-4, atol=1e-4)
+
+    def test_prior_box_count(self):
+        pbx, pvar = V.prior_box(
+            T(rs.randn(1, 8, 4, 4).astype(np.float32)),
+            T(rs.randn(1, 3, 32, 32).astype(np.float32)),
+            min_sizes=[8.0], aspect_ratios=[1.0, 2.0], flip=True)
+        # 1 min + ar2 + ar0.5 = 3 per cell
+        assert list(pbx.shape) == [4, 4, 3, 4]
+
+    def test_fpn_distribute_restore(self):
+        rois = np.array([[0, 0, 16, 16], [0, 0, 200, 200],
+                         [0, 0, 60, 60]], np.float32)
+        outs, restore = V.distribute_fpn_proposals(T(rois), 2, 5, 4, 224)
+        rebuilt = np.concatenate([o.numpy() for o in outs if o.shape[0]])
+        order = restore.numpy().reshape(-1)
+        np.testing.assert_allclose(rebuilt[order], rois)
+
+    def test_generate_proposals(self):
+        h = w = 4
+        na = 3
+        scores = rs.rand(1, na, h, w).astype(np.float32)
+        deltas = rs.randn(1, na * 4, h, w).astype(np.float32) * 0.1
+        anchors = np.tile(np.array([[0, 0, 15, 15], [0, 0, 31, 31],
+                                    [0, 0, 7, 7]], np.float32),
+                          (h * w, 1)).reshape(-1, 4)
+        var = np.ones_like(anchors)
+        rois, probs, num = V.generate_proposals(
+            T(scores), T(deltas), T(np.array([[64, 64]], np.float32)),
+            T(anchors), T(var), post_nms_top_n=8, return_rois_num=True)
+        assert rois.shape[0] == probs.shape[0] == int(num.numpy()[0])
+        assert rois.shape[0] <= 8
+
+
+class TestReviewRegressions:
+    def test_roi_pool_exact_on_large_bin(self):
+        """A 32x32 RoI pooled to 1x1 must find a lone peak anywhere."""
+        feat = np.zeros((1, 1, 32, 32), np.float32)
+        feat[0, 0, 17, 23] = 9.0
+        boxes = np.array([[0.0, 0.0, 31.0, 31.0]], np.float32)
+        out = V.roi_pool(T(feat), T(boxes), T(np.array([1], np.int32)), 1)
+        np.testing.assert_allclose(float(out.numpy().max()), 9.0)
+
+    def test_yolo_box_iou_aware_layout(self):
+        na, nc, h, w = 3, 2, 4, 4
+        x = rs.randn(1, na * (6 + nc), h, w).astype(np.float32)
+        boxes, scores = V.yolo_box(T(x), T(np.array([[64, 64]], np.int32)),
+                                   [10, 14, 23, 27, 37, 58], nc, 0.0,
+                                   iou_aware=True, iou_aware_factor=0.5)
+        assert list(boxes.shape) == [1, na * h * w, 4]
+        assert np.isfinite(boxes.numpy()).all()
+
+    def test_audio_8bit_wav_roundtrip(self, tmp_path):
+        import paddle_tpu.audio as A
+        wav = (np.sin(np.linspace(0, 20, 400)) * 0.8).astype(np.float32)[None]
+        p = str(tmp_path / "t8.wav")
+        A.save(p, T(wav), 8000, bits_per_sample=8)
+        back, sr = A.load(p)
+        assert sr == 8000
+        err = np.abs(np.asarray(back._data) - wav).max()
+        assert err < 0.02, err  # 8-bit quantization, but centered correctly
+
+    def test_asgd_window_averages_gradients(self):
+        """After k steps with constant grad g, d/n == g; with alternating
+        grads the window mean appears."""
+        import jax.numpy as jnp
+        from paddle_tpu import optimizer
+        opt = optimizer.ASGD(1.0, batch_num=2,
+                             parameters=[paddle.create_parameter([1])])
+        p = jnp.zeros((1,))
+        st = opt.init_state(p)
+        g1 = jnp.asarray([1.0])
+        g2 = jnp.asarray([3.0])
+        p, st = opt.update(p, g1, st, 1.0, 1)   # window {1}: step -1*1
+        np.testing.assert_allclose(np.asarray(p), [-1.0])
+        p, st = opt.update(p, g2, st, 1.0, 2)   # window {1,3}: step -(4/2)
+        np.testing.assert_allclose(np.asarray(p), [-3.0])
+        p, st = opt.update(p, g1, st, 1.0, 3)   # window {3,1}: step -(4/2)
+        np.testing.assert_allclose(np.asarray(p), [-5.0])

@@ -76,3 +76,69 @@ class cuda:
     @staticmethod
     def empty_cache():
         pass
+
+
+# reference-surface predicates/enumeration (python/paddle/device/__init__.py)
+
+class IPUPlace:
+    def __init__(self, *a):
+        raise NotImplementedError("IPU is not a target of this build")
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    # the fusion compiler role is filled by XLA (DESIGN.md)
+    return False
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+def is_compiled_with_custom_device(device_type=None):
+    # PJRT is the pluggable-device layer; jax backends appear here
+    import jax
+    try:
+        custom = {d.platform for d in jax.devices()} - {"cpu", "gpu", "tpu"}
+        if device_type is not None:
+            return device_type in custom
+        return bool(custom)
+    except Exception:
+        return False
+
+
+def get_cudnn_version():
+    return None  # no cuDNN in a TPU build
+
+
+def get_all_device_type():
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return [t for t in get_all_device_type()
+            if t not in ("cpu", "gpu", "tpu")]
+
+
+def get_available_device():
+    import jax
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device()
+            if d.split(":")[0] not in ("cpu", "gpu", "tpu")]
+
+
+def set_stream(stream=None):
+    """reference: device.set_stream — XLA orders work by data dependency;
+    there is no user-visible stream to switch (accepted for parity)."""
+    return stream

@@ -259,6 +259,7 @@ class _Fleet:
 
 
 fleet = _Fleet()
+Fleet = _Fleet  # reference exports the class too
 init = fleet.init
 distributed_model = fleet.distributed_model
 distributed_optimizer = fleet.distributed_optimizer
@@ -266,3 +267,96 @@ worker_num = lambda: fleet.worker_num
 worker_index = fleet.worker_index
 is_first_worker = fleet.is_first_worker
 from . import elastic  # noqa: F401
+
+
+# ---------------------------------------------------------------------------
+# reference-surface: Fleet class, role makers, util (fleet/__init__.py)
+# ---------------------------------------------------------------------------
+
+
+class Role:
+    """reference: fleet/base/role_maker.py Role enum."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class UtilBase:
+    """reference: fleet/utils/fleet_util.py UtilBase — cross-worker helpers
+    on the single-controller runtime."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        return input  # world of the controller is 1
+
+    def barrier(self, comm_world="worker"):
+        return None
+
+    def all_gather(self, input, comm_world="worker"):
+        return [input]
+
+    def get_file_shard(self, files):
+        return list(files)
+
+    def print_on_rank(self, message, rank_id=0):
+        import jax
+        if jax.process_index() == rank_id:
+            print(message)
+
+
+class PaddleCloudRoleMaker:
+    """reference: fleet/base/role_maker.py PaddleCloudRoleMaker — reads the
+    cluster layout from env; collective (non-PS) mode only here."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        if not is_collective:
+            raise NotImplementedError(
+                "parameter-server roles are descoped on TPU (DESIGN.md)")
+        import os
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+    def worker_index(self):
+        return self._rank
+
+    def worker_num(self):
+        return self._size
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def role(self):
+        return Role.WORKER
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, is_collective=True, current_id=0, worker_num=1,
+                 role=Role.WORKER, **kwargs):
+        self._rank = current_id
+        self._size = worker_num
+        self._role = role
+
+    def role(self):
+        return self._role
+
+
+class _DataGeneratorDescoped:
+    """MultiSlot data generators feed the parameter-server data pipeline,
+    descoped on TPU (DESIGN.md) — use paddle_tpu.io datasets/loaders."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            f"{type(self).__name__}: PS data generators are descoped on "
+            "TPU (DESIGN.md); use paddle_tpu.io.Dataset/DataLoader")
+
+
+class MultiSlotDataGenerator(_DataGeneratorDescoped):
+    pass
+
+
+class MultiSlotStringDataGenerator(_DataGeneratorDescoped):
+    pass

@@ -16,7 +16,8 @@ import numpy as np
 
 from ..framework.core import Tensor, execute, _unwrap
 
-__all__ = ["send_u_recv", "send_ue_recv", "send_uv",
+__all__ = [
+    "weighted_sample_neighbors", "reindex_heter_graph","send_u_recv", "send_ue_recv", "send_uv",
            "segment_sum", "segment_mean", "segment_max", "segment_min",
            "sample_neighbors", "reindex_graph"]
 
@@ -163,3 +164,70 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
     dst = np.repeat(np.arange(len(x_np)), cnt_np)
     keys = np.fromiter(mapping.keys(), dtype=x_np.dtype, count=len(mapping))
     return Tensor(reindexed), Tensor(dst.astype(nbr_np.dtype)), Tensor(keys)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weighted neighbor sampling (probability proportional to edge
+    weight). reference: geometric/sampling/neighbors.py
+    weighted_sample_neighbors. Host op (ragged outputs)."""
+    r = np.asarray(_unwrap(row))
+    cp = np.asarray(_unwrap(colptr))
+    wts = np.asarray(_unwrap(edge_weight))
+    nodes = np.asarray(_unwrap(input_nodes))
+    eid_arr = np.asarray(_unwrap(eids)) if eids is not None else None
+    if return_eids and eid_arr is None:
+        raise ValueError("return_eids=True requires eids")
+    rng = np.random.default_rng(np.random.randint(0, 2 ** 31))
+    out_nb, out_cnt, out_eids = [], [], []
+    for nd in nodes.tolist():
+        beg, end = int(cp[nd]), int(cp[nd + 1])
+        idx = np.arange(beg, end)
+        w = wts[beg:end].astype(np.float64)
+        if sample_size > 0 and len(idx) > sample_size:
+            nnz = int((w > 0).sum())
+            if nnz == 0 or nnz < sample_size:
+                # cannot draw sample_size distinct nonzero-weight edges:
+                # take all nonzero first, fill uniformly from the rest
+                order = np.argsort(-w)
+                idx = rng.permutation(idx[order[:sample_size]])                     if nnz == 0 else np.concatenate(
+                        [idx[order[:nnz]],
+                         rng.choice(idx[order[nnz:]],
+                                    size=sample_size - nnz, replace=False)])
+            else:
+                p = w / w.sum()
+                idx = rng.choice(idx, size=sample_size, replace=False, p=p)
+        out_nb.extend(r[idx].tolist())
+        out_cnt.append(len(idx))
+        if return_eids:
+            out_eids.extend(eid_arr[idx].tolist())
+    res = (Tensor(jnp.asarray(np.asarray(out_nb, np.int64))),
+           Tensor(jnp.asarray(np.asarray(out_cnt, np.int64))))
+    if return_eids:
+        res = res + (Tensor(jnp.asarray(np.asarray(out_eids, np.int64))),)
+    return res
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Reindex a heterogeneous graph: same mapping as reindex_graph but
+    neighbors/count come per edge type. reference:
+    geometric/reindex.py reindex_heter_graph."""
+    xs = np.asarray(_unwrap(x))
+    nb_list = [np.asarray(_unwrap(nb)) for nb in neighbors]
+    ct_list = [np.asarray(_unwrap(ct)) for ct in count]
+    uniq = {}
+    for v in xs.tolist():
+        uniq.setdefault(v, len(uniq))
+    for nb in nb_list:
+        for v in nb.tolist():
+            uniq.setdefault(v, len(uniq))
+    re_srcs = [np.asarray([uniq[v] for v in nb.tolist()], np.int64)
+               for nb in nb_list]
+    re_dsts = [np.repeat(np.arange(len(xs), dtype=np.int64), ct)
+               for ct in ct_list]
+    nodes = np.asarray(sorted(uniq, key=uniq.get), np.int64)
+    return (Tensor(jnp.asarray(np.concatenate(re_srcs))),
+            Tensor(jnp.asarray(np.concatenate(re_dsts))),
+            Tensor(jnp.asarray(nodes)))

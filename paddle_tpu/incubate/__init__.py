@@ -243,3 +243,6 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
     return (_Tensor(_jnp.asarray(re_src)), _Tensor(_jnp.asarray(re_dst)),
             _Tensor(_jnp.asarray(nodes)),
             _Tensor(_jnp.asarray(np.asarray([len(re_src)], np.int64))))
+
+
+from ..optimizer import LBFGS  # noqa: F401  (reference: incubate/optimizer)

@@ -289,3 +289,28 @@ class benchmark:
 
     def end(self):
         pass
+
+
+def export_protobuf(profiler_result=None, path="profile.pb"):
+    """reference: profiler.export_protobuf — the TPU-native trace artifact
+    is the chrome-trace/tensorboard dump jax.profiler writes; this exports
+    the collected host events as a length-prefixed binary record file."""
+    import pickle
+    if profiler_result is None:
+        raise ValueError(
+            "export_protobuf needs a profiler result (e.g. a Profiler's "
+            "collected events); got None")
+    events = getattr(profiler_result, "events", None)
+    if events is None:
+        events = profiler_result
+    if callable(events):
+        events = events()
+    # strip unpicklable members (scheduler closures etc.): keep plain data
+    try:
+        data = pickle.dumps(events, protocol=4)
+    except Exception:
+        data = pickle.dumps(repr(events), protocol=4)
+    with open(path, "wb") as f:
+        f.write(len(data).to_bytes(8, "little"))
+        f.write(data)
+    return path

@@ -326,3 +326,21 @@ class PTQ:
         walk(model)
         model.eval()
         return model
+
+
+def quanter(name):
+    """reference: quantization/factory.py quanter — class decorator that
+    registers a quanter under `name` and synthesizes a factory."""
+    def deco(cls):
+        existing = globals().get(name)
+        if existing is not None and existing is not cls:
+            raise ValueError(
+                f"quanter name {name!r} collides with an existing "
+                "paddle_tpu.quantization export; pick another name")
+        globals()[name] = cls
+        _QUANTER_REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+_QUANTER_REGISTRY = {}

@@ -501,6 +501,16 @@ class TestReferenceSurfaceGate:
         ("python/paddle/audio/__init__.py", "paddle_tpu.audio"),
         ("python/paddle/utils/__init__.py", "paddle_tpu.utils"),
         ("python/paddle/optimizer/lr.py", "paddle_tpu.optimizer.lr"),
+        ("python/paddle/distributed/fleet/__init__.py",
+         "paddle_tpu.distributed.fleet"),
+        ("python/paddle/device/__init__.py", "paddle_tpu.device"),
+        ("python/paddle/profiler/__init__.py", "paddle_tpu.profiler"),
+        ("python/paddle/quantization/__init__.py",
+         "paddle_tpu.quantization"),
+        ("python/paddle/geometric/__init__.py", "paddle_tpu.geometric"),
+        ("python/paddle/regularizer.py", "paddle_tpu.regularizer"),
+        ("python/paddle/hub.py", "paddle_tpu.hub"),
+        ("python/paddle/sysconfig.py", "paddle_tpu.sysconfig"),
     ]
 
     @staticmethod

@@ -102,11 +102,40 @@ def is_compiled_with_distribute():
     return True
 
 
+def _all_devices():
+    """Devices across EVERY initialized PJRT backend, not just the default
+    one (jax.devices() alone hides cpu on accelerator hosts and any custom
+    plugin a built-in backend outranks)."""
+    import jax
+    devs = []
+    seen_platforms = set()
+    try:
+        backends = jax._src.xla_bridge.backends()  # plugin registry
+    except Exception:
+        backends = {}
+    for name in list(backends) or []:
+        try:
+            for d in jax.devices(name):
+                if d.platform not in seen_platforms or name == d.platform:
+                    devs.append(d)
+            seen_platforms.update(d.platform for d in jax.devices(name))
+        except Exception:
+            continue
+    if not devs:  # registry unavailable: default backend + cpu
+        devs = list(jax.devices())
+        try:
+            devs += [d for d in jax.devices("cpu")
+                     if d.platform not in {x.platform for x in devs}]
+        except Exception:
+            pass
+    return devs
+
+
 def is_compiled_with_custom_device(device_type=None):
     # PJRT is the pluggable-device layer; jax backends appear here
-    import jax
     try:
-        custom = {d.platform for d in jax.devices()} - {"cpu", "gpu", "tpu"}
+        custom = ({d.platform for d in _all_devices()}
+                  - {"cpu", "gpu", "cuda", "rocm", "tpu"})
         if device_type is not None:
             return device_type in custom
         return bool(custom)
@@ -119,23 +148,21 @@ def get_cudnn_version():
 
 
 def get_all_device_type():
-    import jax
-    return sorted({d.platform for d in jax.devices()})
+    return sorted({d.platform for d in _all_devices()})
 
 
 def get_all_custom_device_type():
     return [t for t in get_all_device_type()
-            if t not in ("cpu", "gpu", "tpu")]
+            if t not in ("cpu", "gpu", "cuda", "rocm", "tpu")]
 
 
 def get_available_device():
-    import jax
-    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+    return [f"{d.platform}:{d.id}" for d in _all_devices()]
 
 
 def get_available_custom_device():
     return [d for d in get_available_device()
-            if d.split(":")[0] not in ("cpu", "gpu", "tpu")]
+            if d.split(":")[0] not in ("cpu", "gpu", "cuda", "rocm", "tpu")]
 
 
 def set_stream(stream=None):

@@ -343,6 +343,12 @@ class UserDefinedRoleMaker(PaddleCloudRoleMaker):
     def role(self):
         return self._role
 
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
 
 class _DataGeneratorDescoped:
     """MultiSlot data generators feed the parameter-server data pipeline,

@@ -66,3 +66,6 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
     for i in range(0, n, seg):
         out = recompute(run_segment(funcs[i:i + seg]), out)
     return out
+
+
+from . import sequence_parallel_utils  # noqa: E402,F401

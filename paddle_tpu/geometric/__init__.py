@@ -187,14 +187,20 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
         w = wts[beg:end].astype(np.float64)
         if sample_size > 0 and len(idx) > sample_size:
             nnz = int((w > 0).sum())
-            if nnz == 0 or nnz < sample_size:
-                # cannot draw sample_size distinct nonzero-weight edges:
-                # take all nonzero first, fill uniformly from the rest
+            if nnz == 0:
+                # all weights zero: no edge has positive probability, but a
+                # sampler that returns nothing starves the caller — fall
+                # back to a UNIFORM draw (not the first-k edges)
+                idx = rng.choice(idx, size=sample_size, replace=False)
+            elif nnz < sample_size:
+                # take every positive-weight edge, then fill the remainder
+                # uniformly from the zero-weight edges (one policy for both
+                # degenerate branches: zero-weight edges are uniform filler)
                 order = np.argsort(-w)
-                idx = rng.permutation(idx[order[:sample_size]])                     if nnz == 0 else np.concatenate(
-                        [idx[order[:nnz]],
-                         rng.choice(idx[order[nnz:]],
-                                    size=sample_size - nnz, replace=False)])
+                fill = rng.choice(idx[order[nnz:]], size=sample_size - nnz,
+                                  replace=False)
+                idx = rng.permutation(np.concatenate([idx[order[:nnz]],
+                                                      fill]))
             else:
                 p = w / w.sum()
                 idx = rng.choice(idx, size=sample_size, replace=False, p=p)

@@ -15,6 +15,7 @@ import jax.numpy as _jnp
 
 from ..framework.core import Tensor as _Tensor, execute as _execute
 from . import autograd  # noqa: F401
+from . import autotune  # noqa: F401
 from .. import inference  # noqa: F401
 
 

@@ -1,0 +1,57 @@
+"""paddle.incubate.autotune — the user-facing autotune knob.
+
+reference: python/paddle/incubate/autotune.py set_config — accepts
+{"kernel": {"enable": bool, "tuning_range": [...]}, "layout": {...},
+"dataloader": {...}} (a dict or a JSON file path).
+
+TPU-native: "kernel" toggles the Pallas block autotuner
+(ops/pallas/autotune.py). "layout" tuning is XLA's layout assignment
+(always on — accepted, recorded, no-op). "dataloader" num-workers tuning
+maps onto io.DataLoader's worker pool (recorded for DataLoader to read).
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..ops.pallas import autotune as _kernel_autotune
+
+__all__ = ["set_config"]
+
+_config = {"kernel": {"enable": False}, "layout": {"enable": False},
+           "dataloader": {"enable": False}}
+
+
+def set_config(config=None):
+    global _config
+    if config is None:
+        _kernel_autotune.enable_autotune()
+        _config = {k: {"enable": True} for k in _config}
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise TypeError("set_config expects a dict, a JSON file path, or None")
+    for key, val in config.items():
+        if key not in _config:
+            raise ValueError(f"unknown autotune section {key!r} "
+                             "(expected kernel/layout/dataloader)")
+        _config[key].update(val)  # merge: partial configs keep prior keys
+    # flip the kernel switch only when this call carries an explicit
+    # kernel.enable — section-absent or enable-absent configs must not
+    # clobber a switch set out-of-band (FLAGS_use_autotune / prior call)
+    if "enable" in config.get("kernel", {}):
+        if _config["kernel"]["enable"]:
+            _kernel_autotune.enable_autotune()
+        else:
+            _kernel_autotune.disable_autotune()
+
+
+def get_config():
+    return {k: dict(v) for k, v in _config.items()}
+
+
+def status():
+    """Kernel-cache statistics (reference: AutoTuneStatus)."""
+    return _kernel_autotune.autotune_status()

@@ -59,11 +59,13 @@ class StaticFunction:
     python/paddle/jit/dy2static/program_translator.py:377 StaticFunction."""
 
     def __init__(self, fn, input_spec=None, build_strategy=None,
-                 full_graph=True, layer=None):
+                 full_graph=False, layer=None):
         self._fn = fn
         self._layer = layer
         self._input_spec = input_spec
+        self._full_graph = full_graph
         self._cache: dict[Any, tuple] = {}
+        self._fallback_keys: set = set()
         functools.wraps(fn)(self)
 
     # -- discovery ----------------------------------------------------------
@@ -99,9 +101,30 @@ class StaticFunction:
 
         key = (self._signature(in_arrays, params, bufs), treedef,
                tuple((i, repr(a)) for i, a in enumerate(static_rest) if a is not None))
+        if key in self._fallback_keys:  # known graph break: stay eager
+            return self._fn(*args, **kwargs)
         entry = self._cache.get(key)
         if entry is None:
-            entry = self._trace(treedef, flat_args, tensor_idx, params, bufs)
+            try:
+                entry = self._trace(treedef, flat_args, tensor_idx, params,
+                                    bufs)
+            except jax.errors.ConcretizationTypeError as e:
+                # Data-dependent Python control flow reached trace time. The
+                # reference's SOT breaks the graph and runs the fragment
+                # eagerly (sot/translate.py:31, graph-break fallback);
+                # full_graph=True keeps the reference's hard-error contract
+                # (use static.nn.cond/while_loop instead).
+                if self._full_graph:
+                    raise
+                import warnings
+                warnings.warn(
+                    f"to_static: graph break in {getattr(self._fn, '__name__', self._fn)!r} "
+                    f"(data-dependent control flow); running this input "
+                    f"signature eagerly. Use paddle_tpu.static.nn.cond/"
+                    f"while_loop or full_graph=True to make this an error.\n"
+                    f"  cause: {e}", RuntimeWarning, stacklevel=2)
+                self._fallback_keys.add(key)
+                return self._fn(*args, **kwargs)
             self._cache[key] = entry
         jitted, out_rebuild, mutated = entry
 
@@ -204,8 +227,11 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, **kwargs):
-    """reference: python/paddle/jit/api.py:195."""
+              backend=None, full_graph=False, **kwargs):
+    """reference: python/paddle/jit/api.py:195. Default full_graph=False
+    matches the reference's SOT mode: trace failures from data-dependent
+    Python control flow fall back to eager for that input signature (graph
+    break) instead of raising; full_graph=True restores the hard error."""
 
     def decorate(fn):
         from ..nn import Layer

@@ -224,6 +224,51 @@ def _block_sizes(sq, sk, block_q, block_k):
     return min(block_q, sq), min(block_k, sk)
 
 
+# candidate (block_q, block_k) VMEM tilings for the autotuner — the TPU
+# analog of the reference's per-algorithm candidate list (auto_tune_base.h)
+_BLOCK_CANDIDATES = ((128, 128), (256, 128), (128, 256), (256, 256),
+                     (512, 128), (128, 512))
+
+
+def _tuned_blocks(kind, bh, sq, sk, d, dtype, causal, interpret):
+    """Resolve (block_q, block_k): default (128, 128), or the timed winner
+    when FLAGS_use_autotune is on. Timing runs on synthetic zeros, so this
+    works even while the caller is being traced."""
+    from .autotune import autotune, autotune_enabled
+    if not autotune_enabled():
+        return 128, 128
+    dev = jax.devices()[0]
+    key = (kind, sq, sk, d, str(dtype), bool(causal), dev.device_kind)
+
+    def make_runner(cfg):
+        bq, bk = cfg
+        if bq > sq or bk > sk:
+            raise ValueError("block larger than sequence")
+        q = jnp.zeros((min(bh, 2), sq, d), dtype)
+        k = jnp.zeros((min(bh, 2), sk, d), dtype)
+        v = jnp.zeros((min(bh, 2), sk, d), dtype)
+        if kind == "fwd":
+            def run():
+                o, lse = _flash_fwd_bhsd(q, k, v, causal, 1.0, block_q=bq,
+                                         block_k=bk, interpret=interpret)
+                jax.block_until_ready(o)
+        else:
+            # run the forward once OUTSIDE the timed closure so the 'bwd'
+            # key times only the backward kernels
+            o, lse = _flash_fwd_bhsd(q, k, v, causal, 1.0, block_q=bq,
+                                     block_k=bk, interpret=interpret)
+            jax.block_until_ready(o)
+
+            def run():
+                outs = _flash_bwd_bhsd(q, k, v, o, lse, o, causal, 1.0,
+                                       block_q=bq, block_k=bk,
+                                       interpret=interpret)
+                jax.block_until_ready(outs)
+        return run
+
+    return autotune(key, _BLOCK_CANDIDATES, make_runner, default=(128, 128))
+
+
 def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=128, block_k=128,
                     interpret=None):
     """q/k/v: (BH, S, D) -> (out (BH, Sq, D), lse (BH, Sq_padded) f32).
@@ -359,20 +404,36 @@ def _xla_attention_bhsd(q, k, v, causal, scale):
     return jnp.einsum("bqk,bkd->bqd", p, v)
 
 
+def _fwd_blocks(q, k, causal):
+    bh, sq, d = q.shape
+    return _tuned_blocks("fwd", bh, sq, k.shape[1], d, q.dtype, causal,
+                         _interpret_default())
+
+
+def _bwd_blocks(q, k, causal):
+    bh, sq, d = q.shape
+    return _tuned_blocks("bwd", bh, sq, k.shape[1], d, q.dtype, causal,
+                         _interpret_default())
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_attention_bhsd(q, k, v, causal, scale):
-    out, _ = _flash_fwd_bhsd(q, k, v, causal, scale)
+    bq, bk = _fwd_blocks(q, k, causal)
+    out, _ = _flash_fwd_bhsd(q, k, v, causal, scale, block_q=bq, block_k=bk)
     return out
 
 
 def _fa_fwd(q, k, v, causal, scale):
-    out, lse = _flash_fwd_bhsd(q, k, v, causal, scale)
+    bq, bk = _fwd_blocks(q, k, causal)
+    out, lse = _flash_fwd_bhsd(q, k, v, causal, scale, block_q=bq, block_k=bk)
     return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, scale, res, g):
     q, k, v, o, lse = res
-    return _flash_bwd_bhsd(q, k, v, o, lse, g, causal, scale)
+    bq, bk = _bwd_blocks(q, k, causal)
+    return _flash_bwd_bhsd(q, k, v, o, lse, g, causal, scale,
+                           block_q=bq, block_k=bk)
 
 
 _flash_attention_bhsd.defvjp(_fa_fwd, _fa_bwd)

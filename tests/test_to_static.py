@@ -1,0 +1,108 @@
+"""jit.to_static: trace-compile, cache, mutation, and graph-break fallback.
+
+reference: python/paddle/jit/api.py:195 to_static; SOT graph-break fallback
+(jit/sot/translate.py:31); StaticFunction cache
+(dy2static/program_translator.py:377).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _mlp():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def test_to_static_matches_eager():
+    model = _mlp()
+    x = paddle.Tensor(jnp.asarray(
+        np.random.RandomState(0).randn(4, 8), jnp.float32))
+    eager = np.asarray(model(x)._data)
+    smodel = paddle.jit.to_static(model)
+    out = smodel(x)
+    np.testing.assert_allclose(np.asarray(out._data), eager,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_backward_matches_eager():
+    model = _mlp()
+    x = paddle.Tensor(jnp.asarray(
+        np.random.RandomState(1).randn(4, 8), jnp.float32))
+
+    loss_e = model(x).mean()
+    loss_e.backward()
+    ref_grads = {k: np.asarray(p.grad._data)
+                 for k, p in model.named_parameters()}
+    for p in model.parameters():
+        p.clear_grad()
+
+    smodel = paddle.jit.to_static(model)
+    loss_s = smodel(x).mean()
+    loss_s.backward()
+    for k, p in model.named_parameters():
+        np.testing.assert_allclose(np.asarray(p.grad._data), ref_grads[k],
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_to_static_cache_reuse_and_shape_polymorphism():
+    model = _mlp()
+    sf = paddle.jit.to_static(model.forward)
+    x4 = paddle.Tensor(jnp.ones((4, 8), jnp.float32))
+    x2 = paddle.Tensor(jnp.ones((2, 8), jnp.float32))
+    sf(x4)
+    assert len(sf._cache) == 1
+    sf(x4)
+    assert len(sf._cache) == 1  # same signature: cache hit
+    sf(x2)
+    assert len(sf._cache) == 2  # new shape: new program
+
+
+def test_graph_break_falls_back_to_eager():
+    """Data-dependent Python branch: full_graph=False (the default, matching
+    the reference's SOT mode) must warn + run eagerly, not raise."""
+
+    def fn(x):
+        if float(x.sum()) > 0:  # concretizes a tracer
+            return x * 2
+        return x - 1
+
+    sf = paddle.jit.to_static(fn)
+    x = paddle.Tensor(jnp.ones((3,), jnp.float32))
+    with pytest.warns(RuntimeWarning, match="graph break"):
+        out = sf(x)
+    np.testing.assert_allclose(np.asarray(out._data), 2 * np.ones(3))
+    # second call with the same signature: silent eager fallback
+    out2 = sf(paddle.Tensor(-jnp.ones((3,), jnp.float32)))
+    np.testing.assert_allclose(np.asarray(out2._data), -2 * np.ones(3))
+
+
+def test_full_graph_true_raises_on_break():
+    import jax
+
+    def fn(x):
+        if float(x.sum()) > 0:
+            return x * 2
+        return x - 1
+
+    sf = paddle.jit.to_static(fn, full_graph=True)
+    with pytest.raises(jax.errors.ConcretizationTypeError):
+        sf(paddle.Tensor(jnp.ones((3,), jnp.float32)))
+
+
+def test_enable_to_static_toggle():
+    model = _mlp()
+    sf = paddle.jit.to_static(model.forward)
+    paddle.jit.enable_to_static(False)
+    try:
+        x = paddle.Tensor(jnp.ones((2, 8), jnp.float32))
+        out = sf(x)
+        assert len(sf._cache) == 0  # ran eagerly, nothing compiled
+        assert tuple(out.shape) == (2, 4)
+    finally:
+        paddle.jit.enable_to_static(True)

@@ -272,7 +272,14 @@ def _attempt(args, timeout_s):
                 obj = json.loads(line)
                 if "metric" in obj and "error" not in obj:
                     return obj, None
-                return None, obj.get("error", "worker json without metric")
+                err = obj.get("error", "worker json without metric")
+                # keep the per-config failure messages — "all ladder configs
+                # failed" alone hides the actual compile errors (r2/r3
+                # post-mortem pain)
+                detail_errs = (obj.get("detail") or {}).get("errors")
+                if detail_errs:
+                    err = f"{err}: " + " ;; ".join(detail_errs)[:600]
+                return None, err
             except json.JSONDecodeError:
                 continue
     tail = (p.stderr or p.stdout or "").strip().splitlines()[-3:]
@@ -292,13 +299,15 @@ def main():
     # fast liveness probe first: when the TPU tunnel is down, every config
     # would burn its full timeout — detect that in minutes instead
     tpu_alive = False
-    for i in range(2):
+    for i in range(3):
         result, err = _attempt(["--probe"], 300)
         if result is not None:
             tpu_alive = result.get("unit") == "tpu_alive"
             break
         errors.append(f"probe{i}: {err}")
-        time.sleep(60)
+        # a wedged device lease (killed worker still holding the chip)
+        # expires on a minutes scale — wait longer each round
+        time.sleep(60 * (i + 1))
 
     # one subprocess PER ladder config so a slow/hung compile on a big
     # config can't eat the whole budget before smaller configs get a turn

@@ -117,7 +117,12 @@ from . import onnx  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402
 from .hapi.flops import flops  # noqa: F401,E402
 from .distributed.parallel import DataParallel  # noqa: F401,E402
-from . import linalg  # noqa: F401,E402
+# `from .tensor import *` above bound the name `linalg` to the tensor
+# SUBMODULE, and `from . import linalg` would keep that binding (the import
+# system only falls back to loading package.linalg when the attribute is
+# absent) — import the top-level namespace module explicitly and rebind.
+import importlib as _importlib  # noqa: E402
+linalg = _importlib.import_module(".linalg", __name__)
 
 
 def batch(reader, batch_size, drop_last=False):
@@ -166,6 +171,9 @@ def create_parameter(shape, dtype="float32", name=None, attr=None,
     t = Parameter(_jnp.zeros(tuple(shape), _dt.convert_dtype(dtype)))
     XavierUniform()(t)
     return t
+
+
+Tensor.create_parameter = staticmethod(create_parameter)  # method parity
 
 
 def set_printoptions(precision=None, threshold=None, edgeitems=None,

@@ -114,9 +114,11 @@ def scan(body_fn, init, xs, name=None):
     return execute(f, init, xs, _name="scan")
 
 
-class nn:
-    cond = staticmethod(cond)
-    while_loop = staticmethod(while_loop)
+from . import nn_builders as nn  # noqa: E402  (static-graph layer builders)
+nn.cond = cond
+nn.while_loop = while_loop
+import sys as _sys  # noqa: E402
+_sys.modules[__name__ + ".nn"] = nn  # importable as paddle_tpu.static.nn
 
 
 # ---------------------------------------------------------------------------

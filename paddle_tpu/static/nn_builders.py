@@ -1,0 +1,451 @@
+"""paddle.static.nn — static-graph layer builders.
+
+reference: python/paddle/static/nn/__init__.py (30 symbols; builders defined
+in static/nn/common.py — fc:30, conv2d, batch_norm, embedding, nce, ... —
+plus control_flow.py case/switch_case and static_pylayer.py).
+
+TPU-native: the reference's builders append ops + fresh parameters to the
+global Program. Here the program IS the traced jaxpr, so each builder is a
+define-and-run call: it creates the parameters (respecting
+param_attr/bias_attr via nn.Layer.create_parameter) and applies the op
+immediately. Under jit.to_static the call is traced like any eager code.
+LoD sequence ops (sequence_conv/pool/expand/softmax/first/last_step),
+sparse_embedding and nce serve the legacy LoD/parameter-server pipeline —
+descoped on TPU (DESIGN.md ledger) with guided errors.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, execute
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.layers import Layer
+
+
+def _act(out, activation):
+    if activation is None:
+        return out
+    fn = getattr(F, activation, None)
+    if fn is None:
+        raise ValueError(f"unknown activation {activation!r}")
+    return fn(out)
+
+
+class _ParamFactory(Layer):
+    """One throwaway Layer per builder call: reuses nn's initializer /
+    weight-attr machinery for parameter creation."""
+
+    def make(self, shape, attr=None, is_bias=False, default=None):
+        return self.create_parameter(
+            shape, attr=attr, is_bias=is_bias,
+            default_initializer=default)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """reference: static/nn/common.py fc — flatten trailing dims, linear,
+    optional activation."""
+    pf = _ParamFactory()
+    xs = tuple(x.shape)
+    if num_flatten_dims < 0:
+        num_flatten_dims = len(xs) + num_flatten_dims
+    in_features = 1
+    for d in xs[num_flatten_dims:]:
+        in_features *= int(d)
+    w = pf.make((in_features, size), attr=weight_attr)
+    b = pf.make((size,), attr=bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+
+    def f(a, wt, *bias):
+        flat = a.reshape(a.shape[:num_flatten_dims] + (in_features,))
+        out = flat @ wt
+        if bias:
+            out = out + bias[0]
+        return out
+
+    args = (x, w) + ((b,) if b is not None else ())
+    return _act(execute(f, *args, _name="static_fc"), activation)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32", name=None):
+    """reference: static/nn/common.py embedding."""
+    pf = _ParamFactory()
+    w = pf.make(tuple(size), attr=param_attr)
+    return F.embedding(input, w, padding_idx=padding_idx, sparse=is_sparse)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    """reference: static/nn/common.py conv2d."""
+    pf = _ParamFactory()
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    cin = int(input.shape[1 if data_format == "NCHW" else -1])
+    w = pf.make((num_filters, cin // groups) + tuple(ks), attr=param_attr)
+    b = pf.make((num_filters,), attr=bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+    out = F.conv2d(input, w, bias=b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, data_format=data_format)
+    return _act(out, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    pf = _ParamFactory()
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size,) * 3
+    cin = int(input.shape[1 if data_format == "NCDHW" else -1])
+    w = pf.make((num_filters, cin // groups) + tuple(ks), attr=param_attr)
+    b = pf.make((num_filters,), attr=bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+    out = F.conv3d(input, w, bias=b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, data_format=data_format)
+    return _act(out, act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    pf = _ParamFactory()
+    if filter_size is None:
+        raise ValueError("filter_size is required (output_size-only "
+                         "inference is not supported)")
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    cin = int(input.shape[1 if data_format == "NCHW" else -1])
+    w = pf.make((cin, num_filters // groups) + tuple(ks), attr=param_attr)
+    b = pf.make((num_filters,), attr=bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+    out = F.conv2d_transpose(input, w, bias=b, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             output_size=output_size, data_format=data_format)
+    return _act(out, act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    pf = _ParamFactory()
+    if filter_size is None:
+        raise ValueError("filter_size is required")
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size,) * 3
+    cin = int(input.shape[1 if data_format == "NCDHW" else -1])
+    w = pf.make((cin, num_filters // groups) + tuple(ks), attr=param_attr)
+    b = pf.make((num_filters,), attr=bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+    out = F.conv3d_transpose(input, w, bias=b, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             output_size=output_size, data_format=data_format)
+    return _act(out, act)
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    """reference: static/nn/common.py deformable_conv — delegates to the
+    vision op (modulated when mask is given)."""
+    from ..vision.ops import deform_conv2d as _dc
+    pf = _ParamFactory()
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    cin = int(input.shape[1])
+    w = pf.make((num_filters, cin // groups) + tuple(ks), attr=param_attr)
+    b = pf.make((num_filters,), attr=bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+    return _dc(input, offset, w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=mask)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    """reference: static/nn/common.py batch_norm. Creates scale/bias +
+    moving stats and applies the normalization in one call."""
+    pf = _ParamFactory()
+    c = int(input.shape[1 if data_layout == "NCHW" else -1])
+    scale = pf.make((c,), attr=param_attr, default=I.Constant(1.0))
+    bias = pf.make((c,), attr=bias_attr, is_bias=True)
+    mean = Tensor(jnp.zeros((c,), jnp.float32), stop_gradient=True)
+    var = Tensor(jnp.ones((c,), jnp.float32), stop_gradient=True)
+    out = F.batch_norm(input, mean, var, weight=scale, bias=bias,
+                       training=not (is_test or use_global_stats),
+                       momentum=momentum, epsilon=epsilon,
+                       data_format=data_layout)
+    return _act(out, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    pf = _ParamFactory()
+    shape = tuple(int(s) for s in input.shape[begin_norm_axis:])
+    w = pf.make(shape, attr=param_attr, default=I.Constant(1.0)) \
+        if scale else None
+    b = pf.make(shape, attr=bias_attr, is_bias=True) if shift else None
+    out = F.layer_norm(input, shape, weight=w, bias=b, epsilon=epsilon)
+    return _act(out, act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    pf = _ParamFactory()
+    c = int(input.shape[1 if data_layout == "NCHW" else -1])
+    w = pf.make((c,), attr=param_attr, default=I.Constant(1.0))
+    b = pf.make((c,), attr=bias_attr, is_bias=True)
+    out = F.group_norm(input, groups, epsilon=epsilon, weight=w, bias=b,
+                       data_format=data_layout)
+    return _act(out, act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    pf = _ParamFactory()
+    c = int(input.shape[1])
+    w = pf.make((c,), attr=param_attr, default=I.Constant(1.0)) \
+        if param_attr is not False else None
+    b = pf.make((c,), attr=bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              summary_decay_rate=0.9999999, sync_stats=False,
+              enable_scale_and_shift=False):
+    """reference: static/nn/common.py data_norm — normalization by running
+    batch statistics without learned affine (unless enabled)."""
+    def f(a):
+        mean = jnp.mean(a, axis=0, keepdims=True)
+        var = jnp.var(a, axis=0, keepdims=True)
+        return (a - mean) / jnp.sqrt(var + epsilon)
+    out = execute(f, input, _name="data_norm")
+    return _act(out, act)
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    """reference: static/nn/common.py prelu — modes all/channel/element."""
+    pf = _ParamFactory()
+    if mode == "all":
+        shape = (1,)
+    elif mode == "channel":
+        shape = (int(x.shape[1 if data_format == "NCHW" else -1]),)
+    elif mode == "element":
+        shape = tuple(int(s) for s in x.shape[1:])
+    else:
+        raise ValueError(f"prelu mode must be all/channel/element, got {mode}")
+    w = pf.make(shape, attr=param_attr, default=I.Constant(0.25))
+    if mode == "channel":
+        return F.prelu(x, w, data_format=data_format)
+    if mode == "element":
+        def f(a, wt):
+            return jnp.where(a > 0, a, a * wt[None])  # (1, *x.shape[1:])
+        return execute(f, x, w, _name="static_prelu")
+    return F.prelu(x, w)  # mode == "all": scalar weight broadcasts
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """reference: static/nn/common.py bilinear_tensor_product:
+    out_k = x W_k y^T + b."""
+    pf = _ParamFactory()
+    dx, dy = int(x.shape[1]), int(y.shape[1])
+    w = pf.make((size, dx, dy), attr=param_attr)
+    b = pf.make((size,), attr=bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+
+    def f(a, c, wt, *bias):
+        out = jnp.einsum("bi,kij,bj->bk", a, wt, c)
+        if bias:
+            out = out + bias[0]
+        return out
+
+    args = (x, y, w) + ((b,) if b is not None else ())
+    return _act(execute(f, *args, _name="bilinear_tensor_product"), act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference: static/nn/common.py spectral_norm — normalize a weight by
+    its largest singular value via power iteration (stateless: iterations
+    run from a fixed start each call, matching the functional contract)."""
+    def f(w):
+        import jax
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((wm.shape[0],), w.dtype) / (wm.shape[0] ** 0.5)
+        for _ in range(max(power_iters, 1)):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ wm @ v
+        return w / sigma
+    return execute(f, weight, _name="spectral_norm")
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """reference: static/nn/common.py row_conv (lookahead convolution,
+    Deep Speech 2): out[t] = sum_{i=0..k} in[t+i] * w[i]."""
+    pf = _ParamFactory()
+    k = future_context_size
+    d = int(input.shape[-1])
+    w = pf.make((k + 1, d), attr=param_attr)
+
+    def f(a, wt):
+        outs = jnp.zeros_like(a)
+        T = a.shape[1]
+        for i in range(k + 1):
+            seg = a[:, i:, :]
+            outs = outs.at[:, :T - i, :].add(seg * wt[i])
+        return outs
+
+    return _act(execute(f, input, w, _name="row_conv"), act)
+
+
+# -- control flow -----------------------------------------------------------
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference: static/nn/control_flow.py case — first true predicate
+    wins; chained lax.cond under trace."""
+    from . import cond as _cond
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+
+    def build(pairs):
+        (pred, fn) = pairs[0]
+        rest = pairs[1:]
+        if not rest:
+            if default is None:
+                return fn()
+            return _cond(pred, fn, default)
+        return _cond(pred, fn, lambda: build(rest))
+
+    return build(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference: static/nn/control_flow.py switch_case."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    import jax
+
+    def f(idx):
+        fns = [fn for _, fn in items]
+        keys = jnp.asarray([k for k, _ in items])
+        pos = jnp.argmax(keys == idx)
+        valid = jnp.any(keys == idx)
+        branches = [lambda _, fn=fn: _untensor(fn()) for fn in fns]
+        if default is not None:
+            branches.append(lambda _: _untensor(default()))
+            pos = jnp.where(valid, pos, len(fns))
+        else:
+            # reference contract: no match and no default -> the branch
+            # with the MAX key runs (control_flow.py switch_case docs)
+            pos = jnp.where(valid, pos, len(fns) - 1)
+        return jax.lax.switch(pos, branches, None)
+
+    idx = branch_index._data if isinstance(branch_index, Tensor) \
+        else jnp.asarray(branch_index)
+    return Tensor(f(idx))
+
+
+def _untensor(v):
+    return v._data if isinstance(v, Tensor) else jnp.asarray(v)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference: static/nn/common.py py_func — run arbitrary python in the
+    graph. Under trace this uses jax.pure_callback with the declared `out`
+    shape/dtype; eagerly it just calls func."""
+    import jax
+    import numpy as np
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    arrs = [t._data if isinstance(t, Tensor) else jnp.asarray(t) for t in xs]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    specs = [jax.ShapeDtypeStruct(tuple(o.shape), _np_dtype(o)) for o in outs]
+
+    def host(*a):
+        r = func(*[np.asarray(v) for v in a])
+        rs = r if isinstance(r, (list, tuple)) else [r]
+        return tuple(np.asarray(v) for v in rs)
+
+    res = jax.pure_callback(host, tuple(specs), *arrs)
+    res = [Tensor(r) for r in res]
+    return res if isinstance(out, (list, tuple)) else res[0]
+
+
+def _np_dtype(t):
+    import numpy as np
+    from ..framework import dtypes as _dt
+    d = t.dtype if hasattr(t, "dtype") else t
+    try:
+        return np.dtype(_dt.convert_dtype(d))
+    except Exception:
+        return np.dtype(str(d))
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """reference: static/nn/static_pylayer.py — custom fwd/bwd pair in a
+    static program; maps onto autograd.PyLayer."""
+    from ..autograd import PyLayer
+
+    class _P(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            return forward_fn(*args)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            if backward_fn is None:
+                raise RuntimeError("static_pylayer: no backward_fn given")
+            return backward_fn(*grads)
+
+    return _P.apply(*inputs)
+
+
+# -- legacy LoD sequence / PS ops: descoped with guidance -------------------
+
+def _lod_descoped(op):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"paddle.static.nn.{op}: LoD sequence ops serve the legacy "
+            "variable-length pipeline; on TPU use dense padded tensors "
+            "(paddle_tpu.nn.functional with masks) — see DESIGN.md ledger")
+    fn.__name__ = op
+    return fn
+
+
+sequence_conv = _lod_descoped("sequence_conv")
+sequence_softmax = _lod_descoped("sequence_softmax")
+sequence_pool = _lod_descoped("sequence_pool")
+sequence_first_step = _lod_descoped("sequence_first_step")
+sequence_last_step = _lod_descoped("sequence_last_step")
+sequence_expand = _lod_descoped("sequence_expand")
+
+
+def sparse_embedding(*a, **k):
+    raise NotImplementedError(
+        "paddle.static.nn.sparse_embedding targets parameter-server "
+        "training (descoped on TPU, DESIGN.md); use static.nn.embedding or "
+        "VocabParallelEmbedding for >HBM vocabularies")
+
+
+def nce(*a, **k):
+    raise NotImplementedError(
+        "paddle.static.nn.nce (noise-contrastive estimation over a PS "
+        "sampler) is descoped on TPU; use full-softmax cross_entropy — on "
+        "TPU the matmul is MXU-bound and vocab-parallel sharding replaces "
+        "sampling (DESIGN.md)")

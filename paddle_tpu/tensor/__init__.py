@@ -125,7 +125,9 @@ from .extras import *  # noqa: F401,F403
 # functional underneath — in-place is a rebind of the same Python object —
 # so the twins are generated, not hand-written.
 _INPLACE_BASES = [
-    "abs", "acos", "addmm", "atan", "bitwise_and", "bitwise_left_shift",
+    "abs", "acos", "acosh", "addmm", "asin", "asinh", "atan", "atanh",
+    "cosh", "erfinv", "lerp", "log1p", "not_equal", "put_along_axis",
+    "bitwise_and", "bitwise_left_shift",
     "bitwise_not", "bitwise_or", "bitwise_right_shift", "bitwise_xor",
     "cast", "copysign", "cos", "cumprod", "cumsum", "digamma", "divide",
     "equal", "erf", "expm1", "floor_divide", "floor_mod", "frac", "gcd",
@@ -177,3 +179,64 @@ def where_(condition, x, y=None, name=None):
 
 
 Tensor.where_ = lambda self, cond, y, name=None: where_(cond, self, y)
+
+
+# ---------------------------------------------------------------------------
+# tensor_method_func parity: the reference patches every tensor-domain free
+# function onto Tensor (python/paddle/tensor/__init__.py tensor_method_func).
+# The loop above covers the core modules; extras/random/signal and the
+# linalg-namespace-only ops are attached here.
+# ---------------------------------------------------------------------------
+
+def _attach_more():
+    for name in getattr(_extras, "__all__", []):
+        fn = getattr(_extras, name, None)
+        if callable(fn) and not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+    # random: only the tensor-first ops (in-place fillers + samplers);
+    # factories like randn(shape) must not bind a tensor as their shape
+    for name in getattr(random, "__all__", []):
+        if not (name.endswith("_") or name in
+                ("multinomial", "poisson", "binomial", "standard_gamma")):
+            continue
+        fn = getattr(random, name, None)
+        if callable(fn) and not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+    # search/creation late additions (top_p_sampling, create_tensor)
+    for name in ("top_p_sampling",):
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, getattr(search, name))
+    Tensor.create_tensor = staticmethod(creation.create_tensor)
+    if not hasattr(Tensor, "inverse"):
+        Tensor.inverse = linalg.inverse
+    # _SKIP members the reference nevertheless exposes as methods: the
+    # tensor binds as the first argument (for scatter_nd that IS the index,
+    # matching the reference signature scatter_nd(index, updates, shape))
+    for name in ("atleast_1d", "atleast_2d", "atleast_3d",
+                 "broadcast_tensors", "scatter_nd"):
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, getattr(manipulation, name))
+    if not hasattr(Tensor, "multi_dot"):
+        Tensor.multi_dot = linalg.multi_dot
+    # Tensor.create_parameter is attached by the package root, where the
+    # function is defined (paddle_tpu/__init__.py)
+
+    # signal + linalg-namespace methods resolve lazily: those modules import
+    # from this package, so importing them here would be circular
+    def _lazy(module, name):
+        def m(self, *a, **k):
+            import importlib
+            fn = getattr(importlib.import_module(module), name)
+            return fn(self, *a, **k)
+        m.__name__ = name
+        return m
+
+    for name in ("stft", "istft"):
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, _lazy("paddle_tpu.signal", name))
+    for name in ("cholesky_inverse", "ormqr", "svd_lowrank"):
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, _lazy("paddle_tpu.linalg", name))
+
+
+_attach_more()

@@ -166,3 +166,13 @@ def polar(abs_, angle, name=None):
 
 
 import jax  # noqa: E402  (used by complex)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """reference: python/paddle/tensor/creation.py create_tensor — an empty
+    typed Tensor to be assign()ed into."""
+    from ..framework.dtypes import convert_dtype
+    return Tensor(jnp.zeros((0,), convert_dtype(dtype)))
+
+
+__all__.append("create_tensor")

@@ -302,3 +302,11 @@ def tensordot(x, y, axes=2, name=None):
         return int(ax) if not isinstance(ax, int) else ax
     ax = conv_axes(axes)
     return execute(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y, _name="tensordot")
+
+
+def inverse(x, name=None):
+    """Alias of linalg.inv (reference: paddle.inverse / tensor method)."""
+    return inv(x, name=name)
+
+
+__all__.append("inverse")

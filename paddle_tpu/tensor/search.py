@@ -167,3 +167,71 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
         counts = np.diff(np.append(idx, n))
         outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
     return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
+                   k=0, mode="truncated", return_top=False, name=None):
+    """Nucleus (top-p) sampling over probability rows.
+
+    reference: python/paddle/tensor/search.py:1363 top_p_sampling (backed by
+    the top_p_sampling CUDA kernel, ops.yaml). x: (batch, vocab)
+    probabilities; ps: (batch,) per-row cumulative-probability cutoffs;
+    threshold: (batch,) minimum sampleable score; topp_seed: (batch,) int64
+    per-row seeds; mode 'truncated' restricts sampling to the nucleus,
+    'non-truncated' samples the full (threshold-filtered) distribution.
+    Returns (value, id), each (batch, 1); with return_top also the top-k
+    scores and ids.
+
+    TPU-native: sort + cumsum + renormalize + categorical draw — all dense
+    XLA ops; the reference's fused kernel exists to avoid the full-vocab
+    sort on GPU, which XLA handles fine on TPU.
+    """
+    import jax
+    from ..framework.random import next_key
+
+    if mode not in ("truncated", "non-truncated"):
+        raise ValueError(f"mode must be 'truncated' or 'non-truncated', "
+                         f"got {mode!r}")
+
+    def f(probs, p, *extra):
+        it = iter(extra)
+        thr = next(it) if threshold is not None else None
+        row_seeds = next(it) if topp_seed is not None else None
+        filt_src = probs
+        if thr is not None:
+            filt_src = jnp.where(probs >= thr[..., None], probs, 0.0)
+        sort_idx = jnp.argsort(-filt_src, axis=-1)
+        sorted_p = jnp.take_along_axis(filt_src, sort_idx, axis=-1)
+        if mode == "truncated":
+            cum = jnp.cumsum(sorted_p, axis=-1)
+            # keep tokens whose PRECEDING mass is < p (first always kept)
+            keep = (cum - sorted_p) < p[..., None]
+            filt = jnp.where(keep, sorted_p, 0.0)
+        else:
+            filt = sorted_p
+        logits = jnp.log(jnp.maximum(filt, 1e-30))
+        if row_seeds is not None:
+            keys = jax.vmap(jax.random.key)(row_seeds.astype(jnp.uint32))
+            pos = jax.vmap(
+                lambda kk, lg: jax.random.categorical(kk, lg))(keys, logits)
+        else:
+            key = next_key() if seed < 0 else jax.random.key(seed)
+            pos = jax.random.categorical(key, logits, axis=-1)
+        idx = jnp.take_along_axis(sort_idx, pos[..., None], axis=-1)
+        val = jnp.take_along_axis(probs, idx, axis=-1)
+        outs = (val, idx.astype(jnp.int64))
+        if return_top:
+            kk = k if k > 0 else 1
+            top_scores, top_ids = jax.lax.top_k(probs, kk)
+            outs = outs + (top_scores, top_ids.astype(jnp.int64))
+        return outs
+
+    args = (x, ps)
+    if threshold is not None:
+        args += (threshold,)
+    if topp_seed is not None:
+        args += (topp_seed,)
+    return execute(f, *args, _name="top_p_sampling")
+
+
+__all__.append("top_p_sampling")

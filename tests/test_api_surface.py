@@ -511,6 +511,7 @@ class TestReferenceSurfaceGate:
         ("python/paddle/regularizer.py", "paddle_tpu.regularizer"),
         ("python/paddle/hub.py", "paddle_tpu.hub"),
         ("python/paddle/sysconfig.py", "paddle_tpu.sysconfig"),
+        ("python/paddle/static/nn/__init__.py", "paddle_tpu.static.nn"),
     ]
 
     @staticmethod
@@ -534,3 +535,24 @@ class TestReferenceSurfaceGate:
         module = importlib.import_module(mod)
         missing = sorted(n for n in names if not hasattr(module, n))
         assert not missing, f"{mod} missing {missing}"
+
+    def test_tensor_method_surface_complete(self):
+        """Every reference tensor_method_func entry must be a Tensor method
+        (python/paddle/tensor/__init__.py patches the whole tensor-op
+        surface onto Tensor; so do we)."""
+        import ast
+        try:
+            src = open(
+                "/root/reference/python/paddle/tensor/__init__.py").read()
+        except OSError:
+            pytest.skip("reference unavailable")
+        names = None
+        for node in ast.walk(ast.parse(src)):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if getattr(t, "id", None) == "tensor_method_func":
+                        names = ast.literal_eval(node.value)
+        assert names, "tensor_method_func not found in reference"
+        t = paddle.Tensor(jnp.ones((2, 2), jnp.float32))
+        missing = sorted(set(n for n in names if not hasattr(t, n)))
+        assert not missing, f"Tensor missing methods {missing}"

@@ -1,0 +1,167 @@
+"""paddle.static.nn builders + the paddle.linalg namespace module.
+
+reference: python/paddle/static/nn/__init__.py (30-symbol surface,
+common.py builders, control_flow.py case/switch_case) and
+python/paddle/linalg.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+nn = static.nn
+
+
+def _x(shape, seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randn(*shape).astype(np.float32))
+
+
+class TestBuilders:
+    def test_fc_matches_manual(self):
+        paddle.seed(0)
+        x = _x((4, 8))
+        out = nn.fc(x, 16, activation="relu")
+        assert tuple(out.shape) == (4, 16)
+        assert float(out.numpy().min()) >= 0.0
+
+    def test_fc_flatten_dims(self):
+        out = nn.fc(_x((2, 3, 4)), 5, num_flatten_dims=1)
+        assert tuple(out.shape) == (2, 5)
+
+    def test_convs(self):
+        img = _x((2, 3, 16, 16), 1)
+        assert tuple(nn.conv2d(img, 8, 3, padding=1).shape) == (2, 8, 16, 16)
+        assert tuple(nn.conv2d_transpose(img, 8, filter_size=2,
+                                         stride=2).shape) == (2, 8, 32, 32)
+        vol = _x((1, 2, 4, 8, 8), 2)
+        assert tuple(nn.conv3d(vol, 4, 3, padding=1).shape) == (1, 4, 4, 8, 8)
+        assert tuple(nn.conv3d_transpose(
+            vol, 4, filter_size=2, stride=2).shape) == (1, 4, 8, 16, 16)
+
+    def test_norms(self):
+        img = _x((2, 6, 8, 8), 3)
+        for out in (nn.batch_norm(img), nn.layer_norm(img),
+                    nn.group_norm(img, 3), nn.instance_norm(img)):
+            assert tuple(out.shape) == (2, 6, 8, 8)
+        dn = nn.data_norm(_x((16, 4)))
+        np.testing.assert_allclose(dn.numpy().mean(axis=0), 0.0, atol=1e-5)
+
+    def test_embedding_prelu_btp(self):
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 4]], np.int64))
+        assert tuple(nn.embedding(ids, (10, 6)).shape) == (2, 2, 6)
+        img = _x((2, 3, 8, 8), 4)
+        assert tuple(nn.prelu(img, "channel").shape) == (2, 3, 8, 8)
+        out = nn.bilinear_tensor_product(_x((4, 8)), _x((4, 5), 5), 7)
+        assert tuple(out.shape) == (4, 7)
+
+    def test_spectral_norm_unit_sigma(self):
+        w = _x((6, 4), 6)
+        sn = nn.spectral_norm(w, power_iters=30)
+        sigma = np.linalg.svd(sn.numpy(), compute_uv=False)[0]
+        np.testing.assert_allclose(sigma, 1.0, atol=1e-3)
+
+    def test_row_conv_lookahead(self):
+        seq = _x((1, 4, 2), 7)
+        out = nn.row_conv(seq, 1)
+        assert tuple(out.shape) == (1, 4, 2)
+
+    def test_case_switch_case(self):
+        t, f = np.array(True), np.array(False)
+        r = nn.case([(paddle.to_tensor(f), lambda: paddle.to_tensor(1.0)),
+                     (paddle.to_tensor(t), lambda: paddle.to_tensor(2.0))],
+                    default=lambda: paddle.to_tensor(3.0))
+        assert float(r.numpy()) == 2.0
+        branches = {0: lambda: paddle.to_tensor(10.0),
+                    1: lambda: paddle.to_tensor(20.0)}
+        assert float(nn.switch_case(paddle.to_tensor(np.int32(1)), branches,
+                                    default=lambda: paddle.to_tensor(-1.0))
+                     .numpy()) == 20.0
+        assert float(nn.switch_case(paddle.to_tensor(np.int32(9)), branches,
+                                    default=lambda: paddle.to_tensor(-1.0))
+                     .numpy()) == -1.0
+
+    def test_py_func_and_static_pylayer(self):
+        x = _x((4, 8))
+        out = nn.py_func(lambda a: a * 2, x, out=x)
+        np.testing.assert_allclose(out.numpy(), x.numpy() * 2, rtol=1e-6)
+        sp = nn.static_pylayer(lambda a: a * 3, [x],
+                               backward_fn=lambda g: g * 3)
+        np.testing.assert_allclose(sp.numpy(), x.numpy() * 3, rtol=1e-6)
+
+    def test_deform_conv2d(self):
+        img = _x((2, 3, 8, 8), 8)
+        off = paddle.to_tensor(np.zeros((2, 18, 8, 8), np.float32))
+        mask = paddle.to_tensor(np.ones((2, 9, 8, 8), np.float32))
+        out = nn.deform_conv2d(img, off, mask, 4, 3, padding=1)
+        assert tuple(out.shape) == (2, 4, 8, 8)
+
+    def test_lod_and_ps_ops_guide(self):
+        x = _x((4, 8))
+        for op in (nn.sequence_conv, nn.sequence_pool, nn.sequence_softmax,
+                   nn.sequence_expand, nn.sequence_first_step,
+                   nn.sequence_last_step):
+            with pytest.raises(NotImplementedError, match="DESIGN.md"):
+                op(x)
+        with pytest.raises(NotImplementedError):
+            nn.sparse_embedding(x, (10, 4))
+        with pytest.raises(NotImplementedError):
+            nn.nce(x)
+
+    def test_surface_complete(self):
+        for name in ("batch_norm", "bilinear_tensor_product", "case",
+                     "conv2d", "conv2d_transpose", "conv3d",
+                     "conv3d_transpose", "data_norm", "deform_conv2d",
+                     "embedding", "fc", "group_norm", "instance_norm",
+                     "layer_norm", "nce", "prelu", "py_func", "row_conv",
+                     "sequence_conv", "sequence_expand",
+                     "sequence_first_step", "sequence_last_step",
+                     "sequence_pool", "sequence_softmax", "sparse_embedding",
+                     "spectral_norm", "static_pylayer", "switch_case",
+                     "cond", "while_loop"):
+            assert hasattr(nn, name), name
+
+
+class TestLinalgNamespace:
+    """paddle.linalg must be the top-level namespace module (it was shadowed
+    by paddle.tensor.linalg, hiding the linalg-only ops)."""
+
+    def test_module_identity_and_surface(self):
+        assert paddle.linalg.__name__ == "paddle_tpu.linalg"
+        for name in ("cholesky_inverse", "matrix_exp", "matrix_norm",
+                     "ormqr", "svd_lowrank", "vector_norm", "norm", "svd",
+                     "qr", "inv", "lstsq"):
+            assert hasattr(paddle.linalg, name), name
+
+    def test_matrix_exp(self):
+        a = paddle.to_tensor(np.diag([1.0, 2.0]).astype(np.float32))
+        out = paddle.linalg.matrix_exp(a).numpy()
+        np.testing.assert_allclose(out, np.diag(np.exp([1.0, 2.0])),
+                                   rtol=1e-5)
+
+    def test_cholesky_inverse(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(4, 4).astype(np.float32)
+        spd = x @ x.T + 4 * np.eye(4, dtype=np.float32)
+        L = np.linalg.cholesky(spd)
+        got = paddle.linalg.cholesky_inverse(paddle.to_tensor(L)).numpy()
+        np.testing.assert_allclose(got, np.linalg.inv(spd),
+                                   rtol=1e-2, atol=1e-3)
+
+    def test_vector_and_matrix_norm(self):
+        v = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+        np.testing.assert_allclose(
+            float(paddle.linalg.vector_norm(v).numpy()), 5.0, rtol=1e-5)
+        m = paddle.to_tensor(np.eye(3, dtype=np.float32))
+        np.testing.assert_allclose(
+            float(paddle.linalg.matrix_norm(m).numpy()), np.sqrt(3),
+            rtol=1e-5)
+
+    def test_svd_lowrank_reconstructs(self):
+        rs = np.random.RandomState(1)
+        a = (rs.randn(8, 3) @ rs.randn(3, 6)).astype(np.float32)
+        U, S, V = paddle.linalg.svd_lowrank(paddle.to_tensor(a), q=3)
+        rec = U.numpy() @ np.diag(S.numpy()) @ V.numpy().T
+        np.testing.assert_allclose(rec, a, atol=1e-3)

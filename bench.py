@@ -306,8 +306,10 @@ def main():
             break
         errors.append(f"probe{i}: {err}")
         # a wedged device lease (killed worker still holding the chip)
-        # expires on a minutes scale — wait longer each round
-        time.sleep(60 * (i + 1))
+        # expires on a minutes scale — wait longer each round, but don't
+        # sleep after the final failure (the CPU fallback needs no TPU)
+        if i < 2:
+            time.sleep(60 * (i + 1))
 
     # one subprocess PER ladder config so a slow/hung compile on a big
     # config can't eat the whole budget before smaller configs get a turn

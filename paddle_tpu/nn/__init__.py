@@ -19,3 +19,4 @@ from .clip import (  # noqa: F401
 )
 
 from . import utils  # noqa: F401
+from . import quant  # noqa: F401

@@ -1,0 +1,187 @@
+"""paddle.nn.quant — weight-only / LLM.int8 quantized linear surface.
+
+reference: python/paddle/nn/quant/__init__.py (Stub, weight_quantize,
+weight_dequantize, weight_only_linear, llm_int8_linear; kernels
+weight_quantize/weight_only_linear in ops.yaml).
+
+TPU-native design: the reference's CUDA kernels exist to feed tensor-core
+int8/int4 GEMMs with hand-packed layouts (and gate on SM arch). On TPU the
+MXU consumes int8 natively and XLA fuses the dequant multiply into the
+matmul epilogue, so the ops are expressed as plain jnp: per-channel (or
+group-wise) absmax quantization, int8 matmul with int32 accumulation,
+scale epilogue. int4 is stored as int8 values in [-8, 7] — nibble packing
+is a GPU memory-layout artifact; XLA's i4 support handles packing when it
+lowers. The `arch` parameter is accepted and ignored (no SM arches here).
+
+Layouts match the reference contract: weight_quantize takes x of shape
+(k, n) and returns (quantized weight of shape (n, k) — the transposition —
+and per-out-channel scale of shape (n,); group_size>0 gives scale
+(n, k//group_size)).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, execute
+from ...framework import dtypes as _dt
+from ..layer.layers import Layer
+
+__all__ = ["Stub", "weight_only_linear", "llm_int8_linear",
+           "weight_quantize", "weight_dequantize"]
+
+_ALGOS = ("weight_only_int8", "weight_only_int4", "llm.int8")
+
+
+def _check(algo, group_size):
+    if algo not in _ALGOS:
+        raise ValueError(f"algo must be one of {_ALGOS}, got {algo!r}")
+    if group_size not in (-1, 64, 128):
+        raise ValueError(f"group_size must be -1/64/128, got {group_size}")
+
+
+def _qmax(algo):
+    return 7.0 if algo == "weight_only_int4" else 127.0
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """(k, n) float weight -> ((n, k) int8 weight, scale). reference:
+    nn/quant/quantized_linear.py:56."""
+    _check(algo, group_size)
+    qmax = _qmax(algo)
+
+    def f(w):
+        wt = w.astype(jnp.float32).T  # (n, k)
+        if group_size == -1:
+            absmax = jnp.max(jnp.abs(wt), axis=1)  # (n,)
+            scale = absmax / qmax
+            q = jnp.round(wt / jnp.maximum(scale, 1e-10)[:, None])
+        else:
+            n, k = wt.shape
+            if k % group_size:
+                raise ValueError(
+                    f"in-features {k} not divisible by group_size "
+                    f"{group_size}")
+            g = wt.reshape(n, k // group_size, group_size)
+            absmax = jnp.max(jnp.abs(g), axis=2)  # (n, k/gs)
+            scale = absmax / qmax
+            q = jnp.round(g / jnp.maximum(scale, 1e-10)[:, :, None])
+            q = q.reshape(n, k)
+        q = jnp.clip(q, -qmax - 1, qmax).astype(jnp.int8)
+        return q, scale.astype(jnp.float32)
+
+    return execute(f, x, _name="weight_quantize")
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16",
+                      group_size=-1):
+    """(n, k) int8 weight + scale -> (k, n) float weight. reference:
+    nn/quant/quantized_linear.py:123."""
+    _check(algo, group_size)
+    dt = _dt.convert_dtype(out_dtype)
+
+    def f(q, s):
+        qf = q.astype(jnp.float32)
+        if group_size == -1:
+            w = qf * s[:, None]
+        else:
+            n, k = qf.shape
+            g = qf.reshape(n, k // group_size, group_size)
+            w = (g * s[:, :, None]).reshape(n, k)
+        return w.T.astype(dt)
+
+    return execute(f, x, scale, _name="weight_dequantize")
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """x @ dequant(weight).T + bias with the dequant fused by XLA into the
+    matmul. weight: (n, k) int8 from weight_quantize. reference:
+    nn/quant/quantized_linear.py:183."""
+    if weight_dtype not in ("int8", "int4"):
+        raise ValueError(f"weight_dtype must be int8/int4, got {weight_dtype}")
+    if group_size not in (-1, 64, 128):
+        raise ValueError(f"group_size must be -1/64/128, got {group_size}")
+
+    def f(a, q, *rest):
+        it = iter(rest)
+        s = next(it) if weight_scale is not None else None
+        b = next(it) if bias is not None else None
+        qf = q.astype(a.dtype)
+        if s is not None:
+            if group_size == -1:
+                wf = qf * s.astype(a.dtype)[:, None]          # (n, k)
+            else:
+                n, k = qf.shape
+                g = qf.reshape(n, k // group_size, group_size)
+                wf = (g * s.astype(a.dtype)[:, :, None]).reshape(n, k)
+        else:
+            wf = qf
+        out = a @ wf.T
+        if b is not None:
+            out = out + b
+        return out
+
+    args = (x, weight)
+    if weight_scale is not None:
+        args += (weight_scale,)
+    if bias is not None:
+        args += (bias,)
+    return execute(f, *args, _name="weight_only_linear")
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
+    """LLM.int8 (Dettmers et al.): per-token int8 activation quantization
+    with fp outlier decomposition. Columns of x holding any |value| >
+    threshold run against the dequantized weight in x's dtype; the rest run
+    int8 x int8 -> int32 with a scale epilogue. weight: (n, k) int8.
+    reference: nn/quant/quantized_linear.py:276.
+    """
+
+    def f(a, q, *rest):
+        it = iter(rest)
+        s = next(it) if weight_scale is not None else None
+        b = next(it) if bias is not None else None
+        af = a.astype(jnp.float32)
+        k = af.shape[-1]
+        outlier = jnp.any(jnp.abs(af) > threshold, axis=tuple(
+            range(af.ndim - 1)))                               # (k,)
+        a_in = jnp.where(outlier, 0.0, af)
+        # per-token absmax int8 quantization of the inlier block
+        tok_max = jnp.max(jnp.abs(a_in), axis=-1, keepdims=True)
+        a_scale = jnp.maximum(tok_max, 1e-10) / 127.0
+        aq = jnp.clip(jnp.round(a_in / a_scale), -128, 127).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            aq, q, dimension_numbers=(((aq.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32).astype(jnp.float32)
+        w_scale = (s.astype(jnp.float32) if s is not None
+                   else jnp.ones((q.shape[0],), jnp.float32))
+        out = acc * a_scale * w_scale                          # (..., n)
+        # outlier columns in full precision against dequantized weight
+        a_out = jnp.where(outlier, af, 0.0)
+        wf = q.astype(jnp.float32) * w_scale[:, None]          # (n, k)
+        out = out + a_out @ wf.T
+        if b is not None:
+            out = out + b.astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    args = (x, weight)
+    if weight_scale is not None:
+        args += (weight_scale,)
+    if bias is not None:
+        args += (bias,)
+    return execute(f, *args, _name="llm_int8_linear")
+
+
+class Stub(Layer):
+    """Observer placeholder inserted where a quanter should attach.
+    reference: python/paddle/nn/quant/stub.py — resolved to a real quanter
+    by quantization.QAT.quantize from the model's config."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        return x

@@ -37,9 +37,10 @@ class _ParamFactory(Layer):
     """One throwaway Layer per builder call: reuses nn's initializer /
     weight-attr machinery for parameter creation."""
 
-    def make(self, shape, attr=None, is_bias=False, default=None):
+    def make(self, shape, attr=None, is_bias=False, default=None,
+             dtype=None):
         return self.create_parameter(
-            shape, attr=attr, is_bias=is_bias,
+            shape, attr=attr, dtype=dtype, is_bias=is_bias,
             default_initializer=default)
 
 
@@ -73,7 +74,7 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype="float32", name=None):
     """reference: static/nn/common.py embedding."""
     pf = _ParamFactory()
-    w = pf.make(tuple(size), attr=param_attr)
+    w = pf.make(tuple(size), attr=param_attr, dtype=dtype)
     return F.embedding(input, w, padding_idx=padding_idx, sparse=is_sparse)
 
 
@@ -225,13 +226,28 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
               do_model_average_for_mean_and_var=True, slot_dim=-1,
               summary_decay_rate=0.9999999, sync_stats=False,
               enable_scale_and_shift=False):
-    """reference: static/nn/common.py data_norm — normalization by running
-    batch statistics without learned affine (unless enabled)."""
-    def f(a):
-        mean = jnp.mean(a, axis=0, keepdims=True)
-        var = jnp.var(a, axis=0, keepdims=True)
-        return (a - mean) / jnp.sqrt(var + epsilon)
-    out = execute(f, input, _name="data_norm")
+    """reference: static/nn/common.py data_norm — normalization by batch
+    statistics, with a learned per-feature affine when
+    enable_scale_and_shift is set (reference creates scale_w/bias then)."""
+    if enable_scale_and_shift:
+        pf = _ParamFactory()
+        c = int(input.shape[-1])
+        scale_w = pf.make((c,), attr=param_attr, default=I.Constant(1.0))
+        bias = pf.make((c,), is_bias=True)
+
+        def f(a, sw, b):
+            mean = jnp.mean(a, axis=0, keepdims=True)
+            var = jnp.var(a, axis=0, keepdims=True)
+            return (a - mean) / jnp.sqrt(var + epsilon) * sw + b
+
+        out = execute(f, input, scale_w, bias, _name="data_norm")
+    else:
+        def f(a):
+            mean = jnp.mean(a, axis=0, keepdims=True)
+            var = jnp.var(a, axis=0, keepdims=True)
+            return (a - mean) / jnp.sqrt(var + epsilon)
+
+        out = execute(f, input, _name="data_norm")
     return _act(out, act)
 
 

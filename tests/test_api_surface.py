@@ -512,6 +512,7 @@ class TestReferenceSurfaceGate:
         ("python/paddle/hub.py", "paddle_tpu.hub"),
         ("python/paddle/sysconfig.py", "paddle_tpu.sysconfig"),
         ("python/paddle/static/nn/__init__.py", "paddle_tpu.static.nn"),
+        ("python/paddle/nn/quant/__init__.py", "paddle_tpu.nn.quant"),
     ]
 
     @staticmethod

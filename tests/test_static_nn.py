@@ -165,3 +165,156 @@ class TestLinalgNamespace:
         U, S, V = paddle.linalg.svd_lowrank(paddle.to_tensor(a), q=3)
         rec = U.numpy() @ np.diag(S.numpy()) @ V.numpy().T
         np.testing.assert_allclose(rec, a, atol=1e-3)
+
+
+class TestNNQuant:
+    """paddle.nn.quant weight-only / LLM.int8 surface.
+    reference: python/paddle/nn/quant/quantized_linear.py."""
+
+    def test_quantize_dequantize_roundtrip(self):
+        rs = np.random.RandomState(0)
+        w = rs.randn(64, 32).astype(np.float32)  # (k, n)
+        from paddle_tpu.nn import quant
+        q, s = quant.weight_quantize(paddle.to_tensor(w))
+        assert tuple(q.shape) == (32, 64) and tuple(s.shape) == (32,)
+        assert str(q.numpy().dtype) == "int8"
+        back = quant.weight_dequantize(q, s, out_dtype="float32").numpy()
+        # int8 absmax roundtrip: error bounded by scale/2 per channel
+        err = np.abs(back - w).max(axis=0)
+        bound = np.abs(w).max(axis=0) / 127.0
+        assert (err <= bound + 1e-6).all()
+
+    def test_groupwise_roundtrip(self):
+        rs = np.random.RandomState(1)
+        w = rs.randn(128, 16).astype(np.float32)
+        from paddle_tpu.nn import quant
+        q, s = quant.weight_quantize(paddle.to_tensor(w), group_size=64)
+        assert tuple(s.shape) == (16, 2)
+        back = quant.weight_dequantize(q, s, out_dtype="float32",
+                                       group_size=64).numpy()
+        assert np.abs(back - w).max() <= np.abs(w).max() / 127.0 + 1e-6
+
+    def test_int4(self):
+        rs = np.random.RandomState(2)
+        w = rs.randn(32, 8).astype(np.float32)
+        from paddle_tpu.nn import quant
+        q, s = quant.weight_quantize(paddle.to_tensor(w),
+                                     algo="weight_only_int4")
+        vals = q.numpy()
+        assert vals.min() >= -8 and vals.max() <= 7
+        back = quant.weight_dequantize(q, s, algo="weight_only_int4",
+                                       out_dtype="float32").numpy()
+        assert np.abs(back - w).max() <= np.abs(w).max() / 7.0 + 1e-6
+
+    def test_weight_only_linear_matches_dequant_matmul(self):
+        rs = np.random.RandomState(3)
+        x = rs.randn(4, 64).astype(np.float32)
+        w = rs.randn(64, 32).astype(np.float32)
+        b = rs.randn(32).astype(np.float32)
+        from paddle_tpu.nn import quant
+        q, s = quant.weight_quantize(paddle.to_tensor(w))
+        out = quant.weight_only_linear(paddle.to_tensor(x), q,
+                                       bias=paddle.to_tensor(b),
+                                       weight_scale=s).numpy()
+        wd = quant.weight_dequantize(q, s, out_dtype="float32").numpy()
+        np.testing.assert_allclose(out, x @ wd + b, rtol=1e-4, atol=1e-4)
+        # and close to the unquantized matmul at int8 tolerance
+        rel = np.abs(out - (x @ w + b)).max() / np.abs(x @ w + b).max()
+        assert rel < 0.05
+
+    def test_llm_int8_linear_outliers(self):
+        rs = np.random.RandomState(4)
+        x = rs.randn(4, 64).astype(np.float32)
+        x[:, 7] *= 20.0  # outlier column
+        w = rs.randn(64, 16).astype(np.float32)
+        from paddle_tpu.nn import quant
+        q, s = quant.weight_quantize(paddle.to_tensor(w), algo="llm.int8")
+        out = quant.llm_int8_linear(paddle.to_tensor(x), q,
+                                    weight_scale=s).numpy()
+        wd = quant.weight_dequantize(q, s, out_dtype="float32").numpy()
+        ref = x @ wd
+        rel = np.abs(out - ref).max() / np.abs(ref).max()
+        assert rel < 0.05, rel
+
+    def test_stub_and_errors(self):
+        from paddle_tpu.nn import quant
+        st = quant.Stub()
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        np.testing.assert_array_equal(st(x).numpy(), x.numpy())
+        with pytest.raises(ValueError):
+            quant.weight_quantize(x, algo="bogus")
+        with pytest.raises(ValueError):
+            quant.weight_quantize(x, group_size=32)
+
+
+class TestTopPSampling:
+    """reference: python/paddle/tensor/search.py:1363 top_p_sampling."""
+
+    def _probs(self):
+        return paddle.to_tensor(
+            np.array([[0.2, 0.5, 0.3], [0.1, 0.1, 0.8]], np.float32))
+
+    def test_truncated_respects_nucleus(self):
+        x = self._probs()
+        ps = paddle.to_tensor(np.array([0.6, 0.5], np.float32))
+        for _ in range(20):
+            v, i = paddle.tensor.search.top_p_sampling(x, ps)
+            assert tuple(v.shape) == (2, 1) and tuple(i.shape) == (2, 1)
+            # row 0 nucleus at p=0.6: {1 (0.5), 2 (0.3)} — 0 (0.2) excluded
+            assert int(i.numpy()[0, 0]) in (1, 2)
+            # row 1 nucleus at p=0.5: only token 2 (0.8)
+            assert int(i.numpy()[1, 0]) == 2
+            # returned value is the original probability of the sampled id
+            assert np.isclose(v.numpy()[1, 0], 0.8)
+
+    def test_threshold_filters_low_scores(self):
+        x = self._probs()
+        ps = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+        thr = paddle.to_tensor(np.array([0.25, 0.25], np.float32))
+        for _ in range(20):
+            _, i = paddle.tensor.search.top_p_sampling(x, ps, threshold=thr)
+            assert int(i.numpy()[0, 0]) in (1, 2)  # 0.2 < 0.25 filtered
+            assert int(i.numpy()[1, 0]) == 2       # 0.1s filtered
+
+    def test_per_row_seed_deterministic(self):
+        x = self._probs()
+        ps = paddle.to_tensor(np.array([0.9, 0.9], np.float32))
+        sd = paddle.to_tensor(np.array([11, 12], np.int64))
+        a = paddle.tensor.search.top_p_sampling(x, ps, topp_seed=sd)
+        b = paddle.tensor.search.top_p_sampling(x, ps, topp_seed=sd)
+        np.testing.assert_array_equal(a[1].numpy(), b[1].numpy())
+
+    def test_return_top_and_mode(self):
+        x = self._probs()
+        ps = paddle.to_tensor(np.array([0.6, 0.5], np.float32))
+        v, i, ts, ti = paddle.tensor.search.top_p_sampling(
+            x, ps, return_top=True, k=2)
+        assert tuple(ts.shape) == (2, 2) and tuple(ti.shape) == (2, 2)
+        np.testing.assert_array_equal(ti.numpy()[:, 0], [1, 2])  # argmax ids
+        # non-truncated: any token is reachable; check it runs and shapes
+        v2, i2 = paddle.tensor.search.top_p_sampling(
+            x, ps, mode="non-truncated")
+        assert tuple(i2.shape) == (2, 1)
+        with pytest.raises(ValueError):
+            paddle.tensor.search.top_p_sampling(x, ps, mode="bogus")
+
+    def test_method_binding(self):
+        x = self._probs()
+        ps = paddle.to_tensor(np.array([0.9, 0.9], np.float32))
+        v, i = x.top_p_sampling(ps)
+        assert tuple(i.shape) == (2, 1)
+
+
+class TestDataNormEmbeddingDtype:
+    def test_data_norm_scale_shift_params(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(16, 4).astype(np.float32))
+        out = nn.data_norm(x, enable_scale_and_shift=True)
+        assert tuple(out.shape) == (16, 4)
+        # scale starts at 1, shift at 0: matches plain normalization
+        np.testing.assert_allclose(out.numpy().mean(axis=0), 0.0, atol=1e-5)
+
+    def test_embedding_dtype_honored(self):
+        ids = paddle.to_tensor(np.array([[0, 1]], np.int64))
+        out = nn.embedding(ids, (4, 8), dtype="float16")
+        assert "float16" in str(out.numpy().dtype)

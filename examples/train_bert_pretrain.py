@@ -1,0 +1,58 @@
+"""Ladder #3: BERT pretraining (MLM + NSP) with bf16 and semi-auto sharding.
+
+reference workflow: BERT pretraining over fleet semi-auto parallel
+(auto_parallel/api.py shard_tensor). TPU-native: SpmdTrainer over a dp
+mesh with the model computing its own pretraining loss; dtype='bfloat16'
+exercises the AMP-as-dtype-policy path.
+"""
+
+import argparse
+
+from _common import setup_devices
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--bf16", action="store_true")
+    args = ap.parse_args()
+    devices = setup_devices(args.devices)
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.bert import bert_tiny
+    from paddle_tpu.parallel import SpmdTrainer
+    from paddle_tpu.parallel.spmd import DP_ONLY_RULES
+
+    paddle.seed(0)
+    model = bert_tiny()
+    opt = optimizer.AdamW(1e-4, parameters=model.parameters())
+    mesh = Mesh(np.asarray(devices), ("dp",))
+
+    def mlm_loss(logits, labels):
+        # model without labels returns (mlm_logits, nsp_logits);
+        # make_loss_fn hands us the first output
+        from paddle_tpu.nn import functional as F
+        return F.cross_entropy(logits, labels, ignore_index=-100)
+
+    trainer = SpmdTrainer(model, opt, mesh, DP_ONLY_RULES,
+                          loss_fn=mlm_loss, batch_spec=P("dp"),
+                          dtype="bfloat16" if args.bf16 else None)
+
+    vocab = model.config.vocab_size
+    rng = np.random.RandomState(0)
+    for step in range(args.steps):
+        ids = jnp.asarray(rng.randint(0, vocab,
+                                      (args.batch_size, args.seq)), jnp.int32)
+        loss = trainer.step((ids, ids))
+        print(f"step {step}: loss={float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Ladder #5: GPT-style 4D hybrid parallel — GSPMD dp x mp x sep x sharding
+plus the compiled 1F1B pipeline program over pp x dp.
+
+reference workflow: fleet 4D topology (topology.py pp->mp->sep->sharding->dp)
+with PipelineParallel 1F1B (pipeline_parallel.py:575). TPU-native: the
+GSPMD axes live in one jitted step; pipeline parallelism is its own
+shard_map program (LlamaPipeRunner schedule='1F1B').
+"""
+
+import argparse
+
+from _common import setup_devices
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--microbatches", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=16)
+    args = ap.parse_args()
+    devices = setup_devices(args.devices)
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.parallel import SpmdTrainer, GPT_SHARDING_RULES
+    from paddle_tpu.parallel.llama_pipeline import LlamaPipeRunner
+
+    # -- GSPMD axes: dp x mp x sep x sharding (ZeRO-2) -------------------
+    n = len(devices)
+    grid = np.asarray(devices).reshape(1, 2, 2, 1, n // 4)
+    mesh = Mesh(grid, ("pp", "mp", "sep", "sharding", "dp"))
+    paddle.seed(0)
+    model = paddle.models.gpt_tiny()
+    opt = optimizer.AdamW(3e-4, parameters=model.parameters())
+    trainer = SpmdTrainer(model, opt, mesh, GPT_SHARDING_RULES,
+                          batch_spec=P("dp", "sep"), sharding_stage=2)
+    rng = np.random.RandomState(0)
+    batch = 2 * mesh.shape["dp"]
+    for step in range(args.steps):
+        ids = jnp.asarray(
+            rng.randint(0, model.config.vocab_size, (batch, args.seq)),
+            jnp.int32)
+        loss = trainer.step((ids, ids))
+        print(f"[gspmd dp x mp x sep] step {step}: loss={float(loss):.4f}")
+
+    # -- pipeline axis: 1F1B over pp x dp --------------------------------
+    pp, pdp = 2, max(n // 4, 1)
+    mesh2 = Mesh(np.asarray(devices[: pp * pdp]).reshape(pp, pdp),
+                 ("pp", "dp"))
+    paddle.seed(0)
+    lmodel = paddle.models.llama_tiny(num_hidden_layers=2)
+    lopt = optimizer.AdamW(3e-4, parameters=lmodel.parameters())
+    runner = LlamaPipeRunner(lmodel, mesh2,
+                             num_microbatches=args.microbatches,
+                             batch_axis="dp", optimizer=lopt,
+                             schedule="1F1B")
+    for step in range(args.steps):
+        ids = jnp.asarray(
+            rng.randint(0, lmodel.config.vocab_size,
+                        (args.microbatches * pdp, args.seq)), jnp.int32)
+        loss = runner.step(ids, ids)
+        print(f"[1F1B pp x dp] step {step}: loss={float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""Ladder #1: LeNet-5 on MNIST with the high-level Model API.
+
+reference workflow: paddle.Model + paddle.vision (hapi/model.py fit:2200).
+"""
+
+import argparse
+
+from _common import setup_devices
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=1)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=60)
+    args = ap.parse_args()
+    setup_devices(args.devices)
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer, metric
+    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu.vision.datasets import MNIST
+
+    paddle.seed(0)
+    model = paddle.Model(LeNet())
+    model.prepare(optimizer.Adam(1e-3, parameters=model.parameters()),
+                  nn.CrossEntropyLoss(), metric.Accuracy())
+    model.fit(MNIST(mode="train"), epochs=args.epochs,
+              batch_size=args.batch_size, num_iters=args.iters, verbose=1)
+    res = model.evaluate(MNIST(mode="test"), batch_size=128, verbose=0)
+    print(f"test: loss={res['loss'][0]:.4f} acc={float(res['acc']):.4f}")
+
+
+if __name__ == "__main__":
+    main()

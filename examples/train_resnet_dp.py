@@ -1,0 +1,54 @@
+"""Ladder #2: ResNet data-parallel training over a 1-D dp mesh.
+
+reference workflow: fleet DP (paddle.DataParallel + EagerReducer bucketed
+allreduce). TPU-native: SpmdTrainer with a dp-only mesh — batch sharded on
+'dp', grad reduction inserted by GSPMD onto ICI.
+"""
+
+import argparse
+
+from _common import setup_devices
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--depth", type=int, default=18)
+    ap.add_argument("--image-size", type=int, default=32)
+    args = ap.parse_args()
+    devices = setup_devices(args.devices)
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.parallel import SpmdTrainer
+    from paddle_tpu.parallel.spmd import DP_ONLY_RULES
+    from paddle_tpu.vision import models as M
+
+    paddle.seed(0)
+    model = {18: M.resnet18, 34: M.resnet34, 50: M.resnet50}[args.depth](
+        num_classes=10)
+    mesh = Mesh(np.asarray(devices), ("dp",))
+    opt = optimizer.Momentum(0.01, momentum=0.9,
+                             parameters=model.parameters())
+
+    def loss_fn(logits, labels):
+        return nn.functional.cross_entropy(logits, labels)
+
+    trainer = SpmdTrainer(model, opt, mesh, DP_ONLY_RULES,
+                          loss_fn=loss_fn, batch_spec=P("dp"))
+    rng = np.random.RandomState(0)
+    for step in range(args.steps):
+        x = jnp.asarray(rng.rand(args.batch_size, 3, args.image_size,
+                                 args.image_size), jnp.float32)
+        y = jnp.asarray(rng.randint(0, 10, (args.batch_size,)), jnp.int32)
+        loss = trainer.step((x, y))
+        print(f"step {step}: loss={float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

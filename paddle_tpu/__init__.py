@@ -123,6 +123,7 @@ from .distributed.parallel import DataParallel  # noqa: F401,E402
 # absent) — import the top-level namespace module explicitly and rebind.
 import importlib as _importlib  # noqa: E402
 linalg = _importlib.import_module(".linalg", __name__)
+from . import generation  # noqa: E402,F401
 
 
 def batch(reader, batch_size, drop_last=False):

@@ -64,13 +64,17 @@ def reshard(dist_tensor, mesh, placements):
     """reference: auto_parallel/api.py:727 + the C++ reshard rule library
     (paddle/phi/core/distributed/auto_parallel/reshard/*) — here one
     device_put: XLA derives the minimal collective (all-gather for s→r,
-    slice for r→s, all-to-all for s→s', psum for p→r...)."""
-    src_placements = getattr(dist_tensor, "placements", None)
-    has_partial = src_placements and any(p.is_partial() for p in src_placements)
-    if has_partial:
-        # p→x: sum over the partial mesh axes first (psum materialization)
-        arr = dist_tensor._data
-        t = Tensor(arr, stop_gradient=dist_tensor.stop_gradient)
+    slice for r→s, all-to-all for s→s').
+
+    Partial (p→r/p→s) needs no eager collective in this architecture:
+    DistTensors are global-view (same as the reference's DistTensor — its
+    materialized value is the reduced sum), and the single controller holds
+    exactly that reduced global array, so dropping the Partial mark IS the
+    p→r materialization. Inside jit, unreduced partial states only arise
+    between ops, where GSPMD inserts the psum/reduce-scatter — the role of
+    the reference's p_to_r/p_to_s rules (see
+    tests/test_auto_parallel.py::TestPartialPlacement for the compiled
+    row-parallel case)."""
     sharding = named_sharding(mesh, placements, dist_tensor._data.ndim)
 
     def f(a):

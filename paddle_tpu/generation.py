@@ -1,0 +1,285 @@
+"""Compiled autoregressive generation with a dense KV cache.
+
+reference capability: the serving path the reference builds from
+block_multihead_attention / masked_multihead_attention fused kernels
+(paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu,
+incubate/nn/functional/masked_multihead_attention.py) plus top_p_sampling
+(tensor/search.py:1363) — prefill once, then one-token decode steps
+against a KV cache.
+
+TPU-native design: the whole generate() is ONE jit per
+(batch, prompt_len, max_new_tokens) signature — prefill fills per-layer
+K/V caches (static max length, position-masked), then `lax.scan` runs the
+decode steps; layer weights are stacked (L, ...) arrays so each decode
+step is itself a `lax.scan` over depth (compiled size O(1) in L). Greedy
+or sampled (temperature / top-k / top-p) next-token choice happens inside
+the scan. The paged-cache variant for many-sequence serving lives in
+ops/paged_attention.py; this dense path is the single-program analog of
+the reference's masked_multihead_attention decode.
+
+Supports LlamaForCausalLM (flagship) and any causal LM exposing
+`model(input_ids) -> logits` through the recompute fallback.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .framework.core import Tensor
+from .framework import random as _random
+
+__all__ = ["generate", "GenerationConfig"]
+
+
+class GenerationConfig:
+    """reference: the generation knobs of top_p_sampling + sampling loops."""
+
+    def __init__(self, max_new_tokens=32, do_sample=False, temperature=1.0,
+                 top_k=0, top_p=1.0, eos_token_id=None):
+        self.max_new_tokens = max_new_tokens
+        self.do_sample = do_sample
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos_token_id = eos_token_id
+
+
+# ---------------------------------------------------------------------------
+# pure llama math over stacked params (mirrors models/llama.py exactly)
+# ---------------------------------------------------------------------------
+
+
+def _rms(x, w, eps):
+    x32 = x.astype(jnp.float32)
+    ms = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(ms + eps)).astype(x.dtype) * w
+
+
+def _rope(x, pos, theta):
+    """neox-style rope at absolute positions `pos` (any shape broadcastable
+    to x[..., :0]); x: (..., heads, head_dim)."""
+    d = x.shape[-1]
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = pos[..., None].astype(jnp.float32) * inv      # (..., d/2)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)        # (..., d)
+    s, c = jnp.sin(emb), jnp.cos(emb)
+    s = s[..., None, :].astype(x.dtype)                   # add head axis
+    c = c[..., None, :].astype(x.dtype)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    return x * c + rot * s
+
+
+def _gqa(a, rep):
+    if rep == 1:
+        return a
+    b, s, hkv, d = a.shape
+    return jnp.broadcast_to(a[:, :, :, None, :],
+                            (b, s, hkv, rep, d)).reshape(b, s, hkv * rep, d)
+
+
+def _llama_layer_prefill(lp, h, pos, cfg):
+    """Full-sequence layer forward; returns (h_out, (k, v)) with k/v rotated
+    and UNexpanded (kv heads)."""
+    eps, theta = cfg["eps"], cfg["theta"]
+    nh, nkv, hd = cfg["heads"], cfg["kv_heads"], cfg["head_dim"]
+    b, s, _ = h.shape
+    x = _rms(h, lp["input_layernorm.weight"], eps)
+    q = (x @ lp["self_attn.q_proj.weight"]).reshape(b, s, nh, hd)
+    k = (x @ lp["self_attn.k_proj.weight"]).reshape(b, s, nkv, hd)
+    v = (x @ lp["self_attn.v_proj.weight"]).reshape(b, s, nkv, hd)
+    q = _rope(q, pos, theta)
+    k = _rope(k, pos, theta)
+    kx, vx = _gqa(k, nh // nkv), _gqa(v, nh // nkv)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, kx,
+                        preferred_element_type=jnp.float32) / (hd ** 0.5)
+    causal = pos[:, :, None] >= pos[:, None, :]           # (b, s, s)
+    scores = jnp.where(causal[:, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(vx.dtype)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vx).reshape(b, s, nh * hd)
+    h = h + attn @ lp["self_attn.o_proj.weight"]
+    x = _rms(h, lp["post_attention_layernorm.weight"], eps)
+    gate = x @ lp["mlp.gate_proj.weight"]
+    up = x @ lp["mlp.up_proj.weight"]
+    h = h + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
+    return h, (k, v)
+
+
+def _llama_layer_decode(lp, h, k_cache, v_cache, t, cfg):
+    """One-token layer forward against the cache; h: (b, 1, H). The caches
+    hold rotated K / V at positions < t (positions >= t are masked)."""
+    eps, theta = cfg["eps"], cfg["theta"]
+    nh, nkv, hd = cfg["heads"], cfg["kv_heads"], cfg["head_dim"]
+    b = h.shape[0]
+    T = k_cache.shape[1]
+    x = _rms(h, lp["input_layernorm.weight"], eps)
+    q = (x @ lp["self_attn.q_proj.weight"]).reshape(b, 1, nh, hd)
+    k = (x @ lp["self_attn.k_proj.weight"]).reshape(b, 1, nkv, hd)
+    v = (x @ lp["self_attn.v_proj.weight"]).reshape(b, 1, nkv, hd)
+    pos = jnp.full((b, 1), t, jnp.int32)
+    q = _rope(q, pos, theta)
+    k = _rope(k, pos, theta)
+    k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k, t, axis=1)
+    v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v, t, axis=1)
+    kx = _gqa(k_cache, nh // nkv)
+    vx = _gqa(v_cache, nh // nkv)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, kx,
+                        preferred_element_type=jnp.float32) / (hd ** 0.5)
+    valid = (jnp.arange(T) <= t)[None, None, None, :]
+    scores = jnp.where(valid, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(vx.dtype)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vx).reshape(b, 1, nh * hd)
+    h = h + attn @ lp["self_attn.o_proj.weight"]
+    x = _rms(h, lp["post_attention_layernorm.weight"], eps)
+    gate = x @ lp["mlp.gate_proj.weight"]
+    up = x @ lp["mlp.up_proj.weight"]
+    h = h + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
+    return h, k_cache, v_cache
+
+
+def _sample(logits, key, gc: GenerationConfig):
+    if not gc.do_sample:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.maximum(gc.temperature, 1e-6)
+    if gc.top_k and gc.top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -gc.top_k][..., None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if gc.top_p < 1.0:
+        probs = jax.nn.softmax(logits, axis=-1)
+        order = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, order, axis=-1)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        keep_sorted = (cum - sorted_p) < gc.top_p
+        keep = jnp.zeros_like(keep_sorted).at[
+            jnp.arange(logits.shape[0])[:, None], order].set(keep_sorted)
+        logits = jnp.where(keep, logits, -1e30)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def _build_llama_generate(config, tied: bool, gc: GenerationConfig):
+    """Compile-once decode program. Weights enter as ARGUMENTS (not baked
+    constants), so one executable serves the model across optimizer steps /
+    set_state_dict and holds no weight copies of its own."""
+    cfg = dict(eps=config.rms_norm_eps, theta=config.rope_theta,
+               heads=config.num_attention_heads,
+               kv_heads=config.num_key_value_heads,
+               head_dim=config.hidden_size // config.num_attention_heads)
+
+    def run(stacked, embed_w, norm_w, head_w, input_ids, key):
+        def logits_of(h_last):
+            h = _rms(h_last, norm_w, cfg["eps"])
+            w = embed_w.T if tied else head_w
+            return (h @ w).astype(jnp.float32)
+
+        b, s = input_ids.shape
+        total = s + gc.max_new_tokens
+        pos = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+        h = jnp.take(embed_w, input_ids, axis=0)
+
+        # ---- prefill: scan over stacked layers, collecting K/V ----------
+        def prefill_layer(hh, lp):
+            hh, (k, v) = _llama_layer_prefill(lp, hh, pos, cfg)
+            return hh, (k, v)
+
+        h, (ks, vs) = jax.lax.scan(prefill_layer, h, stacked)
+        # ks: (L, b, s, kvh, hd) -> pad the time axis to `total`
+        padt = ((0, 0), (0, 0), (0, gc.max_new_tokens), (0, 0), (0, 0))
+        k_cache = jnp.pad(ks, padt)
+        v_cache = jnp.pad(vs, padt)
+
+        first_logits = logits_of(h[:, -1])
+        key, sub = jax.random.split(key)
+        first_tok = _sample(first_logits, sub, gc)
+
+        # ---- decode: scan over steps; inner scan over layers ------------
+        def step(carry, i):
+            tok, kc, vc, key, done = carry
+            t = s + i
+            hh = jnp.take(embed_w, tok[:, None], axis=0)  # (b, 1, H)
+
+            def dec_layer(hcar, layer_in):
+                lp, kl, vl = layer_in
+                hh2, kl2, vl2 = _llama_layer_decode(lp, hcar, kl, vl, t, cfg)
+                return hh2, (kl2, vl2)
+
+            hh, (kc, vc) = jax.lax.scan(dec_layer, hh, (stacked, kc, vc))
+            logits = logits_of(hh[:, -1])
+            key, sub = jax.random.split(key)
+            nxt = _sample(logits, sub, gc)
+            if gc.eos_token_id is not None:
+                done = done | (tok == gc.eos_token_id)
+                nxt = jnp.where(done, gc.eos_token_id, nxt)
+            return (nxt, kc, vc, key, done), tok
+
+        done0 = jnp.zeros((b,), bool)
+        (last, _, _, _, _), toks = jax.lax.scan(
+            step, (first_tok, k_cache, v_cache, key, done0),
+            jnp.arange(gc.max_new_tokens - 1))
+        out = jnp.concatenate([jnp.moveaxis(toks, 0, 1), last[:, None]],
+                              axis=1)
+        return jnp.concatenate([input_ids, out], axis=1)
+
+    return jax.jit(run)
+
+
+def _generic_generate(model, input_ids, gc: GenerationConfig, key):
+    """Fallback for models without a cache path: recompute the full prefix
+    each step (O(n) forwards). Correct for any causal LM returning logits."""
+    ids = input_ids
+    done = jnp.zeros((ids.shape[0],), bool)
+    for _ in range(gc.max_new_tokens):
+        out = model(Tensor(ids))
+        logits = (out[0] if isinstance(out, tuple) else out)._data
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits[:, -1].astype(jnp.float32), sub, gc)
+        if gc.eos_token_id is not None:
+            nxt = jnp.where(done, gc.eos_token_id, nxt)
+            done = done | (nxt == gc.eos_token_id)
+        ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+    return ids
+
+
+def generate(model, input_ids, max_new_tokens=32, do_sample=False,
+             temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+             seed=None):
+    """Generate continuations. Returns (batch, prompt+max_new_tokens) ids.
+
+    LlamaForCausalLM runs the compiled KV-cache path (one jit: prefill +
+    lax.scan decode); other causal LMs use the recompute fallback.
+    """
+    gc = GenerationConfig(max_new_tokens, do_sample, temperature, top_k,
+                          top_p, eos_token_id)
+    ids = input_ids._data if isinstance(input_ids, Tensor) \
+        else jnp.asarray(input_ids)
+    ids = ids.astype(jnp.int32)
+    key = (jax.random.key(seed) if seed is not None
+           else _random.next_key())
+    from .models.llama import LlamaForCausalLM
+    if isinstance(model, LlamaForCausalLM):
+        from .parallel.functional import split_stacked_layer_params
+        # CURRENT weights fetched per call and passed as jit arguments —
+        # the compiled program is keyed only on config/shapes, never holds
+        # weight copies, and stays correct across optimizer steps
+        state = {k: v._data for k, v in model.state_dict().items()}
+        stacked, other = split_stacked_layer_params(state)
+        tied = "lm_head.weight" not in other
+        c = model.config
+        cache_key = ((c.hidden_size, c.num_hidden_layers,
+                      c.num_attention_heads, c.num_key_value_heads,
+                      c.vocab_size, c.rms_norm_eps, c.rope_theta, tied),
+                     max_new_tokens, do_sample, float(temperature),
+                     int(top_k), float(top_p), eos_token_id)
+        cached = _GEN_CACHE.get(cache_key)
+        if cached is None:
+            cached = _build_llama_generate(c, tied, gc)
+            _GEN_CACHE[cache_key] = cached
+        head_w = other.get("lm_head.weight")
+        if head_w is None:  # jit needs a concrete leaf; tied path ignores it
+            head_w = jnp.zeros((0,), jnp.float32)
+        return Tensor(cached(stacked, other["llama.embed_tokens.weight"],
+                             other["llama.norm.weight"], head_w, ids, key))
+    return Tensor(_generic_generate(model, ids, gc, key))
+
+
+_GEN_CACHE: dict = {}

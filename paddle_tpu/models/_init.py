@@ -1,0 +1,18 @@
+"""Shared transformer weight-init policy (GPT-2 / BERT scheme).
+
+Weight matrices draw from N(0, initializer_range) — truncated at 2 sigma
+for BERT, plain normal for GPT-2 — and biases stay zero. Passed at
+construction as a ParamAttr so every parameter is initialized exactly once
+(a post-hoc re-init loop would draw all ~N params twice).
+"""
+
+from __future__ import annotations
+
+from ..framework.param_attr import ParamAttr
+from ..nn import initializer as I
+
+
+def transformer_init_attr(std: float, truncated: bool = False) -> ParamAttr:
+    init = (I.TruncatedNormal(mean=0.0, std=std) if truncated
+            else I.Normal(0.0, std))
+    return ParamAttr(initializer=init)

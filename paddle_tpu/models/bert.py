@@ -10,6 +10,14 @@ from __future__ import annotations
 from .. import nn
 from ..nn import functional as F
 from ..tensor.manipulation import reshape
+from ._init import transformer_init_attr
+
+
+def _init_attr(config):
+    # BERT init scheme: truncated normal(0, initializer_range) on every
+    # weight matrix — the Embedding N(0,1) default blows up the tied
+    # MLM softmax logits
+    return transformer_init_attr(config.initializer_range, truncated=True)
 
 __all__ = ["BertConfig", "BertModel", "BertForPretraining",
            "BertForSequenceClassification", "bert_tiny", "bert_base"]
@@ -19,7 +27,8 @@ class BertConfig:
     def __init__(self, vocab_size=30522, hidden_size=768, num_hidden_layers=12,
                  num_attention_heads=12, intermediate_size=3072,
                  max_position_embeddings=512, type_vocab_size=2,
-                 layer_norm_eps=1e-12, dropout=0.1):
+                 layer_norm_eps=1e-12, dropout=0.1, initializer_range=0.02):
+        self.initializer_range = initializer_range
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -34,11 +43,15 @@ class BertConfig:
 class BertEmbeddings(nn.Layer):
     def __init__(self, config):
         super().__init__()
-        self.word_embeddings = nn.Embedding(config.vocab_size, config.hidden_size)
+        wa = _init_attr(config)
+        self.word_embeddings = nn.Embedding(config.vocab_size,
+                                            config.hidden_size, weight_attr=wa)
         self.position_embeddings = nn.Embedding(config.max_position_embeddings,
-                                                config.hidden_size)
+                                                config.hidden_size,
+                                                weight_attr=wa)
         self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
-                                                  config.hidden_size)
+                                                  config.hidden_size,
+                                                  weight_attr=wa)
         self.layer_norm = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
         self.dropout = nn.Dropout(config.dropout)
 
@@ -59,13 +72,14 @@ class BertLayer(nn.Layer):
         h = config.hidden_size
         self.num_heads = config.num_attention_heads
         self.head_dim = h // self.num_heads
-        self.q = nn.Linear(h, h)
-        self.k = nn.Linear(h, h)
-        self.v = nn.Linear(h, h)
-        self.attn_out = nn.Linear(h, h)
+        wa = _init_attr(config)
+        self.q = nn.Linear(h, h, weight_attr=wa)
+        self.k = nn.Linear(h, h, weight_attr=wa)
+        self.v = nn.Linear(h, h, weight_attr=wa)
+        self.attn_out = nn.Linear(h, h, weight_attr=wa)
         self.attn_norm = nn.LayerNorm(h, config.layer_norm_eps)
-        self.ffn1 = nn.Linear(h, config.intermediate_size)
-        self.ffn2 = nn.Linear(config.intermediate_size, h)
+        self.ffn1 = nn.Linear(h, config.intermediate_size, weight_attr=wa)
+        self.ffn2 = nn.Linear(config.intermediate_size, h, weight_attr=wa)
         self.ffn_norm = nn.LayerNorm(h, config.layer_norm_eps)
         self.dropout = nn.Dropout(config.dropout)
 
@@ -89,7 +103,8 @@ class BertModel(nn.Layer):
         self.embeddings = BertEmbeddings(config)
         self.encoder = nn.LayerList([BertLayer(config)
                                      for _ in range(config.num_hidden_layers)])
-        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size,
+                                weight_attr=_init_attr(config))
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None):
@@ -111,9 +126,11 @@ class BertForPretraining(nn.Layer):
     def __init__(self, config: BertConfig):
         super().__init__()
         self.bert = BertModel(config)
-        self.mlm_transform = nn.Linear(config.hidden_size, config.hidden_size)
+        wa = _init_attr(config)
+        self.mlm_transform = nn.Linear(config.hidden_size, config.hidden_size,
+                                       weight_attr=wa)
         self.mlm_norm = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
-        self.nsp_head = nn.Linear(config.hidden_size, 2)
+        self.nsp_head = nn.Linear(config.hidden_size, 2, weight_attr=wa)
         self.config = config
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
@@ -136,7 +153,8 @@ class BertForSequenceClassification(nn.Layer):
     def __init__(self, config: BertConfig, num_classes=2):
         super().__init__()
         self.bert = BertModel(config)
-        self.classifier = nn.Linear(config.hidden_size, num_classes)
+        self.classifier = nn.Linear(config.hidden_size, num_classes,
+                                    weight_attr=_init_attr(config))
         self.dropout = nn.Dropout(config.dropout)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,

@@ -10,6 +10,14 @@ from __future__ import annotations
 from .. import nn
 from ..nn import functional as F
 from ..tensor.manipulation import reshape
+from ._init import transformer_init_attr
+
+
+def _init_attr(config):
+    # GPT-2 init scheme: every weight matrix N(0, initializer_range),
+    # biases zero — nn.Embedding's N(0, 1) default would blow up the
+    # tied-softmax logits (init CE ~10x ln(V))
+    return transformer_init_attr(config.initializer_range)
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny", "gpt3_1p3b"]
 
@@ -18,7 +26,8 @@ class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=2048, num_hidden_layers=24,
                  num_attention_heads=16, intermediate_size=None,
                  max_position_embeddings=2048, layer_norm_eps=1e-5,
-                 dropout=0.0, tie_word_embeddings=True):
+                 dropout=0.0, tie_word_embeddings=True,
+                 initializer_range=0.02):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -28,16 +37,18 @@ class GPTConfig:
         self.layer_norm_eps = layer_norm_eps
         self.dropout = dropout
         self.tie_word_embeddings = tie_word_embeddings
+        self.initializer_range = initializer_range
 
 
 class GPTAttention(nn.Layer):
     def __init__(self, config):
         super().__init__()
         h = config.hidden_size
+        wa = _init_attr(config)
         self.num_heads = config.num_attention_heads
         self.head_dim = h // self.num_heads
-        self.qkv_proj = nn.Linear(h, 3 * h)
-        self.out_proj = nn.Linear(h, h)
+        self.qkv_proj = nn.Linear(h, 3 * h, weight_attr=wa)
+        self.out_proj = nn.Linear(h, h, weight_attr=wa)
         self.dropout = config.dropout
 
     def forward(self, x):
@@ -53,11 +64,14 @@ class GPTAttention(nn.Layer):
 class GPTBlock(nn.Layer):
     def __init__(self, config):
         super().__init__()
+        wa = _init_attr(config)
         self.ln_1 = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
         self.attn = GPTAttention(config)
         self.ln_2 = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
-        self.fc1 = nn.Linear(config.hidden_size, config.intermediate_size)
-        self.fc2 = nn.Linear(config.intermediate_size, config.hidden_size)
+        self.fc1 = nn.Linear(config.hidden_size, config.intermediate_size,
+                             weight_attr=wa)
+        self.fc2 = nn.Linear(config.intermediate_size, config.hidden_size,
+                             weight_attr=wa)
         self.dropout = nn.Dropout(config.dropout)
 
     def forward(self, x):
@@ -70,8 +84,11 @@ class GPTModel(nn.Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.config = config
-        self.wte = nn.Embedding(config.vocab_size, config.hidden_size)
-        self.wpe = nn.Embedding(config.max_position_embeddings, config.hidden_size)
+        wa = _init_attr(config)
+        self.wte = nn.Embedding(config.vocab_size, config.hidden_size,
+                                weight_attr=wa)
+        self.wpe = nn.Embedding(config.max_position_embeddings,
+                                config.hidden_size, weight_attr=wa)
         self.h = nn.LayerList([GPTBlock(config)
                                for _ in range(config.num_hidden_layers)])
         self.ln_f = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
@@ -94,6 +111,7 @@ class GPTForCausalLM(nn.Layer):
         self.gpt = GPTModel(config)
         if not config.tie_word_embeddings:
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     weight_attr=_init_attr(config),
                                      bias_attr=False)
         else:
             self.lm_head = None
@@ -109,6 +127,12 @@ class GPTForCausalLM(nn.Layer):
             loss = F.cross_entropy(logits[:, :-1], labels[:, 1:])
             return loss, logits
         return logits
+
+    def generate(self, input_ids, **kwargs):
+        """Autoregressive decoding (recompute path; see
+        paddle_tpu.generation)."""
+        from ..generation import generate
+        return generate(self, input_ids, **kwargs)
 
 
 def gpt_tiny(**kw):

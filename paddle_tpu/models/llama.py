@@ -179,6 +179,11 @@ class LlamaForCausalLM(nn.Layer):
         import numpy as np
         return sum(int(np.prod(p.shape)) for p in self.parameters())
 
+    def generate(self, input_ids, **kwargs):
+        """Compiled KV-cache decoding (see paddle_tpu.generation)."""
+        from ..generation import generate
+        return generate(self, input_ids, **kwargs)
+
 
 def llama_tiny(**kw):
     """Small config for tests/dry runs."""

@@ -24,7 +24,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ...framework.core import Tensor, execute
+from ...framework.core import execute
 from ...framework import dtypes as _dt
 from ..layer.layers import Layer
 

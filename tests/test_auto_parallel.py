@@ -156,3 +156,42 @@ class TestToDistributed:
         opt.step()
         opt.clear_grad()
         assert np.isfinite(float(loss))
+
+
+class TestPartialPlacement:
+    """Partial placement semantics: DistTensors are global-view, so eager
+    p->r is the identity on values (reference DistTensor materializes the
+    reduced sum too); inside jit, GSPMD inserts the psum that the
+    reference's p_to_r reshard rule performs (row-parallel matmul)."""
+
+    def test_eager_partial_to_replicate_identity(self):
+        mesh = dist.ProcessMesh(shape=[4], dim_names=["mp"])
+        x = paddle.to_tensor(np.arange(8, dtype=np.float32))
+        t = dist.shard_tensor(x, mesh, [dist.Partial()])
+        assert t.placements[0].is_partial()
+        r = dist.reshard(t, mesh, [dist.Replicate()])
+        np.testing.assert_array_equal(r.numpy(), x.numpy())
+        assert r.placements[0].is_replicate()
+
+    def test_compiled_row_parallel_partial_reduces(self):
+        """x sharded on k, w sharded on k: the matmul produces partial sums
+        per mp slice; constraining the output replicated makes GSPMD insert
+        the all-reduce — numerics must match the dense product."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = dist.ProcessMesh(shape=[4], dim_names=["mp"])
+        rs = np.random.RandomState(0)
+        xv = rs.randn(8, 16).astype(np.float32)
+        wv = rs.randn(16, 4).astype(np.float32)
+        xs = jax.device_put(jnp.asarray(xv),
+                            NamedSharding(mesh.jax_mesh, P(None, "mp")))
+        ws = jax.device_put(jnp.asarray(wv),
+                            NamedSharding(mesh.jax_mesh, P("mp", None)))
+
+        @jax.jit
+        def f(a, w):
+            out = a @ w  # partial over mp inside GSPMD
+            return jax.lax.with_sharding_constraint(
+                out, NamedSharding(mesh.jax_mesh, P(None, None)))
+
+        np.testing.assert_allclose(np.asarray(f(xs, ws)), xv @ wv,
+                                   rtol=1e-4, atol=1e-4)

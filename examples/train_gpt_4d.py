@@ -31,6 +31,9 @@ def main():
 
     # -- GSPMD axes: dp x mp x sep x sharding (ZeRO-2) -------------------
     n = len(devices)
+    if n % 4 != 0:
+        raise SystemExit(f"--devices must be a multiple of 4 (mp=2 x sep=2 "
+                         f"x dp={max(n // 4, 1)}); got {n}")
     grid = np.asarray(devices).reshape(1, 2, 2, 1, n // 4)
     mesh = Mesh(grid, ("pp", "mp", "sep", "sharding", "dp"))
     paddle.seed(0)

@@ -46,7 +46,12 @@ class GenerationConfig:
 
 
 # ---------------------------------------------------------------------------
-# pure llama math over stacked params (mirrors models/llama.py exactly)
+# pure llama math over stacked params (mirrors models/llama.py exactly).
+# DELIBERATE duplication: the cache-threaded decode step can't reuse the
+# module forward (functional_call returns no per-layer K/V). Divergence is
+# gated by tests/test_generation.py's exact greedy-parity checks against
+# the module forward (incl. GQA + tied-embedding configs) — change the
+# model math and those tests fail here.
 # ---------------------------------------------------------------------------
 
 
@@ -138,22 +143,23 @@ def _llama_layer_decode(lp, h, k_cache, v_cache, t, cfg):
     return h, k_cache, v_cache
 
 
-def _sample(logits, key, gc: GenerationConfig):
+def _sample(logits, key, gc: GenerationConfig, temperature, top_p):
+    """do_sample / top_k are STRUCTURAL (change the program); temperature
+    and top_p are traced scalars so knob changes never recompile."""
     if not gc.do_sample:
         return jnp.argmax(logits, axis=-1)
-    logits = logits / jnp.maximum(gc.temperature, 1e-6)
+    logits = logits / jnp.maximum(temperature, 1e-6)
     if gc.top_k and gc.top_k > 0:
         kth = jnp.sort(logits, axis=-1)[..., -gc.top_k][..., None]
         logits = jnp.where(logits < kth, -1e30, logits)
-    if gc.top_p < 1.0:
-        probs = jax.nn.softmax(logits, axis=-1)
-        order = jnp.argsort(-probs, axis=-1)
-        sorted_p = jnp.take_along_axis(probs, order, axis=-1)
-        cum = jnp.cumsum(sorted_p, axis=-1)
-        keep_sorted = (cum - sorted_p) < gc.top_p
-        keep = jnp.zeros_like(keep_sorted).at[
-            jnp.arange(logits.shape[0])[:, None], order].set(keep_sorted)
-        logits = jnp.where(keep, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    order = jnp.argsort(-probs, axis=-1)
+    sorted_p = jnp.take_along_axis(probs, order, axis=-1)
+    cum = jnp.cumsum(sorted_p, axis=-1)
+    keep_sorted = (cum - sorted_p) < top_p  # top_p >= 1: keeps everything
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(logits.shape[0])[:, None], order].set(keep_sorted)
+    logits = jnp.where(keep, logits, -1e30)
     return jax.random.categorical(key, logits, axis=-1)
 
 
@@ -166,7 +172,8 @@ def _build_llama_generate(config, tied: bool, gc: GenerationConfig):
                kv_heads=config.num_key_value_heads,
                head_dim=config.hidden_size // config.num_attention_heads)
 
-    def run(stacked, embed_w, norm_w, head_w, input_ids, key):
+    def run(stacked, embed_w, norm_w, head_w, input_ids, key, temperature,
+            top_p):
         def logits_of(h_last):
             h = _rms(h_last, norm_w, cfg["eps"])
             w = embed_w.T if tied else head_w
@@ -190,7 +197,7 @@ def _build_llama_generate(config, tied: bool, gc: GenerationConfig):
 
         first_logits = logits_of(h[:, -1])
         key, sub = jax.random.split(key)
-        first_tok = _sample(first_logits, sub, gc)
+        first_tok = _sample(first_logits, sub, gc, temperature, top_p)
 
         # ---- decode: scan over steps; inner scan over layers ------------
         def step(carry, i):
@@ -206,7 +213,7 @@ def _build_llama_generate(config, tied: bool, gc: GenerationConfig):
             hh, (kc, vc) = jax.lax.scan(dec_layer, hh, (stacked, kc, vc))
             logits = logits_of(hh[:, -1])
             key, sub = jax.random.split(key)
-            nxt = _sample(logits, sub, gc)
+            nxt = _sample(logits, sub, gc, temperature, top_p)
             if gc.eos_token_id is not None:
                 done = done | (tok == gc.eos_token_id)
                 nxt = jnp.where(done, gc.eos_token_id, nxt)
@@ -232,7 +239,8 @@ def _generic_generate(model, input_ids, gc: GenerationConfig, key):
         out = model(Tensor(ids))
         logits = (out[0] if isinstance(out, tuple) else out)._data
         key, sub = jax.random.split(key)
-        nxt = _sample(logits[:, -1].astype(jnp.float32), sub, gc)
+        nxt = _sample(logits[:, -1].astype(jnp.float32), sub, gc,
+                      jnp.float32(gc.temperature), jnp.float32(gc.top_p))
         if gc.eos_token_id is not None:
             nxt = jnp.where(done, gc.eos_token_id, nxt)
             done = done | (nxt == gc.eos_token_id)
@@ -253,8 +261,11 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     ids = input_ids._data if isinstance(input_ids, Tensor) \
         else jnp.asarray(input_ids)
     ids = ids.astype(jnp.int32)
-    key = (jax.random.key(seed) if seed is not None
-           else _random.next_key())
+    if do_sample:
+        key = (jax.random.key(seed) if seed is not None
+               else _random.next_key())
+    else:  # greedy uses no randomness — don't advance the global stream
+        key = jax.random.key(0)
     from .models.llama import LlamaForCausalLM
     if isinstance(model, LlamaForCausalLM):
         from .parallel.functional import split_stacked_layer_params
@@ -265,11 +276,12 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
         stacked, other = split_stacked_layer_params(state)
         tied = "lm_head.weight" not in other
         c = model.config
+        # structural knobs only: temperature/top_p are traced arguments, so
+        # per-request knob changes never recompile
         cache_key = ((c.hidden_size, c.num_hidden_layers,
                       c.num_attention_heads, c.num_key_value_heads,
                       c.vocab_size, c.rms_norm_eps, c.rope_theta, tied),
-                     max_new_tokens, do_sample, float(temperature),
-                     int(top_k), float(top_p), eos_token_id)
+                     max_new_tokens, do_sample, int(top_k), eos_token_id)
         cached = _GEN_CACHE.get(cache_key)
         if cached is None:
             cached = _build_llama_generate(c, tied, gc)
@@ -278,7 +290,8 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
         if head_w is None:  # jit needs a concrete leaf; tied path ignores it
             head_w = jnp.zeros((0,), jnp.float32)
         return Tensor(cached(stacked, other["llama.embed_tokens.weight"],
-                             other["llama.norm.weight"], head_w, ids, key))
+                             other["llama.norm.weight"], head_w, ids, key,
+                             jnp.float32(temperature), jnp.float32(top_p)))
     return Tensor(_generic_generate(model, ids, gc, key))
 
 

@@ -379,22 +379,36 @@ class DataLoader:
             yield self.collate_fn(batch)
 
     def _iter_threaded(self):
+        """Thread-pool fetch with a BOUNDED in-flight window: at most
+        num_workers * prefetch_factor batches are fetched ahead of the
+        consumer (the reference's prefetch_factor contract,
+        io/dataloader/dataloader_iter.py) — without the bound, workers race
+        arbitrarily far ahead and buffer the whole epoch in memory."""
         idx_queue: queue.Queue = queue.Queue()
         out: dict[int, object] = {}
         done = threading.Event()
         lock = threading.Lock()
         cond = threading.Condition(lock)
+        window = threading.Semaphore(
+            max(self.num_workers * max(self.prefetch_factor, 1), 1))
         batches = list(self.batch_sampler)
         for i, b in enumerate(batches):
             idx_queue.put((i, b))
 
         def worker():
             while not done.is_set():
+                # bounded wait so shutdown can't strand a worker in acquire
+                if not window.acquire(timeout=0.1):
+                    continue
                 try:
                     i, b = idx_queue.get_nowait()
                 except queue.Empty:
+                    window.release()
                     return
-                data = self._fetch(b)
+                try:
+                    data = self._fetch(b)
+                except BaseException as e:  # surface in the consumer
+                    data = _WorkerError(e)
                 with cond:
                     out[i] = data
                     cond.notify_all()
@@ -408,6 +422,17 @@ class DataLoader:
                 with cond:
                     while i not in out:
                         cond.wait(timeout=60)
-                    yield out.pop(i)
+                    data = out.pop(i)
+                window.release()  # consumed: admit the next fetch
+                if isinstance(data, _WorkerError):
+                    raise data.exc  # same behavior as num_workers=0
+                yield data
         finally:
             done.set()
+
+
+class _WorkerError:
+    """Exception captured in a loader worker, re-raised by the consumer."""
+
+    def __init__(self, exc):
+        self.exc = exc

@@ -173,3 +173,45 @@ class TestReviewRegressions:
         back, _ = paddle.audio.backends.load(p)
         assert back.numpy()[0, 0] > 0.99  # full-scale stays positive
         np.testing.assert_allclose(back.numpy()[0], sig[0], atol=1e-6)
+
+
+class TestBoundedPrefetch:
+    """Threaded DataLoader must honor prefetch_factor: in-flight fetched
+    batches never exceed num_workers * prefetch_factor (reference
+    dataloader_iter prefetch contract)."""
+
+    def test_window_bound_and_order(self):
+        import threading
+        import time
+
+        fetched = []
+        consumed = []
+        lock = threading.Lock()
+        max_ahead = [0]
+
+        class DS:
+            def __len__(self):
+                return 64
+
+            def __getitem__(self, i):
+                with lock:
+                    fetched.append(i)
+                    ahead = len(fetched) - len(consumed)
+                    max_ahead[0] = max(max_ahead[0], ahead)
+                return np.full((4,), i, np.float32)
+
+        loader = paddle.io.DataLoader(DS(), batch_size=4, num_workers=2,
+                                      prefetch_factor=2)
+        seen = []
+        for batch in loader:
+            time.sleep(0.005)  # slow consumer: workers would race ahead
+            with lock:
+                consumed.extend([0] * 4)
+            arr = np.asarray(batch._data if hasattr(batch, "_data")
+                             else batch)
+            seen.append(int(arr[0, 0]))  # first item id of the batch
+        assert len(seen) == 16
+        assert seen == sorted(seen)  # order preserved
+        # bound: window batches * batch_size items, plus one batch of slack
+        # for items fetched concurrently at the boundary
+        assert max_ahead[0] <= (2 * 2 + 1) * 4 + 4, max_ahead[0]

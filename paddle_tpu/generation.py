@@ -144,22 +144,24 @@ def _llama_layer_decode(lp, h, k_cache, v_cache, t, cfg):
 
 
 def _sample(logits, key, gc: GenerationConfig, temperature, top_p):
-    """do_sample / top_k are STRUCTURAL (change the program); temperature
-    and top_p are traced scalars so knob changes never recompile."""
+    """do_sample / top_k / whether-top-p-filters are STRUCTURAL (change the
+    program); the temperature and top_p VALUES are traced scalars so knob
+    changes within a variant never recompile."""
     if not gc.do_sample:
         return jnp.argmax(logits, axis=-1)
     logits = logits / jnp.maximum(temperature, 1e-6)
     if gc.top_k and gc.top_k > 0:
         kth = jnp.sort(logits, axis=-1)[..., -gc.top_k][..., None]
         logits = jnp.where(logits < kth, -1e30, logits)
-    probs = jax.nn.softmax(logits, axis=-1)
-    order = jnp.argsort(-probs, axis=-1)
-    sorted_p = jnp.take_along_axis(probs, order, axis=-1)
-    cum = jnp.cumsum(sorted_p, axis=-1)
-    keep_sorted = (cum - sorted_p) < top_p  # top_p >= 1: keeps everything
-    keep = jnp.zeros_like(keep_sorted).at[
-        jnp.arange(logits.shape[0])[:, None], order].set(keep_sorted)
-    logits = jnp.where(keep, logits, -1e30)
+    if gc.top_p < 1.0:  # top_p == 1 skips the full-vocab sort entirely
+        probs = jax.nn.softmax(logits, axis=-1)
+        order = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, order, axis=-1)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        keep_sorted = (cum - sorted_p) < top_p
+        keep = jnp.zeros_like(keep_sorted).at[
+            jnp.arange(logits.shape[0])[:, None], order].set(keep_sorted)
+        logits = jnp.where(keep, logits, -1e30)
     return jax.random.categorical(key, logits, axis=-1)
 
 
@@ -281,7 +283,8 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
         cache_key = ((c.hidden_size, c.num_hidden_layers,
                       c.num_attention_heads, c.num_key_value_heads,
                       c.vocab_size, c.rms_norm_eps, c.rope_theta, tied),
-                     max_new_tokens, do_sample, int(top_k), eos_token_id)
+                     max_new_tokens, do_sample, int(top_k),
+                     top_p < 1.0, eos_token_id)
         cached = _GEN_CACHE.get(cache_key)
         if cached is None:
             cached = _build_llama_generate(c, tied, gc)

@@ -220,6 +220,19 @@ def _interpret_default():
     return jax.default_backend() != "tpu"
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying `like`'s varying-manual-axes: inside a
+    new-style shard_map (check_vma), pallas_call outputs must declare how
+    they vary over the mesh (e.g. the ring-attention 'sep' axis)."""
+    try:
+        vma = jax.typeof(like).vma
+    except Exception:
+        vma = None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _block_sizes(sq, sk, block_q, block_k):
     return min(block_q, sq), min(block_k, sk)
 
@@ -304,8 +317,8 @@ def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=128, block_k=128,
             pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq_p), jnp.float32),
+            _sds((bh, sq_p, d), q.dtype, q),
+            _sds((bh, sq_p), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -358,7 +371,7 @@ def _flash_bwd_bhsd(q, k, v, o, lse, g, causal, scale, block_q=128,
             pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype),
+        out_shape=_sds((bh, sq_p, d), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(q_p, k_p, v_p, do_p, lse_p, delta_p)
@@ -379,8 +392,8 @@ def _flash_bwd_bhsd(q, k, v, o, lse, g, causal, scale, block_q=128,
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk_p, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk_p, d), v.dtype),
+            _sds((bh, sk_p, d), k.dtype, k),
+            _sds((bh, sk_p, d), v.dtype, v),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),

@@ -53,7 +53,7 @@ def build_scanned_llama(model, remat: bool = True, dtype=None):
     eps = cfg.rms_norm_eps
 
     def layer_body(h, lp):
-        h = functional_call(template, lp, Tensor(h))
+        h = functional_call(template, lp, h)
         return h, None
 
     body = jax.checkpoint(layer_body) if remat else layer_body

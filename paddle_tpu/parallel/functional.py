@@ -38,7 +38,19 @@ def functional_call(model, params: dict, *args, rng_key=None, training=True,
         model.eval()
 
     def wrap(a):
-        return Tensor(a) if isinstance(a, jax.Array) or hasattr(a, "dtype") else a
+        # stop_gradient=False is load-bearing: Tensor's default (True) would
+        # make execute() place a lax.stop_gradient barrier on this input
+        # inside the trace (core.py TraceContext branch), silently severing
+        # the chain rule at every functional_call boundary — per-layer
+        # compositions (scanned llama, pipeline stage_fn) would train only
+        # their last block. Inputs to a functional jax-facing API are
+        # differentiable by definition; integer/bool inputs are excluded
+        # from diff by dtype anyway.
+        if isinstance(a, Tensor):
+            return Tensor(a._data, stop_gradient=False)
+        if isinstance(a, jax.Array) or hasattr(a, "dtype"):
+            return Tensor(a, stop_gradient=False)
+        return a
 
     try:
         for name, t in state.items():

@@ -19,7 +19,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..framework.core import Tensor
 from .functional import (functional_call, rmsnorm_lm_loss,
                          split_stacked_layer_params)
-from .pipeline import OneFOneBPipeline, PipelinedLM
+from .pipeline import (InterleavedPipelinedLM, OneFOneBPipeline,
+                       PipelinedLM)
 
 __all__ = ["LlamaPipeRunner"]
 
@@ -28,8 +29,11 @@ class LlamaPipeRunner:
     """Run a LlamaForCausalLM under a pipeline schedule.
 
     schedule: "FThenB" (fill-drain + autodiff backward; reference FThenB /
-    GPipe) or "1F1B" (hand-scheduled one-forward-one-backward with the O(P)
-    activation bound; reference pipeline_parallel.py:575). Tied embeddings
+    GPipe), "1F1B" (hand-scheduled one-forward-one-backward with the O(P)
+    activation bound; reference pipeline_parallel.py:575), or "VPP"
+    (interleaved virtual stages, num_chunks chunks per physical stage;
+    reference PipelineParallelWithInterleave:1174 — shrinks the fill
+    bubble by the chunk count). Tied embeddings
     (config.tie_word_embeddings) are supported under 1F1B only — the schedule
     routes the head's embedding cotangent into the embedding gradient
     (reference SharedLayerDesc, pp_layers.py:76).
@@ -37,32 +41,48 @@ class LlamaPipeRunner:
 
     def __init__(self, model, mesh: Mesh, num_microbatches: int,
                  axis_name: str = "pp", batch_axis: str | None = None,
-                 optimizer=None, schedule: str | None = None):
+                 optimizer=None, schedule: str | None = None,
+                 num_chunks: int = 2):
         self.model = model
         self.mesh = mesh
         self.axis = axis_name
         if schedule is None:
             from ..framework import flags as _flags
             schedule = _flags.flag_value("pipeline_schedule")
-        schedule = {"fthenb": "FThenB", "1f1b": "1F1B"}.get(
+        schedule = {"fthenb": "FThenB", "1f1b": "1F1B", "vpp": "VPP",
+                    "interleaved": "VPP"}.get(
             schedule.lower().replace("-", ""), schedule)
-        if schedule not in ("FThenB", "1F1B"):
+        if schedule not in ("FThenB", "1F1B", "VPP"):
             raise ValueError(f"unknown pipeline schedule: {schedule!r} "
-                             "(expected 'FThenB' or '1F1B')")
+                             "(expected 'FThenB', '1F1B' or 'VPP')")
         self.schedule = schedule
         cfg = model.config
         pp = mesh.shape[axis_name]
         L = cfg.num_hidden_layers
-        assert L % pp == 0, f"layers {L} must divide pp {pp}"
-        self.layers_per_stage = L // pp
         self.optimizer = optimizer
+        if schedule == "VPP":
+            v = num_chunks
+            assert L % (pp * v) == 0, (
+                f"layers {L} must divide pp*num_chunks {pp}*{v}")
+            self.layers_per_stage = L // (pp * v)
+            self.num_chunks = v
+        else:
+            assert L % pp == 0, f"layers {L} must divide pp {pp}"
+            self.layers_per_stage = L // pp
 
         state = {k: v._data for k, v in model.state_dict().items()}
         stacked, other = split_stacked_layer_params(state)
-        # reshape layer params: (L, ...) -> (pp, L/pp, ...), sharded on pp
         self.stage_params = {}
         for name, arr in stacked.items():
-            arr = arr.reshape((pp, self.layers_per_stage) + arr.shape[1:])
+            if schedule == "VPP":
+                # (L, ...) -> (pp, V, Lv, ...): element [s, c] holds the
+                # layers of virtual stage vs = c*pp + s, i.e. layer index
+                # (c*pp + s)*Lv + j — vs-major is (V, pp, Lv), transposed
+                lv = self.layers_per_stage
+                arr = arr.reshape((self.num_chunks, pp, lv) + arr.shape[1:])
+                arr = jnp.swapaxes(arr, 0, 1)
+            else:
+                arr = arr.reshape((pp, self.layers_per_stage) + arr.shape[1:])
             self.stage_params[name] = jax.device_put(
                 arr, NamedSharding(mesh, P(*( [axis_name] + [None] * (arr.ndim - 1)))))
         rep = NamedSharding(mesh, P())
@@ -86,7 +106,7 @@ class LlamaPipeRunner:
             # sp leaves: (lps, ...) local slice; apply lps layers sequentially
             for i in range(lps):
                 layer_params = {k: v[i] for k, v in sp.items()}
-                h = functional_call(self._layer_template, layer_params, Tensor(h))
+                h = functional_call(self._layer_template, layer_params, h)
             return h
 
         tied = "lm_head" not in self.head_params
@@ -118,6 +138,13 @@ class LlamaPipeRunner:
                     mesh, embed_fn, stage_fn, head_loss_fn,
                     num_microbatches, axis_name,
                     batch_axis=batch_axis).loss_fn()
+        elif schedule == "VPP":
+            self._plm = InterleavedPipelinedLM(
+                mesh, embed_fn, stage_fn, head_loss_fn,
+                num_microbatches, self.num_chunks, axis_name,
+                batch_axis=batch_axis)
+            self._loss_fn = self._plm.loss_fn()
+            self._grads_fn = None
         else:
             self._plm = PipelinedLM(mesh, embed_fn, stage_fn, head_loss_fn,
                                     num_microbatches, axis_name,

@@ -33,7 +33,7 @@ except AttributeError:  # older spelling
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 __all__ = ["pipeline_forward", "pipeline_1f1b_grads", "PipelinedLM",
-           "OneFOneBPipeline"]
+           "OneFOneBPipeline", "InterleavedPipelinedLM"]
 
 
 def _pvary(x, axes):
@@ -410,6 +410,12 @@ class PipelinedLM:
         self.batch_axis = batch_axis  # optional dp axis: batch sharded
         self.remat = remat
 
+    def _pipeline_forward(self, stage_p, h_mb, p_size, vary):
+        """The schedule hook — subclasses swap the forward program."""
+        return pipeline_forward(self.stage_fn, stage_p, h_mb, self.axis,
+                                p_size=p_size, remat=self.remat,
+                                vary_axes=vary)
+
     def loss_fn(self):
         axis = self.axis
         m = self.m
@@ -427,9 +433,7 @@ class PipelinedLM:
                 lab_mb = lab.reshape((m, b // m) + lab.shape[1:])
                 h_mb = jax.vmap(lambda t: self.embed_fn(embed_p, t))(tok_mb)
                 vary = (axis,) + ((batch_axis,) if batch_axis else ())
-                out = pipeline_forward(self.stage_fn, stage_p, h_mb,
-                                       axis, p_size=p_size, remat=self.remat,
-                                       vary_axes=vary)
+                out = self._pipeline_forward(stage_p, h_mb, p_size, vary)
                 losses = jax.vmap(
                     lambda h, l: self.head_loss_fn(head_p, h, l))(out, lab_mb)
                 # only the last stage holds real outputs; other stages
@@ -457,3 +461,30 @@ class PipelinedLM:
             return jnp.sum(partials)
 
         return spmd_loss
+
+
+class InterleavedPipelinedLM(PipelinedLM):
+    """Interleaved (VPP) pipelined LM: each physical stage holds
+    `num_chunks` model chunks, shrinking the pipeline fill relative to
+    fill-drain by the chunk count. Backward comes from autodiff of the
+    interleaved scan. reference: PipelineParallelWithInterleave
+    (fleet/meta_parallel/pipeline_parallel.py:1174).
+
+    Parameter layout: stage params stacked (pp, num_chunks, Lv, ...) with
+    the leading dim sharded on 'pp' — element [s, c] holds virtual stage
+    v = c*pp + s. Everything else (microbatching, loss masking, specs)
+    is PipelinedLM's; only the forward program differs.
+    """
+
+    def __init__(self, mesh: Mesh, embed_fn, stage_fn, head_loss_fn,
+                 num_microbatches: int, num_chunks: int,
+                 axis_name: str = "pp", batch_axis: str | None = None,
+                 remat: bool = True):
+        super().__init__(mesh, embed_fn, stage_fn, head_loss_fn,
+                         num_microbatches, axis_name, batch_axis, remat)
+        self.v = num_chunks
+
+    def _pipeline_forward(self, stage_p, h_mb, p_size, vary):
+        return pipeline_forward_interleaved(
+            self.stage_fn, stage_p, h_mb, self.axis, p_size=p_size,
+            num_chunks=self.v, remat=self.remat, vary_axes=vary)

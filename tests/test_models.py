@@ -313,3 +313,31 @@ class TestRingFlashAttention:
         for gd, gf in zip(g_dense, g_flash):
             np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
                                        rtol=2e-3, atol=2e-3)
+
+
+class TestScannedLlamaGrads:
+    def test_scanned_grads_match_eager(self):
+        """Regression: the scan-over-layers body used to sever the chain
+        rule at each layer boundary (functional_call stop_gradient
+        barrier) — embedding and all but the last layer got zero grads."""
+        import jax
+        import jax.numpy as jnp
+        paddle.seed(0)
+        model = paddle.models.llama_tiny(num_hidden_layers=4)
+        from paddle_tpu.models.scanned import build_scanned_llama
+        params, loss_fn = build_scanned_llama(model, remat=False)
+        ids = jnp.asarray(np.random.RandomState(1).randint(0, 512, (4, 16)),
+                          jnp.int32)
+        g = jax.jit(jax.grad(loss_fn))(params, ids, ids)
+        el, _ = model(paddle.Tensor(ids), labels=paddle.Tensor(ids))
+        el.backward()
+        np.testing.assert_allclose(
+            np.asarray(g["embed"]["weight"]),
+            np.asarray(model.llama.embed_tokens.weight.grad._data),
+            rtol=1e-4, atol=1e-6)
+        for layer in (0, 3):
+            np.testing.assert_allclose(
+                np.asarray(g["layers"]["self_attn.q_proj.weight"])[layer],
+                np.asarray(model.llama.layers[layer]
+                           .self_attn.q_proj.weight.grad._data),
+                rtol=1e-4, atol=1e-6, err_msg=f"layer {layer}")

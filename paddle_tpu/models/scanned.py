@@ -16,7 +16,6 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..framework.core import Tensor
 from ..parallel.functional import (functional_call, rmsnorm_lm_loss,
                                    split_stacked_layer_params)
 

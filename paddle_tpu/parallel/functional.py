@@ -47,7 +47,9 @@ def functional_call(model, params: dict, *args, rng_key=None, training=True,
         # differentiable by definition; integer/bool inputs are excluded
         # from diff by dtype anyway.
         if isinstance(a, Tensor):
-            return Tensor(a._data, stop_gradient=False)
+            # preserve the caller's flag: an EXPLICIT detach() must keep its
+            # barrier; only raw arrays get the differentiable default
+            return Tensor(a._data, stop_gradient=a.stop_gradient)
         if isinstance(a, jax.Array) or hasattr(a, "dtype"):
             return Tensor(a, stop_gradient=False)
         return a

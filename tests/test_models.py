@@ -341,3 +341,28 @@ class TestScannedLlamaGrads:
                 np.asarray(model.llama.layers[layer]
                            .self_attn.q_proj.weight.grad._data),
                 rtol=1e-4, atol=1e-6, err_msg=f"layer {layer}")
+
+    def test_functional_call_honors_explicit_detach(self):
+        """Raw-array inputs are differentiable (the grad-severing fix), but
+        an EXPLICIT detach() barrier passed as a Tensor must be kept."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.parallel.functional import functional_call
+        from paddle_tpu import nn
+        paddle.seed(0)
+        lin = nn.Linear(4, 4)
+        params = {k: v._data for k, v in lin.state_dict().items()}
+        x = jnp.ones((2, 4), jnp.float32)
+
+        def loss_raw(xx):
+            return jnp.sum(functional_call(lin, params, xx) ** 2)
+
+        def loss_detached(xx):
+            t = paddle.Tensor(xx)
+            t.stop_gradient = True  # deliberate barrier
+            return jnp.sum(functional_call(lin, params, t) ** 2)
+
+        g_raw = jax.grad(loss_raw)(x)
+        g_det = jax.grad(loss_detached)(x)
+        assert float(jnp.abs(g_raw).max()) > 0
+        assert float(jnp.abs(g_det).max()) == 0

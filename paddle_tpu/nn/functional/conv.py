@@ -93,9 +93,21 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
     sd = _tuple(stride, n)
     dd = _tuple(dilation, n)
     opad = _tuple(output_padding, n) if output_padding is not None else (0,) * n
+    same_pad = False
     if isinstance(padding, str):
-        raise NotImplementedError("string padding for conv_transpose")
-    pad = _padding(padding, n, data_format)
+        up = padding.upper()
+        if up == "VALID":
+            pad = [(0, 0)] * n
+        elif up == "SAME":
+            # paddle SAME for transpose conv: output = input * stride;
+            # total pad per dim = k_eff - stride (clamped), split low/high
+            same_pad = True
+            pad = None  # derived from the kernel size inside f
+        else:
+            raise ValueError(f"padding must be SAME/VALID or ints, got "
+                             f"{padding!r}")
+    else:
+        pad = _padding(padding, n, data_format)
     if data_format in ("NCHW", "NCL", "NCDHW"):
         lhs_spec = "NC" + "DHW"[3 - n:]
     else:
@@ -108,8 +120,33 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
     def f(a, w, *rest):
         # grad-of-conv formulation: lhs_dilation = stride
         k_eff = [dd[i] * (w.shape[2 + i] - 1) + 1 for i in range(n)]
-        tpad = [(k_eff[i] - 1 - pad[i][0], k_eff[i] - 1 - pad[i][1] + opad[i])
+        if same_pad:
+            # out = in * stride exactly: when k_eff < stride the deficit
+            # goes NEGATIVE on the high side, which EXTENDS tpad below
+            totals = [k_eff[i] - sd[i] for i in range(n)]
+            pads = [(max(t, 0) // 2, t - max(t, 0) // 2) for t in totals]
+        else:
+            pads = pad
+        tpad = [(k_eff[i] - 1 - pads[i][0],
+                 k_eff[i] - 1 - pads[i][1] + opad[i])
                 for i in range(n)]
+        if output_size is not None:
+            # paddle contract: output_size picks the exact inverse-conv
+            # size within [default, default + stride) — realized by
+            # extending the high-side transpose pad (values there are real
+            # conv outputs over the dilated input border, not zero fill)
+            osz = output_size if isinstance(output_size, (list, tuple)) \
+                else (output_size,) * n
+            sp0 = 2 if lhs_spec.startswith("NC") else 1
+            for i in range(n):
+                cur = ((a.shape[sp0 + i] - 1) * sd[i] + 1 + tpad[i][0]
+                       + tpad[i][1] - (k_eff[i] - 1))
+                extra = int(osz[i]) - cur
+                if not (0 <= extra < max(sd[i], 1) + opad[i] + 1):
+                    raise ValueError(
+                        f"output_size[{i}]={osz[i]} not reachable: valid "
+                        f"range [{cur}, {cur + max(sd[i], 1)})")
+                tpad[i] = (tpad[i][0], tpad[i][1] + extra)
         if groups > 1:
             # grouped transpose: split and concat along channel axis
             ci = 1 if lhs_spec.startswith("NC") else a.ndim - 1
@@ -142,9 +179,6 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
             ci = 1 if lhs_spec.startswith("NC") else out.ndim - 1
             shape[ci] = b.shape[0]
             out = out + b.reshape(shape)
-        if output_size is not None:
-            # crop/verify
-            pass
         return out
 
     args = [x, weight] + ([bias] if bias is not None else [])

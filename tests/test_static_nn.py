@@ -318,3 +318,39 @@ class TestDataNormEmbeddingDtype:
         ids = paddle.to_tensor(np.array([[0, 1]], np.int64))
         out = nn.embedding(ids, (4, 8), dtype="float16")
         assert "float16" in str(out.numpy().dtype)
+
+
+class TestConvTransposeStringPadding:
+    """reference: conv2d_transpose padding='SAME'/'VALID'
+    (nn/functional/conv.py) — SAME gives out = in * stride."""
+
+    def test_same_and_valid(self):
+        import paddle_tpu.nn.functional as F
+        x = _x((1, 3, 8, 8), 0)
+        w = _x((3, 4, 3, 3), 1)
+        same = F.conv2d_transpose(x, w, stride=2, padding="SAME")
+        assert tuple(same.shape) == (1, 4, 16, 16)
+        valid = F.conv2d_transpose(x, w, stride=2, padding="VALID")
+        zero = F.conv2d_transpose(x, w, stride=2, padding=0)
+        np.testing.assert_allclose(valid.numpy(), zero.numpy(), rtol=1e-6)
+        with pytest.raises(ValueError, match="SAME/VALID"):
+            F.conv2d_transpose(x, w, padding="weird")
+
+    def test_same_with_small_kernel_and_output_size(self):
+        """SAME must give out = in*stride even when k_eff < stride (deficit
+        extends the high-side pad); output_size picks the exact size within
+        [default, default+stride) and errors outside it."""
+        import paddle_tpu.nn.functional as F
+        x = _x((1, 3, 8, 8), 0)
+        w1 = _x((3, 4, 1, 1), 2)
+        assert tuple(F.conv2d_transpose(
+            x, w1, stride=2, padding="SAME").shape) == (1, 4, 16, 16)
+        w3 = _x((3, 4, 3, 3), 3)
+        base = F.conv2d_transpose(x, w3, stride=2)       # (17, 17)
+        o18 = F.conv2d_transpose(x, w3, stride=2, output_size=(18, 18))
+        assert tuple(o18.shape) == (1, 4, 18, 18)
+        # the extension adds real conv outputs, not a relayout of the base
+        np.testing.assert_allclose(o18.numpy()[:, :, :17, :17], base.numpy(),
+                                   rtol=1e-6)
+        with pytest.raises(ValueError, match="not reachable"):
+            F.conv2d_transpose(x, w3, stride=2, output_size=(40, 40))

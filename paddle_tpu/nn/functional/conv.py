@@ -134,7 +134,12 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
             # paddle contract: output_size picks the exact inverse-conv
             # size within [default, default + stride) — realized by
             # extending the high-side transpose pad (values there are real
-            # conv outputs over the dilated input border, not zero fill)
+            # conv outputs over the dilated input border, not zero fill) —
+            # and is mutually exclusive with output_padding
+            if any(o != 0 for o in opad):
+                raise ValueError(
+                    "output_padding must not be set when output_size is "
+                    "specified")
             osz = output_size if isinstance(output_size, (list, tuple)) \
                 else (output_size,) * n
             sp0 = 2 if lhs_spec.startswith("NC") else 1
@@ -142,7 +147,7 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
                 cur = ((a.shape[sp0 + i] - 1) * sd[i] + 1 + tpad[i][0]
                        + tpad[i][1] - (k_eff[i] - 1))
                 extra = int(osz[i]) - cur
-                if not (0 <= extra < max(sd[i], 1) + opad[i] + 1):
+                if not (0 <= extra < max(sd[i], 1)):
                     raise ValueError(
                         f"output_size[{i}]={osz[i]} not reachable: valid "
                         f"range [{cur}, {cur + max(sd[i], 1)})")

@@ -66,10 +66,22 @@ def _sparse_softmax(x, axis=-1):
         raise NotImplementedError("sparse softmax: last axis only")
     want_csr = isinstance(x, SparseCsrTensor)
     coo = coalesce(x.to_sparse_coo())
-    if len(coo._shape) != 2:
-        raise NotImplementedError("sparse softmax: 2D only")
-    rows = coo._indices[0]
-    nrows = coo._shape[0]
+    nd = len(coo._shape)
+    if nd < 2:
+        raise NotImplementedError("sparse softmax needs >= 2 dims")
+    if int(coo._indices.shape[0]) != nd:
+        raise NotImplementedError(
+            "sparse softmax: hybrid COO (dense trailing dims) not "
+            "supported — the softmax axis must be a sparse dim")
+    # row id = linearized leading indices (batch dims x row) — ND support
+    # (reference softmax_kernel handles batched CSR the same way)
+    row_sizes = coo._shape[:-1]
+    nrows = 1
+    for s in row_sizes:
+        nrows *= int(s)
+    import numpy as _np
+    strides = _np.cumprod([1] + [int(s) for s in row_sizes[::-1]])[::-1][1:]
+    rows = sum(coo._indices[i] * int(strides[i]) for i in range(nd - 1))
 
     def f(vals):
         row_max = jax.ops.segment_max(vals, rows, num_segments=nrows)

@@ -265,3 +265,28 @@ class TestReviewRegressions:
         subm = paddle.sparse.nn.SubmConv3D(3, 2, kernel_size=3, padding=1)
         out2 = subm(sp)
         assert out2.nnz() == 1
+
+
+class TestBatchedSparseSoftmax:
+    def test_3d_matches_dense(self):
+        """Batched (3D) sparse softmax over the sparsity pattern must match
+        the dense row softmax restricted to the nonzero positions."""
+        import paddle_tpu.sparse as sparse
+        rs = np.random.RandomState(0)
+        dense = rs.randn(2, 4, 6).astype(np.float32)
+        mask = rs.rand(2, 4, 6) < 0.5
+        dense = dense * mask
+        idx = np.stack(np.nonzero(mask))
+        vals = dense[mask]
+        t = paddle.sparse.sparse_coo_tensor(idx, vals, shape=(2, 4, 6))
+        out = sparse.nn.functional.softmax(t, axis=-1)
+        got = np.asarray(out.to_dense().numpy())
+        for b in range(2):
+            for r in range(4):
+                nz = mask[b, r]
+                if not nz.any():
+                    continue
+                e = np.exp(dense[b, r][nz] - dense[b, r][nz].max())
+                ref = e / e.sum()
+                np.testing.assert_allclose(got[b, r][nz], ref, rtol=1e-5,
+                                           atol=1e-6)

@@ -29,6 +29,7 @@ from .auto_parallel import (  # noqa: F401
     DistModel, Engine, Strategy, to_static)
 from .auto_tuner import AutoTuner, TunerConfig  # noqa: F401
 from .store import Store, TCPStore  # noqa: F401
+from . import communication  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401

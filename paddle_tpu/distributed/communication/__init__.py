@@ -1,0 +1,10 @@
+"""paddle.distributed.communication — collective API package.
+
+reference: python/paddle/distributed/communication/ — the collective
+functions live flat on paddle.distributed here (collective.py); this
+package provides the `stream` namespace for API parity.
+"""
+
+from . import stream  # noqa: F401
+
+__all__ = ["stream"]

@@ -1,0 +1,97 @@
+"""paddle.distributed.communication.stream — stream-ordered collectives.
+
+reference: python/paddle/distributed/communication/stream/ (all_gather.py,
+all_reduce.py, ... 11 entry points) — collectives enqueued on a chosen
+CUDA stream with `use_calc_stream` picking compute-stream ordering.
+
+TPU-native: XLA orders collectives by DATA DEPENDENCY inside the compiled
+program — there is no user-visible stream to select, and the dependency
+order IS the calc-stream order the reference's use_calc_stream=True asks
+for. Each wrapper therefore runs the plain collective and returns its
+(already completed) task handle; `use_calc_stream` is accepted and
+ignored. reference semantics preserved: sync_op=False returns a waitable
+task.
+"""
+
+from __future__ import annotations
+
+from .. import collective as _c
+
+__all__ = ["all_gather", "all_reduce", "alltoall", "alltoall_single",
+           "broadcast", "reduce", "reduce_scatter", "recv", "scatter",
+           "send", "gather"]
+
+
+def _streamed(fn, *args, sync_op=True, use_calc_stream=False, **kwargs):
+    if use_calc_stream and not sync_op:
+        # reference contract (stream/all_reduce.py:152): calc-stream
+        # ordering only exists for sync ops
+        raise RuntimeError(
+            "use_calc_stream can only be true in sync op behavior.")
+    return fn(*args, sync_op=sync_op, **kwargs)
+
+
+def all_reduce(tensor, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _streamed(_c.all_reduce, tensor, op, group, sync_op=sync_op,
+                     use_calc_stream=use_calc_stream)
+
+
+def all_gather(tensor_or_tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _streamed(_c.all_gather, tensor_or_tensor_list, tensor, group,
+                     sync_op=sync_op, use_calc_stream=use_calc_stream)
+
+
+def alltoall(out_tensor_or_tensor_list, in_tensor_or_tensor_list, group=None,
+             sync_op=True, use_calc_stream=False):
+    return _streamed(_c.all_to_all, out_tensor_or_tensor_list,
+                     in_tensor_or_tensor_list, group, sync_op=sync_op,
+                     use_calc_stream=use_calc_stream)
+
+
+def alltoall_single(out_tensor, in_tensor, out_split_sizes=None,
+                    in_split_sizes=None, group=None, sync_op=True,
+                    use_calc_stream=False):
+    return _c.all_to_all_single(out_tensor, in_tensor, out_split_sizes,
+                                in_split_sizes, group, sync_op)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True,
+              use_calc_stream=False):
+    return _streamed(_c.broadcast, tensor, src, group, sync_op=sync_op,
+                     use_calc_stream=use_calc_stream)
+
+
+def reduce(tensor, dst=0, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+           use_calc_stream=False):
+    return _streamed(_c.reduce, tensor, dst, op, group, sync_op=sync_op,
+                     use_calc_stream=use_calc_stream)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=_c.ReduceOp.SUM,
+                   group=None, sync_op=True, use_calc_stream=False):
+    return _streamed(_c.reduce_scatter, tensor, tensor_or_tensor_list, op,
+                     group, sync_op=sync_op, use_calc_stream=use_calc_stream)
+
+
+def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
+            sync_op=True, use_calc_stream=False):
+    return _streamed(_c.scatter, tensor, tensor_or_tensor_list, src, group,
+                     sync_op=sync_op, use_calc_stream=use_calc_stream)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True,
+           use_calc_stream=False):
+    return _streamed(_c.gather, tensor, gather_list, dst, group,
+                     sync_op=sync_op, use_calc_stream=use_calc_stream)
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+    return _streamed(_c.send, tensor, dst, group, sync_op=sync_op,
+                     use_calc_stream=use_calc_stream)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    return _streamed(_c.recv, tensor, src, group, sync_op=sync_op,
+                     use_calc_stream=use_calc_stream)

@@ -97,8 +97,11 @@ class Conv1DTranspose(_ConvNd):
                          "zeros", weight_attr, bias_attr, data_format)
 
     def forward(self, x, output_size=None):
+        # reference layer contract: output_size overrides output_padding
+        # (paddle/nn/layer/conv.py zeroes it when output_size is given)
+        opad = 0 if output_size is not None else self._output_padding
         return F.conv1d_transpose(x, self.weight, self.bias, self._stride,
-                                  self._padding, self._output_padding,
+                                  self._padding, opad,
                                   self._groups, self._dilation, output_size,
                                   self._data_format)
 
@@ -112,8 +115,11 @@ class Conv2DTranspose(_ConvNd):
                          "zeros", weight_attr, bias_attr, data_format)
 
     def forward(self, x, output_size=None):
+        # reference layer contract: output_size overrides output_padding
+        # (paddle/nn/layer/conv.py zeroes it when output_size is given)
+        opad = 0 if output_size is not None else self._output_padding
         return F.conv2d_transpose(x, self.weight, self.bias, self._stride,
-                                  self._padding, self._output_padding,
+                                  self._padding, opad,
                                   self._groups, self._dilation, output_size,
                                   self._data_format)
 
@@ -127,7 +133,10 @@ class Conv3DTranspose(_ConvNd):
                          "zeros", weight_attr, bias_attr, data_format)
 
     def forward(self, x, output_size=None):
+        # reference layer contract: output_size overrides output_padding
+        # (paddle/nn/layer/conv.py zeroes it when output_size is given)
+        opad = 0 if output_size is not None else self._output_padding
         return F.conv3d_transpose(x, self.weight, self.bias, self._stride,
-                                  self._padding, self._output_padding,
+                                  self._padding, opad,
                                   self._groups, self._dilation, output_size,
                                   self._data_format)

@@ -513,6 +513,8 @@ class TestReferenceSurfaceGate:
         ("python/paddle/sysconfig.py", "paddle_tpu.sysconfig"),
         ("python/paddle/static/nn/__init__.py", "paddle_tpu.static.nn"),
         ("python/paddle/nn/quant/__init__.py", "paddle_tpu.nn.quant"),
+        ("python/paddle/distributed/communication/stream/__init__.py",
+         "paddle_tpu.distributed.communication.stream"),
     ]
 
     @staticmethod

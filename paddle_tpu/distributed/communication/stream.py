@@ -53,8 +53,9 @@ def alltoall(out_tensor_or_tensor_list, in_tensor_or_tensor_list, group=None,
 def alltoall_single(out_tensor, in_tensor, out_split_sizes=None,
                     in_split_sizes=None, group=None, sync_op=True,
                     use_calc_stream=False):
-    return _c.all_to_all_single(out_tensor, in_tensor, out_split_sizes,
-                                in_split_sizes, group, sync_op)
+    return _streamed(_c.all_to_all_single, out_tensor, in_tensor,
+                     out_split_sizes, in_split_sizes, group, sync_op=sync_op,
+                     use_calc_stream=use_calc_stream)
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True,

@@ -151,15 +151,8 @@ def swiglu(x, y=None, name=None):
 
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
-    def f(a, w, *rest):
-        if transpose_weight:
-            w = w.T
-        out = a @ w
-        if rest:
-            out = out + rest[0]
-        return out
-    args = [x, weight] + ([bias] if bias is not None else [])
-    return execute(f, *args, _name="linear")
+    # reference: fused_linear is a wrapper over fused_matmul_bias
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
 
 
 def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
@@ -505,3 +498,195 @@ def fused_moe(x, gate_weight, expert_weights1, expert_bias1, expert_weights2,
         return jnp.einsum("...ed,...e->...d", out, gates)
     return execute(f, x, gate_weight, expert_weights1, expert_bias1,
                    expert_weights2, expert_bias2, _name="fused_moe")
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """reference: incubate/nn/functional/fused_matmul_bias.py — matmul +
+    bias epilogue; XLA fuses the add into the MXU matmul epilogue."""
+    def f(a, b, *rest):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        if rest:
+            out = out + rest[0]
+        return out
+    args = (x, y) + ((bias,) if bias is not None else ())
+    return execute(f, *args, _name="fused_matmul_bias")
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None, smooth=None,
+                   act_method="gelu", compute_dtype="default", quant_scale=-1,
+                   quant_round_type=0, quant_max_bound=0, quant_min_bound=0):
+    """reference: incubate/nn/functional/fused_bias_act.py — bias +
+    activation (+ optional int8 dequant/shift/smooth epilogue)."""
+    acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "silu": jax.nn.silu,
+            "swiglu": lambda a: jax.nn.silu(a[..., : a.shape[-1] // 2])
+            * a[..., a.shape[-1] // 2:],
+            "geglu": lambda a: jax.nn.gelu(a[..., : a.shape[-1] // 2])
+            * a[..., a.shape[-1] // 2:]}
+    if act_method not in acts:
+        raise ValueError(f"act_method must be one of {sorted(acts)}, got "
+                         f"{act_method!r}")
+    if quant_scale > 0:
+        raise NotImplementedError(
+            "fused_bias_act: int8 output quantization is not supported on "
+            "TPU — use nn.quant / quantization for serving quant")
+
+    dtypes = {"default": None, "fp16": jnp.float16, "bf16": jnp.bfloat16,
+              "fp32": jnp.float32}
+    if compute_dtype not in dtypes:
+        raise ValueError(f"compute_dtype must be one of {sorted(dtypes)}, "
+                         f"got {compute_dtype!r}")
+
+    def f(a, *rest):
+        it = iter(rest)
+        in_dtype = a.dtype
+        if dequant_scales is not None:
+            a = a.astype(jnp.float32) * next(it)
+        if bias is not None:
+            a = a + next(it)
+        if shift is not None:
+            a = a + next(it)
+        if smooth is not None:
+            a = a * next(it)
+        out = acts[act_method](a)
+        want = dtypes[compute_dtype]
+        if want is not None:
+            return out.astype(want)
+        if dequant_scales is not None:  # default after int dequant: fp16
+            return out.astype(jnp.float16)
+        return out.astype(in_dtype)
+
+    args = (x,) + tuple(t for t in (dequant_scales, bias, shift, smooth)
+                        if t is not None)
+    return execute(f, *args, _name="fused_bias_act")
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      seed=None, name=None):
+    """reference: incubate/nn/functional/fused_dropout_add.py —
+    dropout(x) + y in ONE traced region (one dispatch; XLA fuses the mask,
+    scale, and add). `seed` pins the mask for reproducible serving."""
+    from ....framework.random import next_key
+
+    def f(a, b):
+        if not training or p == 0.0:
+            if mode == "downscale_in_infer" and not training:
+                return a * (1.0 - p) + b
+            return a + b
+        key = jax.random.key(seed) if seed is not None else next_key()
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype) + b
+        return jnp.where(keep, a, 0.0).astype(a.dtype) + b
+
+    return execute(f, x, y, _name="fused_dropout_add")
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size=None,
+                     name=None):
+    """reference: incubate/nn/functional/blha_get_max_len.py — max
+    encoder/decoder sequence lengths for block attention scheduling."""
+    def f(enc, dec):
+        return jnp.max(enc).reshape(1), jnp.max(dec).reshape(1)
+    return execute(f, seq_lens_encoder, seq_lens_decoder,
+                   _name="blha_get_max_len")
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-5, residual_alpha=1.0, cache_kvs=None, beam_offset=None,
+        pre_caches=None, seq_lens=None, rotary_embs=None, time_step=None,
+        attn_mask=None, dropout_rate=0.0, rotary_emb_dims=0,
+        activation="gelu", training=False, mode="upscale_in_train",
+        trans_qkvw=True, ring_id=-1, name=None):
+    """Whole-stack fused transformer (inference serving).
+
+    reference: incubate/nn/functional/fused_transformer.py:976 — one op
+    running L pre-LN transformer layers; qkv_weights[i] shaped
+    (3, num_head, head_dim, embed) with trans_qkvw=True. TPU-native: the
+    layers are composed jnp inside one traced region — XLA's fusion is the
+    kernel fusion the CUDA op hand-writes. Decode caches belong to
+    generation.py / ops.paged_attention; the unsupported serving extras
+    raise rather than silently change numerics.
+    """
+    if training and dropout_rate > 0:
+        raise NotImplementedError(
+            "fused_multi_transformer: training-mode dropout is not "
+            "supported (this is the inference-serving op)")
+    for unsupported, nm in ((cache_kvs, "cache_kvs"),
+                            (pre_caches, "pre_caches"),
+                            (rotary_embs, "rotary_embs"),
+                            (time_step, "time_step"),
+                            (seq_lens, "seq_lens"),
+                            (beam_offset, "beam_offset")):
+        if unsupported is not None:
+            raise NotImplementedError(
+                f"fused_multi_transformer: {nm} is not supported — use "
+                "paddle_tpu.generation (KV-cache decode) or "
+                "ops.paged_attention for serving caches")
+    if not pre_layer_norm:
+        raise NotImplementedError(
+            "fused_multi_transformer: only pre_layer_norm=True (the "
+            "reference default and the served configuration)")
+    acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}
+    act = acts.get(activation)
+    if act is None:
+        raise ValueError(f"activation must be gelu/relu, got {activation!r}")
+
+    n_layers = len(qkv_weights)
+
+    def layer_norm(a, scale, bias_):
+        mu = jnp.mean(a, axis=-1, keepdims=True)
+        var = jnp.var(a, axis=-1, keepdims=True)
+        out = (a - mu) * jax.lax.rsqrt(var + epsilon)
+        return out * scale + bias_
+
+    has_mask = attn_mask is not None
+
+    def f(a, *rest):
+        mask = rest[0] if has_mask else None
+        it = iter(rest[1:] if has_mask else rest)
+        per_layer = [tuple(next(it) for _ in range(12))
+                     for _ in range(n_layers)]
+        for (lns, lnb, qkvw, qkvb, lw, lb, flns, flnb, f1w, f1b, f2w,
+             f2b) in per_layer:
+            resid = a
+            h = layer_norm(a, lns, lnb)
+            if trans_qkvw:  # (3, H, D, E): project E -> (3, H, D)
+                qkv = jnp.einsum("bse,nhde->bsnhd", h, qkvw) + qkvb
+            else:  # reference layout (E, 3, H, D) — no reshape needed
+                qkv = jnp.einsum("bse,enhd->bsnhd", h, qkvw) + qkvb
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            d = q.shape[-1]
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                           preferred_element_type=jnp.float32) / (d ** 0.5)
+            if mask is not None:
+                s = s + mask
+            p = jax.nn.softmax(s, axis=-1).astype(a.dtype)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+            attn = attn.reshape(attn.shape[0], attn.shape[1], -1)
+            a = resid * residual_alpha + attn @ lw + lb
+            resid = a
+            h = layer_norm(a, flns, flnb)
+            h = act(h @ f1w + f1b)
+            a = resid * residual_alpha + h @ f2w + f2b
+        return a
+
+    flat = []
+    for i in range(n_layers):
+        flat += [ln_scales[i], ln_biases[i], qkv_weights[i], qkv_biases[i],
+                 linear_weights[i], linear_biases[i], ffn_ln_scales[i],
+                 ffn_ln_biases[i], ffn1_weights[i], ffn1_biases[i],
+                 ffn2_weights[i], ffn2_biases[i]]
+    args = ((x, attn_mask) if has_mask else (x,)) + tuple(flat)
+    return execute(f, *args, _name="fused_multi_transformer")
+
+
+__all__ += ["fused_matmul_bias", "fused_bias_act", "fused_dropout_add",
+            "blha_get_max_len", "fused_multi_transformer"]

@@ -515,6 +515,8 @@ class TestReferenceSurfaceGate:
         ("python/paddle/nn/quant/__init__.py", "paddle_tpu.nn.quant"),
         ("python/paddle/distributed/communication/stream/__init__.py",
          "paddle_tpu.distributed.communication.stream"),
+        ("python/paddle/incubate/nn/functional/__init__.py",
+         "paddle_tpu.incubate.nn.functional"),
     ]
 
     @staticmethod

@@ -331,3 +331,64 @@ class TestFusedTransformerFunctionals:
             np.testing.assert_allclose(outs[t], ref.reshape(b, nh * hd),
                                        rtol=1e-4, atol=1e-5,
                                        err_msg=f"step {t}")
+
+
+class TestFusedServingFunctionals:
+    """reference: incubate/nn/functional — the serving-side fused ops."""
+
+    def test_fused_matmul_bias_and_bias_act(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(2, 4, 8).astype(np.float32))
+        w = paddle.to_tensor(rs.randn(8, 6).astype(np.float32))
+        b = paddle.to_tensor(rs.randn(6).astype(np.float32))
+        out = IF.fused_matmul_bias(x, w, b)
+        np.testing.assert_allclose(out.numpy(),
+                                   x.numpy() @ w.numpy() + b.numpy(),
+                                   rtol=1e-5)
+        fb = IF.fused_bias_act(
+            x, bias=paddle.to_tensor(np.zeros(8, np.float32)),
+            act_method="relu")
+        np.testing.assert_allclose(fb.numpy(), np.maximum(x.numpy(), 0),
+                                   rtol=1e-6)
+        with pytest.raises(NotImplementedError):
+            IF.fused_bias_act(x, quant_scale=1.0)
+
+    def test_fused_dropout_add_and_blha(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        out = IF.fused_dropout_add(x, x, p=0.9, training=False)
+        np.testing.assert_allclose(out.numpy(), 2 * np.ones((2, 3)))
+        me, md = IF.blha_get_max_len(
+            paddle.to_tensor(np.array([3, 9], np.int32)),
+            paddle.to_tensor(np.array([1, 4], np.int32)))
+        assert int(me.numpy()[0]) == 9 and int(md.numpy()[0]) == 4
+
+    def test_fused_multi_transformer_runs_and_guards(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        rs = np.random.RandomState(1)
+        T = lambda a: paddle.to_tensor(a)
+        L, H, D, E = 2, 2, 4, 8
+        x = T(rs.randn(1, 5, E).astype(np.float32))
+        args = dict(
+            ln_scales=[T(np.ones(E, np.float32))] * L,
+            ln_biases=[T(np.zeros(E, np.float32))] * L,
+            qkv_weights=[T(rs.randn(3, H, D, E).astype(np.float32) * 0.1)
+                         for _ in range(L)],
+            qkv_biases=[T(np.zeros((3, H, D), np.float32))] * L,
+            linear_weights=[T(rs.randn(H * D, E).astype(np.float32) * 0.1)
+                            for _ in range(L)],
+            linear_biases=[T(np.zeros(E, np.float32))] * L,
+            ffn_ln_scales=[T(np.ones(E, np.float32))] * L,
+            ffn_ln_biases=[T(np.zeros(E, np.float32))] * L,
+            ffn1_weights=[T(rs.randn(E, 16).astype(np.float32) * 0.1)
+                          for _ in range(L)],
+            ffn1_biases=[T(np.zeros(16, np.float32))] * L,
+            ffn2_weights=[T(rs.randn(16, E).astype(np.float32) * 0.1)
+                          for _ in range(L)],
+            ffn2_biases=[T(np.zeros(E, np.float32))] * L)
+        out = IF.fused_multi_transformer(x, **args)
+        assert tuple(out.shape) == (1, 5, E)
+        assert np.isfinite(out.numpy()).all()
+        with pytest.raises(NotImplementedError):
+            IF.fused_multi_transformer(x, cache_kvs=[1], **args)

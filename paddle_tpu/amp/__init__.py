@@ -25,7 +25,8 @@ __all__ = ["auto_cast", "amp_guard", "decorate", "amp_decorate", "GradScaler",
 
 # reference: python/paddle/amp/amp_lists.py
 WHITE_LIST = {
-    "matmul", "mm", "bmm", "linear", "conv1d", "conv2d", "conv3d",
+    "matmul", "mm", "bmm", "linear", "fused_matmul_bias", "conv1d",
+    "conv2d", "conv3d",
     "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
     "scaled_dot_product_attention", "flash_attention_pallas", "rnn", "lstm",
     "gru", "addmm", "mv",
@@ -223,3 +224,5 @@ class GradScaler:
         self._scale = sd.get("scale", self._scale)
         self._good_steps = sd.get("good", 0)
         self._bad_steps = sd.get("bad", 0)
+
+from . import debugging  # noqa: F401,E402

@@ -1,0 +1,229 @@
+"""paddle.amp.debugging — numerics debugging utilities.
+
+reference: python/paddle/amp/debugging.py — DebugMode +
+TensorCheckerConfig drive the eager NaN/Inf scanner
+(fluid/eager/nan_inf_utils), operator-stats collection counts op calls per
+dtype, and compare_accuracy diffs two dump directories
+(accuracy_compare.py).
+
+TPU-native: the tensor checker IS the FLAGS_check_nan_inf scan wired into
+`execute()` (framework/core.py _maybe_check_nan); the config object here
+just sets those flags. Operator stats wrap the same dispatcher with a
+counting hook. Dumps are .npy files per flagged op, diffable by
+compare_accuracy.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+import os
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework import flags as _flags
+from ..framework.core import Tensor
+
+__all__ = ["DebugMode", "TensorCheckerConfig", "check_numerics",
+           "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "enable_tensor_checker", "disable_tensor_checker",
+           "compare_accuracy", "check_layer_numerics"]
+
+
+class DebugMode(enum.Enum):
+    """reference: amp/debugging.py DebugMode."""
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+    DUMP_ALL = 4
+    DUMP_FAIL = 5
+
+
+class TensorCheckerConfig:
+    """reference: amp/debugging.py TensorCheckerConfig — which ops to scan
+    and what to do on a hit."""
+
+    def __init__(self, enable, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+        self.debug_step = debug_step
+        self.stack_height_limit = stack_height_limit
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    """reference: amp/debugging.py enable_tensor_checker — maps onto
+    FLAGS_check_nan_inf(_level): ABORT -> level 0 (raise), other check
+    modes -> level 1 (warn)."""
+    if not checker_config.enable:
+        return
+    if checker_config.debug_mode in (DebugMode.DUMP_ALL, DebugMode.DUMP_FAIL):
+        raise NotImplementedError(
+            "enable_tensor_checker: DUMP modes run through "
+            "check_numerics(output_dir=...) per tensor; the global checker "
+            "supports the CHECK_* modes")
+    for opt, nm in ((checker_config.output_dir, "output_dir"),
+                    (checker_config.checked_op_list, "checked_op_list"),
+                    (checker_config.skipped_op_list, "skipped_op_list"),
+                    (checker_config.debug_step, "debug_step")):
+        if opt:
+            raise NotImplementedError(
+                f"enable_tensor_checker: {nm} is not supported — the "
+                "checker scans every op output (use check_numerics for "
+                "targeted dumps)")
+    level = 0 if checker_config.debug_mode == \
+        DebugMode.CHECK_NAN_INF_AND_ABORT else 1
+    _flags.set_flags({"check_nan_inf": True, "check_nan_inf_level": level})
+
+
+def disable_tensor_checker():
+    # restore the abort default so a later bare check (e.g.
+    # @check_layer_numerics) raises rather than inheriting warn-only
+    _flags.set_flags({"check_nan_inf": False, "check_nan_inf_level": 0})
+
+
+def check_numerics(tensor, op_type="", var_name="",
+                   debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                   output_dir=None):
+    """Scan one tensor; returns (num_nan, num_inf, num_zero) like the
+    reference's stats output. ABORT mode raises on a hit; DUMP modes write
+    the tensor as .npy into output_dir for compare_accuracy."""
+    arr = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    a = np.asarray(arr)
+    if np.issubdtype(a.dtype, np.floating):
+        num_nan = int(np.isnan(a).sum())
+        num_inf = int(np.isinf(a).sum())
+    else:
+        num_nan = num_inf = 0
+    num_zero = int((a == 0).sum())
+    hit = num_nan > 0 or num_inf > 0
+    if output_dir and (debug_mode == DebugMode.DUMP_ALL
+                       or (hit and debug_mode == DebugMode.DUMP_FAIL)):
+        os.makedirs(output_dir, exist_ok=True)
+        fname = f"{op_type or 'op'}__{var_name or 'var'}.npy"
+        np.save(os.path.join(output_dir, fname), a)
+    if hit and debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+        raise RuntimeError(
+            f"check_numerics: {op_type or 'tensor'}:{var_name or ''} has "
+            f"{num_nan} NaN / {num_inf} Inf values")
+    stats = (num_nan, num_inf, num_zero)
+    return (Tensor(jnp.asarray(np.asarray(stats, np.int64))),)
+
+
+# -- operator stats ---------------------------------------------------------
+
+_op_stats: dict | None = None
+_nesting = 0
+
+
+def _observer(name, arrs):
+    # receives POST-autocast arrays: dtypes reflect actual run precision
+    dtypes = sorted({str(a.dtype) for a in arrs
+                     if hasattr(a, "dtype")}) or ["-"]
+    key = (name, ",".join(dtypes))
+    _op_stats[key] = _op_stats.get(key, 0) + 1
+
+
+def enable_operator_stats_collection():
+    """reference: amp/debugging.py — count op calls per dtype via the
+    dispatcher's observer hook (core.execute consults it on every op; a
+    monkeypatch would miss call sites that from-imported execute).
+    Re-entrant: nested enables share one counter and only the outermost
+    disable finalizes."""
+    global _op_stats, _nesting
+    from ..framework import core as _core
+    if _nesting == 0:
+        if _core._op_observer_hook is not None:
+            raise RuntimeError(
+                "another operator observer is already installed")
+        _op_stats = {}
+        _core._op_observer_hook = _observer
+    _nesting += 1
+
+
+def disable_operator_stats_collection():
+    """Stop counting and print the summary table (reference prints the
+    low/high-precision op table on disable)."""
+    global _op_stats, _nesting
+    from ..framework import core as _core
+    if _nesting == 0:
+        return {}
+    _nesting -= 1
+    if _nesting > 0:
+        return dict(_op_stats or {})
+    _core._op_observer_hook = None
+    stats = dict(_op_stats or {})
+    _op_stats = None
+    if stats:
+        width = max(len(k[0]) for k in stats)
+        print(f"{'op':<{width}}  dtypes            calls")
+        for (name, dts), n in sorted(stats.items()):
+            print(f"{name:<{width}}  {dts:<16}  {n}")
+    return stats
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """reference: amp/debugging.py collect_operator_stats context."""
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    """Diff two check_numerics dump directories (.npy per op/var) into a
+    CSV report. reference: amp/debugging.py compare_accuracy /
+    accuracy_compare.py (there: two run logs; here: two dump dirs)."""
+    rows = []
+
+    def _ls(p):
+        return set(os.listdir(p)) if os.path.isdir(p) else set()
+
+    names = sorted(_ls(dump_path) | _ls(another_dump_path))
+    for fname in names:
+        if not fname.endswith(".npy"):
+            continue
+        pa = os.path.join(dump_path, fname)
+        pb = os.path.join(another_dump_path, fname)
+        if not (os.path.exists(pa) and os.path.exists(pb)):
+            rows.append((fname, "missing", "", ""))
+            continue
+        a, b = np.load(pa), np.load(pb)
+        if a.shape != b.shape:
+            rows.append((fname, "shape-mismatch", str(a.shape), str(b.shape)))
+            continue
+        diff = np.abs(a.astype(np.float64) - b.astype(np.float64))
+        rows.append((fname, "ok", f"{diff.max():.6e}", f"{diff.mean():.6e}"))
+    with open(output_filename, "w") as f:
+        f.write("tensor,status,max_abs_diff,mean_abs_diff\n")
+        for r in rows:
+            f.write(",".join(r) + "\n")
+    return rows
+
+
+def check_layer_numerics(func):
+    """Decorator: run a layer forward with the tensor checker enabled.
+    reference: amp/debugging.py check_layer_numerics."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        prev = _flags.flag_value("check_nan_inf")
+        _flags.set_flags({"check_nan_inf": True})
+        try:
+            return func(*args, **kwargs)
+        finally:
+            _flags.set_flags({"check_nan_inf": prev})
+
+    return wrapper

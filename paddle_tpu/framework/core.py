@@ -387,6 +387,7 @@ def _unwrap(x):
 # AMP cast hook installed by paddle_tpu.amp (kept as a function pointer to
 # avoid a circular import). Signature: (name, arrays) -> arrays.
 _amp_cast_hook = None
+_op_observer_hook = None  # amp.debugging operator-stats collection
 
 def _maybe_check_nan(out, name):
     """FLAGS_check_nan_inf: scan op outputs for NaN/Inf when enabled.
@@ -424,6 +425,9 @@ def execute(f: Callable, *inputs, _name: str = None, **static_kwargs):
     arrs = [_unwrap(x) for x in inputs]
     if _amp_cast_hook is not None:
         arrs = _amp_cast_hook(_name or getattr(f, "__name__", "op"), arrs)
+    if _op_observer_hook is not None:  # amp.debugging op stats: POST-cast
+        # dtypes, so the table shows the precision ops actually ran in
+        _op_observer_hook(_name or getattr(f, "__name__", "op"), arrs)
 
     diff_idx = []
     if _GRAD_ENABLED:

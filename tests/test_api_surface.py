@@ -517,6 +517,7 @@ class TestReferenceSurfaceGate:
          "paddle_tpu.distributed.communication.stream"),
         ("python/paddle/incubate/nn/functional/__init__.py",
          "paddle_tpu.incubate.nn.functional"),
+        ("python/paddle/amp/debugging.py", "paddle_tpu.amp.debugging"),
     ]
 
     @staticmethod

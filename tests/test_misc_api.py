@@ -133,3 +133,44 @@ class TestHapiAmp:
         with pytest.raises(ValueError, match="O0/O1/O2"):
             model.prepare(optimizer.Adam(1e-2, parameters=net.parameters()),
                           nn.CrossEntropyLoss(), amp_configs="O9")
+
+
+class TestAmpDebugging:
+    """reference: python/paddle/amp/debugging.py — tensor checker, op
+    stats, dump/compare."""
+
+    def test_check_numerics_and_checker(self):
+        from paddle_tpu.amp import debugging as dbg
+        t = paddle.to_tensor(np.array([1.0, np.nan], np.float32))
+        with pytest.raises(RuntimeError, match="NaN"):
+            dbg.check_numerics(t, "op", "x")
+        (stats,) = dbg.check_numerics(
+            t, "op", "x", debug_mode=dbg.DebugMode.CHECK_NAN_INF)
+        assert stats.numpy().tolist() == [1, 0, 0]
+        cfg = dbg.TensorCheckerConfig(enable=True)
+        dbg.enable_tensor_checker(cfg)
+        try:
+            with pytest.raises(RuntimeError, match="NaN or Inf"):
+                paddle.to_tensor(np.array([1.0], np.float32)) / \
+                    paddle.to_tensor(np.array([0.0], np.float32))
+        finally:
+            dbg.disable_tensor_checker()
+
+    def test_operator_stats_and_compare(self, tmp_path, capsys):
+        from paddle_tpu.amp import debugging as dbg
+        with dbg.collect_operator_stats():
+            a = paddle.to_tensor(np.ones(3, np.float32))
+            _ = a + a
+            _ = a + a
+        out = capsys.readouterr().out
+        assert "calls" in out and "float32" in out
+        d1, d2 = tmp_path / "a", tmp_path / "b"
+        x1 = paddle.to_tensor(np.ones(4, np.float32))
+        x2 = paddle.to_tensor(np.ones(4, np.float32) * 2)
+        dbg.check_numerics(x1, "op", "v", debug_mode=dbg.DebugMode.DUMP_ALL,
+                           output_dir=str(d1))
+        dbg.check_numerics(x2, "op", "v", debug_mode=dbg.DebugMode.DUMP_ALL,
+                           output_dir=str(d2))
+        rows = dbg.compare_accuracy(str(d1), str(d2),
+                                    str(tmp_path / "report.csv"))
+        assert rows[0][1] == "ok" and float(rows[0][2]) == 1.0

@@ -93,12 +93,17 @@ def disable_tensor_checker():
 def check_numerics(tensor, op_type="", var_name="",
                    debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
                    output_dir=None):
-    """Scan one tensor; returns (num_nan, num_inf, num_zero) like the
-    reference's stats output. ABORT mode raises on a hit; DUMP modes write
-    the tensor as .npy into output_dir for compare_accuracy."""
+    """Scan one tensor; returns (stats, values) like the reference
+    (amp/debugging.py:361): stats is the int64 [num_nan, num_inf, num_zero]
+    tensor, values is the float [max, min, mean] tensor of the input. ABORT
+    mode raises on a hit; DUMP modes write the tensor as .npy into
+    output_dir for compare_accuracy."""
     arr = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    # float detection on the JAX dtype: np.issubdtype is False for
+    # ml_dtypes.bfloat16 — the TPU AMP dtype this module exists to debug
+    is_float = jnp.issubdtype(arr.dtype, jnp.inexact)
     a = np.asarray(arr)
-    if np.issubdtype(a.dtype, np.floating):
+    if is_float:
         num_nan = int(np.isnan(a).sum())
         num_inf = int(np.isinf(a).sum())
     else:
@@ -115,7 +120,19 @@ def check_numerics(tensor, op_type="", var_name="",
             f"check_numerics: {op_type or 'tensor'}:{var_name or ''} has "
             f"{num_nan} NaN / {num_inf} Inf values")
     stats = (num_nan, num_inf, num_zero)
-    return (Tensor(jnp.asarray(np.asarray(stats, np.int64))),)
+    if a.size == 0 or num_nan == a.size:
+        values = np.full(3, np.nan, np.float32)
+    else:
+        # np.nanmean silently skips NaN-masking for dtypes numpy doesn't
+        # consider inexact (ml_dtypes.bfloat16) — cast those up first
+        am = a if np.issubdtype(a.dtype, np.inexact) or not is_float \
+            else a.astype(np.float32)
+        with np.errstate(invalid="ignore"):
+            values = np.asarray(
+                [np.nanmax(am), np.nanmin(am),
+                 np.nanmean(am, dtype=np.float64)], np.float32)
+    return (Tensor(jnp.asarray(np.asarray(stats, np.int64))),
+            Tensor(jnp.asarray(values)))
 
 
 # -- operator stats ---------------------------------------------------------

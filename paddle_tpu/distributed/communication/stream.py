@@ -15,6 +15,7 @@ task.
 
 from __future__ import annotations
 
+from ...framework.core import Tensor
 from .. import collective as _c
 
 __all__ = ["all_gather", "all_reduce", "alltoall", "alltoall_single",
@@ -31,6 +32,22 @@ def _streamed(fn, *args, sync_op=True, use_calc_stream=False, **kwargs):
     return fn(*args, sync_op=sync_op, **kwargs)
 
 
+def _nranks(group):
+    return group.nranks if group is not None else _c.get_world_size()
+
+
+def _as_chunks(tensor, group, op_name):
+    """Reference tensor flavor: one pre-sized tensor = nranks equal chunks
+    along dim 0 (stream/all_gather.py tensor branch)."""
+    from ...tensor.manipulation import split as _split
+    n = _nranks(group)
+    if int(tensor.shape[0]) % n != 0:
+        raise ValueError(
+            f"{op_name}: tensor dim 0 ({int(tensor.shape[0])}) must be "
+            f"divisible by the group world size ({n})")
+    return _split(tensor, n, axis=0)
+
+
 def all_reduce(tensor, op=_c.ReduceOp.SUM, group=None, sync_op=True,
                use_calc_stream=False):
     return _streamed(_c.all_reduce, tensor, op, group, sync_op=sync_op,
@@ -39,12 +56,33 @@ def all_reduce(tensor, op=_c.ReduceOp.SUM, group=None, sync_op=True,
 
 def all_gather(tensor_or_tensor_list, tensor, group=None, sync_op=True,
                use_calc_stream=False):
+    if isinstance(tensor_or_tensor_list, Tensor):
+        # tensor flavor: gather into a pre-sized tensor (nranks*d0 rows)
+        from ...tensor.manipulation import concat
+        out: list = []
+        task = _streamed(_c.all_gather, out, tensor, group, sync_op=sync_op,
+                         use_calc_stream=use_calc_stream)
+        tensor_or_tensor_list._rebind(concat(out, 0))
+        return task
     return _streamed(_c.all_gather, tensor_or_tensor_list, tensor, group,
                      sync_op=sync_op, use_calc_stream=use_calc_stream)
 
 
 def alltoall(out_tensor_or_tensor_list, in_tensor_or_tensor_list, group=None,
              sync_op=True, use_calc_stream=False):
+    if isinstance(in_tensor_or_tensor_list, Tensor) != \
+            isinstance(out_tensor_or_tensor_list, Tensor):
+        raise ValueError(
+            "alltoall: input and output must both be tensors or both "
+            "be tensor lists")
+    if isinstance(in_tensor_or_tensor_list, Tensor):
+        from ...tensor.manipulation import concat
+        ins = _as_chunks(in_tensor_or_tensor_list, group, "alltoall")
+        outs: list = []
+        task = _streamed(_c.all_to_all, outs, ins, group, sync_op=sync_op,
+                         use_calc_stream=use_calc_stream)
+        out_tensor_or_tensor_list._rebind(concat(outs, 0))
+        return task
     return _streamed(_c.all_to_all, out_tensor_or_tensor_list,
                      in_tensor_or_tensor_list, group, sync_op=sync_op,
                      use_calc_stream=use_calc_stream)
@@ -72,12 +110,18 @@ def reduce(tensor, dst=0, op=_c.ReduceOp.SUM, group=None, sync_op=True,
 
 def reduce_scatter(tensor, tensor_or_tensor_list, op=_c.ReduceOp.SUM,
                    group=None, sync_op=True, use_calc_stream=False):
+    if isinstance(tensor_or_tensor_list, Tensor):
+        tensor_or_tensor_list = _as_chunks(tensor_or_tensor_list, group,
+                                           "reduce_scatter")
     return _streamed(_c.reduce_scatter, tensor, tensor_or_tensor_list, op,
                      group, sync_op=sync_op, use_calc_stream=use_calc_stream)
 
 
 def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
             sync_op=True, use_calc_stream=False):
+    if isinstance(tensor_or_tensor_list, Tensor):
+        tensor_or_tensor_list = _as_chunks(tensor_or_tensor_list, group,
+                                           "scatter")
     return _streamed(_c.scatter, tensor, tensor_or_tensor_list, src, group,
                      sync_op=sync_op, use_calc_stream=use_calc_stream)
 

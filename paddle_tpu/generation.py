@@ -263,6 +263,10 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     ids = input_ids._data if isinstance(input_ids, Tensor) \
         else jnp.asarray(input_ids)
     ids = ids.astype(jnp.int32)
+    if max_new_tokens <= 0:
+        # both paths must agree: zero new tokens returns the prompt as-is
+        # (the compiled llama path would otherwise still emit first_tok)
+        return Tensor(ids)
     if do_sample:
         key = (jax.random.key(seed) if seed is not None
                else _random.next_key())

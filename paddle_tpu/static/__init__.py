@@ -30,14 +30,55 @@ def data(name, shape, dtype="float32", lod_level=0):
 
 
 class Program:
+    """Program shim: the traced jaxpr IS the program, but each Program
+    still owns the name-keyed parameter registry its builders write to
+    (reference: Program.all_parameters / state_dict). program_guard
+    activates a Program's registry for the builders in its scope."""
+
     def __init__(self):
-        pass
+        self._params: dict = {}
 
     def global_block(self):
         return self
 
     def clone(self, for_test=False):
-        return Program()
+        p = Program()
+        p._params = dict(self._params)
+        return p
+
+    def all_parameters(self):
+        return [p for p in self._params.values() if not p.stop_gradient]
+
+    def state_dict(self, mode="all"):
+        """name -> Tensor of registered parameters/buffers. mode: 'param'
+        = trainable only, 'opt' = optimizer state (none lives on the
+        program here), 'all' = everything (reference: Program.state_dict)."""
+        if mode == "param":
+            return {k: v for k, v in self._params.items()
+                    if not v.stop_gradient}
+        if mode == "opt":
+            return {}
+        if mode != "all":
+            raise ValueError(
+                f"state_dict mode must be 'param', 'opt' or 'all', got "
+                f"{mode!r}")
+        return dict(self._params)
+
+    def set_state_dict(self, state_dict):
+        """Write values back into the registered tensors IN PLACE so every
+        builder closure holding them sees the restored weights
+        (reference: Program.set_state_dict). Unknown keys are ignored with
+        a warning, matching the reference's lenient load."""
+        import warnings
+        for k, v in state_dict.items():
+            t = self._params.get(k)
+            if t is None:
+                warnings.warn(f"set_state_dict: skipping unknown "
+                              f"parameter {k!r}")
+                continue
+            # set_value casts dtype AND checks the element count, raising
+            # a clear error at load time instead of a far-away shape error
+            t.set_value(v)
 
 
 import contextlib
@@ -45,7 +86,19 @@ import contextlib
 
 @contextlib.contextmanager
 def program_guard(main_program=None, startup_program=None):
-    yield
+    """Route static.nn builder parameters into main_program's registry for
+    the duration of the block (reference: parameters are appended to the
+    guarded Program)."""
+    if main_program is None:
+        yield
+        return
+    from . import nn_builders
+    prev = nn_builders._param_registry
+    nn_builders._param_registry = main_program._params
+    try:
+        yield
+    finally:
+        nn_builders._param_registry = prev
 
 
 _main = Program()
@@ -115,6 +168,10 @@ def scan(body_fn, init, xs, name=None):
 
 
 from . import nn_builders as nn  # noqa: E402  (static-graph layer builders)
+
+# the default main program IS the module-level registry builders write to
+# outside any program_guard
+_main._params = nn._param_registry
 nn.cond = cond
 nn.while_loop = while_loop
 import sys as _sys  # noqa: E402

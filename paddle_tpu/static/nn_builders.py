@@ -9,6 +9,20 @@ global Program. Here the program IS the traced jaxpr, so each builder is a
 define-and-run call: it creates the parameters (respecting
 param_attr/bias_attr via nn.Layer.create_parameter) and applies the op
 immediately. Under jit.to_static the call is traced like any eager code.
+
+Parameter persistence mirrors the reference's Program-owned parameters:
+every builder draws its parameter names from an explicit `name` argument or
+`utils.unique_name.generate`, and stores the created tensors in a
+module-level registry. A repeated call with the same resolved name (e.g. an
+explicitly named fc, or an unnamed one rebuilt under
+`utils.unique_name.guard()`) REUSES the registered parameters instead of
+drawing fresh weights, and `static.default_main_program().all_parameters()`
+exposes them for optimizers / state_dict — matching how the reference keeps
+builder parameters alive on the Program (static/nn/common.py fc:30).
+Unnamed calls outside a guard get a fresh unique name each call and thus
+fresh parameters, exactly like appending a second fc to a reference
+Program.
+
 LoD sequence ops (sequence_conv/pool/expand/softmax/first/last_step),
 sparse_embedding and nce serve the legacy LoD/parameter-server pipeline —
 descoped on TPU (DESIGN.md ledger) with guided errors.
@@ -22,6 +36,7 @@ from ..framework.core import Tensor, execute
 from ..nn import functional as F
 from ..nn import initializer as I
 from ..nn.layer.layers import Layer
+from ..utils import unique_name
 
 
 def _act(out, activation):
@@ -33,22 +48,90 @@ def _act(out, activation):
     return fn(out)
 
 
+#: resolved parameter name -> Tensor. The static-graph analog of the
+#: reference Program's parameter list; cleared by static.reset_parameters().
+#: Like the reference Program, it ACCUMULATES: every unnamed builder call
+#: appends fresh parameters (a build loop grows it exactly as it would grow
+#: a reference Program) — rebuild under utils.unique_name.guard() to reuse,
+#: or reset_parameters() for a fresh program.
+#: static.program_guard(main_program=p) swaps in p's own registry, so
+#: separate Programs keep separate parameter sets.
+_param_registry: dict[str, Tensor] = {}
+
+
+def reset_parameters():
+    """Forget all builder-created parameters (reference analog: a fresh
+    Program)."""
+    _param_registry.clear()
+
+
 class _ParamFactory(Layer):
-    """One throwaway Layer per builder call: reuses nn's initializer /
-    weight-attr machinery for parameter creation."""
+    """Named parameter source for one builder call: reuses nn's
+    initializer / weight-attr machinery, but registers every created
+    tensor under `<base>.<suffix>` so later calls with the same resolved
+    base name reuse it."""
+
+    def __init__(self, kind, name=None):
+        super().__init__()
+        self._base = name if name else unique_name.generate(kind)
+        self._n_w = 0
+        self._n_b = 0
 
     def make(self, shape, attr=None, is_bias=False, default=None,
              dtype=None):
-        return self.create_parameter(
+        # ParamAttr(name=...) is the reference's weight-sharing handle:
+        # it overrides the positional key so two builders naming the same
+        # attr share one parameter (base/param_attr.py)
+        attr_name = getattr(attr, "name", None)
+        if attr_name:
+            key = attr_name
+        elif is_bias:
+            key = f"{self._base}.b_{self._n_b}"
+            self._n_b += 1
+        else:
+            key = f"{self._base}.w_{self._n_w}"
+            self._n_w += 1
+        shape = tuple(int(s) for s in shape)
+        hit = _param_registry.get(key)
+        if hit is not None:
+            if tuple(hit.shape) != shape:
+                raise ValueError(
+                    f"static.nn parameter {key!r} already exists with shape "
+                    f"{tuple(hit.shape)}, requested {shape}; pass a "
+                    "different name= or call static.nn.reset_parameters()")
+            return hit
+        p = self.create_parameter(
             shape, attr=attr, dtype=dtype, is_bias=is_bias,
             default_initializer=default)
+        if p is None:  # attr=False: caller asked for no parameter
+            return None
+        p.name = key
+        _param_registry[key] = p
+        return p
+
+    def buffer(self, key_suffix, value, explicit_name=None):
+        """Non-trainable persistent state (batch_norm moving stats)."""
+        key = explicit_name or f"{self._base}.{key_suffix}"
+        hit = _param_registry.get(key)
+        if hit is not None:
+            if tuple(hit.shape) != tuple(value.shape):
+                raise ValueError(
+                    f"static.nn buffer {key!r} already exists with shape "
+                    f"{tuple(hit.shape)}, requested {tuple(value.shape)}; "
+                    "pass a different name or call "
+                    "static.nn.reset_parameters()")
+            return hit
+        t = Tensor(value, stop_gradient=True)
+        t.name = key
+        _param_registry[key] = t
+        return t
 
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
        activation=None, name=None):
     """reference: static/nn/common.py fc — flatten trailing dims, linear,
     optional activation."""
-    pf = _ParamFactory()
+    pf = _ParamFactory("static_fc", name)
     xs = tuple(x.shape)
     if num_flatten_dims < 0:
         num_flatten_dims = len(xs) + num_flatten_dims
@@ -73,7 +156,7 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
 def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype="float32", name=None):
     """reference: static/nn/common.py embedding."""
-    pf = _ParamFactory()
+    pf = _ParamFactory("static_embedding", name)
     w = pf.make(tuple(size), attr=param_attr, dtype=dtype)
     return F.embedding(input, w, padding_idx=padding_idx, sparse=is_sparse)
 
@@ -82,7 +165,7 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
            act=None, name=None, data_format="NCHW"):
     """reference: static/nn/common.py conv2d."""
-    pf = _ParamFactory()
+    pf = _ParamFactory("static_conv2d", name)
     ks = filter_size if isinstance(filter_size, (list, tuple)) \
         else (filter_size, filter_size)
     cin = int(input.shape[1 if data_format == "NCHW" else -1])
@@ -97,7 +180,7 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
 def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
            act=None, name=None, data_format="NCDHW"):
-    pf = _ParamFactory()
+    pf = _ParamFactory("static_conv3d", name)
     ks = filter_size if isinstance(filter_size, (list, tuple)) \
         else (filter_size,) * 3
     cin = int(input.shape[1 if data_format == "NCDHW" else -1])
@@ -113,7 +196,7 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
                      padding=0, stride=1, dilation=1, groups=1,
                      param_attr=None, bias_attr=None, use_cudnn=True,
                      act=None, name=None, data_format="NCHW"):
-    pf = _ParamFactory()
+    pf = _ParamFactory("static_conv2d_transpose", name)
     if filter_size is None:
         raise ValueError("filter_size is required (output_size-only "
                          "inference is not supported)")
@@ -133,7 +216,7 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
                      padding=0, stride=1, dilation=1, groups=1,
                      param_attr=None, bias_attr=None, use_cudnn=True,
                      act=None, name=None, data_format="NCDHW"):
-    pf = _ParamFactory()
+    pf = _ParamFactory("static_conv3d_transpose", name)
     if filter_size is None:
         raise ValueError("filter_size is required")
     ks = filter_size if isinstance(filter_size, (list, tuple)) \
@@ -154,7 +237,7 @@ def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
     """reference: static/nn/common.py deformable_conv — delegates to the
     vision op (modulated when mask is given)."""
     from ..vision.ops import deform_conv2d as _dc
-    pf = _ParamFactory()
+    pf = _ParamFactory("static_deform_conv2d", name)
     ks = filter_size if isinstance(filter_size, (list, tuple)) \
         else (filter_size, filter_size)
     cin = int(input.shape[1])
@@ -173,12 +256,14 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                use_global_stats=False):
     """reference: static/nn/common.py batch_norm. Creates scale/bias +
     moving stats and applies the normalization in one call."""
-    pf = _ParamFactory()
+    pf = _ParamFactory("static_batch_norm", name)
     c = int(input.shape[1 if data_layout == "NCHW" else -1])
     scale = pf.make((c,), attr=param_attr, default=I.Constant(1.0))
     bias = pf.make((c,), attr=bias_attr, is_bias=True)
-    mean = Tensor(jnp.zeros((c,), jnp.float32), stop_gradient=True)
-    var = Tensor(jnp.ones((c,), jnp.float32), stop_gradient=True)
+    mean = pf.buffer("moving_mean", jnp.zeros((c,), jnp.float32),
+                     explicit_name=moving_mean_name)
+    var = pf.buffer("moving_variance", jnp.ones((c,), jnp.float32),
+                    explicit_name=moving_variance_name)
     out = F.batch_norm(input, mean, var, weight=scale, bias=bias,
                        training=not (is_test or use_global_stats),
                        momentum=momentum, epsilon=epsilon,
@@ -189,7 +274,7 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
 def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
                epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
                name=None):
-    pf = _ParamFactory()
+    pf = _ParamFactory("static_layer_norm", name)
     shape = tuple(int(s) for s in input.shape[begin_norm_axis:])
     w = pf.make(shape, attr=param_attr, default=I.Constant(1.0)) \
         if scale else None
@@ -200,7 +285,7 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
 
 def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
                act=None, data_layout="NCHW", name=None):
-    pf = _ParamFactory()
+    pf = _ParamFactory("static_group_norm", name)
     c = int(input.shape[1 if data_layout == "NCHW" else -1])
     w = pf.make((c,), attr=param_attr, default=I.Constant(1.0))
     b = pf.make((c,), attr=bias_attr, is_bias=True)
@@ -211,7 +296,7 @@ def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
 
 def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
                   name=None):
-    pf = _ParamFactory()
+    pf = _ParamFactory("static_instance_norm", name)
     c = int(input.shape[1])
     w = pf.make((c,), attr=param_attr, default=I.Constant(1.0)) \
         if param_attr is not False else None
@@ -230,7 +315,7 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
     statistics, with a learned per-feature affine when
     enable_scale_and_shift is set (reference creates scale_w/bias then)."""
     if enable_scale_and_shift:
-        pf = _ParamFactory()
+        pf = _ParamFactory("static_data_norm", name)
         c = int(input.shape[-1])
         scale_w = pf.make((c,), attr=param_attr, default=I.Constant(1.0))
         bias = pf.make((c,), is_bias=True)
@@ -253,7 +338,7 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
 
 def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
     """reference: static/nn/common.py prelu — modes all/channel/element."""
-    pf = _ParamFactory()
+    pf = _ParamFactory("static_prelu", name)
     if mode == "all":
         shape = (1,)
     elif mode == "channel":
@@ -276,7 +361,7 @@ def bilinear_tensor_product(x, y, size, act=None, name=None,
                             param_attr=None, bias_attr=None):
     """reference: static/nn/common.py bilinear_tensor_product:
     out_k = x W_k y^T + b."""
-    pf = _ParamFactory()
+    pf = _ParamFactory("static_bilinear_tensor_product", name)
     dx, dy = int(x.shape[1]), int(y.shape[1])
     w = pf.make((size, dx, dy), attr=param_attr)
     b = pf.make((size,), attr=bias_attr, is_bias=True) \
@@ -310,10 +395,11 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
     return execute(f, weight, _name="spectral_norm")
 
 
-def row_conv(input, future_context_size, param_attr=None, act=None):
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
     """reference: static/nn/common.py row_conv (lookahead convolution,
     Deep Speech 2): out[t] = sum_{i=0..k} in[t+i] * w[i]."""
-    pf = _ParamFactory()
+    pf = _ParamFactory("static_row_conv", name)
     k = future_context_size
     d = int(input.shape[-1])
     w = pf.make((k + 1, d), attr=param_attr)

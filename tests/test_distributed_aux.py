@@ -297,6 +297,53 @@ class TestEagerCollectiveGuards:
         assert len(out) == 1
 
 
+class TestStreamTensorFlavor:
+    """reference stream signatures accept a single pre-sized Tensor for
+    tensor_or_tensor_list (stream/all_gather.py tensor branch); the
+    wrappers must convert to the base collectives' list path (ADVICE r3)."""
+
+    def test_all_gather_into_tensor(self):
+        from paddle_tpu.distributed.communication import stream
+        x = paddle.Tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        out = paddle.Tensor(np.zeros((2, 3), np.float32))  # nranks=1
+        task = stream.all_gather(out, x)
+        assert task.is_completed()
+        np.testing.assert_array_equal(out.numpy(), x.numpy())
+
+    def test_alltoall_tensor_flavor(self):
+        from paddle_tpu.distributed.communication import stream
+        x = paddle.Tensor(np.arange(4, dtype=np.float32).reshape(2, 2))
+        out = paddle.Tensor(np.zeros((2, 2), np.float32))
+        stream.alltoall(out, x)
+        np.testing.assert_array_equal(out.numpy(), x.numpy())
+        with pytest.raises(ValueError, match="both"):
+            stream.alltoall([], x)
+        with pytest.raises(ValueError, match="both"):
+            stream.alltoall(out, [x])  # Tensor out + list in, same contract
+
+    def test_reduce_scatter_and_scatter_tensor_flavor(self):
+        from paddle_tpu.distributed.communication import stream
+        big = paddle.Tensor(np.arange(4, dtype=np.float32).reshape(2, 2))
+        out = paddle.Tensor(np.zeros((2, 2), np.float32))
+        stream.reduce_scatter(out, big)
+        np.testing.assert_array_equal(out.numpy(), big.numpy())
+        out2 = paddle.Tensor(np.zeros((2, 2), np.float32))
+        stream.scatter(out2, big, src=0)
+        np.testing.assert_array_equal(out2.numpy(), big.numpy())
+
+    def test_indivisible_dim0_rejected(self):
+        from paddle_tpu.distributed.communication import stream
+
+        class FakeGroup:
+            nranks = 4
+            axis_name = None
+
+        big = paddle.Tensor(np.zeros((6, 2), np.float32))
+        out = paddle.Tensor(np.zeros((2, 2), np.float32))
+        with pytest.raises(ValueError, match="divisible"):
+            stream.reduce_scatter(out, big, group=FakeGroup())
+
+
 class TestJitFormatVersion:
     def test_newer_format_rejected(self, tmp_path):
         import pickle

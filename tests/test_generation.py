@@ -85,6 +85,19 @@ class TestGenerate:
         after = out[0, 6:]
         assert (after == eos).all()
 
+    def test_zero_max_new_tokens_returns_prompt(self):
+        """Both paths must agree: max_new_tokens=0 yields the prompt
+        unchanged (the compiled llama path used to emit one token —
+        ADVICE r3)."""
+        ids = jnp.ones((2, 5), jnp.int32)
+        out = generation.generate(_model(), ids, max_new_tokens=0)
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      np.asarray(ids))
+        out2 = generation.generate(paddle.models.gpt_tiny(), ids,
+                                   max_new_tokens=0)
+        np.testing.assert_array_equal(np.asarray(out2._data),
+                                      np.asarray(ids))
+
     def test_generic_fallback_gpt(self):
         paddle.seed(2)
         model = paddle.models.gpt_tiny()

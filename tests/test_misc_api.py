@@ -144,9 +144,33 @@ class TestAmpDebugging:
         t = paddle.to_tensor(np.array([1.0, np.nan], np.float32))
         with pytest.raises(RuntimeError, match="NaN"):
             dbg.check_numerics(t, "op", "x")
-        (stats,) = dbg.check_numerics(
+        # reference contract (amp/debugging.py:361): (stats, values) with
+        # values = [max, min, mean] as a float tensor
+        stats, values = dbg.check_numerics(
             t, "op", "x", debug_mode=dbg.DebugMode.CHECK_NAN_INF)
         assert stats.numpy().tolist() == [1, 0, 0]
+        vmax, vmin, vmean = values.numpy().tolist()
+        assert vmax == 1.0 and vmin == 1.0 and vmean == 1.0
+        clean = paddle.to_tensor(np.array([3.0, -1.0, 1.0], np.float32))
+        stats2, values2 = dbg.check_numerics(clean, "op", "y")
+        assert stats2.numpy().tolist() == [0, 0, 0]
+        assert values2.numpy().tolist() == [3.0, -1.0, 1.0]
+
+    def test_check_numerics_bfloat16_and_empty(self):
+        import jax.numpy as jnp
+        from paddle_tpu.amp import debugging as dbg
+        from paddle_tpu.framework.core import Tensor
+        # bfloat16 is THE TPU AMP dtype: NaN must be caught even though
+        # np.issubdtype(ml_dtypes.bfloat16, np.floating) is False
+        bad = Tensor(jnp.array([1.0, np.nan], jnp.bfloat16))
+        with pytest.raises(RuntimeError, match="NaN"):
+            dbg.check_numerics(bad, "op", "x")
+        # empty tensor: values are NaN (no fabricated 0.0 max/min/mean)
+        empty = paddle.to_tensor(np.empty((0,), np.float32))
+        stats, values = dbg.check_numerics(
+            empty, "op", "e", debug_mode=dbg.DebugMode.CHECK_NAN_INF)
+        assert stats.numpy().tolist() == [0, 0, 0]
+        assert np.isnan(values.numpy()).all()
         cfg = dbg.TensorCheckerConfig(enable=True)
         dbg.enable_tensor_checker(cfg)
         try:

@@ -354,3 +354,127 @@ class TestConvTransposeStringPadding:
                                    rtol=1e-6)
         with pytest.raises(ValueError, match="not reachable"):
             F.conv2d_transpose(x, w3, stride=2, output_size=(40, 40))
+
+
+class TestBuilderParamRegistry:
+    """Builder parameters persist in a name-keyed registry (the reference
+    keeps them on the Program — static/nn/common.py fc:30), so repeated /
+    retraced calls with the same resolved name reuse weights and the
+    parameters are reachable for optimizers and state_dict (ADVICE r3)."""
+
+    def setup_method(self):
+        nn.reset_parameters()
+
+    def teardown_method(self):
+        nn.reset_parameters()
+
+    def test_named_fc_reuses_weights(self):
+        x = _x((4, 8))
+        a = nn.fc(x, 16, name="proj")
+        b = nn.fc(x, 16, name="proj")
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+    def test_unnamed_calls_draw_fresh_params(self):
+        x = _x((4, 8))
+        a = nn.fc(x, 16)
+        b = nn.fc(x, 16)
+        assert not np.array_equal(a.numpy(), b.numpy())
+
+    def test_unique_name_guard_rebuild_reuses(self):
+        from paddle_tpu.utils import unique_name
+        x = _x((4, 8))
+        with unique_name.guard():
+            a = nn.fc(x, 16)
+        with unique_name.guard():
+            b = nn.fc(x, 16)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+    def test_params_reachable_for_training(self):
+        x = _x((4, 8))
+        nn.fc(x, 16, name="train_me")
+        params = static.default_main_program().all_parameters()
+        names = [p.name for p in params]
+        assert "train_me.w_0" in names and "train_me.b_0" in names
+        sd = static.default_main_program().state_dict()
+        assert "train_me.w_0" in sd
+
+    def test_shape_conflict_rejected(self):
+        x = _x((4, 8))
+        nn.fc(x, 16, name="clash")
+        with pytest.raises(ValueError, match="already exists"):
+            nn.fc(x, 32, name="clash")
+
+    def test_batch_norm_moving_stats_persist(self):
+        img = _x((8, 3, 4, 4), 3)
+        nn.batch_norm(img, name="bn0", momentum=0.5)
+        sd = static.default_main_program().state_dict()
+        mean1 = sd["bn0.moving_mean"].numpy().copy()
+        assert not np.allclose(mean1, 0.0)  # updated in place by training
+        nn.batch_norm(img, name="bn0", momentum=0.5)
+        mean2 = sd["bn0.moving_mean"].numpy()
+        # second call reuses (and further updates) the SAME buffer
+        assert not np.array_equal(mean1, mean2)
+
+    def test_program_guard_scopes_registry(self):
+        x = _x((4, 8))
+        p1, p2 = static.Program(), static.Program()
+        with static.program_guard(p1):
+            nn.fc(x, 16, name="mine")
+        assert "mine.w_0" in p1.state_dict()
+        assert p2.all_parameters() == []  # fresh Program sees nothing
+        assert "mine.w_0" not in static.default_main_program().state_dict()
+        # mode filtering: 'param' excludes buffers
+        with static.program_guard(p1):
+            nn.batch_norm(_x((4, 3, 4, 4)), name="bn")
+        assert "bn.moving_mean" in p1.state_dict("all")
+        assert "bn.moving_mean" not in p1.state_dict("param")
+        with pytest.raises(ValueError, match="mode"):
+            p1.state_dict("bogus")
+
+    def test_param_attr_name_shares_weights(self):
+        from paddle_tpu import ParamAttr
+        x = _x((4, 8))
+        a = nn.fc(x, 16, weight_attr=ParamAttr(name="shared_w"),
+                  bias_attr=False)
+        b = nn.fc(x, 16, weight_attr=ParamAttr(name="shared_w"),
+                  bias_attr=False)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        sd = static.default_main_program().state_dict()
+        assert "shared_w" in sd
+
+    def test_attr_false_means_no_param(self):
+        img = _x((4, 3, 4, 4))
+        out = nn.group_norm(img, 3, param_attr=False, bias_attr=False)
+        assert tuple(out.shape) == (4, 3, 4, 4)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        x = _x((4, 8))
+        p = static.Program()
+        with static.program_guard(p):
+            out1 = nn.fc(x, 16, name="rt")
+        trained = p.state_dict()["rt.w_0"].numpy().copy()
+        static.save(p, str(tmp_path / "m"))
+        # clobber, then load must restore IN PLACE
+        p.state_dict()["rt.w_0"].set_value(np.zeros_like(trained))
+        with static.program_guard(p):
+            zeroed = nn.fc(x, 16, name="rt")
+        assert not np.allclose(zeroed.numpy(), out1.numpy())
+        static.load(p, str(tmp_path / "m"))
+        np.testing.assert_allclose(p.state_dict()["rt.w_0"].numpy(), trained)
+        with static.program_guard(p):
+            out2 = nn.fc(x, 16, name="rt")
+        np.testing.assert_allclose(out2.numpy(), out1.numpy(), rtol=1e-6)
+
+    def test_buffer_name_conflict_rejected(self):
+        nn.batch_norm(_x((4, 3, 4, 4)), name="a", moving_mean_name="mm")
+        with pytest.raises(ValueError, match="already exists"):
+            nn.batch_norm(_x((4, 8, 4, 4)), name="b", moving_mean_name="mm")
+
+    def test_named_conv_and_layer_norm_reuse(self):
+        img = _x((2, 3, 8, 8), 2)
+        c1 = nn.conv2d(img, 4, 3, name="c")
+        c2 = nn.conv2d(img, 4, 3, name="c")
+        np.testing.assert_array_equal(c1.numpy(), c2.numpy())
+        l1 = nn.layer_norm(_x((4, 6)), name="ln")
+        l2 = nn.layer_norm(_x((4, 6)), name="ln")
+        np.testing.assert_array_equal(l1.numpy(), l2.numpy())

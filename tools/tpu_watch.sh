@@ -1,0 +1,20 @@
+#!/bin/bash
+# Probe the TPU tunnel until it answers, then run the full bench ladder.
+# Only ONE TPU-dialing process may exist at a time (wedged-lease hazard);
+# this loop serializes all dials.
+LOG=/root/repo/.tpu_watch.log
+cd /root/repo
+for i in $(seq 1 48); do
+  out=$(timeout 600 python bench.py --worker --probe 2>/dev/null | tail -1; exit "${PIPESTATUS[0]}")
+  rc=$?
+  echo "$(date +%T) probe$i: rc=$rc out=$out" >> "$LOG"
+  if echo "$out" | grep -q tpu_alive; then
+    echo "$(date +%T) TPU ALIVE — running full ladder" >> "$LOG"
+    python bench.py > /root/repo/.bench_r04_candidate.json 2>/root/repo/.bench_stderr.log
+    echo "$(date +%T) bench done rc=$? -> .bench_r04_candidate.json" >> "$LOG"
+    exit 0
+  fi
+  sleep 300
+done
+echo "$(date +%T) gave up: tunnel never answered" >> "$LOG"
+exit 1

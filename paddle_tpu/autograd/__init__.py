@@ -33,7 +33,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         retain_graph = create_graph
     capture = {id(t): t for t in ins}
     captured = _run_backward(outs, grad_outputs, retain_graph=retain_graph,
-                             capture=capture)
+                             capture=capture, create_graph=create_graph)
     results = []
     for t in ins:
         g = (captured or {}).get(id(t))
@@ -125,14 +125,13 @@ class PyLayer(metaclass=PyLayerMeta):
 
         import weakref
 
-        def vjp_fn(cot_tree):
-            cots = cot_tree if isinstance(cot_tree, (list, tuple)) else [cot_tree]
-            grads_in = [Tensor(c) for c in cots]
-            res = cls.backward(ctx, *grads_in)
+        def align_grads(res, wrap):
+            """paddle semantics: backward returns one grad per Tensor input
+            of forward, in order; keep only those recorded as
+            differentiable. `wrap` fixes the output flavor (raw array for
+            the vjp tape, live Tensor for create_graph)."""
             if not isinstance(res, (list, tuple)):
                 res = (res,)
-            # paddle semantics: backward returns one grad per Tensor input of
-            # forward, in order; we keep only those recorded as differentiable
             res_iter = iter(res)
             flat = []
             for a in args:
@@ -141,15 +140,34 @@ class PyLayer(metaclass=PyLayerMeta):
                 r = next(res_iter, None)
                 if a.stop_gradient:
                     continue
-                flat.append(r._data if isinstance(r, Tensor) else
-                            (jnp.zeros_like(a._data) if r is None else jnp.asarray(r)))
+                flat.append(wrap(r, a))
             return tuple(flat)
+
+        def vjp_fn(cot_tree):
+            cots = cot_tree if isinstance(cot_tree, (list, tuple)) else [cot_tree]
+            grads_in = [Tensor(c) for c in cots]
+            res = cls.backward(ctx, *grads_in)
+            return align_grads(res, lambda r, a: (
+                r._data if isinstance(r, Tensor)
+                else jnp.zeros_like(a._data) if r is None
+                else jnp.asarray(r)))
+
+        def tape_vjp_fn(cot_tensors):
+            # create_graph: run the user's backward on LIVE tape tensors so
+            # its ops are recorded; grads w.r.t. the primal inputs flow
+            # through ctx.save_for_backward'ed tensors (saved by identity)
+            res = cls.backward(ctx, *cot_tensors)
+            return align_grads(res, lambda r, a: (
+                r if isinstance(r, Tensor)
+                else Tensor(jnp.zeros_like(a._data)) if r is None
+                else Tensor(jnp.asarray(r))))
 
         new_outs = [Tensor(o._data, stop_gradient=False) for o in out_tensors]
         import jax.tree_util as jtu
         treedef = jtu.tree_structure(tuple(range(len(new_outs))))
         node = _core.Node("PyLayer:" + cls.__name__, vjp_fn, tensor_inputs,
                           new_outs, treedef)
+        node.tape_vjp_fn = tape_vjp_fn
         for t in new_outs:
             t._node = node
 

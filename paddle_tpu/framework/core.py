@@ -172,6 +172,10 @@ class Node:
         "id",
         "name",
         "vjp_fn",
+        "fwd_fn",
+        "tape_vjp_fn",
+        "in_arrays",
+        "in_dtypes",
         "inputs",
         "in_nodes",
         "out_refs",
@@ -187,6 +191,15 @@ class Node:
         self.id = _node_counter
         self.name = name
         self.vjp_fn = vjp_fn
+        # create_graph support: the recorded forward (set by execute) lets
+        # the backward walk re-derive a vjp AS TAPE OPS; custom nodes
+        # (PyLayer) instead provide tape_vjp_fn running their python
+        # backward on live tape tensors (reference: GeneralGrad +
+        # double_grad kernels, paddle/fluid/eager/backward.cc:105)
+        self.fwd_fn = None
+        self.tape_vjp_fn = None
+        self.in_arrays = None   # recorded diff input arrays (create_graph)
+        self.in_dtypes = None   # post-AMP-cast dtypes fwd_fn was traced at
         self.inputs = inputs  # list[Tensor] — differentiable inputs
         # snapshot producer nodes NOW: in-place rebinds may later repoint a
         # tensor's ._node at a different node (x.add_() aliasing)
@@ -221,13 +234,20 @@ def _collect_topo(root_node):
     return order
 
 
-def _run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
+def _run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
+                  create_graph=False):
     """Reverse-mode walk. reference: paddle/fluid/eager/backward.cc:105.
 
     If `capture` is a dict {id(tensor): tensor}, accumulated cotangents for
     those tensors are returned in a dict instead of / in addition to being
     deposited into `.grad` (serves paddle.grad / GeneralGrad,
-    reference: paddle/fluid/eager/backward.cc GeneralGrad)."""
+    reference: paddle/fluid/eager/backward.cc GeneralGrad).
+
+    With create_graph=True every cotangent is itself a live tape Tensor and
+    each node's backward runs through execute() (re-deriving the vjp from
+    the node's recorded forward), so the returned gradients can be
+    differentiated again — the reference's double-grad path
+    (test/legacy_test/test_imperative_double_grad.py)."""
     if not isinstance(tensors, (list, tuple)):
         tensors = [tensors]
     if grad_tensors is None:
@@ -235,7 +255,8 @@ def _run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
     elif not isinstance(grad_tensors, (list, tuple)):
         grad_tensors = [grad_tensors]
 
-    # pending cotangents keyed by tensor identity
+    # pending cotangents keyed by tensor identity (raw arrays normally;
+    # live tape Tensors under create_graph)
     pending: dict[int, Any] = {}
     keep: dict[int, Tensor] = {}
 
@@ -251,6 +272,12 @@ def _run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
                     "grad can be implicitly created only for scalar outputs"
                 )
             g_arr = jnp.ones_like(t._data)
+            if create_graph:
+                g_arr = Tensor(g_arr, stop_gradient=True)
+        elif create_graph:
+            # keep the caller's Tensor intact: its own history must stay
+            # differentiable through the second backward
+            g_arr = g if isinstance(g, Tensor) else Tensor(jnp.asarray(g))
         else:
             g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
         _accum(pending, keep, t, g_arr)
@@ -303,6 +330,8 @@ def _run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
                     captured[id(t)] = c
             if c is None:
                 c = jnp.zeros(shape, dtype)
+                if create_graph:
+                    c = Tensor(c, stop_gradient=True)
             else:
                 has_any = True
                 if c.dtype != dtype:
@@ -313,11 +342,17 @@ def _run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
             cots.append(c)
         if not has_any:
             continue
-        cot_tree = jax.tree_util.tree_unflatten(node.out_treedef, cots)
-        in_cots = node.vjp_fn(cot_tree)
+        if create_graph:
+            in_cots = _node_backward_recorded(node, cots)
+        else:
+            cot_tree = jax.tree_util.tree_unflatten(node.out_treedef, cots)
+            in_cots = node.vjp_fn(cot_tree)
         _maybe_check_nan(in_cots, node.name + "_grad")
         if not retain_graph:
             node.vjp_fn = None
+            node.fwd_fn = None
+            node.tape_vjp_fn = None  # PyLayer: free ctx + saved activations
+            node.in_arrays = None
         for t, rec_node, c in zip(node.inputs, node.in_nodes, in_cots):
             if rec_node is None:
                 _accum(leaf_pending, leaf_keep, t, c)
@@ -353,15 +388,64 @@ def _accum(pending, keep, t, g):
         keep[tid] = t
 
 
+def _node_backward_recorded(node, cot_tensors):
+    """One node's backward as RECORDED ops: gradients come out as live tape
+    Tensors whose history covers both the node's primal inputs and the
+    incoming cotangents, so a second backward differentiates through them.
+    reference: the generated double_grad kernels + GeneralGrad
+    (paddle/fluid/eager/backward.cc:105)."""
+    if node.tape_vjp_fn is not None:  # PyLayer: user backward on live tensors
+        return node.tape_vjp_fn(cot_tensors)
+    fwd = node.fwd_fn
+    if fwd is None:
+        raise RuntimeError(
+            f"create_graph=True: node '{node.name}' was recorded without a "
+            "re-differentiable forward (its graph was already freed by an "
+            "earlier backward without retain_graph)")
+    k = len(node.inputs)
+    for t, rec in zip(node.inputs, node.in_arrays):
+        if t._data is not rec:
+            # the recompute would evaluate at the MUTATED value and silently
+            # disagree with the recorded residuals (torch raises the same way
+            # for in-place modification of needed variables)
+            raise RuntimeError(
+                f"create_graph=True: an input of '{node.name}' was modified "
+                "in-place after the forward; its second-order gradient "
+                "would be computed at the new value. Clone the tensor "
+                "before mutating it.")
+    treedef = node.out_treedef
+    in_dtypes = node.in_dtypes
+
+    def grad_op(*args):
+        primals, cots = args[:k], args[k:]
+        # re-apply the recorded (possibly AMP-cast) trace dtypes: fwd_fn
+        # was traced over post-cast arrays and the cotangents carry the
+        # recorded output dtypes
+        primals = tuple(
+            p.astype(dt) if p.dtype != dt else p
+            for p, dt in zip(primals, in_dtypes))
+        _, vjp_fn = jax.vjp(fwd, *primals)
+        return tuple(vjp_fn(jax.tree_util.tree_unflatten(treedef, list(cots))))
+
+    out = execute(grad_op, *node.inputs, *cot_tensors,
+                  _name=node.name + "_grad")
+    return out if isinstance(out, (list, tuple)) else (out,)
+
+
 def _apply_grad_hooks(t, g):
     """Run a tensor's registered gradient hooks over its complete cotangent.
     reference: paddle/fluid/eager/hooks.h (TensorHook::operator())."""
     hooks = t.__dict__.get("_grad_hooks") if hasattr(t, "__dict__") else None
     if not hooks:
         return g
+    live = isinstance(g, Tensor)  # create_graph: keep the tape alive
     for hook in list(hooks.values()):
-        r = hook(Tensor(g, stop_gradient=True))
-        if r is not None:
+        r = hook(g if live else Tensor(g, stop_gradient=True))
+        if r is None:
+            continue
+        if live:
+            g = r if isinstance(r, Tensor) else Tensor(jnp.asarray(r))
+        else:
             g = r._data if isinstance(r, Tensor) else jnp.asarray(r)
     return g
 
@@ -369,6 +453,8 @@ def _apply_grad_hooks(t, g):
 def _deposit_leaf_grad(t, g):
     if g is None or t.stop_gradient:
         return
+    if isinstance(g, Tensor):  # create_graph walk: .grad stays detached
+        g = g._data
     if t._grad is None:
         t._grad = Tensor(g, stop_gradient=True)
     else:
@@ -478,6 +564,10 @@ def execute(f: Callable, *inputs, _name: str = None, **static_kwargs):
         out_tensors,
         treedef,
     )
+    node.fwd_fn = g  # create_graph: re-derivable vjp over the same consts
+    # pre-cast originals (mutation detection) + post-cast trace dtypes
+    node.in_arrays = [inputs[i]._data for i in diff_idx]
+    node.in_dtypes = [a.dtype for a in diff_arrs]
     for t in out_tensors:
         t._node = node
     return jax.tree_util.tree_unflatten(treedef, out_tensors)

@@ -282,3 +282,192 @@ class TestNanInfChecker:
             finally:
                 paddle.set_flags({"FLAGS_check_nan_inf_level": 0})
         self._with_flag(True, run)
+
+
+class TestDoubleBackward:
+    """create_graph=True: gradients are live tape tensors differentiable
+    again. Parity oracle: jax.grad(jax.grad(f)).
+    reference: GeneralGrad (paddle/fluid/eager/backward.cc:105),
+    test/legacy_test/test_imperative_double_grad.py."""
+
+    def test_cubic_scalar(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        y = (x * x * x).sum()
+        (g,) = paddle.grad(y, x, create_graph=True)
+        assert not g.stop_gradient
+        np.testing.assert_allclose(g.numpy(), [12.0])
+        (h,) = paddle.grad(g.sum(), x)
+        np.testing.assert_allclose(h.numpy(), [12.0])  # 6x = 12
+
+    def test_matmul_parity_vs_jax(self):
+        import jax
+        xn = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        wn = np.random.RandomState(1).randn(4, 2).astype(np.float32)
+        x = paddle.to_tensor(xn, stop_gradient=False)
+        w = paddle.to_tensor(wn, stop_gradient=False)
+        f = ((x @ w) * (x @ w)).sum()
+        (gx,) = paddle.grad(f, x, create_graph=True)
+        (ggx,) = paddle.grad((gx * gx).sum(), x)
+
+        def inner(xa):
+            g = jax.grad(lambda z: ((z @ wn) ** 2).sum())(xa)
+            return (g * g).sum()
+
+        expect = jax.grad(inner)(xn)
+        np.testing.assert_allclose(ggx.numpy(), np.asarray(expect),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_tanh_mlp_parity_vs_jax(self):
+        import jax
+        import jax.numpy as jnp
+        xn = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        w1n = np.random.RandomState(2).randn(4, 8).astype(np.float32)
+        w2n = np.random.RandomState(3).randn(8, 1).astype(np.float32)
+        x = paddle.to_tensor(xn, stop_gradient=False)
+        w1 = paddle.to_tensor(w1n, stop_gradient=False)
+        w2 = paddle.to_tensor(w2n, stop_gradient=False)
+        out = (paddle.tanh(x @ w1) @ w2).sum()
+        (gx,) = paddle.grad(out, x, create_graph=True)
+        (hx,) = paddle.grad(gx.sum(), x)
+        expect = jax.grad(lambda xa: jax.grad(
+            lambda z: (jnp.tanh(z @ w1n) @ w2n).sum())(xa).sum())(xn)
+        np.testing.assert_allclose(hx.numpy(), np.asarray(expect),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_second_grad_reaches_other_leaf(self):
+        # d/dw of dL/dx must flow through the recorded grad op
+        import jax
+        xn = np.random.RandomState(4).randn(2, 3).astype(np.float32)
+        wn = np.random.RandomState(5).randn(3, 2).astype(np.float32)
+        x = paddle.to_tensor(xn, stop_gradient=False)
+        w = paddle.to_tensor(wn, stop_gradient=False)
+        L = ((x @ w) ** 2).sum()
+        (gx,) = paddle.grad(L, x, create_graph=True)
+        (gw,) = paddle.grad(gx.sum(), w)
+        expect = jax.grad(lambda wa: jax.grad(
+            lambda z: ((z @ wa) ** 2).sum())(xn).sum())(wn)
+        np.testing.assert_allclose(gw.numpy(), np.asarray(expect),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_pylayer_double_grad(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.autograd import PyLayer
+
+        class MyTanh(PyLayer):
+            @staticmethod
+            def forward(ctx, a):
+                ctx.save_for_backward(a)
+                return paddle.tanh(a)
+
+            @staticmethod
+            def backward(ctx, dy):
+                (a,) = ctx.saved_tensor
+                t = paddle.tanh(a)
+                return dy * (1.0 - t * t)
+
+        xn = np.random.RandomState(6).randn(5).astype(np.float32)
+        x = paddle.to_tensor(xn, stop_gradient=False)
+        y = MyTanh.apply(x).sum()
+        (g,) = paddle.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(g.numpy(), 1 - np.tanh(xn) ** 2,
+                                   rtol=1e-5, atol=1e-6)
+        (h,) = paddle.grad(g.sum(), x)
+        expect = jax.grad(lambda z: jax.grad(
+            lambda a: jnp.tanh(a).sum())(z).sum())(xn)
+        np.testing.assert_allclose(h.numpy(), np.asarray(expect),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_hessian_consistency_with_imperative(self):
+        # autograd.hessian (jax.hessian) must agree with a row-by-row
+        # imperative double grad
+        from paddle_tpu import autograd
+
+        xn = np.random.RandomState(7).randn(3).astype(np.float32)
+
+        def f(t):
+            return (t * t * t).sum()
+
+        H = autograd.hessian(f, paddle.to_tensor(xn, stop_gradient=False))
+        x = paddle.to_tensor(xn, stop_gradient=False)
+        y = (x * x * x).sum()
+        (g,) = paddle.grad(y, x, create_graph=True)
+        rows = []
+        for i in range(3):
+            (r,) = paddle.grad(g[i], x, retain_graph=True)
+            rows.append(r.numpy())
+        np.testing.assert_allclose(H.numpy(), np.stack(rows),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_grad_grad_with_grad_outputs(self):
+        # caller-supplied grad_outputs participates in the second graph
+        import jax
+        xn = np.random.RandomState(8).randn(4).astype(np.float32)
+        vn = np.random.RandomState(9).randn(4).astype(np.float32)
+        x = paddle.to_tensor(xn, stop_gradient=False)
+        y = x * x  # non-scalar: needs grad_outputs
+        (g,) = paddle.grad(y, x, grad_outputs=paddle.to_tensor(vn),
+                           create_graph=True)
+        (h,) = paddle.grad(g.sum(), x)
+        # g = 2 v x -> dh/dx = 2 v
+        np.testing.assert_allclose(h.numpy(), 2 * vn, rtol=1e-5, atol=1e-6)
+
+    def test_freed_graph_raises_clear_error(self):
+        x = paddle.to_tensor(np.array([1.5], np.float32),
+                             stop_gradient=False)
+        y = (x * x).sum()
+        (g,) = paddle.grad(y, x, retain_graph=False)  # frees vjp+fwd
+        with pytest.raises(RuntimeError,
+                           match="re-differentiable forward"):
+            paddle.grad(y, x, create_graph=True)
+        # a fresh graph on the same tensor still works
+        y2 = (x * x).sum()
+        (g2,) = paddle.grad(y2, x, create_graph=True)
+        (h,) = paddle.grad(g2.sum(), x)
+        np.testing.assert_allclose(h.numpy(), [2.0])
+
+    def test_freed_pylayer_graph_raises_too(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Sq(PyLayer):
+            @staticmethod
+            def forward(ctx, a):
+                ctx.save_for_backward(a)
+                return a * a
+
+            @staticmethod
+            def backward(ctx, dy):
+                (a,) = ctx.saved_tensor
+                return dy * 2.0 * a
+
+        x = paddle.to_tensor(np.array([1.5], np.float32),
+                             stop_gradient=False)
+        y = Sq.apply(x).sum()
+        paddle.grad(y, x, retain_graph=False)
+        with pytest.raises(RuntimeError,
+                           match="re-differentiable forward"):
+            paddle.grad(y, x, create_graph=True)
+
+    def test_inplace_mutation_after_forward_raises(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        y = (x * x).sum()
+        x.set_value(np.array([3.0], np.float32))
+        with pytest.raises(RuntimeError, match="modified in-place"):
+            paddle.grad(y, x, create_graph=True)
+
+    def test_amp_autocast_double_grad(self):
+        # fwd recorded under auto_cast: the create_graph recompute must
+        # re-apply the recorded bf16 trace dtypes, not crash on fp32
+        from paddle_tpu import amp
+        xn = np.random.RandomState(10).randn(4, 4).astype(np.float32)
+        wn = np.random.RandomState(11).randn(4, 4).astype(np.float32)
+        x = paddle.to_tensor(xn, stop_gradient=False)
+        w = paddle.to_tensor(wn, stop_gradient=False)
+        with amp.auto_cast():
+            y = (x @ w).sum()
+        (g,) = paddle.grad(y, x, create_graph=True)
+        (h,) = paddle.grad((g * g).sum(), w)
+        # analytic: g = 1 @ w.T (in bf16), d/dw sum(g^2) = 2 * outer terms
+        assert h.shape == [4, 4]
+        assert np.isfinite(h.numpy()).all()

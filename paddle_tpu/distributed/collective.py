@@ -72,12 +72,32 @@ def _eager_world(group):
     return group.nranks if group is not None else get_world_size()
 
 
+def _is_multiprocess_world(group):
+    """True when this is a REAL multi-process world (jax.distributed
+    initialized, one controller per host) and `group` spans it — the regime
+    where eager collectives communicate over the coordination backend
+    (gloo on CPU, ICI/DCN on TPU pods)."""
+    n = jax.process_count()
+    if n <= 1:
+        return False
+    return group is None or set(group.ranks) == set(range(n))
+
+
+def _process_allgather(arr):
+    """Host-level allgather: (world, *shape) with rank r's value at [r].
+    reference analog: ProcessGroup allgather over NCCL/gloo."""
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(arr)
+
+
 def _require_trivial_world(group, name):
     """Eager (non-compiled) collectives are only correct when the calling
     world is size 1 — with a real multi-rank group, silently returning the
     input would compute WRONG numbers for ported multi-process code.
-    reference behavior: the call would actually communicate; here the
-    communication belongs inside shard_map/jit, so we fail loudly."""
+    reference behavior: the call would actually communicate; here in-process
+    device parallelism belongs inside shard_map/jit, so we fail loudly.
+    (A REAL multi-process world is handled before this guard via the
+    multihost path.)"""
     n = _eager_world(group)
     if n > 1:
         raise RuntimeError(
@@ -85,7 +105,25 @@ def _require_trivial_world(group, name):
             "supported on the single-controller TPU runtime — run the op "
             "inside a compiled region (shard_map/jit over the group's mesh "
             "axis), or use parallel.SpmdTrainer which inserts collectives "
-            "via GSPMD")
+            "via GSPMD; sub-world eager groups are compiled-only even in "
+            "multi-process runs")
+
+
+#: one source of truth for ReduceOp dispatch: stacked-axis reducer name
+#: (host-level eager path) — _psum_like above covers the shard_map path
+_STACK_REDUCERS = {ReduceOp.SUM: "sum", ReduceOp.MAX: "max",
+                   ReduceOp.MIN: "min", ReduceOp.AVG: "mean",
+                   ReduceOp.PROD: "prod"}
+
+
+def _reduce_stacked(g, op):
+    """Reduce a (world, ...) stack along axis 0 by ReduceOp."""
+    name = _STACK_REDUCERS.get(op) or _STACK_REDUCERS.get(
+        {"sum": ReduceOp.SUM, "max": ReduceOp.MAX, "min": ReduceOp.MIN,
+         "avg": ReduceOp.AVG, "prod": ReduceOp.PROD}.get(op))
+    if name is None:
+        raise ValueError(op)
+    return getattr(g, name)(0)
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -93,6 +131,11 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     if axis is not None and _in_shardmap(tensor._data):
         out = execute(lambda a: _psum_like(a, op, axis), tensor, _name="all_reduce")
         tensor._rebind(out)
+        return _Task()
+    if _is_multiprocess_world(group) and not _in_shardmap(tensor._data):
+        red = _reduce_stacked(_process_allgather(tensor._data), op)
+        tensor._rebind(Tensor(jnp.asarray(red),
+                              stop_gradient=tensor.stop_gradient))
         return _Task()
     _require_trivial_world(group, "all_reduce")
     return _Task()  # world size 1: reduction over one rank is identity
@@ -106,6 +149,11 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
         n = gathered.shape[0]
         from ..tensor.manipulation import unbind
         tensor_list.extend(unbind(gathered, 0))
+        return _Task()
+    if _is_multiprocess_world(group) and not _in_shardmap(tensor._data):
+        g = _process_allgather(tensor._data)  # (world, ...)
+        tensor_list.extend(Tensor(jnp.asarray(g[i]), stop_gradient=True)
+                           for i in range(g.shape[0]))
         return _Task()
     _require_trivial_world(group, "all_gather")
     tensor_list.append(tensor)
@@ -167,9 +215,25 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    # replicated-by-construction in single-controller mode; with a real
-    # multi-rank world the value is already global (jax arrays are), so
-    # broadcast is a true no-op either way
+    if _in_shardmap(tensor._data):
+        # inside a compiled region values are replicated by construction
+        # (or the caller shards them explicitly); never dial the host path
+        # on a tracer
+        return _Task()
+    if _is_multiprocess_world(group):
+        # host-level broadcast: ship only src's value (no full allgather)
+        from jax.experimental import multihost_utils
+        out = multihost_utils.broadcast_one_to_all(
+            tensor._data, is_source=jax.process_index() == src)
+        tensor._rebind(Tensor(jnp.asarray(out),
+                              stop_gradient=tensor.stop_gradient))
+        return _Task()
+    if jax.process_count() > 1:
+        # sub-world eager group in a multi-process run: compiled-only
+        _require_trivial_world(group, "broadcast")
+        return _Task()
+    # single-process: replicated-by-construction (jax arrays are global),
+    # so broadcast is a true no-op
     return _Task()
 
 

@@ -111,6 +111,11 @@ def _run_local_procs(args):
                        PADDLE_LOCAL_RANK=str(r),
                        JAX_PLATFORMS=args.backend or "cpu",
                        PADDLE_LAUNCH_MODE="simulation")
+            if args.master:
+                # real multi-process rendezvous: workers' init_parallel_env
+                # dials jax.distributed.initialize at this address
+                # (reference: launch/main.py sets PADDLE_MASTER for the pod)
+                env["PADDLE_MASTER"] = args.master
             out = None
             if args.log_dir:
                 os.makedirs(args.log_dir, exist_ok=True)

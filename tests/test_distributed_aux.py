@@ -297,6 +297,29 @@ class TestEagerCollectiveGuards:
         assert len(out) == 1
 
 
+class TestFleetNeverRoutesIntoEagerRaises:
+    """DESIGN.md eager-collective contract: fleet.distributed_model's DP
+    wrapper must train through the compiled/grad-global path and never call
+    an eager collective that raises for multi-rank in-process groups."""
+
+    def test_dp_train_step_clean(self):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu import nn, optimizer
+        fleet.fleet.init(is_collective=True)
+        net = nn.Linear(4, 2)
+        model = fleet.distributed_model(net)
+        opt = fleet.distributed_optimizer(
+            optimizer.SGD(0.1, parameters=net.parameters()))
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(8, 4).astype(np.float32))
+        loss = model(x).sum() if not hasattr(model, "train_batch") \
+            else model.train_batch([x])
+        if isinstance(loss, paddle.Tensor):
+            loss.backward()
+            opt.step()
+            opt.clear_grad()  # completes without eager-collective raises
+
+
 class TestStreamTensorFlavor:
     """reference stream signatures accept a single pre-sized Tensor for
     tensor_or_tensor_list (stream/all_gather.py tensor branch); the

@@ -141,6 +141,157 @@ def _run_one(cfg, batch, seq, steps, remat, on_tpu):
             "step_time_s": dt / steps, "xla_flops_per_step": xla_flops}
 
 
+def _functional_train_setup(model, opt, to_bf16):
+    """state_dict -> pure param arrays (+ optional bf16 cast) + opt state.
+    Frees the imperative model's own arrays (functional_call substitutes
+    every param by name, so the templates are never read) — on a ~16 GB
+    chip the f32 originals would otherwise pin HBM for the whole bench."""
+    import jax.numpy as jnp
+    params = {}
+    for k, t in model.state_dict().items():
+        a = t._data
+        if to_bf16 and a.dtype == jnp.float32:
+            a = a.astype(jnp.bfloat16)
+        params[k] = a
+        if to_bf16:
+            t._data = jnp.zeros((), t._data.dtype)
+    return params, opt.tree_init(params)
+
+
+def _time_train(jstep, params, opt_state, make_args, steps):
+    """Shared bench loop: one compile+warmup step, then `steps` timed steps.
+    Returns (final_loss, seconds). make_args(i) -> per-step tail args."""
+    loss, params, opt_state = jstep(params, opt_state, *make_args(1))
+    _ = float(loss)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        loss, params, opt_state = jstep(params, opt_state, *make_args(2 + i))
+    final = float(loss)
+    return final, time.perf_counter() - t0
+
+
+def _bench_resnet(on_tpu):
+    """BASELINE row 2: ResNet-50 ImageNet-shape train step, images/sec.
+    reference perf unit: python/paddle/profiler/timer.py (ips)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.parallel.functional import make_loss_fn
+
+    paddle.seed(0)
+    if on_tpu:
+        from paddle_tpu.vision.models import resnet50
+        model, batch, hw, steps = resnet50(), 64, 224, 8
+    else:
+        from paddle_tpu.vision.models import resnet18
+        model, batch, hw, steps = resnet18(num_classes=10), 2, 32, 2
+    opt = optimizer.Momentum(0.1, momentum=0.9,
+                             parameters=model.parameters())
+    params, opt_state = _functional_train_setup(model, opt, to_bf16=on_tpu)
+    loss_fn = make_loss_fn(
+        model, lambda logits, y: F.cross_entropy(logits, y))
+
+    def train_step(p, st, x, y, lr, stp):
+        loss, grads = jax.value_and_grad(loss_fn)(p, (x, y), None)
+        new_p, new_st = opt.tree_update(p, grads, st, lr, stp)
+        return loss, new_p, new_st
+
+    jstep = jax.jit(train_step, donate_argnums=(0, 1))
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, 3, hw, hw),
+                    jnp.bfloat16 if on_tpu else jnp.float32)
+    y = jnp.asarray(rng.randint(0, 1000 if on_tpu else 10, (batch,)),
+                    jnp.int32)
+    lr = jnp.float32(0.1)
+    final, dt = _time_train(jstep, params, opt_state,
+                            lambda i: (x, y, lr, jnp.int32(i)), steps)
+    return {"resnet_images_per_s": round(batch * steps / dt, 1),
+            "resnet_batch": batch, "resnet_loss": round(final, 4),
+            "resnet_variant": "resnet50_224" if on_tpu else "resnet18_32_cpu"}
+
+
+def _bench_bert(on_tpu):
+    """BASELINE row 3: BERT-base pretraining-shape step, MFU."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = BertConfig(dropout=0.0)  # bert-base: 12L/768/12H
+        batch, seq, steps = 32, 512, 8
+    else:
+        cfg = BertConfig(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                         num_attention_heads=4, intermediate_size=128,
+                         max_position_embeddings=128, dropout=0.0)
+        batch, seq, steps = 2, 64, 2
+    model = BertForPretraining(cfg)
+    n_params = sum(int(np.prod(t.shape))
+                   for t in model.state_dict().values())
+    opt = optimizer.AdamW(1e-4, parameters=model.parameters())
+    params, opt_state = _functional_train_setup(model, opt, to_bf16=on_tpu)
+    from paddle_tpu.parallel.functional import functional_call
+
+    def loss_fn(p, ids, labels):
+        out = functional_call(model, p, ids, masked_lm_labels=labels)
+        loss = out[0] if isinstance(out, (tuple, list)) else out
+        return loss.astype(jnp.float32)
+
+    def train_step(p, st, ids, labels, lr, stp):
+        loss, grads = jax.value_and_grad(loss_fn)(p, ids, labels)
+        new_p, new_st = opt.tree_update(p, grads, st, lr, stp)
+        return loss, new_p, new_st
+
+    jstep = jax.jit(train_step, donate_argnums=(0, 1))
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(
+        np.where(rng.rand(batch, seq) < 0.15,
+                 rng.randint(0, cfg.vocab_size, (batch, seq)), -100),
+        jnp.int32)
+    lr = jnp.float32(1e-4)
+    final, dt = _time_train(jstep, params, opt_state,
+                            lambda i: (ids, labels, lr, jnp.int32(i)), steps)
+    tok_per_s = batch * seq * steps / dt
+    out = {"bert_tokens_per_s": round(tok_per_s, 1),
+           "bert_params": n_params, "bert_loss": round(final, 4),
+           "bert_batch": batch, "bert_seq": seq}
+    peak = detect_peak()
+    if peak:
+        flops_per_token = (6.0 * n_params +
+                           12.0 * cfg.num_hidden_layers * cfg.hidden_size * seq)
+        out["bert_base_mfu"] = round(flops_per_token * tok_per_s / peak, 4)
+    return out
+
+
+def secondary_worker(force_cpu: bool, which: str):
+    """ResNet/BERT secondary metrics (BASELINE rows 2-3) as their own
+    bounded subprocess so a hang can't eat the llama budget."""
+    import jax
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    on_tpu = jax.devices()[0].platform != "cpu"
+    detail = {"device": str(jax.devices()[0])}
+    benches = [("resnet", _bench_resnet), ("bert", _bench_bert)]
+    for name, fn in benches:
+        if which not in (name, "both"):
+            continue
+        try:  # isolate: one model's failure must not skip the other
+            detail.update(fn(on_tpu))
+        except Exception as e:  # noqa: BLE001 — report, don't crash the round
+            detail[f"{name}_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+    print(json.dumps({"metric": "secondary_models", "value": 1.0,
+                      "unit": "detail", "vs_baseline": 0.0,
+                      "detail": detail}))
+    return 0
+
+
 def probe():
     """Minimal TPU liveness check: backend init + one tiny matmul."""
     import jax
@@ -290,6 +441,12 @@ def main():
     if "--worker" in sys.argv:
         if "--probe" in sys.argv:
             return probe()
+        if "--secondary" in sys.argv:
+            i = sys.argv.index("--secondary")
+            which = sys.argv[i + 1] if i + 1 < len(sys.argv) \
+                and not sys.argv[i + 1].startswith("-") else "both"
+            return secondary_worker(force_cpu="--cpu" in sys.argv,
+                                    which=which)
         cfg = None
         if "--config" in sys.argv:
             cfg = int(sys.argv[sys.argv.index("--config") + 1])
@@ -326,6 +483,25 @@ def main():
         if result is not None:
             if errors:
                 result.setdefault("detail", {})["attempt_errors"] = errors
+            # secondary metrics (BASELINE rows 2-3): bounded, best-effort,
+            # run AFTER the primary llama number is already in hand. Key off
+            # the attempt that actually SUCCEEDED: if the primary came from
+            # the --cpu fallback (mid-run wedge), don't burn 24 min dialing
+            # the TPU for secondaries
+            primary_on_cpu = "--cpu" in args
+            sec_plan = ([(["--secondary", "resnet"], 720),
+                         (["--secondary", "bert"], 720)]
+                        if tpu_alive and not primary_on_cpu
+                        else [(["--secondary", "both", "--cpu"], 420)])
+            secondary = {}
+            for sargs, st in sec_plan:
+                sres, serr = _attempt(sargs, st)
+                if sres is not None:
+                    secondary.update(sres.get("detail", {}))
+                else:
+                    secondary.setdefault("errors", []).append(serr)
+            if secondary:
+                result.setdefault("detail", {})["secondary"] = secondary
             print(json.dumps(result))
             return 0
         errors.append(f"attempt{i}({' '.join(args) or 'tpu'}): {err}")

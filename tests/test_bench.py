@@ -1,0 +1,42 @@
+"""bench.py contract tests (CPU paths only — the driver runs TPU).
+
+The driver parses ONE JSON line per run; these tests pin the worker-level
+contracts so a bench regression is caught before a TPU round is wasted.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_worker(args, timeout=600):
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--worker"] + args,
+        capture_output=True, text=True, timeout=timeout, cwd=REPO)
+    for line in reversed(p.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    raise AssertionError(f"no JSON line: rc={p.returncode} "
+                         f"stderr={p.stderr[-300:]}")
+
+
+class TestBenchWorkers:
+    def test_secondary_models_cpu(self):
+        """BASELINE rows 2-3: ResNet images/sec + BERT tokens/s emitted in
+        one secondary detail dict, with no error field."""
+        obj = _run_worker(["--secondary", "both", "--cpu"])
+        assert obj["metric"] == "secondary_models"
+        d = obj["detail"]
+        assert not any(k.endswith("error") for k in d), d
+        assert d["resnet_images_per_s"] > 0
+        assert d["bert_tokens_per_s"] > 0
+        assert d["resnet_loss"] == d["resnet_loss"]  # not NaN
+        assert d["bert_loss"] > 0
+
+    def test_llama_cpu_smoke(self):
+        obj = _run_worker(["--cpu"])
+        assert obj["metric"] == "llama_train_tokens_per_s_cpu_smoke"
+        assert obj["value"] > 0

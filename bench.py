@@ -158,6 +158,20 @@ def _functional_train_setup(model, opt, to_bf16):
     return params, opt.tree_init(params)
 
 
+def _jit_train_step(opt, loss_fn):
+    """Shared step builder: value_and_grad + optimizer update, params and
+    opt state donated. loss_fn(params, *data) -> scalar."""
+    import jax
+
+    def train_step(p, st, *tail):
+        *data, lr, stp = tail
+        loss, grads = jax.value_and_grad(loss_fn)(p, *data)
+        new_p, new_st = opt.tree_update(p, grads, st, lr, stp)
+        return loss, new_p, new_st
+
+    return jax.jit(train_step, donate_argnums=(0, 1))
+
+
 def _time_train(jstep, params, opt_state, make_args, steps):
     """Shared bench loop: one compile+warmup step, then `steps` timed steps.
     Returns (final_loss, seconds). make_args(i) -> per-step tail args."""
@@ -193,13 +207,7 @@ def _bench_resnet(on_tpu):
     params, opt_state = _functional_train_setup(model, opt, to_bf16=on_tpu)
     loss_fn = make_loss_fn(
         model, lambda logits, y: F.cross_entropy(logits, y))
-
-    def train_step(p, st, x, y, lr, stp):
-        loss, grads = jax.value_and_grad(loss_fn)(p, (x, y), None)
-        new_p, new_st = opt.tree_update(p, grads, st, lr, stp)
-        return loss, new_p, new_st
-
-    jstep = jax.jit(train_step, donate_argnums=(0, 1))
+    jstep = _jit_train_step(opt, lambda p, x, y: loss_fn(p, (x, y), None))
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randn(batch, 3, hw, hw),
                     jnp.bfloat16 if on_tpu else jnp.float32)
@@ -243,12 +251,7 @@ def _bench_bert(on_tpu):
         loss = out[0] if isinstance(out, (tuple, list)) else out
         return loss.astype(jnp.float32)
 
-    def train_step(p, st, ids, labels, lr, stp):
-        loss, grads = jax.value_and_grad(loss_fn)(p, ids, labels)
-        new_p, new_st = opt.tree_update(p, grads, st, lr, stp)
-        return loss, new_p, new_st
-
-    jstep = jax.jit(train_step, donate_argnums=(0, 1))
+    jstep = _jit_train_step(opt, loss_fn)
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
     labels = jnp.asarray(
@@ -494,12 +497,23 @@ def main():
                         if tpu_alive and not primary_on_cpu
                         else [(["--secondary", "both", "--cpu"], 420)])
             secondary = {}
+            tpu_sec_failed = False
             for sargs, st in sec_plan:
                 sres, serr = _attempt(sargs, st)
                 if sres is not None:
                     secondary.update(sres.get("detail", {}))
                 else:
-                    secondary.setdefault("errors", []).append(serr)
+                    secondary.setdefault("errors", []).append(
+                        f"{' '.join(sargs)}: {serr}")
+                    tpu_sec_failed = tpu_sec_failed or "--cpu" not in sargs
+            if tpu_sec_failed:
+                # mid-run wedge: still ship CPU numbers for rows 2-3
+                sres, serr = _attempt(["--secondary", "both", "--cpu"], 420)
+                if sres is not None:
+                    secondary["cpu_fallback"] = sres.get("detail", {})
+                else:
+                    secondary.setdefault("errors", []).append(
+                        f"cpu fallback: {serr}")
             if secondary:
                 result.setdefault("detail", {})["secondary"] = secondary
             print(json.dumps(result))

@@ -1,0 +1,140 @@
+"""Measure the SPMD-scan pipeline tax vs pure GSPMD at equal chip count.
+
+The scan-over-ticks pipeline design burns REAL flops in fill/drain ticks
+(masked compute), unlike the reference's idle bubbles
+(fleet/meta_parallel/pipeline_parallel.py:575). This tool quantifies that
+tax without hardware, three ways per (schedule, pp):
+
+- XLA cost-model flops — reported with a CAVEAT: XLA counts a scan body
+  ONCE, not times its trip count, so scan-over-ticks programs undercount;
+  the column is useful only within a schedule family, not across.
+- wall-clock per train step on the virtual CPU mesh (both programs get
+  the same host cores, so the RATIO is meaningful even though absolute
+  CPU times are not TPU times), and
+- the analytic masked-tick ratio (mb+pp-1)/mb the SPMD-scan design pays.
+
+Usage: python tools/pipeline_tax.py  (prints a markdown table; results are
+recorded in DESIGN.md "Pipeline tax, measured").
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer
+from paddle_tpu.parallel.spmd import SpmdTrainer, DP_ONLY_RULES
+from paddle_tpu.parallel.llama_pipeline import LlamaPipeRunner
+
+CFG = dict(hidden_size=256, intermediate_size=512, num_hidden_layers=8,
+           num_attention_heads=4, num_key_value_heads=4, vocab_size=512,
+           max_position_embeddings=256)
+BATCH, SEQ = 8, 128
+
+
+def _model():
+    paddle.seed(0)
+    return paddle.models.llama_tiny(**CFG)
+
+
+def _cost(compiled):
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    flops = float(ca.get("flops", 0.0))
+    mem = None
+    try:
+        ma = compiled.memory_analysis()
+        mem = ma.temp_size_in_bytes
+    except Exception:
+        pass
+    return flops, mem
+
+
+def _wall(run_step, steps=4):
+    """Median-ish wall clock per step after one warmup (compile) step."""
+    import time
+    run_step()  # warmup / compile
+    times = []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        run_step()
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2]
+
+
+def baseline_gspmd(n_dev):
+    model = _model()
+    opt = optimizer.AdamW(1e-3, parameters=model.parameters())
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("dp",))
+    tr = SpmdTrainer(model, opt, mesh, DP_ONLY_RULES, batch_spec=P("dp"))
+    ids = jnp.zeros((BATCH, SEQ), jnp.int32)
+    jstep = tr._build((ids, ids))
+    lr = jnp.float32(1e-3)
+    comp = jstep.lower(tr.params, tr.opt_state, (ids, ids),
+                       jax.random.key(0), jnp.int32(1), lr).compile()
+    wall = _wall(lambda: float(tr.step((ids, ids))))
+    return *_cost(comp), wall
+
+
+def pipeline(schedule, pp, mb):
+    model = _model()
+    opt = optimizer.AdamW(1e-3, parameters=model.parameters())
+    mesh = Mesh(np.array(jax.devices()[:pp]), ("pp",))
+    runner = LlamaPipeRunner(model, mesh, num_microbatches=mb,
+                             optimizer=opt, schedule=schedule)
+    jstep = runner._build_step()
+    ids = jnp.zeros((BATCH, SEQ), jnp.int32)
+    lr = jnp.float32(1e-3)
+    comp = jstep.lower(runner.embed_params, runner.stage_params,
+                       runner.head_params, runner.opt_states, ids, ids,
+                       lr, jnp.int32(1)).compile()
+    wall = _wall(lambda: float(runner.step(ids, ids)))
+    return *_cost(comp), wall
+
+
+def fmt_mem(b):
+    return f"{b / 1e6:.1f}MB" if b is not None else "n/a"
+
+
+def main():
+    rows = []
+    for pp, mb in ((2, 4), (4, 8)):
+        base_fl, base_mem, base_wall = baseline_gspmd(pp)
+        rows.append((f"pure GSPMD dp={pp}", pp, mb, base_fl, base_mem,
+                     base_wall, 1.0, 1.0))
+        for sched in ("FThenB", "1F1B", "VPP"):
+            try:
+                fl, mem, wall = pipeline(sched, pp, mb)
+            except Exception as e:  # noqa: BLE001
+                print(f"| {sched} pp={pp} | FAILED: {type(e).__name__}: "
+                      f"{str(e)[:120]} |")
+                continue
+            ticks = (mb + pp - 1) / mb  # analytic masked-tick ratio
+            rows.append((f"{sched} pp={pp}", pp, mb, fl, mem, wall,
+                         wall / base_wall, ticks))
+    print("| program | devices | microbatches | HLO GFLOPs/step* | "
+          "peak temp/dev | wall ms/step (cpu mesh) | wall vs GSPMD | "
+          "analytic tick ratio |")
+    print("|---|---|---|---|---|---|---|---|")
+    for name, pp, mb, fl, mem, wall, ratio, ticks in rows:
+        print(f"| {name} | {pp} | {mb} | {fl / 1e9:.2f} | {fmt_mem(mem)} | "
+              f"{wall * 1e3:.0f} | {ratio:.2f}x | {ticks:.2f}x |")
+    print("\n*XLA cost-model flops count each scan BODY once (trip count "
+          "ignored), so scan-over-ticks programs undercount — compare "
+          "wall-clock and the analytic ratio instead.")
+
+
+if __name__ == "__main__":
+    main()

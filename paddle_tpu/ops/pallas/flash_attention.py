@@ -106,7 +106,11 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
     def _flush():
         l = jnp.maximum(l_s[...][:, :1], 1e-30)
         o_ref[0] = (acc_s[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_s[...][:, :1] + jnp.log(l))[:, 0]
+        # lane-expanded (block_q, _LANES) write: TPU block shapes need the
+        # last two dims tiled (8, 128); a (1, block_q) row per grid step is
+        # unlowerable. m_s/l_s already hold the row value in every lane.
+        # (Same layout as jax's official TPU flash kernel's l/m outputs.)
+        lse_ref[0] = m_s[...] + jnp.log(jnp.maximum(l_s[...], 1e-30))
 
 
 def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -126,8 +130,8 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]                 # (block_q, 1)
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, :1]                   # (block_q, 1) of lanes
+        delta = delta_ref[0][:, :1]
         s = scale * jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -173,8 +177,8 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
         s = scale * jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -314,11 +318,11 @@ def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=128, block_k=128,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             _sds((bh, sq_p, d), q.dtype, q),
-            _sds((bh, sq_p), jnp.float32, q),
+            _sds((bh, sq_p, _LANES), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -327,7 +331,9 @@ def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=128, block_k=128,
         ],
         interpret=interpret,
     )(q_p, k_p, v_p)
-    return out[:, :sq], lse
+    # collapse the lane-expanded lse back to (bh, sq_p) right away so the
+    # autodiff residual is O(S), not O(S * 128)
+    return out[:, :sq], lse[..., 0]
 
 
 def _flash_bwd_bhsd(q, k, v, o, lse, g, causal, scale, block_q=128,
@@ -359,6 +365,11 @@ def _flash_bwd_bhsd(q, k, v, o, lse, g, causal, scale, block_q=128,
 
     nq, nk = sq_p // block_q, sk_p // block_k
 
+    # lane-expand the per-row scalars: a (1, block_q) block is unlowerable
+    # on TPU (last-two-dims tiling), so feed (1, block_q, _LANES) blocks
+    lse3 = jnp.broadcast_to(lse_p[..., None], (bh, sq_p, _LANES))
+    delta3 = jnp.broadcast_to(delta_p[..., None], (bh, sq_p, _LANES))
+
     dq = pl.pallas_call(
         functools.partial(_fa_dq_kernel, **common),
         grid=(bh, nq, nk),
@@ -367,14 +378,14 @@ def _flash_bwd_bhsd(q, k, v, o, lse, g, causal, scale, block_q=128,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=_sds((bh, sq_p, d), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q_p, k_p, v_p, do_p, lse_p, delta_p)
+    )(q_p, k_p, v_p, do_p, lse3, delta3)
 
     dk, dv = pl.pallas_call(
         functools.partial(_fa_dkv_kernel, **common),
@@ -384,8 +395,8 @@ def _flash_bwd_bhsd(q, k, v, o, lse, g, causal, scale, block_q=128,
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -400,7 +411,7 @@ def _flash_bwd_bhsd(q, k, v, o, lse, g, causal, scale, block_q=128,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q_p, k_p, v_p, do_p, lse_p, delta_p)
+    )(q_p, k_p, v_p, do_p, lse3, delta3)
 
     return dq[:, :sq], dk[:, :sk], dv[:, :sk]
 

@@ -219,8 +219,16 @@ def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=No
 
 
 def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    # reference contract (tensor/linalg.py:5321): `ranges` is a FLAT
+    # sequence [lo0, hi0, lo1, hi1, ...]; jnp wants per-dim pairs
+    pair_ranges = None
+    if ranges is not None:
+        flat = list(ranges)
+        pair_ranges = [tuple(flat[i:i + 2]) for i in range(0, len(flat), 2)]
+
     def f(a, w=None):
-        h, edges = jnp.histogramdd(a, bins=bins, range=ranges, density=density, weights=w)
+        h, edges = jnp.histogramdd(a, bins=bins, range=pair_ranges,
+                                   density=density, weights=w)
         return (h,) + tuple(edges)
     outs = execute(f, x, *( [weights] if weights is not None else []), _name="histogramdd")
     return outs[0], list(outs[1:])

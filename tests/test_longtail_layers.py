@@ -1,0 +1,845 @@
+"""Behavioral checks for long-tail nn layers + functionals (VERDICT r3 #5).
+
+Layer classes are verified against their (numerically-gated) functional
+twins or straight NumPy references; previously these names were covered
+only by the hasattr surface gate. reference: test/legacy_test per-op tests.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+rs = np.random.RandomState(3)
+
+
+def T(a, **kw):
+    return paddle.Tensor(np.asarray(a), **kw)
+
+
+def X(*shape):
+    return rs.randn(*shape).astype(np.float32)
+
+
+# --------------------------------------------------------------------------
+# activation layers == functional twins
+# --------------------------------------------------------------------------
+
+ACT_LAYERS = [
+    # (Layer thunk, functional thunk)
+    ("CELU", lambda: nn.CELU(), lambda x: F.celu(x)),
+    ("ELU", lambda: nn.ELU(0.7), lambda x: F.elu(x, 0.7)),
+    ("GELU", lambda: nn.GELU(), lambda x: F.gelu(x)),
+    ("GLU", lambda: nn.GLU(axis=-1), lambda x: F.glu(x, axis=-1)),
+    ("Hardshrink", lambda: nn.Hardshrink(), lambda x: F.hardshrink(x)),
+    ("Hardsigmoid", lambda: nn.Hardsigmoid(), lambda x: F.hardsigmoid(x)),
+    ("Hardswish", lambda: nn.Hardswish(), lambda x: F.hardswish(x)),
+    ("Hardtanh", lambda: nn.Hardtanh(-0.5, 0.5),
+     lambda x: F.hardtanh(x, -0.5, 0.5)),
+    ("LeakyReLU", lambda: nn.LeakyReLU(0.1),
+     lambda x: F.leaky_relu(x, 0.1)),
+    ("LogSigmoid", lambda: nn.LogSigmoid(), lambda x: F.log_sigmoid(x)),
+    ("LogSoftmax", lambda: nn.LogSoftmax(axis=-1),
+     lambda x: F.log_softmax(x, axis=-1)),
+    ("Mish", lambda: nn.Mish(), lambda x: F.mish(x)),
+    ("ReLU6", lambda: nn.ReLU6(), lambda x: F.relu6(x)),
+    ("SELU", lambda: nn.SELU(), lambda x: F.selu(x)),
+    ("Sigmoid", lambda: nn.Sigmoid(), lambda x: F.sigmoid(x)),
+    ("Silu", lambda: nn.Silu(), lambda x: F.silu(x)),
+    ("Softmax", lambda: nn.Softmax(axis=-1),
+     lambda x: F.softmax(x, axis=-1)),
+    ("Softplus", lambda: nn.Softplus(), lambda x: F.softplus(x)),
+    ("Softshrink", lambda: nn.Softshrink(), lambda x: F.softshrink(x)),
+    ("Softsign", lambda: nn.Softsign(), lambda x: F.softsign(x)),
+    ("Swish", lambda: nn.Swish(), lambda x: F.swish(x)),
+    ("Tanhshrink", lambda: nn.Tanhshrink(), lambda x: F.tanhshrink(x)),
+    ("ThresholdedReLU", lambda: nn.ThresholdedReLU(0.3),
+     lambda x: F.thresholded_relu(x, 0.3)),
+    ("Maxout", lambda: nn.Maxout(groups=2),
+     lambda x: F.maxout(x, groups=2)),
+    ("Identity", lambda: nn.Identity(), lambda x: x),
+]
+
+
+@pytest.mark.parametrize("name,layer,fn", ACT_LAYERS,
+                         ids=[a[0] for a in ACT_LAYERS])
+def test_activation_layer_matches_functional(name, layer, fn):
+    x = X(2, 4, 3, 3) if name == "Maxout" else X(3, 4)
+    got = layer()(T(x)).numpy()
+    want = fn(T(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6, err_msg=name)
+
+
+def test_prelu_layer_uses_its_weight():
+    layer = nn.PReLU(num_parameters=1, init=0.4)
+    x = X(3, 4)
+    got = layer(T(x)).numpy()
+    np.testing.assert_allclose(got, np.where(x > 0, x, 0.4 * x), rtol=1e-6)
+
+
+def test_rrelu_eval_is_mean_slope():
+    x = -np.abs(X(3, 4)) - 0.1
+    lo, hi = 0.125, 1.0 / 3.0
+    got = F.rrelu(T(x), lo, hi, training=False).numpy()
+    np.testing.assert_allclose(got, x * (lo + hi) / 2, rtol=1e-5)
+    layer_got = nn.RReLU(lo, hi)(T(x)).numpy()
+    np.testing.assert_allclose(layer_got, got, rtol=1e-6)
+
+
+def test_maxout_vs_numpy():
+    x = X(2, 4, 3, 3)
+    got = F.maxout(T(x), groups=2, axis=1).numpy()
+    want = x.reshape(2, 2, 2, 3, 3).max(axis=2)
+    np.testing.assert_allclose(got, want)
+
+
+def test_glu_vs_numpy():
+    x = X(3, 6)
+    a, b = np.split(x, 2, axis=-1)
+    np.testing.assert_allclose(F.glu(T(x)).numpy(),
+                               a / (1 + np.exp(-b)) * (1 + np.exp(-b)) * 0
+                               + a * (1 / (1 + np.exp(-b))), rtol=1e-5)
+
+
+def test_functional_inplace_twins():
+    x = X(3, 4)
+    for name, ref in [("relu_", lambda v: np.maximum(v, 0)),
+                      ("elu_", None), ("leaky_relu_", None),
+                      ("hardtanh_", None), ("softmax_", None),
+                      ("thresholded_relu_", None)]:
+        t = T(x.copy())
+        out_of_place = getattr(F, name[:-1])(T(x.copy()))
+        ret = getattr(F, name)(t)
+        assert ret is t, name
+        np.testing.assert_allclose(t.numpy(), out_of_place.numpy(),
+                                   rtol=1e-6, err_msg=name)
+        if ref is not None:
+            np.testing.assert_allclose(t.numpy(), ref(x), rtol=1e-6)
+
+
+# --------------------------------------------------------------------------
+# loss layers == functional twins
+# --------------------------------------------------------------------------
+
+def _lab_pm1(shape):
+    return (rs.randint(0, 2, shape) * 2 - 1).astype(np.float32)
+
+
+LOSS_LAYERS = [
+    ("L1Loss", lambda: nn.L1Loss(),
+     lambda a, b: F.l1_loss(a, b), (3, 4), (3, 4)),
+    ("MSELoss", lambda: nn.MSELoss(),
+     lambda a, b: F.mse_loss(a, b), (3, 4), (3, 4)),
+    ("SmoothL1Loss", lambda: nn.SmoothL1Loss(),
+     lambda a, b: F.smooth_l1_loss(a, b), (3, 4), (3, 4)),
+    ("KLDivLoss", lambda: nn.KLDivLoss(),
+     lambda a, b: F.kl_div(a, b), (3, 4), (3, 4)),
+    ("SoftMarginLoss", lambda: nn.SoftMarginLoss(),
+     lambda a, b: F.soft_margin_loss(a, b), (3, 4), "pm1"),
+    ("HingeEmbeddingLoss", lambda: nn.HingeEmbeddingLoss(),
+     lambda a, b: F.hinge_embedding_loss(a, b), (3, 4), "pm1"),
+    ("MultiLabelSoftMarginLoss", lambda: nn.MultiLabelSoftMarginLoss(),
+     lambda a, b: F.multi_label_soft_margin_loss(a, b), (3, 4), "01"),
+    ("BCEWithLogitsLoss", lambda: nn.BCEWithLogitsLoss(),
+     lambda a, b: F.binary_cross_entropy_with_logits(a, b), (3, 4), "01"),
+]
+
+
+@pytest.mark.parametrize("name,layer,fn,sa,sb", LOSS_LAYERS,
+                         ids=[a[0] for a in LOSS_LAYERS])
+def test_loss_layer_matches_functional(name, layer, fn, sa, sb):
+    a = X(*sa)
+    if sb == "pm1":
+        b = _lab_pm1(sa)
+    elif sb == "01":
+        b = rs.randint(0, 2, sa).astype(np.float32)
+    else:
+        b = X(*sb)
+    got = float(layer()(T(a), T(b)))
+    want = float(fn(T(a), T(b)))
+    np.testing.assert_allclose(got, want, rtol=1e-6, err_msg=name)
+
+
+def test_bce_loss_vs_numpy():
+    p = rs.uniform(0.1, 0.9, (3, 4)).astype(np.float32)
+    y = rs.randint(0, 2, (3, 4)).astype(np.float32)
+    got = float(nn.BCELoss()(T(p), T(y)))
+    want = float(np.mean(-(y * np.log(p) + (1 - y) * np.log(1 - p))))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_nll_loss_layer():
+    x = X(4, 5)
+    logp = x - np.log(np.exp(x).sum(-1, keepdims=True))
+    lab = np.array([0, 2, 4, 1], np.int64)
+    got = float(nn.NLLLoss()(T(logp), T(lab)))
+    np.testing.assert_allclose(got, -logp[np.arange(4), lab].mean(),
+                               rtol=1e-5)
+
+
+def test_margin_ranking_loss_vs_numpy():
+    a, b = X(6), X(6)
+    y = _lab_pm1((6,))
+    got = float(nn.MarginRankingLoss(margin=0.2)(T(a), T(b), T(y)))
+    want = np.maximum(0, -y * (a - b) + 0.2).mean()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_cosine_embedding_loss_vs_numpy():
+    a, b = X(4, 5), X(4, 5)
+    y = _lab_pm1((4,))
+    cos = (a * b).sum(-1) / (np.linalg.norm(a, axis=-1) *
+                             np.linalg.norm(b, axis=-1))
+    want = np.where(y > 0, 1 - cos, np.maximum(0, cos - 0.1)).mean()
+    got = float(nn.CosineEmbeddingLoss(margin=0.1)(T(a), T(b), T(y)))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_triplet_margin_losses_vs_numpy():
+    a, p, n = X(4, 6), X(4, 6), X(4, 6)
+    dp = np.linalg.norm(a - p, axis=-1)
+    dn = np.linalg.norm(a - n, axis=-1)
+    want = np.maximum(0, dp - dn + 1.0).mean()
+    got = float(nn.TripletMarginLoss()(T(a), T(p), T(n)))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    got2 = float(nn.TripletMarginWithDistanceLoss()(T(a), T(p), T(n)))
+    np.testing.assert_allclose(got2, want, rtol=1e-5)
+    # custom distance
+    got3 = float(F.triplet_margin_with_distance_loss(
+        T(a), T(p), T(n),
+        distance_function=lambda u, v: paddle.sum(paddle.abs(u - v), -1)))
+    dl1p = np.abs(a - p).sum(-1)
+    dl1n = np.abs(a - n).sum(-1)
+    np.testing.assert_allclose(got3, np.maximum(0, dl1p - dl1n + 1).mean(),
+                               rtol=1e-5)
+
+
+def test_poisson_nll_loss_vs_numpy():
+    lam = X(3, 4)
+    y = rs.poisson(2.0, (3, 4)).astype(np.float32)
+    got = float(nn.PoissonNLLLoss()(T(lam), T(y)))
+    want = (np.exp(lam) - y * lam).mean()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_gaussian_nll_loss_vs_numpy():
+    mu, y = X(3, 4), X(3, 4)
+    var = rs.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+    got = float(nn.GaussianNLLLoss()(T(mu), T(y), T(var)))
+    want = (0.5 * (np.log(var) + (y - mu) ** 2 / var)).mean()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_ctc_loss_matches_enumeration():
+    """T=2, one label y: collapsing paths are (y,y),(blank,y),(y,blank)."""
+    rs2 = np.random.RandomState(5)
+    logits = rs2.randn(2, 1, 4).astype(np.float32)  # (T, B, V)
+    lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    blank, y = 0, 2
+    paths = [lp[0, 0, y] + lp[1, 0, y],
+             lp[0, 0, blank] + lp[1, 0, y],
+             lp[0, 0, y] + lp[1, 0, blank]]
+    ref = -np.logaddexp.reduce(paths)
+    got = float(F.ctc_loss(T(lp), T(np.array([[y]], np.int32)),
+                           T(np.array([2], np.int64)),
+                           T(np.array([1], np.int64)),
+                           blank=blank, reduction="sum"))
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+    layer_got = float(nn.CTCLoss(blank=blank, reduction="sum")(
+        T(lp), T(np.array([[y]], np.int32)),
+        T(np.array([2], np.int64)), T(np.array([1], np.int64))))
+    np.testing.assert_allclose(layer_got, got, rtol=1e-6)
+
+
+def test_simple_loss_functionals_vs_numpy():
+    x, y = X(3, 4), X(3, 4)
+    np.testing.assert_allclose(F.square_error_cost(T(x), T(y)).numpy(),
+                               (x - y) ** 2, rtol=1e-6)
+    p = rs.uniform(0.1, 0.9, (4, 1)).astype(np.float32)
+    lab = rs.randint(0, 2, (4, 1)).astype(np.float32)
+    eps = 1e-4
+    want = -lab * np.log(p + eps) - (1 - lab) * np.log(1 - p + eps)
+    np.testing.assert_allclose(F.log_loss(T(p), T(lab)).numpy(), want,
+                               rtol=1e-5)
+    seg = rs.uniform(0.1, 0.9, (2, 6, 3)).astype(np.float32)
+    seg /= seg.sum(-1, keepdims=True)
+    gt = rs.randint(0, 3, (2, 6, 1)).astype(np.int64)
+    got = F.dice_loss(T(seg), T(gt)).numpy()
+    oh = np.eye(3, dtype=np.float32)[gt.squeeze(-1)]
+    inter = (seg * oh).sum(1)
+    union = seg.sum(1) + oh.sum(1)
+    want = (1 - (2 * inter + 1e-5) / (union + 1e-5)).mean()
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+    anchor = X(4, 6)
+    pos = X(4, 6)
+    labels = np.array([0, 1, 0, 1], np.float32)
+    got = float(F.npair_loss(T(anchor), T(pos), T(labels), l2_reg=0.0))
+    sim = anchor @ pos.T
+    same = labels[:, None] == labels[None, :]
+    tgt = same / same.sum(1, keepdims=True)
+    logp = sim - np.log(np.exp(sim).sum(1, keepdims=True))
+    want = float((-tgt * logp).sum(1).mean())
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_softmax_with_cross_entropy_hard_and_soft():
+    x = X(4, 5)
+    lab = np.array([[0], [2], [4], [1]], np.int64)
+    got = F.softmax_with_cross_entropy(T(x), T(lab)).numpy()
+    logp = x - np.log(np.exp(x).sum(-1, keepdims=True))
+    np.testing.assert_allclose(
+        got.squeeze(-1), -logp[np.arange(4), lab.squeeze(-1)], rtol=1e-5)
+    soft = rs.uniform(0.1, 0.9, (4, 5)).astype(np.float32)
+    soft /= soft.sum(-1, keepdims=True)
+    got = F.softmax_with_cross_entropy(T(x), T(soft),
+                                       soft_label=True).numpy()
+    np.testing.assert_allclose(got.squeeze(-1), -(soft * logp).sum(-1),
+                               rtol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# pooling layers / functionals
+# --------------------------------------------------------------------------
+
+def test_pool1d_vs_numpy():
+    x = X(2, 3, 8)
+    np.testing.assert_allclose(
+        F.max_pool1d(T(x), 2).numpy(),
+        x.reshape(2, 3, 4, 2).max(-1), rtol=1e-6)
+    np.testing.assert_allclose(
+        F.avg_pool1d(T(x), 2).numpy(),
+        x.reshape(2, 3, 4, 2).mean(-1), rtol=1e-6)
+    np.testing.assert_allclose(nn.MaxPool1D(2)(T(x)).numpy(),
+                               F.max_pool1d(T(x), 2).numpy())
+    np.testing.assert_allclose(nn.AvgPool1D(2)(T(x)).numpy(),
+                               F.avg_pool1d(T(x), 2).numpy())
+
+
+def test_pool3d_vs_numpy():
+    x = X(1, 2, 4, 4, 4)
+    r = x.reshape(1, 2, 2, 2, 2, 2, 2, 2)
+    want_max = r.max(axis=(3, 5, 7))
+    want_avg = r.mean(axis=(3, 5, 7))
+    np.testing.assert_allclose(F.max_pool3d(T(x), 2).numpy(), want_max)
+    np.testing.assert_allclose(F.avg_pool3d(T(x), 2).numpy(), want_avg,
+                               rtol=1e-6)
+    np.testing.assert_allclose(nn.MaxPool3D(2)(T(x)).numpy(), want_max)
+    np.testing.assert_allclose(nn.AvgPool3D(2)(T(x)).numpy(), want_avg,
+                               rtol=1e-6)
+
+
+def test_pool2d_layers_match_functional():
+    x = X(2, 3, 6, 6)
+    np.testing.assert_allclose(nn.MaxPool2D(2)(T(x)).numpy(),
+                               F.max_pool2d(T(x), 2).numpy())
+    np.testing.assert_allclose(nn.AvgPool2D(2)(T(x)).numpy(),
+                               F.avg_pool2d(T(x), 2).numpy())
+
+
+def test_adaptive_pools():
+    x = X(2, 3, 8)
+    np.testing.assert_allclose(F.adaptive_avg_pool1d(T(x), 2).numpy(),
+                               x.reshape(2, 3, 2, 4).mean(-1), rtol=1e-6)
+    got, idx = F.adaptive_max_pool1d(T(x), 2, return_mask=True)
+    np.testing.assert_allclose(got.numpy(), x.reshape(2, 3, 2, 4).max(-1))
+    np.testing.assert_allclose(nn.AdaptiveAvgPool1D(2)(T(x)).numpy(),
+                               F.adaptive_avg_pool1d(T(x), 2).numpy())
+    np.testing.assert_allclose(nn.AdaptiveMaxPool1D(2)(T(x)).numpy(),
+                               F.adaptive_max_pool1d(T(x), 2).numpy())
+    x2 = X(2, 3, 6, 6)
+    np.testing.assert_allclose(
+        nn.AdaptiveAvgPool2D(3)(T(x2)).numpy(),
+        F.adaptive_avg_pool2d(T(x2), 3).numpy())
+    np.testing.assert_allclose(
+        nn.AdaptiveMaxPool2D(3)(T(x2)).numpy(),
+        F.adaptive_max_pool2d(T(x2), 3).numpy())
+    x3 = X(1, 2, 4, 4, 4)
+    np.testing.assert_allclose(
+        F.adaptive_avg_pool3d(T(x3), 2).numpy(),
+        x3.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean(axis=(3, 5, 7)),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        nn.AdaptiveAvgPool3D(2)(T(x3)).numpy(),
+        F.adaptive_avg_pool3d(T(x3), 2).numpy())
+    np.testing.assert_allclose(
+        nn.AdaptiveMaxPool3D(2)(T(x3)).numpy(),
+        F.adaptive_max_pool3d(T(x3), 2).numpy())
+    np.testing.assert_allclose(
+        F.adaptive_max_pool3d(T(x3), 2).numpy(),
+        x3.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(3, 5, 7)))
+
+
+def test_lp_pool_vs_numpy():
+    x = np.abs(X(2, 3, 8)) + 0.1
+    got = F.lp_pool1d(T(x), 2.0, 2).numpy()
+    want = (x.reshape(2, 3, 4, 2) ** 2).sum(-1) ** 0.5
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    np.testing.assert_allclose(nn.LPPool1D(2.0, 2)(T(x)).numpy(), got,
+                               rtol=1e-6)
+    x2 = np.abs(X(1, 2, 4, 4)) + 0.1
+    got = F.lp_pool2d(T(x2), 2.0, 2).numpy()
+    want = (x2.reshape(1, 2, 2, 2, 2, 2) ** 2).sum(axis=(3, 5)) ** 0.5
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    np.testing.assert_allclose(nn.LPPool2D(2.0, 2)(T(x2)).numpy(), got,
+                               rtol=1e-6)
+
+
+def test_max_unpool_1d_3d_roundtrip():
+    x = X(2, 3, 8)
+    pooled, idx = F.max_pool1d(T(x), 2, return_mask=True)
+    un = F.max_unpool1d(pooled, idx, 2)
+    assert list(un.shape) == [2, 3, 8]
+    np.testing.assert_allclose(float(un.sum()), float(pooled.sum()),
+                               rtol=1e-5)
+    np.testing.assert_allclose(nn.MaxUnPool1D(2)(pooled, idx).numpy(),
+                               un.numpy())
+    x3 = X(1, 2, 4, 4, 4)
+    pooled, idx = F.max_pool3d(T(x3), 2, return_mask=True)
+    un = F.max_unpool3d(pooled, idx, 2)
+    assert list(un.shape) == [1, 2, 4, 4, 4]
+    np.testing.assert_allclose(float(un.sum()), float(pooled.sum()),
+                               rtol=1e-5)
+    np.testing.assert_allclose(nn.MaxUnPool3D(2)(pooled, idx).numpy(),
+                               un.numpy())
+    x2 = X(2, 3, 6, 6)
+    pooled, idx = F.max_pool2d(T(x2), 2, return_mask=True)
+    np.testing.assert_allclose(
+        nn.MaxUnPool2D(2)(pooled, idx).numpy(),
+        F.max_unpool2d(pooled, idx, 2).numpy())
+
+
+def test_fractional_pool3d_partitions():
+    x = X(1, 1, 6, 6, 6)
+    out = F.fractional_max_pool3d(T(x), 3, random_u=0.4)
+    assert list(out.shape) == [1, 1, 3, 3, 3]
+    assert float(out.max()) <= float(x.max()) + 1e-6
+    layer_out = nn.FractionalMaxPool3D(3)(T(x))
+    assert list(layer_out.shape) == [1, 1, 3, 3, 3]
+    l2 = nn.FractionalMaxPool2D(2)(T(X(1, 1, 4, 4)))
+    assert list(l2.shape) == [1, 1, 2, 2]
+
+
+# --------------------------------------------------------------------------
+# conv layers: layer weight -> functional parity
+# --------------------------------------------------------------------------
+
+def test_conv_layers_match_functional():
+    x1 = X(1, 2, 8)
+    c1 = nn.Conv1D(2, 3, 3)
+    np.testing.assert_allclose(
+        c1(T(x1)).numpy(),
+        F.conv1d(T(x1), c1.weight, c1.bias).numpy(), rtol=1e-5)
+    x2 = X(1, 2, 6, 6)
+    c2 = nn.Conv2D(2, 3, 3, stride=2, padding=1)
+    np.testing.assert_allclose(
+        c2(T(x2)).numpy(),
+        F.conv2d(T(x2), c2.weight, c2.bias, stride=2, padding=1).numpy(),
+        rtol=1e-5)
+    ct1 = nn.Conv1DTranspose(2, 3, 3)
+    np.testing.assert_allclose(
+        ct1(T(x1)).numpy(),
+        F.conv1d_transpose(T(x1), ct1.weight, ct1.bias).numpy(),
+        rtol=1e-5)
+    ct2 = nn.Conv2DTranspose(2, 3, 3)
+    np.testing.assert_allclose(
+        ct2(T(x2)).numpy(),
+        F.conv2d_transpose(T(x2), ct2.weight, ct2.bias).numpy(), rtol=1e-5)
+    x3 = X(1, 2, 4, 4, 4)
+    ct3 = nn.Conv3DTranspose(2, 3, 3)
+    np.testing.assert_allclose(
+        ct3(T(x3)).numpy(),
+        F.conv3d_transpose(T(x3), ct3.weight, ct3.bias).numpy(), rtol=1e-5)
+
+
+def test_conv1d_transpose_inverts_shape():
+    x = X(1, 2, 5)
+    w = X(2, 3, 4)  # (in, out, k)
+    out = F.conv1d_transpose(T(x), T(w), stride=2)
+    # L_out = (L-1)*stride + k
+    assert list(out.shape) == [1, 3, (5 - 1) * 2 + 4]
+
+
+# --------------------------------------------------------------------------
+# norm layers
+# --------------------------------------------------------------------------
+
+def test_batchnorm_1d_3d_normalize():
+    x = X(4, 3, 5)
+    bn = nn.BatchNorm1D(3)
+    bn.train()
+    out = bn(T(x)).numpy()
+    mean = out.mean(axis=(0, 2))
+    std = out.std(axis=(0, 2))
+    np.testing.assert_allclose(mean, np.zeros(3), atol=1e-4)
+    np.testing.assert_allclose(std, np.ones(3), atol=1e-2)
+    x3 = X(2, 3, 3, 3, 3)
+    bn3 = nn.BatchNorm3D(3)
+    bn3.train()
+    out = bn3(T(x3)).numpy()
+    np.testing.assert_allclose(out.mean(axis=(0, 2, 3, 4)), np.zeros(3),
+                               atol=1e-4)
+    # SyncBatchNorm degenerates to BatchNorm on a single device
+    sbn = nn.SyncBatchNorm(3)
+    sbn.train()
+    out = sbn(T(X(4, 3, 5, 5))).numpy()
+    np.testing.assert_allclose(out.mean(axis=(0, 2, 3)), np.zeros(3),
+                               atol=1e-4)
+
+
+def test_instancenorm_1d_3d_normalize():
+    x = X(2, 3, 8)
+    out = nn.InstanceNorm1D(3)(T(x)).numpy()
+    np.testing.assert_allclose(out.mean(-1), np.zeros((2, 3)), atol=1e-4)
+    np.testing.assert_allclose(out.std(-1), np.ones((2, 3)), atol=1e-2)
+    x3 = X(2, 3, 3, 3, 3)
+    out = nn.InstanceNorm3D(3)(T(x3)).numpy()
+    np.testing.assert_allclose(out.mean(axis=(2, 3, 4)),
+                               np.zeros((2, 3)), atol=1e-4)
+
+
+def test_local_response_norm_vs_numpy():
+    x = np.abs(X(1, 4, 3, 3))
+    size, alpha, beta, k = 3, 1e-4, 0.75, 1.0
+    got = nn.LocalResponseNorm(size, alpha, beta, k)(T(x)).numpy()
+    sq = x ** 2
+    div = np.zeros_like(x)
+    half = size // 2
+    for c in range(4):
+        lo, hi = max(0, c - half), min(4, c + half + 1)
+        div[:, c] = sq[:, lo:hi].sum(1)
+    want = x / (k + alpha * div) ** beta
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_spectral_norm_normalizes_sigma():
+    w = X(4, 5)
+    sn = nn.SpectralNorm([4, 5], dim=0, power_iters=30)
+    out = sn(T(w)).numpy()
+    # largest singular value of the normalized weight ~ 1
+    s = np.linalg.svd(out, compute_uv=False)[0]
+    np.testing.assert_allclose(s, 1.0, rtol=1e-2)
+
+
+# --------------------------------------------------------------------------
+# dropout family
+# --------------------------------------------------------------------------
+
+def test_dropout_layers_eval_identity_train_stats():
+    x = np.ones((64, 8, 4, 4), np.float32)
+    for layer in [nn.AlphaDropout(0.3), nn.Dropout2D(0.3),
+                  nn.Dropout3D(0.3), nn.FeatureAlphaDropout(0.3)]:
+        layer.eval()
+        inp = x if not isinstance(layer, nn.Dropout3D) else \
+            np.ones((8, 4, 2, 2, 2), np.float32)
+        np.testing.assert_array_equal(layer(T(inp)).numpy(), inp)
+    paddle.seed(0)
+    d2 = nn.Dropout2D(0.5)
+    d2.train()
+    out = d2(T(x)).numpy()
+    # whole channels dropped: each (n,c) map is all-zero or all-scaled
+    per_map = out.reshape(64 * 8, -1)
+    is_zero = (per_map == 0).all(1)
+    is_scaled = np.isclose(per_map, 2.0).all(1)
+    assert (is_zero | is_scaled).all()
+    assert 0.3 < is_zero.mean() < 0.7
+    paddle.seed(0)
+    ad = nn.AlphaDropout(0.5)
+    ad.train()
+    out = ad(T(X(2000, 4))).numpy()
+    # alpha dropout keeps mean/var roughly (0,1) for standard normal input
+    assert abs(out.mean()) < 0.1
+    assert abs(out.std() - 1.0) < 0.15
+
+
+def test_functional_dropout23d_and_alpha():
+    x = np.ones((16, 4, 3, 3), np.float32)
+    np.testing.assert_array_equal(
+        F.dropout2d(T(x), 0.5, training=False).numpy(), x)
+    x3 = np.ones((4, 2, 2, 2, 2), np.float32)
+    np.testing.assert_array_equal(
+        F.dropout3d(T(x3), 0.5, training=False).numpy(), x3)
+    np.testing.assert_array_equal(
+        F.alpha_dropout(T(x), 0.5, training=False).numpy(), x)
+    paddle.seed(1)
+    out = F.dropout3d(T(np.ones((32, 8, 2, 2, 2), np.float32)), 0.5).numpy()
+    per = out.reshape(32 * 8, -1)
+    assert ((per == 0).all(1) | np.isclose(per, 2.0).all(1)).all()
+
+
+# --------------------------------------------------------------------------
+# shape / rearrangement layers
+# --------------------------------------------------------------------------
+
+def test_shape_layers():
+    x = X(2, 3, 4, 5)
+    np.testing.assert_allclose(nn.Flatten()(T(x)).numpy(),
+                               x.reshape(2, -1))
+    np.testing.assert_allclose(
+        nn.Flatten(start_axis=2)(T(x)).numpy(), x.reshape(2, 3, 20))
+    np.testing.assert_allclose(
+        nn.ChannelShuffle(3)(T(X(1, 6, 2, 2))).numpy(),
+        F.channel_shuffle(T(X(1, 6, 2, 2)) * 0 + 1, 3).numpy() * 0 +
+        nn.ChannelShuffle(3)(T(X(1, 6, 2, 2))).numpy())
+    y = X(1, 6, 2, 2)
+    np.testing.assert_allclose(
+        nn.ChannelShuffle(3)(T(y)).numpy(),
+        y.reshape(1, 3, 2, 2, 2).transpose(0, 2, 1, 3, 4).reshape(
+            1, 6, 2, 2))
+    z = X(1, 4, 2, 2)
+    np.testing.assert_allclose(nn.PixelShuffle(2)(T(z)).numpy(),
+                               F.pixel_shuffle(T(z), 2).numpy())
+    w = X(1, 1, 4, 4)
+    un = nn.PixelUnshuffle(2)(T(w))
+    np.testing.assert_allclose(
+        nn.PixelShuffle(2)(un).numpy(), w)
+    np.testing.assert_allclose(F.pixel_unshuffle(T(w), 2).numpy(),
+                               un.numpy())
+
+
+def test_pad_layers_vs_numpy():
+    x = X(2, 3, 5)
+    np.testing.assert_allclose(
+        nn.Pad1D([1, 2])(T(x)).numpy(),
+        np.pad(x, [(0, 0), (0, 0), (1, 2)]))
+    x2 = X(2, 3, 4, 4)
+    np.testing.assert_allclose(
+        nn.Pad2D([1, 1, 2, 0])(T(x2)).numpy(),
+        np.pad(x2, [(0, 0), (0, 0), (2, 0), (1, 1)]))
+    np.testing.assert_allclose(
+        nn.ZeroPad2D([1, 1, 1, 1])(T(x2)).numpy(),
+        np.pad(x2, [(0, 0), (0, 0), (1, 1), (1, 1)]))
+    np.testing.assert_allclose(
+        F.zeropad2d(T(x2), [1, 0, 0, 2]).numpy(),
+        np.pad(x2, [(0, 0), (0, 0), (0, 2), (1, 0)]))
+    x3 = X(1, 2, 3, 3, 3)
+    np.testing.assert_allclose(
+        nn.Pad3D([1, 0, 1, 0, 1, 0])(T(x3)).numpy(),
+        np.pad(x3, [(0, 0), (0, 0), (1, 0), (1, 0), (1, 0)]))
+    # reflect mode parity with numpy
+    np.testing.assert_allclose(
+        nn.Pad2D([1, 1, 1, 1], mode="reflect")(T(x2)).numpy(),
+        np.pad(x2, [(0, 0), (0, 0), (1, 1), (1, 1)], mode="reflect"))
+
+
+def test_fold_unfold_inverse():
+    x = X(1, 2, 4, 4)
+    cols = F.unfold(T(x), 2, strides=2)
+    back = F.fold(cols, [4, 4], 2, strides=2)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+    lf = nn.Fold([4, 4], 2, strides=2)
+    np.testing.assert_allclose(lf(cols).numpy(), x, rtol=1e-6)
+    lu = nn.Unfold(2, strides=2)
+    np.testing.assert_allclose(lu(T(x)).numpy(), cols.numpy())
+
+
+def test_upsample_layers():
+    x = X(1, 2, 3, 3)
+    up = nn.Upsample(scale_factor=2, mode="nearest")(T(x)).numpy()
+    np.testing.assert_allclose(up, x.repeat(2, axis=2).repeat(2, axis=3))
+    un = nn.UpsamplingNearest2D(scale_factor=2)(T(x)).numpy()
+    np.testing.assert_allclose(un, up)
+    ub = nn.UpsamplingBilinear2D(scale_factor=2)(T(x)).numpy()
+    ref = F.interpolate(T(x), scale_factor=2, mode="bilinear",
+                        align_corners=True).numpy()
+    np.testing.assert_allclose(ub, ref, rtol=1e-5)
+    fu = F.upsample(T(x), scale_factor=2, mode="nearest").numpy()
+    np.testing.assert_allclose(fu, up)
+
+
+# --------------------------------------------------------------------------
+# distance / similarity layers
+# --------------------------------------------------------------------------
+
+def test_cosine_similarity_and_pairwise_distance_layers():
+    a, b = X(4, 6), X(4, 6)
+    got = nn.CosineSimilarity(axis=1)(T(a), T(b)).numpy()
+    want = (a * b).sum(1) / (np.linalg.norm(a, axis=1) *
+                             np.linalg.norm(b, axis=1))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    got = nn.PairwiseDistance(p=2.0)(T(a), T(b)).numpy()
+    np.testing.assert_allclose(got, np.linalg.norm(a - b, axis=1),
+                               rtol=1e-5)
+    got = F.pairwise_distance(T(a), T(b), p=1.0).numpy()
+    np.testing.assert_allclose(got, np.abs(a - b).sum(1), rtol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# recurrent cells / RNN wrappers vs numpy recurrences
+# --------------------------------------------------------------------------
+
+def test_simple_rnn_cell_vs_numpy():
+    cell = nn.SimpleRNNCell(3, 4)
+    x = X(2, 3)
+    h0 = X(2, 4)
+    out, h = cell(T(x), T(h0))
+    wi = cell.weight_ih.numpy()
+    wh = cell.weight_hh.numpy()
+    bi = cell.bias_ih.numpy()
+    bh = cell.bias_hh.numpy()
+    want = np.tanh(x @ wi.T + bi + h0 @ wh.T + bh)
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-5)
+    np.testing.assert_allclose(h.numpy(), want, rtol=1e-5)
+
+
+def test_gru_cell_vs_numpy():
+    cell = nn.GRUCell(3, 4)
+    x, h0 = X(2, 3), X(2, 4)
+    out, _ = cell(T(x), T(h0))
+    wi, wh = cell.weight_ih.numpy(), cell.weight_hh.numpy()
+    bi, bh = cell.bias_ih.numpy(), cell.bias_hh.numpy()
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+    gi = x @ wi.T + bi
+    gh = h0 @ wh.T + bh
+    ir, iz, ic = np.split(gi, 3, -1)
+    hr, hz, hc = np.split(gh, 3, -1)
+    r = sig(ir + hr)
+    z = sig(iz + hz)
+    c = np.tanh(ic + r * hc)
+    want = (1 - z) * c + z * h0
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_cell_vs_numpy():
+    cell = nn.LSTMCell(3, 4)
+    x, h0, c0 = X(2, 3), X(2, 4), X(2, 4)
+    out, (h, c) = cell(T(x), (T(h0), T(c0)))
+    wi, wh = cell.weight_ih.numpy(), cell.weight_hh.numpy()
+    bi, bh = cell.bias_ih.numpy(), cell.bias_hh.numpy()
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+    g = x @ wi.T + bi + h0 @ wh.T + bh
+    i, f, cc, o = np.split(g, 4, -1)
+    cn = sig(f) * c0 + sig(i) * np.tanh(cc)
+    hn = sig(o) * np.tanh(cn)
+    np.testing.assert_allclose(c.numpy(), cn, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), hn, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(out.numpy(), hn, rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_wrapper_unrolls_cell():
+    cell = nn.SimpleRNNCell(3, 4)
+    rnn = nn.RNN(cell)
+    x = X(2, 5, 3)  # (batch, time, feat)
+    out, last = rnn(T(x))
+    assert list(out.shape) == [2, 5, 4]
+    # manual unroll
+    h = np.zeros((2, 4), np.float32)
+    wi, wh = cell.weight_ih.numpy(), cell.weight_hh.numpy()
+    bi, bh = cell.bias_ih.numpy(), cell.bias_hh.numpy()
+    for t in range(5):
+        h = np.tanh(x[:, t] @ wi.T + bi + h @ wh.T + bh)
+    np.testing.assert_allclose(out.numpy()[:, -1], h, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_birnn_concats_directions():
+    fw = nn.SimpleRNNCell(3, 4)
+    bw = nn.SimpleRNNCell(3, 4)
+    bi = nn.BiRNN(fw, bw)
+    x = X(2, 5, 3)
+    out, _ = bi(T(x))
+    assert list(out.shape) == [2, 5, 8]
+    # forward half equals running fw alone
+    fw_out, _ = nn.RNN(fw)(T(x))
+    np.testing.assert_allclose(out.numpy()[..., :4], fw_out.numpy(),
+                               rtol=1e-5)
+
+
+def test_rnn_cell_base_initial_states():
+    cell = nn.SimpleRNNCell(3, 4)
+    assert isinstance(cell, nn.RNNCellBase)
+    st = cell.get_initial_states(T(X(2, 3)))
+    assert np.asarray(st._data if hasattr(st, "_data") else st[0]._data
+                      ).shape[-1] == 4
+
+
+# --------------------------------------------------------------------------
+# transformer decoder
+# --------------------------------------------------------------------------
+
+def test_transformer_decoder_layer_and_stack():
+    layer = nn.TransformerDecoderLayer(16, 4, 32, dropout=0.0)
+    dec = nn.TransformerDecoder(layer, 2)
+    tgt = T(X(2, 5, 16))
+    mem = T(X(2, 7, 16))
+    out = dec(tgt, mem)
+    assert list(out.shape) == [2, 5, 16]
+    # a single layer with self-attn mask: causal masking changes outputs
+    m = paddle.full([5, 5], float("-inf"))
+    m = paddle.triu(m, diagonal=1)
+    out_masked = layer(tgt, mem, tgt_mask=m)
+    assert list(out_masked.shape) == [2, 5, 16]
+    assert not np.allclose(out_masked.numpy(), layer(tgt, mem).numpy())
+
+
+# --------------------------------------------------------------------------
+# grad clipping
+# --------------------------------------------------------------------------
+
+def test_clip_grad_by_norm_and_value():
+    lin = nn.Linear(4, 3)
+    x = T(X(8, 4))
+    (lin(x).sum() * 10).backward()
+    gn = float(paddle.norm(lin.weight.grad))
+    clip = nn.ClipGradByNorm(clip_norm=gn / 2)
+    out = clip([(lin.weight, lin.weight.grad)])
+    new_norm = float(paddle.norm(out[0][1]))
+    np.testing.assert_allclose(new_norm, gn / 2, rtol=1e-4)
+    vclip = nn.ClipGradByValue(max=0.1, min=-0.1)
+    out = vclip([(lin.weight, lin.weight.grad)])
+    arr = out[0][1].numpy()
+    assert arr.max() <= 0.1 + 1e-6 and arr.min() >= -0.1 - 1e-6
+    # optimizer path: grad_clip kwarg accepted
+    opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters(),
+                               grad_clip=clip)
+    opt.step()
+
+
+# --------------------------------------------------------------------------
+# containers
+# --------------------------------------------------------------------------
+
+def test_layer_dict_and_parameter_list():
+    ld = nn.LayerDict({"a": nn.Linear(2, 3), "b": nn.ReLU()})
+    assert set(ld.keys()) == {"a", "b"}
+    y = ld["a"](T(X(4, 2)))
+    assert list(y.shape) == [4, 3]
+    ld["c"] = nn.Linear(3, 1)
+    assert len(ld) == 3
+    params = list(ld.parameters())
+    assert len(params) == 4  # two Linears x (w, b)
+    pl = nn.ParameterList([paddle.create_parameter([2, 2])
+                           for _ in range(3)])
+    assert len(list(pl.parameters())) == 3
+    pl.append(paddle.create_parameter([1]))
+    assert len(list(pl.parameters())) == 4
+    # registered parameters show up in a holder's state_dict
+
+    class Holder(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.ps = nn.ParameterList([paddle.create_parameter([2])])
+
+    assert len(Holder().state_dict()) == 1
+
+
+# --------------------------------------------------------------------------
+# misc functionals
+# --------------------------------------------------------------------------
+
+def test_one_hot_label_smooth_sequence_mask():
+    lab = np.array([0, 2, 1], np.int64)
+    got = F.one_hot(T(lab), 4).numpy()
+    np.testing.assert_allclose(got, np.eye(4, dtype=np.float32)[lab])
+    oh = np.eye(4, dtype=np.float32)[lab]
+    sm = F.label_smooth(T(oh), epsilon=0.1).numpy()
+    np.testing.assert_allclose(sm, oh * 0.9 + 0.1 / 4, rtol=1e-5)
+    lens = np.array([2, 0, 3], np.int64)
+    mask = F.sequence_mask(T(lens), maxlen=4).numpy()
+    want = (np.arange(4)[None, :] < lens[:, None])
+    np.testing.assert_array_equal(mask.astype(bool), want)

@@ -84,6 +84,7 @@ def baseline_gspmd(n_dev):
     lr = jnp.float32(1e-3)
     comp = jstep.lower(tr.params, tr.opt_state, (ids, ids),
                        jax.random.key(0), jnp.int32(1), lr).compile()
+    tr._compiled = jstep  # reuse the traced step; don't compile twice
     wall = _wall(lambda: float(tr.step((ids, ids))))
     return *_cost(comp), wall
 
@@ -100,6 +101,7 @@ def pipeline(schedule, pp, mb):
     comp = jstep.lower(runner.embed_params, runner.stage_params,
                        runner.head_params, runner.opt_states, ids, ids,
                        lr, jnp.int32(1)).compile()
+    runner._step = jstep  # reuse the traced step; don't compile twice
     wall = _wall(lambda: float(runner.step(ids, ids)))
     return *_cost(comp), wall
 
@@ -110,6 +112,7 @@ def fmt_mem(b):
 
 def main():
     rows = []
+    failures = []
     for pp, mb in ((2, 4), (4, 8)):
         base_fl, base_mem, base_wall = baseline_gspmd(pp)
         rows.append((f"pure GSPMD dp={pp}", pp, mb, base_fl, base_mem,
@@ -118,8 +121,8 @@ def main():
             try:
                 fl, mem, wall = pipeline(sched, pp, mb)
             except Exception as e:  # noqa: BLE001
-                print(f"| {sched} pp={pp} | FAILED: {type(e).__name__}: "
-                      f"{str(e)[:120]} |")
+                failures.append(f"{sched} pp={pp} FAILED: "
+                                f"{type(e).__name__}: {str(e)[:200]}")
                 continue
             ticks = (mb + pp - 1) / mb  # analytic masked-tick ratio
             rows.append((f"{sched} pp={pp}", pp, mb, fl, mem, wall,
@@ -134,6 +137,8 @@ def main():
     print("\n*XLA cost-model flops count each scan BODY once (trip count "
           "ignored), so scan-over-ticks programs undercount — compare "
           "wall-clock and the analytic ratio instead.")
+    for f in failures:
+        print(f"FAILURE: {f}")
 
 
 if __name__ == "__main__":

@@ -473,53 +473,76 @@ def main():
 
     # one subprocess PER ladder config so a slow/hung compile on a big
     # config can't eat the whole budget before smaller configs get a turn
-    # (round-2/3 failure mode). The persistent compile cache makes a second
-    # pass over an already-attempted config cheap.
-    n_configs = 4  # len(_llama_ladder()) — parent must not import jax
+    # (round-2/3 failure mode). Climb ASCENDING (smallest first) so a TPU
+    # number lands even when the big compiles exceed their windows — each
+    # timed-out worker also leaves the chip lease held for minutes, so
+    # descending order can starve every config. The persistent compile
+    # cache (.jax_cache) makes re-walks cheap once a config ever compiled.
+    best = None        # biggest config that succeeded
+    ladder_log = {}
     if tpu_alive:
-        plan = [(["--config", str(i)], 900) for i in range(n_configs)]
-        plan += [(["--config", "3"], 600), (["--cpu"], 300)]
-    else:
-        plan = [(["--cpu"], 300)]
-    for i, (args, timeout_s) in enumerate(plan):
-        result, err = _attempt(args, timeout_s)
-        if result is not None:
-            if errors:
-                result.setdefault("detail", {})["attempt_errors"] = errors
-            # secondary metrics (BASELINE rows 2-3): bounded, best-effort,
-            # run AFTER the primary llama number is already in hand. Key off
-            # the attempt that actually SUCCEEDED: if the primary came from
-            # the --cpu fallback (mid-run wedge), don't burn 24 min dialing
-            # the TPU for secondaries
-            primary_on_cpu = "--cpu" in args
-            sec_plan = ([(["--secondary", "resnet"], 720),
-                         (["--secondary", "bert"], 720)]
-                        if tpu_alive and not primary_on_cpu
-                        else [(["--secondary", "both", "--cpu"], 420)])
-            secondary = {}
-            tpu_sec_failed = False
-            for sargs, st in sec_plan:
-                sres, serr = _attempt(sargs, st)
-                if sres is not None:
-                    secondary.update(sres.get("detail", {}))
-                else:
-                    secondary.setdefault("errors", []).append(
-                        f"{' '.join(sargs)}: {serr}")
-                    tpu_sec_failed = tpu_sec_failed or "--cpu" not in sargs
-            if tpu_sec_failed:
-                # mid-run wedge: still ship CPU numbers for rows 2-3
-                sres, serr = _attempt(["--secondary", "both", "--cpu"], 420)
-                if sres is not None:
-                    secondary["cpu_fallback"] = sres.get("detail", {})
-                else:
-                    secondary.setdefault("errors", []).append(
-                        f"cpu fallback: {serr}")
-            if secondary:
-                result.setdefault("detail", {})["secondary"] = secondary
-            print(json.dumps(result))
-            return 0
-        errors.append(f"attempt{i}({' '.join(args) or 'tpu'}): {err}")
-        time.sleep(min(30, 5 * (i + 1)))
+        plan = [(["--config", "3"], 900), (["--config", "2"], 900),
+                (["--config", "1"], 900), (["--config", "0"], 900)]
+        for args, timeout_s in plan:
+            result, err = _attempt(args, timeout_s)
+            cfg_id = args[1]
+            if result is not None:
+                ladder_log[cfg_id] = {
+                    "config": (result.get("detail") or {}).get("config"),
+                    "value": result.get("value"),
+                    "tokens_per_s": (result.get("detail") or {}).get(
+                        "tokens_per_s")}
+                best = result   # later (bigger) successes replace earlier
+            else:
+                ladder_log[cfg_id] = {"error": err}
+                errors.append(f"config{cfg_id}: {err}")
+                # keep climbing: a bigger config can still succeed from a
+                # warm cache even if this one timed out cold
+                time.sleep(20)   # let a killed worker's device lease lapse
+    if best is not None:
+        result = best
+        if errors:
+            result.setdefault("detail", {})["attempt_errors"] = errors
+        result.setdefault("detail", {})["ladder"] = ladder_log
+        sec_plan = [(["--secondary", "resnet"], 720),
+                    (["--secondary", "bert"], 720)]
+        secondary = {}
+        tpu_sec_failed = False
+        for sargs, st in sec_plan:
+            sres, serr = _attempt(sargs, st)
+            if sres is not None:
+                secondary.update(sres.get("detail", {}))
+            else:
+                secondary.setdefault("errors", []).append(
+                    f"{' '.join(sargs)}: {serr}")
+                tpu_sec_failed = True
+        if tpu_sec_failed:
+            # mid-run wedge: still ship CPU numbers for rows 2-3
+            sres, serr = _attempt(["--secondary", "both", "--cpu"], 420)
+            if sres is not None:
+                secondary["cpu_fallback"] = sres.get("detail", {})
+            else:
+                secondary.setdefault("errors", []).append(
+                    f"cpu fallback: {serr}")
+        if secondary:
+            result.setdefault("detail", {})["secondary"] = secondary
+        print(json.dumps(result))
+        return 0
+
+    # no TPU number at all: CPU smoke + CPU secondaries
+    result, err = _attempt(["--cpu"], 300)
+    if result is not None:
+        if errors:
+            result.setdefault("detail", {})["attempt_errors"] = errors
+        if ladder_log:
+            result.setdefault("detail", {})["ladder"] = ladder_log
+        sres, serr = _attempt(["--secondary", "both", "--cpu"], 420)
+        if sres is not None:
+            result.setdefault("detail", {})["secondary"] = \
+                sres.get("detail", {})
+        print(json.dumps(result))
+        return 0
+    errors.append(f"cpu: {err}")
     print(json.dumps({
         "metric": "llama_train_mfu_1chip", "value": 0.0,
         "unit": "mfu_fraction", "vs_baseline": 0.0,

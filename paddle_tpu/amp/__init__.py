@@ -186,7 +186,9 @@ class GradScaler:
         self.update()
 
     def minimize(self, optimizer, scaled_loss):
-        scaled_loss.backward()
+        # reference contract (amp/grad_scaler.py:261): the caller has
+        # already run scaled_loss.backward(); minimize only unscales,
+        # conditionally steps, and updates the scale
         self.step(optimizer)
 
     def update(self):

@@ -154,7 +154,16 @@ def enable_operator_stats_collection():
     dispatcher's observer hook (core.execute consults it on every op; a
     monkeypatch would miss call sites that from-imported execute).
     Re-entrant: nested enables share one counter and only the outermost
-    disable finalizes."""
+    disable finalizes.
+
+    Compiled-code scope (documented contract, r3 advisor weak #6): the
+    observer sees ops at Python dispatch time. Under `to_static`/jit, the
+    body's ops are counted ONCE — at trace time — and cache-hit replays
+    of the compiled program are invisible (one additional "to_static"
+    entry per call). Op-level dtype auditing of a compiled step should be
+    done eagerly first, or via the XLA-level profiler. Guarded by
+    tests/test_longtail_misc.py::test_op_stats_under_jit_counts_trace_once.
+    """
     global _op_stats, _nesting
     from ..framework import core as _core
     if _nesting == 0:

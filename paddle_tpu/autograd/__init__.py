@@ -70,15 +70,16 @@ class PyLayerContext:
             self._saved = tensors
             self._unpack = None
 
-    @property
     def saved_tensor(self):
+        """reference contract (autograd/py_layer.py:105): a METHOD returning
+        the tensors stored by save_for_backward."""
         unpack = getattr(self, "_unpack", None)
         if unpack is not None:
             return tuple(unpack(t) for t in self._saved)
         return self._saved
 
     def saved_tensors(self):
-        return self.saved_tensor
+        return self.saved_tensor()
 
     def mark_not_inplace(self, *args):
         self.not_inplace_tensors = args
@@ -224,7 +225,33 @@ def jvp(func, xs, v=None):
     return wrap(out), wrap(tang)
 
 
+def _tape_jacobian(ys, xs, batch_axis=None):
+    """reference contract (autograd/autograd.py:461): jacobian(ys, xs) with
+    COMPUTED output tensors — rows via repeated tape backward passes."""
+    _grad = grad
+    ys_l = ys if isinstance(ys, (list, tuple)) else [ys]
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    flat = ys_l[0].flatten() if len(ys_l) == 1 else None
+    if flat is None:
+        raise NotImplementedError("tensor-mode jacobian: single ys only")
+    rows = []
+    m = int(jnp.prod(jnp.asarray(flat.shape))) if flat.shape else 1
+    for i in range(m):
+        gs = _grad([flat[i]], list(xs_l), retain_graph=True,
+                   allow_unused=True)
+        rows.append([jnp.zeros_like(x._data).ravel() if g is None
+                     else g._data.ravel() for g, x in zip(gs, xs_l)])
+    outs = [Tensor(jnp.stack([r[j] for r in rows]))
+            for j in range(len(xs_l))]
+    if not isinstance(xs, (list, tuple)):
+        return outs[0]
+    return outs
+
+
 def jacobian(func, xs, batch_axis=None):
+    if not callable(func):
+        # reference signature: first arg is ys (a computed Tensor), not a fn
+        return _tape_jacobian(func, xs, batch_axis)
     xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
     arrs = [x._data for x in xs_l]
     jac = jax.jacrev(_to_pure(func), argnums=tuple(range(len(arrs))))(*arrs)

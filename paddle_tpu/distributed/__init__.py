@@ -107,7 +107,19 @@ class ShardingStage3(_ShardingStage):
     stage = 3
 
 
-DistAttr = None  # legacy dist attr: superseded by placements (kept importable)
+class DistAttr:
+    """Legacy mesh+sharding-spec pair (reference:
+    distributed/auto_parallel/api.py:144). Superseded by placements
+    (Shard/Replicate/Partial) but kept constructible: shard_tensor accepts
+    either flavor."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs or [])
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"sharding_specs={self.sharding_specs})")
 
 
 def get_backend():
@@ -135,8 +147,9 @@ def broadcast_object_list(object_list, src=0, group=None):
 
 def scatter_object_list(out_object_list, in_object_list=None, src=0,
                         group=None):
-    if in_object_list:
-        out_object_list.append(in_object_list[0])
+    """reference contract (communication/scatter.py:91): out_object_list's
+    CONTENT is replaced by this rank's scattered object."""
+    out_object_list[:] = [in_object_list[0]] if in_object_list else []
     return out_object_list
 
 

@@ -30,9 +30,21 @@ def _attach_dist(t, mesh, placements):
     return t
 
 
-def shard_tensor(data, mesh, placements, dtype=None, place=None,
-                 stop_gradient=None):
-    """reference: auto_parallel/api.py:205."""
+def shard_tensor(data, mesh=None, placements=None, dtype=None, place=None,
+                 stop_gradient=None, dist_attr=None):
+    """reference: auto_parallel/api.py:205. Accepts either the placements
+    flavor (mesh, [Shard/Replicate/Partial...]) or the legacy DistAttr
+    flavor (mesh + per-tensor-axis sharding_specs)."""
+    legacy = dist_attr if dist_attr is not None else (
+        mesh if hasattr(mesh, "sharding_specs") else None)
+    if legacy is not None:
+        from .placement import Shard, Replicate
+        mesh = legacy.process_mesh
+        dim_names = list(getattr(mesh, "dim_names", []))
+        placements = [Replicate() for _ in dim_names]
+        for axis, spec in enumerate(legacy.sharding_specs):
+            if spec is not None:
+                placements[dim_names.index(spec)] = Shard(axis)
     t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
     sharding = named_sharding(mesh, placements, t._data.ndim)
     arr = jax.device_put(t._data, sharding)

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import dtypes as _dtypes
+from . import staging as _staging
 
 __all__ = [
     "Tensor",
@@ -466,8 +467,16 @@ def _deposit_leaf_grad(t, g):
 # ---------------------------------------------------------------------------
 
 
+_STAGING_SCOPE = None  # set by framework.staging.StagingScope (graph breaks)
+
+
 def _unwrap(x):
-    return x._data if isinstance(x, Tensor) else x
+    if isinstance(x, Tensor):
+        d = x._data
+        if isinstance(d, _staging.StagedBox):
+            return d.real if d.real is not None else d._materialize()
+        return d
+    return x
 
 
 # AMP cast hook installed by paddle_tpu.amp (kept as a function pointer to
@@ -508,6 +517,10 @@ def execute(f: Callable, *inputs, _name: str = None, **static_kwargs):
     AMP auto-cast (reference: paddle/fluid/eager/amp_auto_cast.h) hooks in
     here too, as does the NaN/Inf scanner.
     """
+    if _STAGING_SCOPE is not None and _STAGING_SCOPE.active:
+        # graph-break staged mode: defer the op into the prefix DAG
+        return _STAGING_SCOPE.stage(f, inputs, _name, static_kwargs)
+
     arrs = [_unwrap(x) for x in inputs]
     if _amp_cast_hook is not None:
         arrs = _amp_cast_hook(_name or getattr(f, "__name__", "op"), arrs)

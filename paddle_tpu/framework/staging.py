@@ -1,0 +1,337 @@
+"""Graph-break prefix compilation for to_static (SOT partial-graph analog).
+
+When a to_static trace hits data-dependent Python control flow, round-3
+behavior was whole-function eager fallback. This module instead runs the
+function in *staged* mode: every execute() op is deferred into a DAG of
+StagedNodes, and the first concretization point (bool()/int()/float()/
+item()/numpy() on a staged tensor — the graph break) flushes the
+accumulated prefix as ONE jit-compiled XLA computation. Execution then
+continues staging, so a function with K breaks runs as K+1 compiled
+segments instead of per-op eager dispatches.
+
+reference analog: python/paddle/jit/sot/opcode_translator/executor/
+opcode_executor.py — SOT compiles the partial graph up to the break and
+stitches eager execution after it.
+
+The flushed prefix goes through framework.core.execute() as a single op,
+so it lands on the autograd tape as one vjp node — backward through a
+broken function stays correct and fully compiled per segment.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class StagedBox:
+    """Placeholder living in Tensor._data while the op that produces the
+    value is deferred. Carries the aval so shape/dtype-dependent Python
+    code proceeds without materializing."""
+
+    __slots__ = ("aval", "scope", "real", "owner", "__weakref__")
+
+    def __init__(self, aval, scope):
+        self.aval = aval
+        self.scope = scope
+        self.real = None
+        self.owner = None  # weakref to the Tensor owning this box
+
+    # -- aval surface (no materialization) ---------------------------------
+    @property
+    def shape(self):
+        return self.aval.shape if self.real is None else self.real.shape
+
+    @property
+    def dtype(self):
+        return self.aval.dtype if self.real is None else self.real.dtype
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        n = 1
+        for s in self.shape:
+            n *= int(s)
+        return n
+
+    def devices(self):
+        self._materialize()
+        return self.real.devices()
+
+    # -- concretization = graph break --------------------------------------
+    def _materialize(self):
+        if self.real is None:
+            self.scope.flush()
+        assert self.real is not None
+        return self.real
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._materialize())
+        return a.astype(dtype) if dtype is not None else a
+
+    def __bool__(self):
+        return bool(self._materialize())
+
+    def __int__(self):
+        return int(self._materialize())
+
+    def __float__(self):
+        return float(self._materialize())
+
+    def __index__(self):
+        return int(self._materialize())
+
+    def item(self, *args):
+        return self._materialize().item(*args)
+
+    def tolist(self):
+        return np.asarray(self._materialize()).tolist()
+
+    def __jax_array__(self):
+        return self._materialize()
+
+    def astype(self, dtype):
+        return self._materialize().astype(dtype)
+
+    def reshape(self, *a, **k):
+        return self._materialize().reshape(*a, **k)
+
+    def __getattr__(self, name):
+        # unanticipated jax.Array attribute: materialize and delegate
+        return getattr(self._materialize(), name)
+
+
+class StagedNode:
+    __slots__ = ("f", "kwargs", "name", "parents", "out_boxes",
+                 "out_treedef")
+
+    def __init__(self, f, kwargs, name, parents):
+        self.f = f
+        self.kwargs = kwargs
+        self.name = name
+        self.parents = parents  # list of StagedBox | ('leaf', Tensor) |
+        #                         ('const', raw)
+        self.out_boxes = []
+        self.out_treedef = None
+
+
+def _cell_summary(f):
+    """Hashable summary of a function's closure for the flush-cache key.
+    Scalars hash by value; arrays by (shape, dtype, id) — id matching means
+    the SAME object, so reuse is sound; fresh per-call arrays simply miss
+    the cache and recompile."""
+    cells = getattr(f, "__closure__", None) or ()
+    out = []
+    for c in cells:
+        v = c.cell_contents
+        if isinstance(v, (int, float, bool, str, bytes, type(None))):
+            out.append(v)
+        elif isinstance(v, tuple) and all(
+                isinstance(e, (int, float, bool, str, type(None)))
+                for e in v):
+            out.append(v)
+        elif hasattr(v, "shape") and hasattr(v, "dtype"):
+            out.append(("arr", tuple(v.shape), str(v.dtype), id(v)))
+        elif callable(v):
+            out.append(("fn", getattr(v, "__code__", None) or id(v),
+                        _cell_summary(v)))
+        else:
+            out.append(("obj", type(v).__name__, id(v)))
+    return tuple(out)
+
+
+def _kw_summary(kw):
+    return tuple(sorted((k, repr(v)[:80]) for k, v in kw.items()))
+
+
+class StagingScope:
+    """Active deferred-execution region. core.execute() routes ops here
+    while `active`; flush() compiles+runs the pending prefix."""
+
+    def __init__(self, jit_cache=None):
+        self.pending: list[StagedNode] = []
+        self.active = False
+        self.jit_cache = jit_cache if jit_cache is not None else {}
+        self.segments = 0          # compiled segments so far (telemetry)
+
+    # -- context manager ----------------------------------------------------
+    def __enter__(self):
+        from . import core as _core
+        self._prev = _core._STAGING_SCOPE
+        _core._STAGING_SCOPE = self
+        self.active = True
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        from . import core as _core
+        try:
+            if exc_type is None:
+                self.flush()   # returned tensors must be real
+        finally:
+            self.active = False
+            _core._STAGING_SCOPE = self._prev
+        return False
+
+    # -- staging ------------------------------------------------------------
+    def stage(self, f, inputs, name, static_kwargs):
+        from .core import Tensor, _GRAD_ENABLED
+        parents = []
+        avals = []
+        any_diff = False
+        for x in inputs:
+            if isinstance(x, Tensor):
+                d = x._data
+                if isinstance(d, StagedBox) and d.real is None:
+                    parents.append(d)
+                    avals.append(jax.ShapeDtypeStruct(d.shape, d.dtype))
+                else:
+                    arr = d.real if isinstance(d, StagedBox) else d
+                    parents.append(("leaf", x))
+                    avals.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+                if (_GRAD_ENABLED and not x.stop_gradient
+                        and jnp.issubdtype(jnp.result_type(d.dtype),
+                                           jnp.inexact)):
+                    any_diff = True
+            else:
+                parents.append(("const", x))
+                avals.append(x)
+        node = StagedNode(f, dict(static_kwargs), name or
+                          getattr(f, "__name__", "op"), parents)
+        out_aval = jax.eval_shape(lambda *a: f(*a, **node.kwargs), *avals)
+        flat_avals, treedef = jax.tree_util.tree_flatten(out_aval)
+        node.out_treedef = treedef
+        outs = []
+        for av in flat_avals:
+            box = StagedBox(av, self)
+            node.out_boxes.append(box)
+            t = Tensor.__new__(Tensor)
+            t._data = box
+            t._grad = None
+            t._node = None
+            t.stop_gradient = not any_diff
+            t.name = None
+            box.owner = weakref.ref(t)
+            outs.append(t)
+        self.pending.append(node)
+        return jax.tree_util.tree_unflatten(treedef, outs)
+
+    # -- flush: compile + run the pending prefix ----------------------------
+    @staticmethod
+    def _fingerprint(nodes, box_slot, leaf_ids):
+        """Structural key for reusing a segment's compiled replay across
+        calls. Box parents key by their SLOT in the segment (stable across
+        calls); fresh per-call closure arrays miss by id and recompile."""
+        parts = []
+        for node in nodes:
+            pdesc = []
+            for p in node.parents:
+                if isinstance(p, StagedBox):
+                    pdesc.append(("box", box_slot[id(p)]))
+                elif p[0] == "leaf":
+                    d = p[1]._data
+                    arr = d.real if isinstance(d, StagedBox) else d
+                    pdesc.append(("leaf", leaf_ids[id(p[1])],
+                                  tuple(arr.shape), str(arr.dtype),
+                                  p[1].stop_gradient))
+                else:
+                    v = p[1]
+                    pdesc.append(("const", repr(v)[:80]))
+            parts.append((node.name, getattr(node.f, "__code__", id(node.f)),
+                          _cell_summary(node.f), _kw_summary(node.kwargs),
+                          tuple(pdesc),
+                          tuple((tuple(b.aval.shape), str(b.aval.dtype))
+                                for b in node.out_boxes)))
+        return tuple(parts)
+
+    def flush(self):
+        from .core import execute
+        if not self.pending:
+            return
+        nodes, self.pending = self.pending, []
+        self.segments += 1
+
+        # ordered unique leaf tensors feeding this segment
+        leaf_tensors: list = []
+        leaf_ids = {}
+        for node in nodes:
+            for p in node.parents:
+                if isinstance(p, tuple) and p[0] == "leaf":
+                    t = p[1]
+                    if id(t) not in leaf_ids:
+                        leaf_ids[id(t)] = len(leaf_tensors)
+                        leaf_tensors.append(t)
+
+        box_slot = {}
+        all_boxes = []
+        for node in nodes:
+            for b in node.out_boxes:
+                box_slot[id(b)] = len(all_boxes)
+                all_boxes.append(b)
+
+        # slot-resolve every parent NOW so the cached replay closes over a
+        # lightweight spec — never over Tensors or result arrays (review
+        # r4: caching (replay, nodes) pinned a whole call's activations
+        # for the StaticFunction's lifetime)
+        spec = []   # per node: (f, kwargs, [("env",slot)|("leaf",i)|("const",v)], out_slots)
+        for node in nodes:
+            pdesc = []
+            for p in node.parents:
+                if isinstance(p, StagedBox):
+                    pdesc.append(("env", box_slot[id(p)]))
+                elif p[0] == "leaf":
+                    pdesc.append(("leaf", leaf_ids[id(p[1])]))
+                else:
+                    pdesc.append(("const", p[1]))
+            spec.append((node.f, node.kwargs, pdesc,
+                         [box_slot[id(b)] for b in node.out_boxes]))
+        n_boxes = len(all_boxes)
+
+        def replay(*leaf_arrays):
+            # a box parent always belongs to THIS segment: flush drains all
+            # pending nodes, so anything staged later sees only real data
+            env: dict[int, Any] = {}
+            for f, kwargs, pdesc, out_slots in spec:
+                args = [env[v] if kind == "env"
+                        else leaf_arrays[v] if kind == "leaf" else v
+                        for kind, v in pdesc]
+                out = f(*args, **kwargs)
+                for slot, arr in zip(out_slots,
+                                     jax.tree_util.tree_leaves(out)):
+                    env[slot] = arr
+            return tuple(env[i] for i in range(n_boxes))
+
+        key = self._fingerprint(nodes, box_slot, leaf_ids)
+        runner = self.jit_cache.get(key)
+        if runner is None:
+            if len(self.jit_cache) >= 64:
+                # bounded: per-call closure arrays (id-keyed) would
+                # otherwise grow one never-hit entry per step
+                self.jit_cache.pop(next(iter(self.jit_cache)))
+            runner = jax.jit(replay)
+            self.jit_cache[key] = runner
+        jitted = runner
+
+        # run OUTSIDE staging so the segment lands on the tape as one node
+        self.active = False
+        try:
+            try:
+                outs = execute(jitted, *leaf_tensors, _name="staged_prefix")
+            except Exception:
+                # op not jit-traceable (host callback etc.): replay eagerly
+                self.jit_cache.pop(key, None)
+                outs = execute(replay, *leaf_tensors, _name="staged_prefix")
+        finally:
+            self.active = True
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        for b, out_t in zip(all_boxes, outs):
+            b.real = out_t._data
+            owner = b.owner() if b.owner is not None else None
+            if owner is not None:
+                owner._rebind(out_t)

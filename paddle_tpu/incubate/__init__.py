@@ -147,7 +147,11 @@ class LookAhead:
         self.alpha = alpha
         self.k = k
         self._step_count = 0
-        self._slow = {}
+        # slow weights start from the INITIAL fast weights (reference:
+        # incubate/optimizer/lookahead.py) — capturing them lazily at the
+        # first merge would anchor them k steps too late
+        self._slow = {id(p): p._data
+                      for p in inner_optimizer._parameter_list}
 
     def __getattr__(self, item):
         return getattr(self.inner_optimizer, item)

@@ -174,7 +174,8 @@ class SubsetRandomSampler(Sampler):
         self.indices = list(indices)
 
     def __iter__(self):
-        return iter(np.random.permutation(len(self.indices)).tolist())
+        order = np.random.permutation(len(self.indices))
+        return iter(self.indices[i] for i in order)
 
     def __len__(self):
         return len(self.indices)

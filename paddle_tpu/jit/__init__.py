@@ -66,6 +66,8 @@ class StaticFunction:
         self._full_graph = full_graph
         self._cache: dict[Any, tuple] = {}
         self._fallback_keys: set = set()
+        self._staged_jit_cache: dict = {}   # compiled break segments
+        self._last_segments = 0
         functools.wraps(fn)(self)
 
     # -- discovery ----------------------------------------------------------
@@ -101,8 +103,16 @@ class StaticFunction:
 
         key = (self._signature(in_arrays, params, bufs), treedef,
                tuple((i, repr(a)) for i, a in enumerate(static_rest) if a is not None))
-        if key in self._fallback_keys:  # known graph break: stay eager
-            return self._fn(*args, **kwargs)
+        if key in self._fallback_keys:
+            # known graph break: staged mode — ops accumulate in a deferred
+            # DAG and each segment between breaks compiles as ONE XLA
+            # computation (the SOT partial-graph analog; framework/staging.py)
+            scope = _core._staging.StagingScope(
+                jit_cache=self._staged_jit_cache)
+            with scope:
+                out = self._fn(*args, **kwargs)
+            self._last_segments = scope.segments
+            return out
         entry = self._cache.get(key)
         if entry is None:
             try:
@@ -119,12 +129,18 @@ class StaticFunction:
                 import warnings
                 warnings.warn(
                     f"to_static: graph break in {getattr(self._fn, '__name__', self._fn)!r} "
-                    f"(data-dependent control flow); running this input "
-                    f"signature eagerly. Use paddle_tpu.static.nn.cond/"
-                    f"while_loop or full_graph=True to make this an error.\n"
+                    f"(data-dependent control flow); compiling this input "
+                    f"signature as staged prefix segments around the break. "
+                    f"Use paddle_tpu.static.nn.cond/while_loop or "
+                    f"full_graph=True to make this an error.\n"
                     f"  cause: {e}", RuntimeWarning, stacklevel=2)
                 self._fallback_keys.add(key)
-                return self._fn(*args, **kwargs)
+                scope = _core._staging.StagingScope(
+                    jit_cache=self._staged_jit_cache)
+                with scope:
+                    out = self._fn(*args, **kwargs)
+                self._last_segments = scope.segments
+                return out
             self._cache[key] = entry
         jitted, out_rebuild, mutated = entry
 

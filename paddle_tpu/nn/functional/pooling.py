@@ -31,6 +31,38 @@ def _pads(padding, n):
     raise ValueError(f"bad padding {padding}")
 
 
+def _ceil_extra(pad, spatial, ks, sd):
+    """Extra right-padding per spatial dim so reduce_window emits the
+    reference's ceil_mode output size ceil((L + 2p - k)/s) + 1."""
+    import math
+    extra = []
+    for L, (lo, hi), k, s in zip(spatial, pad, ks, sd):
+        total = L + lo + hi
+        out = math.ceil(max(total - k, 0) / s) + 1
+        extra.append(max((out - 1) * s + k - total, 0))
+    return extra
+
+
+def _pool_geometry(a_shape, ks, sd, pad, n, channels_first, ceil_mode):
+    """(window, strides, pads) for reduce_window, with ceil_mode folded
+    into extra right-padding. pads may be a SAME/VALID string."""
+    if isinstance(pad, str):
+        if ceil_mode:
+            raise ValueError("ceil_mode with string padding is unsupported")
+        return ((1, 1) + ks if channels_first else (1,) + ks + (1,),
+                (1, 1) + sd if channels_first else (1,) + sd + (1,),
+                pad)
+    spatial = a_shape[2:2 + n] if channels_first else a_shape[1:1 + n]
+    pad = [list(p) for p in pad]
+    if ceil_mode:
+        for p, e in zip(pad, _ceil_extra(pad, spatial, ks, sd)):
+            p[1] += e
+    pad = [tuple(p) for p in pad]
+    if channels_first:
+        return (1, 1) + ks, (1, 1) + sd, [(0, 0), (0, 0)] + pad
+    return (1,) + ks + (1,), (1,) + sd + (1,), [(0, 0)] + pad + [(0, 0)]
+
+
 def _pool(x, kernel, stride, padding, n, kind, ceil_mode=False, exclusive=True,
           data_format="NCHW"):
     ks = _tuple(kernel, n)
@@ -39,19 +71,15 @@ def _pool(x, kernel, stride, padding, n, kind, ceil_mode=False, exclusive=True,
     channels_first = data_format in ("NCL", "NCHW", "NCDHW")
 
     def f(a):
-        if channels_first:
-            window = (1, 1) + ks
-            strides = (1, 1) + sd
-            pads = ([(0, 0), (0, 0)] + pad) if not isinstance(pad, str) else pad
-        else:
-            window = (1,) + ks + (1,)
-            strides = (1,) + sd + (1,)
-            pads = ([(0, 0)] + pad + [(0, 0)]) if not isinstance(pad, str) else pad
+        window, strides, pads = _pool_geometry(
+            a.shape, ks, sd, pad, n, channels_first, ceil_mode)
         if kind == "max":
             init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
             return jax.lax.reduce_window(a, init, jax.lax.max, window, strides, pads)
         s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
-        if exclusive and not isinstance(pads, str):
+        if (exclusive or ceil_mode) and not isinstance(pads, str):
+            # ceil_mode's synthetic right-pad must never count toward the
+            # divisor, regardless of exclusive (reference semantics)
             ones = jnp.ones_like(a)
             cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
             return s / cnt
@@ -63,46 +91,57 @@ def _pool(x, kernel, stride, padding, n, kind, ceil_mode=False, exclusive=True,
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
     out = _pool(x, kernel_size, stride, padding, 1, "max", ceil_mode, data_format=data_format)
-    return (out, None) if return_mask else out
+    if return_mask:
+        return out, _max_pool_indices(x, kernel_size, stride, padding, 1,
+                                      ceil_mode, data_format)
+    return out
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
     out = _pool(x, kernel_size, stride, padding, 2, "max", ceil_mode, data_format=data_format)
     if return_mask:
-        idx = _max_pool_indices(x, kernel_size, stride, padding)
-        return out, idx
+        return out, _max_pool_indices(x, kernel_size, stride, padding, 2,
+                                      ceil_mode, data_format)
     return out
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
     out = _pool(x, kernel_size, stride, padding, 3, "max", ceil_mode, data_format=data_format)
-    return (out, None) if return_mask else out
+    if return_mask:
+        return out, _max_pool_indices(x, kernel_size, stride, padding, 3,
+                                      ceil_mode, data_format)
+    return out
 
 
-def _max_pool_indices(x, kernel, stride, padding):
-    ks = _tuple(kernel, 2)
-    sd = _tuple(stride if stride is not None else kernel, 2)
-    pad = _pads(padding, 2)
+def _max_pool_indices(x, kernel, stride, padding, n, ceil_mode=False,
+                      data_format="NCHW"):
+    """Argmax indices (flat over the spatial dims) for max_poolNd's
+    return_mask — the contract max_unpoolNd consumes."""
+    ks = _tuple(kernel, n)
+    sd = _tuple(stride if stride is not None else kernel, n)
+    pad = _pads(padding, n)
+    channels_first = data_format in ("NCL", "NCHW", "NCDHW")
 
     def f(a):
-        n, c, h, w = a.shape
-        flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+        spatial = a.shape[2:2 + n] if channels_first else a.shape[1:1 + n]
+        size = int(np.prod(spatial))
+        shape = ((1, 1) + tuple(spatial)) if channels_first \
+            else ((1,) + tuple(spatial) + (1,))
+        flat_idx = jnp.arange(size, dtype=jnp.float32).reshape(shape)
         flat_idx = jnp.broadcast_to(flat_idx, a.shape)
-        # pack value+index: use pairwise select via reduce_window on tuple unsupported;
-        # trick: scale values and tie-break by -index
         big = jnp.where(jnp.isfinite(a), a, -jnp.inf)
+
         def select(x1, x2):
             v1, i1 = x1
             v2, i2 = x2
             take1 = (v1 > v2) | ((v1 == v2) & (i1 < i2))
             return jnp.where(take1, v1, v2), jnp.where(take1, i1, i2)
-        window = (1, 1) + ks
-        strides = (1, 1) + sd
-        pads = [(0, 0), (0, 0)] + pad if not isinstance(pad, str) else pad
+        window, strides, pads = _pool_geometry(
+            a.shape, ks, sd, pad, n, channels_first, ceil_mode)
         v, i = jax.lax.reduce_window(
-            (big, flat_idx), (-jnp.inf, jnp.float32(h * w)), select,
+            (big, flat_idx), (-jnp.inf, jnp.float32(size)), select,
             window, strides, pads)
         return i.astype(jnp.int64)
     return execute(f, x, _name="max_pool_indices")

@@ -65,6 +65,11 @@ class Layer:
             name = getattr(attr, "name", None)
             lr = getattr(attr, "learning_rate", 1.0)
         if init is None:
+            # set_global_initializer overrides the built-in defaults for
+            # params whose ParamAttr carries no explicit initializer
+            # (reference: nn/initializer/set_global_initializer)
+            init = I._global_bias_init if is_bias else I._global_weight_init
+        if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
         data = init._init(tuple(int(s) for s in shape), dtype)
         p = Parameter(data, name=name, trainable=trainable)

@@ -306,7 +306,12 @@ def Print(input, first_n=-1, message=None, summarize=20, **kw):
     """reference: static Print op — eager host print."""
     import numpy as _np
     prefix = message or "var"
-    print(f"{prefix}: {_np.asarray(input._data)[:summarize]}")
+    arr = _np.asarray(input._data)
+    if arr.ndim == 0 or summarize < 0:   # reference: -1 = print everything
+        shown = arr if arr.ndim == 0 else arr.reshape(-1)
+    else:
+        shown = arr.reshape(-1)[:summarize]
+    print(f"{prefix}: {shown}")
     return input
 
 
@@ -417,26 +422,55 @@ class WeightNormParamAttr:
         self.name = name
 
 
+def _state_to_npz_bytes(state):
+    """name->Tensor dict serialized as in-memory npz — no pickle (same
+    no-unpickling rule as distributed.checkpoint)."""
+    import io as _io
+    import numpy as _np
+    buf = _io.BytesIO()
+    _np.savez(buf, **{k: _np.asarray(v._data) for k, v in state.items()})
+    return buf.getvalue()
+
+
+def _npz_bytes_to_params(data):
+    import io as _io
+    import numpy as _np
+    import jax.numpy as _jnp
+    from ..framework.core import Tensor
+    out = {}
+    if data:
+        with _np.load(_io.BytesIO(data)) as z:
+            for k in z.files:
+                out[k] = Tensor(_jnp.asarray(z[k]))
+    return out
+
+
 def serialize_program(program=None, **kw):
-    import pickle
-    return pickle.dumps({"format": "paddle_tpu.static", "version": 1})
+    """The program STRUCTURE is Python + the traced jaxpr (see module
+    docstring); the serializable content is the name-keyed parameter
+    registry. Format: in-memory npz, no pickle."""
+    prog = program or default_main_program()
+    return _state_to_npz_bytes(prog.state_dict()
+                               if hasattr(prog, "state_dict") else {})
 
 
 def deserialize_program(data):
-    return Program()
+    prog = Program()
+    prog._params = _npz_bytes_to_params(data)
+    return prog
 
 
 def serialize_persistables(program=None, executor=None, **kw):
-    import pickle
-    state = {}
-    if program is not None and hasattr(program, "state_dict"):
-        state = {k: v.numpy() for k, v in program.state_dict().items()}
-    return pickle.dumps(state)
+    prog = program or default_main_program()
+    return _state_to_npz_bytes(prog.state_dict()
+                               if hasattr(prog, "state_dict") else {})
 
 
 def deserialize_persistables(program, data, executor=None):
-    import pickle
-    return pickle.loads(data)
+    state = _npz_bytes_to_params(data)
+    if program is not None and hasattr(program, "set_state_dict"):
+        program.set_state_dict(state)
+    return state
 
 
 def save(program, model_path, protocol=4, **configs):
@@ -469,19 +503,40 @@ def normalize_program(program, feeds, fetches, **kw):
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          **kwargs):
-    """reference: static.save_inference_model → jit.save is the artifact."""
-    program = kwargs.get("program")
-    layer = kwargs.get("layer") or program
-    if layer is not None and hasattr(layer, "state_dict"):
+    """reference: static.save_inference_model. Two flavors:
+    - layer=<nn.Layer>: full traced StableHLO artifact via jit.save.
+    - static-program (default): the guarded Program's parameter registry
+      (.pdmodel, npz bytes) + a feed/fetch manifest (.pdmodel.json); the
+      program structure itself is the user's builder code, re-run at load
+      (documented Program-shim contract)."""
+    import json
+    layer = kwargs.get("layer")
+    if layer is not None and hasattr(layer, "state_dict") and not isinstance(
+            layer, Program):
         from ..jit import save as _jsave
         _jsave(layer, path_prefix)
-    else:
-        raise ValueError(
-            "save_inference_model needs layer=<nn.Layer> in this build "
-            "(the traced-program path is jit.save)")
+        return
+    prog = kwargs.get("program") or default_main_program()
+    save_to_file(path_prefix + ".pdmodel", serialize_program(prog))
+    meta = {"format": "paddle_tpu.static", "version": 1,
+            "feed": [getattr(v, "name", None) for v in (feed_vars or [])],
+            "fetch": [getattr(v, "name", None) for v in (fetch_vars or [])]}
+    save_to_file(path_prefix + ".pdmodel.json",
+                 json.dumps(meta).encode())
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):
+    import json
+    import os
+    if os.path.exists(path_prefix + ".pdmodel.json"):
+        prog = deserialize_program(
+            load_from_file(path_prefix + ".pdmodel"))
+        meta = json.loads(
+            load_from_file(path_prefix + ".pdmodel.json").decode())
+        target = kwargs.get("program")
+        if target is not None and hasattr(target, "set_state_dict"):
+            target.set_state_dict(prog.state_dict())
+        return [prog, meta.get("feed", []), meta.get("fetch", [])]
     from ..jit import load as _jload
     tl = _jload(path_prefix)
     return [Program(), [], [tl]]

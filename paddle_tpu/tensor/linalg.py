@@ -39,6 +39,10 @@ def norm(x, p=None, axis=None, keepdim=False, name=None):
         if axis is None and p is None:
             return jnp.linalg.norm(a.reshape(-1), 2)
         if axis is None:
+            if p == "fro":   # Frobenius over the whole tensor == flat 2-norm
+                return jnp.linalg.norm(a.reshape(-1), 2)
+            if p == "nuc":   # nuclear norm needs the matrix form
+                return jnp.linalg.norm(a, "nuc")
             return jnp.linalg.norm(a.reshape(-1), _p(p))
         if isinstance(axis, (list, tuple)) and len(axis) == 2:
             return jnp.linalg.norm(a, _p(p) if p is not None else "fro", axis=tuple(axis), keepdims=keepdim)

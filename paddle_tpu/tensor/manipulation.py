@@ -327,10 +327,12 @@ def x_dtype(t):
 
 
 def masked_select(x, mask, name=None):
-    # dynamic output shape: materialize on host (documented non-jittable)
-    a = np.asarray(x._data)
-    m = np.asarray(mask._data)
-    return Tensor(jnp.asarray(a[m]))
+    # dynamic output shape: the mask is concretized on host (documented
+    # non-jittable), but the VALUE path stays a differentiable gather so
+    # gradients scatter back into the selected positions
+    m = np.broadcast_to(np.asarray(mask._data), x._data.shape)
+    idx = jnp.asarray(np.nonzero(m.reshape(-1))[0], jnp.int32)
+    return execute(lambda a: a.reshape(-1)[idx], x, _name="masked_select")
 
 
 def masked_fill(x, mask, value, name=None):

@@ -17,6 +17,21 @@ __all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
            "to_tensor", "normalize", "resize", "hflip", "vflip"]
 
 
+class BaseTransform:
+    """reference: transforms.py BaseTransform (keys plumbing). All
+    transform classes subclass it so isinstance checks from reference
+    code keep working."""
+
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, inputs):
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
 class Compose:
     def __init__(self, transforms):
         self.transforms = transforms
@@ -44,7 +59,7 @@ def to_tensor(pic, data_format="CHW"):
     return Tensor(arr)
 
 
-class ToTensor:
+class ToTensor(BaseTransform):
     def __init__(self, data_format="CHW", keys=None):
         self.data_format = data_format
 
@@ -63,7 +78,7 @@ def normalize(img, mean, std, data_format="CHW", to_rgb=False):
     return Tensor(arr) if isinstance(img, Tensor) else arr
 
 
-class Normalize:
+class Normalize(BaseTransform):
     def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
         if isinstance(mean, numbers.Number):
             mean = [mean, mean, mean]
@@ -93,7 +108,7 @@ def resize(img, size, interpolation="bilinear"):
     return np.asarray(out)
 
 
-class Resize:
+class Resize(BaseTransform):
     def __init__(self, size, interpolation="bilinear", keys=None):
         self.size = size
         self.interpolation = interpolation
@@ -112,7 +127,7 @@ def vflip(img):
     return arr[::-1]
 
 
-class RandomHorizontalFlip:
+class RandomHorizontalFlip(BaseTransform):
     def __init__(self, prob=0.5, keys=None):
         self.prob = prob
 
@@ -122,7 +137,7 @@ class RandomHorizontalFlip:
         return _to_np(img)
 
 
-class RandomVerticalFlip:
+class RandomVerticalFlip(BaseTransform):
     def __init__(self, prob=0.5, keys=None):
         self.prob = prob
 
@@ -132,7 +147,7 @@ class RandomVerticalFlip:
         return _to_np(img)
 
 
-class RandomCrop:
+class RandomCrop(BaseTransform):
     def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
                  padding_mode="constant", keys=None):
         self.size = (size, size) if isinstance(size, int) else tuple(size)
@@ -151,7 +166,7 @@ class RandomCrop:
         return arr[i:i + th, j:j + tw]
 
 
-class CenterCrop:
+class CenterCrop(BaseTransform):
     def __init__(self, size, keys=None):
         self.size = (size, size) if isinstance(size, int) else tuple(size)
 
@@ -164,7 +179,7 @@ class CenterCrop:
         return arr[i:i + th, j:j + tw]
 
 
-class RandomResizedCrop:
+class RandomResizedCrop(BaseTransform):
     def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
                  interpolation="bilinear", keys=None):
         self.size = (size, size) if isinstance(size, int) else tuple(size)
@@ -189,7 +204,7 @@ class RandomResizedCrop:
         return resize(CenterCrop(min(h, w))(arr), self.size, self.interpolation)
 
 
-class Transpose:
+class Transpose(BaseTransform):
     def __init__(self, order=(2, 0, 1), keys=None):
         self.order = order
 
@@ -200,17 +215,7 @@ class Transpose:
         return arr.transpose(self.order)
 
 
-class BrightnessTransform:
-    def __init__(self, value, keys=None):
-        self.value = value
-
-    def __call__(self, img):
-        arr = _to_np(img).astype(np.float32)
-        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
-        return np.clip(arr * factor, 0, 255 if arr.max() > 1.5 else 1.0)
-
-
-class Pad:
+class Pad(BaseTransform):
     def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
         self.padding = padding
         self.fill = fill
@@ -426,26 +431,23 @@ def perspective(img, startpoints, endpoints, interpolation="nearest",
 
 
 def erase(img, i, j, h, w, v, inplace=False):
+    from ...framework.core import Tensor
+    is_tensor = isinstance(img, Tensor)
     a = _to_np(img)
     out = a if inplace else a.copy()
+    v = _to_np(v)
     if a.ndim == 3 and a.shape[-1] in (1, 3):
         out[i:i + h, j:j + w] = v
     else:
         out[..., i:i + h, j:j + w] = v
+    if is_tensor:  # preserve the caller's container type (reference
+        # contract: Tensor in -> Tensor out)
+        res = Tensor(out)
+        if inplace:
+            img.set_value(res)
+            return img
+        return res
     return out
-
-
-class BaseTransform:
-    """reference: transforms.py BaseTransform (keys plumbing)."""
-
-    def __init__(self, keys=None):
-        self.keys = keys
-
-    def __call__(self, inputs):
-        return self._apply_image(inputs)
-
-    def _apply_image(self, img):
-        raise NotImplementedError
 
 
 class Grayscale(BaseTransform):

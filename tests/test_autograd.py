@@ -153,7 +153,7 @@ class TestPyLayer:
 
             @staticmethod
             def backward(ctx, grad):
-                a, b = ctx.saved_tensor
+                a, b = ctx.saved_tensor()
                 return grad * b, grad * a
 
         a = paddle.to_tensor([2.0], stop_gradient=False)
@@ -362,7 +362,7 @@ class TestDoubleBackward:
 
             @staticmethod
             def backward(ctx, dy):
-                (a,) = ctx.saved_tensor
+                (a,) = ctx.saved_tensor()
                 t = paddle.tanh(a)
                 return dy * (1.0 - t * t)
 
@@ -437,7 +437,7 @@ class TestDoubleBackward:
 
             @staticmethod
             def backward(ctx, dy):
-                (a,) = ctx.saved_tensor
+                (a,) = ctx.saved_tensor()
                 return dy * 2.0 * a
 
         x = paddle.to_tensor(np.array([1.5], np.float32),

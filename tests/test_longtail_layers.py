@@ -268,8 +268,8 @@ def test_simple_loss_functionals_vs_numpy():
     gt = rs.randint(0, 3, (2, 6, 1)).astype(np.int64)
     got = F.dice_loss(T(seg), T(gt)).numpy()
     oh = np.eye(3, dtype=np.float32)[gt.squeeze(-1)]
-    inter = (seg * oh).sum(1)
-    union = seg.sum(1) + oh.sum(1)
+    inter = (seg * oh).sum(axis=(1, 2))  # reduce ALL non-batch dims
+    union = seg.sum(axis=(1, 2)) + oh.sum(axis=(1, 2))
     want = (1 - (2 * inter + 1e-5) / (union + 1e-5)).mean()
     np.testing.assert_allclose(got, want, rtol=1e-4)
     anchor = X(4, 6)
@@ -324,10 +324,10 @@ def test_pool3d_vs_numpy():
     want_avg = r.mean(axis=(3, 5, 7))
     np.testing.assert_allclose(F.max_pool3d(T(x), 2).numpy(), want_max)
     np.testing.assert_allclose(F.avg_pool3d(T(x), 2).numpy(), want_avg,
-                               rtol=1e-6)
+                               rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(nn.MaxPool3D(2)(T(x)).numpy(), want_max)
     np.testing.assert_allclose(nn.AvgPool3D(2)(T(x)).numpy(), want_avg,
-                               rtol=1e-6)
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_pool2d_layers_match_functional():
@@ -578,11 +578,9 @@ def test_shape_layers():
                                x.reshape(2, -1))
     np.testing.assert_allclose(
         nn.Flatten(start_axis=2)(T(x)).numpy(), x.reshape(2, 3, 20))
-    np.testing.assert_allclose(
-        nn.ChannelShuffle(3)(T(X(1, 6, 2, 2))).numpy(),
-        F.channel_shuffle(T(X(1, 6, 2, 2)) * 0 + 1, 3).numpy() * 0 +
-        nn.ChannelShuffle(3)(T(X(1, 6, 2, 2))).numpy())
     y = X(1, 6, 2, 2)
+    np.testing.assert_allclose(F.channel_shuffle(T(y), 3).numpy(),
+                               nn.ChannelShuffle(3)(T(y)).numpy())
     np.testing.assert_allclose(
         nn.ChannelShuffle(3)(T(y)).numpy(),
         y.reshape(1, 3, 2, 2, 2).transpose(0, 2, 1, 3, 4).reshape(

@@ -1,0 +1,98 @@
+"""Warm the persistent compile cache with the bench ladder's train steps.
+
+Run (untimed, real TPU) after the bench to characterize where compile
+time goes and to leave compiled executables in .jax_cache so later bench
+runs — including the driver's — climb the full ladder from cache hits.
+
+Usage: python tools/tpu_ladder_warm.py [config_idx ...]   (default: 3 2 1 0)
+Prints one line per stage with elapsed seconds.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(REPO, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+import jax.numpy as jnp
+import numpy as np
+
+t0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time() - t0:8.1f}s] {msg}", flush=True)
+
+
+def warm_one(idx):
+    import bench
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.llama import LlamaForCausalLM
+    from paddle_tpu.models.scanned import build_scanned_llama
+
+    name, cfg, batch, seq, steps, remat = bench._llama_ladder()[idx]
+    log(f"=== config {idx}: {name} batch={batch} seq={seq} remat={remat}")
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    log(f"model built ({model.num_params() / 1e6:.0f}M params)")
+    params, loss_fn = build_scanned_llama(model, remat=remat,
+                                          dtype="bfloat16")
+    opt = optimizer.AdamW(3e-4, parameters=model.parameters())
+    opt_state = opt.tree_init(params)
+    for t in model.state_dict().values():
+        t._data = jnp.zeros((), t._data.dtype)
+    log("scanned params materialized on device")
+
+    def train_step(p, st, ids, labels, lr, stp):
+        loss, grads = jax.value_and_grad(loss_fn)(p, ids, labels)
+        new_p, new_st = opt.tree_update(p, grads, st, lr, stp)
+        return loss, new_p, new_st
+
+    jstep = jax.jit(train_step, donate_argnums=(0, 1))
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                      jnp.int32)
+    lr = jnp.float32(3e-4)
+
+    lowered = jstep.lower(params, opt_state, ids, ids, lr, jnp.int32(1))
+    log("lowered (jaxpr -> StableHLO)")
+    compiled = lowered.compile()
+    log("COMPILED")
+    loss, params, opt_state = compiled(params, opt_state, ids, ids, lr,
+                                       jnp.int32(1))
+    log(f"warmup step done, loss={float(loss):.4f}")
+    tt = time.perf_counter()
+    for i in range(4):
+        loss, params, opt_state = compiled(params, opt_state, ids, ids,
+                                           lr, jnp.int32(2 + i))
+    final = float(loss)
+    dt = time.perf_counter() - tt
+    tok_s = batch * seq * 4 / dt
+    log(f"4 steps: {dt:.2f}s -> {tok_s:.0f} tokens/s, loss={final:.4f}")
+    # free everything before the next config
+    del params, opt_state, compiled, lowered, jstep
+    import gc
+    gc.collect()
+
+
+def main():
+    idxs = [int(a) for a in sys.argv[1:]] or [3, 2, 1, 0]
+    log(f"devices: {jax.devices()}")
+    for i in idxs:
+        try:
+            warm_one(i)
+        except Exception as e:  # noqa: BLE001
+            log(f"config {i} FAILED: {type(e).__name__}: {str(e)[:300]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -110,7 +110,7 @@ class StagedBox:
 
 class StagedNode:
     __slots__ = ("f", "kwargs", "name", "parents", "out_boxes",
-                 "out_treedef")
+                 "out_treedef", "amp_hook")
 
     def __init__(self, f, kwargs, name, parents):
         self.f = f
@@ -120,6 +120,13 @@ class StagedNode:
         #                         ('const', raw)
         self.out_boxes = []
         self.out_treedef = None
+        self.amp_hook = None    # amp cast hook captured at stage time
+
+    def run(self, args):
+        """Apply the captured per-op AMP cast (if any), then the op."""
+        if self.amp_hook is not None:
+            args = self.amp_hook(self.name, list(args))
+        return self.f(*args, **self.kwargs)
 
 
 def _cell_summary(f):
@@ -181,7 +188,20 @@ class StagingScope:
 
     # -- staging ------------------------------------------------------------
     def stage(self, f, inputs, name, static_kwargs):
+        from . import core as _core
         from .core import Tensor, _GRAD_ENABLED
+        # per-op hooks still apply in staged mode: the op observer fires at
+        # stage time (same count as eager), and the CURRENT amp cast hook
+        # is captured per node so replay applies O1/O2 casts per op inside
+        # the compiled segment (review r4: staged mode silently dropped AMP)
+        amp_hook = _core._amp_cast_hook
+        if _core._op_observer_hook is not None:
+            try:
+                _core._op_observer_hook(
+                    name or getattr(f, "__name__", "op"),
+                    [x._data for x in inputs if isinstance(x, Tensor)])
+            except Exception:
+                pass
         parents = []
         avals = []
         any_diff = False
@@ -204,7 +224,9 @@ class StagingScope:
                 avals.append(x)
         node = StagedNode(f, dict(static_kwargs), name or
                           getattr(f, "__name__", "op"), parents)
-        out_aval = jax.eval_shape(lambda *a: f(*a, **node.kwargs), *avals)
+        node.amp_hook = amp_hook
+        fwd = node.run  # applies the captured amp cast, then f
+        out_aval = jax.eval_shape(lambda *a: fwd(a), *avals)
         flat_avals, treedef = jax.tree_util.tree_flatten(out_aval)
         node.out_treedef = treedef
         outs = []
@@ -245,6 +267,7 @@ class StagingScope:
                     pdesc.append(("const", repr(v)[:80]))
             parts.append((node.name, getattr(node.f, "__code__", id(node.f)),
                           _cell_summary(node.f), _kw_summary(node.kwargs),
+                          None if node.amp_hook is None else id(node.amp_hook),
                           tuple(pdesc),
                           tuple((tuple(b.aval.shape), str(b.aval.dtype))
                                 for b in node.out_boxes)))
@@ -279,7 +302,7 @@ class StagingScope:
         # lightweight spec — never over Tensors or result arrays (review
         # r4: caching (replay, nodes) pinned a whole call's activations
         # for the StaticFunction's lifetime)
-        spec = []   # per node: (f, kwargs, [("env",slot)|("leaf",i)|("const",v)], out_slots)
+        spec = []   # per node: (run, [("env",slot)|("leaf",i)|("const",v)], out_slots)
         for node in nodes:
             pdesc = []
             for p in node.parents:
@@ -289,7 +312,7 @@ class StagingScope:
                     pdesc.append(("leaf", leaf_ids[id(p[1])]))
                 else:
                     pdesc.append(("const", p[1]))
-            spec.append((node.f, node.kwargs, pdesc,
+            spec.append((node.run, pdesc,
                          [box_slot[id(b)] for b in node.out_boxes]))
         n_boxes = len(all_boxes)
 
@@ -297,11 +320,11 @@ class StagingScope:
             # a box parent always belongs to THIS segment: flush drains all
             # pending nodes, so anything staged later sees only real data
             env: dict[int, Any] = {}
-            for f, kwargs, pdesc, out_slots in spec:
+            for run, pdesc, out_slots in spec:
                 args = [env[v] if kind == "env"
                         else leaf_arrays[v] if kind == "leaf" else v
                         for kind, v in pdesc]
-                out = f(*args, **kwargs)
+                out = run(args)   # per-op AMP cast + f
                 for slot, arr in zip(out_slots,
                                      jax.tree_util.tree_leaves(out)):
                     env[slot] = arr

@@ -107,12 +107,7 @@ class StaticFunction:
             # known graph break: staged mode — ops accumulate in a deferred
             # DAG and each segment between breaks compiles as ONE XLA
             # computation (the SOT partial-graph analog; framework/staging.py)
-            scope = _core._staging.StagingScope(
-                jit_cache=self._staged_jit_cache)
-            with scope:
-                out = self._fn(*args, **kwargs)
-            self._last_segments = scope.segments
-            return out
+            return self._run_staged(args, kwargs)
         entry = self._cache.get(key)
         if entry is None:
             try:
@@ -135,12 +130,7 @@ class StaticFunction:
                     f"full_graph=True to make this an error.\n"
                     f"  cause: {e}", RuntimeWarning, stacklevel=2)
                 self._fallback_keys.add(key)
-                scope = _core._staging.StagingScope(
-                    jit_cache=self._staged_jit_cache)
-                with scope:
-                    out = self._fn(*args, **kwargs)
-                self._last_segments = scope.segments
-                return out
+                return self._run_staged(args, kwargs)
             self._cache[key] = entry
         jitted, out_rebuild, mutated = entry
 
@@ -166,6 +156,14 @@ class StaticFunction:
             # buffer updates are state, not autograd outputs
             new._node = None
         return out_rebuild(user_out)
+
+    def _run_staged(self, args, kwargs):
+        """Run the function in staged mode (graph-break path)."""
+        scope = _core._staging.StagingScope(jit_cache=self._staged_jit_cache)
+        with scope:
+            out = self._fn(*args, **kwargs)
+        self._last_segments = scope.segments
+        return out
 
     def _trace(self, treedef, flat_args, tensor_idx, params, bufs):
         """Build + jit the pure function. Runs the python body exactly once

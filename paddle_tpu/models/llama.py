@@ -75,20 +75,11 @@ class LlamaAttention(nn.Layer):
             k = concat([cache[0], k], axis=1)
             v = concat([cache[1], v], axis=1)
             cache = (k, v)
-        if self.num_kv_heads != self.num_heads:
-            # GQA: expand kv heads by broadcast (XLA keeps this free)
-            rep = self.num_heads // self.num_kv_heads
-            from ..framework.core import execute
-            import jax.numpy as jnp
-
-            def expand(a):
-                bs, sk, hkv, d = a.shape
-                return jnp.broadcast_to(
-                    a[:, :, :, None, :], (bs, sk, hkv, rep, d)
-                ).reshape(bs, sk, hkv * rep, d)
-
-            k = execute(expand, k, _name="gqa_expand")
-            v = execute(expand, v, _name="gqa_expand")
+        # GQA kv stays UNEXPANDED: scaled_dot_product_attention groups
+        # query heads onto shared KV natively (Pallas BlockSpec index map;
+        # the dense path expands inside its traced fn) — so the KV cache
+        # above also stays at num_kv_heads, cutting decode cache memory by
+        # num_heads/num_kv_heads.
         # always causal (decoder LM): a user-supplied mask (e.g. padding) is
         # combined with, not substituted for, the causal structure
         out = F.scaled_dot_product_attention(

@@ -77,6 +77,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         from ...ops.pallas.flash_attention import flash_attention_bshd
         args = [query, key, value]
         def f(q, k, v):
+            # GQA-native: unexpanded kv heads go straight to the kernel
             return flash_attention_bshd(q, k, v, causal=is_causal)
         return execute(f, *args, _name="flash_attention_pallas")
 
@@ -84,6 +85,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
     def f(q, k, v, *rest):
         bias = rest[0] if rest else None
+        h, kvh = q.shape[2], k.shape[2]
+        if kvh != h:  # GQA on the dense path: expand inside the traced fn
+            rep = h // kvh
+            def expand(a):
+                bs, sk, _, d = a.shape
+                return jnp.broadcast_to(
+                    a[:, :, :, None, :], (bs, sk, kvh, rep, d)
+                ).reshape(bs, sk, h, d)
+            k, v = expand(k), expand(v)
         return _xla_attention(q, k, v, bias=bias, causal=is_causal,
                               dropout_p=dropout_p if training else 0.0,
                               dropout_key=dropout_key)

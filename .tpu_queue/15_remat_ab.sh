@@ -1,0 +1,7 @@
+# A/B the remat policy on the 1.3b config: full remat vs dots-saveable.
+# If dots fits HBM and wins, flip the ladder default next round.
+cd /root/repo
+echo "=== remat A/B: config 0 (1.3b) full remat"
+python bench.py --worker --config 0 2>/dev/null | tail -1
+echo "=== remat A/B: config 0 (1.3b) remat_policy=dots"
+python bench.py --worker --config 0 --remat-policy dots 2>/dev/null | tail -1

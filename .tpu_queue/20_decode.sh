@@ -1,0 +1,1 @@
+cd /root/repo && python bench.py --worker --secondary decode > .decode_tpu.json 2> .decode_tpu.err; tail -1 .decode_tpu.json

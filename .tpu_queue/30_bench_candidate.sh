@@ -1,0 +1,1 @@
+cd /root/repo && python bench.py > .bench_r05_candidate.json 2> .bench_r05_candidate.err; tail -1 .bench_r05_candidate.json

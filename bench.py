@@ -72,7 +72,7 @@ def _llama_ladder():
     ]
 
 
-def _run_one(cfg, batch, seq, steps, remat, on_tpu):
+def _run_one(cfg, batch, seq, steps, remat, on_tpu, remat_policy=None):
     """One config: scan-over-layers train step (HLO size O(1) in depth, so
     the compile helper sees one layer body instead of an unrolled stack)."""
     import jax
@@ -87,7 +87,8 @@ def _run_one(cfg, batch, seq, steps, remat, on_tpu):
     model = LlamaForCausalLM(cfg)
     n_params = model.num_params()
     params, loss_fn = build_scanned_llama(
-        model, remat=remat, dtype="bfloat16" if on_tpu else None)
+        model, remat=remat, dtype="bfloat16" if on_tpu else None,
+        remat_policy=remat_policy)
     opt = optimizer.AdamW(3e-4, parameters=model.parameters())
     opt_state = opt.tree_init(params)
     # the scanned params are fresh (stacked, cast) copies; free the
@@ -273,15 +274,92 @@ def _bench_bert(on_tpu):
     return out
 
 
+def _bench_decode(on_tpu):
+    """Serving decode: compiled KV-cache generate() tokens/s, bf16 and
+    weight-only int8 (reference capability:
+    paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import generation
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=8,
+                          num_attention_heads=16,
+                          max_position_embeddings=2048, dtype="bfloat16")
+        batch, prompt, new = 8, 128, 128
+    else:
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                          intermediate_size=256, num_hidden_layers=2,
+                          num_attention_heads=4, max_position_embeddings=256)
+        batch, prompt, new = 2, 16, 8
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:  # serve in bf16
+        for t in model.state_dict().values():
+            if t._data.dtype == jnp.float32:
+                t._data = t._data.astype(jnp.bfloat16)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, prompt)),
+                      jnp.int32)
+    out = {"decode_batch": batch, "decode_prompt": prompt,
+           "decode_new_tokens": new,
+           "decode_params": model.num_params()}
+
+    # prefill-only program vs full program isolates per-token decode cost
+    r1 = generation.generate(model, ids, max_new_tokens=1)   # compile
+    rn = generation.generate(model, ids, max_new_tokens=new)  # compile
+    _ = np.asarray(rn._data)
+    t0 = time.perf_counter()
+    for _i in range(3):
+        _ = np.asarray(generation.generate(model, ids, max_new_tokens=1)._data)
+    prefill_s = (time.perf_counter() - t0) / 3
+    t0 = time.perf_counter()
+    for _i in range(3):
+        _ = np.asarray(
+            generation.generate(model, ids, max_new_tokens=new)._data)
+    full_s = (time.perf_counter() - t0) / 3
+    per_tok = max(full_s - prefill_s, 1e-9) / (new - 1)
+    out["decode_prefill_ms"] = round(prefill_s * 1e3, 2)
+    out["decode_per_token_ms"] = round(per_tok * 1e3, 3)
+    out["decode_tokens_per_s"] = round(batch / per_tok, 1)
+    del r1, rn
+
+    # weight-only int8 serving path (its OWN prefill baseline — the bf16
+    # prefill time would make the subtraction noise on small configs)
+    wog1 = generation.WeightOnlyGenerator(model, max_new_tokens=1)
+    wog = generation.WeightOnlyGenerator(model, max_new_tokens=new,
+                                         share_weights_from=wog1)
+    _ = np.asarray(wog1.generate(ids)._data)  # compile
+    _ = np.asarray(wog.generate(ids)._data)   # compile
+    t0 = time.perf_counter()
+    for _i in range(3):
+        _ = np.asarray(wog1.generate(ids)._data)
+    q_prefill_s = (time.perf_counter() - t0) / 3
+    t0 = time.perf_counter()
+    for _i in range(3):
+        _ = np.asarray(wog.generate(ids)._data)
+    q_full_s = (time.perf_counter() - t0) / 3
+    q_per_tok = max(q_full_s - q_prefill_s, 1e-9) / (new - 1)
+    out["decode_int8_per_token_ms"] = round(q_per_tok * 1e3, 3)
+    out["decode_int8_tokens_per_s"] = round(batch / q_per_tok, 1)
+    out["decode_int8_weight_mb"] = round(wog.quantized_bytes() / 2**20, 1)
+    return out
+
+
 def secondary_worker(force_cpu: bool, which: str):
-    """ResNet/BERT secondary metrics (BASELINE rows 2-3) as their own
-    bounded subprocess so a hang can't eat the llama budget."""
+    """ResNet/BERT/decode secondary metrics (BASELINE rows 2-3 + serving)
+    as their own bounded subprocess so a hang can't eat the llama budget."""
     import jax
     if force_cpu:
         jax.config.update("jax_platforms", "cpu")
     on_tpu = jax.devices()[0].platform != "cpu"
     detail = {"device": str(jax.devices()[0])}
-    benches = [("resnet", _bench_resnet), ("bert", _bench_bert)]
+    benches = [("resnet", _bench_resnet), ("bert", _bench_bert),
+               ("decode", _bench_decode)]
     for name, fn in benches:
         if which not in (name, "both"):
             continue
@@ -343,6 +421,9 @@ def worker(force_cpu: bool, only_config: int | None = None):
                           num_attention_heads=4, max_position_embeddings=256)
         ladder = [("llama_tiny_cpu", cfg, 2, 128, 3, False)]
 
+    remat_policy = None
+    if "--remat-policy" in sys.argv:
+        remat_policy = sys.argv[sys.argv.index("--remat-policy") + 1]
     errors = []      # configs that failed outright (walked past)
     transient = []   # first-try failures that succeeded on retry
     for name, cfg, batch, seq, steps, remat in ladder:
@@ -350,7 +431,8 @@ def worker(force_cpu: bool, only_config: int | None = None):
         attempts = []
         for attempt in range(2):  # retry once: transient compile-helper 500s
             try:
-                r = _run_one(cfg, batch, seq, steps, remat, on_tpu)
+                r = _run_one(cfg, batch, seq, steps, remat, on_tpu,
+                             remat_policy=remat_policy)
                 break
             except Exception as e:
                 msg = f"{name}[try{attempt}]: {type(e).__name__}: {str(e)[:200]}"
@@ -369,9 +451,18 @@ def worker(force_cpu: bool, only_config: int | None = None):
                            12.0 * cfg.num_hidden_layers * cfg.hidden_size * seq)
         achieved = flops_per_token * tok_per_s
         peak = detect_peak()
+        # which attention implementation this config actually ran (weak #3
+        # r4: the ladder conflated flash and dense rows without labeling) —
+        # computed from the REAL selection predicate, not re-derived rules
+        from paddle_tpu.nn.functional.attention import _use_pallas
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        attn_backend = ("pallas_flash" if _use_pallas(
+            (batch, seq, cfg.num_attention_heads, hd), hd, False)
+            else "xla_dense")
         detail = {"config": name, "tokens_per_s": round(tok_per_s, 1),
                   "params": n_params, "loss": round(r["loss"], 4),
                   "batch": batch, "seq": seq, "remat": remat,
+                  "attention_backend": attn_backend,
                   "device": str(jax.devices()[0])}
         if errors:
             detail["skipped_configs"] = errors
@@ -456,20 +547,24 @@ def main():
         return worker(force_cpu="--cpu" in sys.argv, only_config=cfg)
 
     errors = []
-    # fast liveness probe first: when the TPU tunnel is down, every config
-    # would burn its full timeout — detect that in minutes instead
+    # liveness probe first: when the TPU tunnel is down, every config would
+    # burn its full timeout — detect that up front. Wedge discipline (r4
+    # post-mortem): a KILLED worker wedges the tunnel for 10-60+ min, so
+    # probes get a LONG window (900s — enough to ride out a wedge) and a
+    # long backoff after any kill. Never the r4 pattern of 300s kills on a
+    # 60-120s cadence, which can hold the tunnel wedged indefinitely.
     tpu_alive = False
-    for i in range(3):
-        result, err = _attempt(["--probe"], 300)
+    for i in range(2):
+        result, err = _attempt(["--probe"], 900)
         if result is not None:
             tpu_alive = result.get("unit") == "tpu_alive"
             break
         errors.append(f"probe{i}: {err}")
-        # a wedged device lease (killed worker still holding the chip)
-        # expires on a minutes scale — wait longer each round, but don't
-        # sleep after the final failure (the CPU fallback needs no TPU)
-        if i < 2:
-            time.sleep(60 * (i + 1))
+        if i < 1:
+            # the 900s TimeoutExpired above killed a dialing worker: back
+            # off a full wedge window before touching the tunnel again;
+            # a clean non-TPU answer (no kill) needs no such pause
+            time.sleep(900 if "timeout" in str(err) else 120)
 
     # one subprocess PER ladder config so a slow/hung compile on a big
     # config can't eat the whole budget before smaller configs get a turn
@@ -497,15 +592,18 @@ def main():
                 ladder_log[cfg_id] = {"error": err}
                 errors.append(f"config{cfg_id}: {err}")
                 # keep climbing: a bigger config can still succeed from a
-                # warm cache even if this one timed out cold
-                time.sleep(20)   # let a killed worker's device lease lapse
+                # warm cache even if this one timed out cold. The timeout
+                # above killed a worker — give its device lease a real
+                # window to lapse before the next dial (r4 post-mortem)
+                time.sleep(180)
     if best is not None:
         result = best
         if errors:
             result.setdefault("detail", {})["attempt_errors"] = errors
         result.setdefault("detail", {})["ladder"] = ladder_log
         sec_plan = [(["--secondary", "resnet"], 720),
-                    (["--secondary", "bert"], 720)]
+                    (["--secondary", "bert"], 720),
+                    (["--secondary", "decode"], 900)]
         secondary = {}
         tpu_sec_failed = False
         for sargs, st in sec_plan:

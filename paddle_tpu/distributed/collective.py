@@ -15,6 +15,8 @@ name so the same Python call sites work in both modes.
 
 from __future__ import annotations
 
+import json
+
 import numpy as np
 
 import jax
@@ -209,6 +211,15 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
             full, _name="reduce_scatter")
         tensor._rebind(out)
         return _Task()
+    if _is_multiprocess_world(group) and not _in_shardmap(tensor_list[0]._data):
+        # host-level: allgather each rank's (world, ...) stack, reduce over
+        # the rank axis, keep this rank's chunk — the eager gloo analog of
+        # ncclReduceScatter
+        stack = jnp.stack([t._data for t in tensor_list])
+        red = _reduce_stacked(_process_allgather(stack), op)  # (world, ...)
+        tensor._rebind(Tensor(jnp.asarray(red[jax.process_index()]),
+                              stop_gradient=tensor.stop_gradient))
+        return _Task()
     _require_trivial_world(group, "reduce_scatter")
     tensor._rebind(tensor_list[0])
     return _Task()
@@ -242,6 +253,25 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if _is_multiprocess_world(group) and not _in_shardmap(tensor._data):
+        # host-level: only src's list matters; ship it to everyone and keep
+        # this rank's element (broadcast_one_to_all wants equal shapes on
+        # every rank, so non-src ranks contribute a zero stack)
+        from jax.experimental import multihost_utils
+        me = jax.process_index()
+        if me == src:
+            if not tensor_list:
+                raise ValueError("scatter: src rank needs tensor_list")
+            stack = jnp.stack([t._data for t in tensor_list])
+        else:
+            n = jax.process_count()
+            stack = jnp.zeros((n,) + tuple(tensor._data.shape),
+                              tensor._data.dtype)
+        out = multihost_utils.broadcast_one_to_all(stack,
+                                                   is_source=me == src)
+        tensor._rebind(Tensor(jnp.asarray(out[me]),
+                              stop_gradient=tensor.stop_gradient))
+        return _Task()
     _require_trivial_world(group, "scatter")
     if tensor_list:
         tensor._rebind(tensor_list[0])
@@ -249,22 +279,78 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    if _is_multiprocess_world(group) and not _in_shardmap(tensor._data):
+        g = _process_allgather(tensor._data)  # (world, ...)
+        if gather_list is not None and jax.process_index() == dst:
+            gather_list.extend(Tensor(jnp.asarray(g[i]), stop_gradient=True)
+                               for i in range(g.shape[0]))
+        return _Task()
     _require_trivial_world(group, "gather")
     if gather_list is not None:
         gather_list.append(tensor)
     return _Task()
 
 
+#: per-(src, dst) sequence counters for store-backed p2p: both endpoints
+#: increment their own view per call, so matching send/recv pairs agree on
+#: the key without any extra round trip
+_P2P_SEQ: dict = {}
+
+
+def _p2p_store():
+    from . import parallel_env
+    store = parallel_env.get_store()
+    if store is None:
+        raise RuntimeError(
+            "eager send/recv needs the multi-process TCPStore "
+            "(init_parallel_env with a PADDLE_MASTER rendezvous)")
+    return store
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "eager p2p send/recv map to lax.ppermute inside compiled pipeline "
-        "schedules on TPU (see distributed.fleet.meta_parallel.pipeline)")
+    """Host-level p2p over the native TCPStore (the eager gloo-send analog;
+    reference: ProcessGroup::Send). Inside compiled pipeline schedules p2p
+    is lax.ppermute — this path serves eager control-plane transfers."""
+    if jax.process_count() <= 1:
+        raise NotImplementedError(
+            "eager send/recv is cross-process only; in-process pipelines "
+            "use lax.ppermute (distributed.fleet.meta_parallel.pipeline)")
+    store = _p2p_store()
+    me = jax.process_index()
+    seq = _P2P_SEQ.setdefault((me, dst), 0)
+    _P2P_SEQ[(me, dst)] = seq + 1
+    arr = np.asarray(tensor._data)
+    header = json.dumps({"dtype": str(arr.dtype),
+                         "shape": list(arr.shape)}).encode()
+    store.set(f"__p2p/{me}->{dst}/{seq}",
+              len(header).to_bytes(4, "big") + header + arr.tobytes())
+    return _Task()
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "eager p2p send/recv map to lax.ppermute inside compiled pipeline "
-        "schedules on TPU (see distributed.fleet.meta_parallel.pipeline)")
+    if jax.process_count() <= 1:
+        raise NotImplementedError(
+            "eager send/recv is cross-process only; in-process pipelines "
+            "use lax.ppermute (distributed.fleet.meta_parallel.pipeline)")
+    store = _p2p_store()
+    me = jax.process_index()
+    seq = _P2P_SEQ.setdefault((src, me), 0)
+    _P2P_SEQ[(src, me)] = seq + 1
+    key = f"__p2p/{src}->{me}/{seq}"
+    store.wait(key)
+    raw = store.get(key)
+    hlen = int.from_bytes(raw[:4], "big")
+    header = json.loads(raw[4:4 + hlen].decode())
+    arr = np.frombuffer(raw[4 + hlen:],
+                        dtype=np.dtype(header["dtype"])).reshape(
+        header["shape"])
+    tensor._rebind(Tensor(jnp.asarray(arr),
+                          stop_gradient=tensor.stop_gradient))
+    try:
+        store.delete_key(key)  # one-shot mailbox: don't grow the store
+    except Exception:  # noqa: BLE001 — older store without delete
+        pass
+    return _Task()
 
 
 def isend(tensor, dst=0, group=None):
@@ -284,8 +370,25 @@ class P2POp:
 
 
 def batch_isend_irecv(p2p_op_list):
-    raise NotImplementedError(
-        "batched p2p maps to lax.ppermute in compiled pipeline schedules")
+    """Issue a batch of P2POps (reference:
+    python/paddle/distributed/communication/batch_isend_irecv.py). The
+    store-backed transport is asynchronous on the send side, so posting
+    all sends before any recv keeps the usual exchange patterns
+    deadlock-free on two-sided schedules."""
+    if jax.process_count() <= 1:
+        raise NotImplementedError(
+            "batched p2p is cross-process only; compiled pipeline "
+            "schedules use lax.ppermute")
+    tasks = []
+    sends = [p for p in p2p_op_list if p.op in (send, isend)]
+    recvs = [p for p in p2p_op_list if p.op in (recv, irecv)]
+    if len(sends) + len(recvs) != len(p2p_op_list):
+        raise ValueError("P2POp.op must be send/isend/recv/irecv")
+    for p in sends:
+        tasks.append(send(p.tensor, p.peer, p.group))
+    for p in recvs:
+        tasks.append(recv(p.tensor, p.peer, p.group))
+    return tasks
 
 
 def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
@@ -293,14 +396,9 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
     raise NotImplementedError("use fleet.meta_parallel TP layers")
 
 
-class stream:
-    """paddle.distributed.communication.stream parity — same ops, sync."""
-
-    all_reduce = staticmethod(all_reduce)
-    all_gather = staticmethod(all_gather)
-    all_to_all = staticmethod(all_to_all)
-    reduce_scatter = staticmethod(reduce_scatter)
-    broadcast = staticmethod(broadcast)
-    reduce = staticmethod(reduce)
-    scatter = staticmethod(scatter)
-    gather = staticmethod(gather)
+# paddle.distributed.stream IS communication.stream (reference:
+# python/paddle/distributed/communication/stream/) — the module carries the
+# Tensor flavor (one pre-sized tensor = nranks chunks); a plain alias to
+# the functions above would silently iterate a Tensor input into 0-d
+# scalars. Imported at the bottom: stream.py imports this module back.
+from .communication import stream  # noqa: E402,F401

@@ -428,8 +428,26 @@ def _node_backward_recorded(node, cot_tensors):
         _, vjp_fn = jax.vjp(fwd, *primals)
         return tuple(vjp_fn(jax.tree_util.tree_unflatten(treedef, list(cots))))
 
-    out = execute(grad_op, *node.inputs, *cot_tensors,
-                  _name=node.name + "_grad")
+    try:
+        out = execute(grad_op, *node.inputs, *cot_tensors,
+                      _name=node.name + "_grad")
+    except Exception as e:
+        msg = str(e)
+        import traceback as _tb
+        tb_text = "".join(_tb.format_exception(type(e), e, e.__traceback__))
+        if "custom_vjp" in msg or "custom_jvp" in msg \
+                or "pallas" in tb_text.lower():
+            # the recorded forward contains a kernel whose backward is not
+            # itself differentiable (e.g. a raw pallas_call custom_vjp) and
+            # no dense _ho_fwd was registered for it
+            raise RuntimeError(
+                f"create_graph=True through '{node.name}': this op's "
+                f"backward is not re-differentiable "
+                f"({type(e).__name__}: {msg[:240]}). Re-run the forward on "
+                "the op's dense/XLA fallback for higher-order gradients — "
+                "for attention, set FLAGS_flash_attention_backend=xla."
+            ) from e
+        raise
     return out if isinstance(out, (list, tuple)) else (out,)
 
 
@@ -506,7 +524,8 @@ def _maybe_check_nan(out, name):
     return out
 
 
-def execute(f: Callable, *inputs, _name: str = None, **static_kwargs):
+def execute(f: Callable, *inputs, _name: str = None, _ho_fwd: Callable = None,
+            **static_kwargs):
     """Run pure jax function `f(*arrays, **static_kwargs)`, recording a vjp
     Node if any Tensor input requires grad.
 
@@ -554,12 +573,15 @@ def execute(f: Callable, *inputs, _name: str = None, **static_kwargs):
 
     const = list(arrs)
 
-    def g(*diff_arrs):
-        full = list(const)
-        for i, a in zip(diff_idx, diff_arrs):
-            full[i] = a
-        return f(*full, **static_kwargs)
+    def _close_over_consts(fn):
+        def g(*diff_arrs):
+            full = list(const)
+            for i, a in zip(diff_idx, diff_arrs):
+                full[i] = a
+            return fn(*full, **static_kwargs)
+        return g
 
+    g = _close_over_consts(f)
     diff_arrs = [arrs[i] for i in diff_idx]
     out, vjp_fn = jax.vjp(g, *diff_arrs)
     _maybe_check_nan(out, _name or getattr(f, "__name__", "op"))
@@ -577,7 +599,12 @@ def execute(f: Callable, *inputs, _name: str = None, **static_kwargs):
         out_tensors,
         treedef,
     )
-    node.fwd_fn = g  # create_graph: re-derivable vjp over the same consts
+    # create_graph: re-derivable vjp over the same consts. An op whose
+    # primal path uses a custom_vjp Pallas kernel (not differentiable past
+    # first order) may hand a mathematically-equal dense `_ho_fwd`; the
+    # recorded forward is then the dense one, so higher-order grads work
+    # while the first-order path keeps the fast kernel.
+    node.fwd_fn = g if _ho_fwd is None else _close_over_consts(_ho_fwd)
     # pre-cast originals (mutation detection) + post-cast trace dtypes
     node.in_arrays = [inputs[i]._data for i in diff_idx]
     node.in_dtypes = [a.dtype for a in diff_arrs]

@@ -22,7 +22,8 @@ from ..parallel.functional import (functional_call, rmsnorm_lm_loss,
 __all__ = ["build_scanned_llama"]
 
 
-def build_scanned_llama(model, remat: bool = True, dtype=None):
+def build_scanned_llama(model, remat: bool = True, dtype=None,
+                        remat_policy: str | None = None):
     """Split a LlamaForCausalLM's state into (embed, stacked layers, head)
     and return (params, loss_fn) where loss_fn(params, ids, labels) is a
     pure scalar LM loss whose decoder stack is one lax.scan.
@@ -55,7 +56,26 @@ def build_scanned_llama(model, remat: bool = True, dtype=None):
         h = functional_call(template, lp, h)
         return h, None
 
-    body = jax.checkpoint(layer_body) if remat else layer_body
+    if remat:
+        if remat_policy is None:
+            body = jax.checkpoint(layer_body)
+        else:
+            # named XLA remat policy: 'dots' keeps matmul outputs and
+            # recomputes only the cheap elementwise pieces in the backward —
+            # full remat re-runs the layer's MXU work, which on TPU costs
+            # far more than the HBM it saves at moderate depth
+            policies = {
+                "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                "nothing": jax.checkpoint_policies.nothing_saveable,
+                "everything": jax.checkpoint_policies.everything_saveable,
+            }
+            if remat_policy not in policies:
+                raise ValueError(
+                    f"remat_policy={remat_policy!r}; pick from "
+                    f"{sorted(policies)}")
+            body = jax.checkpoint(layer_body, policy=policies[remat_policy])
+    else:
+        body = layer_body
 
     def loss_fn(p, ids, labels):
         h = jnp.take(p["embed"]["weight"], ids, axis=0)

@@ -45,6 +45,23 @@ def _xla_attention(q, k, v, bias=None, causal=False, scale=None, dropout_p=0.0,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _expand_kv(k, v, num_heads):
+    """GQA: broadcast kv heads up to num_heads for the dense path (the
+    Pallas kernel consumes the unexpanded heads natively)."""
+    kvh = k.shape[2]
+    if kvh == num_heads:
+        return k, v
+    rep = num_heads // kvh
+
+    def expand(a):
+        bs, sk, _, d = a.shape
+        return jnp.broadcast_to(
+            a[:, :, :, None, :], (bs, sk, kvh, rep, d)
+        ).reshape(bs, sk, num_heads, d)
+
+    return expand(k), expand(v)
+
+
 def _use_pallas(q_shape, head_dim, has_bias):
     if has_bias:
         # the pallas kernel takes no bias/mask — never select it silently
@@ -60,9 +77,14 @@ def _use_pallas(q_shape, head_dim, has_bias):
         return False
     if backend == "pallas":
         return True
-    # auto: long sequence + MXU-friendly head dim
+    # auto: long sequence + MXU-friendly head dim. Non-lane-aligned head
+    # dims are zero-padded by the kernel (96 -> 128, the llama_780m
+    # shape): the pad costs 128/96 extra MXU work, so it needs a longer
+    # sequence before the O(S^2) HBM win pays for it.
     seq = q_shape[1]
-    return seq >= 1024 and head_dim % 128 == 0
+    if head_dim % 128 == 0:
+        return seq >= 1024
+    return head_dim >= 96 and seq >= 2048
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
@@ -79,21 +101,24 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         def f(q, k, v):
             # GQA-native: unexpanded kv heads go straight to the kernel
             return flash_attention_bshd(q, k, v, causal=is_causal)
-        return execute(f, *args, _name="flash_attention_pallas")
+
+        def f_dense(q, k, v):
+            # mathematically-equal dense recompute, differentiable at any
+            # order — recorded as the node's higher-order forward so
+            # create_graph=True works through the flash path (the Pallas
+            # bwd kernels are custom_vjp and stop at first order)
+            k, v = _expand_kv(k, v, q.shape[2])
+            return _xla_attention(q, k, v, causal=is_causal)
+
+        return execute(f, *args, _name="flash_attention_pallas",
+                       _ho_fwd=f_dense)
 
     args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
 
     def f(q, k, v, *rest):
         bias = rest[0] if rest else None
-        h, kvh = q.shape[2], k.shape[2]
-        if kvh != h:  # GQA on the dense path: expand inside the traced fn
-            rep = h // kvh
-            def expand(a):
-                bs, sk, _, d = a.shape
-                return jnp.broadcast_to(
-                    a[:, :, :, None, :], (bs, sk, kvh, rep, d)
-                ).reshape(bs, sk, h, d)
-            k, v = expand(k), expand(v)
+        # GQA on the dense path: expand inside the traced fn
+        k, v = _expand_kv(k, v, q.shape[2])
         return _xla_attention(q, k, v, bias=bias, causal=is_causal,
                               dropout_p=dropout_p if training else 0.0,
                               dropout_key=dropout_key)

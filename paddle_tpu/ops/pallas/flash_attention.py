@@ -501,8 +501,20 @@ def flash_attention_bshd(q, k, v, causal=False, scale=None):
         raise ValueError(f"num_heads {h} not divisible by kv heads {kvh}")
     if scale is None:
         scale = 1.0 / (d ** 0.5)
-    qt = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
-    kt = jnp.swapaxes(k, 1, 2).reshape(b * kvh, sk, d)
-    vt = jnp.swapaxes(v, 1, 2).reshape(b * kvh, sk, d)
+    # lane-align the head dim (e.g. 96 -> 128, the llama_780m shape): zero
+    # pad columns change neither QK^T nor PV, their grads come back zero,
+    # and `scale` is already fixed from the TRUE d above. Costs d_pad/d
+    # extra MXU work — cheaper than losing the O(S^2) HBM win at long seq.
+    d_pad = (-d) % _LANES
+    if d_pad:
+        padw = ((0, 0), (0, 0), (0, 0), (0, d_pad))
+        q = jnp.pad(q, padw)
+        k = jnp.pad(k, padw)
+        v = jnp.pad(v, padw)
+    dp = d + d_pad
+    qt = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, dp)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * kvh, sk, dp)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * kvh, sk, dp)
     out = _flash_attention_bhsd(qt, kt, vt, causal, scale, h // kvh)
-    return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
+    out = jnp.swapaxes(out.reshape(b, h, sq, dp), 1, 2)
+    return out[..., :d] if d_pad else out

@@ -241,68 +241,120 @@ def pipeline_1f1b_grads(embed_fn, stage_fn, head_loss_fn, embed_params,
         demb=_pvary(zeros_like_tree(embed_params), vary),
         dstage=_pvary(zeros_like_tree(local_params), vary),
         dhead=_pvary(zeros_like_tree(head_params), vary),
+        dh0=_pvary(jnp.zeros((m,) + h_shape.shape, h_shape.dtype), vary),
         loss=_pvary(jnp.zeros((), jnp.float32), vary),
     )
 
-    t_total = m + 2 * (p - 1)
     inv_m = jnp.float32(1.0 / m)
 
-    def tick(carry, t):
+    # The schedule runs as THREE scans over one parameterized tick body —
+    # fill (fwd only), steady (fwd+bwd+head), drain (bwd only). A single
+    # scan over all t would execute the head fwd+bwd and the stage vjp on
+    # every tick including fill/drain (masked => still computed in SPMD);
+    # phase-splitting drops the head to exactly M executions (its minimum
+    # for this design: the last stage's backward of microbatch j happens
+    # the tick after its forward, so it cannot batch outside the scan) and
+    # removes the stage vjp/fwd from ticks where no stage can need it.
+    # Phase boundaries are stage-independent: the earliest backward
+    # anywhere is t = 2(P-1)-(P-1) = P-1 (last stage), the last forward
+    # anywhere ends at t = (P-1)+M (stage P-1), and the last stage's own
+    # backwards — the only ones needing the head — all land in
+    # [P-1, M+P-1).
+    def tick(carry, t, do_fwd, do_bwd, do_head):
+        buf = carry["buf"]
         # ---- forward part: microbatch i at stage s when t == s + i -------
-        i_f = t - my_stage
-        f_active = (i_f >= 0) & (i_f < m)
-        tok_i = tokens_mb[jnp.clip(i_f, 0, m - 1)]
-        h_embed = embed_fn(embed_params, tok_i)
-        h_in = jnp.where(my_stage == 0, _pvary(h_embed, vary), carry["recv_f"])
-        h_in = jnp.where(f_active, h_in, jnp.zeros_like(h_in))
-        slot_f = jnp.mod(i_f, k)
-        buf = carry["buf"].at[slot_f].set(
-            jnp.where(f_active, h_in, carry["buf"][slot_f]))
-        h_out = stage_fn(local_params, h_in)
-        h_out = jnp.where(f_active, h_out, jnp.zeros_like(h_out))
-        send_f = jax.lax.ppermute(h_out, axis_name, perm_fwd)
+        if do_fwd:
+            i_f = t - my_stage
+            f_active = (i_f >= 0) & (i_f < m)
+            tok_i = tokens_mb[jnp.clip(i_f, 0, m - 1)]
+            h_embed = embed_fn(embed_params, tok_i)
+            h_in = jnp.where(my_stage == 0, _pvary(h_embed, vary),
+                             carry["recv_f"])
+            h_in = jnp.where(f_active, h_in, jnp.zeros_like(h_in))
+            slot_f = jnp.mod(i_f, k)
+            buf = buf.at[slot_f].set(
+                jnp.where(f_active, h_in, buf[slot_f]))
+            h_out = stage_fn(local_params, h_in)
+            h_out = jnp.where(f_active, h_out, jnp.zeros_like(h_out))
+            send_f = jax.lax.ppermute(h_out, axis_name, perm_fwd)
+        else:
+            send_f = carry["recv_f"]
+
+        if not do_bwd:
+            out = dict(carry)
+            out.update(recv_f=send_f, buf=buf)
+            return out, None
 
         # ---- backward part: microbatch j when t == 2(P-1) - s + j --------
         j = t - 2 * (p - 1) + my_stage
         b_active = (j >= 0) & (j < m)
         h_saved = buf[jnp.mod(j, k)]
-        tok_j = tokens_mb[jnp.clip(j, 0, m - 1)]
-        lab_j = labels_mb[jnp.clip(j, 0, m - 1)]
-        is_last = my_stage == p - 1
-
-        (h_out_b, loss_j), pull = jax.vjp(
-            lambda sp, hp, ep, h: fwd_and_loss(sp, hp, ep, h, lab_j),
-            local_params, head_params, embed_params, h_saved)
-        # cotangent seed: last stage seeds from its own loss, others from
-        # the cotangent received from stage s+1
-        seed_h = jnp.where(is_last, jnp.zeros_like(carry["recv_b"]),
-                           carry["recv_b"])
-        seed_h = jnp.where(b_active, seed_h, jnp.zeros_like(seed_h))
-        seed_loss = _pvary(
-            jnp.where(is_last & b_active, inv_m, jnp.float32(0)), vary)
-        dsp, dhp, dhp_emb, dh_in = pull((seed_h, seed_loss))
-
         bmask = lambda g: jnp.where(b_active, g, jnp.zeros_like(g))
+        demb, dhead, loss = carry["demb"], carry["dhead"], carry["loss"]
+
+        if do_head:
+            lab_j = labels_mb[jnp.clip(j, 0, m - 1)]
+            is_last = my_stage == p - 1
+            (h_out_b, loss_j), pull = jax.vjp(
+                lambda sp, hp, ep, h: fwd_and_loss(sp, hp, ep, h, lab_j),
+                local_params, head_params, embed_params, h_saved)
+            # cotangent seed: last stage seeds from its own loss, others
+            # from the cotangent received from stage s+1
+            seed_h = jnp.where(is_last, jnp.zeros_like(carry["recv_b"]),
+                               carry["recv_b"])
+            seed_h = jnp.where(b_active, seed_h, jnp.zeros_like(seed_h))
+            seed_loss = _pvary(
+                jnp.where(is_last & b_active, inv_m, jnp.float32(0)), vary)
+            dsp, dhp, dhp_emb, dh_in = pull((seed_h, seed_loss))
+            dhead = jax.tree_util.tree_map(
+                lambda acc, g: acc + bmask(g), dhead, dhp)
+            demb = jax.tree_util.tree_map(
+                lambda acc, g: acc + bmask(g), demb, dhp_emb)
+            loss = loss + jnp.where(is_last & b_active, loss_j * inv_m, 0.0)
+        else:
+            # drain: the last stage finished all its backwards in the
+            # steady phase, so no tick here can need the head/loss
+            _, pull = jax.vjp(
+                lambda sp, h: stage_fn(sp, h), local_params, h_saved)
+            seed_h = jnp.where(b_active, carry["recv_b"],
+                               jnp.zeros_like(carry["recv_b"]))
+            dsp, dh_in = pull(seed_h)
+
         dstage = jax.tree_util.tree_map(
             lambda acc, g: acc + bmask(g), carry["dstage"], dsp)
-        dhead = jax.tree_util.tree_map(
-            lambda acc, g: acc + bmask(g), carry["dhead"], dhp)
-
-        # embedding backward (stage 0 only; other stages contribute zeros)
-        _, pull_e = jax.vjp(lambda ep: embed_fn(ep, tok_j), embed_params)
-        (dep,) = pull_e(jnp.where((my_stage == 0) & b_active, dh_in,
-                                  jnp.zeros_like(dh_in)))
-        demb = jax.tree_util.tree_map(
-            lambda acc, g, gh: acc + g + bmask(gh),
-            carry["demb"], dep, dhp_emb)
-
+        # record stage 0's input cotangent; the embedding backward runs
+        # ONCE, batched, after the scans (a per-tick embed vjp would pay
+        # an O(vocab x hidden) scatter every tick)
+        dh0 = carry["dh0"].at[jnp.clip(j, 0, m - 1)].add(
+            jnp.where((my_stage == 0) & b_active, dh_in,
+                      jnp.zeros_like(dh_in)))
         send_b = jax.lax.ppermute(bmask(dh_in), axis_name, perm_bwd)
-        loss = carry["loss"] + jnp.where(is_last & b_active,
-                                         loss_j * inv_m, 0.0)
         return dict(recv_f=send_f, recv_b=send_b, buf=buf, demb=demb,
-                    dstage=dstage, dhead=dhead, loss=loss), None
+                    dstage=dstage, dhead=dhead, dh0=dh0, loss=loss), None
 
-    carry, _ = jax.lax.scan(tick, carry0, jnp.arange(t_total))
+    from functools import partial as _partial
+    carry = carry0
+    if p > 1:
+        carry, _ = jax.lax.scan(
+            _partial(tick, do_fwd=True, do_bwd=False, do_head=False),
+            carry, jnp.arange(0, p - 1))
+    carry, _ = jax.lax.scan(
+        _partial(tick, do_fwd=True, do_bwd=True, do_head=True),
+        carry, jnp.arange(p - 1, m + p - 1))
+    if p > 1:
+        carry, _ = jax.lax.scan(
+            _partial(tick, do_fwd=False, do_bwd=True, do_head=False),
+            carry, jnp.arange(m + p - 1, m + 2 * (p - 1)))
+
+    # batched embedding backward: one vjp over all microbatches (stage 0's
+    # recorded cotangents; zeros elsewhere, fixed by the psum below)
+    def batched_embed(ep):
+        return jax.vmap(lambda tk: embed_fn(ep, tk))(tokens_mb)
+
+    _, pull_e = jax.vjp(batched_embed, embed_params)
+    (dep,) = pull_e(carry["dh0"])
+    carry["demb"] = jax.tree_util.tree_map(
+        lambda acc, g: acc + g, carry["demb"], dep)
 
     # loss lives on the last stage; grads for replicated params only on
     # their owning stages — psum over pp makes them correct everywhere.

@@ -1,0 +1,175 @@
+"""Double backward across custom-VJP boundaries (VERDICT r4 #7).
+
+reference: test/legacy_test/test_imperative_double_grad.py — second-order
+gradients must either work or fail loudly, never silently return wrong
+values. Three boundaries:
+
+- Pallas flash attention (ops/pallas/flash_attention.py): the bwd kernels
+  are custom_vjp and stop at first order, so the sdpa pallas branch records
+  a DENSE higher-order forward (`_ho_fwd` in framework/core.py execute);
+  create_graph=True must produce the same hessian as the dense path.
+- fused functionals (incubate/nn/functional): pure jax compositions —
+  grad-of-grad must just work.
+- recompute (jax.checkpoint): differentiable at any order — must work.
+- a custom_vjp op with NO registered dense fallback: must raise a
+  RuntimeError naming the op and the dense-fallback hint.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.framework.core import execute
+from paddle_tpu.nn import functional as F
+
+
+def _double_grad_sdpa(q_np, k_np, v_np):
+    """sum of hessian-vector pieces: grad of ||grad_q||^2 wrt q."""
+    q = paddle.to_tensor(q_np)
+    k = paddle.to_tensor(k_np)
+    v = paddle.to_tensor(v_np)
+    for t in (q, k, v):
+        t.stop_gradient = False
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    (gq,) = paddle.grad([out.sum()], [q], create_graph=True)
+    (ggq,) = paddle.grad([(gq * gq).sum()], [q])
+    return np.asarray(ggq.numpy())
+
+
+class TestDoubleGradFlashAttention:
+    def test_pallas_path_matches_dense_hessian(self, monkeypatch):
+        """create_graph through the flash path: first-order runs the Pallas
+        kernel, the second-order recompute runs the recorded dense forward;
+        the result must equal the all-dense double grad."""
+        rng = np.random.RandomState(0)
+        shape = (1, 8, 2, 4)
+        q, k, v = (rng.randn(*shape).astype(np.float32) for _ in range(3))
+
+        dense = _double_grad_sdpa(q, k, v)
+
+        from paddle_tpu.nn.functional import attention as attn
+        monkeypatch.setattr(attn, "_use_pallas", lambda *a, **kw: True)
+        flash = _double_grad_sdpa(q, k, v)
+
+        np.testing.assert_allclose(flash, dense, rtol=2e-4, atol=2e-5)
+        assert np.abs(dense).sum() > 0  # the hessian is not trivially zero
+
+    def test_pallas_first_order_still_flash(self, monkeypatch):
+        """_ho_fwd must not change the primal or first-order path."""
+        rng = np.random.RandomState(1)
+        shape = (1, 8, 2, 4)
+        q_np, k_np, v_np = (rng.randn(*shape).astype(np.float32)
+                            for _ in range(3))
+
+        def run():
+            q = paddle.to_tensor(q_np)
+            q.stop_gradient = False
+            k, v = paddle.to_tensor(k_np), paddle.to_tensor(v_np)
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+            out.sum().backward()
+            return np.asarray(out.numpy()), np.asarray(q.grad.numpy())
+
+        out_d, gq_d = run()
+        from paddle_tpu.nn.functional import attention as attn
+        monkeypatch.setattr(attn, "_use_pallas", lambda *a, **kw: True)
+        out_f, gq_f = run()
+        np.testing.assert_allclose(out_f, out_d, rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(gq_f, gq_d, rtol=2e-4, atol=2e-5)
+
+
+class TestDoubleGradFusedAndRecompute:
+    def test_fused_linear_double_grad(self):
+        from paddle_tpu.incubate.nn import functional as IF
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(4, 3).astype(np.float32))
+        w = paddle.to_tensor(rng.randn(3, 5).astype(np.float32))
+        b = paddle.to_tensor(np.zeros(5, np.float32))
+        for t in (x, w, b):
+            t.stop_gradient = False
+        y = IF.fused_linear(x, w, b)
+        (gx,) = paddle.grad([(y * y).sum()], [x], create_graph=True)
+        (ggx,) = paddle.grad([(gx * gx).sum()], [x])
+        # analytic: y = xW+b, L=sum(y^2) -> gx = 2 y W^T;
+        # sum(gx^2) -> ggx = d/dx sum((2 x W W^T + 2 b W^T)^2)
+        W = rng.randn(0)  # noqa: F841 — clarity only
+        Wn = np.asarray(w.numpy())
+        yn = np.asarray(x.numpy()) @ Wn + np.asarray(b.numpy())
+        gxn = 2 * yn @ Wn.T
+        ggxn = 2 * (2 * gxn @ Wn) @ Wn.T
+        np.testing.assert_allclose(np.asarray(ggx.numpy()), ggxn,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_recompute_double_grad(self):
+        from paddle_tpu.distributed.fleet.utils import recompute
+        rng = np.random.RandomState(0)
+        lin = nn.Linear(4, 4)
+        x = paddle.to_tensor(rng.randn(2, 4).astype(np.float32))
+        x.stop_gradient = False
+
+        def block(h):
+            return paddle.tanh(lin(h))
+
+        y = recompute(block, x)
+        (gx,) = paddle.grad([y.sum()], [x], create_graph=True)
+        (ggx,) = paddle.grad([(gx * gx).sum()], [x])
+
+        # reference: same math without recompute
+        y2 = block(x)
+        (gx2,) = paddle.grad([y2.sum()], [x], create_graph=True)
+        (ggx2,) = paddle.grad([(gx2 * gx2).sum()], [x])
+        np.testing.assert_allclose(np.asarray(ggx.numpy()),
+                                   np.asarray(ggx2.numpy()),
+                                   rtol=1e-4, atol=1e-5)
+        assert np.abs(np.asarray(ggx2.numpy())).sum() > 0
+
+
+class TestDoubleGradLoudFailure:
+    def test_differentiable_custom_bwd_just_works(self):
+        """A custom_vjp whose bwd is ordinary jax code IS re-differentiable
+        (the recorded-forward recompute unwraps it), so no error and the
+        analytic second derivative comes out."""
+        @jax.custom_vjp
+        def cube(x):
+            return x ** 3
+
+        def cube_fwd(x):
+            return x ** 3, x
+
+        def cube_bwd(res, g):
+            return (3.0 * res ** 2 * g,)
+
+        cube.defvjp(cube_fwd, cube_bwd)
+
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        x.stop_gradient = False
+        y = execute(cube, x, _name="cube_custom_vjp")
+        (gx,) = paddle.grad([y.sum()], [x], create_graph=True)
+        (ggx,) = paddle.grad([gx.sum()], [x])
+        np.testing.assert_allclose(np.asarray(ggx.numpy()), [12.0],
+                                   rtol=1e-5)  # d2/dx2 x^3 = 6x = 12
+
+    def test_raw_pallas_kernel_raises_with_hint(self):
+        """The raw flash kernel (no dense _ho_fwd registered) must raise a
+        RuntimeError naming the op and the dense-fallback hint — never
+        return silently wrong second-order numbers. (The sdpa entry point
+        registers the dense fallback; this exercises the guard for code
+        that calls the kernel directly.)"""
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention_bshd
+        rng = np.random.RandomState(0)
+        q = paddle.to_tensor(rng.randn(1, 8, 2, 4).astype(np.float32))
+        q.stop_gradient = False
+        k = paddle.to_tensor(rng.randn(1, 8, 2, 4).astype(np.float32))
+        v = paddle.to_tensor(rng.randn(1, 8, 2, 4).astype(np.float32))
+        y = execute(lambda a, b, c: flash_attention_bshd(a, b, c, causal=True),
+                    q, k, v, _name="raw_flash_attention")
+        with pytest.raises(RuntimeError) as ei:
+            (gq,) = paddle.grad([y.sum()], [q], create_graph=True)
+            # some jax versions defer the failure to the second grad
+            paddle.grad([(gq * gq).sum()], [q])
+        msg = str(ei.value)
+        assert "raw_flash_attention" in msg
+        assert "FLAGS_flash_attention_backend" in msg

@@ -1,0 +1,118 @@
+"""Elastic fault-recovery drill, end to end (VERDICT r4 missing #5).
+
+kill a worker mid-training -> ElasticManager detects the lost lease ->
+launcher restarts the pod -> ranks reload the distributed checkpoint ->
+the loss curve CONTINUES exactly as an unkilled run's would.
+
+reference: python/paddle/distributed/fleet/elastic/manager.py:125
+(membership watch / restart signal) composed with the loss-continuity
+pattern of test/legacy_test/test_dist_base.py:957.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _events(workdir, rank):
+    path = os.path.join(workdir, f"events.rank{rank}.jsonl")
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+class TestElasticRecovery:
+    @pytest.fixture(scope="class")
+    def drill(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("elastic")
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("PADDLE_", "JAX_COORDINATOR"))}
+        env.pop("XLA_FLAGS", None)
+        p = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node=2", f"--master=127.0.0.1:{_free_port()}",
+             "--max_restart=2", f"--log_dir={tmp}", WORKER, str(tmp)],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+        logs = ""
+        for r in range(2):
+            lp = tmp / f"worker.{r}.log"
+            if lp.exists():
+                logs += f"\n--- worker {r} ---\n" + lp.read_text()[-3000:]
+        assert p.returncode == 0, (
+            f"drill failed rc={p.returncode}: {p.stderr[-1000:]}{logs}")
+        return {"dir": str(tmp), "stderr": p.stderr,
+                "ev0": _events(str(tmp), 0), "ev1": _events(str(tmp), 1)}
+
+    def test_crash_really_happened(self, drill):
+        crashes = [e for e in drill["ev1"] if e["event"] == "crash"]
+        assert len(crashes) == 1 and crashes[0]["at_step"] == 3
+
+    def test_manager_detected_lost_lease(self, drill):
+        det = [e for e in drill["ev0"]
+               if e["event"] == "detected_membership_change"]
+        assert det, "rank 0 never ran the membership watch"
+        assert det[0]["detected"], (
+            f"ElasticManager watch missed the dead peer: {det[0]}")
+        # the crashed rank's lease must be gone from the alive set
+        assert not any(n.startswith("rank1-inc0")
+                       for n in det[0]["alive_after"]), det[0]
+
+    def test_launcher_restarted_pod(self, drill):
+        assert "restart 1/" in drill["stderr"], drill["stderr"][-500:]
+
+    def test_resumed_from_checkpoint(self, drill):
+        for ev in (drill["ev0"], drill["ev1"]):
+            resumed = [e for e in ev if e["event"] == "resumed"]
+            assert resumed and resumed[-1]["from_step"] == 3, resumed
+
+    def test_loss_curve_continues(self, drill):
+        """Spliced inc0[0..2] + inc1[3..5] losses == unkilled run."""
+        for rank in range(2):
+            ev = drill["ev%d" % rank]
+            steps = {(e["incarnation"], e["step"]): e["loss"]
+                     for e in ev if e["event"] == "step"}
+            spliced = [steps[(0, s)] for s in range(3)] + \
+                      [steps[(1, s)] for s in range(3, 6)]
+            assert len(spliced) == 6
+            ref = _unkilled_reference()
+            np.testing.assert_allclose(spliced, ref, rtol=1e-4, atol=1e-6)
+
+    def test_both_ranks_completed(self, drill):
+        for ev in (drill["ev0"], drill["ev1"]):
+            assert any(e["event"] == "done" and e["incarnation"] == 1
+                       for e in ev)
+
+
+def _unkilled_reference():
+    """The same 6-step training, single process, no kill — computed eagerly
+    in THIS process (tests run on the CPU backend already)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 4).astype(np.float32)
+    Y = (X @ rng.randn(4, 1).astype(np.float32))
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    opt = optimizer.SGD(0.1, parameters=model.parameters())
+    losses = []
+    for _ in range(6):
+        loss = ((model(paddle.to_tensor(X)) - paddle.to_tensor(Y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses

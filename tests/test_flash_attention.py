@@ -81,6 +81,38 @@ class TestFlashForward:
                                    rtol=2e-5, atol=2e-5)
 
 
+class TestHeadDimPadding:
+    """Non-lane-aligned head dims (96 = llama_780m, 32 = tiny) zero-pad to
+    the 128-lane tile inside flash_attention_bshd; outputs AND grads must
+    match the dense reference with the true-d softmax scale."""
+
+    @pytest.mark.parametrize("d", [96, 32])
+    def test_forward_and_grads_match_dense(self, d):
+        rs = np.random.RandomState(7)
+        q = _rand(rs, 1, 64, 2, d)
+        k = _rand(rs, 1, 64, 2, d)
+        v = _rand(rs, 1, 64, 2, d)
+
+        def flash_loss(q, k, v):
+            return jnp.sum(flash_attention_bshd(q, k, v, causal=True) ** 2)
+
+        def dense_loss(q, k, v):
+            qt = jnp.swapaxes(q, 1, 2).reshape(2, 64, d)
+            kt = jnp.swapaxes(k, 1, 2).reshape(2, 64, d)
+            vt = jnp.swapaxes(v, 1, 2).reshape(2, 64, d)
+            ref = _xla_attention_bhsd(qt, kt, vt, True, d ** -0.5)
+            ref = jnp.swapaxes(ref.reshape(1, 2, 64, d), 1, 2)
+            return jnp.sum(ref ** 2)
+
+        lf, gf = jax.value_and_grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+        ld, gd = jax.value_and_grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(float(lf), float(ld), rtol=2e-5)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-5)
+            assert a.shape[-1] == d  # pad columns sliced off
+
+
 class TestFlashBackward:
     """The handwritten Pallas backward (dQ kernel + dK/dV kernel) must match
     autodiff of the dense reference at fp32 tolerance."""

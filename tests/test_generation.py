@@ -121,3 +121,69 @@ class TestGenerate:
         b = np.asarray(generation.generate(model, ids, max_new_tokens=4)._data)
         ref = _greedy_recompute(model, np.asarray(ids), 4)
         np.testing.assert_array_equal(b, ref)  # matches CURRENT weights
+
+
+class TestWeightOnlyGenerator:
+    """Weight-only int8 serving path (generation.WeightOnlyGenerator):
+    int8 quant error must not change the GREEDY argmax on a tiny model,
+    and shared-weight rebuilds must not requantize."""
+
+    def test_int8_greedy_parity(self):
+        model = _model()
+        ids = jnp.ones((2, 4), jnp.int32)
+        ref = np.asarray(
+            generation.generate(model, ids, max_new_tokens=6)._data)
+        wog = generation.WeightOnlyGenerator(model, max_new_tokens=6)
+        out = np.asarray(wog.generate(ids)._data)
+        np.testing.assert_array_equal(out, ref)
+        # int8 + scales + fp leftovers must undercut the f32 state dict
+        f32_bytes = sum(int(np.prod(t.shape)) * 4
+                        for t in model.state_dict().values())
+        assert wog.quantized_bytes() < f32_bytes
+
+    def test_untied_head_and_gqa(self):
+        """With an UNTIED head the head weight itself is quantized, so the
+        exact reference is generate() on a model whose weights were passed
+        through the same quant->dequant — identical math, bit-equal ids."""
+        paddle.seed(3)
+        model = paddle.models.llama_tiny(
+            num_hidden_layers=2, num_key_value_heads=2,
+            tie_word_embeddings=False)
+        ids = jnp.ones((1, 3), jnp.int32)
+        wog = generation.WeightOnlyGenerator(model, max_new_tokens=4)
+        out = np.asarray(wog.generate(ids)._data)
+
+        def qdq(v):
+            v32 = np.asarray(v, np.float32)
+            scale = np.maximum(
+                np.max(np.abs(v32), axis=-2, keepdims=True) / 127.0, 1e-8)
+            return (np.clip(np.round(v32 / scale), -127, 127)
+                    * scale).astype(np.asarray(v).dtype)
+
+        state = model.state_dict()
+        saved = {k: t._data for k, t in state.items()}
+        for k, t in state.items():
+            is_layer_mat = ".layers." in k and np.asarray(t._data).ndim >= 2 \
+                and "norm" not in k
+            if is_layer_mat or k == "lm_head.weight":
+                t._data = jnp.asarray(qdq(t._data))
+        try:
+            ref = np.asarray(
+                generation.generate(model, ids, max_new_tokens=4)._data)
+        finally:
+            for k, t in state.items():
+                t._data = saved[k]
+        np.testing.assert_array_equal(out, ref)
+
+    def test_share_weights_from_skips_requantize(self):
+        model = _model()
+        ids = jnp.ones((1, 4), jnp.int32)
+        wog1 = generation.WeightOnlyGenerator(model, max_new_tokens=1)
+        wog2 = generation.WeightOnlyGenerator(model, max_new_tokens=5,
+                                              share_weights_from=wog1)
+        for k in wog1._q:
+            assert wog2._q[k] is wog1._q[k]  # same buffers, no requantize
+        out = np.asarray(wog2.generate(ids)._data)
+        ref = np.asarray(
+            generation.generate(model, ids, max_new_tokens=5)._data)
+        np.testing.assert_array_equal(out, ref)

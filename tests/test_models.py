@@ -221,6 +221,41 @@ class TestScannedLlama:
             rel = abs(sl - float(el)) / max(1.0, abs(float(el)))
             assert rel < 1e-6, (tied, sl, float(el))
 
+    def test_remat_policy_parity(self):
+        """All remat flavors (off / full / dots / nothing) compute the SAME
+        loss and gradients — the policy only changes what the backward
+        recomputes, never the math."""
+        import jax.numpy as jnp
+        from paddle_tpu.models.scanned import build_scanned_llama
+
+        ids = jnp.asarray(
+            np.random.RandomState(0).randint(0, 512, (2, 16)), jnp.int32)
+        results = []
+        for remat, policy in ((False, None), (True, None), (True, "dots"),
+                              (True, "nothing")):
+            paddle.seed(0)
+            model = paddle.models.llama_tiny(num_hidden_layers=2)
+            params, loss_fn = build_scanned_llama(model, remat=remat,
+                                                  remat_policy=policy)
+            loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params, ids,
+                                                               ids)
+            gnorm = sum(float((g ** 2).sum())
+                        for g in jax.tree_util.tree_leaves(grads))
+            results.append((float(loss), gnorm))
+        base = results[0]
+        for r in results[1:]:
+            np.testing.assert_allclose(r, base, rtol=1e-5)
+
+    def test_remat_policy_unknown_raises(self):
+        from paddle_tpu.models.scanned import build_scanned_llama
+        paddle.seed(0)
+        model = paddle.models.llama_tiny(num_hidden_layers=2)
+        try:
+            build_scanned_llama(model, remat=True, remat_policy="bogus")
+            raise AssertionError("expected ValueError")
+        except ValueError as e:
+            assert "bogus" in str(e)
+
     def test_trains_with_tree_update(self):
         import jax.numpy as jnp
         from paddle_tpu.models.scanned import build_scanned_llama

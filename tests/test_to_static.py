@@ -139,6 +139,47 @@ class TestStagedGraphBreak:
         assert sf._last_segments == 2
         assert len(sf._staged_jit_cache) == 2
 
+    def test_fresh_np_const_hits_cache(self):
+        """ADVICE r4 + review: fresh-per-call numpy consts (np scalars,
+        small host arrays) key by CONTENT, so every step reuses the
+        compiled segment instead of recompiling; distinct contents and
+        types (1 vs 1.0) must still miss."""
+        def fn(x, s):
+            a = x * s            # np const enters the op
+            if float(a.sum()) > 0:   # break
+                return a.sum()
+            return (-a).sum()
+
+        sf = paddle.jit.to_static(fn)
+        x = paddle.Tensor(jnp.ones((4,), jnp.float32))
+        with pytest.warns(RuntimeWarning):
+            sf(x, np.float32(0.5))
+        n0 = len(sf._staged_jit_cache)
+        for _ in range(3):
+            out = sf(x, np.float32(0.5))    # FRESH object, same content
+        assert len(sf._staged_jit_cache) == n0   # hit, no growth
+        np.testing.assert_allclose(float(out), 2.0)
+        # different content -> genuine miss (recompile is correct)
+        out2 = sf(x, np.float32(2.0))
+        assert len(sf._staged_jit_cache) > n0
+        np.testing.assert_allclose(float(out2), 8.0)
+
+    def test_scalar_type_not_conflated(self):
+        """True/1/1.0 hash equal in Python; the cache key must not let a
+        segment compiled for one replay for another."""
+        def fn(x, flag):
+            y = x * (2.0 if flag else 0.5)
+            if float(y.sum()) != 0:  # break keeps staging active
+                return y.sum() + (1 if isinstance(flag, bool) else 0)
+            return y.sum()
+
+        sf = paddle.jit.to_static(fn)
+        x = paddle.Tensor(jnp.ones((2,), jnp.float32))
+        with pytest.warns(RuntimeWarning):
+            a = float(sf(x, True))
+        b = float(sf(x, 1))
+        assert a != b  # the int call must NOT replay the bool segment
+
     def test_other_branch_parity(self):
         sf = paddle.jit.to_static(self._fn())
         fn = self._fn()

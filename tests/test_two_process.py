@@ -51,6 +51,7 @@ class TestTwoProcessIntegration:
         for r in range(2):
             with open(f"{out}.rank{r}") as f:
                 res[r] = json.load(f)
+        res["ckpt_path"] = out + ".ckpt2p"
         return res
 
     def test_bootstrap_world(self, results):
@@ -77,3 +78,84 @@ class TestTwoProcessIntegration:
             assert results[r]["parity"], results[r]
         # and both ranks observed the SAME replicated loss
         assert results[0]["spmd_losses"] == results[1]["spmd_losses"]
+
+    def test_reduce_scatter_cross_process(self, results):
+        # contributions [r+1, 10(r+1)] sum to [3, 30]; rank r keeps chunk r
+        assert results[0]["reduce_scatter"] == 3.0
+        assert results[1]["reduce_scatter"] == 30.0
+        assert results[0]["stream_reduce_scatter"] == 3.0
+        assert results[1]["stream_reduce_scatter"] == 30.0
+
+    def test_scatter_gather_cross_process(self, results):
+        assert results[0]["scatter_from0"] == 100.0
+        assert results[1]["scatter_from0"] == 200.0
+        assert results[0]["gather_dst1"] == []       # only dst fills
+        assert results[1]["gather_dst1"] == [7.0, 8.0]
+
+    def test_send_recv_cross_process(self, results):
+        assert results[1]["p2p_recv"] == [41.0, 42.0]
+        assert results[0]["p2p_roundtrip"] == [42.0, 43.0]
+
+    def test_batch_isend_irecv_cross_process(self, results):
+        assert results[0]["batch_p2p"] == 109.0
+        assert results[1]["batch_p2p"] == 9.0
+
+    def test_two_proc_checkpoint_reshard_loads_single_proc(self, results,
+                                                           tmp_path_factory):
+        """The checkpoint two processes wrote loads in THIS single process
+        onto a different (8-device) mesh — reshard-on-load — and matches
+        the worker's own trained parameters (verified there against the
+        eager reference)."""
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from paddle_tpu.distributed import checkpoint as dck
+
+        for r in range(2):
+            assert results[r]["ckpt_saved"]
+        ckpt = results["ckpt_path"]
+        assert os.path.exists(os.path.join(ckpt, "metadata.json"))
+        import json as _json
+        with open(os.path.join(ckpt, "metadata.json")) as f:
+            meta = _json.load(f)
+        mesh = Mesh(np.array(jax.devices()[:2]), ("x",))
+        state = {}
+        for k, info in meta["arrays"].items():
+            shape = tuple(info["shape"])
+            # shard the first even-sized dim over 'x' to force resharding
+            spec = [None] * len(shape)
+            for d, s in enumerate(shape):
+                if s % 2 == 0:
+                    spec[d] = "x"
+                    break
+            state[k] = jax.device_put(
+                jnp.zeros(shape, jnp.dtype(info["dtype"])),
+                NamedSharding(mesh, P(*spec)))
+        dck.load_state_dict(state, ckpt)
+        # worker trained 3 SGD steps matching its eager reference; recompute
+        # that reference here and compare arrays
+        ref = _eager_reference_params()
+        for k, arr in state.items():
+            np.testing.assert_allclose(np.asarray(arr), ref[k],
+                                       rtol=1e-4, atol=1e-5)
+
+
+def _eager_reference_params():
+    """3 SGD steps on the worker's model/data, eagerly, in this process."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 4).astype(np.float32)
+    Y = (X @ rng.randn(4, 1).astype(np.float32))
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    opt = optimizer.SGD(0.1, parameters=model.parameters())
+    for _ in range(3):
+        loss = ((model(paddle.to_tensor(X)) - paddle.to_tensor(Y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return {k: np.asarray(t.numpy()) for k, t in model.state_dict().items()}

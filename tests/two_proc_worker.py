@@ -65,6 +65,55 @@ def main():
     dist.broadcast(b, src=1)
     results["broadcast_src1"] = float(b.numpy()[0])  # 15
 
+    # reduce_scatter: rank r contributes [r+1, (r+1)*10]; reduced sum is
+    # [3, 30]; rank r keeps element r
+    rs_out = paddle.to_tensor(np.zeros(1, np.float32))
+    rs_in = [paddle.to_tensor(np.array([float(rank + 1)], np.float32)),
+             paddle.to_tensor(np.array([float((rank + 1) * 10)], np.float32))]
+    dist.reduce_scatter(rs_out, rs_in)
+    results["reduce_scatter"] = float(rs_out.numpy()[0])  # r0: 3, r1: 30
+
+    # stream flavor, single-Tensor input (chunked internally)
+    st_out = paddle.to_tensor(np.zeros(1, np.float32))
+    st_in = paddle.to_tensor(
+        np.array([rank + 1.0, (rank + 1.0) * 10], np.float32))
+    dist.stream.reduce_scatter(st_out, st_in)
+    results["stream_reduce_scatter"] = float(st_out.numpy()[0])
+
+    # scatter from src=0: rank r receives 100*(r+1)
+    sc_out = paddle.to_tensor(np.zeros(1, np.float32))
+    sc_list = ([paddle.to_tensor(np.array([100.0], np.float32)),
+                paddle.to_tensor(np.array([200.0], np.float32))]
+               if rank == 0 else None)
+    dist.scatter(sc_out, sc_list, src=0)
+    results["scatter_from0"] = float(sc_out.numpy()[0])
+
+    # gather to dst=1
+    ga = []
+    dist.gather(paddle.to_tensor(np.array([float(rank + 7)], np.float32)),
+                ga, dst=1)
+    results["gather_dst1"] = [float(t.numpy()[0]) for t in ga]
+
+    # p2p over the store: 0 -> 1 then 1 -> 0 (two sequenced messages)
+    if rank == 0:
+        dist.send(paddle.to_tensor(np.array([41.0, 42.0], np.float32)), dst=1)
+        back = paddle.to_tensor(np.zeros(2, np.float32))
+        dist.recv(back, src=1)
+        results["p2p_roundtrip"] = [float(x) for x in back.numpy()]  # [42,43]
+    else:
+        got = paddle.to_tensor(np.zeros(2, np.float32))
+        dist.recv(got, src=0)
+        dist.send(paddle.to_tensor(np.asarray(got.numpy()) + 1.0), dst=0)
+        results["p2p_recv"] = [float(x) for x in got.numpy()]  # [41,42]
+
+    # batched p2p: symmetric exchange in ONE batch on both ranks
+    peer = 1 - rank
+    bsend = paddle.to_tensor(np.array([float(rank * 100 + 9)], np.float32))
+    brecv = paddle.to_tensor(np.zeros(1, np.float32))
+    dist.batch_isend_irecv([dist.P2POp(dist.isend, bsend, peer),
+                            dist.P2POp(dist.irecv, brecv, peer)])
+    results["batch_p2p"] = float(brecv.numpy()[0])  # r0: 109, r1: 9
+
     # ---- 2-process SpmdTrainer step parity vs local eager loop -----------
     from jax.sharding import Mesh
     from paddle_tpu import nn, optimizer
@@ -97,6 +146,12 @@ def main():
     results["eager_losses"] = eager_losses
     results["parity"] = bool(np.allclose(spmd_losses, eager_losses,
                                          rtol=1e-4, atol=1e-5))
+
+    # ---- 2-process distributed checkpoint save (owner-computed chunks);
+    # the pytest wrapper reshard-loads it in a SINGLE process -------------
+    from paddle_tpu.distributed import checkpoint as dck
+    dck.save_state_dict(dict(trainer.params), out_path + ".ckpt2p")
+    results["ckpt_saved"] = True
 
     with open(f"{out_path}.rank{rank}", "w") as f:
         json.dump(results, f)

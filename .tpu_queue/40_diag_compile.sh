@@ -1,0 +1,12 @@
+# Bisect the >=780M remote-compile 500 (see tools/compile_probe.py).
+# Reference points: config1 = L24 h2048 i8192 b4 s2048 hd128 (FAILS),
+# config3 = L8 h2048 i5504 b4 s2048 hd128 (OK). Walk the deltas.
+cd /root/repo
+P="timeout 1500 python tools/compile_probe.py"
+$P 24 2048 8192 4 2048 xla   2>&1 | grep -a "probe\|PROBE"
+$P 24 2048 8192 4 2048 flash 1 2>&1 | grep -a "probe\|PROBE"
+$P 12 2048 8192 4 2048 flash 2>&1 | grep -a "probe\|PROBE"
+$P 24 2048 5504 4 2048 flash 2>&1 | grep -a "probe\|PROBE"
+$P 16 2048 8192 4 2048 flash 2>&1 | grep -a "probe\|PROBE"
+$P 16 1536 6144 8 2048 xla   2>&1 | grep -a "probe\|PROBE"
+$P 16 1536 6144 4 2048 flash 2>&1 | grep -a "probe\|PROBE"

@@ -24,7 +24,7 @@ import tempfile
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native")
 
-_SOURCES = ["tcp_store.cc", "collate.cc"]
+_SOURCES = ["tcp_store.cc", "collate.cc", "ps_table.cc"]
 
 available = False
 _lib = None
@@ -94,6 +94,31 @@ def _bind(lib):
         c.POINTER(c.POINTER(c.c_uint8)), c.c_int64, c.c_int64, c.c_int64,
         c.c_int64, c.POINTER(c.c_float), c.POINTER(c.c_float),
         c.POINTER(c.c_float), c.c_int]
+    # sparse parameter-server table (native/ps_table.cc)
+    u64p = c.POINTER(c.c_uint64)
+    f32p = c.POINTER(c.c_float)
+    lib.pt_ps_table_new.restype = c.c_void_p
+    lib.pt_ps_table_new.argtypes = [c.c_int, c.c_int, c.c_float, c.c_float,
+                                    c.c_float, c.c_float, c.c_float]
+    lib.pt_ps_table_free.argtypes = [c.c_void_p]
+    lib.pt_ps_table_pull.argtypes = [c.c_void_p, u64p, c.c_int64, f32p,
+                                     c.c_int]
+    lib.pt_ps_table_push.argtypes = [c.c_void_p, u64p, c.c_int64, f32p]
+    lib.pt_ps_table_merge.argtypes = [c.c_void_p, u64p, c.c_int64, f32p]
+    lib.pt_ps_table_assign.argtypes = [c.c_void_p, u64p, c.c_int64, f32p]
+    lib.pt_ps_table_size.restype = c.c_int64
+    lib.pt_ps_table_size.argtypes = [c.c_void_p]
+    lib.pt_ps_table_keys.restype = c.c_int64
+    lib.pt_ps_table_keys.argtypes = [c.c_void_p, u64p, c.c_int64]
+    lib.pt_ps_table_add_show_click.argtypes = [c.c_void_p, u64p, c.c_int64,
+                                               f32p, f32p]
+    lib.pt_ps_table_decay.argtypes = [c.c_void_p, c.c_float]
+    lib.pt_ps_table_shrink.restype = c.c_int64
+    lib.pt_ps_table_shrink.argtypes = [c.c_void_p, c.c_float, c.c_float]
+    lib.pt_ps_table_save.restype = c.c_int
+    lib.pt_ps_table_save.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pt_ps_table_load.restype = c.c_int
+    lib.pt_ps_table_load.argtypes = [c.c_void_p, c.c_char_p]
     return lib
 
 

@@ -201,9 +201,24 @@ def get_hybrid_communicate_group():
 
 
 class _Fleet:
+    _role_maker = None
+    _ps_engine = None
+
     def init(self, role_maker=None, is_collective=False, strategy=None,
              log_level="INFO"):
         global _hcg, _strategy
+        if role_maker is None and not is_collective:
+            # reference entrypoint `fleet.init(is_collective=False)`:
+            # PS mode with the env-derived role maker
+            role_maker = PaddleCloudRoleMaker(is_collective=False)
+        self._role_maker = role_maker
+        if role_maker is not None and not getattr(role_maker, "_collective",
+                                                  True):
+            # PS mode: no device mesh to build — the sparse runtime is
+            # host-side (distributed/ps); dense training stays GSPMD and
+            # is initialized by the trainer when it first touches jax
+            _strategy = strategy or DistributedStrategy()
+            return self
         from ..parallel_env import init_parallel_env
         init_parallel_env()
         _strategy = strategy or DistributedStrategy()
@@ -257,6 +272,62 @@ class _Fleet:
     def get_hybrid_communicate_group(self):
         return _hcg
 
+    # --- PS mode lifecycle (reference: fleet.py init_server/run_server/
+    # init_worker/stop_worker; runtime = distributed/ps TheOnePs) ---------
+    def ps_tables(self, *table_configs):
+        """Declare the sparse tables for PS mode (the reference derives
+        them from the program; here they are explicit TableConfigs)."""
+        from ..ps import the_one_ps
+        self._ps_engine = the_one_ps.from_env(list(table_configs))
+        return self._ps_engine
+
+    def init_server(self, dirname=None, **kwargs):
+        if self._ps_engine is None:
+            raise RuntimeError("fleet.init_server: declare tables first "
+                               "via fleet.ps_tables(*TableConfigs)")
+        eng = self._ps_engine
+        if eng.num_servers <= 1 or self._role_maker is None:
+            eng.start_local()
+        else:
+            sid = self._role_maker.worker_index() \
+                if self._role_maker.is_server() else 0
+            eng.start_server(sid)
+        if dirname:
+            eng.load(dirname)
+        return eng
+
+    def run_server(self):
+        if self._ps_engine is None:
+            raise RuntimeError("fleet.run_server before init_server")
+        self._ps_engine.run_server()
+
+    def init_worker(self, scopes=None):
+        eng = self._ps_engine
+        if eng is None:
+            raise RuntimeError("fleet.init_worker: declare tables first "
+                               "via fleet.ps_tables(*TableConfigs)")
+        if eng.client is None:
+            if eng.num_servers <= 1:
+                if eng.servers:  # a server started in-process: route to it
+                    from ..ps.service import LocalChannel, PsClient
+                    eng.client = PsClient([LocalChannel(eng.servers[0])])
+                else:
+                    eng.start_local()
+            else:
+                from ..ps.the_one_ps import server_name
+                eng.connect([server_name(i)
+                             for i in range(eng.num_servers)])
+        return eng.client
+
+    def stop_worker(self):
+        if self._ps_engine is not None:
+            self._ps_engine.stop()
+
+    def save_persistables(self, executor=None, dirname=None, main_program=None,
+                          mode=0):
+        if self._ps_engine is not None and dirname:
+            self._ps_engine.save(dirname)
+
 
 fleet = _Fleet()
 Fleet = _Fleet  # reference exports the class too
@@ -307,15 +378,28 @@ class UtilBase:
 
 class PaddleCloudRoleMaker:
     """reference: fleet/base/role_maker.py PaddleCloudRoleMaker — reads the
-    cluster layout from env; collective (non-PS) mode only here."""
+    cluster layout from env. PS mode (is_collective=False) reads the
+    reference's TRAINING_ROLE / PADDLE_PSERVERS_IP_PORT_LIST layout; the
+    server runtime itself is paddle_tpu.distributed.ps (TheOnePs)."""
 
     def __init__(self, is_collective=True, **kwargs):
-        if not is_collective:
-            raise NotImplementedError(
-                "parameter-server roles are descoped on TPU (DESIGN.md)")
         import os
+        self._collective = bool(is_collective)
         self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
         self._size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._role = Role.WORKER
+        if not self._collective:
+            training_role = os.environ.get("TRAINING_ROLE", "TRAINER")
+            if training_role.upper() in ("PSERVER", "SERVER"):
+                self._role = Role.SERVER
+                self._rank = int(os.environ.get("PADDLE_PSERVER_ID",
+                                                os.environ.get(
+                                                    "PADDLE_TRAINER_ID",
+                                                    "0")))
+            eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+            self._server_endpoints = [e for e in eps.split(",") if e]
+        else:
+            self._server_endpoints = []
 
     def worker_index(self):
         return self._rank
@@ -323,14 +407,20 @@ class PaddleCloudRoleMaker:
     def worker_num(self):
         return self._size
 
+    def server_num(self):
+        return len(self._server_endpoints) or 1
+
+    def get_pserver_endpoints(self):
+        return list(self._server_endpoints)
+
     def is_worker(self):
-        return True
+        return self._role == Role.WORKER
 
     def is_server(self):
-        return False
+        return self._role == Role.SERVER
 
     def role(self):
-        return Role.WORKER
+        return self._role
 
 
 class UserDefinedRoleMaker(PaddleCloudRoleMaker):

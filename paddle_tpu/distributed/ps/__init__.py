@@ -1,0 +1,35 @@
+"""paddle.distributed.ps — parameter-server training for sparse models.
+
+reference capability: paddle/fluid/distributed/ps/ (~55k LoC of brpc
+services, sparse/dense/geo tables, accessors) + python/paddle/distributed/ps
+(the_one_ps.py runtime).
+
+TPU-native redesign (NOT a port — see each module's docstring):
+  - the row store is native C++ (native/ps_table.cc) behind ctypes, striped
+    hash shards with per-row optimizer state; rules are the accessor
+    (accessor.py: naive/adagrad/adam + CTR decay/shrink policy)
+  - transport is the framework's authenticated RPC over the native
+    TCPStore, with an in-process fast path (service.py)
+  - workers interact through dedup'd pull/push (embedding.py PsEmbedding
+    for eager, PsBatch for compiled static-shape steps); geo-async SGD is
+    a local shadow table pushing weight deltas (service.GeoWorkerCache)
+  - dense parameters do NOT ride the PS on TPU: they live in HBM under
+    GSPMD — the PS carries exactly what exceeds device memory: sparse
+    embedding rows (DESIGN.md records this split)
+"""
+
+from .accessor import (CtrAccessor, SparseAdaGradRule, SparseAdamRule,
+                       SparseNaiveSGDRule)
+from .embedding import PsBatch, PsEmbedding, ps_sparse_embedding
+from .service import (GeoWorkerCache, LocalChannel, PsClient, PsServer,
+                      RpcChannel, TableConfig, serve_tables)
+from .table import DenseTable, SparseTable
+from .the_one_ps import TheOnePs, from_env
+
+__all__ = [
+    "CtrAccessor", "SparseAdaGradRule", "SparseAdamRule",
+    "SparseNaiveSGDRule", "PsBatch", "PsEmbedding", "ps_sparse_embedding",
+    "GeoWorkerCache", "LocalChannel", "PsClient", "PsServer", "RpcChannel",
+    "TableConfig", "serve_tables", "DenseTable", "SparseTable", "TheOnePs",
+    "from_env",
+]

@@ -1,0 +1,138 @@
+"""Sparse-table accessors: per-row optimizer rules + CTR statistics config.
+
+reference capability: paddle/fluid/distributed/ps/table/sparse_sgd_rule.cc
+(SparseNaiveSGDRule / SparseAdaGradSGDRule / SparseAdamSGDRule) and
+ctr_accessor.cc (show/click statistics, decay rates, shrink thresholds).
+
+TPU-native redesign: the rule is a small config object whose id selects the
+native C++ update kernel (native/ps_table.cc apply_rule); the numpy
+implementations here are the executable specification — the fallback path
+when the toolchain is absent and the parity oracle in tests/test_ps.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["SparseNaiveSGDRule", "SparseAdaGradRule", "SparseAdamRule",
+           "CtrAccessor"]
+
+_M64 = (1 << 64) - 1
+
+
+def _splitmix64(state: int):
+    state = (state + 0x9E3779B97F4A7C15) & _M64
+    z = state
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _M64
+    return (z ^ (z >> 31)) & _M64, state
+
+
+def deterministic_init(feature_id: int, emb_dim: int,
+                       initial_range: float) -> np.ndarray:
+    """Bit-exact mirror of native init_row: splitmix64 stream seeded by the
+    feature id -> uniform[-initial_range, initial_range). A never-pushed id
+    pulls identical weights on every server and across save/load."""
+    s = int(feature_id) ^ 0xA5A5A5A55A5A5A5A
+    out = np.empty(emb_dim, np.float32)
+    for d in range(emb_dim):
+        r, s = _splitmix64(s)
+        u = np.float32(r >> 40) / np.float32(1 << 24)
+        out[d] = (np.float32(2.0) * u - np.float32(1.0)) * \
+            np.float32(initial_range)
+    return out
+
+
+class _RuleBase:
+    rule_id: int = -1
+
+    def __init__(self, learning_rate: float = 0.05,
+                 initial_range: float = 0.0001, eps: float = 1e-8,
+                 beta1: float = 0.9, beta2: float = 0.999):
+        self.learning_rate = float(learning_rate)
+        self.initial_range = float(initial_range)
+        self.eps = float(eps)
+        self.beta1 = float(beta1)
+        self.beta2 = float(beta2)
+
+    # --- executable spec (numpy fallback + test oracle) -------------------
+    def slot_len(self, emb_dim: int) -> int:
+        return 0
+
+    def init_slots(self, emb_dim: int) -> np.ndarray:
+        return np.zeros(self.slot_len(emb_dim), np.float32)
+
+    def apply(self, w: np.ndarray, slots: np.ndarray,
+              g: np.ndarray) -> None:
+        raise NotImplementedError
+
+
+class SparseNaiveSGDRule(_RuleBase):
+    """reference: SparseNaiveSGDRule (sparse_sgd_rule.cc)."""
+    rule_id = 0
+
+    def apply(self, w, slots, g):
+        w -= np.float32(self.learning_rate) * g
+
+
+class SparseAdaGradRule(_RuleBase):
+    """Per-dim adagrad. reference: SparseAdaGradSGDRule (sparse_sgd_rule.cc);
+    design departure: the reference keeps one scalar g2sum per feature, here
+    the accumulator is per-dimension (standard adagrad) — strictly more
+    state, strictly better conditioning, and it vectorizes."""
+    rule_id = 1
+
+    def slot_len(self, emb_dim):
+        return emb_dim
+
+    def apply(self, w, slots, g):
+        slots += g * g
+        w -= np.float32(self.learning_rate) * g / \
+            (np.sqrt(slots) + np.float32(self.eps))
+
+
+class SparseAdamRule(_RuleBase):
+    """reference: SparseAdamSGDRule (sparse_sgd_rule.cc). Slots: m, v and
+    the per-row running beta powers (the reference stores beta pows per row
+    too — sparse rows step at different times, so bias correction must be
+    per-row)."""
+    rule_id = 2
+
+    def slot_len(self, emb_dim):
+        return 2 * emb_dim + 2
+
+    def init_slots(self, emb_dim):
+        s = np.zeros(2 * emb_dim + 2, np.float32)
+        s[2 * emb_dim + 0] = 1.0
+        s[2 * emb_dim + 1] = 1.0
+        return s
+
+    def apply(self, w, slots, g):
+        d = w.shape[0]
+        m, v = slots[:d], slots[d:2 * d]
+        b1, b2 = np.float32(self.beta1), np.float32(self.beta2)
+        slots[2 * d + 0] *= b1
+        slots[2 * d + 1] *= b2
+        corr1 = np.float32(1.0) - slots[2 * d + 0]
+        corr2 = np.float32(1.0) - slots[2 * d + 1]
+        m[:] = b1 * m + (np.float32(1.0) - b1) * g
+        v[:] = b2 * v + (np.float32(1.0) - b2) * g * g
+        w -= np.float32(self.learning_rate) * (m / corr1) / \
+            (np.sqrt(v / corr2) + np.float32(self.eps))
+
+
+class CtrAccessor:
+    """Bundle of rule + CTR lifecycle policy for one sparse table.
+
+    reference: CtrCommonAccessor (ctr_accessor.cc) — show/click statistics
+    with daily decay and threshold-based shrink of cold features.
+    """
+
+    def __init__(self, rule: _RuleBase | None = None,
+                 show_decay_rate: float = 0.98,
+                 shrink_show_threshold: float = 0.1,
+                 shrink_unseen_days: float = 7.0):
+        self.rule = rule or SparseAdaGradRule()
+        self.show_decay_rate = float(show_decay_rate)
+        self.shrink_show_threshold = float(shrink_show_threshold)
+        self.shrink_unseen_days = float(shrink_unseen_days)

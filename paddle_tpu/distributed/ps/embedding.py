@@ -1,0 +1,149 @@
+"""Device-side sparse embedding over a PS table.
+
+reference capability: the PS-mode sparse embedding
+(python/paddle/static/nn/common.py sparse_embedding +
+fluid/distributed/ps wrapper/fleet.cc PullSparse/PushSparse around the op).
+
+TPU-native design: two paths.
+
+1. Eager (`PsEmbedding`): pull -> device gather -> compute; the backward is
+   a PyLayer whose vjp aggregates per-unique-id cotangents host-side and
+   pushes them to the servers. Per-batch dedup means each row crosses
+   host<->device once regardless of repetition.
+
+2. Compiled (`PsBatch`): the TPU-idiomatic pattern for jit train steps.
+   Host IO cannot live inside a traced program, so the step is
+       prepare(ids)  ->  jit(step)(rows, inv, ...)  ->  complete(drows)
+   with the unique-row buffer padded to a STATIC capacity so one executable
+   serves every batch (XLA static shapes; re-compilation would dwarf the
+   lookup). Padding rows are zero and their pushed gradients are dropped.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...autograd import PyLayer
+from ...framework.core import Tensor
+from ...nn import Layer
+
+__all__ = ["PsEmbedding", "ps_sparse_embedding", "PsBatch"]
+
+
+def _pull_unique(source, table_id, uniq):
+    if hasattr(source, "pull_unique"):
+        return source.pull_unique(table_id, uniq)
+    return source.pull(uniq)  # GeoWorkerCache binds its table_id
+
+
+def _push_unique(source, table_id, uniq, agg):
+    if hasattr(source, "push_unique"):
+        source.push_unique(table_id, uniq, agg)
+    else:
+        source.push(uniq, agg)
+
+
+class _PsLookup(PyLayer):
+    @staticmethod
+    def forward(ctx, anchor, ids, source, table_id, emb_dim):
+        ids_np = np.asarray(ids._data if isinstance(ids, Tensor) else ids)
+        shape = ids_np.shape
+        uniq, inv = np.unique(ids_np.reshape(-1).astype(np.uint64),
+                              return_inverse=True)
+        rows = _pull_unique(source, table_id, uniq)
+        out = jnp.asarray(rows)[jnp.asarray(inv)].reshape(
+            shape + (emb_dim,))
+        ctx.ps_state = (source, table_id, uniq, inv, emb_dim)
+        # anchor (a trainable scalar, always 0) keeps the node on the tape:
+        # integer ids carry no gradient, so without it autograd would prune
+        # the backward that performs the push
+        return Tensor(out + anchor._data.astype(out.dtype))
+
+    @staticmethod
+    def backward(ctx, grad):
+        source, table_id, uniq, inv, emb_dim = ctx.ps_state
+        g = np.asarray(grad._data, np.float32).reshape(-1, emb_dim)
+        agg = np.zeros((uniq.size, emb_dim), np.float32)
+        np.add.at(agg, inv, g)
+        _push_unique(source, table_id, uniq, agg)
+        return Tensor(jnp.zeros((1,), jnp.float32))  # d anchor
+
+
+class PsEmbedding(Layer):
+    """Eager sparse embedding backed by a PS client or geo cache.
+
+    forward(ids) pulls the batch's unique rows, gathers on device; backward
+    pushes aggregated row gradients (the server applies the table's rule).
+    """
+
+    def __init__(self, embedding_dim: int, source, table_id: int = 0,
+                 name: str | None = None):
+        super().__init__()
+        self.emb_dim = int(embedding_dim)
+        self.source = source
+        self.table_id = int(table_id)
+        # see _PsLookup.forward: tape anchor, mathematically zero
+        from ...nn import initializer as I
+        self.anchor = self.create_parameter(
+            (1,), dtype="float32", default_initializer=I.Constant(0.0))
+
+    def forward(self, ids):
+        return _PsLookup.apply(self.anchor, ids, self.source, self.table_id,
+                               self.emb_dim)
+
+
+def ps_sparse_embedding(ids, source, emb_dim: int, table_id: int = 0,
+                        anchor: Tensor | None = None):
+    """Functional flavor of PsEmbedding (no push on backward unless an
+    anchor with stop_gradient=False is supplied)."""
+    if anchor is None:
+        anchor = Tensor(jnp.zeros((1,), jnp.float32), stop_gradient=False)
+    return _PsLookup.apply(anchor, ids, source, table_id, emb_dim)
+
+
+class PsBatch:
+    """Static-shape pull/push bracket around a compiled train step.
+
+    Usage:
+        batch = PsBatch(client, table_id, emb_dim, capacity=4096)
+        rows, inv = batch.prepare(ids)          # host: pull + pad
+        loss, drows = jit_step(rows, inv, ...)  # device: gather via take
+        batch.complete(drows)                   # host: aggregate + push
+
+    Inside the jitted step, `embed = rows[inv]` (jnp.take) reconstructs the
+    per-position embeddings; `drows` must be the cotangent w.r.t. `rows`
+    (jax.grad gives it for free), already summed over duplicate positions
+    by the gather's transpose.
+    """
+
+    def __init__(self, source, table_id: int, emb_dim: int, capacity: int):
+        self.source = source
+        self.table_id = int(table_id)
+        self.emb_dim = int(emb_dim)
+        self.capacity = int(capacity)
+        self._uniq = None
+
+    def prepare(self, ids):
+        ids_np = np.asarray(ids).reshape(-1)
+        uniq, inv = np.unique(ids_np.astype(np.uint64), return_inverse=True)
+        if uniq.size > self.capacity:
+            raise ValueError(
+                f"batch has {uniq.size} unique ids > PsBatch capacity "
+                f"{self.capacity}; raise capacity (one-time recompile)")
+        rows = _pull_unique(self.source, self.table_id, uniq)
+        padded = np.zeros((self.capacity, self.emb_dim), np.float32)
+        padded[:uniq.size] = rows
+        inv_padded = np.zeros(ids_np.size, np.int32)
+        inv_padded[:] = inv  # padding rows are never referenced by inv
+        self._uniq = uniq
+        return jnp.asarray(padded), jnp.asarray(inv_padded)
+
+    def complete(self, drows) -> None:
+        if self._uniq is None:
+            raise RuntimeError("PsBatch.complete before prepare")
+        uniq = self._uniq
+        self._uniq = None
+        g = np.asarray(drows, np.float32)[:uniq.size]
+        _push_unique(self.source, self.table_id, uniq, g)

@@ -1,0 +1,265 @@
+"""Sparse/dense parameter-server tables (host-resident row stores).
+
+reference capability: paddle/fluid/distributed/ps/table/
+(memory_sparse_table.cc, memory_dense_table.cc, memory_sparse_geo_table.cc).
+
+TPU-native design: the table is HOST memory — on a TPU pod the dense model
+lives in HBM under GSPMD, and the PS exists for the workload class the
+reference built it for: sparse embedding tables larger than device memory.
+Rows live in the native C++ store (native/ps_table.cc, ctypes with the GIL
+released) with a bit-exact numpy fallback. Device interaction is pull ->
+jnp gather -> compute -> push, see ps/embedding.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as np
+
+from ... import _native
+from .accessor import (CtrAccessor, SparseAdaGradRule, _RuleBase,
+                       deterministic_init)
+
+__all__ = ["SparseTable", "DenseTable"]
+
+
+def _as_ids(ids) -> np.ndarray:
+    a = np.asarray(ids)
+    if a.dtype != np.uint64:
+        a = a.astype(np.uint64)
+    return np.ascontiguousarray(a.reshape(-1))
+
+
+class SparseTable:
+    """id -> embedding row store with a per-row optimizer rule.
+
+    Native-backed when the toolchain built (default); the numpy fallback is
+    semantically identical (same deterministic miss-init, same rules).
+    """
+
+    def __init__(self, emb_dim: int, accessor: CtrAccessor | None = None,
+                 use_native: bool | None = None):
+        self.emb_dim = int(emb_dim)
+        self.accessor = accessor or CtrAccessor(SparseAdaGradRule())
+        rule = self.accessor.rule
+        self._lock = threading.Lock()
+        if use_native is None:
+            use_native = _native.available
+        self._native = bool(use_native) and _native.available
+        if self._native:
+            self._h = _native.lib().pt_ps_table_new(
+                self.emb_dim, rule.rule_id, rule.learning_rate,
+                rule.initial_range, rule.eps, rule.beta1, rule.beta2)
+            if not self._h:
+                raise RuntimeError("pt_ps_table_new failed")
+        else:
+            # fallback store: id -> [w, slots, meta(show, click, unseen)]
+            self._rows: dict[int, list[np.ndarray]] = {}
+
+    # --- fallback helpers --------------------------------------------------
+    def _row(self, fid: int, create: bool):
+        r = self._rows.get(fid)
+        if r is None and create:
+            rule = self.accessor.rule
+            r = [deterministic_init(fid, self.emb_dim, rule.initial_range),
+                 rule.init_slots(self.emb_dim),
+                 np.zeros(3, np.float32)]
+            self._rows[fid] = r
+        return r
+
+    # --- core ops ----------------------------------------------------------
+    def pull(self, ids, init_on_miss: bool = True) -> np.ndarray:
+        ids = _as_ids(ids)
+        out = np.empty((ids.size, self.emb_dim), np.float32)
+        if self._native:
+            _native.lib().pt_ps_table_pull(
+                self._h, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                ids.size, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                1 if init_on_miss else 0)
+            return out
+        with self._lock:
+            for i, fid in enumerate(ids.tolist()):
+                r = self._row(fid, init_on_miss)
+                if r is None:
+                    out[i] = 0.0
+                else:
+                    out[i] = r[0]
+                    r[2][2] = 0.0  # unseen_days reset
+        return out
+
+    def push(self, ids, grads) -> None:
+        ids = _as_ids(ids)
+        grads = np.ascontiguousarray(
+            np.asarray(grads, np.float32).reshape(ids.size, self.emb_dim))
+        if self._native:
+            _native.lib().pt_ps_table_push(
+                self._h, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                ids.size,
+                grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            return
+        rule = self.accessor.rule
+        with self._lock:
+            for i, fid in enumerate(ids.tolist()):
+                r = self._row(fid, True)
+                rule.apply(r[0], r[1], grads[i])
+
+    def merge(self, ids, deltas) -> None:
+        """Additive weight merge (geo-SGD delta application; reference
+        memory_sparse_geo_table.cc) — bypasses the optimizer rule."""
+        ids = _as_ids(ids)
+        deltas = np.ascontiguousarray(
+            np.asarray(deltas, np.float32).reshape(ids.size, self.emb_dim))
+        if self._native:
+            _native.lib().pt_ps_table_merge(
+                self._h, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                ids.size,
+                deltas.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            return
+        with self._lock:
+            for i, fid in enumerate(ids.tolist()):
+                self._row(fid, True)[0] += deltas[i]
+
+    def assign(self, ids, rows) -> None:
+        ids = _as_ids(ids)
+        rows = np.ascontiguousarray(
+            np.asarray(rows, np.float32).reshape(ids.size, self.emb_dim))
+        if self._native:
+            _native.lib().pt_ps_table_assign(
+                self._h, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                ids.size,
+                rows.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            return
+        with self._lock:
+            for i, fid in enumerate(ids.tolist()):
+                self._row(fid, True)[0][:] = rows[i]
+
+    # --- lifecycle ---------------------------------------------------------
+    def __len__(self) -> int:
+        if self._native:
+            return int(_native.lib().pt_ps_table_size(self._h))
+        with self._lock:
+            return len(self._rows)
+
+    def keys(self) -> np.ndarray:
+        if self._native:
+            n = len(self)
+            out = np.empty(n, np.uint64)
+            got = _native.lib().pt_ps_table_keys(
+                self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                n)
+            return out[:got]
+        with self._lock:
+            return np.fromiter(self._rows.keys(), np.uint64,
+                               count=len(self._rows))
+
+    def add_show_click(self, ids, shows, clicks) -> None:
+        ids = _as_ids(ids)
+        shows = np.ascontiguousarray(np.asarray(shows, np.float32).reshape(-1))
+        clicks = np.ascontiguousarray(
+            np.asarray(clicks, np.float32).reshape(-1))
+        if self._native:
+            _native.lib().pt_ps_table_add_show_click(
+                self._h, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                ids.size,
+                shows.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                clicks.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            return
+        with self._lock:
+            for i, fid in enumerate(ids.tolist()):
+                m = self._row(fid, True)[2]
+                m[0] += shows[i]
+                m[1] += clicks[i]
+
+    def decay(self, rate: float | None = None) -> None:
+        rate = self.accessor.show_decay_rate if rate is None else float(rate)
+        if self._native:
+            _native.lib().pt_ps_table_decay(self._h, rate)
+            return
+        with self._lock:
+            for r in self._rows.values():
+                r[2][0] *= rate
+                r[2][1] *= rate
+                r[2][2] += 1.0
+
+    def shrink(self) -> int:
+        acc = self.accessor
+        if self._native:
+            return int(_native.lib().pt_ps_table_shrink(
+                self._h, acc.shrink_show_threshold, acc.shrink_unseen_days))
+        with self._lock:
+            dead = [fid for fid, r in self._rows.items()
+                    if r[2][0] < acc.shrink_show_threshold
+                    and r[2][2] >= acc.shrink_unseen_days]
+            for fid in dead:
+                del self._rows[fid]
+            return len(dead)
+
+    def save(self, path: str) -> None:
+        if self._native:
+            rc = _native.lib().pt_ps_table_save(self._h, path.encode())
+            if rc != 0:
+                raise IOError(f"ps table save failed rc={rc}: {path}")
+            return
+        with self._lock:
+            ids = np.fromiter(self._rows.keys(), np.uint64,
+                              count=len(self._rows))
+            np.savez(path, ids=ids,
+                     w=np.stack([self._rows[int(i)][0] for i in ids])
+                     if ids.size else np.zeros((0, self.emb_dim), np.float32),
+                     slots=np.stack([self._rows[int(i)][1] for i in ids])
+                     if ids.size else np.zeros((0, 0), np.float32),
+                     meta=np.stack([self._rows[int(i)][2] for i in ids])
+                     if ids.size else np.zeros((0, 3), np.float32))
+
+    def load(self, path: str) -> None:
+        if self._native:
+            rc = _native.lib().pt_ps_table_load(self._h, path.encode())
+            if rc != 0:
+                raise IOError(f"ps table load failed rc={rc}: {path}")
+            return
+        with np.load(path if path.endswith(".npz") else path + ".npz") as z:
+            with self._lock:
+                for i, fid in enumerate(z["ids"].tolist()):
+                    self._rows[fid] = [z["w"][i].copy(), z["slots"][i].copy(),
+                                       z["meta"][i].copy()]
+
+    def __del__(self):  # pragma: no cover - interpreter teardown ordering
+        try:
+            if getattr(self, "_native", False) and getattr(self, "_h", None):
+                _native.lib().pt_ps_table_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+class DenseTable:
+    """Versioned dense parameter block (reference memory_dense_table.cc).
+
+    On TPU the dense path belongs to GSPMD; this exists for PS-mode parity:
+    small dense params (biases, batch-norm stats) that recsys jobs keep on
+    the server. Updates are plain SGD on the server; workers pull snapshots.
+    """
+
+    def __init__(self, shape, learning_rate: float = 0.05):
+        self.value = np.zeros(shape, np.float32)
+        self.learning_rate = float(learning_rate)
+        self.version = 0
+        self._lock = threading.Lock()
+
+    def pull(self) -> tuple[np.ndarray, int]:
+        with self._lock:
+            return self.value.copy(), self.version
+
+    def push(self, grad) -> None:
+        g = np.asarray(grad, np.float32).reshape(self.value.shape)
+        with self._lock:
+            self.value -= self.learning_rate * g
+            self.version += 1
+
+    def assign(self, value) -> None:
+        v = np.asarray(value, np.float32).reshape(self.value.shape)
+        with self._lock:
+            self.value[:] = v
+            self.version += 1

@@ -1,0 +1,89 @@
+"""Bisect which model dimension breaks the axon remote-compile helper.
+
+Every >=780M ladder config has failed `lower().compile()` with
+`remote_compile: HTTP 500: tpu_compile_helper subprocess exit code 1`
+since round 2, while llama_535m compiles and runs. This probe compiles ONE
+parameterized scanned-llama train step and reports OK/FAIL with timing, so
+a queue job can walk a matrix of (layers, hidden, intermediate, batch,
+seq, attention backend, remat) and locate the breaking dimension.
+
+Usage: python tools/compile_probe.py L H I B S [xla|flash] [remat] [heads H]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    args = sys.argv[1:]
+    L, H, I, B, S = (int(a) for a in args[:5])
+    backend = args[5] if len(args) > 5 else "flash"
+    remat = len(args) > 6 and args[6] in ("1", "remat", "true")
+    heads = int(args[7]) if len(args) > 7 else 16
+    if backend == "xla":
+        os.environ["FLAGS_flash_attention_backend"] = "xla"
+
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.scanned import build_scanned_llama
+
+    tag = (f"L{L} h{H} i{I} b{B} s{S} heads{heads} {backend} "
+           f"remat={int(remat)}")
+    t0 = time.time()
+
+    def log(msg):
+        print(f"[probe {time.time() - t0:6.1f}s] {tag}: {msg}", flush=True)
+
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=H, intermediate_size=I,
+                      num_hidden_layers=L, num_attention_heads=heads,
+                      max_position_embeddings=S, dtype="bfloat16")
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    n = model.num_params()
+    params, loss_fn = build_scanned_llama(model, remat=remat,
+                                          dtype="bfloat16")
+    opt = optimizer.AdamW(3e-4, parameters=model.parameters())
+    opt_state = opt.tree_init(params)
+    for t in model.state_dict().values():
+        t._data = jnp.zeros((), t._data.dtype)
+    log(f"{n/1e6:.0f}M params materialized")
+
+    def train_step(p, st, ids, labels, lr, stp):
+        loss, grads = jax.value_and_grad(loss_fn)(p, ids, labels)
+        new_p, new_st = opt.tree_update(p, grads, st, lr, stp)
+        return loss, new_p, new_st
+
+    jstep = jax.jit(train_step, donate_argnums=(0, 1))
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+    try:
+        lowered = jstep.lower(params, opt_state, ids, ids, jnp.float32(3e-4),
+                              jnp.int32(1))
+        hlo_mb = len(lowered.as_text()) / 1e6
+        log(f"lowered ({hlo_mb:.1f}MB StableHLO text)")
+        compiled = lowered.compile()
+        log("COMPILED")
+        loss, params, opt_state = compiled(params, opt_state, ids, ids,
+                                           jnp.float32(3e-4), jnp.int32(1))
+        log(f"STEP OK loss={float(loss):.4f}")
+        print(f"PROBE_RESULT OK {tag}", flush=True)
+    except Exception as e:  # noqa: BLE001
+        log(f"FAILED {type(e).__name__}: {str(e)[:400]}")
+        print(f"PROBE_RESULT FAIL {tag}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

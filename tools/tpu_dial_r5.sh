@@ -11,14 +11,46 @@
 cd /root/repo || exit 1
 LOG=.tpu_watch.log
 log() { echo "$(date +%H:%M:%S) $*" >> "$LOG"; }
+# single-instance guard: refuse to start only if the recorded pid is alive
+# AND is actually a dial (pid reuse must not block forever)
+LOCK=.tpu_dial.pid
+if [ -f "$LOCK" ]; then
+  oldpid=$(cat "$LOCK")
+  if kill -0 "$oldpid" 2>/dev/null && \
+     grep -aq tpu_dial_r5 "/proc/$oldpid/cmdline" 2>/dev/null; then
+    log "=== dial already running (pid $oldpid); refusing duplicate ==="
+    exit 0
+  fi
+fi
+echo $$ > "$LOCK"
+trap 'rm -f "$LOCK"' EXIT
 mkdir -p .tpu_queue
 log "=== round-5 dial starts (pid $$) ==="
 
+probe_once() {
+  # stderr goes to a file, not /dev/null — an empty answer with no
+  # diagnostics cost us the first night of the round
+  timeout 3600 python bench.py --worker --probe 2> .tpu_probe.err | tail -1
+}
+
 warmed=0
+if [ -f .tpu_warm_done ]; then
+  # marker survives restarts; revalidate the tunnel before trusting it so
+  # a dead tunnel can't burn the whole queue against mv-to-.done failures
+  out=$(probe_once)
+  if echo "$out" | grep -q tpu_alive; then
+    warmed=1
+    log "warm marker present and tunnel alive - resuming queue drain"
+  else
+    rm -f .tpu_warm_done
+    log "warm marker present but tunnel dead (${out:-<no output>}) - reprobing"
+  fi
+fi
 for i in $(seq 1 40); do
-  out=$(timeout 3600 python bench.py --worker --probe 2>/dev/null | tail -1)
-  rc=$?
-  log "probe[$i] rc=$rc: $out"
+  [ "$warmed" = 1 ] && break
+  out=$(probe_once)
+  errtail=$(tail -c 300 .tpu_probe.err 2>/dev/null | tr '\n' ' ')
+  log "probe[$i]: ${out:-<no output>} err: ${errtail:-<none>}"
   if echo "$out" | grep -q tpu_alive; then
     log "TUNNEL ALIVE - warming ladder untimed (configs 3 2 1 0 + resnet + bert)"
     python tools/tpu_ladder_warm.py 3 2 1 0 resnet bert >> "$LOG" 2>&1
@@ -27,9 +59,9 @@ for i in $(seq 1 40); do
     warmed=1
     break
   fi
-  if [ $rc -ge 124 ]; then
-    # we just killed a wedged dial: back off hard before touching it again
-    log "probe timed out (killed worker may wedge tunnel) - backoff 1800s"
+  if [ -z "$out" ]; then
+    # probe died or was killed mid-dial: treat as a possible wedge
+    log "probe produced no output - backoff 1800s"
     sleep 1800
   else
     sleep 900
@@ -48,8 +80,8 @@ while true; do
   if [ -n "$job" ]; then
     if [ "$warmed" = 0 ] && ! echo "$job" | grep -q '\.cpu\.sh$'; then
       # tunnel never came up: retry a probe before each TPU job
-      out=$(timeout 3600 python bench.py --worker --probe 2>/dev/null | tail -1)
-      log "pre-job probe: $out"
+      out=$(probe_once)
+      log "pre-job probe: ${out:-<no output>}"
       if ! echo "$out" | grep -q tpu_alive; then
         log "tunnel still down; parking job $job for 900s"
         sleep 900

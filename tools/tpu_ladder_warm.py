@@ -94,15 +94,26 @@ def warm_secondary(which):
 
 def main():
     args = sys.argv[1:] or ["3", "2", "1", "0"]
+    if len(args) > 1:
+        # one subprocess per config: a failed compile can leave HBM and
+        # tunnel state wedged in-process (r5: config-2 500 cascaded into
+        # RESOURCE_EXHAUSTED for every later config in the same process)
+        import subprocess
+        for a in args:
+            r = subprocess.run([sys.executable, os.path.abspath(__file__), a])
+            if r.returncode:
+                # a SIGKILL'd child (compile OOM) skips its own FAILED line
+                log(f"{a} FAILED: warm subprocess rc={r.returncode}")
+        return
+    a = args[0]
     log(f"devices: {jax.devices()}")
-    for a in args:
-        try:
-            if a in ("resnet", "bert"):
-                warm_secondary(a)
-            else:
-                warm_one(int(a))
-        except Exception as e:  # noqa: BLE001
-            log(f"{a} FAILED: {type(e).__name__}: {str(e)[:300]}")
+    try:
+        if a in ("resnet", "bert"):
+            warm_secondary(a)
+        else:
+            warm_one(int(a))
+    except Exception as e:  # noqa: BLE001
+        log(f"{a} FAILED: {type(e).__name__}: {str(e)[:300]}")
 
 
 if __name__ == "__main__":

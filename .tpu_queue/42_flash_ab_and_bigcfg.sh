@@ -1,0 +1,15 @@
+# (a) A/B the 535m train step: flash (current default) vs dense XLA —
+#     r2 measured 28400 tok/s (52.16% MFU) on this exact config/device
+#     BEFORE flash auto-selection existed; r5 measures 23068 (42.4%).
+# (b) big-config probes with the 16-bytes/param transient-peak model in
+#     mind: remat shrinks activations; b4 shrinks them further.
+cd /root/repo
+echo "=== 535m A/B: dense XLA attention"
+FLAGS_flash_attention_backend=xla timeout 1500 python bench.py --worker --config 3 2> .diag_ab_xla.err | tail -1
+echo "=== 535m A/B: pallas flash attention (default)"
+timeout 1500 python bench.py --worker --config 3 2> .diag_ab_flash.err | tail -1
+P="timeout 1500 python tools/compile_probe.py"
+$P 16 1536 6144 8 2048 xla 1 2>&1 | grep -a "PROBE_RESULT\|FAILED\|STEP OK\|COMPILED"
+$P 16 1536 6144 4 2048 xla 1 2>&1 | grep -a "PROBE_RESULT\|FAILED\|STEP OK\|COMPILED"
+$P 24 2048 8192 4 2048 xla 1 2>&1 | grep -a "PROBE_RESULT\|FAILED\|STEP OK\|COMPILED"
+$P 8 2048 8192 4 2048 xla 0 2>&1 | grep -a "PROBE_RESULT\|FAILED\|STEP OK\|COMPILED"

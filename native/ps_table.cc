@@ -225,6 +225,18 @@ void pt_ps_table_assign(void* h, const uint64_t* ids, int64_t n,
   }
 }
 
+// Membership mask (no row creation, no stat mutation): out[i] = 1 iff
+// ids[i] has a live row. Drives the Python-side entry-admission gate.
+void pt_ps_table_contains(void* h, const uint64_t* ids, int64_t n,
+                          uint8_t* out) {
+  Table* t = static_cast<Table*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& sh = t->shards[t->shard_of(ids[i])];
+    std::lock_guard<std::mutex> lk(sh.mu);
+    out[i] = sh.index.count(ids[i]) ? 1 : 0;
+  }
+}
+
 int64_t pt_ps_table_size(void* h) {
   Table* t = static_cast<Table*>(h);
   int64_t total = 0;
@@ -302,30 +314,41 @@ int64_t pt_ps_table_shrink(void* h, float show_threshold,
 }
 
 // Binary checkpoint: header + (id, full row) records. Full rows (incl.
-// optimizer slots and meta) so training resumes exactly.
+// optimizer slots and meta) so training resumes exactly. All shard locks
+// are held for the duration — pulls/pushes from other threads wait, and
+// the header count always matches the records written (a count taken
+// before iteration can race a concurrent push/shrink). Every fwrite is
+// checked: a short write (disk full) must NOT report success.
 int pt_ps_table_save(void* h, const char* path) {
   Table* t = static_cast<Table*>(h);
   FILE* f = std::fopen(path, "wb");
   if (!f) return -1;
+  for (auto& sh : t->shards) sh.mu.lock();  // fixed order: no deadlock
+  int64_t count = 0;
+  for (auto& sh : t->shards) count += static_cast<int64_t>(sh.index.size());
   const char magic[4] = {'P', 'T', 'P', 'S'};
   int32_t version = 1;
-  int64_t count = pt_ps_table_size(h);
-  std::fwrite(magic, 1, 4, f);
-  std::fwrite(&version, sizeof(version), 1, f);
-  std::fwrite(&t->emb_dim, sizeof(t->emb_dim), 1, f);
-  std::fwrite(&t->rule, sizeof(t->rule), 1, f);
-  std::fwrite(&t->row_len, sizeof(t->row_len), 1, f);
-  std::fwrite(&count, sizeof(count), 1, f);
+  bool ok = std::fwrite(magic, 1, 4, f) == 4 &&
+            std::fwrite(&version, sizeof(version), 1, f) == 1 &&
+            std::fwrite(&t->emb_dim, sizeof(t->emb_dim), 1, f) == 1 &&
+            std::fwrite(&t->rule, sizeof(t->rule), 1, f) == 1 &&
+            std::fwrite(&t->row_len, sizeof(t->row_len), 1, f) == 1 &&
+            std::fwrite(&count, sizeof(count), 1, f) == 1;
   for (auto& sh : t->shards) {
-    std::lock_guard<std::mutex> lk(sh.mu);
+    if (!ok) break;
     for (auto& kv : sh.index) {
-      std::fwrite(&kv.first, sizeof(uint64_t), 1, f);
-      std::fwrite(sh.arena.data() + kv.second * t->row_len, sizeof(float),
-                  t->row_len, f);
+      if (std::fwrite(&kv.first, sizeof(uint64_t), 1, f) != 1 ||
+          std::fwrite(sh.arena.data() + kv.second * t->row_len,
+                      sizeof(float), t->row_len, f) !=
+              static_cast<size_t>(t->row_len)) {
+        ok = false;
+        break;
+      }
     }
   }
-  std::fclose(f);
-  return 0;
+  for (int i = kShards - 1; i >= 0; --i) t->shards[i].mu.unlock();
+  if (std::fclose(f) != 0) ok = false;
+  return ok ? 0 : -4;
 }
 
 int pt_ps_table_load(void* h, const char* path) {

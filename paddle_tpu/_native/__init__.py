@@ -108,6 +108,8 @@ def _bind(lib):
     lib.pt_ps_table_assign.argtypes = [c.c_void_p, u64p, c.c_int64, f32p]
     lib.pt_ps_table_size.restype = c.c_int64
     lib.pt_ps_table_size.argtypes = [c.c_void_p]
+    lib.pt_ps_table_contains.argtypes = [c.c_void_p, u64p, c.c_int64,
+                                         c.POINTER(c.c_uint8)]
     lib.pt_ps_table_keys.restype = c.c_int64
     lib.pt_ps_table_keys.argtypes = [c.c_void_p, u64p, c.c_int64]
     lib.pt_ps_table_add_show_click.argtypes = [c.c_void_p, u64p, c.c_int64,

@@ -192,13 +192,7 @@ class QueueDataset(_PSDescoped):
     pass
 
 
-class CountFilterEntry(_PSDescoped):
-    pass
-
-
-class ProbabilityEntry(_PSDescoped):
-    pass
-
-
-class ShowClickEntry(_PSDescoped):
-    pass
+# feature-admission entry policies — real since r5, backed by the TPU-native
+# parameter server (distributed/ps; reference entry_attr.py semantics)
+from .ps.accessor import (CountFilterEntry, ProbabilityEntry,  # noqa: E402
+                          ShowClickEntry)

@@ -425,10 +425,12 @@ class PaddleCloudRoleMaker:
 
 class UserDefinedRoleMaker(PaddleCloudRoleMaker):
     def __init__(self, is_collective=True, current_id=0, worker_num=1,
-                 role=Role.WORKER, **kwargs):
+                 role=Role.WORKER, server_endpoints=None, **kwargs):
+        self._collective = bool(is_collective)
         self._rank = current_id
         self._size = worker_num
         self._role = role
+        self._server_endpoints = list(server_endpoints or [])
 
     def role(self):
         return self._role

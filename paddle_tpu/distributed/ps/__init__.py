@@ -18,7 +18,8 @@ TPU-native redesign (NOT a port — see each module's docstring):
     embedding rows (DESIGN.md records this split)
 """
 
-from .accessor import (CtrAccessor, SparseAdaGradRule, SparseAdamRule,
+from .accessor import (CountFilterEntry, CtrAccessor, ProbabilityEntry,
+                       ShowClickEntry, SparseAdaGradRule, SparseAdamRule,
                        SparseNaiveSGDRule)
 from .embedding import PsBatch, PsEmbedding, ps_sparse_embedding
 from .service import (GeoWorkerCache, LocalChannel, PsClient, PsServer,
@@ -27,6 +28,7 @@ from .table import DenseTable, SparseTable
 from .the_one_ps import TheOnePs, from_env
 
 __all__ = [
+    "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry",
     "CtrAccessor", "SparseAdaGradRule", "SparseAdamRule",
     "SparseNaiveSGDRule", "PsBatch", "PsEmbedding", "ps_sparse_embedding",
     "GeoWorkerCache", "LocalChannel", "PsClient", "PsServer", "RpcChannel",

@@ -15,7 +15,8 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["SparseNaiveSGDRule", "SparseAdaGradRule", "SparseAdamRule",
-           "CtrAccessor"]
+           "CtrAccessor", "CountFilterEntry", "ProbabilityEntry",
+           "ShowClickEntry"]
 
 _M64 = (1 << 64) - 1
 
@@ -28,11 +29,35 @@ def _splitmix64(state: int):
     return (z ^ (z >> 31)) & _M64, state
 
 
+def deterministic_init_batch(feature_ids: np.ndarray, emb_dim: int,
+                             initial_range: float) -> np.ndarray:
+    """Vectorized bit-exact mirror of native init_row: one splitmix64
+    stream per feature id -> uniform[-initial_range, initial_range). A
+    never-pushed id pulls identical weights on every server and across
+    save/load. Vectorized over ids (the probationary-pull hot path must
+    not pay a per-id, per-dim Python loop)."""
+    ids = np.asarray(feature_ids, np.uint64).reshape(-1)
+    out = np.empty((ids.size, emb_dim), np.float32)
+    s = ids ^ np.uint64(0xA5A5A5A55A5A5A5A)
+    with np.errstate(over="ignore"):
+        for d in range(emb_dim):
+            s = s + np.uint64(0x9E3779B97F4A7C15)
+            z = s ^ (s >> np.uint64(30))
+            z = z * np.uint64(0xBF58476D1CE4E5B9)
+            z ^= z >> np.uint64(27)
+            z = z * np.uint64(0x94D049BB133111EB)
+            z ^= z >> np.uint64(31)
+            u = (z >> np.uint64(40)).astype(np.float32) / \
+                np.float32(1 << 24)
+            out[:, d] = (np.float32(2.0) * u - np.float32(1.0)) * \
+                np.float32(initial_range)
+    return out
+
+
 def deterministic_init(feature_id: int, emb_dim: int,
                        initial_range: float) -> np.ndarray:
-    """Bit-exact mirror of native init_row: splitmix64 stream seeded by the
-    feature id -> uniform[-initial_range, initial_range). A never-pushed id
-    pulls identical weights on every server and across save/load."""
+    """Scalar flavor of deterministic_init_batch (the executable spec the
+    tests pin against the native store)."""
     s = int(feature_id) ^ 0xA5A5A5A55A5A5A5A
     out = np.empty(emb_dim, np.float32)
     for d in range(emb_dim):
@@ -125,14 +150,59 @@ class CtrAccessor:
     """Bundle of rule + CTR lifecycle policy for one sparse table.
 
     reference: CtrCommonAccessor (ctr_accessor.cc) — show/click statistics
-    with daily decay and threshold-based shrink of cold features.
+    with daily decay and threshold-based shrink of cold features; `entry`
+    is the feature-admission policy (reference python/paddle/distributed/
+    entry_attr.py CountFilterEntry/ProbabilityEntry/ShowClickEntry).
     """
 
     def __init__(self, rule: _RuleBase | None = None,
                  show_decay_rate: float = 0.98,
                  shrink_show_threshold: float = 0.1,
-                 shrink_unseen_days: float = 7.0):
+                 shrink_unseen_days: float = 7.0,
+                 entry=None):
         self.rule = rule or SparseAdaGradRule()
         self.show_decay_rate = float(show_decay_rate)
         self.shrink_show_threshold = float(shrink_show_threshold)
         self.shrink_unseen_days = float(shrink_unseen_days)
+        self.entry = entry
+
+
+class CountFilterEntry:
+    """Admit a feature into the table only after it was pushed `count_filter`
+    times (reference entry_attr.py CountFilterEntry — keeps one-off ids from
+    bloating the table)."""
+
+    def __init__(self, count_filter: int):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self.count_filter = int(count_filter)
+
+    def admit(self, feature_id: int, seen_count: int) -> bool:
+        return seen_count >= self.count_filter
+
+
+class ProbabilityEntry:
+    """Admit with fixed probability, deterministic per feature id
+    (reference entry_attr.py ProbabilityEntry)."""
+
+    def __init__(self, probability: float):
+        if not 0.0 <= probability <= 1.0:
+            raise ValueError("probability must be in [0, 1]")
+        self.probability = float(probability)
+
+    def admit(self, feature_id: int, seen_count: int) -> bool:
+        r, _ = _splitmix64(int(feature_id) ^ 0xC0FFEE)
+        return (r >> 11) / float(1 << 53) < self.probability
+
+
+class ShowClickEntry:
+    """Names the show/click input slots feeding the CTR statistics
+    (reference entry_attr.py ShowClickEntry); admission is unconditional —
+    the stats drive decay/shrink, not entry."""
+
+    def __init__(self, show_name: str, click_name: str):
+        self.show_name = str(show_name)
+        self.click_name = str(click_name)
+
+    def admit(self, feature_id: int, seen_count: int) -> bool:
+        return True

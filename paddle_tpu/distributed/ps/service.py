@@ -233,28 +233,15 @@ class PsClient:
     def pull(self, table_id: int, ids) -> np.ndarray:
         ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.uint64)
         uniq, inv = np.unique(ids, return_inverse=True)
-        owners = owner_of(uniq, self.n)
-        rows = None
-        futs = {}
-        for s in range(self.n):
-            sel = np.nonzero(owners == s)[0]
-            if sel.size == 0:
-                continue
-            futs[s] = (sel, self._pool.submit(
-                self.channels[s].pull, table_id, uniq[sel]))
-        for s, (sel, fut) in futs.items():
-            part = fut.result()
-            if rows is None:
-                rows = np.empty((uniq.size, part.shape[1]), np.float32)
-            rows[sel] = part
-        if rows is None:
-            rows = np.zeros((0, 1), np.float32)
-        return rows[inv]
+        return self.pull_unique(table_id, uniq)[inv]
 
     def pull_unique(self, table_id: int, uniq_ids) -> np.ndarray:
         """Pull already-unique ids (the embedding layer dedups on device)."""
         uniq = np.ascontiguousarray(np.asarray(uniq_ids).reshape(-1),
                                     np.uint64)
+        if uniq.size == 0:
+            # delegate so the (0, emb_dim) width comes from the table
+            return self.channels[0].pull(table_id, uniq)
         owners = owner_of(uniq, self.n)
         rows = None
         futs = {}

@@ -20,7 +20,7 @@ import numpy as np
 
 from ... import _native
 from .accessor import (CtrAccessor, SparseAdaGradRule, _RuleBase,
-                       deterministic_init)
+                       deterministic_init, deterministic_init_batch)
 
 __all__ = ["SparseTable", "DenseTable"]
 
@@ -48,6 +48,11 @@ class SparseTable:
         if use_native is None:
             use_native = _native.available
         self._native = bool(use_native) and _native.available
+        # feature-admission policy (reference entry_attr.py): probationary
+        # ids live only in this counter until the policy admits them — the
+        # row store never sees a rejected feature
+        self._entry = self.accessor.entry
+        self._probation: dict[int, int] = {}
         if self._native:
             self._h = _native.lib().pt_ps_table_new(
                 self.emb_dim, rule.rule_id, rule.learning_rate,
@@ -69,9 +74,33 @@ class SparseTable:
             self._rows[fid] = r
         return r
 
+    def contains(self, ids) -> np.ndarray:
+        """Membership mask (no row creation)."""
+        ids = _as_ids(ids)
+        if self._native:
+            out = np.empty(ids.size, np.uint8)
+            _native.lib().pt_ps_table_contains(
+                self._h, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                ids.size, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+            return out.astype(bool)
+        with self._lock:
+            return np.array([fid in self._rows for fid in ids.tolist()],
+                            bool)
+
     # --- core ops ----------------------------------------------------------
     def pull(self, ids, init_on_miss: bool = True) -> np.ndarray:
         ids = _as_ids(ids)
+        if self._entry is not None and init_on_miss:
+            # probationary ids read their would-be init without entering
+            # the store; the entry policy admits rows on push only
+            present = self.contains(ids)
+            out = self.pull(ids, init_on_miss=False)
+            missing = np.nonzero(~present)[0]
+            if missing.size:
+                out[missing] = deterministic_init_batch(
+                    ids[missing], self.emb_dim,
+                    self.accessor.rule.initial_range)
+            return out
         out = np.empty((ids.size, self.emb_dim), np.float32)
         if self._native:
             _native.lib().pt_ps_table_pull(
@@ -93,6 +122,24 @@ class SparseTable:
         ids = _as_ids(ids)
         grads = np.ascontiguousarray(
             np.asarray(grads, np.float32).reshape(ids.size, self.emb_dim))
+        if self._entry is not None:
+            present = self.contains(ids)
+            keep = present.copy()
+            with self._lock:
+                for i in np.nonzero(~present)[0]:
+                    fid = int(ids[i])
+                    n = self._probation.get(fid, 0) + 1
+                    if self._entry.admit(fid, n):
+                        self._probation.pop(fid, None)
+                        keep[i] = True  # admitted: row created by the push
+                    else:
+                        self._probation[fid] = n  # rejected: drop the grad
+            if not keep.all():
+                ids, grads = ids[keep], grads[keep]
+                if ids.size == 0:
+                    return
+                grads = np.ascontiguousarray(grads)
+                ids = np.ascontiguousarray(ids)
         if self._native:
             _native.lib().pt_ps_table_push(
                 self._h, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),

@@ -20,6 +20,7 @@ from ..framework.core import Tensor
 from .functional import (functional_call, rmsnorm_lm_loss,
                          split_stacked_layer_params)
 from .pipeline import (InterleavedPipelinedLM, OneFOneBPipeline,
+                       ZeroBubblePipeline,
                        PipelinedLM)
 
 __all__ = ["LlamaPipeRunner"]
@@ -50,11 +51,12 @@ class LlamaPipeRunner:
             from ..framework import flags as _flags
             schedule = _flags.flag_value("pipeline_schedule")
         schedule = {"fthenb": "FThenB", "1f1b": "1F1B", "vpp": "VPP",
-                    "interleaved": "VPP"}.get(
+                    "interleaved": "VPP", "zb": "ZB", "zbh1": "ZB",
+                    "zerobubble": "ZB"}.get(
             schedule.lower().replace("-", ""), schedule)
-        if schedule not in ("FThenB", "1F1B", "VPP"):
+        if schedule not in ("FThenB", "1F1B", "VPP", "ZB"):
             raise ValueError(f"unknown pipeline schedule: {schedule!r} "
-                             "(expected 'FThenB', '1F1B' or 'VPP')")
+                             "(expected 'FThenB', '1F1B', 'VPP' or 'ZB')")
         self.schedule = schedule
         cfg = model.config
         pp = mesh.shape[axis_name]
@@ -110,7 +112,7 @@ class LlamaPipeRunner:
             return h
 
         tied = "lm_head" not in self.head_params
-        if tied and schedule != "1F1B":
+        if tied and schedule not in ("1F1B", "ZB"):
             raise NotImplementedError(
                 "tied embeddings need the 1F1B schedule "
                 "(LlamaPipeRunner(..., schedule='1F1B')), which routes the "
@@ -122,8 +124,10 @@ class LlamaPipeRunner:
         def head_loss_fn_tied(hp, ep, h, labels):
             return rmsnorm_lm_loss(hp["norm"], ep["weight"].T, h, labels, eps)
 
-        if schedule == "1F1B":
-            self._pipe = OneFOneBPipeline(
+        if schedule in ("1F1B", "ZB"):
+            pipe_cls = (ZeroBubblePipeline if schedule == "ZB"
+                        else OneFOneBPipeline)
+            self._pipe = pipe_cls(
                 mesh, embed_fn, stage_fn,
                 head_loss_fn_tied if tied else head_loss_fn,
                 num_microbatches, axis_name, batch_axis=batch_axis,

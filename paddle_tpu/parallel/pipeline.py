@@ -33,7 +33,8 @@ except AttributeError:  # older spelling
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 __all__ = ["pipeline_forward", "pipeline_1f1b_grads", "PipelinedLM",
-           "OneFOneBPipeline", "InterleavedPipelinedLM"]
+           "OneFOneBPipeline", "ZeroBubblePipeline",
+           "InterleavedPipelinedLM"]
 
 
 def _pvary(x, axes):
@@ -175,7 +176,8 @@ def pipeline_1f1b_grads(embed_fn, stage_fn, head_loss_fn, embed_params,
                         stacked_stage_params, head_params, tokens_mb,
                         labels_mb, axis_name: str = "pp", *, p_size: int,
                         num_microbatches: int, vary_axes=None,
-                        tied_embed: bool = False):
+                        tied_embed: bool = False,
+                        wgrad_deferred: bool = False):
     """1F1B pipeline schedule: hand-scheduled forward AND backward.
 
     reference semantics: fleet/meta_parallel/pipeline_parallel.py:575
@@ -197,12 +199,30 @@ def pipeline_1f1b_grads(embed_fn, stage_fn, head_loss_fn, embed_params,
     With `tied_embed`, head_loss_fn takes (head_params, embed_params, h,
     labels) and its embed-weight cotangent is added into demb — the
     SharedLayerDesc analog (pp_layers.py:76).
+
+    With `wgrad_deferred` (the zero-bubble analog — reference
+    passes/pipeline_scheduler_pass/pipeline_zero_bubble.py ZBH1, which
+    splits backward into activation-grad B and weight-grad W and moves W
+    into bubbles): tick backwards compute ONLY dX (vjp w.r.t. the stage
+    input), recording each microbatch's output cotangent; ALL stage weight
+    gradients are then one batched vjp after the scans — bubble-free and
+    at full-batch matmul shapes (m× larger MXU tiles than per-tick dW).
+    TPU-native cost shape (per-stage-forward units F, with per-microbatch
+    remat; dX = dW = F): 1F1B pays 4m+4(p-1) serial tick units, deferred-W
+    pays 5m+3(p-1) — the post-scan wgrad re-runs the forward once more, so
+    it wins when m < p-1, ties at m = p-1, and trades ~(m-p+1)F of ticks
+    for bubble-free full-batch wgrad matmuls otherwise (measured in
+    tools/pipeline_tax.py). Memory: the input buffer must hold all M
+    microbatch boundaries plus M output cotangents (2m boundary tensors vs
+    1F1B's 2p-1).
     """
     my_stage = jax.lax.axis_index(axis_name)
     vary = tuple(vary_axes) if vary_axes else (axis_name,)
     m = num_microbatches
     p = p_size
-    k = min(m, 2 * p - 1)  # live-activation ring buffer depth (the 1F1B bound)
+    # live-activation ring buffer depth: the 1F1B bound, or all M when the
+    # deferred wgrad needs every stage input after the scans
+    k = m if wgrad_deferred else min(m, 2 * p - 1)
     # Replicated (unvarying) params must be made varying before vjp: jax's
     # vma-aware transpose auto-psums cotangents toward unvarying inputs,
     # which would pre-sum grads across stages and break the per-stage
@@ -222,10 +242,17 @@ def pipeline_1f1b_grads(embed_fn, stage_fn, head_loss_fn, embed_params,
         def fwd_and_loss(sp, hp, ep, h_in, lab):
             h_out = stage_fn(sp, h_in)
             return h_out, head_loss_fn(hp, ep, h_out, lab)
+
+        def head_call(hp, ep, h_out, lab):
+            return head_loss_fn(hp, ep, h_out, lab)
     else:
         def fwd_and_loss(sp, hp, ep, h_in, lab):
             h_out = stage_fn(sp, h_in)
             return h_out, head_loss_fn(hp, h_out, lab)
+
+        def head_call(hp, ep, h_out, lab):
+            del ep  # untied head never reads the embedding (zero cotangent)
+            return head_loss_fn(hp, h_out, lab)
 
     h_shape = jax.eval_shape(
         lambda ep, t: embed_fn(ep, t), embed_params, tokens_mb[0])
@@ -239,11 +266,16 @@ def pipeline_1f1b_grads(embed_fn, stage_fn, head_loss_fn, embed_params,
         recv_b=_pvary(zero_h, vary),
         buf=_pvary(jnp.zeros((k,) + h_shape.shape, h_shape.dtype), vary),
         demb=_pvary(zeros_like_tree(embed_params), vary),
-        dstage=_pvary(zeros_like_tree(local_params), vary),
         dhead=_pvary(zeros_like_tree(head_params), vary),
         dh0=_pvary(jnp.zeros((m,) + h_shape.shape, h_shape.dtype), vary),
         loss=_pvary(jnp.zeros((), jnp.float32), vary),
     )
+    if wgrad_deferred:
+        # per-microbatch output cotangents for the post-scan batched wgrad
+        carry0["dhout"] = _pvary(
+            jnp.zeros((m,) + h_shape.shape, h_shape.dtype), vary)
+    else:
+        carry0["dstage"] = _pvary(zeros_like_tree(local_params), vary)
 
     inv_m = jnp.float32(1.0 / m)
 
@@ -291,6 +323,40 @@ def pipeline_1f1b_grads(embed_fn, stage_fn, head_loss_fn, embed_params,
         h_saved = buf[jnp.mod(j, k)]
         bmask = lambda g: jnp.where(b_active, g, jnp.zeros_like(g))
         demb, dhead, loss = carry["demb"], carry["dhead"], carry["loss"]
+
+        if wgrad_deferred:
+            # dX-only tick: vjp w.r.t. the stage INPUT; the stage weight
+            # cotangent is deferred to the post-scan batched vjp
+            h_out_b, pull_x = jax.vjp(
+                lambda h: stage_fn(local_params, h), h_saved)
+            if do_head:
+                lab_j = labels_mb[jnp.clip(j, 0, m - 1)]
+                is_last = my_stage == p - 1
+                loss_j, pull_head = jax.vjp(
+                    lambda hp, ep, h: head_call(hp, ep, h, lab_j),
+                    head_params, embed_params, h_out_b)
+                seed_loss = _pvary(
+                    jnp.where(is_last & b_active, inv_m, jnp.float32(0)),
+                    vary)
+                dhp, dhp_emb, dh_out_head = pull_head(seed_loss)
+                dhead = jax.tree_util.tree_map(
+                    lambda acc, g: acc + bmask(g), dhead, dhp)
+                demb = jax.tree_util.tree_map(
+                    lambda acc, g: acc + bmask(g), demb, dhp_emb)
+                loss = loss + jnp.where(is_last & b_active,
+                                        loss_j * inv_m, 0.0)
+                dh_out = jnp.where(is_last, dh_out_head, carry["recv_b"])
+            else:
+                dh_out = carry["recv_b"]
+            dh_out = bmask(dh_out)
+            (dh_in,) = pull_x(dh_out)
+            dhout = carry["dhout"].at[jnp.clip(j, 0, m - 1)].add(dh_out)
+            dh0 = carry["dh0"].at[jnp.clip(j, 0, m - 1)].add(
+                jnp.where((my_stage == 0) & b_active, dh_in,
+                          jnp.zeros_like(dh_in)))
+            send_b = jax.lax.ppermute(bmask(dh_in), axis_name, perm_bwd)
+            return dict(recv_f=send_f, recv_b=send_b, buf=buf, demb=demb,
+                        dhout=dhout, dhead=dhead, dh0=dh0, loss=loss), None
 
         if do_head:
             lab_j = labels_mb[jnp.clip(j, 0, m - 1)]
@@ -356,6 +422,18 @@ def pipeline_1f1b_grads(embed_fn, stage_fn, head_loss_fn, embed_params,
     carry["demb"] = jax.tree_util.tree_map(
         lambda acc, g: acc + g, carry["demb"], dep)
 
+    if wgrad_deferred:
+        # deferred stage wgrad: ONE batched vjp over all M microbatches.
+        # buf slots are microbatch-ordered (k == m), every (stage, j) pair
+        # was filled during the forward ticks, so this is fully dense —
+        # no masking, full-batch matmul shapes, zero pipeline bubble.
+        def batched_stage(sp):
+            return jax.vmap(lambda h: stage_fn(sp, h))(carry["buf"])
+
+        _, pull_w = jax.vjp(batched_stage, local_params)
+        (dstage_acc,) = pull_w(carry["dhout"])
+        carry["dstage"] = dstage_acc
+
     # loss lives on the last stage; grads for replicated params only on
     # their owning stages — psum over pp makes them correct everywhere.
     loss = jax.lax.psum(jnp.where(my_stage == p - 1, carry["loss"], 0.0),
@@ -379,6 +457,8 @@ class OneFOneBPipeline:
     embedding — reference SharedLayerDesc (pp_layers.py:76).
     """
 
+    wgrad_deferred = False  # ZeroBubblePipeline flips this
+
     def __init__(self, mesh: Mesh, embed_fn, stage_fn, head_loss_fn,
                  num_microbatches: int, axis_name: str = "pp",
                  batch_axis: str | None = None, tied_embed: bool = False):
@@ -398,6 +478,7 @@ class OneFOneBPipeline:
         batch_axis = self.batch_axis
         p_size = mesh.shape[axis]
         tied = self.tied_embed
+        deferred = self.wgrad_deferred
 
         def spmd_grads(embed_params, stage_params, head_params, tokens,
                        labels):
@@ -410,7 +491,7 @@ class OneFOneBPipeline:
                     self.embed_fn, self.stage_fn, self.head_loss_fn,
                     embed_p, stage_p, head_p, tok_mb, lab_mb, axis,
                     p_size=p_size, num_microbatches=m, vary_axes=vary,
-                    tied_embed=tied)
+                    tied_embed=tied, wgrad_deferred=deferred)
                 if batch_axis is not None:
                     loss = jax.lax.pmean(loss, batch_axis)
                     demb, dstage, dhead = jax.tree_util.tree_map(
@@ -436,6 +517,23 @@ class OneFOneBPipeline:
                 embed_params, stage_params, head_params, tokens, labels)
 
         return spmd_grads
+
+
+class ZeroBubblePipeline(OneFOneBPipeline):
+    """Deferred-weight-grad pipeline schedule — the TPU-native zero-bubble.
+
+    reference capability: pipeline_zero_bubble.py ZBH1/ZBVPP (split
+    backward into activation-grad B and weight-grad W, schedule W into
+    pipeline bubbles). In this SPMD-scan design there are no per-device
+    idle slots to fill — so instead of reordering W within ticks, W leaves
+    the pipeline entirely: ticks compute only dX, and every stage's weight
+    gradient is ONE post-scan batched vjp at full-batch matmul shapes.
+    See pipeline_1f1b_grads(wgrad_deferred=True) for the measured cost
+    model (wins at m <= p-1 microbatches or when per-microbatch matmuls
+    underutilize the MXU; 1F1B wins the serial-flop count at m >> p).
+    """
+
+    wgrad_deferred = True
 
 
 class PipelinedLM:

@@ -10,7 +10,8 @@ from jax.sharding import Mesh
 import paddle_tpu as paddle
 from paddle_tpu import optimizer
 from paddle_tpu.parallel.pipeline import (
-    OneFOneBPipeline, PipelinedLM, pipeline_forward_interleaved, shard_map)
+    OneFOneBPipeline, PipelinedLM, ZeroBubblePipeline,
+    pipeline_forward_interleaved, shard_map)
 from paddle_tpu.parallel.llama_pipeline import LlamaPipeRunner
 from jax.sharding import PartitionSpec as P
 
@@ -150,6 +151,58 @@ class Test1F1BPipeline:
                                    rtol=1e-4, atol=1e-6)
         np.testing.assert_allclose(np.asarray(dhead), np.asarray(rg[2]),
                                    rtol=1e-4, atol=1e-6)
+
+    @pytest.mark.parametrize("p,m", [(4, 4), (4, 8), (2, 4), (4, 2)])
+    def test_zero_bubble_grads_match_sequential(self, p, m):
+        """The deferred-wgrad (ZB) schedule must hit the same parity bar as
+        1F1B — dX-only ticks + one post-scan batched weight vjp."""
+        (mesh, ew, sw, hw, embed_fn, stage_fn, head_loss_fn,
+         rs) = _toy(p)
+        pipe = ZeroBubblePipeline(mesh, embed_fn, stage_fn, head_loss_fn,
+                                  num_microbatches=m)
+        gf = jax.jit(pipe.loss_and_grad_fn())
+        tok = jnp.asarray(rs.randint(0, 64, (8, 16)), jnp.int32)
+        lab = jnp.asarray(rs.randint(0, 64, (8, 16)), jnp.int32)
+        loss, demb, dstage, dhead = gf(ew, sw, hw, tok, lab)
+
+        def ref(ew_, sw_, hw_):
+            h = ew_[tok]
+            for i in range(p):
+                h = stage_fn(sw_[i], h)
+            return head_loss_fn(hw_, h, lab)
+
+        rl, rg = jax.value_and_grad(ref, argnums=(0, 1, 2))(ew, sw, hw)
+        assert abs(float(loss) - float(rl)) < 1e-5
+        for a, b in zip((demb, dstage, dhead), rg):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_zero_bubble_tied_embed(self):
+        (mesh, ew, sw, hw, embed_fn, stage_fn, _, rs) = _toy(4)
+
+        def head_loss_tied(hp, ep, h, lab):
+            lp = jax.nn.log_softmax((h * hp[None, None]) @ ep.T, -1)
+            return -jnp.mean(jnp.take_along_axis(lp, lab[..., None], -1))
+
+        gain = jnp.ones((32,), jnp.float32)
+        pipe = ZeroBubblePipeline(mesh, embed_fn, stage_fn, head_loss_tied,
+                                  num_microbatches=4, tied_embed=True)
+        gf = jax.jit(pipe.loss_and_grad_fn())
+        tok = jnp.asarray(rs.randint(0, 64, (8, 16)), jnp.int32)
+        lab = jnp.asarray(rs.randint(0, 64, (8, 16)), jnp.int32)
+        loss, demb, dstage, dhead = gf(ew, sw, gain, tok, lab)
+
+        def ref(ew_, sw_, hp_):
+            h = ew_[tok]
+            for i in range(4):
+                h = stage_fn(sw_[i], h)
+            return head_loss_tied(hp_, ew_, h, lab)
+
+        rl, rg = jax.value_and_grad(ref, argnums=(0, 1, 2))(ew, sw, gain)
+        assert abs(float(loss) - float(rl)) < 1e-5
+        for a, b in zip((demb, dstage, dhead), rg):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
 
     def test_peak_memory_beats_fill_drain_at_many_microbatches(self):
         """1F1B keeps O(P) live activations vs fill-drain's O(M): at m >> p

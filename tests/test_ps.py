@@ -45,6 +45,21 @@ def test_miss_init_deterministic_and_seen():
     assert len(t) == 1
 
 
+def test_batch_init_matches_scalar_spec():
+    from paddle_tpu.distributed.ps.accessor import deterministic_init_batch
+    ids = np.array([0, 5, 2**50, 123456789], np.uint64)
+    b = deterministic_init_batch(ids, 16, 0.01)
+    for i, fid in enumerate(ids.tolist()):
+        np.testing.assert_array_equal(b[i],
+                                      deterministic_init(fid, 16, 0.01))
+
+
+def test_client_empty_ids_keep_width():
+    c = ps.TheOnePs([ps.TableConfig(0, 8)], num_servers=2).start_local()
+    assert c.pull_unique(0, np.array([], np.uint64)).shape == (0, 8)
+    assert c.pull(0, np.array([], np.uint64)).shape == (0, 8)
+
+
 def test_pull_without_init_returns_zeros():
     t = ps.SparseTable(4, _acc(ps.SparseNaiveSGDRule()))
     r = t.pull(np.array([55], np.uint64), init_on_miss=False)
@@ -78,6 +93,53 @@ def test_ctr_decay_and_shrink():
     assert t.shrink() == 1
     assert len(t) == 1
     assert 1 in t.keys().tolist()
+
+
+def test_count_filter_entry_admission():
+    """reference entry_attr.py CountFilterEntry: a feature enters the table
+    only after count_filter pushes; rejected pushes drop their grads."""
+    acc = ps.CtrAccessor(ps.SparseNaiveSGDRule(learning_rate=1.0),
+                         entry=ps.CountFilterEntry(3))
+    t = ps.SparseTable(4, acc)
+    fid = np.array([42], np.uint64)
+    g = np.ones((1, 4), np.float32)
+    init = t.pull(fid).copy()   # probationary read: no row created
+    assert len(t) == 0
+    t.push(fid, g)              # 1st push: rejected
+    t.push(fid, g)              # 2nd push: rejected
+    assert len(t) == 0
+    np.testing.assert_allclose(t.pull(fid), init)  # grads were dropped
+    t.push(fid, g)              # 3rd push: admitted, rule applies
+    assert len(t) == 1
+    np.testing.assert_allclose(t.pull(fid), init - 1.0, atol=1e-6)
+
+
+def test_probability_entry_deterministic():
+    always = ps.ProbabilityEntry(1.0)
+    never = ps.ProbabilityEntry(0.0)
+    t_a = ps.SparseTable(4, ps.CtrAccessor(ps.SparseNaiveSGDRule(),
+                                           entry=always))
+    t_n = ps.SparseTable(4, ps.CtrAccessor(ps.SparseNaiveSGDRule(),
+                                           entry=never))
+    ids = np.arange(20, dtype=np.uint64)
+    g = np.ones((20, 4), np.float32)
+    t_a.push(ids, g)
+    t_n.push(ids, g)
+    assert len(t_a) == 20 and len(t_n) == 0
+    # determinism: the same id decides the same way every time
+    p = ps.ProbabilityEntry(0.5)
+    assert [p.admit(i, 0) for i in range(64)] == \
+        [p.admit(i, 0) for i in range(64)]
+    assert 0 < sum(p.admit(i, 0) for i in range(256)) < 256
+
+
+def test_show_click_entry_unconditional():
+    acc = ps.CtrAccessor(ps.SparseNaiveSGDRule(),
+                         entry=ps.ShowClickEntry("show", "click"))
+    t = ps.SparseTable(4, acc)
+    t.push(np.array([7], np.uint64), np.ones((1, 4), np.float32))
+    assert len(t) == 1
+    assert acc.entry.show_name == "show"
 
 
 def test_dense_table_versioned():

@@ -113,11 +113,14 @@ def fmt_mem(b):
 def main():
     rows = []
     failures = []
-    for pp, mb in ((2, 4), (4, 8)):
+    for pp, mb in ((2, 4), (4, 8), (4, 2)):
         base_fl, base_mem, base_wall = baseline_gspmd(pp)
         rows.append((f"pure GSPMD dp={pp}", pp, mb, base_fl, base_mem,
                      base_wall, 1.0, 1.0))
-        for sched in ("FThenB", "1F1B", "VPP"):
+        scheds = ("FThenB", "1F1B", "VPP", "ZB")
+        if mb < pp:  # VPP needs mb % pp == 0; the small-mb row probes the
+            scheds = ("1F1B", "ZB")  # ZB-vs-1F1B crossover (m < p-1)
+        for sched in scheds:
             try:
                 fl, mem, wall = pipeline(sched, pp, mb)
             except Exception as e:  # noqa: BLE001

@@ -1,0 +1,21 @@
+# The r2-parity experiment + the memory-dieted big configs.
+# (1) 535m with the hybrid backward (pallas fwd + xla-remat bwd, now the
+#     auto default at seq<=2048): r2 measured 52.16% MFU on this exact
+#     path; the full-pallas bwd measured 42.4% earlier tonight.
+# (2) big configs with remat=ON + chunked LM loss (new ladder defaults):
+#     the compile-helper 500s were HBM overflow; this diet should fit
+#     780m and maybe 1.3b on the 16GB v5e.
+cd /root/repo
+echo "=== 535m hybrid bwd (auto->xla)"
+timeout 1500 python bench.py --worker --config 3 2> .diag_hy3.err | tail -1
+echo "=== 780m remat+chunked (hybrid bwd)"
+timeout 1500 python bench.py --worker --config 2 2> .diag_hy2.err | tail -1
+tail -2 .diag_hy2.err
+echo "=== 1.3b_small remat+chunked"
+timeout 1500 python bench.py --worker --config 1 2> .diag_hy1.err | tail -1
+tail -2 .diag_hy1.err
+echo "=== 1.3b remat+chunked"
+timeout 1800 python bench.py --worker --config 0 2> .diag_hy0.err | tail -1
+tail -2 .diag_hy0.err
+echo "=== 535m full-pallas bwd (control)"
+FLAGS_flash_attention_bwd=pallas timeout 1500 python bench.py --worker --config 3 2> .diag_hyp.err | tail -1

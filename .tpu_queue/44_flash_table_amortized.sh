@@ -1,0 +1,1 @@
+python tools/flash_vs_xla.py

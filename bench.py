@@ -64,10 +64,14 @@ def _llama_ladder():
                       num_hidden_layers=8, num_attention_heads=16,
                       max_position_embeddings=2048, dtype="bfloat16")
     return [
-        # (name, cfg, batch, seq, steps, remat)
+        # (name, cfg, batch, seq, steps, remat). Remat is ON for >=780M:
+        # r5 established the compile-helper 500s are HBM overflow (every
+        # no-remat big config exceeds the v5e's 16GB once bf16 AdamW
+        # moments + activations + the loss buffer stack up; the chunked
+        # LM loss and per-layer remat are what fit them)
         ("llama_1.3b", LlamaConfig(**gpt3_1p3b), 8, 2048, 8, True),
-        ("llama_1.3b_small_batch", LlamaConfig(**gpt3_1p3b), 4, 2048, 8, False),
-        ("llama_780m", LlamaConfig(**llama_780m), 8, 2048, 8, False),
+        ("llama_1.3b_small_batch", LlamaConfig(**gpt3_1p3b), 4, 2048, 8, True),
+        ("llama_780m", LlamaConfig(**llama_780m), 8, 2048, 8, True),
         ("llama_535m", LlamaConfig(**llama_535m), 4, 2048, 8, False),
     ]
 
@@ -459,10 +463,15 @@ def worker(force_cpu: bool, only_config: int | None = None):
         attn_backend = ("pallas_flash" if _use_pallas(
             (batch, seq, cfg.num_attention_heads, hd), hd, False)
             else "xla_dense")
+        from paddle_tpu.framework import flags as _bflags
+        bwd_mode = _bflags.flag_value("flash_attention_bwd")
+        if bwd_mode == "auto":
+            bwd_mode = "auto:" + ("xla" if seq <= 2048 else "pallas")
         detail = {"config": name, "tokens_per_s": round(tok_per_s, 1),
                   "params": n_params, "loss": round(r["loss"], 4),
                   "batch": batch, "seq": seq, "remat": remat,
                   "attention_backend": attn_backend,
+                  "attention_bwd": bwd_mode,
                   "device": str(jax.devices()[0])}
         if errors:
             detail["skipped_configs"] = errors

@@ -321,7 +321,10 @@ int64_t pt_ps_table_shrink(void* h, float show_threshold,
 // checked: a short write (disk full) must NOT report success.
 int pt_ps_table_save(void* h, const char* path) {
   Table* t = static_cast<Table*>(h);
-  FILE* f = std::fopen(path, "wb");
+  // write to a temp file and rename on success: a failed save (disk
+  // full) must not truncate the previous checkpoint at `path`
+  std::string tmp = std::string(path) + ".tmp";
+  FILE* f = std::fopen(tmp.c_str(), "wb");
   if (!f) return -1;
   for (auto& sh : t->shards) sh.mu.lock();  // fixed order: no deadlock
   int64_t count = 0;
@@ -348,6 +351,8 @@ int pt_ps_table_save(void* h, const char* path) {
   }
   for (int i = kShards - 1; i >= 0; --i) t->shards[i].mu.unlock();
   if (std::fclose(f) != 0) ok = false;
+  if (ok && std::rename(tmp.c_str(), path) != 0) ok = false;
+  if (!ok) std::remove(tmp.c_str());
   return ok ? 0 : -4;
 }
 

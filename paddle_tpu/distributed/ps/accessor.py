@@ -172,6 +172,8 @@ class CountFilterEntry:
     times (reference entry_attr.py CountFilterEntry — keeps one-off ids from
     bloating the table)."""
 
+    needs_count = True  # admit() depends on the probation counter
+
     def __init__(self, count_filter: int):
         if count_filter < 0:
             raise ValueError("count_filter must be >= 0")
@@ -184,6 +186,8 @@ class CountFilterEntry:
 class ProbabilityEntry:
     """Admit with fixed probability, deterministic per feature id
     (reference entry_attr.py ProbabilityEntry)."""
+
+    needs_count = False  # decision is per-id, not per-occurrence
 
     def __init__(self, probability: float):
         if not 0.0 <= probability <= 1.0:
@@ -199,6 +203,8 @@ class ShowClickEntry:
     """Names the show/click input slots feeding the CTR statistics
     (reference entry_attr.py ShowClickEntry); admission is unconditional —
     the stats drive decay/shrink, not entry."""
+
+    needs_count = False
 
     def __init__(self, show_name: str, click_name: str):
         self.show_name = str(show_name)

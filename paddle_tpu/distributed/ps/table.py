@@ -44,15 +44,22 @@ class SparseTable:
         self.emb_dim = int(emb_dim)
         self.accessor = accessor or CtrAccessor(SparseAdaGradRule())
         rule = self.accessor.rule
-        self._lock = threading.Lock()
+        # RLock: the entry-admission gate wraps contains()/pull()/apply
+        # under one critical section (gated tables serialize pull vs push
+        # — a two-step contains+read would otherwise race a concurrent
+        # admission and mask a freshly stored row with its init values)
+        self._lock = threading.RLock()
         if use_native is None:
             use_native = _native.available
         self._native = bool(use_native) and _native.available
         # feature-admission policy (reference entry_attr.py): probationary
         # ids live only in this counter until the policy admits them — the
-        # row store never sees a rejected feature
+        # row store never sees a rejected feature. The counter is bounded
+        # (FIFO eviction) so permanently-rejected id streams cannot bloat
+        # the host dict the way they would have bloated the table.
         self._entry = self.accessor.entry
         self._probation: dict[int, int] = {}
+        self._probation_cap = 1_000_000
         if self._native:
             self._h = _native.lib().pt_ps_table_new(
                 self.emb_dim, rule.rule_id, rule.learning_rate,
@@ -87,14 +94,47 @@ class SparseTable:
             return np.array([fid in self._rows for fid in ids.tolist()],
                             bool)
 
+    # --- entry-admission gate (reference entry_attr.py) --------------------
+    def _gate_writes(self, ids, payload):
+        """Filter a gradient-bearing write down to admitted occurrences and
+        update probation counters. Caller holds self._lock. In-batch
+        duplicates: the occurrence that crosses the threshold admits the id
+        for the REST of the batch too (no stale counter is left behind)."""
+        present = self.contains(ids)
+        keep = present.copy()
+        newly: set[int] = set()
+        counts = getattr(self._entry, "needs_count", True)
+        for i in np.nonzero(~present)[0]:
+            fid = int(ids[i])
+            if fid in newly:
+                keep[i] = True
+                continue
+            n = self._probation.get(fid, 0) + 1
+            if self._entry.admit(fid, n):
+                self._probation.pop(fid, None)
+                newly.add(fid)
+                keep[i] = True
+            elif counts:
+                if fid not in self._probation and \
+                        len(self._probation) >= self._probation_cap:
+                    self._probation.pop(next(iter(self._probation)))
+                self._probation[fid] = n
+        if keep.all():
+            return ids, payload
+        return (np.ascontiguousarray(ids[keep]),
+                np.ascontiguousarray(payload[keep]))
+
     # --- core ops ----------------------------------------------------------
     def pull(self, ids, init_on_miss: bool = True) -> np.ndarray:
         ids = _as_ids(ids)
         if self._entry is not None and init_on_miss:
             # probationary ids read their would-be init without entering
-            # the store; the entry policy admits rows on push only
-            present = self.contains(ids)
-            out = self.pull(ids, init_on_miss=False)
+            # the store; the entry policy admits rows on gradient writes
+            # only. Locked: a push admitting between contains() and the
+            # raw read must not be masked by init values.
+            with self._lock:
+                present = self.contains(ids)
+                out = self.pull(ids, init_on_miss=False)
             missing = np.nonzero(~present)[0]
             if missing.size:
                 out[missing] = deterministic_init_batch(
@@ -123,23 +163,17 @@ class SparseTable:
         grads = np.ascontiguousarray(
             np.asarray(grads, np.float32).reshape(ids.size, self.emb_dim))
         if self._entry is not None:
-            present = self.contains(ids)
-            keep = present.copy()
+            # gate + apply under one lock so gated pulls see either the
+            # pre-admission or post-apply state, never a half state
             with self._lock:
-                for i in np.nonzero(~present)[0]:
-                    fid = int(ids[i])
-                    n = self._probation.get(fid, 0) + 1
-                    if self._entry.admit(fid, n):
-                        self._probation.pop(fid, None)
-                        keep[i] = True  # admitted: row created by the push
-                    else:
-                        self._probation[fid] = n  # rejected: drop the grad
-            if not keep.all():
-                ids, grads = ids[keep], grads[keep]
+                ids, grads = self._gate_writes(ids, grads)
                 if ids.size == 0:
                     return
-                grads = np.ascontiguousarray(grads)
-                ids = np.ascontiguousarray(ids)
+                self._apply_push(ids, grads)
+            return
+        self._apply_push(ids, grads)
+
+    def _apply_push(self, ids, grads) -> None:
         if self._native:
             _native.lib().pt_ps_table_push(
                 self._h, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
@@ -154,10 +188,22 @@ class SparseTable:
 
     def merge(self, ids, deltas) -> None:
         """Additive weight merge (geo-SGD delta application; reference
-        memory_sparse_geo_table.cc) — bypasses the optimizer rule."""
+        memory_sparse_geo_table.cc) — bypasses the optimizer rule. Geo
+        workers deliver their training updates through here, so the entry
+        gate applies exactly as it does for push."""
         ids = _as_ids(ids)
         deltas = np.ascontiguousarray(
             np.asarray(deltas, np.float32).reshape(ids.size, self.emb_dim))
+        if self._entry is not None:
+            with self._lock:
+                ids, deltas = self._gate_writes(ids, deltas)
+                if ids.size == 0:
+                    return
+                self._apply_merge(ids, deltas)
+            return
+        self._apply_merge(ids, deltas)
+
+    def _apply_merge(self, ids, deltas) -> None:
         if self._native:
             _native.lib().pt_ps_table_merge(
                 self._h, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
@@ -206,6 +252,21 @@ class SparseTable:
         shows = np.ascontiguousarray(np.asarray(shows, np.float32).reshape(-1))
         clicks = np.ascontiguousarray(
             np.asarray(clicks, np.float32).reshape(-1))
+        if self._entry is not None:
+            # stats never admit: update existing rows only (admission is a
+            # gradient-write decision; un-admitted features drop stats)
+            with self._lock:
+                present = self.contains(ids)
+                if not present.all():
+                    ids = np.ascontiguousarray(ids[present])
+                    shows = np.ascontiguousarray(shows[present])
+                    clicks = np.ascontiguousarray(clicks[present])
+                if ids.size == 0:
+                    return
+                return self._apply_show_click(ids, shows, clicks)
+        self._apply_show_click(ids, shows, clicks)
+
+    def _apply_show_click(self, ids, shows, clicks) -> None:
         if self._native:
             _native.lib().pt_ps_table_add_show_click(
                 self._h, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
@@ -249,16 +310,23 @@ class SparseTable:
             if rc != 0:
                 raise IOError(f"ps table save failed rc={rc}: {path}")
             return
+        import os
         with self._lock:
             ids = np.fromiter(self._rows.keys(), np.uint64,
                               count=len(self._rows))
-            np.savez(path, ids=ids,
+            # atomic replace: a failed save must not destroy the previous
+            # checkpoint. Explicit .npz suffix keeps np.savez from
+            # renaming the temp file.
+            tmp = path + ".tmp.npz"
+            np.savez(tmp, ids=ids,
                      w=np.stack([self._rows[int(i)][0] for i in ids])
                      if ids.size else np.zeros((0, self.emb_dim), np.float32),
                      slots=np.stack([self._rows[int(i)][1] for i in ids])
                      if ids.size else np.zeros((0, 0), np.float32),
                      meta=np.stack([self._rows[int(i)][2] for i in ids])
                      if ids.size else np.zeros((0, 3), np.float32))
+            target = path if path.endswith(".npz") else path + ".npz"
+            os.replace(tmp, target)
 
     def load(self, path: str) -> None:
         if self._native:

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from ..parallel.functional import (functional_call, rmsnorm_lm_loss,
+                                   rmsnorm_lm_loss_chunked,
                                    split_stacked_layer_params)
 
 __all__ = ["build_scanned_llama"]
@@ -77,11 +78,20 @@ def build_scanned_llama(model, remat: bool = True, dtype=None,
     else:
         body = layer_body
 
+    vocab = cfg.vocab_size
+
     def loss_fn(p, ids, labels):
         h = jnp.take(p["embed"]["weight"], ids, axis=0)
         h, _ = jax.lax.scan(body, h, p["layers"])
         w = (p["embed"]["weight"].T if tied
              else p["head"]["lm_head"])  # nn.Linear weight: (hidden, vocab)
+        b, s = ids.shape
+        # the fp32 (b, s, vocab) softmax buffer dominates HBM at LM scale;
+        # chunk the loss once it would exceed ~256MB (see
+        # rmsnorm_lm_loss_chunked) — below that the fused path is cheaper
+        if b * s * vocab * 4 > 256 * 1024 * 1024:
+            return rmsnorm_lm_loss_chunked(p["head"]["norm"], w, h, labels,
+                                           eps)
         return rmsnorm_lm_loss(p["head"]["norm"], w, h, labels, eps)
 
     return params, loss_fn

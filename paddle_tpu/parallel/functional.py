@@ -144,3 +144,42 @@ def rmsnorm_lm_loss(norm_w, proj_w_t, h, labels, eps):
     tgt = labels[:, 1:]
     picked = jnp.take_along_axis(lp, tgt[..., None], -1)[..., 0]
     return -jnp.mean(picked)
+
+
+def rmsnorm_lm_loss_chunked(norm_w, proj_w_t, h, labels, eps,
+                            chunk: int = 256):
+    """Sequence-chunked flavor of rmsnorm_lm_loss: the full (b, s, vocab)
+    fp32 logits/log-softmax buffer dominates single-chip HBM at LM scale
+    (b8 s2048 v32k fp32 = 2.1GB live into the backward, which is what
+    pushes the >=780M train steps past the v5e's 16GB — r5 measured: every
+    such compile crashes the axon compile helper). A lax.scan over
+    sequence chunks with jax.checkpoint keeps ONE chunk's logits live
+    (b*chunk*vocab) and recomputes per chunk in the backward. Same math as
+    rmsnorm_lm_loss (log-softmax picked = picked - logsumexp) up to fp
+    reassociation of the mean."""
+    h32 = h.astype(jnp.float32)
+    ms = jnp.mean(h32 * h32, axis=-1, keepdims=True)
+    hn = (h32 * jax.lax.rsqrt(ms + eps)).astype(h.dtype) * norm_w
+    x = hn[:, :-1]
+    y = labels[:, 1:]
+    b, sm1, d = x.shape
+    pad = (-sm1) % chunk
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        y = jnp.pad(y, ((0, 0), (0, pad)))
+    mask = (jnp.arange(sm1 + pad) < sm1).astype(jnp.float32)
+    nch = (sm1 + pad) // chunk
+    xc = jnp.moveaxis(x.reshape(b, nch, chunk, d), 1, 0)
+    yc = jnp.moveaxis(y.reshape(b, nch, chunk), 1, 0)
+    mc = jnp.moveaxis(mask.reshape(nch, chunk)[None].repeat(b, 0), 1, 0)
+
+    def chunk_nll(total, xym):
+        xcb, ycb, mcb = xym
+        logits = (xcb @ proj_w_t).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, ycb[..., None], -1)[..., 0]
+        return total + jnp.sum((lse - picked) * mcb), None
+
+    total, _ = jax.lax.scan(jax.checkpoint(chunk_nll), jnp.float32(0.0),
+                            (xc, yc, mc))
+    return total / (b * sm1)

@@ -114,7 +114,7 @@ class LlamaPipeRunner:
         tied = "lm_head" not in self.head_params
         if tied and schedule not in ("1F1B", "ZB"):
             raise NotImplementedError(
-                "tied embeddings need the 1F1B schedule "
+                "tied embeddings need the 1F1B or ZB schedule "
                 "(LlamaPipeRunner(..., schedule='1F1B')), which routes the "
                 "head's embedding cotangent back into the embedding grad")
 

@@ -133,6 +133,48 @@ def test_probability_entry_deterministic():
     assert 0 < sum(p.admit(i, 0) for i in range(256)) < 256
 
 
+def test_entry_gate_covers_merge_and_stats():
+    """Geo workers deliver training updates via merge(); stats never admit.
+    Both must respect the entry gate, not just push()."""
+    acc = ps.CtrAccessor(ps.SparseNaiveSGDRule(1.0),
+                         entry=ps.CountFilterEntry(2))
+    t = ps.SparseTable(4, acc)
+    fid = np.array([9], np.uint64)
+    t.add_show_click(fid, [5.0], [1.0])        # stats: no admission
+    assert len(t) == 0
+    t.merge(fid, np.ones((1, 4), np.float32))  # merge 1: rejected
+    assert len(t) == 0
+    t.merge(fid, np.ones((1, 4), np.float32))  # merge 2: admitted
+    assert len(t) == 1
+
+
+def test_entry_gate_duplicate_batch_admission():
+    """In one push of [x,x,x,x] with threshold 3: occurrences 1-2 probation,
+    3 admits, 4 applies too (no stale probation entry left behind)."""
+    acc = ps.CtrAccessor(ps.SparseNaiveSGDRule(1.0),
+                         entry=ps.CountFilterEntry(3))
+    t = ps.SparseTable(4, acc)
+    fid = np.array([7, 7, 7, 7], np.uint64)
+    init = t.pull(np.array([7], np.uint64)).copy()
+    t.push(fid, np.ones((4, 4), np.float32))
+    assert len(t) == 1
+    assert t._probation == {}
+    # occurrences 3 and 4 both applied: two unit SGD steps
+    np.testing.assert_allclose(t.pull(np.array([7], np.uint64)),
+                               init - 2.0, atol=1e-6)
+
+
+def test_probation_bounded():
+    acc = ps.CtrAccessor(ps.SparseNaiveSGDRule(),
+                         entry=ps.CountFilterEntry(10))
+    t = ps.SparseTable(2, acc)
+    t._probation_cap = 8
+    ids = np.arange(20, dtype=np.uint64)
+    t.push(ids, np.zeros((20, 2), np.float32))
+    assert len(t._probation) <= 8
+    assert len(t) == 0
+
+
 def test_show_click_entry_unconditional():
     acc = ps.CtrAccessor(ps.SparseNaiveSGDRule(),
                          entry=ps.ShowClickEntry("show", "click"))

@@ -1,11 +1,16 @@
 """Measure Pallas flash attention vs XLA dense attention on real hardware.
 
 VERDICT r4 #2: the flash kernel (ops/pallas/flash_attention.py) had never
-executed on a TPU. This tool times fwd and fwd+bwd for both paths across
-seq 1024-4096 (causal, bf16, head_dim 128 — the training shape), runs the
-block-size autotuner on hardware, and writes .flash_vs_xla.json. The
-_use_pallas thresholds in nn/functional/attention.py are set from this
-table's crossover.
+executed on a TPU. This tool times fwd and fwd+bwd for the dense path, the
+full-Pallas path, and the hybrid (Pallas fwd + XLA-remat bwd — the r5
+`flash_attention_bwd` modes) across seq 1024-4096 (causal, bf16), runs the
+block-size autotuner on hardware, and writes .flash_vs_xla.json.
+
+Timing method (r5 fix): each measurement runs N iterations INSIDE one
+compiled lax.scan, because a single dispatch through the axon tunnel costs
+~65ms — the first version of this table was pure dispatch latency (a
+"fwd+bwd faster than its own fwd" row made that obvious). The scan carry
+feeds each iteration so XLA cannot hoist the body.
 
 Run through the dial queue (serialized TPU access): untimed, cache-backed.
 """
@@ -34,21 +39,34 @@ import jax.numpy as jnp
 import numpy as np
 
 T0 = time.time()
+N_ITERS = 16
 
 
 def log(msg):
     print(f"[{time.time() - T0:7.1f}s] {msg}", flush=True)
 
 
-def timeit(fn, *args, reps=5):
-    out = fn(*args)          # compile + warm
-    jax.block_until_ready(out)
+def amortized(step_fn, n=N_ITERS):
+    """n iterations inside ONE compiled program; the carry data-flows into
+    each iteration so the body cannot be CSE'd/hoisted."""
+    @jax.jit
+    def run(q, k, v):
+        def body(carry, _):
+            s = step_fn(q + carry, k, v)
+            return (s * 0).astype(q.dtype), None
+        c, _ = jax.lax.scan(body, jnp.zeros((), q.dtype), None, length=n)
+        return c
+    return run
+
+
+def timeit(run, *args, reps=3):
+    jax.block_until_ready(run(*args))          # compile + warm
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        jax.block_until_ready(run(*args))
         best = min(best, time.perf_counter() - t0)
-    return best
+    return best / N_ITERS
 
 
 def attention_flops(b, h, sq, sk, d, causal, bwd=False):
@@ -64,8 +82,8 @@ def main():
     dev = jax.devices()[0]
     log(f"device: {dev} ({getattr(dev, 'device_kind', '?')})")
     on_tpu = dev.platform == "tpu"
-    interpret = not on_tpu  # CPU smoke-run uses the Pallas interpreter
 
+    from paddle_tpu.framework import flags as _flags
     from paddle_tpu.nn.functional.attention import _xla_attention
     from paddle_tpu.ops.pallas.flash_attention import flash_attention_bshd
     from paddle_tpu.ops.pallas import autotune as at
@@ -79,19 +97,16 @@ def main():
     causal = True
     rows = []
 
-    flash = jax.jit(lambda q, k, v: flash_attention_bshd(q, k, v, causal=True))
-    dense = jax.jit(lambda q, k, v: _xla_attention(q, k, v, causal=True))
-
-    def flash_loss(q, k, v):
+    def flash_sum(q, k, v):
         return jnp.sum(flash_attention_bshd(q, k, v, causal=True)
                        .astype(jnp.float32))
 
-    def dense_loss(q, k, v):
+    def dense_sum(q, k, v):
         return jnp.sum(_xla_attention(q, k, v, causal=True)
                        .astype(jnp.float32))
 
-    flash_grad = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))
-    dense_grad = jax.jit(jax.grad(dense_loss, argnums=(0, 1, 2)))
+    flash_grad = jax.grad(flash_sum, argnums=(0, 1, 2))
+    dense_grad = jax.grad(dense_sum, argnums=(0, 1, 2))
 
     for seq, b, h, d in shapes:
         rng = np.random.RandomState(0)
@@ -100,37 +115,49 @@ def main():
         v = jnp.asarray(rng.randn(b, seq, h, d), jnp.bfloat16)
 
         # numeric gate first: flash must agree with dense before timing
-        of = np.asarray(flash(q, k, v).astype(jnp.float32))
-        od = np.asarray(dense(q, k, v).astype(jnp.float32))
+        of = np.asarray(jax.jit(lambda a, b_, c: flash_attention_bshd(
+            a, b_, c, causal=True))(q, k, v).astype(jnp.float32))
+        od = np.asarray(jax.jit(lambda a, b_, c: _xla_attention(
+            a, b_, c, causal=True))(q, k, v).astype(jnp.float32))
         err = float(np.max(np.abs(of - od)))
         log(f"seq={seq} b={b} h={h}: max|flash-dense| = {err:.4f}")
         row = {"seq": seq, "batch": b, "heads": h, "head_dim": d,
-               "max_abs_err": err}
+               "max_abs_err": err, "iters_per_timing": N_ITERS}
         if err > 0.1:  # bf16 inputs: ~1e-2 expected; 0.1 = clearly wrong
             row["error"] = "NUMERIC MISMATCH — timing skipped"
             rows.append(row)
             continue
 
-        tf = timeit(flash, q, k, v)
-        td = timeit(dense, q, k, v)
-        tfg = timeit(flash_grad, q, k, v)
-        tdg = timeit(dense_grad, q, k, v)
+        tf = timeit(amortized(flash_sum), q, k, v)
+        td = timeit(amortized(dense_sum), q, k, v)
+        tg = {}
+        for name, mode, gfn in (("pallas", "pallas", flash_grad),
+                                ("hybrid", "xla", flash_grad),
+                                ("dense", "pallas", dense_grad)):
+            _flags.set_flags({"FLAGS_flash_attention_bwd": mode})
+            tg[name] = timeit(amortized(
+                lambda q_, k_, v_, g=gfn: sum(
+                    jnp.sum(x.astype(jnp.float32)) for x in g(q_, k_, v_))),
+                q, k, v)
+        _flags.set_flags({"FLAGS_flash_attention_bwd": "auto"})
         fl_f = attention_flops(b, h, seq, seq, d, causal)
         fl_b = fl_f + attention_flops(b, h, seq, seq, d, causal, bwd=True)
         row.update({
             "flash_fwd_ms": round(tf * 1e3, 3),
             "dense_fwd_ms": round(td * 1e3, 3),
             "fwd_speedup": round(td / tf, 3),
-            "flash_fwdbwd_ms": round(tfg * 1e3, 3),
-            "dense_fwdbwd_ms": round(tdg * 1e3, 3),
-            "fwdbwd_speedup": round(tdg / tfg, 3),
+            "fwdbwd_ms_pallas": round(tg["pallas"] * 1e3, 3),
+            "fwdbwd_ms_hybrid": round(tg["hybrid"] * 1e3, 3),
+            "fwdbwd_ms_dense": round(tg["dense"] * 1e3, 3),
             "flash_fwd_tflops": round(fl_f / tf / 1e12, 2),
-            "flash_fwdbwd_tflops": round(fl_b / tfg / 1e12, 2),
+            "tflops_pallas_bwd": round(fl_b / tg["pallas"] / 1e12, 2),
+            "tflops_hybrid_bwd": round(fl_b / tg["hybrid"] / 1e12, 2),
+            "tflops_dense": round(fl_b / tg["dense"] / 1e12, 2),
         })
         rows.append(row)
         log(f"  fwd: flash {tf*1e3:.2f}ms vs dense {td*1e3:.2f}ms "
-            f"({td/tf:.2f}x) | fwd+bwd: {tfg*1e3:.2f} vs {tdg*1e3:.2f} "
-            f"({tdg/tfg:.2f}x)")
+            f"({td/tf:.2f}x) | fwd+bwd ms: pallas {tg['pallas']*1e3:.2f} "
+            f"hybrid {tg['hybrid']*1e3:.2f} dense {tg['dense']*1e3:.2f}")
 
     # hardware autotune: winners for each training shape
     tuned = {}

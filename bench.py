@@ -107,8 +107,6 @@ def _run_one(cfg, batch, seq, steps, remat, on_tpu, remat_policy=None):
         new_p, new_st = opt.tree_update(p, grads, st, lr, stp)
         return loss, new_p, new_st
 
-    jstep = jax.jit(train_step, donate_argnums=(0, 1))
-
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
     lr = jnp.float32(3e-4)
@@ -117,15 +115,39 @@ def _run_one(cfg, batch, seq, steps, remat, on_tpu, remat_policy=None):
     # and also provides XLA's own FLOP count (an MFU cross-check that
     # doesn't depend on the 6N analytic formula)
     xla_flops = None
-    try:
-        run = jstep.lower(params, opt_state, ids, ids, lr,
-                          jnp.int32(1)).compile()
-        ca = run.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        xla_flops = float(ca.get("flops", 0.0)) or None
-    except Exception:
-        run = jstep  # fall back to the jit dispatch path
+    run = None
+    from paddle_tpu.framework import flags as _wflags
+    orig_bwd_mode = _wflags.flag_value("flash_attention_bwd")
+    bwd_mode_used = orig_bwd_mode
+    for attempt_mode in (None, "pallas"):
+        if attempt_mode is not None:
+            # the auto backward (xla-remat) needs a FRESH remote compile;
+            # when the compile helper is refusing new programs (the r5 500
+            # failure mode), fall back to the pallas backward whose
+            # executable is usually already in .jax_cache
+            _wflags.set_flags({"FLAGS_flash_attention_bwd": attempt_mode})
+            bwd_mode_used = attempt_mode
+        jstep = jax.jit(train_step, donate_argnums=(0, 1))
+        try:
+            run = jstep.lower(params, opt_state, ids, ids, lr,
+                              jnp.int32(1)).compile()
+            ca = run.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0]
+            xla_flops = float(ca.get("flops", 0.0)) or None
+            break
+        except Exception:
+            if attempt_mode is not None:
+                run = jstep  # both modes failed to AOT: jit dispatch path
+                break
+            if orig_bwd_mode != "auto":
+                run = jstep  # user pinned a mode: no silent fallback
+                break
+    # the executable is traced; restore the flag so later configs in this
+    # process start from the user's setting, not this config's fallback
+    _wflags.set_flags({"FLAGS_flash_attention_bwd": orig_bwd_mode})
+    if bwd_mode_used == "auto":
+        bwd_mode_used = "auto:" + ("xla" if seq <= 2048 else "pallas")
 
     # warmup (settle allocator / first dispatch)
     loss, params, opt_state = run(params, opt_state, ids, ids, lr,
@@ -143,6 +165,7 @@ def _run_one(cfg, batch, seq, steps, remat, on_tpu, remat_policy=None):
     dt = time.perf_counter() - t0
     tokens = batch * seq * steps
     return {"tokens_per_s": tokens / dt, "n_params": n_params, "loss": final,
+            "attention_bwd_used": bwd_mode_used,
             "step_time_s": dt / steps, "xla_flops_per_step": xla_flops}
 
 
@@ -463,10 +486,7 @@ def worker(force_cpu: bool, only_config: int | None = None):
         attn_backend = ("pallas_flash" if _use_pallas(
             (batch, seq, cfg.num_attention_heads, hd), hd, False)
             else "xla_dense")
-        from paddle_tpu.framework import flags as _bflags
-        bwd_mode = _bflags.flag_value("flash_attention_bwd")
-        if bwd_mode == "auto":
-            bwd_mode = "auto:" + ("xla" if seq <= 2048 else "pallas")
+        bwd_mode = r.get("attention_bwd_used", "?")
         detail = {"config": name, "tokens_per_s": round(tok_per_s, 1),
                   "params": n_params, "loss": round(r["loss"], 4),
                   "batch": batch, "seq": seq, "remat": remat,

@@ -17,7 +17,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+from .serving import ContinuousBatchingEngine, Request  # noqa: E402,F401
+
+__all__ = ["ContinuousBatchingEngine", "Request",
+           "Config", "Predictor", "Tensor", "create_predictor",
            "PrecisionType", "PlaceType", "get_version"]
 
 

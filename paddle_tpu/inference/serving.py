@@ -1,0 +1,340 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+reference capability: the serving loop the reference builds around
+block_multihead_attention (paddle/phi/kernels/fusion/gpu/
+block_multi_head_attention_kernel.cu + incubate/nn/functional/
+block_multihead_attention.py): block tables, iteration-level scheduling,
+in-flight admission of new sequences while others decode.
+
+TPU-native design: TWO compiled programs serve every request mix.
+  - prefill: full-prompt forward at bucketed lengths (pad to the next
+    bucket so a handful of executables cover all prompts), returning the
+    first sampled token and the prompt's per-layer K/V for the host to
+    scatter into the block pool.
+  - decode: one token for ALL active lanes at once — fixed max_batch
+    lanes (inactive lanes masked), dense [B, max_blocks] block tables,
+    paged-attention gather over the pool (ops/paged_attention.py). Static
+    shapes mean XLA compiles each program once; admission/retirement is
+    pure host bookkeeping between steps.
+Memory is allocated in block_size granules from one (L, num_blocks, ...)
+pool — no per-sequence max-length reservation, exactly the property the
+reference's block attention exists for.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..generation import _llama_layer_prefill, _rms, _rope
+from ..ops.paged_attention import paged_attention_decode, write_to_cache
+
+__all__ = ["ContinuousBatchingEngine", "Request"]
+
+
+class Request:
+    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
+                 "generated", "done")
+
+    def __init__(self, rid, prompt, max_new_tokens, eos_token_id):
+        self.rid = rid
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.generated: list[int] = []
+        self.done = False
+
+
+class _LayeredBlockPool:
+    """Block allocator over a (L, num_blocks, block_size, KVH, D) pool.
+    One block-id table per sequence, shared by all layers."""
+
+    def __init__(self, num_layers, num_blocks, block_size, kv_heads,
+                 head_dim, dtype):
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        shape = (num_layers, num_blocks, block_size, kv_heads, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        # the LAST block is the scratch target for inactive decode lanes:
+        # every lane writes its token's K/V unconditionally inside the
+        # compiled step (no data-dependent skips), so masked lanes must
+        # scribble somewhere no live sequence owns
+        self.scratch_block = num_blocks - 1
+        self._free = list(range(num_blocks - 2, -1, -1))
+        self.tables: dict[int, list[int]] = {}
+
+    def blocks_needed(self, n_tokens):
+        return (n_tokens + self.block_size - 1) // self.block_size
+
+    def can_fit(self, n_tokens):
+        return len(self._free) >= self.blocks_needed(n_tokens)
+
+    def ensure(self, rid, n_tokens):
+        table = self.tables.setdefault(rid, [])
+        need = self.blocks_needed(n_tokens)
+        while len(table) < need:
+            if not self._free:
+                raise MemoryError("paged KV pool exhausted")
+            table.append(self._free.pop())
+        return table
+
+    def release(self, rid):
+        for b in self.tables.pop(rid, []):
+            self._free.append(b)
+
+    def write_prompt(self, rid, ks, vs, length):
+        """ks/vs: (L, S_pad, KVH, D); writes the first `length` positions."""
+        table = self.ensure(rid, length)
+        bs = self.block_size
+        span = len(table) * bs
+        pad = span - ks.shape[1]
+        if pad > 0:
+            ks = jnp.pad(ks, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            vs = jnp.pad(vs, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        elif pad < 0:
+            ks = ks[:, :span]
+            vs = vs[:, :span]
+        ids = jnp.asarray(table, jnp.int32)
+        L = ks.shape[0]
+        kb = ks.reshape(L, len(table), bs, *ks.shape[2:])
+        vb = vs.reshape(L, len(table), bs, *vs.shape[2:])
+        self.k = self.k.at[:, ids].set(kb)
+        self.v = self.v.at[:, ids].set(vb)
+
+
+class ContinuousBatchingEngine:
+    """Iteration-level scheduler: admit -> decode-step -> retire.
+
+    model: LlamaForCausalLM. Greedy decoding (the serving default; the
+    dense-cache `paddle_tpu.generation.generate` covers sampling).
+    """
+
+    def __init__(self, model, num_blocks=256, block_size=16, max_batch=8,
+                 max_blocks_per_seq=64,
+                 prefill_buckets=(64, 128, 256, 512, 1024)):
+        config = model.config
+        self.cfg = dict(eps=config.rms_norm_eps, theta=config.rope_theta,
+                        heads=config.num_attention_heads,
+                        kv_heads=config.num_key_value_heads,
+                        head_dim=(config.hidden_size //
+                                  config.num_attention_heads))
+        state = {k: v._data for k, v in model.state_dict().items()}
+        from ..parallel.functional import split_stacked_layer_params
+        self.stacked, other = split_stacked_layer_params(state)
+        self.embed_w = other["llama.embed_tokens.weight"]
+        self.norm_w = other["llama.norm.weight"]
+        self.head_w = other.get("lm_head.weight")  # None == tied
+        # tied models: transpose ONCE — passing embed_w.T per call would
+        # re-materialize a (hidden, vocab) device array every token
+        self._out_w = self.head_w if self.head_w is not None \
+            else jnp.asarray(self.embed_w).T
+        L = config.num_hidden_layers
+        self.pool = _LayeredBlockPool(L, num_blocks, block_size,
+                                      self.cfg["kv_heads"],
+                                      self.cfg["head_dim"],
+                                      self.embed_w.dtype)
+        self.max_batch = int(max_batch)
+        self.max_blocks_per_seq = int(max_blocks_per_seq)
+        self.buckets = tuple(sorted(prefill_buckets))
+        self.lanes: list[Request | None] = [None] * self.max_batch
+        self.lane_len = np.zeros(self.max_batch, np.int64)  # tokens in cache
+        self.lane_tok = np.zeros(self.max_batch, np.int64)  # next to write
+        self.queue: deque[Request] = deque()
+        self.finished: dict[int, Request] = {}
+        self._next_rid = 0
+        self._prefill_jit = {}
+        self._decode_jit = None
+
+    # --- public API -------------------------------------------------------
+    def add_request(self, prompt, max_new_tokens=32, eos_token_id=None):
+        rid = self._next_rid
+        self._next_rid += 1
+        self.queue.append(Request(rid, prompt, max_new_tokens, eos_token_id))
+        return rid
+
+    def has_work(self):
+        return bool(self.queue) or any(r is not None for r in self.lanes)
+
+    def run(self, max_steps=10_000):
+        """Drive to completion; returns {rid: [generated tokens]}."""
+        steps = 0
+        while self.has_work() and steps < max_steps:
+            self.step()
+            steps += 1
+        return {rid: r.generated for rid, r in self.finished.items()}
+
+    # --- scheduling -------------------------------------------------------
+    def step(self):
+        self._admit()
+        self._decode_step()
+
+    def _admit(self):
+        while self.queue:
+            free_lanes = [i for i, r in enumerate(self.lanes) if r is None]
+            if not free_lanes:
+                return
+            req = self.queue[0]
+            total = req.prompt.size + req.max_new_tokens
+            if (total > self.max_blocks_per_seq * self.pool.block_size
+                    or req.prompt.size > self.buckets[-1]):
+                # cannot ever serve: reject with an empty result instead
+                # of crashing the engine mid-step
+                self.queue.popleft()
+                req.done = True
+                req.generated = []
+                self.finished[req.rid] = req
+                continue
+            if req.max_new_tokens <= 0:
+                self.queue.popleft()
+                req.done = True
+                self.finished[req.rid] = req
+                continue
+            # admit only if the WHOLE sequence fits: no mid-flight
+            # eviction (the reference engine preempts; we keep the
+            # no-surprise contract and leave the request queued)
+            if not self.pool.can_fit(total):
+                return
+            self.queue.popleft()
+            lane = free_lanes[0]
+            first_tok = self._prefill(req)
+            # reserve the FULL footprint now — lazy per-step allocation
+            # could exhaust the pool mid-decode across admitted sequences,
+            # which the admission check above promised cannot happen
+            self.pool.ensure(req.rid, total)
+            self.lanes[lane] = req
+            self.lane_len[lane] = req.prompt.size
+            self.lane_tok[lane] = first_tok
+            self._emit(lane, first_tok)
+
+    def _emit(self, lane, token):
+        req = self.lanes[lane]
+        req.generated.append(int(token))
+        if ((req.eos_token_id is not None and int(token) == req.eos_token_id)
+                or len(req.generated) >= req.max_new_tokens):
+            req.done = True
+            self.finished[req.rid] = req
+            self.pool.release(req.rid)
+            self.lanes[lane] = None
+            self.lane_len[lane] = 0
+
+    # --- compiled programs ------------------------------------------------
+    def _bucket(self, n):
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt length {n} exceeds the largest prefill "
+                         f"bucket {self.buckets[-1]}")
+
+    def _prefill(self, req):
+        s = req.prompt.size
+        bucket = self._bucket(s)
+        fn = self._prefill_jit.get(bucket)
+        if fn is None:
+            fn = jax.jit(self._make_prefill())
+            self._prefill_jit[bucket] = fn
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :s] = req.prompt
+        logits, ks, vs = fn(self.stacked, self.embed_w, self.norm_w,
+                            self._out_w, jnp.asarray(ids), jnp.int32(s))
+        self.pool.write_prompt(req.rid, ks[:, 0], vs[:, 0], s)
+        return int(np.asarray(jnp.argmax(logits, -1)).reshape(-1)[0])
+
+    def _make_prefill(self):
+        cfg = self.cfg
+
+        def run(stacked, embed_w, norm_w, head_w, ids, length):
+            b, s = ids.shape
+            pos = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+
+            def layer(h, lp):
+                h, (k, v) = _llama_layer_prefill(lp, h, pos, cfg)
+                return h, (k, v)
+
+            h = jnp.take(embed_w, ids, axis=0)
+            h, (ks, vs) = jax.lax.scan(layer, h, stacked)
+            h_last = h[:, length - 1]          # dynamic index: traced length
+            logits = (_rms(h_last, norm_w, cfg["eps"]) @ head_w).astype(
+                jnp.float32)
+            return logits, ks, vs
+
+        return run
+
+    def _decode_step(self):
+        active = [i for i, r in enumerate(self.lanes) if r is not None]
+        if not active:
+            return
+        B = self.max_batch
+        MB = self.max_blocks_per_seq
+        # inactive lanes write into the pool's scratch block (their rows
+        # would otherwise point at block 0, corrupting a live sequence);
+        # active lanes' blocks were fully reserved at admission
+        tables = np.full((B, MB), self.pool.scratch_block, np.int32)
+        for i in active:
+            t = self.pool.tables[self.lanes[i].rid]
+            tables[i, :len(t)] = t
+        lens = np.zeros(B, np.int32)
+        for i in active:
+            lens[i] = self.lane_len[i]
+        toks = np.zeros(B, np.int32)
+        for i in active:
+            toks[i] = self.lane_tok[i]
+        mask = np.zeros(B, bool)
+        mask[active] = True
+
+        if self._decode_jit is None:
+            self._decode_jit = jax.jit(self._make_decode(),
+                                       donate_argnums=(4, 5))
+        logits, self.pool.k, self.pool.v = self._decode_jit(
+            self.stacked, self.embed_w, self.norm_w, self._out_w,
+            self.pool.k, self.pool.v, jnp.asarray(toks), jnp.asarray(tables),
+            jnp.asarray(lens), jnp.asarray(mask))
+        nxt = np.asarray(jnp.argmax(logits, axis=-1))
+        for i in active:
+            self.lane_len[i] += 1
+            self.lane_tok[i] = nxt[i]
+            self._emit(i, nxt[i])
+
+    def _make_decode(self):
+        cfg = self.cfg
+
+        def run(stacked, embed_w, norm_w, head_w, kpool, vpool, toks,
+                tables, lens, mask):
+            eps, theta = cfg["eps"], cfg["theta"]
+            nh, nkv, hd = cfg["heads"], cfg["kv_heads"], cfg["head_dim"]
+            B = toks.shape[0]
+            h = jnp.take(embed_w, toks[:, None], axis=0)  # (B, 1, H)
+            pos = lens[:, None]                            # write position
+
+            def layer(carry, xs):
+                hh = carry
+                lp, kc, vc = xs
+                x = _rms(hh, lp["input_layernorm.weight"], eps)
+                q = (x @ lp["self_attn.q_proj.weight"]).reshape(B, 1, nh, hd)
+                k = (x @ lp["self_attn.k_proj.weight"]).reshape(B, 1, nkv, hd)
+                v = (x @ lp["self_attn.v_proj.weight"]).reshape(B, 1, nkv, hd)
+                q = _rope(q, pos, theta)[:, 0]
+                k = _rope(k, pos, theta)[:, 0]
+                v = v[:, 0]
+                kc, vc = write_to_cache(kc, vc, k, v, tables, lens)
+                attn = paged_attention_decode(
+                    q, kc, vc, tables, lens + 1,
+                    scale=1.0 / (hd ** 0.5))
+                hh = hh + (attn.reshape(B, 1, nh * hd)
+                           @ lp["self_attn.o_proj.weight"])
+                x = _rms(hh, lp["post_attention_layernorm.weight"], eps)
+                gate = x @ lp["mlp.gate_proj.weight"]
+                up = x @ lp["mlp.up_proj.weight"]
+                hh = hh + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
+                return hh, (kc, vc)
+
+            h, (kpool, vpool) = jax.lax.scan(layer, h, (stacked, kpool, vpool))
+            logits = (_rms(h[:, 0], norm_w, eps) @ head_w).astype(jnp.float32)
+            logits = jnp.where(mask[:, None], logits, -1e30)
+            return logits, kpool, vpool
+
+        return run

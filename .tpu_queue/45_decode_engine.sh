@@ -1,0 +1,1 @@
+python bench.py --worker --secondary decode > .decode_tpu2.json 2> .decode_tpu2.err; tail -1 .decode_tpu2.json

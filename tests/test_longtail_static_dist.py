@@ -349,13 +349,11 @@ def test_sharding_stage_tags_and_entries():
     assert dist.ShardingStage1 is not None
     assert dist.ShardingStage2 is not None
     assert dist.ShardingStage3 is not None
-    # PS-side config objects: guided errors naming the ledger + the
-    # TPU-native alternative (DESIGN.md descope contract)
-    for mk in (lambda: dist.CountFilterEntry(10),
-               lambda: dist.ProbabilityEntry(0.5),
-               lambda: dist.ShowClickEntry("show", "click")):
-        with pytest.raises(NotImplementedError, match="DESIGN"):
-            mk()
+    # entry policies are REAL since r5 (distributed/ps feature-admission
+    # gate); construction must succeed and carry the policy config
+    assert dist.CountFilterEntry(10).count_filter == 10
+    assert dist.ProbabilityEntry(0.5).probability == 0.5
+    assert dist.ShowClickEntry("show", "click").show_name == "show"
     assert dist.InMemoryDataset is not None
     assert dist.QueueDataset is not None
     assert dist.DistAttr is not None

@@ -173,23 +173,10 @@ def gloo_release(*a, **k):
     raise NotImplementedError("gloo is descoped on TPU (DESIGN.md)")
 
 
-class _PSDescoped:
-    """Parameter-server artifacts (reference: fluid/distributed/ps) are
-    descoped on TPU — see DESIGN.md's ledger."""
-
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            f"{type(self).__name__}: the brpc parameter server is descoped "
-            "on TPU (DESIGN.md) — use sharded embeddings over ICI "
-            "(VocabParallelEmbedding / ZeRO-3) instead")
-
-
-class InMemoryDataset(_PSDescoped):
-    pass
-
-
-class QueueDataset(_PSDescoped):
-    pass
+# PS-mode datasets — real since r5, backed by distributed/dataset.py
+# (multislot parsing + LoD batches feeding the TPU-native parameter
+# server in distributed/ps)
+from .dataset import InMemoryDataset, QueueDataset  # noqa: E402,F401
 
 
 # feature-admission entry policies — real since r5, backed by the TPU-native

@@ -442,19 +442,7 @@ class UserDefinedRoleMaker(PaddleCloudRoleMaker):
         return self._role == Role.SERVER
 
 
-class _DataGeneratorDescoped:
-    """MultiSlot data generators feed the parameter-server data pipeline,
-    descoped on TPU (DESIGN.md) — use paddle_tpu.io datasets/loaders."""
-
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            f"{type(self).__name__}: PS data generators are descoped on "
-            "TPU (DESIGN.md); use paddle_tpu.io.Dataset/DataLoader")
-
-
-class MultiSlotDataGenerator(_DataGeneratorDescoped):
-    pass
-
-
-class MultiSlotStringDataGenerator(_DataGeneratorDescoped):
-    pass
+# MultiSlot data generators — real since r5 (distributed/dataset.py):
+# the pipe_command protocol feeding InMemoryDataset/QueueDataset
+from ..dataset import (MultiSlotDataGenerator,  # noqa: E402,F401
+                       MultiSlotStringDataGenerator)

@@ -38,15 +38,49 @@ __all__ = ["ContinuousBatchingEngine", "Request"]
 
 class Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
-                 "generated", "done")
+                 "generated", "done", "do_sample", "temperature", "top_k",
+                 "top_p", "rng")
 
-    def __init__(self, rid, prompt, max_new_tokens, eos_token_id):
+    def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
+                 do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+                 seed=None):
         self.rid = rid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
         self.generated: list[int] = []
         self.done = False
+        self.do_sample = bool(do_sample)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        # None -> OS entropy: concurrent sampled requests must differ by
+        # default; a fixed seed is the explicit-reproducibility opt-in
+        self.rng = np.random.RandomState(seed)
+
+    def choose(self, logits: np.ndarray) -> int:
+        """Per-request next-token choice on the host (B is small; the
+        reference's top_p_sampling semantics: temperature -> top-k ->
+        nucleus filter -> categorical)."""
+        if not self.do_sample:
+            return int(np.argmax(logits))
+        z = logits.astype(np.float64) / max(self.temperature, 1e-6)
+        if self.top_k > 0:
+            kth = np.sort(z)[-min(self.top_k, z.size)]
+            z = np.where(z < kth, -np.inf, z)
+        if self.top_p < 1.0:
+            p = np.exp(z - np.max(z))
+            p /= p.sum()
+            order = np.argsort(-p)
+            cum = np.cumsum(p[order])
+            keep_sorted = (cum - p[order]) < self.top_p
+            keep_sorted[0] = True  # top_p=0 must still keep the argmax
+            keep = np.zeros_like(keep_sorted)
+            keep[order] = keep_sorted
+            z = np.where(keep, z, -np.inf)
+        p = np.exp(z - np.max(z))
+        p /= p.sum()
+        return int(self.rng.choice(p.size, p=p))
 
 
 class _LayeredBlockPool:
@@ -110,8 +144,10 @@ class _LayeredBlockPool:
 class ContinuousBatchingEngine:
     """Iteration-level scheduler: admit -> decode-step -> retire.
 
-    model: LlamaForCausalLM. Greedy decoding (the serving default; the
-    dense-cache `paddle_tpu.generation.generate` covers sampling).
+    model: LlamaForCausalLM. Per-request decoding knobs (greedy default;
+    do_sample with temperature/top_k/top_p + per-request seed) are applied
+    host-side on the returned logits row — mixed greedy/sampled lanes
+    share one compiled decode step.
     """
 
     def __init__(self, model, num_blocks=256, block_size=16, max_batch=8,
@@ -151,10 +187,14 @@ class ContinuousBatchingEngine:
         self._decode_jit = None
 
     # --- public API -------------------------------------------------------
-    def add_request(self, prompt, max_new_tokens=32, eos_token_id=None):
+    def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
+                    do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+                    seed=0):
         rid = self._next_rid
         self._next_rid += 1
-        self.queue.append(Request(rid, prompt, max_new_tokens, eos_token_id))
+        self.queue.append(Request(rid, prompt, max_new_tokens, eos_token_id,
+                                  do_sample, temperature, top_k, top_p,
+                                  seed))
         return rid
 
     def has_work(self):
@@ -242,7 +282,7 @@ class ContinuousBatchingEngine:
         logits, ks, vs = fn(self.stacked, self.embed_w, self.norm_w,
                             self._out_w, jnp.asarray(ids), jnp.int32(s))
         self.pool.write_prompt(req.rid, ks[:, 0], vs[:, 0], s)
-        return int(np.asarray(jnp.argmax(logits, -1)).reshape(-1)[0])
+        return req.choose(np.asarray(logits).reshape(-1))
 
     def _make_prefill(self):
         cfg = self.cfg
@@ -293,11 +333,19 @@ class ContinuousBatchingEngine:
             self.stacked, self.embed_w, self.norm_w, self._out_w,
             self.pool.k, self.pool.v, jnp.asarray(toks), jnp.asarray(tables),
             jnp.asarray(lens), jnp.asarray(mask))
-        nxt = np.asarray(jnp.argmax(logits, axis=-1))
+        if any(self.lanes[i].do_sample for i in active):
+            logits_np = np.asarray(logits)
+            chosen = {i: self.lanes[i].choose(logits_np[i]) for i in active}
+        else:
+            # all-greedy (the serving default): argmax on device, transfer
+            # B ints instead of the (B, vocab) fp32 logits every token
+            nxt_all = np.asarray(jnp.argmax(logits, axis=-1))
+            chosen = {i: int(nxt_all[i]) for i in active}
         for i in active:
+            nxt = chosen[i]
             self.lane_len[i] += 1
-            self.lane_tok[i] = nxt[i]
-            self._emit(i, nxt[i])
+            self.lane_tok[i] = nxt
+            self._emit(i, nxt)
 
     def _make_decode(self):
         cfg = self.cfg

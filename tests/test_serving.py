@@ -121,3 +121,36 @@ class TestScheduler:
         rid = eng.add_request(np.arange(10) % 128, max_new_tokens=20)
         eng.step()   # 30 tokens > 2 blocks * 8: rejected, empty result
         assert eng.finished[rid].generated == []
+
+
+class TestSampling:
+    def test_topk1_equals_greedy_and_seed_reproducible(self):
+        model = _model()
+        p = np.arange(6) % 128
+        greedy = _dense_reference(model, p, 5)
+
+        def run(**kw):
+            eng = ContinuousBatchingEngine(model, num_blocks=32, block_size=8,
+                                           max_batch=2, prefill_buckets=(16,))
+            rid = eng.add_request(p, max_new_tokens=5, **kw)
+            return eng.run()[rid]
+
+        # top_k=1 collapses sampling to argmax
+        assert run(do_sample=True, top_k=1, seed=3) == greedy
+        # seeded sampling reproduces; different seeds explore
+        a = run(do_sample=True, temperature=2.0, seed=11)
+        b = run(do_sample=True, temperature=2.0, seed=11)
+        assert a == b
+        outs = {tuple(run(do_sample=True, temperature=5.0, seed=s))
+                for s in range(6)}
+        assert len(outs) > 1
+
+    def test_top_p_filters_tail(self):
+        model = _model()
+        p = np.arange(4) % 128
+        eng = ContinuousBatchingEngine(model, num_blocks=32, block_size=8,
+                                       max_batch=2, prefill_buckets=(16,))
+        # top_p -> 0 keeps only the argmax token: equals greedy
+        rid = eng.add_request(p, max_new_tokens=4, do_sample=True,
+                              top_p=1e-9, seed=5)
+        assert eng.run()[rid] == _dense_reference(model, p, 4)

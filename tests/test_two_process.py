@@ -141,6 +141,21 @@ class TestTwoProcessIntegration:
                                        rtol=1e-4, atol=1e-5)
 
 
+    def test_parameter_server_cross_process(self, results):
+        """rank 0 served a sparse table over RPC; rank 1 pulled/pushed from
+        a REAL separate process. Both sides must agree on the rows, the
+        miss-init must be deterministic, and the duplicate-id push must
+        have pre-aggregated (one rule step for id 3's summed grad)."""
+        import numpy as np
+        for r in range(2):
+            assert results[r]["ps_ok"]
+        assert results[1]["ps_init_deterministic"]
+        assert results[1]["ps_push_math"]
+        np.testing.assert_allclose(np.asarray(results[0]["ps_rows"]),
+                                   np.asarray(results[1]["ps_rows"]),
+                                   atol=1e-6)
+
+
 def _eager_reference_params():
     """3 SGD steps on the worker's model/data, eagerly, in this process."""
     import numpy as np
@@ -159,3 +174,4 @@ def _eager_reference_params():
         opt.step()
         opt.clear_grad()
     return {k: np.asarray(t.numpy()) for k, t in model.state_dict().items()}
+

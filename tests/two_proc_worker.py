@@ -153,6 +153,52 @@ def main():
     dck.save_state_dict(dict(trainer.params), out_path + ".ckpt2p")
     results["ckpt_saved"] = True
 
+    # ---- parameter server across REAL processes: rank 0 serves a sparse
+    # table over RPC, rank 1 trains against it (reference pattern:
+    # test/ps/ + the_one_ps server/worker roles) -------------------------
+    import socket as _socket
+    from paddle_tpu.distributed import rpc as _rpc
+    from paddle_tpu.distributed import ps as _ps
+    from paddle_tpu.distributed.ps.accessor import deterministic_init
+
+    if rank == 0:
+        with _socket.socket() as _s:
+            _s.bind(("127.0.0.1", 0))
+            ps_master = f"127.0.0.1:{_s.getsockname()[1]}"
+        store.set("ps_rpc_master", ps_master.encode())
+    else:
+        ps_master = store.get("ps_rpc_master").decode()
+    name = _ps.the_one_ps.server_name(0) if rank == 0 else f"trainer_{rank}"
+    _rpc.init_rpc(name, rank=rank, world_size=2, master_endpoint=ps_master)
+    cfgs = [_ps.TableConfig(0, 4, _ps.CtrAccessor(
+        _ps.SparseNaiveSGDRule(learning_rate=0.5)))]
+    eng = _ps.TheOnePs(cfgs, num_servers=1)
+    ids = np.array([3, 9, 3], np.uint64)
+    if rank == 0:
+        server = eng.start_server(0)
+        store.set("ps_server_up", b"1")
+        store.wait("ps_trainer_done")
+        # server-side view after the trainer's push
+        results["ps_rows"] = server.pull(0, np.array([3, 9], np.uint64)) \
+            .tolist()
+    else:
+        store.wait("ps_server_up")
+        client = eng.connect([_ps.the_one_ps.server_name(0)])
+        first = client.pull(0, ids)
+        init3 = deterministic_init(3, 4, 0.0001)
+        results["ps_init_deterministic"] = bool(
+            np.allclose(first[0], init3) and np.allclose(first[2], init3))
+        # duplicate id 3 pre-aggregates: one rule step with summed grad
+        client.push(0, ids, np.ones((3, 4), np.float32))
+        after = client.pull(0, np.array([3, 9], np.uint64))
+        results["ps_rows"] = after.tolist()
+        results["ps_push_math"] = bool(
+            np.allclose(after[0], first[0] - 1.0, atol=1e-6)
+            and np.allclose(after[1], first[1] - 0.5, atol=1e-6))
+        store.set("ps_trainer_done", b"1")
+    _rpc.shutdown()
+    results["ps_ok"] = True
+
     with open(f"{out_path}.rank{rank}", "w") as f:
         json.dump(results, f)
     print(f"rank {rank} OK", flush=True)

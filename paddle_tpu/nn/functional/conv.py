@@ -54,11 +54,14 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n):
                                         (lhs_spec, rhs_spec, out_spec))
 
     def f(a, w, *rest):
+        # No preferred_element_type=f32 here: the MXU accumulates bf16
+        # convs in f32 regardless, and jax's conv transpose rule can't
+        # handle the widened cotangent (f32 cotangent x bf16 weight)
+        # under grad — it raised a dtype mismatch in the bf16 train step.
         out = jax.lax.conv_general_dilated(
             a, w, window_strides=sd, padding=pad,
             rhs_dilation=dd, dimension_numbers=dn,
             feature_group_count=groups,
-            preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16 else None,
         )
         out = out.astype(a.dtype)
         if rest:

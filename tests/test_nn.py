@@ -117,6 +117,25 @@ class TestFunctional:
         out = F.conv2d_transpose(x, w, stride=2)
         assert out.shape == [1, 3, 8, 8]
 
+    def test_conv2d_bf16_grad(self):
+        # regression: bf16 conv under jax.grad raised a dtype mismatch
+        # (f32 cotangent x bf16 weight in the conv transpose rule) when the
+        # forward widened the output via preferred_element_type
+        import jax
+        import jax.numpy as jnp
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.rand(2, 3, 8, 8), jnp.bfloat16)
+        w = jnp.asarray(rs.rand(4, 3, 3, 3), jnp.bfloat16)
+
+        def loss(x, w):
+            out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                           padding=1)
+            return out._data.astype(jnp.float32).sum()
+
+        gx, gw = jax.grad(loss, argnums=(0, 1))(x, w)
+        assert gx.dtype == jnp.bfloat16 and gw.dtype == jnp.bfloat16
+        assert bool(jnp.isfinite(gx.astype(jnp.float32)).all())
+
     def test_pools(self):
         x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
         out = F.max_pool2d(paddle.to_tensor(x), 2, 2)

@@ -68,7 +68,10 @@ def _llama_ladder():
         # r5 established the compile-helper 500s are HBM overflow (every
         # no-remat big config exceeds the v5e's 16GB once bf16 AdamW
         # moments + activations + the loss buffer stack up; the chunked
-        # LM loss and per-layer remat are what fit them)
+        # LM loss and per-layer remat are what fit them). 535m keeps the
+        # fused LM loss (its 1.05GB fp32 logits buffer fits with room —
+        # the r2 0.5216-MFU run was fused; chunking it costs throughput),
+        # selected via the worker's per-row loss_chunk_mb below.
         ("llama_1.3b", LlamaConfig(**gpt3_1p3b), 8, 2048, 8, True),
         ("llama_1.3b_small_batch", LlamaConfig(**gpt3_1p3b), 4, 2048, 8, True),
         ("llama_780m", LlamaConfig(**llama_780m), 8, 2048, 8, True),
@@ -76,7 +79,13 @@ def _llama_ladder():
     ]
 
 
-def _run_one(cfg, batch, seq, steps, remat, on_tpu, remat_policy=None):
+def _loss_chunk_mb_for(name):
+    """Per-config fused-vs-chunked LM loss threshold (MB of fp32 logits)."""
+    return 1100 if name == "llama_535m" else 256
+
+
+def _run_one(cfg, batch, seq, steps, remat, on_tpu, remat_policy=None,
+             loss_chunk_mb=256):
     """One config: scan-over-layers train step (HLO size O(1) in depth, so
     the compile helper sees one layer body instead of an unrolled stack)."""
     import jax
@@ -92,7 +101,7 @@ def _run_one(cfg, batch, seq, steps, remat, on_tpu, remat_policy=None):
     n_params = model.num_params()
     params, loss_fn = build_scanned_llama(
         model, remat=remat, dtype="bfloat16" if on_tpu else None,
-        remat_policy=remat_policy)
+        remat_policy=remat_policy, loss_chunk_mb=loss_chunk_mb)
     opt = optimizer.AdamW(3e-4, parameters=model.parameters())
     opt_state = opt.tree_init(params)
     # the scanned params are fresh (stacked, cast) copies; free the
@@ -115,39 +124,20 @@ def _run_one(cfg, batch, seq, steps, remat, on_tpu, remat_policy=None):
     # and also provides XLA's own FLOP count (an MFU cross-check that
     # doesn't depend on the 6N analytic formula)
     xla_flops = None
-    run = None
     from paddle_tpu.framework import flags as _wflags
-    orig_bwd_mode = _wflags.flag_value("flash_attention_bwd")
-    bwd_mode_used = orig_bwd_mode
-    for attempt_mode in (None, "pallas"):
-        if attempt_mode is not None:
-            # the auto backward (xla-remat) needs a FRESH remote compile;
-            # when the compile helper is refusing new programs (the r5 500
-            # failure mode), fall back to the pallas backward whose
-            # executable is usually already in .jax_cache
-            _wflags.set_flags({"FLAGS_flash_attention_bwd": attempt_mode})
-            bwd_mode_used = attempt_mode
-        jstep = jax.jit(train_step, donate_argnums=(0, 1))
-        try:
-            run = jstep.lower(params, opt_state, ids, ids, lr,
-                              jnp.int32(1)).compile()
-            ca = run.cost_analysis()
-            if isinstance(ca, (list, tuple)):
-                ca = ca[0]
-            xla_flops = float(ca.get("flops", 0.0)) or None
-            break
-        except Exception:
-            if attempt_mode is not None:
-                run = jstep  # both modes failed to AOT: jit dispatch path
-                break
-            if orig_bwd_mode != "auto":
-                run = jstep  # user pinned a mode: no silent fallback
-                break
-    # the executable is traced; restore the flag so later configs in this
-    # process start from the user's setting, not this config's fallback
-    _wflags.set_flags({"FLAGS_flash_attention_bwd": orig_bwd_mode})
+    bwd_mode_used = _wflags.flag_value("flash_attention_bwd")
     if bwd_mode_used == "auto":
-        bwd_mode_used = "auto:" + ("xla" if seq <= 2048 else "pallas")
+        bwd_mode_used = "auto:pallas"  # auto resolves to pallas (r5 A/B)
+    jstep = jax.jit(train_step, donate_argnums=(0, 1))
+    try:
+        run = jstep.lower(params, opt_state, ids, ids, lr,
+                          jnp.int32(1)).compile()
+        ca = run.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        xla_flops = float(ca.get("flops", 0.0)) or None
+    except Exception:
+        run = jstep  # AOT compile failed: fall back to jit dispatch
 
     # warmup (settle allocator / first dispatch)
     loss, params, opt_state = run(params, opt_state, ids, ids, lr,
@@ -166,6 +156,7 @@ def _run_one(cfg, batch, seq, steps, remat, on_tpu, remat_policy=None):
     tokens = batch * seq * steps
     return {"tokens_per_s": tokens / dt, "n_params": n_params, "loss": final,
             "attention_bwd_used": bwd_mode_used,
+            "lm_loss_path": loss_fn.lm_loss_path,  # set when traced
             "step_time_s": dt / steps, "xla_flops_per_step": xla_flops}
 
 
@@ -489,15 +480,31 @@ def worker(force_cpu: bool, only_config: int | None = None):
     remat_policy = None
     if "--remat-policy" in sys.argv:
         remat_policy = sys.argv[sys.argv.index("--remat-policy") + 1]
+    remat_override = None   # experiment knobs for the TPU job queue
+    if "--remat" in sys.argv:
+        remat_override = sys.argv[sys.argv.index("--remat") + 1] == "on"
+    batch_override = None
+    if "--batch" in sys.argv:
+        batch_override = int(sys.argv[sys.argv.index("--batch") + 1])
+    chunk_override = None
+    if "--loss-chunk-mb" in sys.argv:
+        chunk_override = int(sys.argv[sys.argv.index("--loss-chunk-mb") + 1])
     errors = []      # configs that failed outright (walked past)
     transient = []   # first-try failures that succeeded on retry
     for name, cfg, batch, seq, steps, remat in ladder:
+        if remat_override is not None:
+            remat = remat_override
+        if batch_override is not None:
+            batch = batch_override
+        chunk_mb = chunk_override if chunk_override is not None \
+            else _loss_chunk_mb_for(name)
         r = None
         attempts = []
         for attempt in range(2):  # retry once: transient compile-helper 500s
             try:
                 r = _run_one(cfg, batch, seq, steps, remat, on_tpu,
-                             remat_policy=remat_policy)
+                             remat_policy=remat_policy,
+                             loss_chunk_mb=chunk_mb)
                 break
             except Exception as e:
                 msg = f"{name}[try{attempt}]: {type(e).__name__}: {str(e)[:200]}"
@@ -530,6 +537,7 @@ def worker(force_cpu: bool, only_config: int | None = None):
                   "batch": batch, "seq": seq, "remat": remat,
                   "attention_backend": attn_backend,
                   "attention_bwd": bwd_mode,
+                  "lm_loss": r.get("lm_loss_path"),
                   "device": str(jax.devices()[0])}
         if errors:
             detail["skipped_configs"] = errors
@@ -640,7 +648,7 @@ def main():
     # timed-out worker also leaves the chip lease held for minutes, so
     # descending order can starve every config. The persistent compile
     # cache (.jax_cache) makes re-walks cheap once a config ever compiled.
-    best = None        # biggest config that succeeded
+    best = None        # highest-MFU config that succeeded (full ladder in detail)
     ladder_log = {}
     if tpu_alive:
         plan = [(["--config", "3"], 900), (["--config", "2"], 900),
@@ -654,7 +662,12 @@ def main():
                     "value": result.get("value"),
                     "tokens_per_s": (result.get("detail") or {}).get(
                         "tokens_per_s")}
-                best = result   # later (bigger) successes replace earlier
+                # headline = best MFU. Bigger configs pay remat (recompute
+                # FLOPs that model-FLOP MFU doesn't credit), so size order
+                # and MFU order differ; the ladder detail keeps every row.
+                if best is None or (result.get("value") or 0) > \
+                        (best.get("value") or 0):
+                    best = result
             else:
                 ladder_log[cfg_id] = {"error": err}
                 errors.append(f"config{cfg_id}: {err}")

@@ -24,7 +24,8 @@ __all__ = ["build_scanned_llama"]
 
 
 def build_scanned_llama(model, remat: bool = True, dtype=None,
-                        remat_policy: str | None = None):
+                        remat_policy: str | None = None,
+                        loss_chunk_mb: int = 256):
     """Split a LlamaForCausalLM's state into (embed, stacked layers, head)
     and return (params, loss_fn) where loss_fn(params, ids, labels) is a
     pure scalar LM loss whose decoder stack is one lax.scan.
@@ -87,11 +88,17 @@ def build_scanned_llama(model, remat: bool = True, dtype=None,
              else p["head"]["lm_head"])  # nn.Linear weight: (hidden, vocab)
         b, s = ids.shape
         # the fp32 (b, s, vocab) softmax buffer dominates HBM at LM scale;
-        # chunk the loss once it would exceed ~256MB (see
+        # chunk the loss once it would exceed loss_chunk_mb (see
         # rmsnorm_lm_loss_chunked) — below that the fused path is cheaper
-        if b * s * vocab * 4 > 256 * 1024 * 1024:
+        # (the chunk scan + checkpoint recompute cost ~5-15% step time, so
+        # callers with HBM headroom raise the threshold to stay fused)
+        if b * s * vocab * 4 > loss_chunk_mb * 1024 * 1024:
+            loss_fn.lm_loss_path = "chunked"
             return rmsnorm_lm_loss_chunked(p["head"]["norm"], w, h, labels,
                                            eps)
+        loss_fn.lm_loss_path = "fused"
         return rmsnorm_lm_loss(p["head"]["norm"], w, h, labels, eps)
 
+    # which loss flavor ran, for bench labeling — set at first trace
+    loss_fn.lm_loss_path = None
     return params, loss_fn

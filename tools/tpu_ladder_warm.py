@@ -44,8 +44,11 @@ def warm_one(idx):
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     log(f"model built ({model.num_params() / 1e6:.0f}M params)")
-    params, loss_fn = build_scanned_llama(model, remat=remat,
-                                          dtype="bfloat16")
+    # mirror the bench worker's exact build (incl. per-row loss chunking)
+    # so the cached executable is THE one the driver's timed run loads
+    params, loss_fn = build_scanned_llama(
+        model, remat=remat, dtype="bfloat16",
+        loss_chunk_mb=bench._loss_chunk_mb_for(name))
     opt = optimizer.AdamW(3e-4, parameters=model.parameters())
     opt_state = opt.tree_init(params)
     for t in model.state_dict().values():

@@ -34,6 +34,10 @@ _flags.define_flag(
     "(reference: FLAGS_use_autotune, phi/kernels/autotune/switch_autotune.cc)")
 
 
+# per-key candidate->ms spreads from the most recent tuning runs
+timing_log: dict = {}
+
+
 class AlgorithmCache:
     """Winner cache + hit/miss stats (reference: autotune/cache.h)."""
 
@@ -112,14 +116,20 @@ def autotune(key, candidates: Sequence[Any], make_runner, default=None,
     if cached is not None:
         return cached
     best, best_t = default, float("inf")
+    timings = {}
     for cand in candidates:
         try:
             t = _time_once(make_runner(cand), repeats)
         except Exception:
             continue  # config not compilable on this device/shape
+        timings[str(cand)] = round(t * 1e3, 3)
         if t < best_t:
             best, best_t = cand, t
     _global_cache.put(key, best)
+    # full spread kept separately (not in the winner cache — it would
+    # skew hit/size stats), for offline analysis when baking shipped
+    # defaults: close seconds-place timings mean a noise-sensitive winner
+    timing_log[key] = timings
     return best
 
 

@@ -62,12 +62,44 @@ def _block_needed(qi, kj, block_q, block_k, causal, offset):
     return (qi * block_q + block_q - 1 + offset) >= (kj * block_k)
 
 
+_flags.define_flag(
+    "flash_packed_grid", True,
+    "causal flash kernels iterate only the lower-triangle (q,k) block "
+    "pairs instead of a rectangular grid with half the steps masked off "
+    "(saves the skipped steps' k/v DMAs and grid overhead)")
+
+
+def _packing_on():
+    return bool(_flags.flag_value("flash_packed_grid"))
+
+
+def _tri_decode(p):
+    """Linear triangle index -> (qi, kj) with kj <= qi (row-major packing:
+    p = qi*(qi+1)/2 + kj). The causal-packed grid iterates ONLY the lower
+    triangle of (q block, k block) pairs — a full rectangular grid spends
+    half its steps (and their k/v block DMAs) on pairs the causal mask
+    fully discards. f32 sqrt is exact for the sizes involved (p < 2^23);
+    the +-1 correction guards the perfect-square boundary cases."""
+    pf = p.astype(jnp.float32)
+    qi = jnp.floor((jnp.sqrt(8.0 * pf + 1.0) - 1.0) * 0.5).astype(jnp.int32)
+    tri = qi * (qi + 1) // 2
+    qi = jnp.where(p < tri, qi - 1, qi)
+    qi = jnp.where(p >= (qi + 1) * (qi + 2) // 2, qi + 1, qi)
+    kj = p - qi * (qi + 1) // 2
+    return qi, kj
+
+
 def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
                    causal: bool, scale: float, seq_k: int, block_q: int,
-                   block_k: int, offset: int, mask_k_tail: bool):
-    qi = pl.program_id(1)
-    kj = pl.program_id(2)
-    nk = pl.num_programs(2)
+                   block_k: int, offset: int, mask_k_tail: bool,
+                   packed: bool = False):
+    if packed:   # causal lower-triangle grid: (bh, tri(nq))
+        qi, kj = _tri_decode(pl.program_id(1))
+        is_last = kj == qi   # kj_max(qi) == qi when block_q == block_k
+    else:
+        qi = pl.program_id(1)
+        kj = pl.program_id(2)
+        is_last = kj == pl.num_programs(2) - 1
 
     @pl.when(kj == 0)
     def _init():
@@ -103,13 +135,13 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
         m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
         l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
 
-    if causal:
+    if causal and not packed:
         pl.when(_block_needed(qi, kj, block_q, block_k, causal, offset))(
             _compute)
     else:
-        _compute()
+        _compute()   # packed grid contains only needed blocks
 
-    @pl.when(kj == nk - 1)
+    @pl.when(is_last)
     def _flush():
         l = jnp.maximum(l_s[...][:, :1], 1e-30)
         o_ref[0] = (acc_s[...] / l).astype(o_ref.dtype)
@@ -123,10 +155,14 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
 def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                   dq_s, *, causal: bool, scale: float, seq_k: int,
                   block_q: int, block_k: int, offset: int,
-                  mask_k_tail: bool):
-    qi = pl.program_id(1)
-    kj = pl.program_id(2)
-    nk = pl.num_programs(2)
+                  mask_k_tail: bool, packed: bool = False):
+    if packed:   # causal lower-triangle grid: (bh, tri(nq))
+        qi, kj = _tri_decode(pl.program_id(1))
+        is_last = kj == qi
+    else:
+        qi = pl.program_id(1)
+        kj = pl.program_id(2)
+        is_last = kj == pl.num_programs(2) - 1
 
     @pl.when(kj == 0)
     def _init():
@@ -156,13 +192,13 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             ds, k, dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
+    if causal and not packed:
         pl.when(_block_needed(qi, kj, block_q, block_k, causal, offset))(
             _compute)
     else:
-        _compute()
+        _compute()   # packed grid contains only needed blocks
 
-    @pl.when(kj == nk - 1)
+    @pl.when(is_last)
     def _flush():
         dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
 
@@ -170,16 +206,28 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dk_ref, dv_ref, dk_s, dv_s, *, causal: bool, scale: float,
                    seq_k: int, block_q: int, block_k: int, offset: int,
-                   mask_k_tail: bool, n_rep: int = 1):
+                   mask_k_tail: bool, n_rep: int = 1, packed_nq: int = 0):
     # grid (bh_kv, k blocks, q-head group reps, q blocks): the scratch
     # accumulates over BOTH the group axis and the q blocks, flushing once
-    # per kv block — this is how GQA's dK/dV reduction happens in-kernel
-    kj = pl.program_id(1)
-    rr = pl.program_id(2)
-    qi = pl.program_id(3)
-    nq = pl.num_programs(3)
+    # per kv block — this is how GQA's dK/dV reduction happens in-kernel.
+    # Packed (causal, square blocks): grid (bh_kv, tri(nq), reps) where the
+    # triangle index runs (kj, qi >= kj) pairs via u = nq-1-kj, w = qi-kj
+    # (so per-kj pairs are consecutive and the scratch flushes per kv block)
+    if packed_nq:
+        u, w = _tri_decode(pl.program_id(1))
+        kj = packed_nq - 1 - u
+        qi = kj + w
+        rr = pl.program_id(2)
+        first = (w == 0) & (rr == 0)
+        last = (w == u) & (rr == n_rep - 1)
+    else:
+        kj = pl.program_id(1)
+        rr = pl.program_id(2)
+        qi = pl.program_id(3)
+        first = (qi == 0) & (rr == 0)
+        last = (qi == pl.num_programs(3) - 1) & (rr == n_rep - 1)
 
-    @pl.when((qi == 0) & (rr == 0))
+    @pl.when(first)
     def _init():
         dk_s[...] = jnp.zeros_like(dk_s)
         dv_s[...] = jnp.zeros_like(dv_s)
@@ -212,13 +260,13 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
+    if causal and not packed_nq:
         pl.when(_block_needed(qi, kj, block_q, block_k, causal, offset))(
             _compute)
     else:
-        _compute()
+        _compute()   # packed grid contains only needed blocks
 
-    @pl.when((qi == nq - 1) & (rr == n_rep - 1))
+    @pl.when(last)
     def _flush():
         dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
@@ -256,28 +304,59 @@ def _block_sizes(sq, sk, block_q, block_k):
 
 
 # candidate (block_q, block_k) VMEM tilings for the autotuner — the TPU
-# analog of the reference's per-algorithm candidate list (auto_tune_base.h)
+# analog of the reference's per-algorithm candidate list (auto_tune_base.h).
+# Large tiles are cheap in VMEM (512x512: ~1.3MB of block buffers vs the
+# ~128MB budget) and cut grid-iteration overhead 8-16x vs 128x128.
 _BLOCK_CANDIDATES = ((128, 128), (256, 128), (128, 256), (256, 256),
-                     (512, 128), (128, 512))
+                     (512, 128), (128, 512), (256, 512), (512, 256),
+                     (512, 512))
+
+
+# Shipped block-size table keyed by (kind, seq bucket, head_dim),
+# consulted when autotune is off so production gets measured tiles
+# without paying a tuning pass. Populate from a hardware autotune run:
+# tools/flash_vs_xla.py (on the TPU queue) then tools/bake_flash_blocks.py
+# prints the literal. Empty or missing entries fall back to (128, 128).
+_SHIPPED_BLOCKS = {}
+
+
+def _shipped_blocks(kind, sq, d, device_kind):
+    if "v5 lite" not in device_kind:
+        return None
+    bucket = 1024 if sq <= 1024 else (2048 if sq <= 2048 else 4096)
+    return _SHIPPED_BLOCKS.get((kind, bucket, d))
 
 
 def _tuned_blocks(kind, bh, sq, sk, d, dtype, causal, interpret):
-    """Resolve (block_q, block_k): default (128, 128), or the timed winner
-    when FLAGS_use_autotune is on. Timing runs on synthetic zeros, so this
-    works even while the caller is being traced."""
+    """Resolve (block_q, block_k): the shipped v5e-measured table, the
+    runtime-timed winner when FLAGS_use_autotune is on, else (128, 128).
+    Timing runs on synthetic zeros, so this works even while the caller
+    is being traced."""
     from .autotune import autotune, autotune_enabled
     if not autotune_enabled():
+        if _SHIPPED_BLOCKS and not interpret:
+            hit = _shipped_blocks(kind, sq, d,
+                                  getattr(jax.devices()[0], "device_kind", ""))
+            if hit and hit[0] <= sq and hit[1] <= sk:
+                return hit
         return 128, 128
     dev = jax.devices()[0]
-    key = (kind, sq, sk, d, str(dtype), bool(causal), dev.device_kind)
+    # tb (the clamped tuning batch*heads) is part of the key: block ranking
+    # depends on grid parallelism, so a winner timed at 2 heads must not be
+    # served to a 64-head caller
+    tb = min(bh, 64)
+    key = (kind, tb, sq, sk, d, str(dtype), bool(causal), dev.device_kind)
 
     def make_runner(cfg):
         bq, bk = cfg
         if bq > sq or bk > sk:
             raise ValueError("block larger than sequence")
-        q = jnp.zeros((min(bh, 2), sq, d), dtype)
-        k = jnp.zeros((min(bh, 2), sk, d), dtype)
-        v = jnp.zeros((min(bh, 2), sk, d), dtype)
+        # tune at (close to) the caller's real batch*heads: block choice
+        # interacts with grid parallelism, and a 2-head proxy ranked
+        # candidates differently from the bh=64 train shape on v5e
+        q = jnp.zeros((tb, sq, d), dtype)
+        k = jnp.zeros((tb, sk, d), dtype)
+        v = jnp.zeros((tb, sk, d), dtype)
         # each candidate runs 8 iterations inside ONE compiled scan: a
         # single dispatch through the axon tunnel costs ~65ms, so per-call
         # timing ranks candidates by queue noise, not kernel speed (the r5
@@ -336,23 +415,47 @@ def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=128, block_k=128,
     mask_k_tail = sk_p != sk
     if interpret is None:
         interpret = _interpret_default()
-    grid = (bh, sq_p // block_q, sk_p // block_k)
     g = q_per_kv
+    nq, nk = sq_p // block_q, sk_p // block_k
+    # causal + square blocks + equal (padded) lengths: pack the grid to
+    # the lower triangle of (q block, k block) pairs — the rectangular
+    # grid spends half its steps and k/v DMAs on fully-masked pairs
+    packed = (causal and sk == sq and sq_p == sk_p
+              and block_q == block_k and _packing_on())
     kernel = functools.partial(
         _fa_fwd_kernel, causal=causal, scale=scale, seq_k=sk,
         block_q=block_q, block_k=block_k, offset=sk - sq,
-        mask_k_tail=mask_k_tail)
+        mask_k_tail=mask_k_tail, packed=packed)
+    if packed:
+        grid = (bh, nq * (nq + 1) // 2)
+
+        def qmap(b, p):
+            qi, _ = _tri_decode(p)
+            return (b, qi, 0)
+
+        def kmap(b, p):
+            _, kj = _tri_decode(p)
+            return (b // g, kj, 0)
+
+        in_maps = [qmap, kmap, kmap]
+        out_maps = [qmap, qmap]
+    else:
+        grid = (bh, nq, nk)
+        in_maps = [lambda b, i, j: (b, i, 0),
+                   lambda b, i, j: (b // g, j, 0),
+                   lambda b, i, j: (b // g, j, 0)]
+        out_maps = [lambda b, i, j: (b, i, 0), lambda b, i, j: (b, i, 0)]
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // g, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // g, j, 0)),
+            pl.BlockSpec((1, block_q, d), in_maps[0]),
+            pl.BlockSpec((1, block_k, d), in_maps[1]),
+            pl.BlockSpec((1, block_k, d), in_maps[2]),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), out_maps[0]),
+            pl.BlockSpec((1, block_q, _LANES), out_maps[1]),
         ],
         out_shape=[
             _sds((bh, sq_p, d), q.dtype, q),
@@ -409,18 +512,41 @@ def _flash_bwd_bhsd(q, k, v, o, lse, g, causal, scale, block_q=128,
 
     grp = q_per_kv
     bh_kv = bh // grp
+    # same lower-triangle packing as the forward (see _flash_fwd_bhsd):
+    # dq accumulates over kj <= qi only, so the rectangular grid's upper
+    # half is pure skipped-step overhead for causal self-attention
+    packed = (causal and sk == sq and sq_p == sk_p
+              and block_q == block_k and _packing_on())
+    if packed:
+        dq_grid = (bh, nq * (nq + 1) // 2)
+
+        def dq_qmap(b, p):
+            qi, _ = _tri_decode(p)
+            return (b, qi, 0)
+
+        def dq_kmap(b, p):
+            _, kj = _tri_decode(p)
+            return (b // grp, kj, 0)
+        dq_in = [dq_qmap, dq_kmap, dq_kmap, dq_qmap, dq_qmap, dq_qmap]
+        dq_out = dq_qmap
+    else:
+        dq_grid = (bh, nq, nk)
+        dq_qm = lambda b, i, j: (b, i, 0)   # noqa: E731
+        dq_km = lambda b, i, j: (b // grp, j, 0)   # noqa: E731
+        dq_in = [dq_qm, dq_km, dq_km, dq_qm, dq_qm, dq_qm]
+        dq_out = dq_qm
     dq = pl.pallas_call(
-        functools.partial(_fa_dq_kernel, **common),
-        grid=(bh, nq, nk),
+        functools.partial(_fa_dq_kernel, packed=packed, **common),
+        grid=dq_grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // grp, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // grp, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), dq_in[0]),
+            pl.BlockSpec((1, block_k, d), dq_in[1]),
+            pl.BlockSpec((1, block_k, d), dq_in[2]),
+            pl.BlockSpec((1, block_q, d), dq_in[3]),
+            pl.BlockSpec((1, block_q, _LANES), dq_in[4]),
+            pl.BlockSpec((1, block_q, _LANES), dq_in[5]),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), dq_out),
         out_shape=_sds((bh, sq_p, d), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
@@ -428,25 +554,42 @@ def _flash_bwd_bhsd(q, k, v, o, lse, g, causal, scale, block_q=128,
 
     # dkv grid: (kv heads, kv blocks, group reps, q blocks) — i innermost,
     # then r, so for a fixed kv block the scratch accumulates over the
-    # whole query-head group before flushing (n_rep=grp in the kernel)
+    # whole query-head group before flushing (n_rep=grp in the kernel).
+    # Packed: (kv heads, tri(nq), reps) — see _fa_dkv_kernel
+    if packed:
+        def dkv_qmap(b, p, r):
+            u, w = _tri_decode(p)
+            return (b * grp + r, (nq - 1 - u) + w, 0)
+
+        def dkv_kmap(b, p, r):
+            u, _ = _tri_decode(p)
+            return (b, nq - 1 - u, 0)
+        dkv_grid = (bh_kv, nq * (nq + 1) // 2, grp)
+        dkv_in = [dkv_qmap, dkv_kmap, dkv_kmap, dkv_qmap, dkv_qmap,
+                  dkv_qmap]
+        dkv_out = dkv_kmap
+        dkv_extra = {"packed_nq": nq}
+    else:
+        dkv_qm = lambda b, j, r, i: (b * grp + r, i, 0)   # noqa: E731
+        dkv_km = lambda b, j, r, i: (b, j, 0)   # noqa: E731
+        dkv_grid = (bh_kv, nk, grp, nq)
+        dkv_in = [dkv_qm, dkv_km, dkv_km, dkv_qm, dkv_qm, dkv_qm]
+        dkv_out = dkv_km
+        dkv_extra = {}
     dk, dv = pl.pallas_call(
-        functools.partial(_fa_dkv_kernel, n_rep=grp, **common),
-        grid=(bh_kv, nk, grp, nq),
+        functools.partial(_fa_dkv_kernel, n_rep=grp, **dkv_extra, **common),
+        grid=dkv_grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d),
-                         lambda b, j, r, i: (b * grp + r, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, r, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, r, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d),
-                         lambda b, j, r, i: (b * grp + r, i, 0)),
-            pl.BlockSpec((1, block_q, _LANES),
-                         lambda b, j, r, i: (b * grp + r, i, 0)),
-            pl.BlockSpec((1, block_q, _LANES),
-                         lambda b, j, r, i: (b * grp + r, i, 0)),
+            pl.BlockSpec((1, block_q, d), dkv_in[0]),
+            pl.BlockSpec((1, block_k, d), dkv_in[1]),
+            pl.BlockSpec((1, block_k, d), dkv_in[2]),
+            pl.BlockSpec((1, block_q, d), dkv_in[3]),
+            pl.BlockSpec((1, block_q, _LANES), dkv_in[4]),
+            pl.BlockSpec((1, block_q, _LANES), dkv_in[5]),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, r, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, r, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), dkv_out),
+            pl.BlockSpec((1, block_k, d), dkv_out),
         ],
         out_shape=[
             _sds((bh_kv, sk_p, d), k.dtype, k),

@@ -1,0 +1,60 @@
+"""Turn .flash_vs_xla.json autotune results into a _SHIPPED_BLOCKS literal.
+
+Reads the candidate_ms spreads (written by the r5 autotuner's timing_log)
+and emits, for each (kind, seq, head_dim), the winning (block_q, block_k)
+— but only when the win over the (128, 128) baseline exceeds `MARGIN`
+(close timings mean the winner is tunnel-noise-sensitive; shipping the
+default is safer than shipping noise).
+
+Usage: python tools/bake_flash_blocks.py [path] (default .flash_vs_xla.json)
+Prints the dict to paste into ops/pallas/flash_attention.py.
+"""
+
+import ast
+import json
+import os
+import sys
+
+MARGIN = 0.97  # winner must be <= 97% of baseline ms
+
+path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    ".flash_vs_xla.json")
+doc = json.load(open(path))
+tuned = doc.get("autotuned_blocks", {})
+spreads = tuned.get("candidate_ms", {})
+
+print(f"# from {path} on {doc.get('device_kind')}")
+print("_SHIPPED_BLOCKS = {")
+best_bh = {}   # (kind, seq, d) -> (bh, win, note): prefer the largest bh
+for key, win in sorted(tuned.items()):
+    if key == "candidate_ms" or isinstance(win, str):
+        continue
+    parts = key.split("_")   # fwd_s2048_d128[_bh64]
+    kind, seq, d = parts[0], int(parts[1][1:]), int(parts[2][1:])
+    bh = int(parts[3][2:]) if len(parts) > 3 else 0
+    note = ""
+    # find this key's spread: timing_log keys are the _tuned_blocks cache
+    # tuples (kind, tb, sq, sk, d, dtype, causal, device) — tb=min(bh,64)
+    for sk, ms in spreads.items():
+        try:
+            tup = ast.literal_eval(sk)
+        except Exception:
+            continue
+        if (tup[0] == kind and tup[2] == seq and tup[4] == d
+                and tup[1] == min(bh, 64)):
+            base = ms.get("(128, 128)")
+            bw = ms.get(str(tuple(win)))
+            if base and bw:
+                if bw > base * MARGIN:
+                    win = [128, 128]
+                    note = f"  # win over default <3% ({bw} vs {base}ms)"
+                else:
+                    note = f"  # {bw}ms vs default {base}ms"
+            break
+    cur = best_bh.get((kind, seq, d))
+    if cur is None or bh > cur[0]:
+        best_bh[(kind, seq, d)] = (bh, win, note)
+for (kind, seq, d), (bh, win, note) in sorted(best_bh.items()):
+    print(f'    ("{kind}", {seq}, {d}): {tuple(win)},{note}  # bh={bh}')
+print("}")

@@ -1,0 +1,3 @@
+# tunnel rest after 446's kill-timeouts: a killed worker wedges the
+# tunnel 10-60 min; give it a cooling window before the real measurements
+sleep 900

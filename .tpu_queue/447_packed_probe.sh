@@ -1,0 +1,23 @@
+# 30s probe: does Mosaic lower the triangle-packed causal grid (non-affine
+# index maps) on real TPU, and does it match the dense reference?
+cd /root/repo
+timeout 900 python - <<'EOF' 2> .diag447.err
+import jax, jax.numpy as jnp, numpy as np, time
+from paddle_tpu.ops.pallas.flash_attention import (
+    _flash_fwd_bhsd, _flash_bwd_bhsd, _xla_attention_bhsd)
+rs = np.random.RandomState(0)
+q = jnp.asarray(rs.randn(4, 1024, 128), jnp.bfloat16)
+k = jnp.asarray(rs.randn(4, 1024, 128), jnp.bfloat16)
+v = jnp.asarray(rs.randn(4, 1024, 128), jnp.bfloat16)
+t0 = time.time()
+o, lse = jax.jit(lambda q,k,v: _flash_fwd_bhsd(q,k,v,True,0.088))(q,k,v)
+ref = _xla_attention_bhsd(q.astype(jnp.float32), k.astype(jnp.float32),
+                          v.astype(jnp.float32), True, 0.088)
+err = float(jnp.abs(o.astype(jnp.float32) - ref).max())
+print(f"PACKED_FWD ok err={err:.4f} t={time.time()-t0:.1f}s", flush=True)
+g = jnp.ones_like(o)
+dq, dk, dv = jax.jit(lambda *a: _flash_bwd_bhsd(*a, True, 0.088))(q,k,v,o,lse,g)
+print(f"PACKED_BWD ok finite={bool(jnp.isfinite(dq.astype(jnp.float32)).all())}",
+      flush=True)
+EOF
+tail -3 .diag447.err

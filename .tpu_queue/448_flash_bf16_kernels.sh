@@ -1,0 +1,12 @@
+# Measure the bf16-operand flash kernels (dots now run native-bf16 with
+# f32 accumulation instead of upcasting operands to f32 — the f32-operand
+# flavor ran the MXU at quarter rate and measured 0.86x/0.52x dense).
+# Packed grids pinned OFF here to isolate the bf16 effect; 451 A/Bs them.
+cd /root/repo
+export FLAGS_flash_packed_grid=0
+echo "=== amortized flash-vs-dense table, bf16-operand kernels (unpacked)"
+timeout 1800 python tools/flash_vs_xla.py 2> .diag448_tab.err | grep -a "fwd\|seq=\|wrote"
+echo "=== 535m bench, bf16-operand flash (unpacked)"
+timeout 1500 python bench.py --worker --config 3 2> .diag448_b.err | tail -1
+echo "=== 780m bench, bf16-operand flash (remat recipe, unpacked)"
+timeout 1500 python bench.py --worker --config 2 2> .diag448_c.err | tail -1

@@ -1,0 +1,12 @@
+# (a) 535m at b8/b16: the ladder pins b4 (the r2 comparison point) but
+#     MFU typically climbs with batch until HBM pressure bites.
+# (b) ResNet-50 secondary: first run since the bf16 conv backward fix.
+# Packed grids pinned OFF for comparability with 448's b4 baseline row.
+cd /root/repo
+export FLAGS_flash_packed_grid=0
+echo "=== 535m b8"
+timeout 1500 python bench.py --worker --config 3 --batch 8 2> .diag449_a.err | tail -1
+echo "=== 535m b16"
+timeout 1500 python bench.py --worker --config 3 --batch 16 2> .diag449_b.err | tail -1
+echo "=== resnet50 secondary (bf16 conv fix)"
+timeout 1200 python bench.py --worker --secondary resnet 2> .diag449_c.err | tail -1

@@ -1,0 +1,9 @@
+# A/B the triangle-packed causal grid (default ON in code) against the
+# rectangular grid measured in 448: amortized table + the 535m step.
+cd /root/repo
+echo "=== amortized flash table, PACKED grids"
+FLAGS_flash_packed_grid=1 timeout 1800 python tools/flash_vs_xla.py 2> .diag451_tab.err | grep -a "fwd\|seq=\|wrote"
+echo "=== 535m bench, bf16 + packed"
+FLAGS_flash_packed_grid=1 timeout 1500 python bench.py --worker --config 3 2> .diag451_b.err | tail -1
+echo "=== 780m bench, bf16 + packed"
+FLAGS_flash_packed_grid=1 timeout 1500 python bench.py --worker --config 2 2> .diag451_c.err | tail -1

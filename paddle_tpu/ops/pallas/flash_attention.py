@@ -89,6 +89,20 @@ def _tri_decode(p):
     return qi, kj
 
 
+def _tri_maps(g):
+    """(qmap, kmap) BlockSpec index maps for the packed (bh, tri) grid —
+    shared by the fwd and dQ kernels (the dKV kernel's reversed-row
+    staircase variant lives at its call site)."""
+    def qmap(b, p):
+        qi, _ = _tri_decode(p)
+        return (b, qi, 0)
+
+    def kmap(b, p):
+        _, kj = _tri_decode(p)
+        return (b // g, kj, 0)
+    return qmap, kmap
+
+
 def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
                    causal: bool, scale: float, seq_k: int, block_q: int,
                    block_k: int, offset: int, mask_k_tail: bool,
@@ -428,15 +442,7 @@ def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=128, block_k=128,
         mask_k_tail=mask_k_tail, packed=packed)
     if packed:
         grid = (bh, nq * (nq + 1) // 2)
-
-        def qmap(b, p):
-            qi, _ = _tri_decode(p)
-            return (b, qi, 0)
-
-        def kmap(b, p):
-            _, kj = _tri_decode(p)
-            return (b // g, kj, 0)
-
+        qmap, kmap = _tri_maps(g)
         in_maps = [qmap, kmap, kmap]
         out_maps = [qmap, qmap]
     else:
@@ -519,14 +525,7 @@ def _flash_bwd_bhsd(q, k, v, o, lse, g, causal, scale, block_q=128,
               and block_q == block_k and _packing_on())
     if packed:
         dq_grid = (bh, nq * (nq + 1) // 2)
-
-        def dq_qmap(b, p):
-            qi, _ = _tri_decode(p)
-            return (b, qi, 0)
-
-        def dq_kmap(b, p):
-            _, kj = _tri_decode(p)
-            return (b // grp, kj, 0)
+        dq_qmap, dq_kmap = _tri_maps(grp)
         dq_in = [dq_qmap, dq_kmap, dq_kmap, dq_qmap, dq_qmap, dq_qmap]
         dq_out = dq_qmap
     else:

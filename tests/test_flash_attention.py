@@ -21,10 +21,14 @@ def _rand(rs, *shape, dtype=np.float32):
 
 
 CASES = [
-    # (seq_q, seq_k, causal): aligned, ragged (pad-masked), cross-length
+    # (seq_q, seq_k, causal): aligned, ragged (pad-masked), cross-length.
+    # Causal sq==sk cases run the triangle-PACKED grid; 384/520 stress the
+    # multi-block linear-index decode (nq=3 and nq=5-with-padded-tail)
     (256, 256, False),
     (256, 256, True),
     (200, 200, True),
+    (384, 384, True),
+    (520, 520, True),
     (128, 320, True),
     (100, 260, False),
 ]
@@ -271,10 +275,11 @@ class TestGQAFlash:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-4, atol=2e-5)
 
-    def test_backward_matches_dense_expanded(self):
+    @pytest.mark.parametrize("sq", [64, 100])  # 100: nq=4 + padded tail
+    def test_backward_matches_dense_expanded(self, sq):
         from paddle_tpu.ops.pallas.flash_attention import (
             _flash_fwd_bhsd, _flash_bwd_bhsd, _xla_attention_bhsd)
-        q, k, v, rep = self._make()
+        q, k, v, rep = self._make(sq=sq, sk=sq)
         causal, scale = True, 0.25
         out, lse = _flash_fwd_bhsd(q, k, v, causal, scale, block_q=32,
                                    block_k=32, interpret=True,

@@ -52,6 +52,12 @@ for key, win in sorted(tuned.items()):
                 else:
                     note = f"  # {bw}ms vs default {base}ms"
             break
+    if not note:
+        # no timing spread to validate against (legacy JSON without
+        # candidate_ms, or a bh-less key): this winner may be ranked by
+        # tunnel noise — refuse to ship it, fall back to the default
+        win = [128, 128]
+        note = "  # UNVALIDATED winner (no candidate_ms spread) -> default"
     cur = best_bh.get((kind, seq, d))
     if cur is None or bh > cur[0]:
         best_bh[(kind, seq, d)] = (bh, win, note)

@@ -53,7 +53,10 @@ for i in $(seq 1 40); do
   log "probe[$i]: ${out:-<no output>} err: ${errtail:-<none>}"
   if echo "$out" | grep -q tpu_alive; then
     log "TUNNEL ALIVE - warming ladder untimed (configs 3 2 1 0 + resnet + bert)"
-    python tools/tpu_ladder_warm.py 3 2 1 0 resnet bert >> "$LOG" 2>&1
+    # configs 1/0 (1.3b) dropped from warm: they compile for minutes then
+    # deterministically OOM at runtime on the 16GB chip (r5 established);
+    # the bench walks them with its own bounded timeouts
+    python tools/tpu_ladder_warm.py 3 2 resnet bert >> "$LOG" 2>&1
     log "ladder warm finished"
     touch .tpu_warm_done
     warmed=1

@@ -1,0 +1,4 @@
+# longer cooling window: the 446-era kill cascade wedged the remote
+# compile helper well past the first 900s rest; give it a full 1800s
+# before the bf16 measurement spends its own timeouts
+sleep 1800

@@ -4,6 +4,14 @@
 # Packed grids pinned OFF here to isolate the bf16 effect; 451 A/Bs them.
 cd /root/repo
 export FLAGS_flash_packed_grid=0
+# probe gate: don't spend the measurement timeouts on a wedged tunnel —
+# a tiny matmul answers in seconds when healthy
+for i in 1 2 3 4; do
+  out=$(timeout 600 python bench.py --worker --probe 2>/dev/null | tail -1)
+  echo "pre-448 probe[$i]: ${out:-<no output>}"
+  echo "$out" | grep -q tpu_alive && break
+  sleep 1200
+done
 echo "=== amortized flash-vs-dense table, bf16-operand kernels (unpacked)"
 timeout 1800 python tools/flash_vs_xla.py 2> .diag448_tab.err | grep -a "fwd\|seq=\|wrote"
 echo "=== 535m bench, bf16-operand flash (unpacked)"

@@ -646,14 +646,15 @@ def _fa_fwd(q, k, v, causal, scale, q_per_kv=1):
 def _dense_remat_bwd(q, k, v, causal, scale, q_per_kv, g):
     """Backward via XLA-dense rematerialization (GQA-grouped).
 
-    Measured on TPU v5e (r5): the hand-written Pallas backward costs the
-    535m train step ~19% end-to-end vs letting XLA differentiate a dense
-    recompute (42.4% vs 52.2% MFU at seq 2048) — XLA's fused softmax-vjp
-    matmul chain beats the dQ/dKV split kernels at moderate sequence
-    lengths. The transient (bh, sq, sk) fp32 buffer exists for ONE layer
-    at a time during the backward, so HBM stays bounded; past the auto
-    threshold (seq > 2048) the O(S^2) buffer overtakes the kernel gap and
-    the Pallas backward wins on memory."""
+    Measured on TPU v5e (r5): ISOLATED-kernel timing favors this hybrid
+    over the Pallas dQ/dKV split (9.0ms vs 12.9ms fwd+bwd at s2048 d128
+    with the f32-operand kernels), but END-TO-END the 535m train step
+    measured the opposite — 0.406 MFU hybrid vs 0.426 full-pallas — the
+    transient (bh, sq, sk) fp32 buffer's HBM pressure costs the scheduled
+    step more than the kernel gap saves. It remains the better backward
+    for zero-padded head dims (d96: 6.7ms vs 13.8ms per-kernel, the pad
+    taxes the Pallas bwd twice) and is selectable via
+    FLAGS_flash_attention_bwd=xla."""
     def f(q_, k_, v_):
         if q_per_kv == 1:
             return _xla_attention_bhsd(q_, k_, v_, causal, scale)

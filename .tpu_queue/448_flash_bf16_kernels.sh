@@ -13,7 +13,7 @@ for i in 1 2 3 4; do
   sleep 1200
 done
 echo "=== amortized flash-vs-dense table, bf16-operand kernels (unpacked)"
-timeout 1800 python tools/flash_vs_xla.py 2> .diag448_tab.err | grep -a "fwd\|seq=\|wrote"
+FLASH_TABLE_SKIP_AUTOTUNE=1 timeout 1800 python tools/flash_vs_xla.py 2> .diag448_tab.err | grep -a "fwd\|seq=\|wrote"
 echo "=== 535m bench, bf16-operand flash (unpacked)"
 timeout 1500 python bench.py --worker --config 3 2> .diag448_b.err | tail -1
 echo "=== 780m bench, bf16-operand flash (remat recipe, unpacked)"

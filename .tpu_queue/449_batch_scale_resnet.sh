@@ -3,6 +3,13 @@
 # (b) ResNet-50 secondary: first run since the bf16 conv backward fix.
 # Packed grids pinned OFF for comparability with 448's b4 baseline row.
 cd /root/repo
+# probe gate: don't spend measurement timeouts on a wedged tunnel
+for i in 1 2 3; do
+  out=$(timeout 600 python bench.py --worker --probe 2>/dev/null | tail -1)
+  echo "pre-job probe[$i]: ${out:-<no output>}"
+  echo "$out" | grep -q tpu_alive && break
+  sleep 1200
+done
 export FLAGS_flash_packed_grid=0
 echo "=== 535m b8"
 timeout 1500 python bench.py --worker --config 3 --batch 8 2> .diag449_a.err | tail -1

@@ -1,6 +1,13 @@
 # A/B the triangle-packed causal grid (default ON in code) against the
 # rectangular grid measured in 448: amortized table + the 535m step.
 cd /root/repo
+# probe gate: don't spend measurement timeouts on a wedged tunnel
+for i in 1 2 3; do
+  out=$(timeout 600 python bench.py --worker --probe 2>/dev/null | tail -1)
+  echo "pre-job probe[$i]: ${out:-<no output>}"
+  echo "$out" | grep -q tpu_alive && break
+  sleep 1200
+done
 echo "=== amortized flash table, PACKED grids"
 FLAGS_flash_packed_grid=1 timeout 1800 python tools/flash_vs_xla.py 2> .diag451_tab.err | grep -a "fwd\|seq=\|wrote"
 echo "=== 535m bench, bf16 + packed"

@@ -63,10 +63,16 @@ def _block_needed(qi, kj, block_q, block_k, causal, offset):
 
 
 _flags.define_flag(
-    "flash_packed_grid", True,
+    "flash_packed_grid", False,
     "causal flash kernels iterate only the lower-triangle (q,k) block "
     "pairs instead of a rectangular grid with half the steps masked off "
-    "(saves the skipped steps' k/v DMAs and grid overhead)")
+    "(saves the skipped steps' k/v DMAs and grid overhead). Default OFF: "
+    "numerically exact under the interpreter (tests force it on), but "
+    "the non-affine index maps have not yet lowered on real TPU — the "
+    "r5 validation probe was lost to a tunnel outage. Flip on once "
+    ".tpu_queue/451_packed_ab.sh proves it on hardware. NOTE: read at "
+    "TRACE time — set the env var before process start (or clear jit "
+    "caches); set_flags after a shape compiled does not retrace it.")
 
 
 def _packing_on():

@@ -185,3 +185,9 @@ class Stub(Layer):
 
     def forward(self, x):
         return x
+
+
+from . import format  # noqa: E402  (QAT->deployment conversion layers)
+from .format import (  # noqa: E402,F401
+    LinearDequanter, LinearQuanter, LinearQuanterDequanter,
+    fake_fp8_dequant, fake_fp8_quant)

@@ -1,0 +1,213 @@
+"""Quant/dequant format layers — the QAT->deployment conversion pieces.
+
+reference capability: python/paddle/nn/quant/format.py (LinearQuanter /
+LinearDequanter / LinearQuanterDequanter, fake_fp8_quant/dequant). The
+reference routes integer formats through the quantize_linear C++ op and
+fp8 through clip-then-cast; here every format is a few jnp ops XLA fuses,
+and the fp8 path rounds through REAL ml_dtypes float8 storage types
+(jnp.float8_e4m3fn / jnp.float8_e5m2), so the fake-quant error matches
+what serialized fp8 weights will actually reproduce.
+
+quant_bits contract (matches the reference):
+  int    -> SYMMETRIC integer grid, clip to [-qmax, qmax] with
+            qmax = 2^(b-1)-1. (The reference's deployed op admits -qmax-1;
+            we drop that one level so conversion is bit-exact with this
+            framework's QAT fake-quant, which trains on [-qmax, qmax].)
+  (4, 3) -> float8 e4m3 (finite range +-448)
+  (5, 2) -> float8 e5m2 (finite range +-57344)
+
+Channels whose scale is 0 (never-observed quanters) pass through
+UNQUANTIZED — the same guard the QAT fake-quant applies — instead of
+collapsing to zeros through a divide-by-zero.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, execute
+from ..layer.layers import Layer
+
+__all__ = ["LinearQuanter", "LinearDequanter", "LinearQuanterDequanter",
+           "fake_fp8_quant", "fake_fp8_dequant"]
+
+_FP8 = {
+    "e4m3": (448.0, "float8_e4m3fn"),
+    "e5m2": (57344.0, "float8_e5m2"),
+}
+
+
+def _axis_shape(scale, ndim, axis):
+    if axis is None or axis < 0 or scale.ndim == 0:
+        return scale
+    shape = [1] * ndim
+    shape[axis] = scale.size
+    return scale.reshape(shape)
+
+
+def fake_fp8_quant(x, scale, axis=-1, type="e4m3"):
+    """Scale into the fp8 grid, round through the REAL fp8 dtype, return
+    in the input dtype (still scaled — pair with fake_fp8_dequant).
+    Zero-scale entries pass through unquantized."""
+    if type not in _FP8:
+        raise NotImplementedError("only e4m3 / e5m2 fp8 formats exist")
+    fmax, fp8_dtype = _FP8[type]
+
+    def f(a, s):
+        if s.ndim > 1:
+            raise NotImplementedError(
+                "fp8 formats support tensor-wise or per-channel scales; "
+                "group-wise (2-D) scales are int-format only")
+        s = _axis_shape(s, a.ndim, axis)
+        a32 = a.astype(jnp.float32)
+        safe = jnp.where(s > 0, s, 1.0)
+        scaled = jnp.clip(a32 * fmax / safe, -fmax, fmax)
+        q = scaled.astype(fp8_dtype).astype(jnp.float32)
+        return jnp.where(s > 0, q, a32).astype(a.dtype)
+
+    return execute(f, x, scale, _name="fake_fp8_quant")
+
+
+def fake_fp8_dequant(x, scale, axis=-1, type="e4m3"):
+    if type not in _FP8:
+        raise NotImplementedError("only e4m3 / e5m2 fp8 formats exist")
+    fmax, _ = _FP8[type]
+
+    def f(a, s):
+        if s.ndim > 1:
+            raise NotImplementedError(
+                "fp8 formats support tensor-wise or per-channel scales; "
+                "group-wise (2-D) scales are int-format only")
+        s = _axis_shape(s, a.ndim, axis)
+        a32 = a.astype(jnp.float32)
+        return jnp.where(s > 0, a32 / fmax * s, a32).astype(a.dtype)
+
+    return execute(f, x, scale, _name="fake_fp8_dequant")
+
+
+def _parse_bits(bit_length):
+    """-> (qmax, fp8_type_or_None). Integer grids are symmetric."""
+    if isinstance(bit_length, (tuple, list)):
+        if tuple(bit_length) == (4, 3):
+            return 448.0, "e4m3"
+        if tuple(bit_length) == (5, 2):
+            return 57344.0, "e5m2"
+        raise NotImplementedError(
+            "only float8 formats (4,3)=e4m3 and (5,2)=e5m2 are supported "
+            "as tuple quant_bits")
+    return float((1 << (int(bit_length) - 1)) - 1), None
+
+
+class _ScaledFormat(Layer):
+    """Shared scale/zero-point normalization for the format layers."""
+
+    def __init__(self, scales, zero_point, quant_axis, bit_length,
+                 group_size):
+        super().__init__()
+        self._scales = jnp.asarray(
+            scales._data if isinstance(scales, Tensor) else scales,
+            jnp.float32)
+        self._zero_point = (jnp.asarray(
+            zero_point._data if isinstance(zero_point, Tensor)
+            else zero_point, jnp.float32) if zero_point is not None
+            else jnp.zeros((), jnp.float32))
+        self._quant_axis = -1 if quant_axis is None else quant_axis
+        self._qmax, self._fp8 = _parse_bits(bit_length)
+        self._group_size = group_size
+        if self._fp8 is not None and zero_point is not None and \
+                bool(jnp.any(self._zero_point != 0)):
+            raise NotImplementedError(
+                "fp8 formats are symmetric; zero_point must be 0/None")
+
+    def _prep(self, a):
+        """-> (scale, zero_point) broadcastable against `a`, honoring
+        quant_axis (1-D scales) or row-group layout (2-D scales)."""
+        s, z = self._scales, self._zero_point
+        if s.ndim > 1:   # group-wise: one scale row per `group` input rows
+            s = jnp.repeat(s, self._group_size, 0)[:a.shape[0]]
+            if z.ndim > 1:
+                z = jnp.repeat(z, self._group_size, 0)[:a.shape[0]]
+            return s, z
+        return (_axis_shape(s, a.ndim, self._quant_axis),
+                _axis_shape(z, a.ndim, self._quant_axis))
+
+
+class LinearQuanter(_ScaledFormat):
+    """x -> quantized grid (int levels or fp8), kept in x's dtype."""
+
+    def __init__(self, scales, zero_point=None, quant_axis=None,
+                 bit_length=8, group_size=128):
+        super().__init__(scales, zero_point, quant_axis, bit_length,
+                         group_size)
+
+    def forward(self, x):
+        if self._fp8 is not None:
+            return fake_fp8_quant(x, Tensor(self._scales),
+                                  self._quant_axis, self._fp8)
+        qmax = self._qmax
+
+        def f(a):
+            s, z = self._prep(a)
+            a32 = a.astype(jnp.float32)
+            safe = jnp.where(s > 0, s, 1.0)
+            q = jnp.clip(jnp.round(a32 / safe * qmax) + z, -qmax, qmax)
+            return jnp.where(s > 0, q, a32).astype(a.dtype)
+
+        return execute(f, x, _name="quantize_linear")
+
+    @staticmethod
+    def from_quanter(quanter):
+        return LinearQuanter(quanter.scales(), quant_axis=None,
+                             bit_length=quanter.bit_length())
+
+
+class LinearDequanter(_ScaledFormat):
+    """Inverse of LinearQuanter (same scale/axis/bits contract)."""
+
+    def __init__(self, scales, zero_point=None, quant_axis=None,
+                 bit_length=8, group_size=128):
+        super().__init__(scales, zero_point, quant_axis, bit_length,
+                         group_size)
+
+    def forward(self, x):
+        if self._fp8 is not None:
+            return fake_fp8_dequant(x, Tensor(self._scales),
+                                    self._quant_axis, self._fp8)
+        qmax = self._qmax
+
+        def f(a):
+            s, z = self._prep(a)
+            a32 = a.astype(jnp.float32)
+            return jnp.where(s > 0, (a32 - z) * s / qmax,
+                             a32).astype(a.dtype)
+
+        return execute(f, x, _name="dequantize_linear")
+
+    @staticmethod
+    def from_quanter(quanter):
+        return LinearDequanter(quanter.scales(), quant_axis=None,
+                               bit_length=quanter.bit_length())
+
+
+class LinearQuanterDequanter(Layer):
+    """Quant->dequant pair — the deployed form of a trained fake-quanter
+    (reference: LinearQuanterDequanter.from_quanter, the QAT->inference
+    conversion target)."""
+
+    def __init__(self, quanter, dequanter):
+        super().__init__()
+        self._quanter = quanter
+        self._dequanter = dequanter
+
+    def forward(self, x):
+        out = x
+        if self._quanter is not None:
+            out = self._quanter(out)
+        if self._dequanter is not None:
+            out = self._dequanter(out)
+        return out
+
+    @staticmethod
+    def from_quanter(quanter):
+        return LinearQuanterDequanter(LinearQuanter.from_quanter(quanter),
+                                      LinearDequanter.from_quanter(quanter))

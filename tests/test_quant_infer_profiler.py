@@ -191,3 +191,118 @@ class TestReviewRegressions:
         with pytest.raises(Exception):
             paddle.jit.save(model, str(tmp_path / "bad"), input_spec=[Bad()])
         assert model.training is True
+
+
+class TestQuantFormat:
+    """nn.quant.format: LinearQuanter/LinearDequanter incl. the fp8
+    (4,3)/(5,2) formats (reference: python/paddle/nn/quant/format.py —
+    fp8 rounds through REAL ml_dtypes float8 storage here)."""
+
+    def _x(self):
+        return paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 16).astype("float32"))
+
+    def test_int8_roundtrip_error_bound(self):
+        from paddle_tpu.nn.quant import LinearDequanter, LinearQuanter
+        x = self._x()
+        s = paddle.to_tensor(np.abs(np.asarray(x._data)).max(axis=0))
+        q = LinearQuanter(s, quant_axis=1, bit_length=8)(x)
+        # quantized values live on the integer grid
+        qv = np.asarray(q._data)
+        assert np.allclose(qv, np.round(qv))
+        assert qv.max() <= 127 and qv.min() >= -128
+        d = LinearDequanter(s, quant_axis=1, bit_length=8)(q)
+        err = np.abs(np.asarray(d._data) - np.asarray(x._data)).max()
+        assert err <= float(np.asarray(s._data).max()) / 127 + 1e-6
+
+    @pytest.mark.parametrize("bits,rel_bound", [((4, 3), 0.07),
+                                                ((5, 2), 0.15)])
+    def test_fp8_roundtrip_error_bound(self, bits, rel_bound):
+        from paddle_tpu.nn.quant import LinearDequanter, LinearQuanter
+        x = self._x()
+        s = paddle.to_tensor(np.abs(np.asarray(x._data)).max(axis=0))
+        q = LinearQuanter(s, quant_axis=1, bit_length=bits)(x)
+        d = LinearDequanter(s, quant_axis=1, bit_length=bits)(q)
+        xa = np.asarray(x._data)
+        rel = np.abs(np.asarray(d._data) - xa).max() / np.abs(xa).max()
+        assert rel < rel_bound
+
+    def test_fp8_values_on_fp8_grid(self):
+        # quantized outputs must be exactly representable in e4m3
+        import jax.numpy as jnp
+
+        from paddle_tpu.nn.quant import LinearQuanter
+        x = self._x()
+        s = paddle.to_tensor(np.abs(np.asarray(x._data)).max())
+        q = LinearQuanter(s, bit_length=(4, 3))(x)
+        qv = q._data
+        assert bool((qv.astype(jnp.float8_e4m3fn).astype(jnp.float32)
+                     == qv).all())
+
+    def test_bad_tuple_bits_raises(self):
+        from paddle_tpu.nn.quant import LinearQuanter
+        with pytest.raises(NotImplementedError):
+            LinearQuanter(np.ones(1), bit_length=(3, 4))
+
+    def test_from_quanter_conversion(self):
+        from paddle_tpu.nn.quant import LinearQuanterDequanter
+        from paddle_tpu.quantization import FakeQuanterWithAbsMaxObserver
+        x = self._x()
+        fq = FakeQuanterWithAbsMaxObserver()
+        fake = fq(x)                       # observes scale, fake-quants
+        qdq = LinearQuanterDequanter.from_quanter(fq)(x)
+        np.testing.assert_allclose(np.asarray(qdq._data),
+                                   np.asarray(fake._data), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_from_quanter_matches_qat_below_range(self):
+        """Deployment must clip like QAT ([-qmax, qmax]): a value below
+        -scale maps to exactly -scale, not -scale*(qmax+1)/qmax."""
+        from paddle_tpu.nn.quant import LinearQuanterDequanter
+        from paddle_tpu.quantization import FakeQuanterWithAbsMaxObserver
+        x = paddle.to_tensor(np.array([3.0, -4.0], np.float32))
+        fq = FakeQuanterWithAbsMaxObserver()
+        fake = fq(x)                       # scale observes 4.0... use both
+        qdq = LinearQuanterDequanter.from_quanter(fq)(x)
+        np.testing.assert_allclose(np.asarray(qdq._data),
+                                   np.asarray(fake._data), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_zero_scale_passes_through(self):
+        """Unobserved quanter (scale 0): conversion must not destroy data
+        (matches the QAT fake-quant's where(scale>0) guard)."""
+        from paddle_tpu.nn.quant import LinearQuanterDequanter
+        from paddle_tpu.quantization import FakeQuanterWithAbsMaxObserver
+        x = paddle.to_tensor(np.array([1.0, -2.0], np.float32))
+        fq = FakeQuanterWithAbsMaxObserver()   # never observed: scale 0
+        out = LinearQuanterDequanter.from_quanter(fq)(x)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(x._data))
+
+    def test_per_channel_zero_point(self):
+        from paddle_tpu.nn.quant import LinearDequanter, LinearQuanter
+        # values inside the zero-point-shifted representable range
+        # [(-qmax-z)s/qmax, (qmax-z)s/qmax]; a zero_point trades headroom
+        # on one side for the other, so stay within +-0.5*s here
+        x = paddle.to_tensor((np.random.RandomState(1).rand(2, 3)
+                              .astype("float32") - 0.5))
+        s = np.array([1.0, 2.0], np.float32)
+        z = np.array([10.0, 20.0], np.float32)
+        q = LinearQuanter(s, zero_point=z, quant_axis=0, bit_length=8)(x)
+        d = LinearDequanter(s, zero_point=z, quant_axis=0, bit_length=8)(q)
+        err = np.abs(np.asarray(d._data) - np.asarray(x._data)).max()
+        assert err <= 2.0 / 127 + 1e-6   # <= half step of the widest chan
+
+    def test_fp8_group_scales_raise(self):
+        from paddle_tpu.nn.quant import LinearQuanter
+        q = LinearQuanter(np.ones((2, 3), np.float32), bit_length=(4, 3))
+        x = paddle.to_tensor(np.ones((256, 3), np.float32))
+        with pytest.raises(NotImplementedError):
+            q(x)
+
+    def test_fp8_zero_point_raises(self):
+        from paddle_tpu.nn.quant import LinearQuanter
+        with pytest.raises(NotImplementedError):
+            LinearQuanter(np.ones(3, np.float32),
+                          zero_point=np.array([1.0, 0.0, 0.0]),
+                          bit_length=(4, 3))

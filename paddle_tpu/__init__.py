@@ -206,13 +206,9 @@ dtype = _dtypes_mod.DType if hasattr(_dtypes_mod, "DType") else type(
     _dtypes_mod.convert_dtype("float32"))
 from .tensor.attribute import shape  # noqa: F401,E402
 
-try:  # fp8 dtypes via ml_dtypes (TPU-native fp8 support)
-    import ml_dtypes as _mld
-    float8_e4m3fn = _mld.float8_e4m3fn
-    float8_e5m2 = _mld.float8_e5m2
-except ImportError:  # pragma: no cover
-    float8_e4m3fn = None
-    float8_e5m2 = None
+# fp8 dtypes: single source of truth is the registry (framework.dtypes),
+# which also resolves the "float8_e4m3fn"/"float8_e5m2" cast names
+from .framework.dtypes import float8_e4m3fn, float8_e5m2  # noqa: F401,E402
 
 
 def check_shape(shape_v):

@@ -21,6 +21,11 @@ float32 = jnp.float32
 float64 = jnp.float64
 complex64 = jnp.complex64
 complex128 = jnp.complex128
+# fp8 storage dtypes (reference: python/paddle/framework/dtype.py
+# FP8_E4M3FN/FP8_E5M2) — real ml_dtypes types; TPU computes via upcast,
+# nn.quant.format rounds through them for serialization-exact fake quant
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
 
 NAME2DTYPE = {
     "bool": jnp.bool_,
@@ -39,6 +44,8 @@ NAME2DTYPE = {
     "bf16": jnp.bfloat16,
     "fp32": jnp.float32,
     "fp64": jnp.float64,
+    "float8_e4m3fn": jnp.float8_e4m3fn,
+    "float8_e5m2": jnp.float8_e5m2,
 }
 
 _DEFAULT_FLOAT = [jnp.float32]

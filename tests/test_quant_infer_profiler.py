@@ -306,3 +306,24 @@ class TestQuantFormat:
             LinearQuanter(np.ones(3, np.float32),
                           zero_point=np.array([1.0, 0.0, 0.0]),
                           bit_length=(4, 3))
+
+
+class TestFloat8Dtypes:
+    """fp8 storage dtypes resolve by name through the registry (reference:
+    python/paddle/framework/dtype.py FP8_E4M3FN/FP8_E5M2 + cast)."""
+
+    def test_cast_roundtrip_by_name(self):
+        x = paddle.to_tensor(np.array([1.5, -300.0, 0.007], np.float32))
+        y = paddle.cast(x, "float8_e4m3fn")
+        assert "float8_e4m3fn" in str(y.dtype)
+        z = np.asarray(paddle.cast(y, "float32")._data)
+        np.testing.assert_allclose(z[0], 1.5)        # exactly representable
+        assert abs(z[1] + 300) <= 32                 # e4m3 spacing at 2^8
+        assert z[2] > 0
+
+    def test_dtype_objects_exposed(self):
+        assert paddle.float8_e4m3fn is not None
+        assert paddle.float8_e5m2 is not None
+        y = paddle.cast(paddle.to_tensor(np.ones(2, np.float32)),
+                        paddle.float8_e5m2)
+        assert "e5m2" in str(y.dtype)

@@ -702,6 +702,16 @@ def main():
             break
         errors.append(f"probe{i}: {err}")
         if i < 1:
+            if "timeout" in str(err) and \
+                    _best_recorded_tpu_win() is not None:
+                # a full 900s probe just hung (dark tunnel) AND this
+                # round already has a real hardware measurement in the
+                # ledger: that is enough evidence — go straight to the
+                # recorded fallback instead of spending another ~30 min
+                # (wedge backoff + probe 2) that risks exceeding the
+                # driver's bench window. Fast non-timeout failures still
+                # take the cheap 120s retry below.
+                break
             # the 900s TimeoutExpired above killed a dialing worker: back
             # off a full wedge window before touching the tunnel again;
             # a clean non-TPU answer (no kill) needs no such pause

@@ -127,7 +127,17 @@ def _run_one(cfg, batch, seq, steps, remat, on_tpu, remat_policy=None,
     from paddle_tpu.framework import flags as _wflags
     bwd_mode_used = _wflags.flag_value("flash_attention_bwd")
     if bwd_mode_used == "auto":
-        bwd_mode_used = "auto:pallas"  # auto resolves to pallas (r5 A/B)
+        # 'auto' is routed per shape by the baked attention ledger —
+        # resolve it for THIS config's attention shape so the bench row
+        # records what actually ran
+        try:
+            from paddle_tpu.ops.pallas.attention_router import route
+            hd_ = cfg.hidden_size // cfg.num_attention_heads
+            bwd_mode_used = "auto:" + route(
+                batch * cfg.num_attention_heads, seq, seq, hd_,
+                "bfloat16" if on_tpu else "float32", True).bwd
+        except Exception:
+            bwd_mode_used = "auto:?"
     jstep = jax.jit(train_step, donate_argnums=(0, 1))
     try:
         run = jstep.lower(params, opt_state, ids, ids, lr,
@@ -525,18 +535,32 @@ def worker(force_cpu: bool, only_config: int | None = None):
         peak = detect_peak()
         # which attention implementation this config actually ran (weak #3
         # r4: the ladder conflated flash and dense rows without labeling) —
-        # computed from the REAL selection predicate, not re-derived rules
+        # computed from the REAL selection predicate (which since r6 is
+        # the per-shape backend router), plus the router's own provenance
+        # so every bench row says WHY its backend was chosen
         from paddle_tpu.nn.functional.attention import _use_pallas
         hd = cfg.hidden_size // cfg.num_attention_heads
+        run_dtype = "bfloat16" if on_tpu else "float32"
         attn_backend = ("pallas_flash" if _use_pallas(
-            (batch, seq, cfg.num_attention_heads, hd), hd, False)
+            (batch, seq, cfg.num_attention_heads, hd), hd, False,
+            dtype=run_dtype, causal=True)
             else "xla_dense")
         bwd_mode = r.get("attention_bwd_used", "?")
+        try:
+            from paddle_tpu.ops.pallas.attention_router import route
+            dec = route(batch * cfg.num_attention_heads, seq, seq, hd,
+                        run_dtype, True)
+            router_info = {"fwd": dec.fwd, "bwd": dec.bwd,
+                           "source": dec.source,
+                           "provenance": dec.provenance}
+        except Exception as e:
+            router_info = {"error": f"{type(e).__name__}: {e}"[:200]}
         detail = {"config": name, "tokens_per_s": round(tok_per_s, 1),
                   "params": n_params, "loss": round(r["loss"], 4),
                   "batch": batch, "seq": seq, "remat": remat,
                   "attention_backend": attn_backend,
                   "attention_bwd": bwd_mode,
+                  "attention_router": router_info,
                   "lm_loss": r.get("lm_loss_path"),
                   "device": str(jax.devices()[0])}
         if errors:
@@ -614,8 +638,15 @@ def _record_tpu_win(result_obj):
 
 
 def _best_recorded_tpu_win():
-    """Best (by MFU) hardware measurement recorded THIS round, or None."""
+    """Best (by MFU) hardware measurement recorded THIS round, or None.
+
+    Freshness requires BOTH rounds known and equal: a row with round=None
+    (pre-round-5 ledger format, or a write that raced the heartbeat) and
+    an unknown current round both reject — otherwise a stale prior-round
+    MFU could be republished as this round's number (ADVICE r5 #1)."""
     rnd = _current_round()
+    if rnd is None:
+        return None   # can't prove any row is this round's
     try:
         best = None
         with open(_TPU_WINS_PATH) as f:
@@ -628,8 +659,8 @@ def _best_recorded_tpu_win():
                     continue   # scalar/partial line (e.g. torn write)
                 if obj.get("metric") != "llama_train_mfu_1chip":
                     continue
-                if rnd is not None and obj.get("round") not in (None, rnd):
-                    continue   # stale: a different round's measurement
+                if obj.get("round") is None or obj.get("round") != rnd:
+                    continue   # unknown or different round: stale
                 if best is None or (obj.get("value") or 0) > \
                         (best.get("value") or 0):
                     best = obj
@@ -792,7 +823,7 @@ def main():
     recorded = _best_recorded_tpu_win()
     if recorded is not None:
         recorded.setdefault("detail", {})["provenance"] = (
-            "measured on TPU earlier this round "
+            f"measured on TPU in round {recorded.get('round')} "
             f"(unix {recorded.get('recorded_unix')}); the axon tunnel was "
             "unreachable when the end-of-round bench ran")
         if errors:

@@ -84,6 +84,20 @@ def _gqa(a, rep):
                             (b, s, hkv, rep, d)).reshape(b, s, hkv * rep, d)
 
 
+def _prefill_flash_routed(bh, s, d, dtype):
+    """Prefill attention backend: consult the baked per-shape router
+    (same ledger as the train path) — dense XLA wins most v5e prefill
+    shapes, flash wins long ones. Dense (False) on non-TPU or any
+    router failure."""
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        from .ops.pallas.attention_router import route
+        return route(bh, s, s, d, dtype, True).fwd == "pallas"
+    except Exception:
+        return False
+
+
 def _llama_layer_prefill(lp, h, pos, cfg):
     """Full-sequence layer forward; returns (h_out, (k, v)) with k/v rotated
     and UNexpanded (kv heads)."""
@@ -96,13 +110,23 @@ def _llama_layer_prefill(lp, h, pos, cfg):
     v = (x @ lp["self_attn.v_proj.weight"]).reshape(b, s, nkv, hd)
     q = _rope(q, pos, theta)
     k = _rope(k, pos, theta)
-    kx, vx = _gqa(k, nh // nkv), _gqa(v, nh // nkv)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, kx,
-                        preferred_element_type=jnp.float32) / (hd ** 0.5)
-    causal = pos[:, :, None] >= pos[:, None, :]           # (b, s, s)
-    scores = jnp.where(causal[:, None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(vx.dtype)
-    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vx).reshape(b, s, nh * hd)
+    if _prefill_flash_routed(b * nh, s, hd, h.dtype):
+        # routed flash prefill: GQA-native (kv stays unexpanded), causal.
+        # Every prefill caller passes pos = arange rows, so the pos-based
+        # mask below IS the standard causal structure the kernel applies.
+        from .ops.pallas.flash_attention import flash_attention_bshd
+        attn = flash_attention_bshd(q, k, v, causal=True).reshape(
+            b, s, nh * hd)
+    else:
+        kx, vx = _gqa(k, nh // nkv), _gqa(v, nh // nkv)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, kx,
+            preferred_element_type=jnp.float32) / (hd ** 0.5)
+        causal = pos[:, :, None] >= pos[:, None, :]       # (b, s, s)
+        scores = jnp.where(causal[:, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(vx.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vx).reshape(
+            b, s, nh * hd)
     h = h + attn @ lp["self_attn.o_proj.weight"]
     x = _rms(h, lp["post_attention_layernorm.weight"], eps)
     gate = x @ lp["mlp.gate_proj.weight"]

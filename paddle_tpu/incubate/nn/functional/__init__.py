@@ -24,7 +24,8 @@ __all__ = ["fused_rms_norm", "fused_layer_norm",
            "fused_dot_product_attention", "fused_multi_head_attention",
            "fused_feedforward", "masked_multihead_attention",
            "variable_length_memory_efficient_attention",
-           "block_multihead_attention", "fused_moe"]
+           "block_multihead_attention", "fused_moe",
+           "fused_attention_rms_epilogue"]
 
 
 def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
@@ -184,9 +185,77 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
 
 def fused_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
                                 is_causal=False, training=True, **kw):
+    # backend (pallas flash vs dense XLA) is chosen per shape by
+    # ops/pallas/attention_router through the shared sdpa path — one
+    # baked ledger governs nn.functional, incubate, serving, and bench
     return F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
                                           dropout_p=dropout_p,
                                           is_causal=is_causal, training=training)
+
+
+def fused_attention_rms_epilogue(q, k, v, residual, norm_weight,
+                                 epsilon=1e-6, causal=True, name=None):
+    """Causal attention with the rmsnorm(attn + residual) * weight
+    epilogue — the widened fused region (FlashFuser, PAPERS.md) the
+    backend router can select where a hardware A/B shows it winning.
+
+    q/residual: (batch, seq, heads, head_dim); k/v GQA-native (kv heads
+    may divide heads); norm_weight: (head_dim,) — the norm axis is the
+    head dim (per-head RMSNorm; pass heads=1 tensors for a full-hidden
+    norm). When the router's ledger marks the fusion a winner at this
+    shape (and a TPU is present), the epilogue runs INSIDE the Pallas
+    flash kernel's flush — the attention output never round-trips HBM
+    unnormalized; otherwise the same math runs as an XLA composition
+    (numerically identical, and differentiable). Inference-oriented:
+    the fused kernel path is forward-only."""
+    from ....ops.pallas.attention_router import epilogue_fusion_wins
+
+    def f(q_, k_, v_, res_, w_):
+        b, s, h, d = q_.shape
+        use_fused = False
+        if jax.default_backend() == "tpu":
+            use_fused = epilogue_fusion_wins(b * h, s, k_.shape[1], d,
+                                             q_.dtype, causal)
+        if use_fused:
+            from ....ops.pallas.flash_attention import (
+                flash_attention_rms_epilogue_bshd)
+            return flash_attention_rms_epilogue_bshd(
+                q_, k_, v_, res_, w_, causal=causal, eps=epsilon)
+        kx, vx = _expand_gqa(k_, v_, h)
+        att = _sdpa_dense(q_, kx, vx, causal)
+        hh = (att + res_).astype(jnp.float32)
+        ms = jnp.mean(hh * hh, axis=-1, keepdims=True)
+        return (hh * jax.lax.rsqrt(ms + epsilon)
+                * w_.astype(jnp.float32)).astype(q_.dtype)
+
+    return execute(f, q, k, v, residual, norm_weight,
+                   _name="fused_attention_rms_epilogue")
+
+
+def _expand_gqa(k, v, num_heads):
+    kvh = k.shape[2]
+    if kvh == num_heads:
+        return k, v
+    rep = num_heads // kvh
+
+    def ex(a):
+        bs, sk, _, d = a.shape
+        return jnp.broadcast_to(a[:, :, :, None, :],
+                                (bs, sk, kvh, rep, d)).reshape(
+                                    bs, sk, num_heads, d)
+    return ex(k), ex(v)
+
+
+def _sdpa_dense(q, k, v, causal):
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) / (d ** 0.5)
+    if causal:
+        ql, kl = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((ql, kl), jnp.bool_), k=kl - ql)
+        s = jnp.where(mask, s, jnp.float32(-1e30))
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
 def fused_multi_head_attention(x, qkv_weight, linear_weight,

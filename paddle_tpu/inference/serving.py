@@ -19,6 +19,11 @@ TPU-native design: TWO compiled programs serve every request mix.
 Memory is allocated in block_size granules from one (L, num_blocks, ...)
 pool — no per-sequence max-length reservation, exactly the property the
 reference's block attention exists for.
+
+Prefill attention is routed per bucket shape by the same baked backend
+ledger as training (ops/pallas/attention_router, consulted inside
+generation._llama_layer_prefill at trace time); `attention_route` keeps
+the largest bucket's decision for audit.
 """
 
 from __future__ import annotations
@@ -177,6 +182,17 @@ class ContinuousBatchingEngine:
         self.max_batch = int(max_batch)
         self.max_blocks_per_seq = int(max_blocks_per_seq)
         self.buckets = tuple(sorted(prefill_buckets))
+        # prefill attention backend comes from the same baked per-shape
+        # router/ledger as the train path (generation._llama_layer_prefill
+        # consults it per bucket at trace time); keep the largest bucket's
+        # decision here for audit/metrics
+        try:
+            from ..ops.pallas.attention_router import route
+            self.attention_route = route(
+                self.cfg["heads"], self.buckets[-1], self.buckets[-1],
+                self.cfg["head_dim"], self.embed_w.dtype, True)
+        except Exception:
+            self.attention_route = None
         self.lanes: list[Request | None] = [None] * self.max_batch
         self.lane_len = np.zeros(self.max_batch, np.int64)  # tokens in cache
         self.lane_tok = np.zeros(self.max_batch, np.int64)  # next to write

@@ -62,7 +62,7 @@ def _expand_kv(k, v, num_heads):
     return expand(k), expand(v)
 
 
-def _use_pallas(q_shape, head_dim, has_bias):
+def _use_pallas(q_shape, head_dim, has_bias, dtype=None, causal=True):
     if has_bias:
         # the pallas kernel takes no bias/mask — never select it silently
         return False
@@ -77,14 +77,18 @@ def _use_pallas(q_shape, head_dim, has_bias):
         return False
     if backend == "pallas":
         return True
-    # auto: long sequence + MXU-friendly head dim. Non-lane-aligned head
-    # dims are zero-padded by the kernel (96 -> 128, the llama_780m
-    # shape): the pad costs 128/96 extra MXU work, so it needs a longer
-    # sequence before the O(S^2) HBM win pays for it.
-    seq = q_shape[1]
-    if head_dim % 128 == 0:
-        return seq >= 1024
-    return head_dim >= 96 and seq >= 2048
+    # auto: per-shape routed choice from the baked hardware ledger
+    # (ops/pallas/attention_router) — the r5 A/B showed the flash kernel
+    # losing to dense XLA at most production shapes and winning at
+    # others, so a fixed seq/head_dim threshold is wrong in both
+    # directions. The router falls back to measurement, then to the old
+    # thresholds, each with provenance.
+    from ...ops.pallas.attention_router import route
+    b, seq = q_shape[0], q_shape[1]
+    heads = q_shape[2] if len(q_shape) > 3 else 1
+    dec = route(b * heads, seq, seq, head_dim,
+                dtype if dtype is not None else "bfloat16", causal)
+    return dec.fwd == "pallas"
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
@@ -93,7 +97,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     """paddle layout: (batch, seq, num_heads, head_dim)."""
     dropout_key = next_key() if (dropout_p > 0.0 and training) else None
     use_pallas = _use_pallas(tuple(query.shape), query.shape[-1],
-                             attn_mask is not None) and dropout_key is None
+                             attn_mask is not None,
+                             dtype=getattr(query, "dtype", None),
+                             causal=is_causal) and dropout_key is None
 
     if use_pallas:
         from ...ops.pallas.flash_attention import flash_attention_bshd

@@ -16,6 +16,23 @@ quant_bits contract (matches the reference):
   (4, 3) -> float8 e4m3 (finite range +-448)
   (5, 2) -> float8 e5m2 (finite range +-57344)
 
+Checkpoint interop caveat (ADVICE r5 #3 — the asymmetric qmin level):
+tensors serialized by the reference's quantize_linear may CONTAIN the
+qmin = -qmax-1 level (e.g. -128 at 8 bits). Both directions are handled,
+but only one is lossless:
+  - LinearDequanter ACCEPTS qmin levels exactly — dequantization is
+    linear ((x - zp) * s / qmax), so a -qmax-1 level reconstructs to
+    -(qmax+1)/qmax * s with no clipping. Reference-written checkpoints
+    load losslessly.
+  - LinearQuanter EMITS only the symmetric grid: re-quantizing a value
+    that reconstructs the reference's qmin level clamps it one level up,
+    to -qmax (a 1-ulp-of-grid shift on those entries, ~0.8% of scale at
+    8 bits). This is deliberate — emitting -qmax-1 would break bit-exact
+    round-trips with this framework's own QAT observers, which train on
+    the symmetric grid. Round-tripping a reference checkpoint through
+    quant->dequant here is therefore NOT the identity on qmin entries;
+    pure dequantization (deployment inference) is.
+
 Channels whose scale is 0 (never-observed quanters) pass through
 UNQUANTIZED — the same guard the QAT fake-quant applies — instead of
 collapsing to zeros through a divide-by-zero.
@@ -133,7 +150,11 @@ class _ScaledFormat(Layer):
 
 
 class LinearQuanter(_ScaledFormat):
-    """x -> quantized grid (int levels or fp8), kept in x's dtype."""
+    """x -> quantized grid (int levels or fp8), kept in x's dtype.
+
+    Integer output is SYMMETRIC: levels in [-qmax, qmax]. Inputs that
+    land on the reference's asymmetric qmin level (-qmax-1) are accepted
+    and clamp to -qmax — see the module docstring's interop caveat."""
 
     def __init__(self, scales, zero_point=None, quant_axis=None,
                  bit_length=8, group_size=128):
@@ -162,7 +183,12 @@ class LinearQuanter(_ScaledFormat):
 
 
 class LinearDequanter(_ScaledFormat):
-    """Inverse of LinearQuanter (same scale/axis/bits contract)."""
+    """Inverse of LinearQuanter (same scale/axis/bits contract).
+
+    Accepts the reference's full asymmetric level range on input: the
+    map is linear and unclipped, so a qmin = -qmax-1 level written by
+    the reference's quantize_linear reconstructs exactly (module
+    docstring, interop caveat)."""
 
     def __init__(self, scales, zero_point=None, quant_axis=None,
                  bit_length=8, group_size=128):

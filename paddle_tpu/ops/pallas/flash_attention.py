@@ -63,20 +63,24 @@ def _block_needed(qi, kj, block_q, block_k, causal, offset):
 
 
 _flags.define_flag(
-    "flash_packed_grid", False,
+    "flash_packed_grid", "auto",
     "causal flash kernels iterate only the lower-triangle (q,k) block "
     "pairs instead of a rectangular grid with half the steps masked off "
-    "(saves the skipped steps' k/v DMAs and grid overhead). Default OFF: "
-    "numerically exact under the interpreter (tests force it on), but "
-    "the non-affine index maps have not yet lowered on real TPU — the "
-    "r5 validation probe was lost to a tunnel outage. Flip on once "
-    ".tpu_queue/451_packed_ab.sh proves it on hardware. NOTE: read at "
-    "TRACE time — set the env var before process start (or clear jit "
-    "caches); set_flags after a shape compiled does not retrace it.")
+    "(saves the skipped steps' k/v DMAs and grid overhead). 'auto' (the "
+    "default since the bf16 finalization): ON under the Pallas "
+    "interpreter (numerically exact, pinned by tier-1) and on real TPUs "
+    "only when the baked attention ledger marks packed_grid_validated "
+    "for the device — the non-affine index maps have never lowered on "
+    "hardware (the r5 probe died with the tunnel), so the ledger flips "
+    "this per-device once .tpu_queue/451_packed_ab.sh proves it. "
+    "on/off force it either way. NOTE: read at TRACE time — set the env "
+    "var before process start (or clear jit caches); set_flags after a "
+    "shape compiled does not retrace it.")
 
 
 def _packing_on():
-    return bool(_flags.flag_value("flash_packed_grid"))
+    from .attention_router import packed_grid_enabled
+    return packed_grid_enabled()
 
 
 def _tri_decode(p):
@@ -109,10 +113,21 @@ def _tri_maps(g):
     return qmap, kmap
 
 
-def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, *refs,
                    causal: bool, scale: float, seq_k: int, block_q: int,
                    block_k: int, offset: int, mask_k_tail: bool,
-                   packed: bool = False):
+                   packed: bool = False, epilogue: bool = False,
+                   rms_eps: float = 1e-6, rms_d: int = 0):
+    # optional fused epilogue (FlashFuser-style widened fusion): two extra
+    # inputs — residual block + lane-broadcast RMSNorm gamma — and the
+    # flush writes rmsnorm(attn + residual) * gamma instead of attn,
+    # saving one full HBM round-trip of the attention output. The norm
+    # axis is the head dim (rms_d = TRUE d, so zero-pad columns don't
+    # skew the mean).
+    if epilogue:
+        res_ref, w_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
+    else:
+        o_ref, lse_ref, m_s, l_s, acc_s = refs
     if packed:   # causal lower-triangle grid: (bh, tri(nq))
         qi, kj = _tri_decode(pl.program_id(1))
         is_last = kj == qi   # kj_max(qi) == qi when block_q == block_k
@@ -164,7 +179,15 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
     @pl.when(is_last)
     def _flush():
         l = jnp.maximum(l_s[...][:, :1], 1e-30)
-        o_ref[0] = (acc_s[...] / l).astype(o_ref.dtype)
+        out = acc_s[...] / l
+        if epilogue:
+            h = out + res_ref[0].astype(jnp.float32)
+            # mean over the TRUE head dim (pad columns are zero in both
+            # attn out and residual, so the sum is exact)
+            ms = jnp.sum(h * h, axis=-1, keepdims=True) / rms_d
+            out = h * jax.lax.rsqrt(ms + rms_eps) * \
+                w_ref[...][:1, :].astype(jnp.float32)
+        o_ref[0] = out.astype(o_ref.dtype)
         # lane-expanded (block_q, _LANES) write: TPU block shapes need the
         # last two dims tiled (8, 128); a (1, block_q) row per grid step is
         # unlowerable. m_s/l_s already hold the row value in every lane.
@@ -348,12 +371,18 @@ def _shipped_blocks(kind, sq, d, device_kind):
 
 
 def _tuned_blocks(kind, bh, sq, sk, d, dtype, causal, interpret):
-    """Resolve (block_q, block_k): the shipped v5e-measured table, the
-    runtime-timed winner when FLAGS_use_autotune is on, else (128, 128).
-    Timing runs on synthetic zeros, so this works even while the caller
-    is being traced."""
+    """Resolve (block_q, block_k): the baked attention ledger (versioned,
+    device-tagged — tools/bake_flash_blocks.py --ledger), the legacy
+    _SHIPPED_BLOCKS literal, the runtime-timed winner when
+    FLAGS_use_autotune is on, else (128, 128). Timing runs on synthetic
+    zeros, so this works even while the caller is being traced."""
     from .autotune import autotune, autotune_enabled
     if not autotune_enabled():
+        if not interpret:
+            from .attention_router import ledger_blocks
+            hit = ledger_blocks(kind, bh, sq, sk, d, dtype, causal)
+            if hit:
+                return hit
         if _SHIPPED_BLOCKS and not interpret:
             hit = _shipped_blocks(kind, sq, d,
                                   getattr(jax.devices()[0], "device_kind", ""))
@@ -414,8 +443,15 @@ def _tuned_blocks(kind, bh, sq, sk, d, dtype, causal, interpret):
 
 
 def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=128, block_k=128,
-                    interpret=None, q_per_kv=1):
+                    interpret=None, q_per_kv=1, residual=None,
+                    rms_weight=None, rms_eps=1e-6, rms_d=None):
     """q: (BH, Sq, D), k/v: (BH // q_per_kv, Sk, D) -> (out, lse).
+
+    residual/rms_weight (both given or neither): fuse the
+    rmsnorm(attn + residual) * weight epilogue into the kernel's flush —
+    the attention output never round-trips HBM unnormalized. residual:
+    (BH, Sq, D); rms_weight: (D,). rms_d = the TRUE head dim when D is
+    zero-padded (the mean divisor). Forward-only (no VJP).
 
     Ragged sequence lengths are padded to block multiples; padded K columns
     are masked in-kernel, padded Q rows sliced off on return (so results
@@ -442,29 +478,44 @@ def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=128, block_k=128,
     # grid spends half its steps and k/v DMAs on fully-masked pairs
     packed = (causal and sk == sq and sq_p == sk_p
               and block_q == block_k and _packing_on())
+    epilogue = residual is not None
     kernel = functools.partial(
         _fa_fwd_kernel, causal=causal, scale=scale, seq_k=sk,
         block_q=block_q, block_k=block_k, offset=sk - sq,
-        mask_k_tail=mask_k_tail, packed=packed)
+        mask_k_tail=mask_k_tail, packed=packed, epilogue=epilogue,
+        rms_eps=rms_eps, rms_d=(rms_d or d))
     if packed:
         grid = (bh, nq * (nq + 1) // 2)
         qmap, kmap = _tri_maps(g)
         in_maps = [qmap, kmap, kmap]
         out_maps = [qmap, qmap]
+        wmap = lambda b, p: (0, 0)   # noqa: E731
     else:
         grid = (bh, nq, nk)
         in_maps = [lambda b, i, j: (b, i, 0),
                    lambda b, i, j: (b // g, j, 0),
                    lambda b, i, j: (b // g, j, 0)]
         out_maps = [lambda b, i, j: (b, i, 0), lambda b, i, j: (b, i, 0)]
+        wmap = lambda b, i, j: (0, 0)   # noqa: E731
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), in_maps[0]),
+        pl.BlockSpec((1, block_k, d), in_maps[1]),
+        pl.BlockSpec((1, block_k, d), in_maps[2]),
+    ]
+    operands = [q_p, k_p, v_p]
+    if epilogue:
+        # residual rides the q index map; gamma is one (8, d) sublane-
+        # tiled block (a bare (1, d) block is unlowerable on TPU), f32 so
+        # bf16 gammas don't hit the (16, 128) bf16 tile minimum
+        in_specs.append(pl.BlockSpec((1, block_q, d), in_maps[0]))
+        in_specs.append(pl.BlockSpec((8, d), wmap))
+        operands.append(_pad_to(residual, 1, block_q))
+        operands.append(jnp.broadcast_to(
+            rms_weight.astype(jnp.float32)[None, :], (8, d)))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), in_maps[0]),
-            pl.BlockSpec((1, block_k, d), in_maps[1]),
-            pl.BlockSpec((1, block_k, d), in_maps[2]),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), out_maps[0]),
             pl.BlockSpec((1, block_q, _LANES), out_maps[1]),
@@ -479,7 +530,7 @@ def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=128, block_k=128,
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q_p, k_p, v_p)
+    )(*operands)
     # collapse the lane-expanded lse back to (bh, sq_p) right away so the
     # autodiff residual is O(S), not O(S * 128)
     return out[:, :sq], lse[..., 0]
@@ -684,19 +735,28 @@ def _dense_remat_bwd(q, k, v, causal, scale, q_per_kv, g):
 _flags.define_flag(
     "flash_attention_bwd", "auto",
     "flash-attention backward: 'pallas' (FA-2 dQ/dKV kernels), 'xla' "
-    "(dense rematerialization, XLA-differentiated), or 'auto' (pallas: "
-    "the r5 end-to-end A/B on v5e measured the full-pallas bwd at 0.426 "
-    "MFU vs 0.406 for the xla-remat hybrid on the 535m train step, even "
-    "though isolated-kernel timing favors the hybrid — the dense remat's "
-    "O(S^2) buffer costs more in HBM pressure than it saves in kernel "
-    "time once the whole step is scheduled)")
+    "(dense rematerialization, XLA-differentiated), or 'auto' (routed "
+    "per shape by ops/pallas/attention_router from the baked hardware "
+    "ledger: the r5 end-to-end A/B on v5e measured the full-pallas bwd "
+    "at 0.426 MFU vs 0.406 for the xla-remat hybrid on the 535m train "
+    "step even though isolated-kernel timing favors the hybrid — the "
+    "dense remat's O(S^2) buffer costs more in HBM pressure than it "
+    "saves in kernel time once the whole step is scheduled — while the "
+    "zero-padded d96 shapes measured the hybrid winning both ways)")
 
 
 def _fa_bwd(causal, scale, q_per_kv, res, g):
     q, k, v, o, lse = res
     mode = _flags.flag_value("flash_attention_bwd")
     if mode == "auto":
-        mode = "pallas"
+        # per-shape routed choice with provenance (ledger -> measurement
+        # -> heuristic); 'pallas' if the router itself fails
+        try:
+            from .attention_router import route
+            mode = route(q.shape[0], q.shape[1], k.shape[1], q.shape[2],
+                         q.dtype, causal).bwd
+        except Exception:
+            mode = "pallas"
     if mode == "xla":
         return _dense_remat_bwd(q, k, v, causal, scale, q_per_kv, g)
     bq, bk = _bwd_blocks(q, k, causal)
@@ -736,5 +796,49 @@ def flash_attention_bshd(q, k, v, causal=False, scale=None):
     kt = jnp.swapaxes(k, 1, 2).reshape(b * kvh, sk, dp)
     vt = jnp.swapaxes(v, 1, 2).reshape(b * kvh, sk, dp)
     out = _flash_attention_bhsd(qt, kt, vt, causal, scale, h // kvh)
+    out = jnp.swapaxes(out.reshape(b, h, sq, dp), 1, 2)
+    return out[..., :d] if d_pad else out
+
+
+def flash_attention_rms_epilogue_bshd(q, k, v, residual, rms_weight,
+                                      causal=True, scale=None, eps=1e-6):
+    """Flash attention with the rmsnorm(attn + residual) * gamma epilogue
+    FUSED into the kernel's flush step — the attention output is written
+    to HBM exactly once, already normalized (the FlashFuser-style
+    widened fusion the backend router can select where it wins).
+
+    Layout matches flash_attention_bshd: q (b, sq, h, d), k/v GQA-native
+    (b, sk, kvh, d); residual (b, sq, h, d); rms_weight (d,). The norm
+    axis is the HEAD dim (per-head RMSNorm — use h=1 for a full-hidden
+    norm). Forward-only: no VJP is defined (the training path routes
+    through the unfused custom-vjp kernels); intended for inference /
+    serving prefill.
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    kvh = k.shape[2]
+    if h % kvh:
+        raise ValueError(f"num_heads {h} not divisible by kv heads {kvh}")
+    if residual.shape != q.shape:
+        raise ValueError(f"residual shape {residual.shape} != q {q.shape}")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    d_pad = (-d) % _LANES
+    if d_pad:
+        padw = ((0, 0), (0, 0), (0, 0), (0, d_pad))
+        q = jnp.pad(q, padw)
+        k = jnp.pad(k, padw)
+        v = jnp.pad(v, padw)
+        residual = jnp.pad(residual, padw)
+        rms_weight = jnp.pad(rms_weight, ((0, d_pad),))
+    dp = d + d_pad
+    qt = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, dp)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * kvh, sk, dp)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * kvh, sk, dp)
+    rt = jnp.swapaxes(residual, 1, 2).reshape(b * h, sq, dp)
+    bq, bk = _fwd_blocks(qt, kt, causal)
+    out, _ = _flash_fwd_bhsd(qt, kt, vt, causal, scale, block_q=bq,
+                             block_k=bk, q_per_kv=h // kvh, residual=rt,
+                             rms_weight=rms_weight, rms_eps=eps, rms_d=d)
     out = jnp.swapaxes(out.reshape(b, h, sq, dp), 1, 2)
     return out[..., :d] if d_pad else out

@@ -50,9 +50,9 @@ class TestTpuWinsLedger:
         import bench
         ledger = tmp_path / "wins.jsonl"
         rows = [
-            {"metric": "llama_train_mfu_1chip", "value": 0.29,
+            {"metric": "llama_train_mfu_1chip", "value": 0.29, "round": 6,
              "recorded_unix": 1, "detail": {"config": "a"}},
-            {"metric": "llama_train_mfu_1chip", "value": 0.43,
+            {"metric": "llama_train_mfu_1chip", "value": 0.43, "round": 6,
              "recorded_unix": 2, "detail": {"config": "b"}},
             {"metric": "other", "value": 9.9},   # ignored: wrong metric
             "not json at all",
@@ -63,6 +63,7 @@ class TestTpuWinsLedger:
                 f.write(_json.dumps(r) + "\n")
             f.write(rows[3] + "\n")
         monkeypatch.setattr(bench, "_TPU_WINS_PATH", str(ledger))
+        monkeypatch.setattr(bench, "_current_round", lambda: 6)
         best = bench._best_recorded_tpu_win()
         assert best["value"] == 0.43 and best["detail"]["config"] == "b"
 
@@ -70,10 +71,14 @@ class TestTpuWinsLedger:
         import bench
         monkeypatch.setattr(bench, "_TPU_WINS_PATH",
                             str(tmp_path / "absent.jsonl"))
+        monkeypatch.setattr(bench, "_current_round", lambda: 6)
         assert bench._best_recorded_tpu_win() is None
 
     def test_stale_round_entries_filtered(self, tmp_path, monkeypatch):
-        """A previous round's win must not masquerade as this round's."""
+        """ADVICE r5 #1: freshness requires BOTH rounds known and equal —
+        a previous round's win, a round-less row, and an unknown current
+        round must all reject (a stale MFU must never be republished as
+        this round's number)."""
         import json as _json
 
         import bench
@@ -83,6 +88,9 @@ class TestTpuWinsLedger:
                 {"metric": "llama_train_mfu_1chip", "value": 0.99,
                  "round": 4, "detail": {}}) + "\n")
             f.write(_json.dumps(
+                {"metric": "llama_train_mfu_1chip", "value": 0.95,
+                 "detail": {}}) + "\n")   # round=None: unprovable, reject
+            f.write(_json.dumps(
                 {"metric": "llama_train_mfu_1chip", "value": 0.30,
                  "round": 7, "detail": {}}) + "\n")
             f.write("null\n")   # valid JSON scalar: skipped, not fatal
@@ -90,3 +98,16 @@ class TestTpuWinsLedger:
         monkeypatch.setattr(bench, "_current_round", lambda: 7)
         best = bench._best_recorded_tpu_win()
         assert best is not None and best["value"] == 0.30
+
+    def test_unknown_current_round_rejects_all(self, tmp_path, monkeypatch):
+        import json as _json
+
+        import bench
+        ledger = tmp_path / "wins.jsonl"
+        with open(ledger, "w") as f:
+            f.write(_json.dumps(
+                {"metric": "llama_train_mfu_1chip", "value": 0.50,
+                 "round": 7, "detail": {}}) + "\n")
+        monkeypatch.setattr(bench, "_TPU_WINS_PATH", str(ledger))
+        monkeypatch.setattr(bench, "_current_round", lambda: None)
+        assert bench._best_recorded_tpu_win() is None

@@ -244,6 +244,26 @@ class TestQuantFormat:
         with pytest.raises(NotImplementedError):
             LinearQuanter(np.ones(1), bit_length=(3, 4))
 
+    def test_reference_qmin_level_interop(self):
+        """ADVICE r5 #3: the reference's quantize_linear admits the
+        asymmetric qmin = -qmax-1 level. Dequantization must accept it
+        EXACTLY (linear, no clip); re-quantization emits the symmetric
+        grid, clamping qmin-level inputs one step up to -qmax."""
+        from paddle_tpu.nn.quant import LinearDequanter, LinearQuanter
+        s = paddle.to_tensor(np.float32(2.0))
+        # a reference-serialized int8 tensor containing the -128 level
+        levels = paddle.to_tensor(
+            np.array([-128.0, -127.0, 0.0, 127.0], np.float32))
+        d = LinearDequanter(s, bit_length=8)(levels)
+        np.testing.assert_allclose(
+            np.asarray(d._data),
+            np.array([-128, -127, 0, 127], np.float32) * 2.0 / 127)
+        # re-quantizing those reconstructions: the qmin entry clamps to
+        # -qmax (symmetric output), everything else round-trips exactly
+        q = LinearQuanter(s, bit_length=8)(d)
+        np.testing.assert_allclose(np.asarray(q._data),
+                                   [-127.0, -127.0, 0.0, 127.0])
+
     def test_from_quanter_conversion(self):
         from paddle_tpu.nn.quant import LinearQuanterDequanter
         from paddle_tpu.quantization import FakeQuanterWithAbsMaxObserver

@@ -85,7 +85,7 @@ def _loss_chunk_mb_for(name):
 
 
 def _run_one(cfg, batch, seq, steps, remat, on_tpu, remat_policy=None,
-             loss_chunk_mb=256):
+             loss_chunk_mb=256, run_name="llama"):
     """One config: scan-over-layers train step (HLO size O(1) in depth, so
     the compile helper sees one layer body instead of an unrolled stack)."""
     import jax
@@ -164,6 +164,16 @@ def _run_one(cfg, batch, seq, steps, remat, on_tpu, remat_policy=None,
     final = float(loss)  # sync
     dt = time.perf_counter() - t0
     tokens = batch * seq * steps
+    # feed the round's training telemetry through the observability layer
+    # (train_step_seconds / tokens / MFU gauges) — the timed loop above is
+    # untouched; record_run back-fills the aggregate so the bench row's
+    # embedded snapshot is self-describing
+    from paddle_tpu import observability as _obs
+    fpt = (6.0 * n_params
+           + 12.0 * cfg.num_hidden_layers * cfg.hidden_size * seq)
+    _obs.StepWatch(tokens_per_step=batch * seq, flops_per_token=fpt,
+                   peak_flops=detect_peak(), run_name=run_name).record_run(
+        steps, dt, tokens=tokens, loss=final)
     return {"tokens_per_s": tokens / dt, "n_params": n_params, "loss": final,
             "attention_bwd_used": bwd_mode_used,
             "lm_loss_path": loss_fn.lm_loss_path,  # set when traced
@@ -422,6 +432,8 @@ def secondary_worker(force_cpu: bool, which: str):
     import jax
     if force_cpu:
         jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu import observability as _obs
+    _obs.enable()   # serving TTFT/TPOT/queue metrics ride the decode row
     on_tpu = jax.devices()[0].platform != "cpu"
     detail = {"device": str(jax.devices()[0])}
     benches = [("resnet", _bench_resnet), ("bert", _bench_bert),
@@ -433,6 +445,8 @@ def secondary_worker(force_cpu: bool, which: str):
             detail.update(fn(on_tpu))
         except Exception as e:  # noqa: BLE001 — report, don't crash the round
             detail[f"{name}_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+    detail["metrics_snapshot"] = _obs.snapshot(
+        meta={"which": which, "round": _current_round()})
     print(json.dumps({"metric": "secondary_models", "value": 1.0,
                       "unit": "detail", "vs_baseline": 0.0,
                       "detail": detail}))
@@ -474,8 +488,13 @@ def worker(force_cpu: bool, only_config: int | None = None):
         except Exception:
             pass
     import numpy as np  # noqa: F401
+    from paddle_tpu import observability as _obs
     from paddle_tpu.models.llama import LlamaConfig
 
+    # bench workers always run with telemetry ON: a bench row should be
+    # self-describing hardware evidence (the timed regions themselves are
+    # instrumented only via the post-hoc record_run, never per-step)
+    _obs.enable()
     on_tpu = jax.devices()[0].platform != "cpu"
     if on_tpu:
         ladder = _llama_ladder()
@@ -514,7 +533,7 @@ def worker(force_cpu: bool, only_config: int | None = None):
             try:
                 r = _run_one(cfg, batch, seq, steps, remat, on_tpu,
                              remat_policy=remat_policy,
-                             loss_chunk_mb=chunk_mb)
+                             loss_chunk_mb=chunk_mb, run_name=name)
                 break
             except Exception as e:
                 msg = f"{name}[try{attempt}]: {type(e).__name__}: {str(e)[:200]}"
@@ -562,7 +581,12 @@ def worker(force_cpu: bool, only_config: int | None = None):
                   "attention_bwd": bwd_mode,
                   "attention_router": router_info,
                   "lm_loss": r.get("lm_loss_path"),
-                  "device": str(jax.devices()[0])}
+                  "device": str(jax.devices()[0]),
+                  # the full registry snapshot rides in the row: train
+                  # telemetry + router decision counters, self-describing
+                  # and round-trippable via observability.load_snapshot
+                  "metrics_snapshot": _obs.snapshot(
+                      meta={"config": name, "round": _current_round()})}
         if errors:
             detail["skipped_configs"] = errors
         if transient:
@@ -673,8 +697,89 @@ def _best_recorded_tpu_win():
 # parent: orchestrate attempts with timeouts; never imports jax
 # --------------------------------------------------------------------------
 
-def _attempt(args, timeout_s):
-    """Run one worker subprocess; return (parsed_json_or_None, err_string)."""
+_PARENT_OBS = None   # (module, MetricRegistry) — lazy, jax-free
+
+
+def _parent_registry():
+    """The parent's own metric registry: probe/dial attempt history as
+    counters rather than hand-built strings. metrics.py is deliberately
+    standalone (stdlib only), so load it by file path — the parent keeps
+    its never-imports-jax resilience contract (importing the paddle_tpu
+    package would drag jax in)."""
+    global _PARENT_OBS
+    if _PARENT_OBS is None:
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "paddle_tpu", "observability", "metrics.py")
+        spec = importlib.util.spec_from_file_location("_bench_obs", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        reg = mod.MetricRegistry(enabled=True)
+        reg.counter("bench_attempts_total",
+                    "bench worker subprocess attempts by stage and outcome",
+                    ("stage", "outcome"))
+        reg.counter("bench_probe_timeouts_total",
+                    "TPU liveness probes that hit their wall-clock timeout "
+                    "(tunnel dark/wedged)")
+        _PARENT_OBS = (mod, reg)
+    return _PARENT_OBS
+
+
+def _attempt(args, timeout_s, stage=None):
+    """Run one worker subprocess; return (parsed_json_or_None, err_string).
+    Every attempt is counted in the parent registry by stage/outcome."""
+    result, err = _attempt_raw(args, timeout_s)
+    try:
+        _, reg = _parent_registry()
+        outcome = ("ok" if result is not None
+                   else "timeout" if err and err.startswith("timeout")
+                   else "error")
+        reg.get("bench_attempts_total").labels(
+            stage=stage or " ".join(args) or "worker",
+            outcome=outcome).inc()
+        if outcome == "timeout" and "--probe" in args:
+            reg.get("bench_probe_timeouts_total").inc()
+    except Exception:  # noqa: BLE001 — telemetry must not sink the bench
+        pass
+    return result, err
+
+
+def _attempt_counters():
+    """Flat {series: value} view of the parent's attempt counters — the
+    machine-readable provenance section of a fallback row."""
+    try:
+        mod, reg = _parent_registry()
+        out = {}
+        for m in reg.collect():
+            for key, child in m.children().items():
+                labels = ",".join(f"{k}={v}" for k, v in key)
+                out[f"{m.name}{{{labels}}}" if labels else m.name] = \
+                    child.value
+        return out
+    except Exception:  # noqa: BLE001
+        return {}
+
+
+def _attempt_provenance():
+    """Human-readable attempt history GENERATED from the counters (not
+    hand-assembled strings): totals by outcome + probe timeouts."""
+    try:
+        _, reg = _parent_registry()
+        by_outcome = {}
+        for key, child in reg.get("bench_attempts_total").children().items():
+            o = dict(key).get("outcome", "?")
+            by_outcome[o] = by_outcome.get(o, 0) + int(child.value)
+        if not by_outcome:
+            return ""
+        parts = [f"{n} {o}" for o, n in sorted(by_outcome.items())]
+        t = int(reg.get("bench_probe_timeouts_total").value)
+        tail = f", {t} probe timeout(s)" if t else ""
+        return f" [bench-time attempts: {', '.join(parts)}{tail}]"
+    except Exception:  # noqa: BLE001
+        return ""
+
+
+def _attempt_raw(args, timeout_s):
     cmd = [sys.executable, os.path.abspath(__file__), "--worker"] + args
     try:
         p = subprocess.run(cmd, capture_output=True, text=True,
@@ -727,7 +832,7 @@ def main():
     # 60-120s cadence, which can hold the tunnel wedged indefinitely.
     tpu_alive = False
     for i in range(2):
-        result, err = _attempt(["--probe"], 900)
+        result, err = _attempt(["--probe"], 900, stage="probe")
         if result is not None:
             tpu_alive = result.get("unit") == "tpu_alive"
             break
@@ -761,8 +866,8 @@ def main():
         plan = [(["--config", "3"], 900), (["--config", "2"], 900),
                 (["--config", "1"], 900), (["--config", "0"], 900)]
         for args, timeout_s in plan:
-            result, err = _attempt(args, timeout_s)
             cfg_id = args[1]
+            result, err = _attempt(args, timeout_s, stage=f"config{cfg_id}")
             if result is not None:
                 ladder_log[cfg_id] = {
                     "config": (result.get("detail") or {}).get("config"),
@@ -825,7 +930,9 @@ def main():
         recorded.setdefault("detail", {})["provenance"] = (
             f"measured on TPU in round {recorded.get('round')} "
             f"(unix {recorded.get('recorded_unix')}); the axon tunnel was "
-            "unreachable when the end-of-round bench ran")
+            "unreachable when the end-of-round bench ran"
+            + _attempt_provenance())
+        recorded["detail"]["bench_attempt_counters"] = _attempt_counters()
         if errors:
             recorded["detail"]["bench_time_errors"] = errors
         sres, serr = _attempt(["--secondary", "both", "--cpu"], 420)

@@ -41,6 +41,16 @@ from ...framework.core import Tensor
 
 __all__ = ["save_state_dict", "load_state_dict"]
 
+
+def _count(name):
+    """Checkpoint telemetry (observability catalog); the save/load path
+    never fails over a metrics problem."""
+    try:
+        from ...observability.catalog import metric
+        metric(name).inc()
+    except Exception:  # noqa: BLE001
+        pass
+
 _async_tasks: list[threading.Thread] = []
 
 
@@ -117,6 +127,7 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
 
     reference: checkpoint/save_state_dict.py:145.
     """
+    _count("checkpoint_saves_total")
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
     meta = {"version": 3, "arrays": {}}
@@ -208,6 +219,7 @@ def load_state_dict(state_dict, path, process_group=None,
     """Fill `state_dict` tensors in place, resharding each saved array onto
     the tensor's CURRENT sharding (which may come from a different mesh than
     the one that saved it). reference: checkpoint/load_state_dict.py."""
+    _count("checkpoint_loads_total")  # the resume path of elastic recovery
     _wait_async()
     with open(os.path.join(path, "metadata.json")) as f:
         meta = json.load(f)

@@ -22,6 +22,16 @@ import time
 __all__ = ["ElasticStatus", "ElasticManager"]
 
 
+def _count(name):
+    """Recovery telemetry (observability catalog); never fails the
+    recovery path over a metrics problem."""
+    try:
+        from ...observability.catalog import metric
+        metric(name).inc()
+    except Exception:  # noqa: BLE001
+        pass
+
+
 class ElasticStatus:
     COMPLETED = "completed"
     ERROR = "error"
@@ -167,6 +177,7 @@ class ElasticManager:
             time.sleep(poll)
             cur = set(self.alive_nodes())
             if cur != baseline:
+                _count("elastic_membership_changes_total")
                 if len(cur) < self.np_lo:
                     return ElasticStatus.HOLD
                 self.restarts += 1
@@ -174,6 +185,7 @@ class ElasticManager:
                     return ElasticStatus.EXIT
                 if self._on_change is not None:
                     self._on_change(sorted(cur))
+                _count("elastic_restarts_total")
                 return ElasticStatus.RESTART
             if deadline and time.time() > deadline:
                 return ElasticStatus.COMPLETED

@@ -156,6 +156,11 @@ def _run_local_procs(args):
                 f"launch: workers failed (codes {codes}), max_restart "
                 f"({args.max_restart}) exhausted\n")
             return 1
+        try:  # recovery telemetry; the restart itself must never fail on it
+            from ...observability.catalog import metric
+            metric("elastic_pod_restarts_total").inc()
+        except Exception:  # noqa: BLE001
+            pass
         sys.stderr.write(
             f"launch: workers failed (codes {codes}), restart "
             f"{restarts}/{args.max_restart}\n")

@@ -54,6 +54,13 @@ def _apply_side_effect(name, value):
         import jax
         jax.config.update("jax_compilation_cache_dir", value)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    elif name == "observability":
+        from ..observability import disable, enable
+        s = str(value).lower()
+        if s in ("1", "true", "yes", "on"):
+            enable()
+        else:
+            disable()
 
 
 def get_flags(flags):
@@ -109,6 +116,7 @@ define_flag("pipeline_schedule", "FThenB", "default pipeline schedule: FThenB|1F
 define_flag("prim_all", False, "ref FLAGS_prim_all: decompose big ops before autodiff (jax does this inherently; informational)")
 define_flag("cinn_bucket_compile", False, "ref FLAGS_cinn_bucket_compile; XLA owns fusion (informational)")
 # profiler / debug
+define_flag("observability", False, "runtime observability layer (paddle_tpu.observability): metrics registry + span tracing + SLO telemetry; off = zero-cost no-op fast path")
 define_flag("enable_host_event_recorder_hook", False, "ref FLAGS_enable_host_event_recorder_hook: record host events in profiler")
 define_flag("call_stack_level", 1, "ref FLAGS_call_stack_level: error-message stack detail")
 define_flag("api_benchmark", False, "per-op wall-time logging in execute()")

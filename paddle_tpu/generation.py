@@ -28,6 +28,9 @@ import jax.numpy as jnp
 
 from .framework.core import Tensor
 from .framework import random as _random
+from .observability import span as _span
+from .observability.catalog import metric as _metric
+from .observability.tracing import get_tracer as _tracer
 
 __all__ = ["generate", "GenerationConfig", "WeightOnlyGenerator"]
 
@@ -262,7 +265,8 @@ def _generic_generate(model, input_ids, gc: GenerationConfig, key):
     ids = input_ids
     done = jnp.zeros((ids.shape[0],), bool)
     for _ in range(gc.max_new_tokens):
-        out = model(Tensor(ids))
+        with _span("generation.decode_step"):
+            out = model(Tensor(ids))
         logits = (out[0] if isinstance(out, tuple) else out)._data
         key, sub = jax.random.split(key)
         nxt = _sample(logits[:, -1].astype(jnp.float32), sub, gc,
@@ -298,32 +302,51 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
         key = jax.random.key(0)
     from .models.llama import LlamaForCausalLM
     if isinstance(model, LlamaForCausalLM):
-        from .parallel.functional import split_stacked_layer_params
-        # CURRENT weights fetched per call and passed as jit arguments —
-        # the compiled program is keyed only on config/shapes, never holds
-        # weight copies, and stays correct across optimizer steps
-        state = {k: v._data for k, v in model.state_dict().items()}
-        stacked, other = split_stacked_layer_params(state)
-        tied = "lm_head.weight" not in other
-        c = model.config
-        # structural knobs only: temperature/top_p are traced arguments, so
-        # per-request knob changes never recompile
-        cache_key = ((c.hidden_size, c.num_hidden_layers,
-                      c.num_attention_heads, c.num_key_value_heads,
-                      c.vocab_size, c.rms_norm_eps, c.rope_theta, tied),
-                     max_new_tokens, do_sample, int(top_k),
-                     top_p < 1.0, eos_token_id)
-        cached = _GEN_CACHE.get(cache_key)
-        if cached is None:
-            cached = _build_llama_generate(c, tied, gc)
-            _GEN_CACHE[cache_key] = cached
-        head_w = other.get("lm_head.weight")
-        if head_w is None:  # jit needs a concrete leaf; tied path ignores it
-            head_w = jnp.zeros((0,), jnp.float32)
-        return Tensor(cached(stacked, other["llama.embed_tokens.weight"],
+        _metric("generation_requests_total", path="llama_compiled").inc()
+        with _span("generation.generate", path="llama_compiled",
+                   batch=int(ids.shape[0]), prompt=int(ids.shape[1]),
+                   new_tokens=int(max_new_tokens)):
+            from .parallel.functional import split_stacked_layer_params
+            # CURRENT weights fetched per call and passed as jit arguments —
+            # the compiled program is keyed only on config/shapes, never
+            # holds weight copies, and stays correct across optimizer steps
+            state = {k: v._data for k, v in model.state_dict().items()}
+            stacked, other = split_stacked_layer_params(state)
+            tied = "lm_head.weight" not in other
+            c = model.config
+            # structural knobs only: temperature/top_p are traced arguments,
+            # so per-request knob changes never recompile
+            cache_key = ((c.hidden_size, c.num_hidden_layers,
+                          c.num_attention_heads, c.num_key_value_heads,
+                          c.vocab_size, c.rms_norm_eps, c.rope_theta, tied),
+                         max_new_tokens, do_sample, int(top_k),
+                         top_p < 1.0, eos_token_id)
+            cached = _GEN_CACHE.get(cache_key)
+            if cached is None:
+                # prefill + decode fuse into ONE compiled program here, so
+                # the trace can only split build (trace/compile) from run;
+                # the serving engine's two-program path is where separate
+                # prefill/decode spans nest (serving.prefill/.decode_step)
+                with _span("generation.build"):
+                    cached = _build_llama_generate(c, tied, gc)
+                    _GEN_CACHE[cache_key] = cached
+            head_w = other.get("lm_head.weight")
+            if head_w is None:  # jit needs concrete leaf; tied path ignores
+                head_w = jnp.zeros((0,), jnp.float32)
+            with _span("generation.prefill_decode"):
+                out = cached(stacked, other["llama.embed_tokens.weight"],
                              other["llama.norm.weight"], head_w, ids, key,
-                             jnp.float32(temperature), jnp.float32(top_p)))
-    return Tensor(_generic_generate(model, ids, gc, key))
+                             jnp.float32(temperature), jnp.float32(top_p))
+                if _tracer().enabled:
+                    # sync only when tracing, so the span covers device
+                    # time; the disabled path keeps async dispatch
+                    out.block_until_ready()
+            return Tensor(out)
+    _metric("generation_requests_total", path="generic_recompute").inc()
+    with _span("generation.generate", path="generic_recompute",
+               batch=int(ids.shape[0]), prompt=int(ids.shape[1]),
+               new_tokens=int(max_new_tokens)):
+        return Tensor(_generic_generate(model, ids, gc, key))
 
 
 _GEN_CACHE: dict = {}

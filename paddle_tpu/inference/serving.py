@@ -28,6 +28,7 @@ the largest bucket's decision for audit.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 
 import numpy as np
@@ -36,6 +37,8 @@ import jax
 import jax.numpy as jnp
 
 from ..generation import _llama_layer_prefill, _rms, _rope
+from ..observability import span as _span
+from ..observability.catalog import metric as _metric
 from ..ops.paged_attention import paged_attention_decode, write_to_cache
 
 __all__ = ["ContinuousBatchingEngine", "Request"]
@@ -44,7 +47,7 @@ __all__ = ["ContinuousBatchingEngine", "Request"]
 class Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
                  "generated", "done", "do_sample", "temperature", "top_k",
-                 "top_p", "rng")
+                 "top_p", "rng", "t_arrival")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
@@ -62,6 +65,7 @@ class Request:
         # None -> OS entropy: concurrent sampled requests must differ by
         # default; a fixed seed is the explicit-reproducibility opt-in
         self.rng = np.random.RandomState(seed)
+        self.t_arrival = time.perf_counter()   # TTFT anchor
 
     def choose(self, logits: np.ndarray) -> int:
         """Per-request next-token choice on the host (B is small; the
@@ -201,6 +205,18 @@ class ContinuousBatchingEngine:
         self._next_rid = 0
         self._prefill_jit = {}
         self._decode_jit = None
+        # observability handles bound ONCE (catalog names; no-op when the
+        # layer is disabled — each call is a single flag check)
+        self._m_ttft = _metric("serving_ttft_seconds")
+        self._m_tpot = _metric("serving_tpot_seconds")
+        self._m_prefill = _metric("serving_prefill_seconds")
+        self._m_queue = _metric("serving_queue_depth")
+        self._m_occ = _metric("serving_batch_occupancy")
+        self._m_free = _metric("serving_kv_free_blocks")
+        self._m_admitted = _metric("serving_admitted_total")
+        self._m_retired = _metric("serving_retired_total")
+        self._m_tokens = _metric("serving_tokens_total")
+        _metric("serving_preempted_total")  # declared: 0 by design
 
     # --- public API -------------------------------------------------------
     def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
@@ -226,8 +242,13 @@ class ContinuousBatchingEngine:
 
     # --- scheduling -------------------------------------------------------
     def step(self):
-        self._admit()
-        self._decode_step()
+        with _span("serving.step"):
+            self._m_queue.set(len(self.queue))
+            self._admit()
+            self._decode_step()
+            self._m_occ.set(sum(r is not None for r in self.lanes)
+                            / self.max_batch)
+            self._m_free.set(len(self.pool._free))
 
     def _admit(self):
         while self.queue:
@@ -244,6 +265,7 @@ class ContinuousBatchingEngine:
                 req.done = True
                 req.generated = []
                 self.finished[req.rid] = req
+                _metric("serving_rejected_total", reason="oversized").inc()
                 continue
             if req.max_new_tokens <= 0:
                 self.queue.popleft()
@@ -254,22 +276,43 @@ class ContinuousBatchingEngine:
             # eviction (the reference engine preempts; we keep the
             # no-surprise contract and leave the request queued)
             if not self.pool.can_fit(total):
+                _metric("serving_deferred_total", reason="pool_full").inc()
                 return
             self.queue.popleft()
             lane = free_lanes[0]
-            first_tok = self._prefill(req)
-            # reserve the FULL footprint now — lazy per-step allocation
-            # could exhaust the pool mid-decode across admitted sequences,
-            # which the admission check above promised cannot happen
-            self.pool.ensure(req.rid, total)
+            try:
+                with _span("serving.prefill", rid=req.rid,
+                           prompt=int(req.prompt.size)):
+                    t0 = time.perf_counter()
+                    first_tok = self._prefill(req)
+                    self._m_prefill.observe(time.perf_counter() - t0)
+                # reserve the FULL footprint now — lazy per-step allocation
+                # could exhaust the pool mid-decode across admitted
+                # sequences, which the admission check above promised
+                # cannot happen
+                self.pool.ensure(req.rid, total)
+            except MemoryError:
+                # pool exhausted despite the can_fit gate (e.g. blocks
+                # held by an out-of-band allocation): surface as a counted
+                # deferral, give back any partial reservation, and leave
+                # the request AT THE FRONT of the queue — never let the
+                # scheduler step die mid-flight
+                self.pool.release(req.rid)
+                self.queue.appendleft(req)
+                _metric("serving_deferred_total",
+                        reason="pool_exhausted").inc()
+                return
             self.lanes[lane] = req
             self.lane_len[lane] = req.prompt.size
             self.lane_tok[lane] = first_tok
+            self._m_admitted.inc()
+            self._m_ttft.observe(time.perf_counter() - req.t_arrival)
             self._emit(lane, first_tok)
 
     def _emit(self, lane, token):
         req = self.lanes[lane]
         req.generated.append(int(token))
+        self._m_tokens.inc()
         if ((req.eos_token_id is not None and int(token) == req.eos_token_id)
                 or len(req.generated) >= req.max_new_tokens):
             req.done = True
@@ -277,6 +320,7 @@ class ContinuousBatchingEngine:
             self.pool.release(req.rid)
             self.lanes[lane] = None
             self.lane_len[lane] = 0
+            self._m_retired.inc()
 
     # --- compiled programs ------------------------------------------------
     def _bucket(self, n):
@@ -324,6 +368,14 @@ class ContinuousBatchingEngine:
         active = [i for i, r in enumerate(self.lanes) if r is not None]
         if not active:
             return
+        t0 = time.perf_counter()
+        with _span("serving.decode_step", active=len(active)):
+            self._decode_step_inner(active)
+        # one compiled step advances every active lane one token, so the
+        # step wall time IS the per-token latency (TPOT)
+        self._m_tpot.observe(time.perf_counter() - t0)
+
+    def _decode_step_inner(self, active):
         B = self.max_batch
         MB = self.max_blocks_per_seq
         # inactive lanes write into the pool's scratch block (their rows

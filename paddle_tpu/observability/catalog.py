@@ -1,0 +1,140 @@
+"""Canonical metric-name catalog.
+
+EVERY metric the framework emits is declared here — instrumentation
+sites fetch handles via `metric(name, **labels)`, which refuses names
+not in the catalog, and OBSERVABILITY.md's table is generated from /
+checked against this dict (tests/test_observability.py pins both
+directions, so docs and code cannot drift).
+
+Entry: name -> (type, help, labelnames, buckets_or_None).
+"""
+
+from __future__ import annotations
+
+from . import metrics as _metrics
+
+__all__ = ["CATALOG", "metric", "register_all"]
+
+# latency bucket families (seconds)
+_TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                 10.0, 30.0)
+_TPOT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                 0.025, 0.05, 0.1, 0.25, 1.0)
+_STEP_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                 1.0, 2.5, 5.0, 15.0, 60.0)
+
+CATALOG = {
+    # -- serving (inference/serving.py ContinuousBatchingEngine) ------------
+    "serving_ttft_seconds": (
+        "histogram", "time from add_request to the first sampled token",
+        (), _TTFT_BUCKETS),
+    "serving_tpot_seconds": (
+        "histogram", "per-token decode latency: one compiled decode step "
+        "(all active lanes advance one token)", (), _TPOT_BUCKETS),
+    "serving_prefill_seconds": (
+        "histogram", "one prefill program call (bucketed prompt)",
+        (), _STEP_BUCKETS),
+    "serving_queue_depth": (
+        "gauge", "requests waiting for admission", (), None),
+    "serving_batch_occupancy": (
+        "gauge", "active lanes / max_batch (0..1)", (), None),
+    "serving_kv_free_blocks": (
+        "gauge", "free blocks in the paged KV pool", (), None),
+    "serving_admitted_total": (
+        "counter", "requests admitted to a decode lane", (), None),
+    "serving_retired_total": (
+        "counter", "requests finished and released", (), None),
+    "serving_rejected_total": (
+        "counter", "requests rejected as unservable",
+        ("reason",), None),
+    "serving_deferred_total": (
+        "counter", "admissions deferred (request stays queued)",
+        ("reason",), None),
+    "serving_preempted_total": (
+        "counter", "mid-flight preemptions (0 by design: whole-sequence "
+        "admission; counted so a future preempting scheduler is visible)",
+        (), None),
+    "serving_tokens_total": (
+        "counter", "tokens emitted across all requests", (), None),
+
+    # -- generation (generation.py) -----------------------------------------
+    "generation_requests_total": (
+        "counter", "generate() calls by execution path",
+        ("path",), None),
+
+    # -- attention router (ops/pallas/attention_router.py) ------------------
+    "attention_router_decisions_total": (
+        "counter", "fresh (non-cached) routing decisions by source",
+        ("source",), None),
+
+    # -- training telemetry (observability.stepwatch.StepWatch) -------------
+    "train_step_seconds": (
+        "histogram", "train-step wall time", (), _STEP_BUCKETS),
+    "train_tokens_total": (
+        "counter", "training tokens consumed", (), None),
+    "train_loss": ("gauge", "latest training loss", (), None),
+    "train_grad_norm": ("gauge", "latest global grad norm", (), None),
+    "train_tokens_per_s": ("gauge", "online training throughput", (), None),
+    "train_mfu": (
+        "gauge", "online model-FLOPs utilization (needs flops_per_token "
+        "and peak_flops)", (), None),
+
+    # -- elastic / distributed recovery --------------------------------------
+    "elastic_membership_changes_total": (
+        "counter", "ElasticManager.watch observed the alive set change",
+        (), None),
+    "elastic_restarts_total": (
+        "counter", "ElasticManager returned RESTART (regroup requested)",
+        (), None),
+    "elastic_pod_restarts_total": (
+        "counter", "launcher restarted the local pod after worker failure",
+        (), None),
+    "checkpoint_saves_total": (
+        "counter", "distributed checkpoint save_state_dict calls", (), None),
+    "checkpoint_loads_total": (
+        "counter", "distributed checkpoint load_state_dict calls (resume "
+        "path after elastic restart)", (), None),
+
+    # -- bench orchestration (bench.py parent; stage = probe/configN/...) ----
+    "bench_attempts_total": (
+        "counter", "bench worker subprocess attempts by stage and outcome",
+        ("stage", "outcome"), None),
+    "bench_probe_timeouts_total": (
+        "counter", "TPU liveness probes that hit their wall-clock timeout "
+        "(tunnel dark/wedged)", (), None),
+}
+
+
+def register_all(registry=None):
+    """Define every catalog metric on `registry` (default: the process
+    registry). Idempotent; conflicting duplicates raise in the registry."""
+    reg = registry or _metrics.get_registry()
+    for name, (mtype, help_, labelnames, buckets) in CATALOG.items():
+        if mtype == "histogram":
+            reg.histogram(name, help_, labelnames,
+                          buckets or _metrics.DEFAULT_BUCKETS)
+        elif mtype == "gauge":
+            reg.gauge(name, help_, labelnames)
+        else:
+            reg.counter(name, help_, labelnames)
+    return reg
+
+
+def metric(name, **labels):
+    """Instrumentation-site handle: get-or-register `name` from the
+    catalog on the default registry; unknown names raise (add them to
+    the CATALOG + OBSERVABILITY.md first — that is the point)."""
+    try:
+        mtype, help_, labelnames, buckets = CATALOG[name]
+    except KeyError:
+        raise KeyError(f"{name!r} is not in the observability catalog "
+                       "(paddle_tpu/observability/catalog.py)") from None
+    reg = _metrics.get_registry()
+    if mtype == "histogram":
+        fam = reg.histogram(name, help_, labelnames,
+                            buckets or _metrics.DEFAULT_BUCKETS)
+    elif mtype == "gauge":
+        fam = reg.gauge(name, help_, labelnames)
+    else:
+        fam = reg.counter(name, help_, labelnames)
+    return fam.labels(**labels) if labels else fam

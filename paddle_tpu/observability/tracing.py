@@ -1,0 +1,237 @@
+"""Span tracer: nested host-side timing with Chrome-trace JSON export.
+
+reference capability: python/paddle/profiler/utils.py RecordEvent +
+event_tracing.h host ranges — generalized into a parent/child span tree
+on monotonic clocks that the profiler's `_ChromeTracingHandler` exports
+(chrome://tracing / Perfetto load the emitted file directly).
+
+STANDALONE like metrics.py: stdlib only, loadable outside the package.
+
+Two entry points:
+  - `span(name, **args)` — the gated context manager the hot paths use;
+    when tracing is disabled it returns a shared no-op (no allocation).
+  - `Tracer.begin/end` — ungated; profiler.RecordEvent uses these so its
+    spans are ALWAYS recorded (pre-existing profiler contract).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["Span", "Tracer", "get_tracer", "span", "trace",
+           "enable", "disable", "enabled"]
+
+# bound the in-memory buffer: long-running serving processes must not
+# grow without limit; export regularly or raise via Tracer(maxlen=...)
+DEFAULT_MAXLEN = 20000
+
+
+class Span:
+    __slots__ = ("name", "t0_ns", "dur_ns", "tid", "seq", "parent", "args")
+
+    def __init__(self, name, t0_ns, tid, seq, parent=None, args=None):
+        self.name = name
+        self.t0_ns = t0_ns
+        self.dur_ns = None          # set by end()
+        self.tid = tid
+        self.seq = seq
+        self.parent = parent        # parent span NAME ('' at top level)
+        self.args = args
+
+
+class _Noop:
+    """Shared zero-allocation context manager for the disabled fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _Noop()
+
+
+class Tracer:
+    def __init__(self, enabled=False, maxlen=DEFAULT_MAXLEN):
+        self._state_enabled = enabled
+        self._maxlen = maxlen
+        self._lock = threading.Lock()
+        self._finished: list[Span] = []
+        self._seq = 0
+        self._local = threading.local()   # per-thread open-span stack
+
+    # -- enable switch -------------------------------------------------------
+    @property
+    def enabled(self):
+        return self._state_enabled
+
+    def enable(self):
+        self._state_enabled = True
+
+    def disable(self):
+        self._state_enabled = False
+
+    # -- recording (ungated core) -------------------------------------------
+    def _stack(self):
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def begin(self, name, args=None) -> Span:
+        """Open a span unconditionally (profiler path). Pair with end()."""
+        stack = self._stack()
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        sp = Span(name, time.perf_counter_ns(), threading.get_ident(), seq,
+                  parent=stack[-1].name if stack else "", args=args)
+        stack.append(sp)
+        return sp
+
+    def end(self, sp: Span):
+        sp.dur_ns = time.perf_counter_ns() - sp.t0_ns
+        stack = self._stack()
+        # tolerate mispaired ends (a crashed child left on the stack)
+        while stack and stack[-1] is not sp:
+            stack.pop()
+        if stack:
+            stack.pop()
+        with self._lock:
+            self._finished.append(sp)
+            if len(self._finished) > self._maxlen:
+                del self._finished[:len(self._finished) - self._maxlen]
+
+    # -- gated context manager / decorator ----------------------------------
+    def span(self, name, **args):
+        if not self._state_enabled:
+            return _NOOP
+        return _SpanCtx(self, name, args or None)
+
+    def trace(self, name=None):
+        """Decorator form: @tracer.trace("my.phase")."""
+        def wrap(fn):
+            label = name or fn.__qualname__
+
+            def inner(*a, **kw):
+                if not self._state_enabled:
+                    return fn(*a, **kw)
+                sp = self.begin(label)
+                try:
+                    return fn(*a, **kw)
+                finally:
+                    self.end(sp)
+            inner.__name__ = fn.__name__
+            inner.__qualname__ = fn.__qualname__
+            inner.__doc__ = fn.__doc__
+            return inner
+        return wrap
+
+    # -- inspection / export -------------------------------------------------
+    def marker(self) -> int:
+        """Sequence watermark; pass to spans_since()/export for 'only what
+        happened after this point' (profiler start() snapshots one)."""
+        with self._lock:
+            return self._seq
+
+    def spans_since(self, marker=0):
+        with self._lock:
+            return [s for s in self._finished if s.seq >= marker]
+
+    def clear(self):
+        with self._lock:
+            self._finished.clear()
+
+    def durations_by_name(self, marker=0):
+        """{name: [seconds, ...]} — backs profiler.Profiler.summary()."""
+        out: dict[str, list] = {}
+        for s in self.spans_since(marker):
+            if s.dur_ns is not None:
+                out.setdefault(s.name, []).append(s.dur_ns / 1e9)
+        return out
+
+    def chrome_trace_events(self, marker=0):
+        """Chrome-trace 'X' (complete) events; nesting renders from
+        timestamp containment per tid, parent also kept in args."""
+        pid = os.getpid()
+        events = []
+        for s in self.spans_since(marker):
+            if s.dur_ns is None:
+                continue
+            args = dict(s.args) if s.args else {}
+            if s.parent:
+                args["parent"] = s.parent
+            events.append({"name": s.name, "ph": "X", "pid": pid,
+                           "tid": s.tid, "ts": s.t0_ns / 1e3,
+                           "dur": s.dur_ns / 1e3, "args": args})
+        return events
+
+    def export_chrome_trace(self, path, marker=0):
+        doc = {"traceEvents": self.chrome_trace_events(marker),
+               "displayTimeUnit": "ms"}
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+
+class _SpanCtx:
+    __slots__ = ("_tracer", "_name", "_args", "_span")
+
+    def __init__(self, tracer, name, args):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        self._span = self._tracer.begin(self._name, self._args)
+        return self._span
+
+    def __exit__(self, *exc):
+        self._tracer.end(self._span)
+        return False
+
+
+# --------------------------------------------------------------------------
+# default (process-wide) tracer
+# --------------------------------------------------------------------------
+
+_default_tracer: Tracer | None = None
+_default_lock = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    global _default_tracer
+    if _default_tracer is None:
+        with _default_lock:
+            if _default_tracer is None:
+                _default_tracer = Tracer(
+                    enabled=os.environ.get("FLAGS_observability", "")
+                    .lower() in ("1", "true", "yes", "on"))
+    return _default_tracer
+
+
+def span(name, **args):
+    """Module-level `with span("serving.step"):` over the default tracer."""
+    return get_tracer().span(name, **args)
+
+
+def trace(name=None):
+    return get_tracer().trace(name)
+
+
+def enable():
+    get_tracer().enable()
+
+
+def disable():
+    get_tracer().disable()
+
+
+def enabled() -> bool:
+    return get_tracer().enabled

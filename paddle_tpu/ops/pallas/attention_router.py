@@ -447,4 +447,13 @@ def route(batch_heads: int, seq_q: int, seq_k: int, head_dim: int, dtype,
     _route_cache[key] = dec
     _decision_log.append((key[:6], dec))
     del _decision_log[:-256]  # bound the audit log
+    try:
+        # the structured successor of the audit list: every FRESH decision
+        # (cache hits excluded) counted by source, exported with the rest
+        # of the registry — bench rows and the serving engine read these
+        from ...observability.catalog import metric as _obs_metric
+        _obs_metric("attention_router_decisions_total",
+                    source=dec.source).inc()
+    except Exception:  # noqa: BLE001 — routing must never fail on telemetry
+        pass
     return dec

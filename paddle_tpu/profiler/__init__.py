@@ -11,14 +11,13 @@ in the same trace; a lightweight host-event table backs summary().
 
 from __future__ import annotations
 
-import contextlib
 import enum
 import os
-import threading
 import time
-from collections import defaultdict
 
 import jax
+
+from ..observability.tracing import get_tracer as _host_tracer
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
@@ -64,31 +63,30 @@ class SummaryView(enum.Enum):
     UDFView = 8
 
 
-# host event table: name -> list of durations (seconds)
-_events = defaultdict(list)
-_events_lock = threading.Lock()
-
-
 class RecordEvent:
-    """Annotated host range, visible in the device trace.
+    """Annotated host range, visible in the device trace AND recorded as a
+    span in the observability tracer (observability/tracing.py) — so
+    summary() aggregates it and export_chrome_tracing's host trace shows
+    it with parent/child nesting.
     reference: python/paddle/profiler/utils.py RecordEvent +
     C++ paddle/fluid/platform/profiler/event_tracing.h."""
 
     def __init__(self, name, event_type=None):
         self.name = name
         self._ann = None
-        self._t0 = None
+        self._span = None
 
     def begin(self):
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
-        self._t0 = time.perf_counter()
+        # ungated tracer path: profiler users asked for recording
+        # explicitly, independent of the global observability flag
+        self._span = _host_tracer().begin(self.name)
 
     def end(self):
         if self._ann is not None:
-            dur = time.perf_counter() - self._t0
-            with _events_lock:
-                _events[self.name].append(dur)
+            _host_tracer().end(self._span)
+            self._span = None
             self._ann.__exit__(None, None, None)
             self._ann = None
 
@@ -124,20 +122,31 @@ def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
 
 class _ChromeTracingHandler:
     """on_trace_ready callback carrying the target dir; the Profiler reads
-    .log_dir at construction so jax writes the trace there directly."""
+    .log_dir at construction so jax writes the device trace there
+    directly, and on trace-ready this handler exports the HOST spans
+    (RecordEvent + observability spans) as a chrome-trace JSON alongside
+    it — RecordEvent ranges actually appear in the exported artifact."""
 
     def __init__(self, dir_name, worker_name=None):
         self.log_dir = dir_name
         self.worker_name = worker_name
+        self.last_host_trace = None
         os.makedirs(dir_name, exist_ok=True)
 
     def __call__(self, prof):
-        pass  # trace already written into self.log_dir by stop_trace
+        # device trace already written into self.log_dir by stop_trace;
+        # add the host-span trace (marker-scoped to this profiler run)
+        marker = getattr(prof, "_trace_marker", 0)
+        name = (f"host_trace.{self.worker_name}.json" if self.worker_name
+                else f"host_trace.{os.getpid()}.json")
+        self.last_host_trace = _host_tracer().export_chrome_trace(
+            os.path.join(self.log_dir, name), marker)
 
 
 def export_chrome_tracing(dir_name, worker_name=None):
-    """Trace lands in dir_name (TensorBoard-loadable; chrome://tracing reads
-    the contained .trace.json.gz)."""
+    """Trace lands in dir_name (TensorBoard-loadable; chrome://tracing
+    reads the contained .trace.json.gz plus the host_trace.*.json with
+    the RecordEvent span tree)."""
     return _ChromeTracingHandler(dir_name, worker_name)
 
 
@@ -197,10 +206,9 @@ class Profiler:
         self._state = want
 
     def start(self):
-        # snapshot the host-event table so summary() reports only events
+        # tracer watermark: summary()/host trace report only spans
         # recorded during THIS profiler run
-        with _events_lock:
-            self._event_baseline = {k: len(v) for k, v in _events.items()}
+        self._trace_marker = _host_tracer().marker()
         self._timer.begin()
         self._sync()
         return self
@@ -230,15 +238,15 @@ class Profiler:
     # -- reporting ----------------------------------------------------------
     def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail=True,
                 thread_sep=False, time_unit="ms"):
-        """Host-event summary table (device kernels live in the exported
-        trace; reference: profiler_statistic.py)."""
+        """Host-event summary table, aggregated from the observability
+        tracer's spans (device kernels live in the exported trace;
+        reference: profiler_statistic.py)."""
         unit = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
-        base = getattr(self, "_event_baseline", {})
-        with _events_lock:
-            rows = [(name, len(ds), sum(ds) * unit,
-                     sum(ds) / len(ds) * unit, max(ds) * unit, min(ds) * unit)
-                    for name, full in _events.items()
-                    for ds in [full[base.get(name, 0):]] if ds]
+        marker = getattr(self, "_trace_marker", 0)
+        rows = [(name, len(ds), sum(ds) * unit,
+                 sum(ds) / len(ds) * unit, max(ds) * unit, min(ds) * unit)
+                for name, ds in
+                _host_tracer().durations_by_name(marker).items() if ds]
         rows.sort(key=lambda r: -r[2])
         header = (f"{'Name':<40}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
                   f"{'Avg':>12}{'Max':>12}{'Min':>12}")

@@ -1,0 +1,660 @@
+"""Observability layer: metric registry, span tracer, StepWatch, catalog
+drift, instrumented hot paths, and the disabled-mode overhead guard.
+
+reference test pattern: the reference pins its profiler/timer contracts
+in test/legacy_test/test_profiler.py; here the unified layer gets the
+same treatment plus Prometheus/JSONL golden outputs and the two-process
+snapshot hand-off (the test_two_process.py subprocess pattern, scaled
+down: a worker process writes a snapshot, the parent loads it).
+"""
+
+import json
+import re
+import subprocess
+import sys
+import threading
+import time
+import tracemalloc
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import catalog as obs_catalog
+from paddle_tpu.observability import metrics as obs_metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+METRICS_PY = os.path.join(REPO, "paddle_tpu", "observability", "metrics.py")
+
+
+@pytest.fixture
+def reg():
+    return obs_metrics.MetricRegistry(enabled=True)
+
+
+@pytest.fixture
+def enabled_obs():
+    """Enable the process-wide layer for one test, scoped and cleaned."""
+    obs.get_registry().reset()
+    obs.enable()
+    marker = obs.get_tracer().marker()
+    yield marker
+    obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# registry core
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_gauge_basic(self, reg):
+        c = reg.counter("c", "help")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        g = reg.gauge("g")
+        g.set(7)
+        g.dec(3)
+        assert g.value == 4.0
+
+    def test_conflicting_reregistration_raises(self, reg):
+        reg.counter("m", labels=("a",))
+        assert reg.counter("m", labels=("a",)) is reg.get("m")  # idempotent
+        with pytest.raises(ValueError):
+            reg.gauge("m")
+        with pytest.raises(ValueError):
+            reg.counter("m", labels=("b",))
+
+    def test_labels_validated(self, reg):
+        c = reg.counter("http", labels=("code",))
+        with pytest.raises(ValueError):
+            c.labels(verb="GET")
+        with pytest.raises(ValueError):
+            c.inc()    # labeled family needs .labels()
+        c.labels(code=200).inc()
+        assert c.labels(code="200").value == 1  # values stringified
+
+    def test_concurrent_increments_from_threads(self, reg):
+        """The process-wide registry must count exactly under contention
+        (8 threads hammering one series and two labeled children)."""
+        c = reg.counter("hits", labels=("worker",))
+        plain = reg.counter("total")
+        n, per = 8, 5000
+
+        def work(i):
+            child = c.labels(worker=i % 2)
+            for _ in range(per):
+                child.inc()
+                plain.inc()
+
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert plain.value == n * per
+        assert (c.labels(worker=0).value + c.labels(worker=1).value
+                == n * per)
+
+    def test_histogram_bucket_boundaries(self, reg):
+        """Prometheus `le` semantics: a value exactly on a bound falls in
+        that bucket; cumulative counts; overflow to +Inf."""
+        h = reg.histogram("lat", buckets=(0.01, 0.1, 1.0))
+        for v in (0.01, 0.05, 0.1, 0.5, 1.0, 5.0):
+            h.observe(v)
+        assert h.cumulative_buckets() == [
+            (0.01, 1), (0.1, 3), (1.0, 5), ("+Inf", 6)]
+        assert h.count == 6
+        assert abs(h.sum - 6.66) < 1e-9
+
+    def test_label_cardinality_cap(self, reg, monkeypatch):
+        monkeypatch.setattr(obs_metrics, "MAX_LABEL_SETS", 4)
+        c = reg.counter("card", labels=("k",))
+        for i in range(4):
+            c.labels(k=i).inc()
+        with pytest.raises(ValueError, match="cardinality"):
+            c.labels(k="one-too-many")
+        c.labels(k=0).inc()   # existing children still usable
+        assert c.labels(k=0).value == 2
+
+    def test_disabled_noop_allocates_nothing(self):
+        """The single-flag fast path: with the registry disabled, inc/set/
+        observe return before touching any state — zero allocations
+        attributable to the metrics module."""
+        dreg = obs_metrics.MetricRegistry(enabled=False)
+        c = dreg.counter("c")
+        g = dreg.gauge("g")
+        h = dreg.histogram("h")
+        for _ in range(10):     # warm up method caches outside the trace
+            c.inc(); g.set(1.0); h.observe(0.5)   # noqa: E702
+        tracemalloc.start()
+        snap1 = tracemalloc.take_snapshot()
+        for _ in range(1000):
+            c.inc(); g.set(1.0); h.observe(0.5)   # noqa: E702
+        snap2 = tracemalloc.take_snapshot()
+        tracemalloc.stop()
+        leaked = [s for s in snap2.compare_to(snap1, "filename")
+                  if "metrics.py" in (s.traceback[0].filename or "")
+                  and s.size_diff > 0]
+        assert not leaked, leaked
+        assert c.value == 0 and h.count == 0    # and nothing was recorded
+
+    def test_prometheus_text_golden(self, reg):
+        c = reg.counter("requests_total", "total requests", ("code",))
+        c.labels(code="200").inc(3)
+        g = reg.gauge("queue_depth", "queued")
+        g.set(2)
+        h = reg.histogram("latency_seconds", "lat", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        assert obs_metrics.to_prometheus_text(reg) == (
+            "# HELP latency_seconds lat\n"
+            "# TYPE latency_seconds histogram\n"
+            'latency_seconds_bucket{le="0.1"} 1\n'
+            'latency_seconds_bucket{le="1"} 2\n'
+            'latency_seconds_bucket{le="+Inf"} 3\n'
+            "latency_seconds_sum 5.55\n"
+            "latency_seconds_count 3\n"
+            "# HELP queue_depth queued\n"
+            "# TYPE queue_depth gauge\n"
+            "queue_depth 2\n"
+            "# HELP requests_total total requests\n"
+            "# TYPE requests_total counter\n"
+            'requests_total{code="200"} 3\n')
+
+    def test_snapshot_roundtrip(self, reg):
+        reg.counter("c", labels=("k",)).labels(k="a").inc(5)
+        h = reg.histogram("h", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 2.0):
+            h.observe(v)
+        doc = json.loads(json.dumps(obs_metrics.snapshot(reg)))  # via JSON
+        reg2 = obs_metrics.load_snapshot(doc)
+        assert (obs_metrics.snapshot(reg2)["metrics"]
+                == obs_metrics.snapshot(reg)["metrics"])
+        assert reg2.get("h").cumulative_buckets() == \
+            h.cumulative_buckets()
+
+    def test_jsonl_snapshot_file_roundtrip(self, reg, tmp_path):
+        reg.counter("c").inc(9)
+        p = obs_metrics.write_snapshot_jsonl(
+            str(tmp_path / "snap.jsonl"), reg, meta={"rank": 3})
+        doc = obs_metrics.read_snapshot_jsonl(p)
+        assert doc["meta"] == {"rank": 3}
+        assert obs_metrics.load_snapshot(doc).get("c").value == 9
+
+    def test_two_process_snapshot_handoff(self, tmp_path):
+        """A REAL worker process (metrics.py loaded standalone — no jax,
+        asserted) writes a JSONL snapshot; the parent loads it. This is
+        the cross-process evidence path bench.py's jax-free parent uses."""
+        out = str(tmp_path / "w.jsonl")
+        code = (
+            "import importlib.util, sys\n"
+            f"spec = importlib.util.spec_from_file_location('m', {METRICS_PY!r})\n"
+            "m = importlib.util.module_from_spec(spec)\n"
+            "spec.loader.exec_module(m)\n"
+            "assert 'jax' not in sys.modules\n"
+            "reg = m.MetricRegistry(enabled=True)\n"
+            "reg.counter('worker_events_total').inc(41)\n"
+            "reg.counter('worker_events_total').inc()\n"
+            f"m.write_snapshot_jsonl({out!r}, reg, meta={{'rank': 0}})\n")
+        subprocess.run([sys.executable, "-c", code], check=True, timeout=60)
+        doc = obs_metrics.read_snapshot_jsonl(out)
+        assert obs_metrics.load_snapshot(doc).get(
+            "worker_events_total").value == 42
+
+
+# ---------------------------------------------------------------------------
+# catalog: docs and code cannot drift
+# ---------------------------------------------------------------------------
+
+class TestCatalog:
+    def test_catalog_registers_exactly_once(self):
+        r = obs_metrics.MetricRegistry(enabled=True)
+        obs_catalog.register_all(r)
+        obs_catalog.register_all(r)   # idempotent, no conflict raise
+        assert set(r.names()) == set(obs_catalog.CATALOG)
+        for name, (mtype, _, labels, _) in obs_catalog.CATALOG.items():
+            m = r.get(name)
+            assert m.type == mtype and m.labelnames == tuple(labels), name
+
+    def test_docs_table_matches_catalog(self):
+        text = open(os.path.join(REPO, "OBSERVABILITY.md")).read()
+        documented = set(re.findall(r"^\| `([a-z0-9_]+)` \|", text,
+                                    re.MULTILINE))
+        assert documented == set(obs_catalog.CATALOG), (
+            "OBSERVABILITY.md catalog table and catalog.py CATALOG differ: "
+            f"docs-only={documented - set(obs_catalog.CATALOG)}, "
+            f"code-only={set(obs_catalog.CATALOG) - documented}")
+
+    def test_metric_refuses_unknown_names(self):
+        with pytest.raises(KeyError, match="catalog"):
+            obs.metric("not_a_registered_name_total")
+
+    def test_bench_parent_names_are_in_catalog(self):
+        """bench.py's jax-free parent registers these by literal string
+        (it cannot import catalog.py); pin them here so they can't drift."""
+        for name in ("bench_attempts_total", "bench_probe_timeouts_total"):
+            assert name in obs_catalog.CATALOG
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+class TestTracer:
+    def test_nesting_and_chrome_export(self, tmp_path):
+        tr = obs.Tracer(enabled=True)
+        with tr.span("outer", kind="test"):
+            with tr.span("inner"):
+                time.sleep(0.001)
+            with tr.span("inner2"):
+                pass
+        path = tr.export_chrome_trace(str(tmp_path / "t.json"))
+        events = json.load(open(path))["traceEvents"]
+        byname = {e["name"]: e for e in events}
+        assert set(byname) == {"outer", "inner", "inner2"}
+        assert byname["inner"]["args"]["parent"] == "outer"
+        assert byname["inner2"]["args"]["parent"] == "outer"
+        assert byname["outer"]["args"]["kind"] == "test"
+        # timestamp containment (ts in us, monotonic clock)
+        o, i = byname["outer"], byname["inner"]
+        assert o["ts"] <= i["ts"]
+        assert i["ts"] + i["dur"] <= o["ts"] + o["dur"] + 1e-3
+        assert i["dur"] >= 1000   # the 1ms sleep
+
+    def test_disabled_span_is_shared_noop(self):
+        tr = obs.Tracer(enabled=False)
+        a, b = tr.span("x"), tr.span("y")
+        assert a is b    # the no-op singleton: nothing allocated per call
+        with a:
+            pass
+        assert tr.spans_since() == []
+
+    def test_decorator_and_marker(self):
+        tr = obs.Tracer(enabled=True)
+
+        @tr.trace("my.fn")
+        def fn(x):
+            return x + 1
+
+        assert fn(1) == 2
+        marker = tr.marker()
+        assert fn(2) == 3
+        names = [s.name for s in tr.spans_since(marker)]
+        assert names == ["my.fn"]
+        assert len(tr.spans_since(0)) == 2
+
+    def test_buffer_bounded(self):
+        tr = obs.Tracer(enabled=True, maxlen=10)
+        for i in range(50):
+            with tr.span(f"s{i}"):
+                pass
+        assert len(tr.spans_since(0)) == 10
+        assert tr.spans_since(0)[-1].name == "s49"
+
+
+# ---------------------------------------------------------------------------
+# profiler integration (satellite: RecordEvent spans in exported traces)
+# ---------------------------------------------------------------------------
+
+class TestProfilerIntegration:
+    def test_record_event_spans_reach_exported_chrome_trace(self, tmp_path):
+        from paddle_tpu import profiler
+        d = str(tmp_path / "trace_out")
+        handler = profiler.export_chrome_tracing(d, worker_name="w0")
+        p = profiler.Profiler(on_trace_ready=handler, timer_only=True)
+        p.start()
+        with profiler.RecordEvent("outer_range"):
+            with profiler.RecordEvent("inner_range"):
+                pass
+        handler(p)   # what stop() invokes on trace-ready
+        path = handler.last_host_trace
+        assert path and path.startswith(d)
+        events = json.load(open(path))["traceEvents"]
+        byname = {e["name"]: e for e in events}
+        assert "outer_range" in byname and "inner_range" in byname
+        assert byname["inner_range"]["args"]["parent"] == "outer_range"
+        p.stop()
+
+    def test_summary_scoped_by_profiler_run(self):
+        from paddle_tpu import profiler
+        with profiler.RecordEvent("before_start"):
+            pass
+        p = profiler.Profiler(timer_only=True)
+        p.start()
+        for _ in range(3):
+            with profiler.RecordEvent("during_run"):
+                pass
+        table = p.summary()
+        p.stop()
+        assert "during_run" in table
+        assert "before_start" not in table
+
+    def test_observability_spans_share_the_summary_substrate(
+            self, enabled_obs):
+        """obs.span() and RecordEvent land in the same tracer: a span
+        opened by an instrumented hot path shows up in Profiler.summary."""
+        from paddle_tpu import profiler
+        p = profiler.Profiler(timer_only=True)
+        p.start()
+        with obs.span("unified.span"):
+            pass
+        table = p.summary()
+        p.stop()
+        assert "unified.span" in table
+
+
+# ---------------------------------------------------------------------------
+# StepWatch
+# ---------------------------------------------------------------------------
+
+class TestStepWatch:
+    def test_record_run_rows_and_metrics(self, enabled_obs, tmp_path):
+        log = str(tmp_path / "steps.jsonl")
+        sw = obs.StepWatch(tokens_per_step=100, flops_per_token=2e8,
+                           peak_flops=1e12, jsonl_path=log,
+                           run_name="unit", round=7, provenance="drill")
+        sw.record_run(steps=3, seconds=0.3, tokens=300, loss=2.5)
+        rows = [json.loads(ln) for ln in open(log)]
+        assert len(rows) == 3
+        r = rows[-1]
+        assert r["run"] == "unit" and r["step"] == 3
+        assert abs(r["step_time_s"] - 0.1) < 1e-9
+        assert abs(r["tokens_per_s"] - 1000.0) < 1e-6
+        # bench-ledger-schema provenance fields on every row
+        assert r["round"] == 7 and r["provenance"] == "drill"
+        assert isinstance(r["recorded_unix"], int)
+        assert abs(r["mfu"] - 2e8 * 1000 / 1e12) < 1e-9   # 0.2 MFU
+        regd = obs.get_registry()
+        assert regd.get("train_step_seconds").count == 3
+        assert regd.get("train_tokens_total").value == 300
+        assert regd.get("train_loss").value == 2.5
+        assert abs(regd.get("train_mfu").value - 0.2) < 1e-9
+        s = sw.summary()
+        assert s["steps"] == 3 and abs(s["avg_step_time_s"] - 0.1) < 1e-9
+
+    def test_live_steps_with_phase_breakdown(self, enabled_obs):
+        sw = obs.StepWatch(tokens_per_step=10).start()
+        with sw.phase("data"):
+            time.sleep(0.002)
+        row = sw.step(loss=1.0, grad_norm=0.5)
+        assert row["breakdown_s"]["data"] >= 0.001
+        assert row["step_time_s"] >= row["breakdown_s"]["data"]
+        assert obs.get_registry().get("train_grad_norm").value == 0.5
+        row2 = sw.step()
+        assert "breakdown_s" not in row2   # phases reset per step
+
+    def test_disabled_stepwatch_is_silent(self, tmp_path):
+        assert not obs.enabled()
+        log = str(tmp_path / "none.jsonl")
+        sw = obs.StepWatch(tokens_per_step=10, jsonl_path=log).start()
+        assert sw.step(loss=1.0) is None
+        assert sw.record_run(2, 0.2) is None
+        assert not os.path.exists(log)
+
+
+# ---------------------------------------------------------------------------
+# instrumented hot paths: serving engine SLOs + nested spans
+# ---------------------------------------------------------------------------
+
+def _tiny_model():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=256)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+def _dense_ref(model, prompt, n):
+    from paddle_tpu.generation import generate
+    ids = paddle.to_tensor(np.asarray(prompt, np.int32)[None])
+    out = generate(model, ids, max_new_tokens=n, do_sample=False)
+    return np.asarray(out._data)[0, len(prompt):].tolist()
+
+
+class TestServingTelemetry:
+    def test_engine_exports_slo_metrics_and_nested_spans(
+            self, enabled_obs, tmp_path):
+        from paddle_tpu.inference import ContinuousBatchingEngine
+        model = _tiny_model()
+        eng = ContinuousBatchingEngine(model, num_blocks=64, block_size=8,
+                                       max_batch=2, prefill_buckets=(16,))
+        rs = np.random.RandomState(0)
+        for _ in range(3):
+            eng.add_request(rs.randint(0, 128, (7,)), max_new_tokens=4)
+        out = eng.run()
+        regd = obs.get_registry()
+        # SLO metrics are non-zero and consistent with the run
+        assert regd.get("serving_ttft_seconds").count == 3
+        assert regd.get("serving_ttft_seconds").sum > 0
+        assert regd.get("serving_tpot_seconds").count > 0
+        assert regd.get("serving_tpot_seconds").sum > 0
+        assert regd.get("serving_admitted_total").value == 3
+        assert regd.get("serving_retired_total").value == 3
+        assert regd.get("serving_tokens_total").value == \
+            sum(len(v) for v in out.values())
+        assert regd.get("serving_kv_free_blocks").value == \
+            len(eng.pool._free)
+        assert regd.get("serving_batch_occupancy").value == 0  # all done
+        # prometheus export carries them
+        text = obs.prometheus_text()
+        assert "serving_ttft_seconds_count 3" in text
+        # chrome trace: prefill and decode spans NEST under serving.step
+        path = obs.get_tracer().export_chrome_trace(
+            str(tmp_path / "serving.json"), marker=enabled_obs)
+        events = json.load(open(path))["traceEvents"]
+        parents = {(e["name"], e["args"].get("parent")) for e in events}
+        assert ("serving.prefill", "serving.step") in parents
+        assert ("serving.decode_step", "serving.step") in parents
+
+    def test_pool_exhaustion_defers_then_drains_and_admits(
+            self, enabled_obs):
+        """Satellite: MemoryError('paged KV pool exhausted') inside
+        admission becomes a counted deferral (request stays queued), never
+        an engine crash; once the pool drains the request is admitted and
+        completes correctly."""
+        from paddle_tpu.inference import ContinuousBatchingEngine
+        model = _tiny_model()
+        # 3 usable blocks of 8: one 10-token-prompt+6 request takes 2
+        eng = ContinuousBatchingEngine(model, num_blocks=4, block_size=8,
+                                       max_batch=2, prefill_buckets=(16,))
+        # simulate an optimistic admission gate: can_fit always says yes,
+        # so the MemoryError path inside ensure() is actually exercised
+        eng.pool.can_fit = lambda n: True
+        rs = np.random.RandomState(2)
+        p = rs.randint(0, 128, (10,))
+        r1 = eng.add_request(p, max_new_tokens=6)
+        r2 = eng.add_request(p, max_new_tokens=6)
+        eng.step()     # r1 admitted; r2's reservation raises -> deferred
+        assert len(eng.queue) == 1          # r2 still queued, engine alive
+        deferred = obs.get_registry().get("serving_deferred_total")
+        assert deferred.labels(reason="pool_exhausted").value >= 1
+        out = eng.run()                     # r1 retires, r2 admitted
+        ref = _dense_ref(model, p, 6)
+        assert out[r1] == ref and out[r2] == ref
+        assert eng.pool.tables == {}        # everything released
+
+    def test_oversized_rejection_counted(self, enabled_obs):
+        from paddle_tpu.inference import ContinuousBatchingEngine
+        model = _tiny_model()
+        eng = ContinuousBatchingEngine(model, num_blocks=64, block_size=8,
+                                       max_batch=2, max_blocks_per_seq=2,
+                                       prefill_buckets=(16,))
+        rid = eng.add_request(np.arange(10) % 128, max_new_tokens=20)
+        eng.step()
+        assert eng.finished[rid].generated == []
+        rej = obs.get_registry().get("serving_rejected_total")
+        assert rej.labels(reason="oversized").value == 1
+
+    def test_disabled_engine_records_nothing(self):
+        from paddle_tpu.inference import ContinuousBatchingEngine
+        assert not obs.enabled()
+        obs.get_registry().reset()
+        model = _tiny_model()
+        eng = ContinuousBatchingEngine(model, num_blocks=64, block_size=8,
+                                       max_batch=2, prefill_buckets=(16,))
+        eng.add_request(np.arange(5) % 128, max_new_tokens=3)
+        eng.run()
+        regd = obs.get_registry()
+        assert regd.get("serving_admitted_total").value == 0
+        assert regd.get("serving_ttft_seconds").count == 0
+
+
+class TestRouterCounters:
+    def test_fresh_decisions_counted_by_source(self, enabled_obs):
+        from paddle_tpu.ops.pallas import attention_router as ar
+        ar.clear_routing_cache()
+        fam = obs.get_registry().get("attention_router_decisions_total")
+        dec = ar.route(64, 512, 512, 64, "float32", True, platform="cpu")
+        child = fam.labels(source=dec.source)
+        after_first = child.value
+        assert after_first >= 1
+        ar.route(64, 512, 512, 64, "float32", True, platform="cpu")  # hit
+        assert child.value == after_first   # cache hits are not re-counted
+        ar.clear_routing_cache()
+
+
+class TestElasticCounters:
+    def test_watch_restart_counts(self, enabled_obs):
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          ElasticStatus)
+
+        class Store:
+            def __init__(self):
+                self.d = {}
+
+            def add(self, k, n):
+                self.d[k] = int(self.d.get(k, 0)) + n
+                return self.d[k]
+
+            def set(self, k, v):
+                self.d[k] = v
+
+            def get(self, k):
+                return self.d[k]
+
+            def check(self, k):
+                return k in self.d
+
+        store = Store()
+        a = ElasticManager(store, node_id="a", np_range=(1, 2),
+                           heartbeat_interval=1.0)
+        b = ElasticManager(store, node_id="b", np_range=(1, 2),
+                           heartbeat_interval=1.0)
+        a.register()
+        b.register()
+        res = {}
+        th = threading.Thread(
+            target=lambda: res.update(st=a.watch(poll=0.05, max_wait=5)))
+        th.start()
+        time.sleep(0.15)
+        b.deregister()          # tombstone: the alive set changes
+        th.join(timeout=10)
+        assert res.get("st") == ElasticStatus.RESTART
+        regd = obs.get_registry()
+        assert regd.get("elastic_membership_changes_total").value >= 1
+        assert regd.get("elastic_restarts_total").value >= 1
+
+
+# ---------------------------------------------------------------------------
+# tools/metrics_dump.py
+# ---------------------------------------------------------------------------
+
+class TestMetricsDumpTool:
+    def _snapshot_file(self, tmp_path):
+        r = obs_metrics.MetricRegistry(enabled=True)
+        r.counter("serving_admitted_total", "x").inc(4)
+        r.histogram("serving_ttft_seconds", "y",
+                    buckets=(0.1, 1.0)).observe(0.5)
+        return obs_metrics.write_snapshot_jsonl(
+            str(tmp_path / "s.jsonl"), r)
+
+    def test_table_and_prom_views(self, tmp_path):
+        path = self._snapshot_file(tmp_path)
+        tool = os.path.join(REPO, "tools", "metrics_dump.py")
+        p = subprocess.run([sys.executable, tool, path],
+                           capture_output=True, text=True, timeout=60)
+        assert p.returncode == 0, p.stderr
+        assert "serving_admitted_total" in p.stdout
+        assert "n=1" in p.stdout        # histogram summary cell
+        p = subprocess.run([sys.executable, tool, path, "--prom"],
+                           capture_output=True, text=True, timeout=60)
+        assert p.returncode == 0, p.stderr
+        assert "# TYPE serving_ttft_seconds histogram" in p.stdout
+        assert "serving_admitted_total 4" in p.stdout
+
+    def test_digs_snapshot_out_of_bench_row(self, tmp_path):
+        r = obs_metrics.MetricRegistry(enabled=True)
+        r.counter("train_tokens_total", "t").inc(123)
+        row = {"metric": "llama_train_mfu_1chip", "value": 0.4,
+               "detail": {"config": "x",
+                          "metrics_snapshot": obs_metrics.snapshot(r)}}
+        path = str(tmp_path / "row.json")
+        json.dump(row, open(path, "w"))
+        tool = os.path.join(REPO, "tools", "metrics_dump.py")
+        p = subprocess.run([sys.executable, tool, path],
+                           capture_output=True, text=True, timeout=60)
+        assert p.returncode == 0, p.stderr
+        assert "train_tokens_total" in p.stdout and "123" in p.stdout
+
+
+# ---------------------------------------------------------------------------
+# the acceptance gate: disabled mode must not tax the train loop
+# ---------------------------------------------------------------------------
+
+class TestDisabledOverheadGuard:
+    def test_50_step_smoke_loop_under_one_percent(self):
+        """50-step CPU smoke train loop vs. the FULL per-step
+        instrumentation sequence the hot paths add (spans + gauges +
+        counters + histogram + StepWatch), measured with observability
+        disabled. The sequence is timed directly (deterministic, unlike
+        an A/B of two noisy loops) and must cost < 1% of a step."""
+        import jax
+        import jax.numpy as jnp
+        assert not obs.enabled()
+
+        def loss(w, x, y):
+            return jnp.mean((x @ w - y) ** 2)
+
+        @jax.jit
+        def train_step(w, x, y):
+            return w - 0.01 * jax.grad(loss)(w, x, y)
+
+        rng = np.random.RandomState(0)
+        w = jnp.asarray(rng.randn(256, 64), jnp.float32)
+        x = jnp.asarray(rng.randn(128, 256), jnp.float32)
+        y = jnp.asarray(rng.randn(128, 64), jnp.float32)
+        train_step(w, x, y).block_until_ready()   # compile
+        t0 = time.perf_counter()
+        for _ in range(50):
+            w = train_step(w, x, y)
+            w.block_until_ready()
+        step_s = (time.perf_counter() - t0) / 50
+
+        c = obs.metric("serving_admitted_total")
+        g = obs.metric("serving_queue_depth")
+        h = obs.metric("serving_tpot_seconds")
+        sw = obs.StepWatch(tokens_per_step=100).start()
+        span = obs.span
+        n = 2000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with span("serving.step"):
+                pass
+            c.inc()
+            g.set(1.0)
+            h.observe(0.001)
+            sw.step(loss=1.0)
+        instr_s = (time.perf_counter() - t0) / n
+        assert instr_s < 0.01 * step_s, (
+            f"disabled-mode instrumentation costs {instr_s * 1e6:.2f}us "
+            f"per step vs step time {step_s * 1e6:.1f}us "
+            f"({instr_s / step_s:.2%} > 1%)")
+        # and nothing was recorded
+        assert obs.get_registry().get("serving_admitted_total").value == 0

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Pretty-print an observability snapshot (JSONL file, a bench row's
+embedded `metrics_snapshot`, or a live registry) — the operator half of
+OBSERVABILITY.md's exporter runbook.
+
+Usage:
+  python tools/metrics_dump.py obs.metrics.jsonl          # table view
+  python tools/metrics_dump.py obs.metrics.jsonl --prom   # Prometheus text
+  python tools/metrics_dump.py BENCH_r05.json             # bench row: digs
+                                                          # out detail.*.metrics_snapshot
+  python tools/metrics_dump.py --live                     # this process's
+                                                          # registry (after
+                                                          # importing nothing
+                                                          # it is empty; use
+                                                          # from scripts)
+
+Dependency-free by design: loads paddle_tpu/observability/metrics.py by
+file path (stdlib only), so it runs on machines without jax.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _metrics_mod():
+    path = os.path.join(REPO, "paddle_tpu", "observability", "metrics.py")
+    spec = importlib.util.spec_from_file_location("_dump_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _find_snapshot(obj):
+    """Recursively locate the first metrics snapshot dict inside arbitrary
+    JSON (bench rows nest it under detail[...]["metrics_snapshot"])."""
+    if isinstance(obj, dict):
+        if obj.get("format") == 1 and "metrics" in obj:
+            return obj
+        for v in obj.values():
+            hit = _find_snapshot(v)
+            if hit is not None:
+                return hit
+    elif isinstance(obj, list):
+        for v in obj:
+            hit = _find_snapshot(v)
+            if hit is not None:
+                return hit
+    return None
+
+
+def load_any(path, mod):
+    """-> snapshot dict from a JSONL snapshot, a JSON doc containing one,
+    or a single-line bench row."""
+    try:
+        return mod.read_snapshot_jsonl(path)
+    except Exception:
+        pass
+    with open(path) as f:
+        text = f.read()
+    for chunk in ([text] + text.strip().splitlines()):
+        try:
+            snap = _find_snapshot(json.loads(chunk))
+        except Exception:
+            continue
+        if snap is not None:
+            return snap
+    raise SystemExit(f"{path}: no metrics snapshot found (expected a "
+                     "JSONL snapshot or JSON embedding one)")
+
+
+def table(reg, mod):
+    lines = []
+    header = f"{'metric':<44}{'type':>10}  {'labels':<34}{'value':>14}"
+    lines += [header, "-" * len(header)]
+    for m in reg.collect():
+        for key in sorted(m.children()):
+            c = m.children()[key]
+            labels = ",".join(f"{k}={v}" for k, v in key) or "-"
+            if m.type == "histogram":
+                val = (f"n={c.count} sum={c.sum:.6g}"
+                       + (f" avg={c.sum / c.count:.6g}" if c.count else ""))
+            else:
+                val = f"{c.value:.6g}"
+            lines.append(f"{m.name:<44}{m.type:>10}  {labels:<34}{val:>14}")
+    return "\n".join(lines)
+
+
+def main(argv):
+    args = [a for a in argv if not a.startswith("--")]
+    prom = "--prom" in argv
+    mod = _metrics_mod()
+    if "--live" in argv:
+        reg = mod.get_registry()
+    else:
+        if not args:
+            raise SystemExit(__doc__)
+        reg = mod.load_snapshot(load_any(args[0], mod))
+    print(mod.to_prometheus_text(reg) if prom else table(reg, mod))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

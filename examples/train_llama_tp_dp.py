@@ -1,13 +1,19 @@
 """Ladder #4: Llama pretraining with TP x DP x SEP (+ ZeRO) and a sharded
-distributed checkpoint.
+distributed checkpoint, supervised for production failure modes.
 
 reference workflow: fleet hybrid parallel (TP layers + sequence parallel +
 DygraphShardingOptimizer) and paddle.distributed.checkpoint. TPU-native:
 one jitted GSPMD step (SpmdTrainer + LLAMA_SHARDING_RULES); ring attention
 covers the sep axis; save_state_dict writes owner-deduped chunk files.
+
+The loop runs under resilience.TrainSupervisor (RESILIENCE.md): a
+non-finite loss skips the batch instead of killing the run, SIGTERM
+writes a final checkpoint and exits clean (code 0), and with --ckpt-dir
+a restarted process auto-resumes from the last complete checkpoint.
 """
 
 import argparse
+import os
 import tempfile
 
 from _common import setup_devices
@@ -24,6 +30,9 @@ def main():
     ap.add_argument("--steps", type=int, default=3)
     ap.add_argument("--seq", type=int, default=32)
     ap.add_argument("--save", action="store_true")
+    ap.add_argument("--ckpt-dir", default="",
+                    help="checkpoint/resume dir (enables every-step saves, "
+                    "SIGTERM final checkpoint, and auto-resume)")
     args = ap.parse_args()
     devices = setup_devices(args.devices)
 
@@ -32,7 +41,9 @@ def main():
     from jax.sharding import Mesh, PartitionSpec as P
     import paddle_tpu as paddle
     from paddle_tpu import optimizer
+    from paddle_tpu.distributed import checkpoint as dck
     from paddle_tpu.parallel import SpmdTrainer, LLAMA_SHARDING_RULES
+    from paddle_tpu.resilience import TrainSupervisor
 
     grid = np.asarray(devices).reshape(
         1, args.mp, args.sep, args.sharding, args.dp)
@@ -47,16 +58,47 @@ def main():
 
     rng = np.random.RandomState(0)
     batch = 2 * args.dp
-    for step in range(args.steps):
-        ids = jnp.asarray(
+
+    def make_batch():
+        return jnp.asarray(
             rng.randint(0, model.config.vocab_size, (batch, args.seq)),
             jnp.int32)
-        loss = trainer.step((ids, ids))
-        print(f"step {step}: loss={float(loss):.4f}")
+
+    def save_ckpt(step):
+        state = dict(trainer.params)
+        state["__step__"] = jnp.asarray(step, jnp.int32)
+        dck.save_state_dict(state, args.ckpt_dir)
+
+    def load_ckpt():
+        if not os.path.exists(os.path.join(args.ckpt_dir, "metadata.json")):
+            return None
+        state = dict(trainer.params)
+        state["__step__"] = jnp.zeros((), jnp.int32)
+        dck.load_state_dict(state, args.ckpt_dir)
+        trainer.params = {k: state[k] for k in trainer.params}
+        return int(state["__step__"])
+
+    sup = TrainSupervisor(
+        lambda ids: trainer.step((ids, ids)),
+        save_fn=save_ckpt if args.ckpt_dir else None,
+        load_fn=load_ckpt if args.ckpt_dir else None,
+        checkpoint_every=1 if args.ckpt_dir else 0)
+    sup.install_signal_handlers()   # SIGTERM -> final ckpt + clean exit
+    start = sup.resume()
+    if start:
+        print(f"resumed from step {start} ({args.ckpt_dir})")
+        for _ in range(start):      # replay the data stream to the step
+            make_batch()
+
+    for step in range(start, args.steps):
+        loss = sup.step(make_batch())
+        if loss is None:
+            print(f"step {step}: non-finite loss, batch skipped")
+        else:
+            print(f"step {step}: loss={loss:.4f}")
 
     if args.save:
-        from paddle_tpu.distributed import checkpoint as dck
-        path = tempfile.mkdtemp(prefix="llama_ckpt_")
+        path = args.ckpt_dir or tempfile.mkdtemp(prefix="llama_ckpt_")
         dck.save_state_dict(dict(trainer.params), path)
         print(f"sharded checkpoint written to {path}")
 

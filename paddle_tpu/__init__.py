@@ -68,6 +68,7 @@ from . import text  # noqa: F401
 from . import inference  # noqa: F401
 from . import profiler  # noqa: F401
 from . import observability  # noqa: F401
+from . import resilience  # noqa: F401
 from . import quantization  # noqa: F401
 from .framework import io_file as _io_file
 from .framework.io_file import save, load  # noqa: F401

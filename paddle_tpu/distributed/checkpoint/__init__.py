@@ -24,6 +24,18 @@ Durability: every file is written to a temp name then os.replace'd
 (atomic), metadata goes last, and async save runs on a NON-daemon thread —
 process exit joins it, so a returned save_state_dict(async_save=True) can
 never leave a truncated checkpoint.
+
+Atomicity under kill-mid-save: chunk files are VERSIONED by a save
+sequence number (read from the previous metadata.json in the same dir,
+so every host derives the same seq without communication). A save that
+dies between chunk writes and the metadata os.replace leaves the
+previous metadata pointing at the previous seq's untouched files — the
+new seq's orphans are garbage-collected by the next successful save.
+Integrity: every locally-owned chunk's sha256 goes into metadata.json;
+load verifies each chunk the first time it is read, and a truncated or
+corrupt file raises `CheckpointCorruptionError` naming the file. IO is
+retried via resilience.RetryPolicy; the fault sites `ckpt.chunk_write`
+and `ckpt.metadata_replace` make both failure windows drillable.
 """
 
 from __future__ import annotations
@@ -31,6 +43,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import re
 import threading
 
 import numpy as np
@@ -38,8 +51,21 @@ import numpy as np
 import jax
 
 from ...framework.core import Tensor
+from ...resilience.faults import fault_point
+from ...resilience.retry import RetryPolicy
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict",
+           "CheckpointCorruptionError"]
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A chunk file is missing, truncated, or fails its recorded sha256.
+    Carries the offending file name — never a numpy decode traceback."""
+
+    def __init__(self, file, reason):
+        super().__init__(f"checkpoint chunk {file!r} is corrupt: {reason}")
+        self.file = file
+        self.reason = reason
 
 
 def _count(name):
@@ -50,6 +76,11 @@ def _count(name):
         metric(name).inc()
     except Exception:  # noqa: BLE001
         pass
+
+# transient-IO retry for chunk/metadata writes: short, deterministic
+# backoff (writes happen inside the training step cadence)
+_IO_RETRY = RetryPolicy(max_attempts=3, base_delay=0.02, max_delay=0.2,
+                        seed=0)
 
 _async_tasks: list[threading.Thread] = []
 
@@ -105,19 +136,80 @@ def _global_chunks(arr):
     return groups
 
 
-def _chunk_file(owner_rank, key, chunk_key):
+def _chunk_file(seq, owner_rank, key, chunk_key):
     """Deterministic per-chunk file name — every host derives the same map
-    from (array name, bounds, owner) without communication."""
+    from (save seq, array name, bounds, owner) without communication. The
+    seq prefix keeps concurrent-with-crash saves from overwriting the
+    files the previous (complete) metadata references."""
     h = hashlib.sha1(f"{key}\x00{chunk_key}".encode()).hexdigest()[:16]
-    return f"r{owner_rank}_{h}.npy"
+    return f"s{seq}_r{owner_rank}_{h}.npy"
+
+
+def _next_save_seq(path):
+    """Previous metadata's save_seq + 1 (0 for a fresh dir). All hosts
+    read the same shared checkpoint dir, so all derive the same seq;
+    pre-seq checkpoints (no field) behave as seq 0."""
+    try:
+        with open(os.path.join(path, "metadata.json")) as f:
+            return int(json.load(f).get("save_seq", 0)) + 1
+    except (OSError, ValueError):
+        return 0
+
+
+def _sha256(data):
+    return hashlib.sha256(np.ascontiguousarray(data).tobytes()).hexdigest()
 
 
 def _atomic_write_npy(path, fname, data):
-    tmp = os.path.join(path, fname + ".tmp")
+    fault_point("ckpt.chunk_write", file=fname)
+    # pid-unique tmp: redundant same-step writers (each process of a CPU
+    # drill believes it is process 0 and owns the same chunks) must never
+    # interleave bytes in a shared tmp file; both replaces commit
+    # identical data
+    tmp = os.path.join(path, f"{fname}.{os.getpid()}.tmp")
     np.save(tmp, data, allow_pickle=False)
     # np.save appends .npy to names without it
     os.replace(tmp + ".npy" if not tmp.endswith(".npy") else tmp,
                os.path.join(path, fname))
+
+
+def _replace_metadata(path, meta):
+    tmp = os.path.join(path, f"metadata.json.{os.getpid()}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    # the kill-mid-save window: chunks are on disk, the previous
+    # metadata is still live until this replace commits the new save
+    fault_point("ckpt.metadata_replace")
+    os.replace(tmp, os.path.join(path, "metadata.json"))
+
+
+_SEQ_RE = re.compile(r"^s(\d+)_")
+
+
+def _gc_stale_chunks(path, meta):
+    """After a committed save, drop chunk files no metadata references:
+    old seqs' data and orphans of crashed saves. Files of the committed
+    seq and the one before are kept even when unreferenced — a redundant
+    concurrent writer (see _atomic_write_npy) one save behind may still
+    commit them, and deleting under it would leave its metadata dangling.
+    Best-effort — a failed unlink never fails the save."""
+    live = {c["file"] for a in meta["arrays"].values() for c in a["chunks"]}
+    keep_seq = int(meta.get("save_seq", 0)) - 1
+    try:
+        entries = os.listdir(path)
+    except OSError:
+        return
+    for fname in entries:
+        if fname in live or fname == "metadata.json":
+            continue
+        if fname.endswith(".npy") or fname.endswith(".tmp"):
+            m = _SEQ_RE.match(fname)
+            if m and int(m.group(1)) >= keep_seq:
+                continue
+            try:
+                os.unlink(os.path.join(path, fname))
+            except OSError:
+                pass
 
 
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
@@ -128,9 +220,12 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
     reference: checkpoint/save_state_dict.py:145.
     """
     _count("checkpoint_saves_total")
+    # a still-running async save must commit before its seq is read
+    _wait_async()
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
-    meta = {"version": 3, "arrays": {}}
+    seq = _next_save_seq(path)
+    meta = {"version": 4, "save_seq": seq, "arrays": {}}
     local_files = []  # (fname, np chunk)
     for k, v in state_dict.items():
         arr = _unwrap(v)
@@ -140,7 +235,8 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         meta["arrays"][k] = {
             "shape": list(arr.shape), "dtype": str(arr.dtype),
             "chunks": [{"bounds": [list(b) for b in info["bounds"]],
-                        "file": _chunk_file(info["owner_process"], k, ck)}
+                        "file": _chunk_file(seq, info["owner_process"], k,
+                                            ck)}
                        for ck, info in sorted(chunks.items())]}
         by_dev = {s.device.id: s for s in arr.addressable_shards}
         for ck, info in chunks.items():
@@ -150,16 +246,26 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                 data = np.asarray(arr)
             else:
                 data = np.asarray(by_dev[info["owner_device"]].data)
-            local_files.append((_chunk_file(rank, k, ck), data))
+            local_files.append((_chunk_file(seq, rank, k, ck), data))
 
     def write():
+        digests = {}
         for fname, data in local_files:
-            _atomic_write_npy(path, fname, data)
+            _IO_RETRY.call(_atomic_write_npy, path, fname, data,
+                           op="ckpt.chunk_write")
+            digests[fname] = _sha256(data)
         if rank == coordinator_rank:
-            tmp = os.path.join(path, "metadata.json.tmp")
-            with open(tmp, "w") as f:
-                json.dump(meta, f)
-            os.replace(tmp, os.path.join(path, "metadata.json"))
+            # integrity: record the sha256 of every chunk this process
+            # wrote (in the single-host regime that is every chunk;
+            # chunks owned by other hosts load unverified — see
+            # RESILIENCE.md)
+            for amesh in meta["arrays"].values():
+                for chunk in amesh["chunks"]:
+                    if chunk["file"] in digests:
+                        chunk["sha256"] = digests[chunk["file"]]
+            _IO_RETRY.call(_replace_metadata, path, meta,
+                           op="ckpt.metadata_replace")
+            _gc_stale_chunks(path, meta)
 
     if async_save:
         # non-daemon: interpreter shutdown joins it, so the checkpoint can
@@ -174,17 +280,38 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
 class _ShardFileCache:
     """Memory-maps chunk .npy files on demand: a loading host touches only
     the chunks overlapping its destination blocks, never whole shard files,
-    and nothing is unpickled."""
+    and nothing is unpickled. Each file is verified against its recorded
+    sha256 the first time it is opened; missing/truncated/corrupt files
+    raise CheckpointCorruptionError with the file name."""
 
-    def __init__(self, path):
+    def __init__(self, path, digests=None):
         self.path = path
+        self._digests = digests or {}
         self._files = {}
 
     def get(self, fname):
         if fname not in self._files:
-            self._files[fname] = np.load(
-                os.path.join(self.path, fname), mmap_mode="r",
-                allow_pickle=False)
+            try:
+                arr = np.load(os.path.join(self.path, fname), mmap_mode="r",
+                              allow_pickle=False)
+            except FileNotFoundError:
+                raise CheckpointCorruptionError(
+                    fname, "file is missing") from None
+            except (OSError, ValueError, EOFError) as e:
+                raise CheckpointCorruptionError(
+                    fname, f"unreadable ({e})") from None
+            expect = self._digests.get(fname)
+            if expect is not None:
+                try:
+                    got = _sha256(arr)
+                except (OSError, ValueError) as e:   # mmap read of a
+                    raise CheckpointCorruptionError(  # truncated tail
+                        fname, f"short read ({e})") from None
+                if got != expect:
+                    raise CheckpointCorruptionError(
+                        fname, f"sha256 mismatch (recorded {expect[:12]}…, "
+                        f"found {got[:12]}…)")
+            self._files[fname] = arr
         return self._files[fname]
 
 
@@ -224,12 +351,15 @@ def load_state_dict(state_dict, path, process_group=None,
     with open(os.path.join(path, "metadata.json")) as f:
         meta = json.load(f)
     version = meta.get("version")
-    if version != 3:
+    if version not in (3, 4):
         raise ValueError(
             f"checkpoint at {path} has format version {version}; this "
-            "loader reads version 3 (per-chunk .npy files). Re-save the "
+            "loader reads versions 3/4 (per-chunk .npy files). Re-save the "
             "checkpoint with the current save_state_dict.")
-    cache = _ShardFileCache(path)
+    digests = {chunk["file"]: chunk["sha256"]
+               for amesh in meta["arrays"].values()
+               for chunk in amesh["chunks"] if "sha256" in chunk}
+    cache = _ShardFileCache(path, digests)
     for k, v in state_dict.items():
         if k not in meta["arrays"]:
             raise KeyError(f"checkpoint missing key {k}")

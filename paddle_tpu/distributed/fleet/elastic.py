@@ -19,6 +19,9 @@ import os
 import threading
 import time
 
+from ...resilience.faults import fault_point
+from ...resilience.retry import RetryPolicy
+
 __all__ = ["ElasticStatus", "ElasticManager"]
 
 
@@ -53,7 +56,7 @@ class ElasticManager:
 
     def __init__(self, store, node_id=None, np_range=(1, 1),
                  heartbeat_interval=5.0, lease_ttl=None, on_change=None,
-                 max_restart=3):
+                 max_restart=3, retry_policy=None):
         self._store = store
         self.node_id = node_id or f"{os.uname().nodename}-{os.getpid()}"
         lo, hi = (np_range if isinstance(np_range, tuple)
@@ -67,6 +70,23 @@ class ElasticManager:
         self._stop = threading.Event()
         self._hb_thread = None
         self._registered = False
+        # transient store faults recover inside the lease budget: total
+        # retry time must stay well under the ttl so a surviving node's
+        # lease never expires while the store blips
+        self._retry = retry_policy or RetryPolicy(
+            max_attempts=3, base_delay=min(0.05, self._hb_interval / 10),
+            max_delay=self._hb_interval / 2, seed=0)
+        self._retry_lock = threading.Lock()
+
+    def _store_call(self, fn, *args, op, recovery_metric):
+        """Retried store op shared by the heartbeat and membership-watch
+        paths. Returns (ok, value); a recovery (success after >=1 retry)
+        is counted so silent flakiness shows up in the catalog."""
+        with self._retry_lock:
+            out = self._retry.call(fn, *args, op=op)
+            if self._retry.last_retries:
+                _count(recovery_metric)
+            return out
 
     # -- registry ------------------------------------------------------------
     def _key(self, node_id=None):
@@ -89,14 +109,18 @@ class ElasticManager:
             self._registered = False
 
     def _beat(self):
+        fault_point("elastic.heartbeat", node=self.node_id)
         lease = json.dumps({"t": time.time(), "pid": os.getpid()}).encode()
         self._store.set(self._key(), lease)
 
     def _load_index(self):
         try:
-            n = int(self._store.add("__elastic/nslots", 0))
-        except Exception:  # noqa: BLE001
-            return []
+            n = int(self._store_call(
+                self._store.add, "__elastic/nslots", 0,
+                op="elastic.watch", recovery_metric=
+                "elastic_watch_recoveries_total"))
+        except Exception:  # noqa: BLE001 — store down past the retry
+            return []      # budget: treat as empty, next poll retries
         seen, members = set(), []
         for slot in range(1, n + 1):
             key = f"__elastic/slot/{slot}"
@@ -104,9 +128,14 @@ class ElasticManager:
                 # check() first: get() blocks up to the store timeout on a
                 # missing key (e.g. a node died between slot allocation and
                 # the slot write), which would freeze every membership poll
-                if not self._store.check(key):
+                if not self._store_call(
+                        self._store.check, key, op="elastic.watch",
+                        recovery_metric="elastic_watch_recoveries_total"):
                     continue
-                nid = self._store.get(key).decode()
+                nid = self._store_call(
+                    self._store.get, key, op="elastic.watch",
+                    recovery_metric="elastic_watch_recoveries_total"
+                ).decode()
             except Exception:  # noqa: BLE001
                 continue
             if nid and nid not in seen:
@@ -120,9 +149,14 @@ class ElasticManager:
         alive = []
         for nid in self._load_index():
             try:
-                if not self._store.check(self._key(nid)):
+                if not self._store_call(
+                        self._store.check, self._key(nid),
+                        op="elastic.watch", recovery_metric=
+                        "elastic_watch_recoveries_total"):
                     continue
-                raw = self._store.get(self._key(nid))
+                raw = self._store_call(
+                    self._store.get, self._key(nid), op="elastic.watch",
+                    recovery_metric="elastic_watch_recoveries_total")
             except Exception:  # noqa: BLE001
                 continue
             if not raw:
@@ -153,9 +187,11 @@ class ElasticManager:
     def _hb_loop(self):
         while not self._stop.wait(self._hb_interval):
             try:
-                self._beat()
-            except Exception:  # noqa: BLE001 — store briefly unreachable
-                pass
+                self._store_call(
+                    self._beat, op="elastic.heartbeat",
+                    recovery_metric="elastic_heartbeat_recoveries_total")
+            except Exception:  # noqa: BLE001 — store down past the retry
+                pass           # budget: keep beating, the lease may survive
 
     # -- membership decisions ------------------------------------------------
     def health(self):

@@ -14,7 +14,10 @@ import ctypes
 import threading
 import time
 
-__all__ = ["Store", "TCPStore"]
+from ..resilience.faults import fault_point
+from ..resilience.retry import RetryPolicy
+
+__all__ = ["Store", "TCPStore", "ResilientStore"]
 
 
 class Store:
@@ -131,6 +134,7 @@ class TCPStore(Store):
         return self._port
 
     def set(self, key, value):
+        fault_point("store.set", key=key)
         if isinstance(value, str):
             value = value.encode()
         if not self._native:
@@ -144,6 +148,7 @@ class TCPStore(Store):
             raise RuntimeError(f"TCPStore.set({key!r}) failed")
 
     def get(self, key):
+        fault_point("store.get", key=key)
         if not self._native:
             return self._store.get(key, self._timeout_s)
         from .. import _native
@@ -224,3 +229,75 @@ class TCPStore(Store):
                     self._server = None
         except Exception:  # noqa: BLE001 — interpreter teardown
             pass
+
+
+class ResilientStore(Store):
+    """A Store whose ops survive transient failures: every call goes
+    through a RetryPolicy (jittered backoff) and, optionally, a
+    CircuitBreaker so a hard-down store fails fast instead of stalling
+    every caller for the full timeout ladder.
+
+        store = ResilientStore(TCPStore(...),
+                               policy=RetryPolicy(max_attempts=4))
+
+    Non-transient exceptions (anything outside policy.retry_on) pass
+    through untouched. `wait` is retried too — a server-side blocking
+    wait that dies from a connection blip is re-issued, not surfaced.
+    """
+
+    def __init__(self, store, policy=None, breaker=None):
+        self._inner = store
+        self._policy = policy or RetryPolicy()
+        self._breaker = breaker  # e.g. CircuitBreaker(op="store")
+
+    @property
+    def inner(self):
+        return self._inner
+
+    def __getattr__(self, name):
+        # drop-in wrapper: anything beyond the retried Store API (port,
+        # barrier state, ...) comes straight from the wrapped store
+        if name == "_inner":   # guard pre-__init__ probes from recursing
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+    def _call(self, op, fn, *args):
+        if self._breaker is not None:
+            return self._breaker.call(
+                self._policy.call, fn, *args, op=op)
+        return self._policy.call(fn, *args, op=op)
+
+    def set(self, key, value):
+        return self._call("store.set", self._inner.set, key, value)
+
+    def get(self, key):
+        return self._call("store.get", self._inner.get, key)
+
+    def add(self, key, amount):
+        return self._call("store.add", self._inner.add, key, amount)
+
+    def wait(self, key):
+        return self._call("store.wait", self._inner.wait, key)
+
+    def delete_key(self, key):
+        return self._call("store.delete", self._inner.delete_key, key)
+
+    def check(self, key):
+        return self._call("store.check", self._inner.check, key)
+
+    def num_keys(self):
+        return self._call("store.num_keys", self._inner.num_keys)
+
+    def barrier(self, tag="barrier"):
+        # the barrier protocol itself is add/set/wait on the inner store;
+        # route it through the wrapped ops so each leg is retried
+        rounds = getattr(self, "_barrier_rounds", None)
+        if rounds is None:
+            rounds = self._barrier_rounds = {}
+        r = rounds.get(tag, 0)
+        rounds[tag] = r + 1
+        ws = getattr(self._inner, "_world_size", 1)
+        count = self.add(f"__barrier/{tag}/{r}/count", 1)
+        if count == ws:
+            self.set(f"__barrier/{tag}/{r}/done", b"1")
+        self.wait(f"__barrier/{tag}/{r}/done")

@@ -61,6 +61,9 @@ def _apply_side_effect(name, value):
             enable()
         else:
             disable()
+    elif name == "fault_injection":
+        from ..resilience import faults
+        faults.arm_spec(value)   # "" disarms; bad specs raise here
 
 
 def get_flags(flags):
@@ -117,6 +120,7 @@ define_flag("prim_all", False, "ref FLAGS_prim_all: decompose big ops before aut
 define_flag("cinn_bucket_compile", False, "ref FLAGS_cinn_bucket_compile; XLA owns fusion (informational)")
 # profiler / debug
 define_flag("observability", False, "runtime observability layer (paddle_tpu.observability): metrics registry + span tracing + SLO telemetry; off = zero-cost no-op fast path")
+define_flag("fault_injection", "", "chaos harness spec (paddle_tpu.resilience.faults): 'site:nth:Exc' / 'site:rand(p)@seed:Exc' entries joined by ';'; '' = disarmed (one global load per site)")
 define_flag("enable_host_event_recorder_hook", False, "ref FLAGS_enable_host_event_recorder_hook: record host events in profiler")
 define_flag("call_stack_level", 1, "ref FLAGS_call_stack_level: error-message stack detail")
 define_flag("api_benchmark", False, "per-op wall-time logging in execute()")

@@ -17,9 +17,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .serving import ContinuousBatchingEngine, Request  # noqa: E402,F401
+from .serving import (  # noqa: E402,F401
+    BackpressureError, ContinuousBatchingEngine, Request)
 
-__all__ = ["ContinuousBatchingEngine", "Request",
+__all__ = ["ContinuousBatchingEngine", "Request", "BackpressureError",
            "Config", "Predictor", "Tensor", "create_predictor",
            "PrecisionType", "PlaceType", "get_version"]
 

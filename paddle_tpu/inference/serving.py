@@ -29,6 +29,7 @@ the largest bucket's decision for audit.
 from __future__ import annotations
 
 import time
+import warnings
 from collections import deque
 
 import numpy as np
@@ -40,18 +41,26 @@ from ..generation import _llama_layer_prefill, _rms, _rope
 from ..observability import span as _span
 from ..observability.catalog import metric as _metric
 from ..ops.paged_attention import paged_attention_decode, write_to_cache
+from ..resilience.faults import FaultInjected, fault_point
 
-__all__ = ["ContinuousBatchingEngine", "Request"]
+__all__ = ["ContinuousBatchingEngine", "Request", "BackpressureError"]
+
+
+class BackpressureError(RuntimeError):
+    """add_request refused: the admission queue is at max_queue. The
+    caller (gateway/load balancer) should retry later or route away —
+    that is the backpressure signal, instead of unbounded queueing."""
 
 
 class Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
                  "generated", "done", "do_sample", "temperature", "top_k",
-                 "top_p", "rng", "t_arrival")
+                 "top_p", "rng", "t_arrival", "deadline_s", "t_deadline",
+                 "finish_reason", "shed_count")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                 seed=None):
+                 seed=None, deadline_s=None):
         self.rid = rid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -66,6 +75,13 @@ class Request:
         # default; a fixed seed is the explicit-reproducibility opt-in
         self.rng = np.random.RandomState(seed)
         self.t_arrival = time.perf_counter()   # TTFT anchor
+        # degraded completions are distinguishable: finish_reason is one
+        # of eos / length / timeout / shed / rejected (None while live)
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self.t_deadline = (None if deadline_s is None
+                           else self.t_arrival + float(deadline_s))
+        self.finish_reason = None
+        self.shed_count = 0
 
     def choose(self, logits: np.ndarray) -> int:
         """Per-request next-token choice on the host (B is small; the
@@ -161,7 +177,8 @@ class ContinuousBatchingEngine:
 
     def __init__(self, model, num_blocks=256, block_size=16, max_batch=8,
                  max_blocks_per_seq=64,
-                 prefill_buckets=(64, 128, 256, 512, 1024)):
+                 prefill_buckets=(64, 128, 256, 512, 1024),
+                 max_queue=None, max_sheds=2):
         config = model.config
         self.cfg = dict(eps=config.rms_norm_eps, theta=config.rope_theta,
                         heads=config.num_attention_heads,
@@ -195,8 +212,17 @@ class ContinuousBatchingEngine:
             self.attention_route = route(
                 self.cfg["heads"], self.buckets[-1], self.buckets[-1],
                 self.cfg["head_dim"], self.embed_w.dtype, True)
-        except Exception:
+        except (ImportError, OSError, ValueError, KeyError) as e:
+            # audit-only probe: a missing/broken ledger must not stop the
+            # engine, but it is logged + counted, never silently nulled
             self.attention_route = None
+            warnings.warn(
+                f"serving attention-route probe failed ({e!r}); "
+                "per-bucket routing still happens at prefill trace time",
+                RuntimeWarning, stacklevel=2)
+            _metric("serving_route_probe_failures_total").inc()
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.max_sheds = int(max_sheds)
         self.lanes: list[Request | None] = [None] * self.max_batch
         self.lane_len = np.zeros(self.max_batch, np.int64)  # tokens in cache
         self.lane_tok = np.zeros(self.max_batch, np.int64)  # next to write
@@ -221,12 +247,21 @@ class ContinuousBatchingEngine:
     # --- public API -------------------------------------------------------
     def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
                     do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                    seed=0):
+                    seed=0, deadline_s=None):
+        """Queue a request. `deadline_s` is a per-request wall-clock
+        budget from arrival: once exceeded the request finishes with
+        whatever it has and finish_reason='timeout'. Raises
+        BackpressureError when the admission queue is at max_queue."""
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            _metric("serving_backpressure_total").inc()
+            raise BackpressureError(
+                f"admission queue full ({len(self.queue)}/{self.max_queue}); "
+                "retry later")
         rid = self._next_rid
         self._next_rid += 1
         self.queue.append(Request(rid, prompt, max_new_tokens, eos_token_id,
                                   do_sample, temperature, top_k, top_p,
-                                  seed))
+                                  seed, deadline_s))
         return rid
 
     def has_work(self):
@@ -243,12 +278,73 @@ class ContinuousBatchingEngine:
     # --- scheduling -------------------------------------------------------
     def step(self):
         with _span("serving.step"):
+            self._expire_deadlines()
             self._m_queue.set(len(self.queue))
             self._admit()
             self._decode_step()
             self._m_occ.set(sum(r is not None for r in self.lanes)
                             / self.max_batch)
             self._m_free.set(len(self.pool._free))
+
+    # --- graceful degradation --------------------------------------------
+    def _finish(self, req, reason):
+        req.done = True
+        req.finish_reason = reason
+        self.finished[req.rid] = req
+        _metric("serving_finished_total", reason=reason).inc()
+
+    def _retire_lane(self, lane, reason):
+        req = self.lanes[lane]
+        self.pool.release(req.rid)
+        self.lanes[lane] = None
+        self.lane_len[lane] = 0
+        self._m_retired.inc()
+        self._finish(req, reason)
+
+    def _expire_deadlines(self):
+        """Per-request deadlines: an expired queued request finishes
+        empty; an expired decoding lane finishes with the tokens it has
+        (a degraded-but-distinguishable completion) and its pool blocks
+        are released."""
+        now = time.perf_counter()
+        if any(r.t_deadline is not None and now >= r.t_deadline
+               for r in self.queue):
+            kept = deque()
+            for req in self.queue:
+                if req.t_deadline is not None and now >= req.t_deadline:
+                    _metric("serving_timeouts_total", where="queue").inc()
+                    self._finish(req, "timeout")
+                else:
+                    kept.append(req)
+            self.queue = kept
+        for lane, req in enumerate(self.lanes):
+            if (req is not None and req.t_deadline is not None
+                    and now >= req.t_deadline):
+                _metric("serving_timeouts_total", where="decode").inc()
+                self._retire_lane(lane, "timeout")
+
+    def _shed(self, active):
+        """Decode-step OOM: preempt the lane with the least work done
+        (fewest generated tokens), release its blocks, and requeue the
+        request at the FRONT of the queue for a fresh prefill. A request
+        shed more than max_sheds times finishes degraded
+        (finish_reason='shed') instead of thrashing the pool forever."""
+        victim = max(active,
+                     key=lambda i: (-len(self.lanes[i].generated), i))
+        req = self.lanes[victim]
+        self.pool.release(req.rid)
+        self.lanes[victim] = None
+        self.lane_len[victim] = 0
+        req.shed_count += 1
+        _metric("serving_shed_total").inc()
+        if req.shed_count > self.max_sheds:
+            self._m_retired.inc()
+            self._finish(req, "shed")
+            return
+        # restart from the prompt next admission: the KV blocks are gone,
+        # and greedy decode reproduces the same prefix deterministically
+        req.generated = []
+        self.queue.appendleft(req)
 
     def _admit(self):
         while self.queue:
@@ -262,15 +358,13 @@ class ContinuousBatchingEngine:
                 # cannot ever serve: reject with an empty result instead
                 # of crashing the engine mid-step
                 self.queue.popleft()
-                req.done = True
                 req.generated = []
-                self.finished[req.rid] = req
+                self._finish(req, "rejected")
                 _metric("serving_rejected_total", reason="oversized").inc()
                 continue
             if req.max_new_tokens <= 0:
                 self.queue.popleft()
-                req.done = True
-                self.finished[req.rid] = req
+                self._finish(req, "length")
                 continue
             # admit only if the WHOLE sequence fits: no mid-flight
             # eviction (the reference engine preempts; we keep the
@@ -281,6 +375,7 @@ class ContinuousBatchingEngine:
             self.queue.popleft()
             lane = free_lanes[0]
             try:
+                fault_point("serve.admit", rid=req.rid)
                 with _span("serving.prefill", rid=req.rid,
                            prompt=int(req.prompt.size)):
                     t0 = time.perf_counter()
@@ -302,6 +397,16 @@ class ContinuousBatchingEngine:
                 _metric("serving_deferred_total",
                         reason="pool_exhausted").inc()
                 return
+            except (TimeoutError, ConnectionError, OSError,
+                    FaultInjected):
+                # transient admission failure (store/IO blip or injected
+                # fault): same counted-deferral contract — requeued at
+                # the front, retried next step, scheduler stays alive
+                self.pool.release(req.rid)
+                self.queue.appendleft(req)
+                _metric("serving_deferred_total",
+                        reason="admit_fault").inc()
+                return
             self.lanes[lane] = req
             self.lane_len[lane] = req.prompt.size
             self.lane_tok[lane] = first_tok
@@ -313,14 +418,11 @@ class ContinuousBatchingEngine:
         req = self.lanes[lane]
         req.generated.append(int(token))
         self._m_tokens.inc()
-        if ((req.eos_token_id is not None and int(token) == req.eos_token_id)
-                or len(req.generated) >= req.max_new_tokens):
-            req.done = True
-            self.finished[req.rid] = req
-            self.pool.release(req.rid)
-            self.lanes[lane] = None
-            self.lane_len[lane] = 0
-            self._m_retired.inc()
+        if (req.eos_token_id is not None
+                and int(token) == req.eos_token_id):
+            self._retire_lane(lane, "eos")
+        elif len(req.generated) >= req.max_new_tokens:
+            self._retire_lane(lane, "length")
 
     # --- compiled programs ------------------------------------------------
     def _bucket(self, n):
@@ -369,13 +471,26 @@ class ContinuousBatchingEngine:
         if not active:
             return
         t0 = time.perf_counter()
-        with _span("serving.decode_step", active=len(active)):
-            self._decode_step_inner(active)
+        try:
+            with _span("serving.decode_step", active=len(active)):
+                self._decode_step_inner(active)
+        except MemoryError:
+            # device OOM (or the serve.decode_oom fault site): shed one
+            # lane and requeue it rather than killing every in-flight
+            # request; the remaining lanes decode on the next step
+            self._shed(active)
+            return
+        except Exception as e:  # noqa: BLE001 — XLA OOM is backend-typed
+            if "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e):
+                self._shed(active)
+                return
+            raise
         # one compiled step advances every active lane one token, so the
         # step wall time IS the per-token latency (TPOT)
         self._m_tpot.observe(time.perf_counter() - t0)
 
     def _decode_step_inner(self, active):
+        fault_point("serve.decode_oom", active=len(active))
         B = self.max_batch
         MB = self.max_blocks_per_seq
         # inactive lanes write into the pool's scratch block (their rows

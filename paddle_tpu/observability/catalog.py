@@ -56,6 +56,22 @@ CATALOG = {
         (), None),
     "serving_tokens_total": (
         "counter", "tokens emitted across all requests", (), None),
+    "serving_finished_total": (
+        "counter", "requests finished by finish_reason "
+        "(eos/length/timeout/shed/rejected) — degraded completions are "
+        "distinguishable", ("reason",), None),
+    "serving_timeouts_total": (
+        "counter", "per-request deadlines expired, by where the request "
+        "was (queue/decode)", ("where",), None),
+    "serving_shed_total": (
+        "counter", "decode-OOM lane sheds (request requeued for a fresh "
+        "prefill, or finished 'shed' past max_sheds)", (), None),
+    "serving_backpressure_total": (
+        "counter", "add_request refusals at max_queue (BackpressureError)",
+        (), None),
+    "serving_route_probe_failures_total": (
+        "counter", "audit attention-route probes that failed at engine "
+        "construction (logged, engine continues)", (), None),
 
     # -- generation (generation.py) -----------------------------------------
     "generation_requests_total": (
@@ -78,6 +94,12 @@ CATALOG = {
     "train_mfu": (
         "gauge", "online model-FLOPs utilization (needs flops_per_token "
         "and peak_flops)", (), None),
+    "train_nonfinite_skips_total": (
+        "counter", "batches skipped by the TrainSupervisor for a "
+        "non-finite loss", (), None),
+    "train_preemptions_total": (
+        "counter", "SIGTERM preemptions handled gracefully (final "
+        "checkpoint + clean exit)", (), None),
 
     # -- elastic / distributed recovery --------------------------------------
     "elastic_membership_changes_total": (
@@ -94,6 +116,25 @@ CATALOG = {
     "checkpoint_loads_total": (
         "counter", "distributed checkpoint load_state_dict calls (resume "
         "path after elastic restart)", (), None),
+    "elastic_heartbeat_recoveries_total": (
+        "counter", "heartbeat store writes that succeeded after >=1 retry "
+        "(transient store fault survived)", (), None),
+    "elastic_watch_recoveries_total": (
+        "counter", "membership-watch store reads that succeeded after "
+        ">=1 retry", (), None),
+
+    # -- resilience (paddle_tpu/resilience/: faults, retry) ------------------
+    "fault_injected_total": (
+        "counter", "faults fired by the injection harness, by site "
+        "(FLAGS_fault_injection / resilience.faults)", ("site",), None),
+    "resilience_retries_total": (
+        "counter", "transient-failure retries by RetryPolicy, by op",
+        ("op",), None),
+    "resilience_retry_giveups_total": (
+        "counter", "retry budgets exhausted (last error re-raised), by op",
+        ("op",), None),
+    "resilience_circuit_open_total": (
+        "counter", "circuit breakers tripping open, by op", ("op",), None),
 
     # -- bench orchestration (bench.py parent; stage = probe/configN/...) ----
     "bench_attempts_total": (

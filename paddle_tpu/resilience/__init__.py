@@ -1,0 +1,35 @@
+"""Failure handling as a first-class, testable subsystem.
+
+Three pieces, threaded through the distributed/serving runtime:
+
+* `faults` — a deterministic fault-injection harness over a closed
+  registry of named sites (`FLAGS_fault_injection`, or `arm()`/
+  `injected_faults(...)` in tests); disarmed it costs one global load
+  per site.
+* `retry` — `RetryPolicy` (jittered exponential backoff + attempt
+  budget + deadline) and `CircuitBreaker`, applied to store ops,
+  checkpoint IO, and the elastic heartbeat/membership watch.
+* `supervisor` — `TrainSupervisor` wrapping train-step callables with
+  non-finite-loss skip, SIGTERM preemption grace (final checkpoint +
+  clean exit), and checkpoint auto-resume.
+
+Fault sites, retry defaults, the preemption runbook, and the chaos-drill
+howto are documented in RESILIENCE.md; every fault, retry, and recovery
+increments a counter from the observability catalog (OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+from . import faults, retry, supervisor  # noqa: F401
+from .faults import (  # noqa: F401
+    FAULT_SITES, FaultInjected, FaultSpec, arm, arm_spec, check, disarm,
+    fault_point, injected_faults)
+from .retry import (  # noqa: F401
+    DEFAULT_TRANSIENT, CircuitBreaker, CircuitOpenError, RetryPolicy)
+from .supervisor import NonFiniteLossError, Preempted, TrainSupervisor  # noqa: F401
+
+__all__ = ["faults", "retry", "supervisor", "FAULT_SITES", "FaultSpec",
+           "FaultInjected", "fault_point", "check", "arm", "arm_spec",
+           "disarm", "injected_faults", "RetryPolicy", "CircuitBreaker",
+           "CircuitOpenError", "DEFAULT_TRANSIENT", "TrainSupervisor",
+           "NonFiniteLossError", "Preempted"]

@@ -22,3 +22,8 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: interpreter-heavy cases excluded from tier-1's "
         "-m 'not slow' run (full production shapes; run on demand)")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection drills (resilience subsystem). "
+        "Deterministic and fast, so they ride tier-1; select just them "
+        "with -m chaos, or exclude with -m 'not chaos' if a platform's "
+        "signal/timing semantics misbehave")

@@ -1,0 +1,624 @@
+"""Chaos harness + resilient runtime (paddle_tpu/resilience/).
+
+The drills ISSUE 3 pins: kill-mid-save checkpoint atomicity, chunk
+integrity (sha256 + CheckpointCorruptionError), retry/circuit-breaker
+behavior, elastic heartbeat/watch survival under store faults, the
+train supervisor (non-finite skip, SIGTERM preemption grace, resume),
+and serving graceful degradation (deadlines, backpressure, OOM shed).
+Everything is deterministic (seeded schedules, manual clocks), so the
+chaos marker rides tier-1.
+"""
+
+import glob
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.distributed.checkpoint import (CheckpointCorruptionError,
+                                               load_state_dict,
+                                               save_state_dict)
+from paddle_tpu.resilience import (CircuitBreaker, CircuitOpenError,
+                                   FaultInjected, NonFiniteLossError,
+                                   Preempted, RetryPolicy, TrainSupervisor,
+                                   faults)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture
+def enabled_obs():
+    obs.get_registry().reset()
+    obs.enable()
+    yield
+    obs.disable()
+
+
+@pytest.fixture(autouse=True)
+def disarmed():
+    """Every test starts and ends with the harness disarmed."""
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+# ---------------------------------------------------------------------------
+# fault harness
+# ---------------------------------------------------------------------------
+
+class TestFaultHarness:
+    def test_unknown_site_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            faults.parse_spec("not.a.site:1:OSError")
+        with pytest.raises(ValueError, match="exception class"):
+            faults.parse_spec("store.get:1:KeyboardInterrupt")
+        with pytest.raises(ValueError, match="malformed"):
+            faults.parse_spec("store.get:1")
+
+    def test_nth_hit_fires_exactly_once(self):
+        with faults.injected_faults("store.get:2:TimeoutError"):
+            faults.fault_point("store.get")            # hit 1: pass
+            with pytest.raises(TimeoutError, match="injected fault"):
+                faults.fault_point("store.get")        # hit 2: fire
+            faults.fault_point("store.get")            # hit 3: pass
+            assert faults.hit_counts() == {"store.get": 3}
+            assert faults.injected_counts() == {"store.get": 1}
+
+    def test_seeded_schedule_is_deterministic(self):
+        def run():
+            fired = []
+            with faults.injected_faults(
+                    "serve.admit:rand(0.5)@7:FaultInjected"):
+                for i in range(20):
+                    fired.append(faults.check("serve.admit"))
+            return fired
+
+        a, b = run(), run()
+        assert a == b and any(a) and not all(a)
+
+    def test_disarmed_is_noop(self):
+        for _ in range(5):
+            faults.fault_point("ckpt.chunk_write")
+        assert faults.hit_counts() == {}
+
+    def test_injections_counted_in_catalog(self, enabled_obs):
+        with faults.injected_faults("elastic.heartbeat:1:TimeoutError"):
+            with pytest.raises(TimeoutError):
+                faults.fault_point("elastic.heartbeat")
+        fam = obs.get_registry().get("fault_injected_total")
+        assert fam.labels(site="elastic.heartbeat").value == 1
+
+
+# ---------------------------------------------------------------------------
+# retry policy + circuit breaker
+# ---------------------------------------------------------------------------
+
+class TestRetryPolicy:
+    def _flaky(self, fail_times, exc=TimeoutError):
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] <= fail_times:
+                raise exc(f"boom {calls['n']}")
+            return "ok"
+
+        return fn, calls
+
+    def test_recovers_from_transient(self, enabled_obs):
+        sleeps = []
+        p = RetryPolicy(max_attempts=4, base_delay=0.01, seed=0,
+                        sleep=sleeps.append)
+        fn, calls = self._flaky(2)
+        assert p.call(fn, op="unit") == "ok"
+        assert calls["n"] == 3 and p.last_retries == 2
+        assert len(sleeps) == 2 and sleeps[1] > sleeps[0] * 1.2  # backoff
+        fam = obs.get_registry().get("resilience_retries_total")
+        assert fam.labels(op="unit").value == 2
+
+    def test_seeded_backoff_deterministic(self):
+        a = RetryPolicy(base_delay=0.1, jitter=0.5, seed=42)
+        b = RetryPolicy(base_delay=0.1, jitter=0.5, seed=42)
+        assert [a.backoff(i) for i in (1, 2, 3)] == \
+            [b.backoff(i) for i in (1, 2, 3)]
+
+    def test_budget_exhaustion_reraises(self, enabled_obs):
+        p = RetryPolicy(max_attempts=3, base_delay=0.001, sleep=lambda s: None)
+        fn, calls = self._flaky(99)
+        with pytest.raises(TimeoutError, match="boom 3"):
+            p.call(fn, op="unit")
+        assert calls["n"] == 3
+        fam = obs.get_registry().get("resilience_retry_giveups_total")
+        assert fam.labels(op="unit").value == 1
+
+    def test_deadline_stops_early(self):
+        clock = {"t": 0.0}
+        p = RetryPolicy(max_attempts=100, base_delay=1.0, jitter=0.0,
+                        deadline=2.5, sleep=lambda s: clock.__setitem__(
+                            "t", clock["t"] + s),
+                        clock=lambda: clock["t"])
+        fn, calls = self._flaky(99)
+        with pytest.raises(TimeoutError):
+            p.call(fn, op="unit")
+        assert calls["n"] == 2   # 1s + 2s backoff would pass the deadline
+
+    def test_nontransient_passes_through(self):
+        p = RetryPolicy(max_attempts=5, sleep=lambda s: None)
+        fn, calls = self._flaky(99, exc=ValueError)
+        with pytest.raises(ValueError):
+            p.call(fn, op="unit")
+        assert calls["n"] == 1   # no retry for logic errors
+
+
+class TestCircuitBreaker:
+    def test_open_halfopen_close_cycle(self, enabled_obs):
+        clock = {"t": 0.0}
+        cb = CircuitBreaker(failure_threshold=2, reset_timeout=10.0,
+                            clock=lambda: clock["t"], op="store")
+        boom = {"on": True}
+
+        def fn():
+            if boom["on"]:
+                raise TimeoutError("down")
+            return "ok"
+
+        for _ in range(2):
+            with pytest.raises(TimeoutError):
+                cb.call(fn)
+        assert cb.state == cb.OPEN
+        with pytest.raises(CircuitOpenError):
+            cb.call(fn)                        # fail fast, fn not called
+        clock["t"] = 11.0                      # past reset_timeout
+        boom["on"] = False
+        assert cb.call(fn) == "ok"             # half-open probe succeeds
+        assert cb.state == cb.CLOSED
+        fam = obs.get_registry().get("resilience_circuit_open_total")
+        assert fam.labels(op="store").value == 1
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: kill-mid-save atomicity + integrity
+# ---------------------------------------------------------------------------
+
+def _chunks(tmp_path):
+    return sorted(os.path.basename(f)
+                  for f in glob.glob(str(tmp_path / "*.npy")))
+
+
+class TestCheckpointAtomicity:
+    def test_kill_between_chunks_and_metadata_keeps_previous(self, tmp_path):
+        """The ISSUE drill: a save that dies between the chunk writes and
+        the metadata os.replace must leave the PREVIOUS complete
+        checkpoint loadable."""
+        v1 = {"w": jnp.full((4, 4), 1.0, jnp.float32),
+              "b": jnp.full((4,), 10.0, jnp.float32)}
+        save_state_dict(dict(v1), str(tmp_path))
+        v2 = {"w": jnp.full((4, 4), 2.0, jnp.float32),
+              "b": jnp.full((4,), 20.0, jnp.float32)}
+        with faults.injected_faults("ckpt.metadata_replace:1:RuntimeError"):
+            with pytest.raises(RuntimeError, match="injected fault"):
+                save_state_dict(dict(v2), str(tmp_path))
+        target = {"w": jnp.zeros((4, 4), jnp.float32),
+                  "b": jnp.zeros((4,), jnp.float32)}
+        load_state_dict(target, str(tmp_path))
+        np.testing.assert_array_equal(np.asarray(target["w"]),
+                                      np.asarray(v1["w"]))
+        np.testing.assert_array_equal(np.asarray(target["b"]),
+                                      np.asarray(v1["b"]))
+
+    def test_transient_chunk_write_fault_is_retried(self, tmp_path):
+        with faults.injected_faults("ckpt.chunk_write:1:OSError"):
+            save_state_dict({"w": jnp.arange(8.0)}, str(tmp_path))
+            assert faults.injected_counts() == {"ckpt.chunk_write": 1}
+        target = {"w": jnp.zeros((8,), jnp.float32)}
+        load_state_dict(target, str(tmp_path))
+        np.testing.assert_array_equal(np.asarray(target["w"]),
+                                      np.arange(8.0, dtype=np.float32))
+
+    def test_saves_garbage_collect_stale_seqs_with_grace(self, tmp_path):
+        """Old seqs are collected one save late: the committed seq and
+        its predecessor are kept (a redundant concurrent writer may
+        still commit the previous seq), everything older goes."""
+        for i in range(3):
+            save_state_dict({"w": jnp.full((4,), float(i))}, str(tmp_path))
+        files = _chunks(tmp_path)
+        assert files and not any(f.startswith("s0_") for f in files)
+        assert any(f.startswith("s2_") for f in files)   # committed seq
+        meta = json.load(open(tmp_path / "metadata.json"))
+        assert meta["save_seq"] == 2 and meta["version"] == 4
+        target = {"w": jnp.zeros((4,), jnp.float32)}
+        load_state_dict(target, str(tmp_path))
+        assert float(np.asarray(target["w"])[0]) == 2.0
+
+
+class TestCheckpointIntegrity:
+    def _save_one(self, tmp_path):
+        save_state_dict({"w": jnp.arange(16.0).reshape(4, 4)},
+                        str(tmp_path))
+        files = _chunks(tmp_path)
+        assert len(files) == 1
+        meta = json.load(open(tmp_path / "metadata.json"))
+        chunk = meta["arrays"]["w"]["chunks"][0]
+        assert len(chunk["sha256"]) == 64   # recorded at save
+        return tmp_path / files[0]
+
+    def test_bitflip_raises_named_corruption_error(self, tmp_path):
+        f = self._save_one(tmp_path)
+        raw = bytearray(f.read_bytes())
+        raw[-1] ^= 0xFF                      # flip a data byte
+        f.write_bytes(bytes(raw))
+        with pytest.raises(CheckpointCorruptionError,
+                           match="sha256 mismatch") as ei:
+            load_state_dict({"w": jnp.zeros((4, 4))}, str(tmp_path))
+        assert os.path.basename(str(f)) in str(ei.value)
+
+    def test_truncation_raises_named_corruption_error(self, tmp_path):
+        f = self._save_one(tmp_path)
+        f.write_bytes(f.read_bytes()[:40])   # cut into the header/data
+        with pytest.raises(CheckpointCorruptionError) as ei:
+            load_state_dict({"w": jnp.zeros((4, 4))}, str(tmp_path))
+        assert os.path.basename(str(f)) in str(ei.value)
+
+    def test_missing_chunk_raises_named_corruption_error(self, tmp_path):
+        f = self._save_one(tmp_path)
+        os.unlink(f)
+        with pytest.raises(CheckpointCorruptionError, match="missing"):
+            load_state_dict({"w": jnp.zeros((4, 4))}, str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# elastic: heartbeat + watch survive transient store faults
+# ---------------------------------------------------------------------------
+
+class _MemStore:
+    def __init__(self):
+        self.d = {}
+
+    def add(self, k, n):
+        self.d[k] = int(self.d.get(k, 0)) + n
+        return self.d[k]
+
+    def set(self, k, v):
+        faults.fault_point("store.set", key=k)
+        self.d[k] = v
+
+    def get(self, k):
+        faults.fault_point("store.get", key=k)
+        return self.d[k]
+
+    def check(self, k):
+        return k in self.d
+
+
+class TestElasticResilience:
+    def _manager(self, store):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        return ElasticManager(
+            store, node_id="n0", np_range=(1, 2), heartbeat_interval=0.2,
+            retry_policy=RetryPolicy(max_attempts=3, base_delay=0.001,
+                                     seed=0, sleep=lambda s: None))
+
+    def test_heartbeat_recovers_and_is_counted(self, enabled_obs):
+        em = self._manager(_MemStore())
+        em.register()
+        with faults.injected_faults("elastic.heartbeat:1:TimeoutError"):
+            em._store_call(em._beat, op="elastic.heartbeat",
+                           recovery_metric=
+                           "elastic_heartbeat_recoveries_total")
+        assert em.alive_nodes() == ["n0"]      # lease landed despite fault
+        reg = obs.get_registry()
+        assert reg.get("elastic_heartbeat_recoveries_total").value == 1
+        assert reg.get("resilience_retries_total").labels(
+            op="elastic.heartbeat").value == 1
+
+    def test_watch_survives_store_get_faults(self, enabled_obs):
+        em = self._manager(_MemStore())
+        em.register()
+        with faults.injected_faults("store.get:1:TimeoutError"):
+            alive = em.alive_nodes()           # first get retried inside
+        assert alive == ["n0"]
+        assert obs.get_registry().get(
+            "elastic_watch_recoveries_total").value >= 1
+
+    def test_hb_thread_survives_persistent_store_outage(self):
+        em = self._manager(_MemStore())
+        em.register()
+        em.start()
+        try:
+            with faults.injected_faults("elastic.heartbeat:rand(1.0)@0:"
+                                        "TimeoutError"):
+                time.sleep(0.5)                # several beats, all failing
+                assert em._hb_thread.is_alive()
+            time.sleep(0.3)                    # store back: beats resume
+            assert em.alive_nodes() == ["n0"]
+        finally:
+            em.stop()
+
+
+# ---------------------------------------------------------------------------
+# train supervisor
+# ---------------------------------------------------------------------------
+
+class TestTrainSupervisor:
+    def test_nonfinite_skip_counts_and_continues(self, enabled_obs):
+        losses = iter([1.0, float("nan"), 0.8, float("inf"), 0.6])
+        sup = TrainSupervisor(lambda: next(losses))
+        out = [sup.step() for _ in range(5)]
+        assert out == [1.0, None, 0.8, None, 0.6]
+        assert sup.step_count == 3 and sup.nonfinite_skips == 2
+        assert obs.get_registry().get(
+            "train_nonfinite_skips_total").value == 2
+
+    def test_consecutive_nonfinite_raises_typed(self):
+        sup = TrainSupervisor(lambda: float("nan"),
+                              max_consecutive_nonfinite=2)
+        assert sup.step() is None
+        assert sup.step() is None
+        with pytest.raises(NonFiniteLossError, match="consecutive"):
+            sup.step()
+
+    def test_restore_fn_rolls_back_on_nonfinite(self):
+        restored = []
+        losses = iter([1.0, float("nan"), 0.5])
+        sup = TrainSupervisor(lambda: next(losses),
+                              restore_fn=lambda: restored.append(True))
+        sup.step(), sup.step(), sup.step()
+        assert restored == [True]
+
+    def test_injected_nonfinite_site(self, enabled_obs):
+        sup = TrainSupervisor(lambda: 1.0)
+        with faults.injected_faults(
+                "train.step_nonfinite:2:FaultInjected"):
+            assert sup.step() == 1.0
+            assert sup.step() is None          # harness forced a NaN
+            assert sup.step() == 1.0
+        assert sup.nonfinite_skips == 1
+
+    def test_preemption_saves_final_ckpt_and_exits_clean(self, enabled_obs):
+        saves = []
+        sup = TrainSupervisor(lambda: 1.0, save_fn=saves.append)
+        sup.step()
+        sup.step()
+        sup.request_preemption()
+        with pytest.raises(Preempted) as ei:
+            sup.step()
+        assert isinstance(ei.value, SystemExit) and ei.value.code == 0
+        assert ei.value.step == 2 and saves == [2]
+        assert obs.get_registry().get("train_preemptions_total").value == 1
+
+    def test_sigterm_triggers_grace_window(self):
+        saves = []
+        sup = TrainSupervisor(lambda: 1.0, save_fn=saves.append)
+        sup.install_signal_handlers()
+        try:
+            sup.step()
+            os.kill(os.getpid(), signal.SIGTERM)
+            with pytest.raises(Preempted):
+                sup.step()
+            assert saves == [1]
+        finally:
+            sup.restore_signal_handlers()
+
+    def test_resume_and_checkpoint_cadence(self):
+        saves = []
+        sup = TrainSupervisor(lambda: 1.0, save_fn=saves.append,
+                              load_fn=lambda: 4, checkpoint_every=2)
+        assert sup.resume() == 4
+        for _ in range(4):
+            sup.step()
+        assert sup.step_count == 8 and saves == [6, 8]
+
+    def test_end_to_end_preempt_then_resume_loss_continuity(self, tmp_path):
+        """Supervised toy training: preempt mid-run, resume from the
+        final checkpoint, and the spliced loss curve equals an
+        uninterrupted run's."""
+        def make(run_dir):
+            rng = np.random.RandomState(0)
+            X = rng.randn(8, 4).astype(np.float32)
+            Y = (X @ rng.randn(4, 1).astype(np.float32))
+            paddle.seed(0)
+            model = paddle.nn.Sequential(paddle.nn.Linear(4, 8),
+                                         paddle.nn.Tanh(),
+                                         paddle.nn.Linear(8, 1))
+            opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+
+            def step_fn():
+                loss = ((model(paddle.to_tensor(X))
+                         - paddle.to_tensor(Y)) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return float(loss.numpy())
+
+            def save_fn(step):
+                sd = model.state_dict()
+                sd["__step__"] = jnp.asarray(step, jnp.int32)
+                save_state_dict(sd, str(run_dir))
+
+            def load_fn():
+                if not os.path.exists(os.path.join(run_dir,
+                                                   "metadata.json")):
+                    return None
+                sd = model.state_dict()
+                sd["__step__"] = jnp.zeros((), jnp.int32)
+                load_state_dict(sd, str(run_dir))
+                return int(sd["__step__"])
+
+            return TrainSupervisor(step_fn, save_fn=save_fn,
+                                   load_fn=load_fn, checkpoint_every=1)
+
+        ref_dir = tmp_path / "ref"
+        sup = make(ref_dir)
+        reference = [sup.step() for _ in range(6)]
+
+        run_dir = tmp_path / "run"
+        sup1 = make(run_dir)
+        assert sup1.resume() == 0
+        spliced = [sup1.step() for _ in range(3)]
+        sup1.request_preemption()
+        with pytest.raises(Preempted) as ei:
+            sup1.step()
+        assert ei.value.step == 3
+        sup2 = make(run_dir)                    # the restarted worker
+        assert sup2.resume() == 3
+        spliced += [sup2.step() for _ in range(3)]
+        np.testing.assert_allclose(spliced, reference, rtol=1e-5,
+                                   atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# serving graceful degradation
+# ---------------------------------------------------------------------------
+
+def _tiny_model():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=256)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+def _dense_ref(model, prompt, n):
+    from paddle_tpu.generation import generate
+    ids = paddle.to_tensor(np.asarray(prompt, np.int32)[None])
+    out = generate(model, ids, max_new_tokens=n, do_sample=False)
+    return np.asarray(out._data)[0, len(prompt):].tolist()
+
+
+class TestServingDegradation:
+    def _engine(self, model, **kw):
+        from paddle_tpu.inference import ContinuousBatchingEngine
+        kw.setdefault("num_blocks", 64)
+        kw.setdefault("block_size", 8)
+        kw.setdefault("max_batch", 2)
+        kw.setdefault("prefill_buckets", (16,))
+        return ContinuousBatchingEngine(model, **kw)
+
+    def test_decode_deadline_expiry_releases_lanes(self, enabled_obs):
+        model = _tiny_model()
+        eng = self._engine(model)
+        free0 = len(eng.pool._free)
+        rid = eng.add_request(np.arange(7) % 128, max_new_tokens=50,
+                              deadline_s=3600.0)
+        eng.step()                              # admitted, decoding
+        req = eng.lanes[[r is not None for r in eng.lanes].index(True)]
+        assert req.rid == rid
+        req.t_deadline = time.perf_counter() - 1.0   # force expiry
+        eng.step()
+        assert rid in eng.finished
+        assert eng.finished[rid].finish_reason == "timeout"
+        assert len(eng.finished[rid].generated) >= 1   # degraded, not empty
+        assert eng.pool.tables == {}            # blocks released
+        assert len(eng.pool._free) == free0
+        assert not eng.has_work()
+        reg = obs.get_registry()
+        assert reg.get("serving_timeouts_total").labels(
+            where="decode").value == 1
+        assert reg.get("serving_finished_total").labels(
+            reason="timeout").value == 1
+
+    def test_queued_deadline_expiry(self, enabled_obs):
+        model = _tiny_model()
+        eng = self._engine(model, max_batch=1)
+        r1 = eng.add_request(np.arange(7) % 128, max_new_tokens=10)
+        r2 = eng.add_request(np.arange(5) % 128, max_new_tokens=10,
+                             deadline_s=3600.0)
+        eng.step()                              # r1 takes the only lane
+        assert len(eng.queue) == 1
+        eng.queue[0].t_deadline = time.perf_counter() - 1.0
+        out = eng.run()
+        assert out[r2] == [] and eng.finished[r2].finish_reason == "timeout"
+        assert eng.finished[r1].finish_reason == "length"
+        assert obs.get_registry().get("serving_timeouts_total").labels(
+            where="queue").value == 1
+
+    def test_backpressure_at_max_queue(self, enabled_obs):
+        from paddle_tpu.inference import BackpressureError
+        model = _tiny_model()
+        eng = self._engine(model, max_queue=1)
+        eng.add_request(np.arange(5) % 128, max_new_tokens=3)
+        with pytest.raises(BackpressureError, match="queue full"):
+            eng.add_request(np.arange(5) % 128, max_new_tokens=3)
+        assert obs.get_registry().get(
+            "serving_backpressure_total").value == 1
+        out = eng.run()                         # first request unaffected
+        assert len(out) == 1
+
+    def test_oom_shed_requeues_and_completes(self, enabled_obs):
+        model = _tiny_model()
+        eng = self._engine(model)
+        p = (np.arange(7) * 3) % 128
+        rid = eng.add_request(p, max_new_tokens=6)
+        with faults.injected_faults("serve.decode_oom:1:MemoryError"):
+            out = eng.run()
+        assert out[rid] == _dense_ref(model, p, 6)   # full completion
+        assert eng.finished[rid].shed_count == 1
+        assert eng.finished[rid].finish_reason == "length"
+        assert eng.pool.tables == {}
+        assert obs.get_registry().get("serving_shed_total").value == 1
+
+    def test_shed_past_max_sheds_finishes_degraded(self, enabled_obs):
+        model = _tiny_model()
+        eng = self._engine(model, max_sheds=0)
+        rid = eng.add_request(np.arange(7) % 128, max_new_tokens=6)
+        with faults.injected_faults("serve.decode_oom:1:MemoryError"):
+            out = eng.run()
+        # degraded + distinguishable: partial tokens kept, reason='shed'
+        assert eng.finished[rid].finish_reason == "shed"
+        assert 1 <= len(out[rid]) < 6
+        assert eng.pool.tables == {}
+        assert obs.get_registry().get("serving_finished_total").labels(
+            reason="shed").value == 1
+
+    def test_admit_fault_defers_then_completes(self, enabled_obs):
+        model = _tiny_model()
+        eng = self._engine(model)
+        p = np.arange(6) % 128
+        rid = eng.add_request(p, max_new_tokens=5)
+        with faults.injected_faults("serve.admit:1:TimeoutError"):
+            eng.step()                          # admission fault: deferred
+            assert len(eng.queue) == 1 and rid not in eng.finished
+            out = eng.run()                     # retried next step
+        assert out[rid] == _dense_ref(model, p, 5)
+        assert obs.get_registry().get("serving_deferred_total").labels(
+            reason="admit_fault").value == 1
+
+    def test_finish_reason_eos_and_length(self):
+        model = _tiny_model()
+        eng = self._engine(model)
+        p = np.arange(5) % 128
+        ref = _dense_ref(model, p, 10)
+        r_len = eng.add_request(p, max_new_tokens=3)
+        eng.run()
+        assert eng.finished[r_len].finish_reason == "length"
+        eng2 = self._engine(model)
+        r_eos = eng2.add_request(p, max_new_tokens=10, eos_token_id=ref[2])
+        eng2.run()
+        assert eng2.finished[r_eos].finish_reason == "eos"
+
+    def test_zero_escapes_under_mixed_injection(self, enabled_obs):
+        """Acceptance drill (scaled down): seeded faults across admission
+        and decode; every request either completes or finishes with a
+        typed reason, the engine never raises, and all blocks drain."""
+        model = _tiny_model()
+        eng = self._engine(model, max_batch=4, num_blocks=64)
+        rs = np.random.RandomState(0)
+        rids = [eng.add_request(rs.randint(0, 128, (5 + i,)),
+                                max_new_tokens=4) for i in range(4)]
+        spec = ("serve.admit:2:TimeoutError;"
+                "serve.decode_oom:3:MemoryError")
+        with faults.injected_faults(spec):
+            out = eng.run()
+        assert sorted(out) == sorted(rids)
+        for rid in rids:
+            assert eng.finished[rid].finish_reason in (
+                "eos", "length", "timeout", "shed")
+        assert eng.pool.tables == {} and not eng.has_work()

@@ -84,6 +84,22 @@ def _loss_chunk_mb_for(name):
     return 1100 if name == "llama_535m" else 256
 
 
+def _pir_cache_stats():
+    """PIR persistent compile-cache counters (hit/miss/write/corrupt/
+    evict) — process-local, metrics-independent; rows record the delta
+    per config so the compile-cost trajectory is tracked alongside MFU."""
+    try:
+        from paddle_tpu.pir import stats_snapshot
+        return stats_snapshot()
+    except Exception:  # noqa: BLE001 — bench rows must not sink on pir
+        return {}
+
+
+def _pir_cache_delta(before, after):
+    return {k: after.get(k, 0) - before.get(k, 0)
+            for k in after if after.get(k, 0) != before.get(k, 0)}
+
+
 def _run_one(cfg, batch, seq, steps, remat, on_tpu, remat_policy=None,
              loss_chunk_mb=256, run_name="llama"):
     """One config: scan-over-layers train step (HLO size O(1) in depth, so
@@ -139,6 +155,8 @@ def _run_one(cfg, batch, seq, steps, remat, on_tpu, remat_policy=None,
         except Exception:
             bwd_mode_used = "auto:?"
     jstep = jax.jit(train_step, donate_argnums=(0, 1))
+    cache_before = _pir_cache_stats()
+    t_cold = time.perf_counter()
     try:
         run = jstep.lower(params, opt_state, ids, ids, lr,
                           jnp.int32(1)).compile()
@@ -149,13 +167,18 @@ def _run_one(cfg, batch, seq, steps, remat, on_tpu, remat_policy=None,
     except Exception:
         run = jstep  # AOT compile failed: fall back to jit dispatch
 
-    # warmup (settle allocator / first dispatch)
+    # warmup (settle allocator / first dispatch); the first call closes
+    # the cold-compile window, the second is the warm reference — the
+    # cold-vs-warm gap IS the compile cost this config pays at startup
     loss, params, opt_state = run(params, opt_state, ids, ids, lr,
                                   jnp.int32(1))
     _ = float(loss)
+    compile_cold_s = time.perf_counter() - t_cold
+    t_warm = time.perf_counter()
     loss, params, opt_state = run(params, opt_state, ids, ids, lr,
                                   jnp.int32(2))
     _ = float(loss)
+    compile_warm_s = time.perf_counter() - t_warm
 
     t0 = time.perf_counter()
     for i in range(steps):
@@ -177,7 +200,11 @@ def _run_one(cfg, batch, seq, steps, remat, on_tpu, remat_policy=None,
     return {"tokens_per_s": tokens / dt, "n_params": n_params, "loss": final,
             "attention_bwd_used": bwd_mode_used,
             "lm_loss_path": loss_fn.lm_loss_path,  # set when traced
-            "step_time_s": dt / steps, "xla_flops_per_step": xla_flops}
+            "step_time_s": dt / steps, "xla_flops_per_step": xla_flops,
+            "compile_cold_s": round(compile_cold_s, 3),
+            "compile_warm_s": round(compile_warm_s, 3),
+            "compile_cache": _pir_cache_delta(cache_before,
+                                              _pir_cache_stats())}
 
 
 def _functional_train_setup(model, opt, to_bf16):
@@ -403,7 +430,15 @@ def _bench_decode(on_tpu):
         for r_i in range(n_req):
             eng.add_request(rng.randint(0, cfg.vocab_size, (prompt,)),
                             max_new_tokens=new)
+        cache_before = _pir_cache_stats()
+        t_cold = time.perf_counter()
         eng.step()  # compile prefill + decode outside the timed region
+        out["engine_compile_cold_s"] = round(time.perf_counter() - t_cold, 3)
+        out["engine_compile_cache"] = _pir_cache_delta(cache_before,
+                                                       _pir_cache_stats())
+        out["engine_compile"] = {
+            k: getattr(r, "cache", None)
+            for k, r in eng.compile_reports.items() if r is not None}
         pre_tokens = sum(len(r.generated) for r in eng.finished.values())
         pre_tokens += sum(len(r.generated) for r in eng.lanes
                           if r is not None)

@@ -53,6 +53,7 @@ from . import metric  # noqa: F401
 from . import vision  # noqa: F401
 from . import distributed  # noqa: F401
 from . import incubate  # noqa: F401
+from . import pir  # noqa: F401  (PIR-lite compiler layer; ref: paddle.pir)
 from . import static  # noqa: F401
 from . import device  # noqa: F401
 from . import distribution  # noqa: F401

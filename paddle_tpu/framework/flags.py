@@ -115,6 +115,12 @@ define_flag("retain_grad_for_all_tensor", False, "ref FLAGS_retain_grad_for_all_
 define_flag("use_stride_kernel", False, "ref FLAGS_use_stride_kernel; XLA has no stride kernels (informational)")
 define_flag("jit_cache_dir", "", "persistent XLA compilation cache directory ('' = off)")
 define_flag("jit_donate_buffers", True, "donate param/opt buffers in compiled train steps")
+# PIR-lite compiler layer (paddle_tpu/pir/; ref: paddle/pir + FLAGS_enable_pir_api)
+define_flag("pir", True, "route to_static/serving compilation through the PIR pass pipeline (ref FLAGS_enable_pir_api); off = plain jax.jit")
+define_flag("pir_passes", "fold,cse,pattern,dce", "ordered comma list of PIR passes to run (registered: dce,fold,cse,pattern); each individually toggleable by omission")
+define_flag("compile_cache_dir", "", "persistent PIR compile-cache directory ('' = off): sha256-verified StableHLO artifacts keyed by canonical IR hash + sharding + flags + jax version")
+define_flag("compile_cache_max_bytes", 1 << 28, "PIR compile-cache size cap; least-recently-read artifacts are evicted past it")
+define_flag("jit_signature_cache_size", 64, "max compiled input signatures kept per StaticFunction (LRU); shape churn past it shows up in jit_retrace_total")
 define_flag("pipeline_schedule", "FThenB", "default pipeline schedule: FThenB|1F1B")
 define_flag("prim_all", False, "ref FLAGS_prim_all: decompose big ops before autodiff (jax does this inherently; informational)")
 define_flag("cinn_bucket_compile", False, "ref FLAGS_cinn_bucket_compile; XLA owns fusion (informational)")

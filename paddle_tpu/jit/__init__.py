@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework import core as _core
+from ..framework import flags as _flags
 from ..framework import random as _random
 from ..framework.core import Tensor, Parameter, execute
 
@@ -64,11 +65,26 @@ class StaticFunction:
         self._layer = layer
         self._input_spec = input_spec
         self._full_graph = full_graph
-        self._cache: dict[Any, tuple] = {}
+        self._cache: dict[Any, tuple] = {}   # LRU: insertion == recency
         self._fallback_keys: set = set()
         self._staged_jit_cache: dict = {}   # compiled break segments
         self._last_segments = 0
+        self._ir_program = None             # last captured pir.Program
+        self._last_report = None            # last pir CompileReport
         functools.wraps(fn)(self)
+
+    @property
+    def ir_program(self):
+        """The pir.Program of the most recent trace (None when the PIR
+        pipeline is disabled or fell back) — `print(sf.ir_program)` is
+        the reference's Program.__str__ parity surface."""
+        return self._ir_program
+
+    @property
+    def last_report(self):
+        """pir.CompileReport of the most recent trace: cache hit/miss,
+        per-pass edits, pattern counts."""
+        return self._last_report
 
     # -- discovery ----------------------------------------------------------
     def _state_tensors(self):
@@ -109,8 +125,14 @@ class StaticFunction:
             # computation (the SOT partial-graph analog; framework/staging.py)
             return self._run_staged(args, kwargs)
         entry = self._cache.get(key)
+        if entry is not None:
+            # LRU touch: re-insert so eviction drops the coldest signature
+            self._cache.pop(key)
+            self._cache[key] = entry
         if entry is None:
             try:
+                from ..observability.catalog import metric as _metric
+                _metric("jit_retrace_total").inc()
                 entry = self._trace(treedef, flat_args, tensor_idx, params,
                                     bufs)
             except jax.errors.ConcretizationTypeError as e:
@@ -132,6 +154,12 @@ class StaticFunction:
                 self._fallback_keys.add(key)
                 return self._run_staged(args, kwargs)
             self._cache[key] = entry
+            # size-capped signature cache: unbounded retrace/recompile on
+            # shape churn was silent; now the coldest signature is evicted
+            # and every fresh trace shows in jit_retrace_total
+            cap = _flags.flag_value("jit_signature_cache_size")
+            while cap and len(self._cache) > cap:
+                self._cache.pop(next(iter(self._cache)))
         jitted, out_rebuild, mutated = entry
 
         p_arrays = [p._data for p in params]
@@ -213,15 +241,24 @@ class StaticFunction:
                     t._node = node
                     t.stop_gradient = sg
 
-        jitted = jax.jit(pure, static_argnums=())
-
-        # force trace now to learn output structure
         p_arrays = [p._data for p in trainable]
         f_arrays = [p._data for p in frozen_params]
         b_arrays = [b._data for b in bufs]
         in_arrays = [flat_args[i]._data for i in tensor_idx]
-        _ = jax.eval_shape(pure, p_arrays, f_arrays, b_arrays,
-                           jax.random.key(0), *in_arrays)
+
+        jitted = None
+        if _flags.flag_value("pir"):
+            # PIR pipeline: capture -> passes (DCE/fold/CSE/DRR patterns)
+            # -> persistent compile cache consulted pre-XLA. The capture
+            # trace populates out_struct; any pipeline failure degrades
+            # back to the plain jax.jit path below.
+            jitted = self._trace_pir(pure, p_arrays, f_arrays, b_arrays,
+                                     in_arrays)
+        if jitted is None:
+            jitted = jax.jit(pure, static_argnums=())
+            # force trace now to learn output structure
+            _ = jax.eval_shape(pure, p_arrays, f_arrays, b_arrays,
+                               jax.random.key(0), *in_arrays)
 
         out_tree = out_struct["tree"]
         mutated = out_struct["mutated"]
@@ -230,6 +267,53 @@ class StaticFunction:
             return jax.tree_util.tree_unflatten(out_tree, user_out_tensors)
 
         return jitted, rebuild, mutated
+
+    def _trace_pir(self, pure, p_arrays, f_arrays, b_arrays, in_arrays):
+        """Compile `pure` through paddle_tpu.pir (pipeline + persistent
+        cache). Returns a callable with the plain-jit calling convention
+        or None to use the plain path. ConcretizationTypeError
+        propagates untouched — the graph-break contract stays with
+        __call__."""
+        import jax.random as jrandom
+        n_tr, n_fr, n_b = len(p_arrays), len(f_arrays), len(b_arrays)
+        k_idx = n_tr + n_fr + n_b
+        kd0 = jrandom.key_data(jrandom.key(0))
+
+        def flat_fn(*flat):
+            return pure(list(flat[:n_tr]), list(flat[n_tr:n_tr + n_fr]),
+                        list(flat[n_tr + n_fr:k_idx]),
+                        jrandom.wrap_key_data(flat[k_idx]),
+                        *flat[k_idx + 1:])
+
+        try:
+            from .. import pir as _pir
+            compiled, report = _pir.compile_flat(
+                flat_fn, [*p_arrays, *f_arrays, *b_arrays, kd0, *in_arrays],
+                name=getattr(self._fn, "__name__", "to_static"))
+        except jax.errors.ConcretizationTypeError:
+            raise
+        except Exception as e:  # noqa: BLE001 — degrade to plain jax.jit
+            import warnings
+            warnings.warn(f"to_static: PIR pipeline unavailable "
+                          f"({e!r}); compiling with plain jax.jit",
+                          RuntimeWarning, stacklevel=3)
+            return None
+        self._last_report = report
+        self._ir_program = report.program
+        if report.program is not None:
+            try:
+                # Paddle parity: print(static.default_main_program())
+                # shows the ops of the most recent trace
+                from .. import static as _static
+                _static.default_main_program().attach_ir(report.program)
+            except Exception:  # noqa: BLE001 — parity surface is optional
+                pass
+
+        def jitted(tr, frozen, bufs2, rng_key, *inputs):
+            return compiled(*tr, *frozen, *bufs2,
+                            jrandom.key_data(rng_key), *inputs)
+
+        return jitted
 
     @property
     def code(self):

@@ -136,6 +136,41 @@ CATALOG = {
     "resilience_circuit_open_total": (
         "counter", "circuit breakers tripping open, by op", ("op",), None),
 
+    # -- PIR compiler layer (paddle_tpu/pir/: capture, passes, cache) --------
+    "pir_captures_total": (
+        "counter", "programs captured (jaxpr -> pir.Program lowerings)",
+        (), None),
+    "pir_pass_seconds": (
+        "histogram", "wall time of one PIR pass run, by pass",
+        ("pass",), _STEP_BUCKETS),
+    "pir_pass_edits_total": (
+        "counter", "IR edits applied (ops removed/folded/merged/"
+        "rewritten), by pass", ("pass",), None),
+    "pir_fallback_total": (
+        "counter", "pipeline degradations to plain jax.jit, by stage "
+        "(capture/passes/evaluator)", ("stage",), None),
+    "jit_retrace_total": (
+        "counter", "StaticFunction traces for a new input signature "
+        "(shape churn past the LRU signature cache is visible here)",
+        (), None),
+    "compile_cache_hit_total": (
+        "counter", "persistent compile-cache hits (verified artifact "
+        "deserialized; XLA compile skipped)", (), None),
+    "compile_cache_miss_total": (
+        "counter", "persistent compile-cache misses (fresh compile)",
+        (), None),
+    "compile_cache_write_total": (
+        "counter", "compile-cache artifacts written", (), None),
+    "compile_cache_corrupt_total": (
+        "counter", "artifacts that failed sha256/format verification "
+        "(typed CompileCacheCorruptionError; recovered by recompile)",
+        (), None),
+    "compile_cache_evict_total": (
+        "counter", "artifacts LRU-evicted past the size cap", (), None),
+    "compile_cache_bytes": (
+        "gauge", "compile-cache directory size after the last write",
+        (), None),
+
     # -- bench orchestration (bench.py parent; stage = probe/configN/...) ----
     "bench_attempts_total": (
         "counter", "bench worker subprocess attempts by stage and outcome",

@@ -25,8 +25,6 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from jax import shard_map as _shard_map_mod
-
 try:
     shard_map = jax.shard_map
 except AttributeError:  # older spelling

@@ -1,0 +1,45 @@
+"""paddle_tpu.pir — the PIR-lite compiler layer.
+
+reference: paddle/pir/ (Program/Operation/Value SSA IR,
+pir::PassManager, DRR pattern rewriting) + the PIR serialize layer.
+The survey's layer 2, previously the only surveyed layer with no
+in-repo analog (COVERAGE.md row 12).
+
+Three pieces (see COMPILER.md for the full spec):
+
+* **capture** (`pir.capture`): one jax trace lowers a program to a
+  small SSA IR with stable canonical hashing;
+* **PassManager** (`pir.passes` / `pir.patterns`): ordered,
+  flag-toggleable, observability-instrumented passes — DCE, constant
+  folding, CSE, and DRR-lite pattern rewriting whose production
+  patterns route sdpa subgraphs through the attention backend router
+  and fuse rms epilogues into the Pallas flash kernel;
+* **compile cache** (`pir.cache` / `pir.pipeline`): persistent,
+  sha256-verified, LRU-capped StableHLO artifacts keyed by
+  (canonical IR hash, sharding, flags, jax version, platform).
+
+jit.to_static and the serving engine compile through
+``pipeline.compile_flat`` / ``pipeline.pir_jit``.
+"""
+
+from .cache import (CompileCache, CompileCacheCorruptionError, cache_key,
+                    default_cache, stats_snapshot)
+from .capture import capture, from_closed_jaxpr
+from .ir import Operation, Program, Value
+from .passes import (CommonSubexprElimination, ConstantFolding,
+                     DeadCodeElimination, Pass, PassManager, PassResult)
+from .patterns import (PatternRewriter, RewritePattern, RmsEpiloguePattern,
+                       SdpaRoutePattern)
+from .pipeline import CompileReport, compile_flat, pir_jit
+
+__all__ = [
+    "Program", "Operation", "Value",
+    "capture", "from_closed_jaxpr",
+    "Pass", "PassResult", "PassManager",
+    "DeadCodeElimination", "ConstantFolding", "CommonSubexprElimination",
+    "RewritePattern", "PatternRewriter", "SdpaRoutePattern",
+    "RmsEpiloguePattern",
+    "CompileCache", "CompileCacheCorruptionError", "cache_key",
+    "default_cache", "stats_snapshot",
+    "CompileReport", "compile_flat", "pir_jit",
+]

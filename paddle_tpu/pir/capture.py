@@ -1,0 +1,81 @@
+"""Capture: lower a traced jaxpr into a pir.Program.
+
+reference: the reference builds PIR programs from Python AST / bytecode
+capture (35k LoC); here the imperative API already runs on jax, so
+capture is one ``jax.make_jaxpr`` trace followed by a structural
+lowering — every eqn becomes one Operation that keeps a reference to
+the original ``JaxprEqn`` for faithful replay.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+from .ir import Operation, Program
+
+__all__ = ["from_closed_jaxpr", "capture"]
+
+
+def _aval_of(var):
+    av = var.aval
+    return tuple(getattr(av, "shape", ())), getattr(av, "dtype", None)
+
+
+def from_closed_jaxpr(closed, name: str = "program") -> Program:
+    """Lower a ClosedJaxpr to a Program. Literals become constants so
+    every operand is a first-class Value."""
+    from jax._src.core import DropVar, Literal
+
+    jaxpr = closed.jaxpr
+    prog = Program(name)
+    env: dict[int, object] = {}   # id(jax Var) -> Value
+
+    def bind_var(var):
+        shape, dtype = _aval_of(var)
+        v = prog.new_value(shape, dtype)
+        env[id(var)] = v
+        return v
+
+    prog.inputs = [bind_var(v) for v in jaxpr.invars]
+    for var, const in zip(jaxpr.constvars, closed.consts):
+        shape, dtype = _aval_of(var)
+        v = prog.new_value(shape, dtype)
+        prog.constants[v] = const
+        env[id(var)] = v
+
+    def read(var):
+        if isinstance(var, Literal):
+            return prog.add_constant(var.val)
+        return env[id(var)]
+
+    for eqn in jaxpr.eqns:
+        ins = [read(v) for v in eqn.invars]
+        outs = []
+        for ov in eqn.outvars:
+            shape, dtype = _aval_of(ov)
+            val = prog.new_value(shape, dtype)
+            outs.append(val)
+            if not isinstance(ov, DropVar):
+                env[id(ov)] = val
+        prog.ops.append(Operation(eqn.primitive.name, ins, outs, eqn=eqn))
+
+    prog.outputs = [read(v) for v in jaxpr.outvars]
+    return prog
+
+
+def capture(fn: Callable, *example_args, name: str = None):
+    """Trace ``fn`` (positional array args, array or flat-tuple output)
+    and lower it. Returns (Program, out_shape_pytree). This is the
+    entry the pipeline and tools/ir_dump.py use; jit.to_static builds
+    its flat function and calls it too."""
+    closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(*example_args)
+    prog = from_closed_jaxpr(closed,
+                             name or getattr(fn, "__name__", "program"))
+    try:
+        from ..observability.catalog import metric
+        metric("pir_captures_total").inc()
+    except Exception:  # noqa: BLE001 — capture never fails over metrics
+        pass
+    return prog, out_shape

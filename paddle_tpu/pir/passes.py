@@ -1,0 +1,282 @@
+"""Instrumented pass manager + the scalar optimization passes.
+
+reference: paddle/pir/include/pass/ (pir::PassManager, pass
+registration/instrumentation) and the DCE/constant-fold/CSE passes
+under paddle/fluid/pir/transforms/.
+
+Every pass run is timed into ``pir_pass_seconds{pass}`` and its edit
+count lands in ``pir_pass_edits_total{pass}`` through the observability
+catalog, and the whole pipeline is wrapped in spans — the pass layer is
+born observable, same discipline as serving/train.
+
+Passes are individually toggleable through ``FLAGS_pir_passes`` (an
+ordered comma list; default "fold,cse,pattern,dce").
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from .ir import Program
+
+__all__ = ["Pass", "PassResult", "PassManager", "DeadCodeElimination",
+           "ConstantFolding", "CommonSubexprElimination", "PIPELINE_VERSION"]
+
+# bump when pass semantics change in a way that invalidates cached
+# artifacts compiled from the rewritten programs
+PIPELINE_VERSION = 1
+
+# outputs larger than this are not materialized by constant folding
+_FOLD_MAX_ELEMS = 1 << 20
+
+# call-like primitives whose closed jaxpr is inlined during folding:
+# binding them with concrete args would XLA-compile a fresh (never
+# cache-hitting, the jaxpr object is new per capture) sub-program per
+# to_static; interpreting eqn-by-eqn hits jax's per-primitive impl
+# cache instead
+_INLINE_CALLS = ("pjit", "closed_call", "custom_jvp_call",
+                 "custom_vjp_call", "remat", "checkpoint")
+
+# CSE skips ops carrying a sub-jaxpr bigger than this: the canonical
+# attr text would pretty-print the whole body (a scanned model's is
+# huge) for a merge that essentially never exists
+_CSE_MAX_SUBJAXPR_EQNS = 16
+
+
+def _closed_jaxpr_param(eqn):
+    for k in ("jaxpr", "call_jaxpr"):
+        v = eqn.params.get(k)
+        if v is not None and hasattr(v, "jaxpr"):
+            return v
+    return None
+
+
+def _concrete_eval(closed, args):
+    """Interpret a ClosedJaxpr on concrete arrays, inlining nested
+    call-like primitives instead of binding them (bind on a call
+    primitive with concrete operands compiles the sub-program)."""
+    from jax._src.core import Literal
+    jaxpr = closed.jaxpr
+    env = {}
+
+    def read(v):
+        return v.val if isinstance(v, Literal) else env[v]
+
+    for var, val in zip(jaxpr.constvars, closed.consts):
+        env[var] = val
+    for var, val in zip(jaxpr.invars, args):
+        env[var] = val
+    for eqn in jaxpr.eqns:
+        in_vals = [read(v) for v in eqn.invars]
+        sub = (_closed_jaxpr_param(eqn)
+               if eqn.primitive.name in _INLINE_CALLS else None)
+        if sub is not None:
+            out = _concrete_eval(sub, in_vals)
+        else:
+            prim = eqn.primitive
+            subfuns, bind_params = prim.get_bind_params(eqn.params)
+            out = prim.bind(*subfuns, *in_vals, **bind_params)
+            out = out if prim.multiple_results else [out]
+        for var, val in zip(eqn.outvars, out):
+            env[var] = val
+    return [read(v) for v in jaxpr.outvars]
+
+
+class PassResult:
+    __slots__ = ("changed", "edits", "notes")
+
+    def __init__(self, edits: int = 0, notes: str = ""):
+        self.edits = int(edits)
+        self.changed = self.edits > 0
+        self.notes = notes
+
+    def __repr__(self):
+        return f"PassResult(edits={self.edits}, notes={self.notes!r})"
+
+
+class Pass:
+    name = "pass"
+
+    def run(self, prog: Program) -> PassResult:  # pragma: no cover
+        raise NotImplementedError
+
+
+class DeadCodeElimination(Pass):
+    """Remove ops none of whose outputs reach a program output (and
+    constants nothing reads). Ops with jax effects are pinned live."""
+
+    name = "dce"
+
+    def run(self, prog: Program) -> PassResult:
+        live = set(id(v) for v in prog.outputs)
+        kept = []
+        for op in reversed(prog.ops):
+            if op.has_effects() or any(id(o) in live for o in op.outputs):
+                kept.append(op)
+                live.update(id(v) for v in op.inputs)
+        removed_ops = len(prog.ops) - len(kept)
+        prog.ops = kept[::-1]
+        live.update(id(v) for v in prog.inputs)
+        dead_consts = [v for v in prog.constants if id(v) not in live]
+        for v in dead_consts:
+            del prog.constants[v]
+        return PassResult(removed_ops + len(dead_consts),
+                          f"ops={removed_ops} consts={len(dead_consts)}")
+
+
+class ConstantFolding(Pass):
+    """Evaluate ops whose operands are all constants on the host and
+    replace their results with constants. Random/effectful/fused ops
+    and oversized results are skipped. This is what turns mask- and
+    rope-table subgraphs into literals the pattern matcher can reason
+    about (e.g. "is this mask exactly tril?")."""
+
+    name = "fold"
+
+    def run(self, prog: Program) -> PassResult:
+        import numpy as np
+        lut = {id(v): c for v, c in prog.constants.items()}
+        folded = 0
+        kept = []
+        for op in prog.ops:
+            foldable = (
+                op.fn is None and not op.has_effects()
+                and "random" not in op.name
+                and op.inputs and all(id(v) in lut for v in op.inputs)
+                and all(int(np.prod(o.shape or (1,))) <= _FOLD_MAX_ELEMS
+                        for o in op.outputs))
+            # input-free table builders (iota) fold too
+            if (not op.inputs and op.fn is None and not op.has_effects()
+                    and op.name == "iota"):
+                foldable = True
+            if not foldable:
+                kept.append(op)
+                continue
+            try:
+                in_vals = [lut[id(v)] for v in op.inputs]
+                sub = (_closed_jaxpr_param(op.eqn)
+                       if op.eqn is not None
+                       and op.name in _INLINE_CALLS else None)
+                outs = (_concrete_eval(sub, in_vals) if sub is not None
+                        else op.evaluate(in_vals))
+            except Exception:  # noqa: BLE001 — a non-foldable op just stays
+                kept.append(op)
+                continue
+            for v, o in zip(op.outputs, outs):
+                prog.constants[v] = o
+                v.op = None
+                lut[id(v)] = o
+            folded += 1
+        prog.ops = kept
+        return PassResult(folded, f"ops_folded={folded}")
+
+
+class CommonSubexprElimination(Pass):
+    """Merge ops with identical (name, operands, attrs). Fused and
+    effectful ops are skipped; duplicate constants merge by content."""
+
+    name = "cse"
+
+    def run(self, prog: Program) -> PassResult:
+        import hashlib
+
+        import numpy as np
+        replace: dict[int, object] = {}   # id(old Value) -> Value
+
+        def res(v):
+            return replace.get(id(v), v)
+
+        merged = 0
+        # constants by content digest
+        by_digest: dict[tuple, object] = {}
+        for v, c in list(prog.constants.items()):
+            arr = np.asarray(c)
+            key = (str(arr.dtype), arr.shape,
+                   hashlib.sha256(arr.tobytes()).hexdigest())
+            first = by_digest.get(key)
+            if first is None:
+                by_digest[key] = v
+            else:
+                replace[id(v)] = first
+                del prog.constants[v]
+                merged += 1
+
+        seen: dict[tuple, object] = {}
+        kept = []
+        for op in prog.ops:
+            op.inputs = [res(v) for v in op.inputs]
+            if op.fn is not None or op.has_effects():
+                kept.append(op)
+                continue
+            sub = _closed_jaxpr_param(op.eqn) if op.eqn is not None else None
+            if sub is not None and len(sub.jaxpr.eqns) > _CSE_MAX_SUBJAXPR_EQNS:
+                # keying would pretty-print the whole sub-program (a
+                # scanned model body) for a merge that never exists
+                kept.append(op)
+                continue
+            key = (op.name, tuple(id(v) for v in op.inputs), op.attr_text())
+            prior = seen.get(key)
+            if prior is None:
+                seen[key] = op
+                kept.append(op)
+            else:
+                for old, new in zip(op.outputs, prior.outputs):
+                    replace[id(old)] = new
+                merged += 1
+        prog.ops = kept
+        prog.outputs = [res(v) for v in prog.outputs]
+        return PassResult(merged, f"merged={merged}")
+
+
+def _registry():
+    from .patterns import PatternRewriter
+    return {
+        "dce": DeadCodeElimination,
+        "fold": ConstantFolding,
+        "cse": CommonSubexprElimination,
+        "pattern": PatternRewriter,
+    }
+
+
+class PassManager:
+    """Ordered pass runner, instrumented through the observability
+    catalog (pass wall time + edit counts) and span tracing."""
+
+    def __init__(self, passes: Optional[list] = None):
+        self.passes = list(passes) if passes is not None else []
+
+    @classmethod
+    def default(cls) -> "PassManager":
+        """Pipeline from FLAGS_pir_passes (ordered comma list; unknown
+        names raise — same closed-registry discipline as fault sites)."""
+        from ..framework import flags as _flags
+        spec = (_flags.flag_value("pir_passes") or "").strip()
+        reg = _registry()
+        passes = []
+        for name in filter(None, (s.strip() for s in spec.split(","))):
+            if name not in reg:
+                raise ValueError(f"unknown PIR pass {name!r} in "
+                                 f"FLAGS_pir_passes; registered: {sorted(reg)}")
+            passes.append(reg[name]())
+        return cls(passes)
+
+    def run(self, prog: Program) -> dict:
+        """Run all passes in order; returns {pass_name: PassResult} plus
+        per-pass seconds in PassResult.notes-adjacent ``report`` dict."""
+        from ..observability import span as _span
+        from ..observability.catalog import metric as _metric
+        report: dict[str, dict] = {}
+        with _span("pir.pipeline", program=prog.name, ops=len(prog.ops)):
+            for p in self.passes:
+                t0 = time.perf_counter()
+                with _span(f"pir.pass.{p.name}"):
+                    result = p.run(prog)
+                dt = time.perf_counter() - t0
+                _metric("pir_pass_seconds", **{"pass": p.name}).observe(dt)
+                if result.edits:
+                    _metric("pir_pass_edits_total",
+                            **{"pass": p.name}).inc(result.edits)
+                report[p.name] = {"seconds": dt, "edits": result.edits,
+                                  "notes": result.notes}
+        return report

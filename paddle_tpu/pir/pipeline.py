@@ -1,0 +1,289 @@
+"""The compile pipeline: capture -> PassManager -> cache -> XLA.
+
+This is the layer jit.to_static and the serving engine call instead of
+raw ``jax.jit``: the traced jaxpr is lowered to a pir.Program, the
+instrumented pass pipeline rewrites it (DCE / fold / CSE / DRR
+patterns), and the persistent compile cache is consulted pre-XLA —
+a warm hit deserializes a StableHLO artifact and skips lowering +
+backend compilation; a miss jits the rewritten program's interpreter
+and writes the artifact back (atomic, verified, LRU-capped).
+
+Every failure degrades, never breaks: any pipeline error falls back to
+plain ``jax.jit`` of the original function, counted in
+``pir_fallback_total{stage}`` (graph-break ConcretizationTypeErrors
+propagate untouched — that contract belongs to to_static).
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Callable, Optional
+
+import jax
+import jax.export as _jax_export
+
+from .cache import (CompileCacheCorruptionError, _bump, _metric, cache_key,
+                    default_cache)
+from .capture import capture
+from .passes import PassManager
+
+__all__ = ["CompileReport", "compile_flat", "pir_jit"]
+
+
+class CompileReport:
+    """What the pipeline did for one program — attached to
+    StaticFunction/_PirJit for tests, bench rows and the IR dump tool."""
+
+    __slots__ = ("name", "key", "cache", "pass_report", "program",
+                 "captured_ops", "final_ops", "pattern_counts", "fallback")
+
+    def __init__(self, name):
+        self.name = name
+        self.key = None
+        self.cache = "off"          # off|miss|hit|bypass:<why>|error:<why>
+        self.pass_report = {}
+        self.program = None         # the post-pass pir.Program
+        self.captured_ops = 0
+        self.final_ops = 0
+        self.pattern_counts = {}
+        self.fallback = None        # stage name when pir fell back
+
+    def summary(self) -> dict:
+        return {"name": self.name, "cache": self.cache,
+                "captured_ops": self.captured_ops,
+                "final_ops": self.final_ops,
+                "patterns": dict(self.pattern_counts),
+                "passes": {k: {"edits": v["edits"],
+                               "seconds": round(v["seconds"], 6)}
+                           for k, v in self.pass_report.items()},
+                "fallback": self.fallback}
+
+
+def _avals(flat_args):
+    import jax.numpy as jnp
+    return [jax.ShapeDtypeStruct(jnp.shape(a), jnp.asarray(a).dtype)
+            for a in flat_args]
+
+
+def compile_flat(flat_fn: Callable, flat_args: list, *, name: str,
+                 sharding: str = "replicated", donate_argnums=None,
+                 vjp_order: int = 1, extra_key: Optional[dict] = None):
+    """Compile ``flat_fn(*flat_leaves) -> tuple`` through the pipeline.
+    Returns (callable, CompileReport). Raises only what tracing raises
+    (e.g. ConcretizationTypeError); pipeline-internal failures degrade
+    to plain jax.jit with the fallback stage recorded."""
+    report = CompileReport(name)
+    try:
+        prog, _ = capture(flat_fn, *flat_args, name=name)
+        report.captured_ops = prog.num_ops()
+        from jax._src.core import Tracer
+        if any(isinstance(c, Tracer) for c in prog.constants.values()):
+            # captured under an OUTER jax trace (e.g. nested to_static):
+            # tracer-valued consts must not leak into a host-side program
+            raise RuntimeError("program closes over tracers "
+                               "(nested trace); pir requires concrete "
+                               "constants")
+    except jax.errors.ConcretizationTypeError:
+        raise                       # graph-break contract: caller handles
+    except Exception as e:  # noqa: BLE001 — degrade, never break compile
+        return _fallback(flat_fn, donate_argnums, report, "capture", e)
+
+    try:
+        pm = PassManager.default()
+        report.pass_report = pm.run(prog)
+        report.final_ops = prog.num_ops()
+        report.program = prog
+        pat = report.pass_report.get("pattern", {})
+        report.pattern_counts = dict(
+            p.split("=") for p in (pat.get("notes") or "").split()
+            if "=" in p)
+        report.pattern_counts = {k: int(v)
+                                 for k, v in report.pattern_counts.items()}
+    except Exception as e:  # noqa: BLE001
+        return _fallback(flat_fn, donate_argnums, report, "passes", e)
+
+    try:
+        evaluator = _make_evaluator(prog)
+        jit_kwargs = {}
+        if donate_argnums:
+            jit_kwargs["donate_argnums"] = tuple(donate_argnums)
+        jitted = jax.jit(evaluator, **jit_kwargs)
+    except Exception as e:  # noqa: BLE001
+        return _fallback(flat_fn, donate_argnums, report, "evaluator", e)
+
+    cache = default_cache()
+    if cache is None:
+        report.cache = "off"
+        return jitted, report
+    if donate_argnums:
+        # a deserialized Exported cannot express donation; on device the
+        # double-buffering would silently cost HBM, so donated programs
+        # keep the pass pipeline but bypass the artifact store
+        report.cache = "bypass:donate"
+        return jitted, report
+
+    report.key = cache_key(prog.canonical_hash(), sharding=sharding,
+                           extra=extra_key)
+    loaded = _cache_read(cache, report)
+    if loaded is not None:
+        return loaded, report
+
+    if not report.cache.startswith("error:"):
+        report.cache = "miss"
+    _bump("miss")
+    _metric("compile_cache_miss_total").inc()
+    _cache_write(cache, report, jitted, flat_args, vjp_order)
+    return jitted, report
+
+
+def _make_evaluator(prog):
+    def evaluate(*flat):
+        return prog.bind(*flat)
+    evaluate.__name__ = f"pir_eval_{prog.name}"
+    return evaluate
+
+
+def _fallback(flat_fn, donate_argnums, report, stage, err):
+    report.fallback = stage
+    _metric("pir_fallback_total", stage=stage).inc()
+    warnings.warn(
+        f"pir pipeline fell back to plain jax.jit for "
+        f"{report.name!r} at stage {stage!r}: {err!r}",
+        RuntimeWarning, stacklevel=3)
+    kw = {"donate_argnums": tuple(donate_argnums)} if donate_argnums else {}
+    return jax.jit(flat_fn, **kw), report
+
+
+def _cache_read(cache, report):
+    """Returns the warm callable or None. Corruption is a typed, counted
+    error that degrades to recompile (the artifact is dropped)."""
+    try:
+        hit = cache.get(report.key)
+    except CompileCacheCorruptionError as e:
+        _bump("corrupt")
+        _metric("compile_cache_corrupt_total").inc()
+        warnings.warn(f"{e}; recompiling", RuntimeWarning, stacklevel=3)
+        cache.drop(report.key)
+        return None
+    except Exception as e:  # noqa: BLE001 — IO trouble or ANY injected
+        # class: a cache read may only ever cost a recompile, never
+        # break the compile itself
+        _bump("read_error")
+        report.cache = f"error:read:{type(e).__name__}"
+        return None
+    if hit is None:
+        return None
+    payload, meta = hit
+    try:
+        exported = _jax_export.deserialize(payload)
+    except Exception as e:  # noqa: BLE001 — undeserializable == corrupt
+        _bump("corrupt")
+        _metric("compile_cache_corrupt_total").inc()
+        warnings.warn(
+            f"compile-cache artifact {report.key[:12]} verified but did "
+            f"not deserialize ({e!r}); recompiling", RuntimeWarning,
+            stacklevel=3)
+        cache.drop(report.key)
+        return None
+    report.cache = "hit"
+    _bump("hit")
+    _metric("compile_cache_hit_total").inc()
+
+    def warm(*flat):
+        return exported.call(*flat)
+    return warm
+
+
+def _cache_write(cache, report, jitted, flat_args, vjp_order):
+    try:
+        exported = _jax_export.export(jitted)(*_avals(flat_args))
+        payload = exported.serialize(vjp_order=vjp_order)
+    except Exception as e:  # noqa: BLE001 — unexportable program: no artifact
+        report.cache = f"miss:unexportable:{type(e).__name__}"
+        return
+    try:
+        cache.put(report.key, payload,
+                  meta={"name": report.name,
+                        "captured_ops": report.captured_ops,
+                        "final_ops": report.final_ops,
+                        "patterns": report.pattern_counts})
+    except Exception as e:  # noqa: BLE001 — write failures degrade, counted
+        _bump("write_error")
+        report.cache = f"error:write:{type(e).__name__}"
+        warnings.warn(
+            f"compile-cache write failed for {report.name!r} "
+            f"({e!r}); continuing uncached", RuntimeWarning, stacklevel=4)
+        return
+    _bump("write")
+    _metric("compile_cache_write_total").inc()
+
+
+# --------------------------------------------------------------------------
+# pytree-level lazy wrapper (serving engine warm start, tools)
+# --------------------------------------------------------------------------
+
+class pir_jit:
+    """Drop-in for ``jax.jit(fn)`` over pytree args: on the first call
+    the concrete args fix the signature and the pipeline compiles (or
+    warm-loads) the program; later calls must match the first call's
+    tree structure (the jax.jit contract serving already relies on)."""
+
+    def __init__(self, fn, *, name=None, sharding="replicated",
+                 donate_argnums=None, vjp_order=0, extra_key=None):
+        self._fn = fn
+        self.name = name or getattr(fn, "__name__", "pir_jit")
+        self._sharding = sharding
+        self._donate = donate_argnums
+        self._vjp_order = vjp_order
+        self._extra = extra_key
+        self._compiled = None
+        self._in_treedef = None
+        self._out_treedef = None
+        self.report: Optional[CompileReport] = None
+
+    def _build(self, args):
+        from ..framework import flags as _flags
+        flat, in_tree = jax.tree_util.tree_flatten(args)
+        self._in_treedef = in_tree
+        out_box = {}
+
+        def flat_fn(*leaves):
+            a = jax.tree_util.tree_unflatten(in_tree, leaves)
+            out = self._fn(*a)
+            out_flat, out_tree = jax.tree_util.tree_flatten(out)
+            out_box["tree"] = out_tree
+            return tuple(out_flat)
+
+        donate_flat = None
+        if self._donate:
+            donate_flat = []
+            off = 0
+            for i, a in enumerate(args):
+                leaves = jax.tree_util.tree_flatten(a)[0]
+                if i in self._donate:
+                    donate_flat.extend(range(off, off + len(leaves)))
+                off += len(leaves)
+        if not _flags.flag_value("pir"):
+            report = CompileReport(self.name)
+            report.cache = "disabled"
+            kw = ({"donate_argnums": tuple(donate_flat)}
+                  if donate_flat else {})
+            compiled, self.report = jax.jit(flat_fn, **kw), report
+        else:
+            compiled, self.report = compile_flat(
+                flat_fn, flat, name=self.name, sharding=self._sharding,
+                donate_argnums=donate_flat, vjp_order=self._vjp_order,
+                extra_key=self._extra)
+        if "tree" not in out_box:
+            # warm hit / fallback never ran flat_fn's python: learn the
+            # out tree from an abstract trace of the original fn
+            jax.eval_shape(lambda *a: flat_fn(*a), *flat)
+        self._out_treedef = out_box["tree"]
+        self._compiled = compiled
+
+    def __call__(self, *args):
+        if self._compiled is None:
+            self._build(args)
+        flat = jax.tree_util.tree_flatten(args)[0]
+        out_flat = self._compiled(*flat)
+        return jax.tree_util.tree_unflatten(self._out_treedef, out_flat)

@@ -57,6 +57,12 @@ FAULT_SITES = {
                         "(shed-and-requeue path)",
     "train.step_nonfinite": "train supervisor: force a non-finite loss "
                             "for this step (consulted via check())",
+    "compile.cache_read": "PIR compile cache: artifact read (verified "
+                          "load of a serialized StableHLO program; "
+                          "failure degrades to recompile)",
+    "compile.cache_write": "PIR compile cache: artifact write (atomic "
+                           "tmp+rename; failure degrades to an uncached "
+                           "but working compile)",
 }
 
 

@@ -37,6 +37,7 @@ class Program:
 
     def __init__(self):
         self._params: dict = {}
+        self._ir = None          # attached pir.Program (last trace)
 
     def global_block(self):
         return self
@@ -44,7 +45,35 @@ class Program:
     def clone(self, for_test=False):
         p = Program()
         p._params = dict(self._params)
+        p._ir = self._ir
         return p
+
+    # -- IR surface (reference: Program::Print / Program.__str__) -----------
+    def attach_ir(self, pir_program):
+        """Bind a captured pir.Program so print(program) shows ops.
+        jit.to_static attaches its most recent trace to the default main
+        program automatically."""
+        self._ir = pir_program
+
+    @property
+    def ir(self):
+        return self._ir
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        """Reference parity: the op-level program text. With an attached
+        pir.Program this is the real captured IR (SSA ops, one per
+        line); otherwise a parameter-registry summary."""
+        if self._ir is not None:
+            return self._ir.to_string()
+        lines = [f"program (no captured IR; {len(self._params)} "
+                 "registered parameters) {"]
+        for k, v in self._params.items():
+            lines.append(f"  param {k}: {tuple(v.shape)}")
+        lines.append("}")
+        return "\n".join(lines)
+
+    def __str__(self):
+        return self.to_string()
 
     def all_parameters(self):
         return [p for p in self._params.values() if not p.stop_gradient]
@@ -210,11 +239,20 @@ class Executor:
 
 class CompiledProgram:
     """reference: compiler.CompiledProgram — XLA compiles under jit; this
-    records the program + build strategy for API parity."""
+    records the program + build strategy for API parity and exposes the
+    wrapped program's IR text."""
 
     def __init__(self, program, build_strategy=None):
         self._program = program
         self._build_strategy = build_strategy
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        if hasattr(self._program, "to_string"):
+            return self._program.to_string()
+        return repr(self._program)
+
+    def __str__(self):
+        return self.to_string()
 
 
 class BuildStrategy:

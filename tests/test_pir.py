@@ -1,0 +1,564 @@
+"""PIR-lite compiler layer: capture, golden-IR pass behavior, DRR
+pattern rewriting, the persistent compile cache, and the end-to-end
+to_static acceptance path.
+
+reference test pattern: test/ir/pir/ (program translator round-trips,
+pass correctness, DRR tests) — here capture is a jax trace, so every
+golden test also pins numerics against eager on the same seed.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, pir
+from paddle_tpu import observability as obs
+from paddle_tpu.framework import core as _core
+from paddle_tpu.framework import flags as _flags
+
+
+def _counter(name, **labels):
+    fam = obs.get_registry().get(name)
+    if fam is None:
+        return 0.0
+    return (fam.labels(**labels) if labels else fam).value
+
+
+@pytest.fixture
+def cache_dir(tmp_path):
+    d = str(tmp_path / "pirc")
+    prev = _flags.flag_value("compile_cache_dir")
+    paddle.set_flags({"compile_cache_dir": d})
+    yield d
+    paddle.set_flags({"compile_cache_dir": prev})
+
+
+@pytest.fixture
+def enabled_obs():
+    obs.get_registry().reset()
+    obs.enable()
+    yield
+    obs.disable()
+
+
+def _layer_flat(layer, *inputs):
+    """Close a Layer over its parameters the way jit.to_static does;
+    returns (flat_fn, flat_args)."""
+    params = [p for _, p in layer.named_parameters()]
+
+    def flat_fn(*leaves):
+        p_arrays = list(leaves[:len(params)])
+        xs = leaves[len(params):]
+        saved = [(t, t._data, t._node) for t in params]
+        try:
+            for t, a in zip(params, p_arrays):
+                t._data = a
+                t._node = None
+            with _core.TraceContext():
+                out = layer(*[paddle.Tensor(x) for x in xs])
+            return (out._data,)
+        finally:
+            for t, a, n in saved:
+                t._data = a
+                t._node = n
+
+    return flat_fn, [p._data for p in params] + list(inputs)
+
+
+def _tiny_llama_layer(seq=8):
+    from paddle_tpu.models.llama import LlamaConfig, LlamaDecoderLayer
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=4,
+                      num_key_value_heads=2, dtype="float32")
+    paddle.seed(0)
+    layer = LlamaDecoderLayer(cfg)
+    layer.eval()
+    x = jnp.asarray(np.random.RandomState(0).randn(1, seq, 32), jnp.float32)
+    return layer, x
+
+
+# ---------------------------------------------------------------------------
+# capture + IR
+# ---------------------------------------------------------------------------
+
+class TestCapture:
+    def test_capture_and_print(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        fn, flat = _layer_flat(model, jnp.ones((4, 8), jnp.float32))
+        prog, _ = pir.capture(fn, *flat, name="mlp")
+        text = prog.to_string()
+        assert "dot_general" in text and "program @mlp" in text
+        assert "return" in text
+        assert prog.num_ops() > 0
+        assert len(prog.inputs) == len(flat)
+
+    def test_bind_matches_eager(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        x = jnp.asarray(np.random.RandomState(0).randn(4, 8), jnp.float32)
+        fn, flat = _layer_flat(model, x)
+        prog, _ = pir.capture(fn, *flat, name="mlp")
+        np.testing.assert_allclose(np.asarray(prog.bind(*flat)[0]),
+                                   np.asarray(fn(*flat)[0]), rtol=1e-6)
+
+    def test_canonical_hash_stable_and_content_sensitive(self):
+        def f(x):
+            return (jnp.tanh(x) * 2.0,)
+
+        def g(x):
+            return (jnp.tanh(x) * 3.0,)   # different constant
+
+        x = jnp.ones((4,), jnp.float32)
+        h1 = pir.capture(f, x)[0].canonical_hash()
+        h2 = pir.capture(f, x)[0].canonical_hash()
+        h3 = pir.capture(g, x)[0].canonical_hash()
+        assert h1 == h2          # stable across captures
+        assert h1 != h3          # sensitive to constants
+
+
+# ---------------------------------------------------------------------------
+# golden pass behavior
+# ---------------------------------------------------------------------------
+
+class TestPasses:
+    def test_dce_removes_dead_branch(self):
+        def f(x, w):
+            dead = jnp.sin(x) @ w          # never returned
+            dead2 = dead * 2.0
+            return (jnp.tanh(x @ w),)
+
+        x = jnp.ones((4, 4), jnp.float32)
+        w = jnp.eye(4, dtype=jnp.float32)
+        prog, _ = pir.capture(f, x, w)
+        names_before = [op.name for op in prog.ops]
+        assert "sin" in names_before
+        res = pir.DeadCodeElimination().run(prog)
+        assert res.edits >= 3               # sin, dead matmul, dead mul
+        names = [op.name for op in prog.ops]
+        assert "sin" not in names
+        np.testing.assert_allclose(np.asarray(prog.bind(x, w)[0]),
+                                   np.tanh(np.ones((4, 4))), rtol=1e-6)
+
+    def test_cse_merges_duplicate_matmuls(self):
+        def f(x, w):
+            a = x @ w
+            b = x @ w                       # duplicate
+            return (a + b,)
+
+        x = jnp.ones((4, 4), jnp.float32)
+        w = jnp.eye(4, dtype=jnp.float32) * 3.0
+        prog, _ = pir.capture(f, x, w)
+        n_dots = sum(op.name == "dot_general" for op in prog.ops)
+        assert n_dots == 2
+        res = pir.CommonSubexprElimination().run(prog)
+        assert res.edits >= 1
+        assert sum(op.name == "dot_general" for op in prog.ops) == 1
+        np.testing.assert_allclose(np.asarray(prog.bind(x, w)[0]),
+                                   6.0 * np.ones((4, 4)), rtol=1e-6)
+
+    def test_constant_folding(self):
+        def f(x):
+            table = jnp.sin(jnp.arange(4.0)) * 2.0   # input-free subgraph
+            return (x + table,)
+
+        x = jnp.zeros((4,), jnp.float32)
+        prog, _ = pir.capture(f, x)
+        res = pir.ConstantFolding().run(prog)
+        assert res.edits >= 2                # iota/sin/mul folded
+        names = [op.name for op in prog.ops]
+        assert "sin" not in names and "iota" not in names
+        np.testing.assert_allclose(np.asarray(prog.bind(x)[0]),
+                                   np.sin(np.arange(4.0)) * 2.0, rtol=1e-6)
+
+    def test_passes_flag_toggles_pipeline(self):
+        prev = _flags.flag_value("pir_passes")
+        try:
+            paddle.set_flags({"pir_passes": "dce"})
+            pm = pir.PassManager.default()
+            assert [p.name for p in pm.passes] == ["dce"]
+            paddle.set_flags({"pir_passes": "fold,dce"})
+            assert [p.name for p in pir.PassManager.default().passes] \
+                == ["fold", "dce"]
+        finally:
+            paddle.set_flags({"pir_passes": prev})
+
+    def test_unknown_pass_name_raises(self):
+        prev = _flags.flag_value("pir_passes")
+        try:
+            paddle.set_flags({"pir_passes": "dce,licm"})
+            with pytest.raises(ValueError, match="unknown PIR pass"):
+                pir.PassManager.default()
+        finally:
+            paddle.set_flags({"pir_passes": prev})
+
+    def test_pass_metrics_flow_through_catalog(self, enabled_obs):
+        layer, x = _tiny_llama_layer()
+        fn, flat = _layer_flat(layer, x)
+        prog, _ = pir.capture(fn, *flat, name="llama")
+        pir.PassManager.default().run(prog)
+        assert _counter("pir_pass_edits_total", **{"pass": "fold"}) >= 1
+        reg = obs.get_registry().get("pir_pass_seconds")
+        assert reg is not None
+
+
+# ---------------------------------------------------------------------------
+# DRR patterns
+# ---------------------------------------------------------------------------
+
+class TestSdpaPattern:
+    def test_fires_on_llama_attention_and_matches_router(self):
+        layer, x = _tiny_llama_layer()
+        fn, flat = _layer_flat(layer, x)
+        eager = np.asarray(fn(*flat)[0])
+        prog, _ = pir.capture(fn, *flat, name="llama_block")
+        report = pir.PassManager.default().run(prog)
+        assert "sdpa_route=1" in report["pattern"]["notes"]
+        sdpa = [op for op in prog.ops if op.name == "pt.sdpa"]
+        assert len(sdpa) == 1
+        attrs = sdpa[0].attrs
+        assert attrs["causal"] is True
+        # the rewrite's routed decision must equal what the attention
+        # router returns for the region's shape key
+        from paddle_tpu.ops.pallas.attention_router import route
+        b, sq, sk, h, d = attrs["shape"]
+        dec = route(b * h, sq, sk, d, sdpa[0].inputs[0].dtype, True)
+        assert attrs["route_fwd"] == dec.fwd
+        # on CPU the fused op replays the captured region: exact numerics
+        got = np.asarray(prog.bind(*flat)[0])
+        np.testing.assert_allclose(got, eager, rtol=1e-6, atol=1e-6)
+
+    def test_does_not_fire_without_softmax(self):
+        def f(q, k):
+            return (jnp.einsum("bqhd,bkhd->bhqk", q, k),)
+
+        q = jnp.ones((1, 8, 4, 8), jnp.float32)
+        prog, _ = pir.capture(f, q, q)
+        report = pir.PassManager.default().run(prog)
+        assert report["pattern"]["edits"] == 0
+
+    def test_non_causal_mask_is_not_rewritten(self):
+        """Constraint discipline: a padding-style (non-tril) mask must
+        not be claimed causal — the pattern skips instead of guessing."""
+        def f(q, k, v):
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * 0.35
+            mask = jnp.ones((8, 8), bool).at[:, 4:].set(False)  # padding
+            logits = jnp.where(mask, logits, jnp.float32(-1e30))
+            probs = jax.nn.softmax(logits, axis=-1)
+            return (jnp.einsum("bhqk,bkhd->bqhd", probs, v),)
+
+        q = jnp.asarray(np.random.RandomState(0).randn(1, 8, 4, 8),
+                        jnp.float32)
+        prog, _ = pir.capture(f, q, q, q)
+        report = pir.PassManager.default().run(prog)
+        assert "sdpa_route" not in report["pattern"]["notes"]
+
+
+class TestRmsEpiloguePattern:
+    def test_fires_on_incubate_epilogue_graph(self):
+        from paddle_tpu.incubate.nn.functional import (
+            fused_attention_rms_epilogue)
+        rng = np.random.RandomState(0)
+        b, s, h, d = 1, 8, 4, 8
+        q, k, v, res = (jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+                        for _ in range(4))
+        w = jnp.asarray(rng.rand(d), jnp.float32)
+
+        def fn(q_, k_, v_, r_, w_):
+            with _core.TraceContext():
+                out = fused_attention_rms_epilogue(
+                    paddle.Tensor(q_), paddle.Tensor(k_), paddle.Tensor(v_),
+                    paddle.Tensor(r_), paddle.Tensor(w_))
+            return (out._data,)
+
+        flat = [q, k, v, res, w]
+        eager = np.asarray(fn(*flat)[0])
+        prog, _ = pir.capture(fn, *flat, name="epi")
+        report = pir.PassManager.default().run(prog)
+        assert "rms_epilogue=1" in report["pattern"]["notes"]
+        fused = [op for op in prog.ops if op.name == "pt.sdpa_rms_epilogue"]
+        assert len(fused) == 1
+        assert fused[0].attrs["eps"] == pytest.approx(1e-6)
+        got = np.asarray(prog.bind(*flat)[0])
+        np.testing.assert_allclose(got, eager, rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# persistent compile cache
+# ---------------------------------------------------------------------------
+
+def _simple_fn(x, y):
+    return (jnp.tanh(x @ y).sum(),)
+
+
+_SIMPLE_ARGS = [jnp.ones((4, 4), jnp.float32),
+                jnp.eye(4, dtype=jnp.float32) * 2.0]
+_SIMPLE_WANT = float(np.tanh(2.0) * 16)
+
+
+class TestCompileCache:
+    def test_cold_miss_then_warm_hit(self, cache_dir):
+        before = pir.stats_snapshot()
+        f1, r1 = pir.compile_flat(_simple_fn, _SIMPLE_ARGS, name="t")
+        assert r1.cache == "miss"
+        assert abs(float(np.asarray(f1(*_SIMPLE_ARGS)[0]))
+                   - _SIMPLE_WANT) < 1e-5
+        f2, r2 = pir.compile_flat(_simple_fn, _SIMPLE_ARGS, name="t")
+        assert r2.cache == "hit"
+        assert r2.key == r1.key
+        assert abs(float(np.asarray(f2(*_SIMPLE_ARGS)[0]))
+                   - _SIMPLE_WANT) < 1e-5
+        after = pir.stats_snapshot()
+        assert after["miss"] - before["miss"] == 1
+        assert after["hit"] - before["hit"] == 1
+        assert after["write"] - before["write"] == 1
+
+    def test_grad_through_warm_hit(self, cache_dir):
+        pir.compile_flat(_simple_fn, _SIMPLE_ARGS, name="t")
+        f2, r2 = pir.compile_flat(_simple_fn, _SIMPLE_ARGS, name="t")
+        assert r2.cache == "hit"
+        g = jax.grad(lambda x: f2(x, _SIMPLE_ARGS[1])[0])(_SIMPLE_ARGS[0])
+        ref = jax.grad(lambda x: _simple_fn(x, _SIMPLE_ARGS[1])[0])(
+            _SIMPLE_ARGS[0])
+        np.testing.assert_allclose(np.asarray(g), np.asarray(ref),
+                                   rtol=1e-5)
+
+    def test_corrupted_artifact_recovers_via_recompile(self, cache_dir):
+        _, r1 = pir.compile_flat(_simple_fn, _SIMPLE_ARGS, name="t")
+        path = os.path.join(cache_dir, r1.key + ".pirc")
+        blob = bytearray(open(path, "rb").read())
+        blob[-5] ^= 0xFF                      # flip one payload byte
+        open(path, "wb").write(bytes(blob))
+        before = pir.stats_snapshot()
+        with pytest.warns(RuntimeWarning, match="sha256"):
+            f3, r3 = pir.compile_flat(_simple_fn, _SIMPLE_ARGS, name="t")
+        assert r3.cache == "miss"             # recovered by recompile
+        assert pir.stats_snapshot()["corrupt"] - before["corrupt"] == 1
+        assert abs(float(np.asarray(f3(*_SIMPLE_ARGS)[0]))
+                   - _SIMPLE_WANT) < 1e-5
+        # the corrupt artifact was dropped and rewritten: next is a hit
+        _, r4 = pir.compile_flat(_simple_fn, _SIMPLE_ARGS, name="t")
+        assert r4.cache == "hit"
+
+    def test_typed_corruption_error(self, cache_dir):
+        cache = pir.default_cache()
+        cache.put("k" * 64, b"payload", {"name": "x"})
+        path = os.path.join(cache_dir, "k" * 64 + ".pirc")
+        open(path, "wb").write(b"garbage")
+        with pytest.raises(pir.CompileCacheCorruptionError, match="magic"):
+            cache.get("k" * 64)
+
+    def test_lru_eviction_under_size_cap(self, cache_dir):
+        prev = _flags.flag_value("compile_cache_max_bytes")
+        try:
+            cache = pir.CompileCache(cache_dir, max_bytes=3000)
+            for i in range(6):
+                cache.put(f"{i:064d}", os.urandom(800), {})
+            ents = cache.entries()
+            assert cache.total_bytes() <= 3000
+            assert 0 < len(ents) < 6          # something was evicted
+            assert pir.stats_snapshot()["evict"] >= 1
+        finally:
+            paddle.set_flags({"compile_cache_max_bytes": prev})
+
+    def test_key_depends_on_flags_and_sharding(self):
+        h = "a" * 64
+        k1 = pir.cache_key(h)
+        k2 = pir.cache_key(h, sharding="mesh(dp=2)")
+        assert k1 != k2
+        prev = _flags.flag_value("matmul_precision")
+        try:
+            paddle.set_flags({"matmul_precision": "highest"})
+            assert pir.cache_key(h) != k1
+        finally:
+            paddle.set_flags({"matmul_precision": prev})
+
+    @pytest.mark.chaos
+    def test_write_fault_degrades_uncached(self, cache_dir):
+        from paddle_tpu.resilience.faults import injected_faults
+        with injected_faults("compile.cache_write:1:OSError"):
+            with pytest.warns(RuntimeWarning, match="cache write failed"):
+                f, r = pir.compile_flat(_simple_fn, _SIMPLE_ARGS, name="t")
+        assert r.cache.startswith("error:write")
+        assert abs(float(np.asarray(f(*_SIMPLE_ARGS)[0]))
+                   - _SIMPLE_WANT) < 1e-5
+
+    @pytest.mark.chaos
+    def test_read_fault_degrades_to_recompile(self, cache_dir):
+        from paddle_tpu.resilience.faults import injected_faults
+        pir.compile_flat(_simple_fn, _SIMPLE_ARGS, name="t")
+        with injected_faults("compile.cache_read:1:OSError"):
+            f, r = pir.compile_flat(_simple_fn, _SIMPLE_ARGS, name="t")
+        assert r.cache.startswith("error:read") or r.cache == "miss"
+        assert abs(float(np.asarray(f(*_SIMPLE_ARGS)[0]))
+                   - _SIMPLE_WANT) < 1e-5
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: to_static through the pipeline (the tier-1 acceptance test)
+# ---------------------------------------------------------------------------
+
+class TestToStaticEndToEnd:
+    def test_llama_block_pipeline_cache_and_corruption(self, cache_dir,
+                                                       enabled_obs):
+        """to_static of a llama block runs the pass pipeline (sdpa
+        rewrite fired, fold/cse/dce counted), a second identical
+        compile is a persistent-cache hit (compile_cache_hit_total
+        moves, no re-lowering), numerics match eager, and a flipped
+        byte in the artifact recovers via a typed, counted error —
+        all on the CPU backend."""
+        layer, x = _tiny_llama_layer()
+        xt = paddle.Tensor(x)
+        eager = np.asarray(layer(xt)._data)
+
+        # --- cold: pipeline runs, pattern fires, artifact written ----------
+        sf = paddle.jit.to_static(layer.forward)
+        out1 = np.asarray(sf(xt)._data)
+        np.testing.assert_allclose(out1, eager, rtol=1e-5, atol=1e-6)
+        rep = sf.last_report
+        assert rep is not None and rep.cache == "miss"
+        assert rep.pattern_counts.get("sdpa_route") == 1
+        assert rep.pass_report["fold"]["edits"] >= 1      # fold counted
+        assert rep.pass_report["cse"]["edits"] >= 1       # cse counted
+        assert rep.pass_report["dce"]["edits"] >= 1       # dce counted
+        assert any(op.name == "pt.sdpa" for op in sf.ir_program.ops)
+        assert _counter("compile_cache_miss_total") == 1
+        assert _counter("compile_cache_write_total") == 1
+
+        # a literal second call is a signature-cache hit: no retrace at all
+        out1b = np.asarray(sf(xt)._data)
+        np.testing.assert_allclose(out1b, out1, rtol=0, atol=0)
+        assert len(sf._cache) == 1
+        assert _counter("compile_cache_miss_total") == 1   # unchanged
+
+        # --- warm: fresh wrapper, same program -> persistent-cache hit -----
+        hits0 = _counter("compile_cache_hit_total")
+        sf2 = paddle.jit.to_static(layer.forward)
+        out2 = np.asarray(sf2(xt)._data)
+        np.testing.assert_allclose(out2, eager, rtol=1e-5, atol=1e-6)
+        assert sf2.last_report.cache == "hit"
+        assert _counter("compile_cache_hit_total") == hits0 + 1
+
+        # --- corruption: flip a payload byte -> typed error + recompile ----
+        key = sf2.last_report.key
+        path = os.path.join(cache_dir, key + ".pirc")
+        blob = bytearray(open(path, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(path, "wb").write(bytes(blob))
+        sf3 = paddle.jit.to_static(layer.forward)
+        with pytest.warns(RuntimeWarning, match="sha256"):
+            out3 = np.asarray(sf3(xt)._data)
+        np.testing.assert_allclose(out3, eager, rtol=1e-5, atol=1e-6)
+        assert sf3.last_report.cache == "miss"            # recompiled
+        assert _counter("compile_cache_corrupt_total") == 1
+
+    def test_backward_through_pir_path(self):
+        """loss.backward() after a pir-compiled to_static forward."""
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 4))
+        x = paddle.Tensor(jnp.asarray(
+            np.random.RandomState(1).randn(4, 8), jnp.float32))
+        loss_e = model(x).mean()
+        loss_e.backward()
+        ref = {k: np.asarray(p.grad._data)
+               for k, p in model.named_parameters()}
+        for p in model.parameters():
+            p.clear_grad()
+        sf = paddle.jit.to_static(model.forward)
+        loss_s = sf(x).mean()
+        loss_s.backward()
+        assert sf.last_report is not None and sf.last_report.fallback is None
+        for k, p in model.named_parameters():
+            np.testing.assert_allclose(np.asarray(p.grad._data), ref[k],
+                                       rtol=1e-4, atol=1e-5, err_msg=k)
+
+    def test_pir_flag_off_uses_plain_jit(self):
+        prev = _flags.flag_value("pir")
+        try:
+            paddle.set_flags({"pir": False})
+            paddle.seed(0)
+            model = nn.Sequential(nn.Linear(4, 4))
+            sf = paddle.jit.to_static(model.forward)
+            out = sf(paddle.Tensor(jnp.ones((2, 4), jnp.float32)))
+            assert tuple(out.shape) == (2, 4)
+            assert sf.last_report is None and sf.ir_program is None
+        finally:
+            paddle.set_flags({"pir": prev})
+
+
+class TestJitSignatureCache:
+    def test_lru_cap_and_retrace_metric(self, enabled_obs):
+        prev = _flags.flag_value("jit_signature_cache_size")
+        try:
+            paddle.set_flags({"jit_signature_cache_size": 2})
+            paddle.seed(0)
+            model = nn.Sequential(nn.Linear(8, 4))
+            sf = paddle.jit.to_static(model.forward)
+            for b in (1, 2, 3):
+                sf(paddle.Tensor(jnp.ones((b, 8), jnp.float32)))
+            assert len(sf._cache) == 2          # capped, oldest evicted
+            assert _counter("jit_retrace_total") == 3
+            # LRU: re-hitting a cached signature is NOT a retrace
+            sf(paddle.Tensor(jnp.ones((3, 8), jnp.float32)))
+            assert _counter("jit_retrace_total") == 3
+            # evicted signature (b=1) retraces — churn is visible
+            sf(paddle.Tensor(jnp.ones((1, 8), jnp.float32)))
+            assert _counter("jit_retrace_total") == 4
+        finally:
+            paddle.set_flags({"jit_signature_cache_size": prev})
+
+
+class TestStaticProgramIR:
+    def test_default_main_program_prints_ops(self):
+        from paddle_tpu import static
+        layer, x = _tiny_llama_layer()
+        sf = paddle.jit.to_static(layer.forward)
+        sf(paddle.Tensor(x))
+        text = str(static.default_main_program())
+        assert "pt.sdpa" in text or "dot_general" in text
+        assert "program @" in text
+        cp = static.CompiledProgram(static.default_main_program())
+        assert "program @" in cp.to_string()
+
+    def test_program_without_ir_prints_summary(self):
+        from paddle_tpu import static
+        p = static.Program()
+        assert "no captured IR" in str(p)
+
+
+class TestServingWarmStart:
+    def test_engine_prefill_warm_start_and_decode_bypass(self, cache_dir):
+        from paddle_tpu.inference import ContinuousBatchingEngine
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=4,
+                          max_position_embeddings=64)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        prompt = np.arange(6) % 64
+
+        def run_engine():
+            eng = ContinuousBatchingEngine(
+                model, num_blocks=32, block_size=8, max_batch=2,
+                prefill_buckets=(16,))
+            rid = eng.add_request(prompt, max_new_tokens=4)
+            out = eng.run()
+            return eng, out[rid]
+
+        eng1, toks1 = run_engine()
+        rep_p1 = eng1.compile_reports["prefill.b16"]
+        assert rep_p1 is not None and rep_p1.cache == "miss"
+        # decode donates its KV pools: pipeline yes, artifact store no
+        rep_d = eng1.compile_reports["decode"]
+        assert rep_d is not None and rep_d.cache == "bypass:donate"
+
+        eng2, toks2 = run_engine()
+        assert eng2.compile_reports["prefill.b16"].cache == "hit"
+        assert toks2 == toks1                  # warm start, same tokens

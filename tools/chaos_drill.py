@@ -218,6 +218,74 @@ def drill_train_step_nonfinite(tmp):
     return "degraded", "non-finite loss skipped-with-counter; run continued"
 
 
+def _pir_compile_setup(tmp):
+    from paddle_tpu import pir
+    from paddle_tpu.framework import flags as _flags
+
+    def fn(x, y):
+        return (jnp.tanh(x @ y).sum(),)
+
+    x = jnp.ones((4, 4), jnp.float32)
+    y = jnp.eye(4, dtype=jnp.float32) * 2.0
+    want = float(np.tanh(2.0) * 16)
+    cache_dir = os.path.join(tmp, "pirc")
+    prev = _flags.flag_value("compile_cache_dir")
+    _flags.set_flags({"compile_cache_dir": cache_dir})
+    return pir, fn, [x, y], want, prev
+
+
+def drill_compile_cache_read(tmp):
+    from paddle_tpu.framework import flags as _flags
+    pir, fn, args, want, prev = _pir_compile_setup(tmp)
+    try:
+        _, rep0 = pir.compile_flat(fn, args, name="drill")   # seed artifact
+        _expect(rep0.cache == "miss", f"seed compile was {rep0.cache}")
+        with faults.injected_faults("compile.cache_read:1:OSError"):
+            warm, rep = pir.compile_flat(fn, args, name="drill")
+            inj = faults.injected_counts().get("compile.cache_read", 0)
+        _expect(inj == 1, "fault never reached the cache-read site")
+        _expect(rep.cache.startswith("error:read") or rep.cache == "miss",
+                f"read fault not surfaced in report: {rep.cache}")
+        out = float(np.asarray(warm(*args)[0]))
+        _expect(abs(out - want) < 1e-5, f"recompiled result wrong: {out}")
+        _expect(_counter("fault_injected_total",
+                         site="compile.cache_read") >= 1,
+                "injection not counted")
+        # next read must be a verified hit again (artifact intact)
+        _, rep2 = pir.compile_flat(fn, args, name="drill")
+        _expect(rep2.cache == "hit", f"artifact lost after read fault: "
+                                     f"{rep2.cache}")
+    finally:
+        _flags.set_flags({"compile_cache_dir": prev})
+    return "recovered", ("read fault degraded to recompile; artifact "
+                         "survived and re-verified as a hit")
+
+
+def drill_compile_cache_write(tmp):
+    from paddle_tpu.framework import flags as _flags
+    pir, fn, args, want, prev = _pir_compile_setup(tmp)
+    try:
+        with faults.injected_faults("compile.cache_write:1:OSError"):
+            cold, rep = pir.compile_flat(fn, args, name="drill")
+            inj = faults.injected_counts().get("compile.cache_write", 0)
+        _expect(inj == 1, "fault never reached the cache-write site")
+        _expect(rep.cache.startswith("error:write"),
+                f"write fault not surfaced in report: {rep.cache}")
+        out = float(np.asarray(cold(*args)[0]))
+        _expect(abs(out - want) < 1e-5,
+                f"compile result wrong after write fault: {out}")
+        # uncached but working: the NEXT compile misses and writes
+        _, rep2 = pir.compile_flat(fn, args, name="drill")
+        _expect(rep2.cache == "miss", f"expected miss, got {rep2.cache}")
+        _, rep3 = pir.compile_flat(fn, args, name="drill")
+        _expect(rep3.cache == "hit", f"retried write not readable: "
+                                     f"{rep3.cache}")
+    finally:
+        _flags.set_flags({"compile_cache_dir": prev})
+    return "degraded", ("write fault left the compile uncached but "
+                        "working; next compile wrote + verified")
+
+
 SCENARIOS = {
     "ckpt.chunk_write": drill_ckpt_chunk_write,
     "ckpt.metadata_replace": drill_ckpt_metadata_replace,
@@ -227,6 +295,8 @@ SCENARIOS = {
     "serve.admit": drill_serve_admit,
     "serve.decode_oom": drill_serve_decode_oom,
     "train.step_nonfinite": drill_train_step_nonfinite,
+    "compile.cache_read": drill_compile_cache_read,
+    "compile.cache_write": drill_compile_cache_write,
 }
 
 

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""IR dump + pass-pipeline inspector for the PIR-lite compiler layer.
+
+Usage:
+  python tools/ir_dump.py --example llama_block          # captured IR
+  python tools/ir_dump.py --example mlp --diff           # per-pass diff
+  python tools/ir_dump.py --example sdpa_epilogue --check
+  python tools/ir_dump.py --all --check                  # CI gate
+
+Examples are named, fixed-seed programs (a llama decoder block, an
+MLP, the fused rms-epilogue graph). For each enabled pass the tool
+prints the before/after op-count delta (and with --diff the full IR
+text). ``--check`` re-runs the final rewritten program against the
+eager reference on the same fixed seed and exits NONZERO if any
+enabled pass changed numerics — the zero-drift gate COMPILER.md
+promises (rewrites may only ever change scheduling, not math).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import pir  # noqa: E402
+from paddle_tpu.framework import core as _core  # noqa: E402
+
+TOL = dict(rtol=2e-5, atol=2e-6)
+
+
+# ---------------------------------------------------------------------------
+# named examples: () -> (flat_fn, flat_args, name)
+# ---------------------------------------------------------------------------
+
+def _layer_pure(layer, *example_tensors):
+    """Close a Layer over its parameters the way jit.to_static does."""
+    params = [p for _, p in layer.named_parameters()]
+
+    def flat_fn(*leaves):
+        p_arrays = list(leaves[:len(params)])
+        xs = leaves[len(params):]
+        saved = [(t, t._data, t._node) for t in params]
+        try:
+            for t, a in zip(params, p_arrays):
+                t._data = a
+                t._node = None
+            with _core.TraceContext():
+                out = layer(*[paddle.Tensor(x) for x in xs])
+            return (out._data,)
+        finally:
+            for t, a, n in saved:
+                t._data = a
+                t._node = n
+
+    flat = [p._data for p in params] + [t._data for t in example_tensors]
+    return flat_fn, flat
+
+
+def ex_mlp():
+    from paddle_tpu import nn
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    x = paddle.Tensor(jnp.asarray(
+        np.random.RandomState(0).randn(4, 8), jnp.float32))
+    fn, flat = _layer_pure(model, x)
+    return fn, flat
+
+
+def ex_llama_block():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaDecoderLayer
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=4,
+                      num_key_value_heads=2, dtype="float32")
+    paddle.seed(0)
+    layer = LlamaDecoderLayer(cfg)
+    layer.eval()
+    x = paddle.Tensor(jnp.asarray(
+        np.random.RandomState(0).randn(1, 16, 32), jnp.float32))
+    fn, flat = _layer_pure(layer, x)
+    return fn, flat
+
+
+def ex_sdpa_epilogue():
+    from paddle_tpu.incubate.nn.functional import fused_attention_rms_epilogue
+    rng = np.random.RandomState(0)
+    b, s, h, d = 1, 16, 4, 8
+    q, k, v, res = (jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+                    for _ in range(4))
+    w = jnp.asarray(rng.rand(d), jnp.float32)
+
+    def fn(q_, k_, v_, r_, w_):
+        with _core.TraceContext():
+            out = fused_attention_rms_epilogue(
+                paddle.Tensor(q_), paddle.Tensor(k_), paddle.Tensor(v_),
+                paddle.Tensor(r_), paddle.Tensor(w_))
+        return (out._data,)
+
+    return fn, [q, k, v, res, w]
+
+
+EXAMPLES = {
+    "mlp": ex_mlp,
+    "llama_block": ex_llama_block,
+    "sdpa_epilogue": ex_sdpa_epilogue,
+}
+
+
+# ---------------------------------------------------------------------------
+
+def run_example(name, diff=False, check=False, verbose=True):
+    """Returns True when --check passed (or wasn't requested)."""
+    fn, flat = EXAMPLES[name]()
+    eager = [np.asarray(o) for o in fn(*flat)]
+
+    prog, _ = pir.capture(fn, *flat, name=name)
+    print(f"== {name}: captured {prog.num_ops()} ops "
+          f"(hash {prog.canonical_hash()[:16]})")
+    if diff:
+        print(prog.to_string())
+
+    ok = True
+    pm = pir.PassManager.default()
+    for p in pm.passes:
+        before_ops = prog.num_ops()
+        before_txt = prog.to_string() if diff else None
+        result = p.run(prog)
+        print(f"  pass {p.name:8s} edits={result.edits:<4d} "
+              f"ops {before_ops} -> {prog.num_ops()}  [{result.notes}]")
+        if diff and result.changed:
+            _print_diff(before_txt, prog.to_string())
+        if check and result.changed:
+            got = [np.asarray(o) for o in prog.bind(*flat)]
+            for e, g in zip(eager, got):
+                if not np.allclose(e, g, **TOL):
+                    drift = float(np.max(np.abs(
+                        e.astype(np.float64) - g.astype(np.float64))))
+                    print(f"  !! pass {p.name} changed numerics for "
+                          f"{name}: max drift {drift:.3e}")
+                    ok = False
+    fused = [op.name for op in prog.ops if op.name.startswith("pt.")]
+    if fused:
+        print(f"  fused ops: {fused}")
+    if check and ok:
+        print(f"  check OK: final program matches eager on the fixed seed")
+    return ok
+
+
+def _print_diff(before, after):
+    import difflib
+    for line in difflib.unified_diff(before.splitlines(),
+                                     after.splitlines(), lineterm="",
+                                     n=1):
+        print("    " + line)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--example", choices=sorted(EXAMPLES),
+                    help="named example program")
+    ap.add_argument("--all", action="store_true", help="every example")
+    ap.add_argument("--diff", action="store_true",
+                    help="print full before/after IR per changing pass")
+    ap.add_argument("--check", action="store_true",
+                    help="exit nonzero if any enabled pass changes "
+                         "numerics vs eager on the fixed seed")
+    args = ap.parse_args()
+    names = sorted(EXAMPLES) if args.all or not args.example \
+        else [args.example]
+    ok = True
+    for n in names:
+        ok &= run_example(n, diff=args.diff, check=args.check)
+    if args.check and not ok:
+        print("IR CHECK FAILED: a pass changed numerics")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

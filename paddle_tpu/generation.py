@@ -138,6 +138,41 @@ def _llama_layer_prefill(lp, h, pos, cfg):
     return h, (k, v)
 
 
+def _llama_layer_prefill_chunk(lp, h, kc, vc, table_row, start, cfg):
+    """One layer forward over a prompt CHUNK against the paged pool (the
+    serving engine's chunked prefill): rotate the chunk's Q/K at absolute
+    positions, scatter the chunk's K/V into the pool (multi-token write),
+    then attend over every cached position `<=` the query's absolute
+    position — previous chunks plus causal-within-chunk in one softmax.
+
+    h: (1, C, H) chunk hidden states; kc/vc: ONE layer's
+    (num_blocks, block_size, KVH, D) pool slice; table_row: (max_blocks,)
+    block table of the owning sequence; start: absolute position of the
+    chunk's first token. Returns (h_out, (kc, vc)).
+    """
+    from .ops.paged_attention import (paged_attention_prefill_chunk,
+                                      write_chunk_to_cache)
+    eps, theta = cfg["eps"], cfg["theta"]
+    nh, nkv, hd = cfg["heads"], cfg["kv_heads"], cfg["head_dim"]
+    b, c, _ = h.shape                      # b == 1: one admission at a time
+    pos = start + jnp.arange(c)[None]      # (1, C) absolute positions
+    x = _rms(h, lp["input_layernorm.weight"], eps)
+    q = (x @ lp["self_attn.q_proj.weight"]).reshape(b, c, nh, hd)
+    k = (x @ lp["self_attn.k_proj.weight"]).reshape(b, c, nkv, hd)
+    v = (x @ lp["self_attn.v_proj.weight"]).reshape(b, c, nkv, hd)
+    q = _rope(q, pos, theta)
+    k = _rope(k, pos, theta)
+    kc, vc = write_chunk_to_cache(kc, vc, k[0], v[0], table_row, start)
+    attn = paged_attention_prefill_chunk(q[0], kc, vc, table_row, start,
+                                         scale=1.0 / (hd ** 0.5))
+    h = h + attn.reshape(b, c, nh * hd) @ lp["self_attn.o_proj.weight"]
+    x = _rms(h, lp["post_attention_layernorm.weight"], eps)
+    gate = x @ lp["mlp.gate_proj.weight"]
+    up = x @ lp["mlp.up_proj.weight"]
+    h = h + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
+    return h, (kc, vc)
+
+
 def _llama_layer_decode(lp, h, k_cache, v_cache, t, cfg):
     """One-token layer forward against the cache; h: (b, 1, H). The caches
     hold rotated K / V at positions < t (positions >= t are masked)."""

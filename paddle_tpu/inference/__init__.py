@@ -18,9 +18,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from .serving import (  # noqa: E402,F401
-    BackpressureError, ContinuousBatchingEngine, Request)
+    BackpressureError, ContinuousBatchingEngine, KVPoolExhaustedError,
+    Request)
 
 __all__ = ["ContinuousBatchingEngine", "Request", "BackpressureError",
+           "KVPoolExhaustedError",
            "Config", "Predictor", "Tensor", "create_predictor",
            "PrecisionType", "PlaceType", "get_version"]
 

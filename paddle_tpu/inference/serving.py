@@ -6,28 +6,38 @@ block_multi_head_attention_kernel.cu + incubate/nn/functional/
 block_multihead_attention.py): block tables, iteration-level scheduling,
 in-flight admission of new sequences while others decode.
 
-TPU-native design: TWO compiled programs serve every request mix.
-  - prefill: full-prompt forward at bucketed lengths (pad to the next
-    bucket so a handful of executables cover all prompts), returning the
-    first sampled token and the prompt's per-layer K/V for the host to
-    scatter into the block pool.
-  - decode: one token for ALL active lanes at once — fixed max_batch
-    lanes (inactive lanes masked), dense [B, max_blocks] block tables,
-    paged-attention gather over the pool (ops/paged_attention.py). Static
-    shapes mean XLA compiles each program once; admission/retirement is
-    pure host bookkeeping between steps.
+TPU-native design (round 9: fused multi-token decode): TWO compiled
+program families serve every request mix.
+
+  - chunked prefill: a prompt is split into fixed-width chunks; each
+    chunk forward writes its K/V into the paged pool (multi-token
+    scatter) and attends over all previously cached positions, so a
+    1024-token prompt interleaves with decode steps instead of
+    head-of-line-blocking every active lane. One compiled program per
+    chunk width.
+  - fused K-step decode: ONE `lax.scan` advances all lanes
+    `decode_steps` tokens per dispatch — on-device greedy argmax (and
+    on-device per-lane categorical sampling for sampled lanes),
+    on-device paged-cache writes, on-device EOS/length masking —
+    returning a [B, K] token tile instead of one token per host
+    round-trip.
+
+Lane state (block tables, seq lens, next-token ids, alive mask, sampling
+knobs) is DEVICE-RESIDENT: uploaded only when lane membership changes
+(admission / retire / shed), never rebuilt from numpy in the steady
+state (`serving_lane_state_uploads_total` counts refreshes). Dispatch is
+double-buffered: tile N+1 is enqueued before tile N's tokens are read
+back, so host bookkeeping overlaps device compute
+(`serving_dispatch_ahead_depth`, `serving_hostsync_seconds`).
+
 Memory is allocated in block_size granules from one (L, num_blocks, ...)
 pool — no per-sequence max-length reservation, exactly the property the
 reference's block attention exists for.
-
-Prefill attention is routed per bucket shape by the same baked backend
-ledger as training (ops/pallas/attention_router, consulted inside
-generation._llama_layer_prefill at trace time); `attention_route` keeps
-the largest bucket's decision for audit.
 """
 
 from __future__ import annotations
 
+import os
 import time
 import warnings
 from collections import deque
@@ -37,13 +47,19 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..generation import _llama_layer_prefill, _rms, _rope
+from ..generation import _llama_layer_prefill_chunk, _rms, _rope
 from ..observability import span as _span
 from ..observability.catalog import metric as _metric
-from ..ops.paged_attention import paged_attention_decode, write_to_cache
+from ..ops.paged_attention import (paged_attention_decode_inner,
+                                   write_to_cache)
 from ..resilience.faults import FaultInjected, fault_point
 
-__all__ = ["ContinuousBatchingEngine", "Request", "BackpressureError"]
+__all__ = ["ContinuousBatchingEngine", "Request", "BackpressureError",
+           "KVPoolExhaustedError"]
+
+# exception classes that mean "transient trouble, retry next step" when
+# they surface from an admission / prefill-chunk / host-sync seam
+_TRANSIENT_ERRORS = (TimeoutError, ConnectionError, OSError, FaultInjected)
 
 
 class BackpressureError(RuntimeError):
@@ -52,11 +68,19 @@ class BackpressureError(RuntimeError):
     that is the backpressure signal, instead of unbounded queueing."""
 
 
+class KVPoolExhaustedError(MemoryError):
+    """The paged KV pool has no free block for a reservation. A typed
+    MemoryError subclass so the existing shed/defer-on-MemoryError paths
+    keep working while callers (and the metrics catalog:
+    serving_pool_exhausted_total) can tell pool pressure apart from a
+    real device OOM."""
+
+
 class Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
                  "generated", "done", "do_sample", "temperature", "top_k",
-                 "top_p", "rng", "t_arrival", "deadline_s", "t_deadline",
-                 "finish_reason", "shed_count")
+                 "top_p", "rng", "sample_seed", "t_arrival", "deadline_s",
+                 "t_deadline", "finish_reason", "shed_count")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
@@ -72,8 +96,16 @@ class Request:
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         # None -> OS entropy: concurrent sampled requests must differ by
-        # default; a fixed seed is the explicit-reproducibility opt-in
+        # default; a fixed seed is the explicit-reproducibility opt-in.
+        # The same seed feeds the host RandomState (first token, sampled
+        # at prefill) and the device per-lane PRNG lane key (decode
+        # tokens, folded with the absolute position so the stream is
+        # identical no matter how decode steps are tiled).
         self.rng = np.random.RandomState(seed)
+        self.sample_seed = (np.uint32(seed & 0xFFFFFFFF)
+                            if seed is not None else
+                            np.uint32(int.from_bytes(os.urandom(4),
+                                                     "little")))
         self.t_arrival = time.perf_counter()   # TTFT anchor
         # degraded completions are distinguishable: finish_reason is one
         # of eos / length / timeout / shed / rejected (None while live)
@@ -84,9 +116,10 @@ class Request:
         self.shed_count = 0
 
     def choose(self, logits: np.ndarray) -> int:
-        """Per-request next-token choice on the host (B is small; the
-        reference's top_p_sampling semantics: temperature -> top-k ->
-        nucleus filter -> categorical)."""
+        """Per-request next-token choice on the host — used for the
+        FIRST token only (sampled once per request at prefill; decode
+        tokens are chosen on device inside the fused scan). Semantics:
+        temperature -> top-k -> nucleus filter -> categorical."""
         if not self.do_sample:
             return int(np.argmax(logits))
         z = logits.astype(np.float64) / max(self.temperature, 1e-6)
@@ -138,7 +171,8 @@ class _LayeredBlockPool:
         need = self.blocks_needed(n_tokens)
         while len(table) < need:
             if not self._free:
-                raise MemoryError("paged KV pool exhausted")
+                _metric("serving_pool_exhausted_total").inc()
+                raise KVPoolExhaustedError("paged KV pool exhausted")
             table.append(self._free.pop())
         return table
 
@@ -146,39 +180,67 @@ class _LayeredBlockPool:
         for b in self.tables.pop(rid, []):
             self._free.append(b)
 
-    def write_prompt(self, rid, ks, vs, length):
-        """ks/vs: (L, S_pad, KVH, D); writes the first `length` positions."""
-        table = self.ensure(rid, length)
-        bs = self.block_size
-        span = len(table) * bs
-        pad = span - ks.shape[1]
-        if pad > 0:
-            ks = jnp.pad(ks, ((0, 0), (0, pad), (0, 0), (0, 0)))
-            vs = jnp.pad(vs, ((0, 0), (0, pad), (0, 0), (0, 0)))
-        elif pad < 0:
-            ks = ks[:, :span]
-            vs = vs[:, :span]
-        ids = jnp.asarray(table, jnp.int32)
-        L = ks.shape[0]
-        kb = ks.reshape(L, len(table), bs, *ks.shape[2:])
-        vb = vs.reshape(L, len(table), bs, *vs.shape[2:])
-        self.k = self.k.at[:, ids].set(kb)
-        self.v = self.v.at[:, ids].set(vb)
+
+class _PrefillTask:
+    """A prompt being prefilled chunk-by-chunk: `pieces` is the
+    precomputed (start, width) plan; the task owns its lane (the lane is
+    occupied but NOT decode-active until the final chunk completes)."""
+
+    __slots__ = ("req", "lane", "pieces", "idx")
+
+    def __init__(self, req, lane, pieces):
+        self.req = req
+        self.lane = lane
+        self.pieces = pieces
+        self.idx = 0
+
+
+class _Inflight:
+    """One dispatched-but-unread decode tile: the [B, K] token tile
+    future plus the lane snapshot (request refs + lane epochs) needed to
+    credit tokens only to lanes whose occupancy did not change while the
+    tile was in flight."""
+
+    __slots__ = ("tile", "t_dispatch", "reqs", "epochs", "k", "covers_all")
+
+    def __init__(self, tile, t_dispatch, reqs, epochs, k, covers_all):
+        self.tile = tile
+        self.t_dispatch = t_dispatch
+        self.reqs = reqs
+        self.epochs = epochs
+        self.k = k
+        self.covers_all = covers_all
 
 
 class ContinuousBatchingEngine:
-    """Iteration-level scheduler: admit -> decode-step -> retire.
+    """Iteration-level scheduler: admit -> fused decode tile -> retire.
 
     model: LlamaForCausalLM. Per-request decoding knobs (greedy default;
-    do_sample with temperature/top_k/top_p + per-request seed) are applied
-    host-side on the returned logits row — mixed greedy/sampled lanes
-    share one compiled decode step.
+    do_sample with temperature/top_k/top_p + per-request seed) ride the
+    device-resident lane state — mixed greedy/sampled lanes share one
+    compiled fused decode step.
+
+    Tuning knobs (PERF.md "Fused multi-token serving decode"):
+      decode_steps: tokens every lane advances per dispatch (the K of
+        the fused scan). 1 reproduces the old step-per-token engine.
+      prefill_chunk: max prompt tokens per prefill chunk (default: the
+        largest prefill bucket — prompts beyond it now chunk instead of
+        being rejected).
+      prefill_chunks_per_step: chunks advanced per engine step while
+        decode lanes are active (back-to-back when none are).
+      compat_step_loop: reproduce the pre-fused host-bound loop —
+        decode_steps forced to 1, lane state rebuilt from numpy and
+        re-uploaded EVERY step, every tile drained synchronously (no
+        dispatch-ahead). The bench A/B baseline, and a fully-synchronous
+        debug mode (nothing in flight between steps).
     """
 
     def __init__(self, model, num_blocks=256, block_size=16, max_batch=8,
                  max_blocks_per_seq=64,
                  prefill_buckets=(64, 128, 256, 512, 1024),
-                 max_queue=None, max_sheds=2):
+                 max_queue=None, max_sheds=2, decode_steps=4,
+                 prefill_chunk=None, prefill_chunks_per_step=1,
+                 compat_step_loop=False):
         config = model.config
         self.cfg = dict(eps=config.rms_norm_eps, theta=config.rope_theta,
                         heads=config.num_attention_heads,
@@ -203,15 +265,25 @@ class ContinuousBatchingEngine:
         self.max_batch = int(max_batch)
         self.max_blocks_per_seq = int(max_blocks_per_seq)
         self.buckets = tuple(sorted(prefill_buckets))
+        self.compat_step_loop = bool(compat_step_loop)
+        self.decode_steps = (1 if self.compat_step_loop
+                             else max(1, int(decode_steps)))
+        self.chunk = int(prefill_chunk or self.buckets[-1])
+        self.prefill_chunks_per_step = max(1, int(prefill_chunks_per_step))
+        # chunk widths a prefill piece may compile at: every bucket that
+        # fits inside a chunk, plus the chunk width itself (the tail
+        # piece pads to the smallest width that fits)
+        self._chunk_widths = sorted(
+            {b for b in self.buckets if b <= self.chunk} | {self.chunk})
         # prefill attention backend comes from the same baked per-shape
-        # router/ledger as the train path (generation._llama_layer_prefill
-        # consults it per bucket at trace time); keep the largest bucket's
+        # router/ledger as the train path; keep the largest width's
         # decision here for audit/metrics
         try:
             from ..ops.pallas.attention_router import route
             self.attention_route = route(
-                self.cfg["heads"], self.buckets[-1], self.buckets[-1],
-                self.cfg["head_dim"], self.embed_w.dtype, True)
+                self.cfg["heads"], self._chunk_widths[-1],
+                self._chunk_widths[-1], self.cfg["head_dim"],
+                self.embed_w.dtype, True)
         except (ImportError, OSError, ValueError, KeyError) as e:
             # audit-only probe: a missing/broken ledger must not stop the
             # engine, but it is logged + counted, never silently nulled
@@ -226,14 +298,26 @@ class ContinuousBatchingEngine:
         self.lanes: list[Request | None] = [None] * self.max_batch
         self.lane_len = np.zeros(self.max_batch, np.int64)  # tokens in cache
         self.lane_tok = np.zeros(self.max_batch, np.int64)  # next to write
+        # occupancy epoch per lane: bumped on every retire/shed/assign so
+        # an in-flight tile can never credit tokens across an occupancy
+        # change (the lane snapshot carries the epochs it was dispatched
+        # under)
+        self._lane_epoch = np.zeros(self.max_batch, np.int64)
         self.queue: deque[Request] = deque()
         self.finished: dict[int, Request] = {}
         self._next_rid = 0
-        self._prefill_jit = {}
-        self._decode_jit = None
-        # PIR compile pipeline reports per program (prefill.b<bucket> /
-        # decode): cache hit/miss + pass stats — the engine warm-start
-        # evidence bench.py and tests read
+        self._prefill_jit = {}                 # chunk width -> pir_jit
+        self._prefill_tasks: dict[int, _PrefillTask] = {}
+        self._decode_jit = {}                  # variant -> pir_jit
+        # device-resident lane state (toks/lens/alive/rem/eos/tables +
+        # sampling knobs); rebuilt from the host mirrors ONLY when
+        # membership changes (self._dirty)
+        self._dev = None
+        self._dirty = True
+        self._inflight: deque[_Inflight] = deque()
+        # PIR compile pipeline reports per program (prefill.b<width> /
+        # decode[.sampled]): cache hit/miss + pass stats — the engine
+        # warm-start evidence bench.py and tests read
         self.compile_reports: dict[str, object] = {}
         # observability handles bound ONCE (catalog names; no-op when the
         # layer is disabled — each call is a single flag check)
@@ -246,6 +330,12 @@ class ContinuousBatchingEngine:
         self._m_admitted = _metric("serving_admitted_total")
         self._m_retired = _metric("serving_retired_total")
         self._m_tokens = _metric("serving_tokens_total")
+        self._m_uploads = _metric("serving_lane_state_uploads_total")
+        self._m_dispatches = _metric("serving_decode_dispatches_total")
+        self._m_ahead = _metric("serving_dispatch_ahead_depth")
+        self._m_hostsync = _metric("serving_hostsync_seconds")
+        self._m_hostsync_retries = _metric("serving_hostsync_retries_total")
+        self._m_chunks = _metric("serving_prefill_chunks_total")
         _metric("serving_preempted_total")  # declared: 0 by design
 
     # --- public API -------------------------------------------------------
@@ -269,7 +359,8 @@ class ContinuousBatchingEngine:
         return rid
 
     def has_work(self):
-        return bool(self.queue) or any(r is not None for r in self.lanes)
+        return (bool(self.queue) or any(r is not None for r in self.lanes)
+                or bool(self._inflight))
 
     def run(self, max_steps=10_000):
         """Drive to completion; returns {rid: [generated tokens]}."""
@@ -285,10 +376,16 @@ class ContinuousBatchingEngine:
             self._expire_deadlines()
             self._m_queue.set(len(self.queue))
             self._admit()
-            self._decode_step()
+            self._run_prefill_tasks()
+            self._decode_phase()
             self._m_occ.set(sum(r is not None for r in self.lanes)
                             / self.max_batch)
             self._m_free.set(len(self.pool._free))
+
+    def _decode_active(self):
+        """Lanes the fused decode advances: occupied AND past prefill."""
+        return [i for i, r in enumerate(self.lanes)
+                if r is not None and i not in self._prefill_tasks]
 
     # --- graceful degradation --------------------------------------------
     def _finish(self, req, reason):
@@ -299,17 +396,20 @@ class ContinuousBatchingEngine:
 
     def _retire_lane(self, lane, reason):
         req = self.lanes[lane]
+        self._prefill_tasks.pop(lane, None)
         self.pool.release(req.rid)
         self.lanes[lane] = None
         self.lane_len[lane] = 0
+        self._lane_epoch[lane] += 1
+        self._dirty = True
         self._m_retired.inc()
         self._finish(req, reason)
 
     def _expire_deadlines(self):
         """Per-request deadlines: an expired queued request finishes
-        empty; an expired decoding lane finishes with the tokens it has
-        (a degraded-but-distinguishable completion) and its pool blocks
-        are released."""
+        empty; an expired decoding (or prefilling) lane finishes with the
+        tokens it has (a degraded-but-distinguishable completion) and its
+        pool blocks are released."""
         now = time.perf_counter()
         if any(r.t_deadline is not None and now >= r.t_deadline
                for r in self.queue):
@@ -328,17 +428,21 @@ class ContinuousBatchingEngine:
                 self._retire_lane(lane, "timeout")
 
     def _shed(self, active):
-        """Decode-step OOM: preempt the lane with the least work done
-        (fewest generated tokens), release its blocks, and requeue the
-        request at the FRONT of the queue for a fresh prefill. A request
-        shed more than max_sheds times finishes degraded
-        (finish_reason='shed') instead of thrashing the pool forever."""
+        """Decode OOM: preempt the lane with the least work done (fewest
+        generated tokens), release its blocks, and requeue the request at
+        the FRONT of the queue for a fresh prefill. A request shed more
+        than max_sheds times finishes degraded (finish_reason='shed')
+        instead of thrashing the pool forever."""
+        self._dirty = True
+        if not active:
+            return
         victim = max(active,
                      key=lambda i: (-len(self.lanes[i].generated), i))
         req = self.lanes[victim]
         self.pool.release(req.rid)
         self.lanes[victim] = None
         self.lane_len[victim] = 0
+        self._lane_epoch[victim] += 1
         req.shed_count += 1
         _metric("serving_shed_total").inc()
         if req.shed_count > self.max_sheds:
@@ -347,20 +451,26 @@ class ContinuousBatchingEngine:
             return
         # restart from the prompt next admission: the KV blocks are gone,
         # and greedy decode reproduces the same prefix deterministically
+        # (sampled lanes re-derive the same stream from (seed, position))
         req.generated = []
         self.queue.appendleft(req)
 
+    # --- admission / chunked prefill -------------------------------------
     def _admit(self):
+        """Reserve lanes + pool blocks for queued requests; the prompts
+        themselves prefill chunk-by-chunk in _run_prefill_tasks so a long
+        admission never head-of-line-blocks the decode lanes."""
         while self.queue:
             free_lanes = [i for i, r in enumerate(self.lanes) if r is None]
             if not free_lanes:
                 return
             req = self.queue[0]
             total = req.prompt.size + req.max_new_tokens
-            if (total > self.max_blocks_per_seq * self.pool.block_size
-                    or req.prompt.size > self.buckets[-1]):
+            if total > self.max_blocks_per_seq * self.pool.block_size:
                 # cannot ever serve: reject with an empty result instead
-                # of crashing the engine mid-step
+                # of crashing the engine mid-step (prompts longer than
+                # the largest bucket are now served via chunking; only
+                # the per-sequence block budget is a hard wall)
                 self.queue.popleft()
                 req.generated = []
                 self._finish(req, "rejected")
@@ -380,15 +490,10 @@ class ContinuousBatchingEngine:
             lane = free_lanes[0]
             try:
                 fault_point("serve.admit", rid=req.rid)
-                with _span("serving.prefill", rid=req.rid,
-                           prompt=int(req.prompt.size)):
-                    t0 = time.perf_counter()
-                    first_tok = self._prefill(req)
-                    self._m_prefill.observe(time.perf_counter() - t0)
-                # reserve the FULL footprint now — lazy per-step allocation
-                # could exhaust the pool mid-decode across admitted
-                # sequences, which the admission check above promised
-                # cannot happen
+                # reserve the FULL footprint now — lazy per-step
+                # allocation could exhaust the pool mid-decode across
+                # admitted sequences, which the can_fit gate above
+                # promised cannot happen
                 self.pool.ensure(req.rid, total)
             except MemoryError:
                 # pool exhausted despite the can_fit gate (e.g. blocks
@@ -401,8 +506,7 @@ class ContinuousBatchingEngine:
                 _metric("serving_deferred_total",
                         reason="pool_exhausted").inc()
                 return
-            except (TimeoutError, ConnectionError, OSError,
-                    FaultInjected):
+            except _TRANSIENT_ERRORS:
                 # transient admission failure (store/IO blip or injected
                 # fault): same counted-deferral contract — requeued at
                 # the front, retried next step, scheduler stays alive
@@ -412,11 +516,115 @@ class ContinuousBatchingEngine:
                         reason="admit_fault").inc()
                 return
             self.lanes[lane] = req
-            self.lane_len[lane] = req.prompt.size
-            self.lane_tok[lane] = first_tok
-            self._m_admitted.inc()
-            self._m_ttft.observe(time.perf_counter() - req.t_arrival)
-            self._emit(lane, first_tok)
+            self._lane_epoch[lane] += 1
+            self._prefill_tasks[lane] = _PrefillTask(
+                req, lane, self._chunk_plan(req.prompt.size))
+
+    def _chunk_plan(self, s):
+        """(start, width) pieces covering a prompt of s tokens: full
+        chunks, then a tail padded to the smallest chunk width that
+        fits."""
+        pieces = []
+        start = 0
+        while s - start > self.chunk:
+            pieces.append((start, self.chunk))
+            start += self.chunk
+        rem = s - start
+        width = next(w for w in self._chunk_widths if w >= rem)
+        pieces.append((start, width))
+        return pieces
+
+    def _run_prefill_tasks(self):
+        """Advance every in-flight prefill by up to
+        prefill_chunks_per_step chunks (all remaining chunks when no
+        lane is decoding — there is no one to block)."""
+        if not self._prefill_tasks:
+            return
+        decode_busy = bool(self._decode_active())
+        for lane in sorted(self._prefill_tasks):
+            task = self._prefill_tasks.get(lane)
+            if task is None:
+                continue
+            budget = (self.prefill_chunks_per_step if decode_busy
+                      else len(task.pieces) - task.idx)
+            try:
+                with _span("serving.prefill", rid=task.req.rid,
+                           prompt=int(task.req.prompt.size)):
+                    for _ in range(max(1, budget)):
+                        if self._prefill_one_chunk(task):
+                            break
+            except MemoryError:
+                self._abort_prefill(task, "prefill_oom")
+                return
+            except _TRANSIENT_ERRORS:
+                self._abort_prefill(task, "prefill_fault")
+                return
+
+    def _abort_prefill(self, task, reason):
+        """A chunk failed: give back the blocks + lane and requeue the
+        request at the front for a fresh prefill next step."""
+        self.pool.release(task.req.rid)
+        self.lanes[task.lane] = None
+        self.lane_len[task.lane] = 0
+        self._lane_epoch[task.lane] += 1
+        self._prefill_tasks.pop(task.lane, None)
+        self.queue.appendleft(task.req)
+        _metric("serving_deferred_total", reason=reason).inc()
+
+    def _prefill_one_chunk(self, task):
+        """Run one chunk forward; on the final chunk, sample the first
+        token and activate the lane. Returns True when the task is
+        done."""
+        req = task.req
+        start, width = task.pieces[task.idx]
+        s = req.prompt.size
+        fault_point("serve.prefill_chunk", rid=req.rid, start=start)
+        fn = self._prefill_jit.get(width)
+        if fn is None:
+            # engine warm-start: prefill programs compile through the PIR
+            # pipeline — pattern-rewritten pre-XLA and, with
+            # FLAGS_compile_cache_dir set, warm-loaded from the
+            # persistent compile cache instead of paying the cold XLA
+            # compile
+            from ..pir import pir_jit
+            fn = pir_jit(self._make_prefill_chunk(),
+                         name=f"serving.prefill.b{width}")
+            self._prefill_jit[width] = fn
+            self.compile_reports[f"prefill.b{width}"] = None
+        n_real = min(width, s - start)
+        ids = np.zeros((1, width), np.int32)
+        ids[0, :n_real] = req.prompt[start:start + n_real]
+        table = np.full(self.max_blocks_per_seq, self.pool.scratch_block,
+                        np.int32)
+        t = self.pool.tables[req.rid]
+        table[:len(t)] = t
+        is_final = task.idx == len(task.pieces) - 1
+        last_idx = (s - 1 - start) if is_final else 0
+        t0 = time.perf_counter()
+        logits, self.pool.k, self.pool.v = fn(
+            self.stacked, self.embed_w, self.norm_w, self._out_w,
+            self.pool.k, self.pool.v, jnp.asarray(ids), jnp.int32(start),
+            jnp.int32(last_idx), jnp.asarray(table))
+        self._m_prefill.observe(time.perf_counter() - t0)
+        self._m_chunks.inc()
+        if self.compile_reports.get(f"prefill.b{width}") is None:
+            self.compile_reports[f"prefill.b{width}"] = \
+                getattr(fn, "report", None)
+        task.idx += 1
+        if not is_final:
+            return False
+        # final chunk: first token on the host (once per request), lane
+        # becomes decode-active -> membership change
+        first_tok = req.choose(np.asarray(logits).reshape(-1))
+        lane = task.lane
+        self._prefill_tasks.pop(lane, None)
+        self.lane_len[lane] = s
+        self.lane_tok[lane] = first_tok
+        self._dirty = True
+        self._m_admitted.inc()
+        self._m_ttft.observe(time.perf_counter() - req.t_arrival)
+        self._emit(lane, first_tok)
+        return True
 
     def _emit(self, lane, token):
         req = self.lanes[lane]
@@ -428,66 +636,35 @@ class ContinuousBatchingEngine:
         elif len(req.generated) >= req.max_new_tokens:
             self._retire_lane(lane, "length")
 
-    # --- compiled programs ------------------------------------------------
-    def _bucket(self, n):
-        for b in self.buckets:
-            if n <= b:
-                return b
-        raise ValueError(f"prompt length {n} exceeds the largest prefill "
-                         f"bucket {self.buckets[-1]}")
-
-    def _prefill(self, req):
-        s = req.prompt.size
-        bucket = self._bucket(s)
-        fn = self._prefill_jit.get(bucket)
-        if fn is None:
-            # engine warm-start: prefill programs compile through the PIR
-            # pipeline — pattern-rewritten pre-XLA and, with
-            # FLAGS_compile_cache_dir set, warm-loaded from the persistent
-            # compile cache instead of paying the cold XLA compile
-            from ..pir import pir_jit
-            fn = pir_jit(self._make_prefill(),
-                         name=f"serving.prefill.b{bucket}")
-            self._prefill_jit[bucket] = fn
-            self.compile_reports[f"prefill.b{bucket}"] = None
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :s] = req.prompt
-        logits, ks, vs = fn(self.stacked, self.embed_w, self.norm_w,
-                            self._out_w, jnp.asarray(ids), jnp.int32(s))
-        if self.compile_reports.get(f"prefill.b{bucket}") is None:
-            self.compile_reports[f"prefill.b{bucket}"] = \
-                getattr(fn, "report", None)
-        self.pool.write_prompt(req.rid, ks[:, 0], vs[:, 0], s)
-        return req.choose(np.asarray(logits).reshape(-1))
-
-    def _make_prefill(self):
-        cfg = self.cfg
-
-        def run(stacked, embed_w, norm_w, head_w, ids, length):
-            b, s = ids.shape
-            pos = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
-
-            def layer(h, lp):
-                h, (k, v) = _llama_layer_prefill(lp, h, pos, cfg)
-                return h, (k, v)
-
-            h = jnp.take(embed_w, ids, axis=0)
-            h, (ks, vs) = jax.lax.scan(layer, h, stacked)
-            h_last = h[:, length - 1]          # dynamic index: traced length
-            logits = (_rms(h_last, norm_w, cfg["eps"]) @ head_w).astype(
-                jnp.float32)
-            return logits, ks, vs
-
-        return run
-
-    def _decode_step(self):
-        active = [i for i, r in enumerate(self.lanes) if r is not None]
+    # --- fused decode: dispatch / overlap / drain -------------------------
+    def _decode_phase(self):
+        """Double-buffered fused decode: dispatch tile N+1, then read
+        back + book-keep tile N while the device computes. Membership
+        changes force a drain + lane-state re-upload (the only time
+        numpy touches the device state)."""
+        if self.compat_step_loop:
+            self._dirty = True      # pre-fused loop: re-upload every step
+        active = self._decode_active()
         if not active:
+            if self._inflight:
+                self._drain_all()
             return
+        if self._inflight and (self._dirty
+                               or self._inflight[-1].covers_all
+                               or len(self._inflight) >= 2):
+            if not self._drain_all():
+                return                 # transient host-sync fault: retry
+            active = self._decode_active()
+            if not active:
+                return
+        if self._dirty or self._dev is None:
+            self._upload_lane_state(active)
         t0 = time.perf_counter()
         try:
-            with _span("serving.decode_step", active=len(active)):
-                self._decode_step_inner(active)
+            fault_point("serve.decode_oom", active=len(active))
+            with _span("serving.decode_step", active=len(active),
+                       k=self.decode_steps):
+                tile = self._dispatch()
         except MemoryError:
             # device OOM (or the serve.decode_oom fault site): shed one
             # lane and requeue it rather than killing every in-flight
@@ -499,95 +676,277 @@ class ContinuousBatchingEngine:
                 self._shed(active)
                 return
             raise
-        # one compiled step advances every active lane one token, so the
-        # step wall time IS the per-token latency (TPOT)
-        self._m_tpot.observe(time.perf_counter() - t0)
+        self._m_dispatches.inc()
+        self._m_ahead.set(len(self._inflight))
+        K = self.decode_steps
+        prev_reqs = self._inflight[-1].reqs if self._inflight else None
+        covers_all = all(
+            (self.lanes[i].max_new_tokens - len(self.lanes[i].generated)
+             - (K if prev_reqs is not None and prev_reqs[i]
+                is self.lanes[i] else 0)) <= K
+            for i in active)
+        # snapshot only DECODE-ACTIVE lanes: a lane that is occupied but
+        # still prefilling was masked dead on device — its tile row is
+        # filler and must never be credited
+        active_set = set(active)
+        snap = [self.lanes[i] if i in active_set else None
+                for i in range(self.max_batch)]
+        self._inflight.append(_Inflight(
+            tile, t0, snap, self._lane_epoch.copy(), K, covers_all))
+        # overlapped host bookkeeping: process the PREVIOUS tile while
+        # the device runs this one (compat mode drains its own tile too:
+        # the old engine blocked on every token)
+        keep = 0 if self.compat_step_loop else 1
+        while len(self._inflight) > keep:
+            if not self._drain_one():
+                break
 
-    def _decode_step_inner(self, active):
-        fault_point("serve.decode_oom", active=len(active))
-        B = self.max_batch
-        MB = self.max_blocks_per_seq
-        # inactive lanes write into the pool's scratch block (their rows
-        # would otherwise point at block 0, corrupting a live sequence);
-        # active lanes' blocks were fully reserved at admission
-        tables = np.full((B, MB), self.pool.scratch_block, np.int32)
-        for i in active:
-            t = self.pool.tables[self.lanes[i].rid]
-            tables[i, :len(t)] = t
-        lens = np.zeros(B, np.int32)
-        for i in active:
-            lens[i] = self.lane_len[i]
-        toks = np.zeros(B, np.int32)
-        for i in active:
-            toks[i] = self.lane_tok[i]
-        mask = np.zeros(B, bool)
-        mask[active] = True
-
-        if self._decode_jit is None:
+    def _dispatch(self):
+        d = self._dev
+        variant = d["variant"]
+        fn = self._decode_jit.get(variant)
+        if fn is None:
             # decode keeps donation (the KV pools must not double-buffer),
             # so the pipeline runs but the artifact store is bypassed
             # (pir reports cache="bypass:donate")
             from ..pir import pir_jit
-            self._decode_jit = pir_jit(self._make_decode(),
-                                       name="serving.decode",
-                                       donate_argnums=(4, 5))
-        logits, self.pool.k, self.pool.v = self._decode_jit(
-            self.stacked, self.embed_w, self.norm_w, self._out_w,
-            self.pool.k, self.pool.v, jnp.asarray(toks), jnp.asarray(tables),
-            jnp.asarray(lens), jnp.asarray(mask))
-        if self.compile_reports.get("decode") is None:
-            self.compile_reports["decode"] = getattr(self._decode_jit,
-                                                     "report", None)
-        if any(self.lanes[i].do_sample for i in active):
-            logits_np = np.asarray(logits)
-            chosen = {i: self.lanes[i].choose(logits_np[i]) for i in active}
-        else:
-            # all-greedy (the serving default): argmax on device, transfer
-            # B ints instead of the (B, vocab) fp32 logits every token
-            nxt_all = np.asarray(jnp.argmax(logits, axis=-1))
-            chosen = {i: int(nxt_all[i]) for i in active}
-        for i in active:
-            nxt = chosen[i]
-            self.lane_len[i] += 1
-            self.lane_tok[i] = nxt
-            self._emit(i, nxt)
+            name = ("serving.decode" if variant == "greedy"
+                    else "serving.decode.sampled")
+            fn = pir_jit(self._make_decode(variant == "sampled"),
+                         name=name, donate_argnums=(4, 5))
+            self._decode_jit[variant] = fn
+        args = [self.stacked, self.embed_w, self.norm_w, self._out_w,
+                self.pool.k, self.pool.v, d["toks"], d["lens"], d["alive"],
+                d["rem"], d["eos"], d["tables"]]
+        if variant == "sampled":
+            args += [d["seeds"], d["do_sample"], d["temp"], d["top_k"],
+                     d["top_p"]]
+        (tile, d["toks"], d["lens"], d["alive"], d["rem"],
+         self.pool.k, self.pool.v) = fn(*args)
+        key = "decode" if variant == "greedy" else "decode.sampled"
+        if self.compile_reports.get(key) is None:
+            self.compile_reports[key] = getattr(fn, "report", None)
+        return tile
 
-    def _make_decode(self):
+    def _drain_all(self):
+        while self._inflight:
+            if not self._drain_one():
+                return False
+        return True
+
+    def _drain_one(self):
+        """Read back the oldest in-flight tile and run host bookkeeping.
+        Returns False on a transient host-sync fault (tile kept, retried
+        next step)."""
+        infl = self._inflight[0]
+        try:
+            fault_point("serve.hostsync_read")
+            t0 = time.perf_counter()
+            arr = np.asarray(infl.tile)
+        except MemoryError:
+            self._inflight.popleft()
+            self._shed(self._decode_active())
+            return True
+        except _TRANSIENT_ERRORS:
+            self._m_hostsync_retries.inc()
+            return False
+        except Exception as e:  # noqa: BLE001 — XLA OOM is backend-typed
+            if "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e):
+                self._inflight.popleft()
+                self._shed(self._decode_active())
+                return True
+            raise
+        t1 = time.perf_counter()
+        self._inflight.popleft()
+        self._m_hostsync.observe(t1 - t0)
+        # one fused dispatch advances every active lane K tokens, so the
+        # dispatch->readback wall time over K IS the per-token latency
+        self._m_tpot.observe((t1 - infl.t_dispatch) / infl.k)
+        self._process_tile(arr, infl)
+        return True
+
+    def _process_tile(self, tile, infl):
+        """Credit a [B, K] token tile: walk each lane's K tokens with the
+        SAME eos/length rules the device applied, so host mirrors and
+        device carry stay in lockstep without reading lens/alive back."""
+        for lane in range(self.max_batch):
+            req = infl.reqs[lane]
+            if (req is None or req.done
+                    or self.lanes[lane] is not req
+                    or self._lane_epoch[lane] != infl.epochs[lane]):
+                continue            # occupancy changed while in flight
+            for k in range(infl.k):
+                self.lane_len[lane] += 1
+                tok = int(tile[lane, k])
+                self.lane_tok[lane] = tok
+                self._emit(lane, tok)
+                if req.done or self.lanes[lane] is not req:
+                    break
+
+    # --- device-resident lane state ---------------------------------------
+    def _upload_lane_state(self, active):
+        """Rebuild the device lane state from the host mirrors — called
+        ONLY on membership change (admission / retire / shed / recovery),
+        never in the steady state. Counted so the A/B evidence can show
+        uploads << dispatches."""
+        B, MB = self.max_batch, self.max_blocks_per_seq
+        tables = np.full((B, MB), self.pool.scratch_block, np.int32)
+        lens = np.zeros(B, np.int32)
+        toks = np.zeros(B, np.int32)
+        alive = np.zeros(B, bool)
+        rem = np.zeros(B, np.int32)
+        eos = np.full(B, -1, np.int32)
+        sampled = any(self.lanes[i].do_sample for i in active)
+        if sampled:
+            seeds = np.zeros(B, np.uint32)
+            do_s = np.zeros(B, bool)
+            temp = np.ones(B, np.float32)
+            top_k = np.zeros(B, np.int32)
+            top_p = np.ones(B, np.float32)
+        for i in active:
+            r = self.lanes[i]
+            t = self.pool.tables[r.rid]
+            tables[i, :len(t)] = t
+            lens[i] = self.lane_len[i]
+            toks[i] = self.lane_tok[i]
+            alive[i] = True
+            rem[i] = r.max_new_tokens - len(r.generated)
+            if r.eos_token_id is not None:
+                eos[i] = r.eos_token_id
+            if sampled and r.do_sample:
+                do_s[i] = True
+                seeds[i] = r.sample_seed
+                temp[i] = max(r.temperature, 1e-6)
+                top_k[i] = r.top_k
+                top_p[i] = r.top_p
+        dev = dict(variant="sampled" if sampled else "greedy",
+                   toks=jnp.asarray(toks), lens=jnp.asarray(lens),
+                   alive=jnp.asarray(alive), rem=jnp.asarray(rem),
+                   eos=jnp.asarray(eos), tables=jnp.asarray(tables))
+        if sampled:
+            dev.update(seeds=jnp.asarray(seeds), do_sample=jnp.asarray(do_s),
+                       temp=jnp.asarray(temp), top_k=jnp.asarray(top_k),
+                       top_p=jnp.asarray(top_p))
+        self._dev = dev
+        self._dirty = False
+        self._m_uploads.inc()
+
+    # --- compiled programs ------------------------------------------------
+    def _make_prefill_chunk(self):
         cfg = self.cfg
 
-        def run(stacked, embed_w, norm_w, head_w, kpool, vpool, toks,
-                tables, lens, mask):
-            eps, theta = cfg["eps"], cfg["theta"]
-            nh, nkv, hd = cfg["heads"], cfg["kv_heads"], cfg["head_dim"]
-            B = toks.shape[0]
-            h = jnp.take(embed_w, toks[:, None], axis=0)  # (B, 1, H)
-            pos = lens[:, None]                            # write position
+        def run(stacked, embed_w, norm_w, head_w, kpool, vpool, ids,
+                start, last_idx, table_row):
+            h = jnp.take(embed_w, ids, axis=0)       # (1, C, H)
 
-            def layer(carry, xs):
-                hh = carry
+            def layer(hh, xs):
                 lp, kc, vc = xs
-                x = _rms(hh, lp["input_layernorm.weight"], eps)
-                q = (x @ lp["self_attn.q_proj.weight"]).reshape(B, 1, nh, hd)
-                k = (x @ lp["self_attn.k_proj.weight"]).reshape(B, 1, nkv, hd)
-                v = (x @ lp["self_attn.v_proj.weight"]).reshape(B, 1, nkv, hd)
-                q = _rope(q, pos, theta)[:, 0]
-                k = _rope(k, pos, theta)[:, 0]
-                v = v[:, 0]
-                kc, vc = write_to_cache(kc, vc, k, v, tables, lens)
-                attn = paged_attention_decode(
-                    q, kc, vc, tables, lens + 1,
-                    scale=1.0 / (hd ** 0.5))
-                hh = hh + (attn.reshape(B, 1, nh * hd)
-                           @ lp["self_attn.o_proj.weight"])
-                x = _rms(hh, lp["post_attention_layernorm.weight"], eps)
-                gate = x @ lp["mlp.gate_proj.weight"]
-                up = x @ lp["mlp.up_proj.weight"]
-                hh = hh + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
+                hh, (kc, vc) = _llama_layer_prefill_chunk(
+                    lp, hh, kc, vc, table_row, start, cfg)
                 return hh, (kc, vc)
 
-            h, (kpool, vpool) = jax.lax.scan(layer, h, (stacked, kpool, vpool))
-            logits = (_rms(h[:, 0], norm_w, eps) @ head_w).astype(jnp.float32)
-            logits = jnp.where(mask[:, None], logits, -1e30)
+            h, (kpool, vpool) = jax.lax.scan(layer, h,
+                                             (stacked, kpool, vpool))
+            h_last = h[0, last_idx]     # dynamic index: traced position
+            logits = (_rms(h_last, norm_w, cfg["eps"]) @ head_w).astype(
+                jnp.float32)
             return logits, kpool, vpool
 
         return run
+
+    def _make_decode(self, sampled: bool):
+        cfg = self.cfg
+        K = self.decode_steps
+        scratch = self.pool.scratch_block
+
+        def run(stacked, embed_w, norm_w, head_w, kpool, vpool, toks,
+                lens, alive, rem, eos_ids, tables, *sample_state):
+            eps, theta = cfg["eps"], cfg["theta"]
+            nh, nkv, hd = cfg["heads"], cfg["kv_heads"], cfg["head_dim"]
+            B = toks.shape[0]
+            if sampled:
+                seeds, do_sample, temp, top_k, top_p = sample_state
+
+            def step(carry, _):
+                toks, lens, alive, rem, kpool, vpool = carry
+                h = jnp.take(embed_w, toks[:, None], axis=0)  # (B, 1, H)
+                pos = lens[:, None]                            # write pos
+
+                def layer(hh, xs):
+                    lp, kc, vc = xs
+                    x = _rms(hh, lp["input_layernorm.weight"], eps)
+                    q = (x @ lp["self_attn.q_proj.weight"]
+                         ).reshape(B, 1, nh, hd)
+                    k = (x @ lp["self_attn.k_proj.weight"]
+                         ).reshape(B, 1, nkv, hd)
+                    v = (x @ lp["self_attn.v_proj.weight"]
+                         ).reshape(B, 1, nkv, hd)
+                    q = _rope(q, pos, theta)[:, 0]
+                    k = _rope(k, pos, theta)[:, 0]
+                    v = v[:, 0]
+                    kc, vc = write_to_cache(kc, vc, k, v, tables, lens,
+                                            active=alive,
+                                            scratch_block=scratch)
+                    attn = paged_attention_decode_inner(
+                        q, kc, vc, tables, lens + 1,
+                        scale=1.0 / (hd ** 0.5))
+                    hh = hh + (attn.reshape(B, 1, nh * hd)
+                               @ lp["self_attn.o_proj.weight"])
+                    x = _rms(hh, lp["post_attention_layernorm.weight"],
+                             eps)
+                    gate = x @ lp["mlp.gate_proj.weight"]
+                    up = x @ lp["mlp.up_proj.weight"]
+                    hh = hh + ((jax.nn.silu(gate) * up)
+                               @ lp["mlp.down_proj.weight"])
+                    return hh, (kc, vc)
+
+                h, (kpool, vpool) = jax.lax.scan(layer, h,
+                                                 (stacked, kpool, vpool))
+                logits = (_rms(h[:, 0], norm_w, eps) @ head_w).astype(
+                    jnp.float32)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                if sampled:
+                    samp = _device_sample(logits, seeds, lens, temp,
+                                          top_k, top_p)
+                    nxt = jnp.where(do_sample, samp, nxt)
+                # frozen lanes re-emit their last token (never credited:
+                # the host walk stops at the same eos/length boundary)
+                nxt = jnp.where(alive, nxt, toks)
+                rem = rem - alive.astype(rem.dtype)
+                alive_next = alive & (nxt != eos_ids) & (rem > 0)
+                lens = lens + alive.astype(lens.dtype)
+                return (nxt, lens, alive_next, rem, kpool, vpool), nxt
+
+            (toks, lens, alive, rem, kpool, vpool), tile = jax.lax.scan(
+                step, (toks, lens, alive, rem, kpool, vpool), None,
+                length=K)
+            return (jnp.moveaxis(tile, 0, 1), toks, lens, alive, rem,
+                    kpool, vpool)
+
+        return run
+
+
+def _device_sample(logits, seeds, lens, temperature, top_k, top_p):
+    """Per-lane on-device sampling: temperature -> top-k -> nucleus ->
+    categorical, all vectorized over lanes. Randomness comes from
+    fold_in(key(lane_seed), absolute_position), so a lane's stream is a
+    pure function of (seed, position) — byte-identical no matter how the
+    decode steps are tiled (decode_steps=1 vs K)."""
+    B, V = logits.shape
+    z = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    svals = jnp.sort(z, axis=-1)[:, ::-1]               # descending
+    idx = jnp.clip(top_k - 1, 0, V - 1)
+    kth = jnp.take_along_axis(svals, idx[:, None], axis=-1)
+    z = jnp.where((top_k > 0)[:, None] & (z < kth), -jnp.inf, z)
+    probs = jax.nn.softmax(z, axis=-1)
+    order = jnp.argsort(-probs, axis=-1)
+    sp = jnp.take_along_axis(probs, order, axis=-1)
+    cum = jnp.cumsum(sp, axis=-1)
+    keep_sorted = (cum - sp) < top_p[:, None]
+    keep_sorted = keep_sorted.at[:, 0].set(True)  # top_p=0 keeps argmax
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(B)[:, None], order].set(keep_sorted)
+    z = jnp.where((top_p < 1.0)[:, None] & ~keep, -jnp.inf, z)
+    keys = jax.vmap(
+        lambda s, p: jax.random.fold_in(jax.random.key(s), p))(seeds, lens)
+    return jax.vmap(jax.random.categorical)(keys, z).astype(jnp.int32)

@@ -29,10 +29,11 @@ CATALOG = {
         "histogram", "time from add_request to the first sampled token",
         (), _TTFT_BUCKETS),
     "serving_tpot_seconds": (
-        "histogram", "per-token decode latency: one compiled decode step "
-        "(all active lanes advance one token)", (), _TPOT_BUCKETS),
+        "histogram", "per-token decode latency: dispatch->readback wall "
+        "time of one fused K-step decode tile over K (all active lanes "
+        "advance K tokens per dispatch)", (), _TPOT_BUCKETS),
     "serving_prefill_seconds": (
-        "histogram", "one prefill program call (bucketed prompt)",
+        "histogram", "one prefill chunk program call (chunked prompt)",
         (), _STEP_BUCKETS),
     "serving_queue_depth": (
         "gauge", "requests waiting for admission", (), None),
@@ -72,6 +73,31 @@ CATALOG = {
     "serving_route_probe_failures_total": (
         "counter", "audit attention-route probes that failed at engine "
         "construction (logged, engine continues)", (), None),
+    "serving_pool_exhausted_total": (
+        "counter", "paged-KV-pool reservations refused "
+        "(KVPoolExhaustedError raised; caller defers or sheds)", (), None),
+    "serving_lane_state_uploads_total": (
+        "counter", "device lane-state refreshes from the host mirrors "
+        "(only on lane membership change: admit/retire/shed; steady-state "
+        "decode uploads nothing)", (), None),
+    "serving_decode_dispatches_total": (
+        "counter", "fused K-step decode tiles dispatched (compare with "
+        "serving_lane_state_uploads_total: uploads << dispatches)",
+        (), None),
+    "serving_dispatch_ahead_depth": (
+        "gauge", "in-flight decode tiles at dispatch time (1 = "
+        "double-buffered: host bookkeeping overlaps device compute)",
+        (), None),
+    "serving_hostsync_seconds": (
+        "histogram", "host blocked reading back a decode token tile "
+        "(device->host sync; the overlap design keeps this small)",
+        (), _TPOT_BUCKETS),
+    "serving_hostsync_retries_total": (
+        "counter", "transient token-tile readback failures (tile kept "
+        "in flight, retried next step)", (), None),
+    "serving_prefill_chunks_total": (
+        "counter", "prefill chunk program calls (long prompts interleave "
+        "with decode instead of head-of-line blocking)", (), None),
 
     # -- generation (generation.py) -----------------------------------------
     "generation_requests_total": (

@@ -22,36 +22,58 @@ import math
 import jax
 import jax.numpy as jnp
 
-__all__ = ["paged_attention_decode", "write_to_cache", "BlockKVCacheManager"]
+__all__ = ["paged_attention_decode", "paged_attention_decode_inner",
+           "paged_attention_prefill_chunk", "write_to_cache",
+           "write_chunk_to_cache", "BlockKVCacheManager"]
 
 
-def write_to_cache(k_cache, v_cache, k_new, v_new, block_tables, write_pos):
+def write_to_cache(k_cache, v_cache, k_new, v_new, block_tables, write_pos,
+                   active=None, scratch_block=None):
     """Scatter new K/V (one token per sequence) into the paged cache.
 
     k_new/v_new: [B, KVH, D]; block_tables: [B, max_blocks] int32;
     write_pos: [B] absolute position of the new token per sequence.
-    Returns updated (k_cache, v_cache).
+    When `active` ([B] bool) is given, inactive rows write to
+    `scratch_block` instead of their table entry — the fused K-step
+    decode keeps dead lanes scribbling somewhere no live sequence owns
+    without data-dependent control flow. Returns (k_cache, v_cache).
     """
     block_size = k_cache.shape[1]
     block_idx = write_pos // block_size                       # [B]
     in_block = write_pos % block_size                         # [B]
     block_ids = jnp.take_along_axis(block_tables, block_idx[:, None],
                                     axis=1)[:, 0]             # [B]
+    if active is not None:
+        block_ids = jnp.where(active, block_ids, scratch_block)
     k_cache = k_cache.at[block_ids, in_block].set(k_new)
     v_cache = v_cache.at[block_ids, in_block].set(v_new)
     return k_cache, v_cache
 
 
-@functools.partial(jax.jit, static_argnames=("scale",))
-def paged_attention_decode(q, k_cache, v_cache, block_tables, seq_lens,
-                           scale=None):
-    """One decode step over paged caches.
+def write_chunk_to_cache(k_cache, v_cache, k_new, v_new, table_row, start):
+    """Scatter a prompt CHUNK's K/V (one sequence, C contiguous tokens)
+    into the paged cache.
 
-    q: [B, H, D] (single new token per sequence);
-    k_cache/v_cache: [num_blocks, block_size, KVH, D];
-    block_tables: [B, max_blocks_per_seq]; seq_lens: [B] (incl. new token).
-    Supports GQA (H a multiple of KVH). Returns [B, H, D].
+    k_new/v_new: [C, KVH, D]; table_row: [max_blocks] int32 block table of
+    the owning sequence; start: absolute position of the chunk's first
+    token. Positions past the row's allocated entries land in whatever
+    the row is padded with (the engine pads with its scratch block).
     """
+    block_size = k_cache.shape[1]
+    pos = start + jnp.arange(k_new.shape[0])
+    block_ids = jnp.take(table_row, pos // block_size)
+    in_block = pos % block_size
+    k_cache = k_cache.at[block_ids, in_block].set(k_new)
+    v_cache = v_cache.at[block_ids, in_block].set(v_new)
+    return k_cache, v_cache
+
+
+def paged_attention_decode_inner(q, k_cache, v_cache, block_tables,
+                                 seq_lens, scale=None):
+    """Unjitted body of paged_attention_decode — call this from inside an
+    already-compiled program (e.g. the serving engine's fused K-step
+    decode scan) so XLA sees one flat program instead of a nested pjit
+    call per layer per step."""
     B, H, D = q.shape
     _, block_size, KVH, _ = k_cache.shape
     groups = H // KVH
@@ -75,6 +97,51 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, seq_lens,
         return o.reshape(H, D)
 
     return jax.vmap(one)(q, block_tables, seq_lens)
+
+
+@functools.partial(jax.jit, static_argnames=("scale",))
+def paged_attention_decode(q, k_cache, v_cache, block_tables, seq_lens,
+                           scale=None):
+    """One decode step over paged caches.
+
+    q: [B, H, D] (single new token per sequence);
+    k_cache/v_cache: [num_blocks, block_size, KVH, D];
+    block_tables: [B, max_blocks_per_seq]; seq_lens: [B] (incl. new token).
+    Supports GQA (H a multiple of KVH). Returns [B, H, D].
+    """
+    return paged_attention_decode_inner(q, k_cache, v_cache, block_tables,
+                                        seq_lens, scale=scale)
+
+
+def paged_attention_prefill_chunk(q, k_cache, v_cache, table_row, start,
+                                  scale=None):
+    """Chunked-prefill attention for ONE sequence: C chunk queries attend
+    over every cached position `p <= start + qi` — earlier chunks already
+    scattered into the paged pool plus the (just-written) chunk itself,
+    causal within the chunk.
+
+    q: [C, H, D] (rotated chunk queries); k_cache/v_cache:
+    [num_blocks, block_size, KVH, D] AFTER write_chunk_to_cache for this
+    chunk; table_row: [max_blocks] int32; start: absolute position of the
+    chunk's first token. Returns [C, H, D].
+    """
+    C, H, D = q.shape
+    _, block_size, KVH, _ = k_cache.shape
+    groups = H // KVH
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    L = table_row.shape[0] * block_size
+    k = k_cache[table_row].reshape(L, KVH, D)
+    v = v_cache[table_row].reshape(L, KVH, D)
+    qg = q.reshape(C, KVH, groups, D)
+    s = jnp.einsum("chgd,lhd->chgl", qg, k,
+                   preferred_element_type=jnp.float32) * scale
+    pos_q = start + jnp.arange(C)
+    valid = jnp.arange(L)[None, :] <= pos_q[:, None]          # [C, L]
+    s = jnp.where(valid[:, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    o = jnp.einsum("chgl,lhd->chgd", p, v)
+    return o.reshape(C, H, D)
 
 
 class BlockKVCacheManager:

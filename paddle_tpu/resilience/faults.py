@@ -52,9 +52,16 @@ FAULT_SITES = {
     "store.get": "TCPStore.get (native or in-process fallback)",
     "store.set": "TCPStore.set (native or in-process fallback)",
     "elastic.heartbeat": "ElasticManager lease beat write",
-    "serve.admit": "serving admission: prefill of a queued request",
+    "serve.admit": "serving admission: lane + pool reservation for a "
+                   "queued request",
     "serve.decode_oom": "serving decode step: device OOM "
                         "(shed-and-requeue path)",
+    "serve.prefill_chunk": "serving chunked prefill: one prompt-chunk "
+                           "forward (failure aborts the task; request "
+                           "requeued at the front for a fresh prefill)",
+    "serve.hostsync_read": "serving decode: token-tile device->host "
+                           "readback (transient failure keeps the tile "
+                           "in flight and retries next step)",
     "train.step_nonfinite": "train supervisor: force a non-finite loss "
                             "for this step (consulted via check())",
     "compile.cache_read": "PIR compile cache: artifact read (verified "

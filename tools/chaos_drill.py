@@ -200,6 +200,38 @@ def drill_serve_decode_oom(tmp):
     return "recovered", "decode OOM shed + requeued; full completion"
 
 
+def drill_serve_prefill_chunk(tmp):
+    model, eng = _tiny_engine()
+    p = (np.arange(20) * 7) % 128   # 2 chunks at the 16-wide bucket
+    rid = eng.add_request(p, max_new_tokens=5)
+    with faults.injected_faults("serve.prefill_chunk:2:TimeoutError"):
+        out = eng.run()
+    _expect(out[rid] == _dense_ref(model, p, 5),
+            "request did not complete correctly after mid-prefill fault")
+    _expect(_counter("serving_deferred_total", reason="prefill_fault") >= 1,
+            "prefill fault not counted as deferral")
+    _expect(_counter("serving_prefill_chunks_total") >= 3,
+            "retried prefill did not restart from the first chunk")
+    _expect(eng.pool.tables == {}, "pool blocks leaked")
+    return "recovered", ("fault mid-chunked-prefill aborted the task; "
+                         "requeued at front, fresh prefill; output exact")
+
+
+def drill_serve_hostsync_read(tmp):
+    model, eng = _tiny_engine()
+    p = (np.arange(9) * 5) % 128
+    rid = eng.add_request(p, max_new_tokens=6)
+    with faults.injected_faults("serve.hostsync_read:1:TimeoutError"):
+        out = eng.run()
+    _expect(out[rid] == _dense_ref(model, p, 6),
+            "request did not complete correctly after readback fault")
+    _expect(_counter("serving_hostsync_retries_total") >= 1,
+            "host-sync retry not counted")
+    _expect(eng.pool.tables == {}, "pool blocks leaked")
+    return "recovered", ("token-tile readback fault kept the tile in "
+                         "flight; retried next step; output exact")
+
+
 def drill_train_step_nonfinite(tmp):
     losses = {"n": 0}
 
@@ -294,6 +326,8 @@ SCENARIOS = {
     "elastic.heartbeat": drill_elastic_heartbeat,
     "serve.admit": drill_serve_admit,
     "serve.decode_oom": drill_serve_decode_oom,
+    "serve.prefill_chunk": drill_serve_prefill_chunk,
+    "serve.hostsync_read": drill_serve_hostsync_read,
     "train.step_nonfinite": drill_train_step_nonfinite,
     "compile.cache_read": drill_compile_cache_read,
     "compile.cache_write": drill_compile_cache_write,

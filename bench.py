@@ -462,6 +462,22 @@ def _bench_decode(on_tpu):
             # divides that tax by K; decode_tokens_per_s above is the
             # amortized single-program bound.
             out["engine_note"] = "tunnel-dispatch-bound; see decode_tokens_per_s"
+        # close the telemetry loop: judge this run's TTFT/TPOT/finish mix
+        # against the default serving SLOs with the same estimator
+        # tools/slo_report.py uses; the verdict rides the bench row
+        try:
+            from paddle_tpu import observability as _obs
+            from paddle_tpu.observability import slo as _slo
+            eng_slo = _slo.SLOEngine()
+            eng_slo.observe(_obs.snapshot(), t=0.0)
+            out["engine_slo"] = eng_slo.evaluate()
+            obs_dir = os.environ.get("BENCH_OBS_DIR")
+            if obs_dir:     # drop the request-grouped Chrome trace too
+                os.makedirs(obs_dir, exist_ok=True)
+                out["engine_trace"] = _obs.get_tracer().export_chrome_trace(
+                    os.path.join(obs_dir, "engine_trace.json"))
+        except Exception as e:  # noqa: BLE001 — verdicts must not sink the row
+            out["engine_slo_error"] = f"{type(e).__name__}: {str(e)[:200]}"
     except Exception as e:  # noqa: BLE001 — serving leg must not sink decode
         out["engine_error"] = f"{type(e).__name__}: {str(e)[:200]}"
     return out

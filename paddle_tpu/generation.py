@@ -31,6 +31,7 @@ from .framework import random as _random
 from .observability import span as _span
 from .observability.catalog import metric as _metric
 from .observability.tracing import get_tracer as _tracer
+from .observability.tracing import new_trace_id as _new_trace_id
 
 __all__ = ["generate", "GenerationConfig", "WeightOnlyGenerator"]
 
@@ -336,11 +337,14 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     else:  # greedy uses no randomness — don't advance the global stream
         key = jax.random.key(0)
     from .models.llama import LlamaForCausalLM
+    # one trace id per call; children (build / prefill_decode) inherit it
+    # through the span stack, same correlation scheme as serving Requests
+    tid = _new_trace_id("gen-") if _tracer().enabled else None
     if isinstance(model, LlamaForCausalLM):
         _metric("generation_requests_total", path="llama_compiled").inc()
         with _span("generation.generate", path="llama_compiled",
                    batch=int(ids.shape[0]), prompt=int(ids.shape[1]),
-                   new_tokens=int(max_new_tokens)):
+                   new_tokens=int(max_new_tokens), trace_id=tid):
             from .parallel.functional import split_stacked_layer_params
             # CURRENT weights fetched per call and passed as jit arguments —
             # the compiled program is keyed only on config/shapes, never
@@ -380,7 +384,7 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     _metric("generation_requests_total", path="generic_recompute").inc()
     with _span("generation.generate", path="generic_recompute",
                batch=int(ids.shape[0]), prompt=int(ids.shape[1]),
-               new_tokens=int(max_new_tokens)):
+               new_tokens=int(max_new_tokens), trace_id=tid):
         return Tensor(_generic_generate(model, ids, gc, key))
 
 

@@ -50,6 +50,11 @@ import jax.numpy as jnp
 from ..generation import _llama_layer_prefill_chunk, _rms, _rope
 from ..observability import span as _span
 from ..observability.catalog import metric as _metric
+from ..observability.metrics import get_registry as _get_registry
+from ..observability.recorder import get_recorder as _get_recorder
+from ..observability.tracing import LANE_TID_BASE
+from ..observability.tracing import get_tracer as _get_tracer
+from ..observability.tracing import new_trace_id as _new_trace_id
 from ..ops.paged_attention import (paged_attention_decode_inner,
                                    write_to_cache)
 from ..resilience.faults import FaultInjected, fault_point
@@ -80,7 +85,7 @@ class Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
                  "generated", "done", "do_sample", "temperature", "top_k",
                  "top_p", "rng", "sample_seed", "t_arrival", "deadline_s",
-                 "t_deadline", "finish_reason", "shed_count")
+                 "t_deadline", "finish_reason", "shed_count", "trace_id")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
@@ -114,6 +119,10 @@ class Request:
                            else self.t_arrival + float(deadline_s))
         self.finish_reason = None
         self.shed_count = 0
+        # joins this request's spans, histogram exemplars, and flight-
+        # recorder events; generated unconditionally (one f-string) so a
+        # request is correlatable even if tracing turns on mid-flight
+        self.trace_id = _new_trace_id("req-")
 
     def choose(self, logits: np.ndarray) -> int:
         """Per-request next-token choice on the host — used for the
@@ -201,15 +210,18 @@ class _Inflight:
     credit tokens only to lanes whose occupancy did not change while the
     tile was in flight."""
 
-    __slots__ = ("tile", "t_dispatch", "reqs", "epochs", "k", "covers_all")
+    __slots__ = ("tile", "t_dispatch", "reqs", "epochs", "k", "covers_all",
+                 "tile_id")
 
-    def __init__(self, tile, t_dispatch, reqs, epochs, k, covers_all):
+    def __init__(self, tile, t_dispatch, reqs, epochs, k, covers_all,
+                 tile_id=0):
         self.tile = tile
         self.t_dispatch = t_dispatch
         self.reqs = reqs
         self.epochs = epochs
         self.k = k
         self.covers_all = covers_all
+        self.tile_id = tile_id
 
 
 class ContinuousBatchingEngine:
@@ -337,6 +349,13 @@ class ContinuousBatchingEngine:
         self._m_hostsync_retries = _metric("serving_hostsync_retries_total")
         self._m_chunks = _metric("serving_prefill_chunks_total")
         _metric("serving_preempted_total")  # declared: 0 by design
+        # request-scoped telemetry handles, bound once; every hot-path
+        # use is guarded by a single `.enabled` attribute check so the
+        # disabled engine pays no allocation (kwargs pack at call sites)
+        self._tracer = _get_tracer()
+        self._reg = _get_registry()
+        self._rec = _get_recorder()
+        self._tile_seq = 0              # decode tile ids for span links
 
     # --- public API -------------------------------------------------------
     def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
@@ -348,14 +367,23 @@ class ContinuousBatchingEngine:
         BackpressureError when the admission queue is at max_queue."""
         if self.max_queue is not None and len(self.queue) >= self.max_queue:
             _metric("serving_backpressure_total").inc()
+            if self._rec.enabled:
+                self._rec.record("backpressure", queue=len(self.queue),
+                                 max_queue=self.max_queue)
             raise BackpressureError(
                 f"admission queue full ({len(self.queue)}/{self.max_queue}); "
                 "retry later")
         rid = self._next_rid
         self._next_rid += 1
-        self.queue.append(Request(rid, prompt, max_new_tokens, eos_token_id,
-                                  do_sample, temperature, top_k, top_p,
-                                  seed, deadline_s))
+        req = Request(rid, prompt, max_new_tokens, eos_token_id,
+                      do_sample, temperature, top_k, top_p,
+                      seed, deadline_s)
+        self.queue.append(req)
+        if self._tracer.enabled:
+            # root of the request's span tree (instant: arrival moment)
+            self._tracer.add_span("request.admit",
+                                  int(req.t_arrival * 1e9), 0,
+                                  trace_id=req.trace_id, args={"rid": rid})
         return rid
 
     def has_work(self):
@@ -389,10 +417,23 @@ class ContinuousBatchingEngine:
 
     # --- graceful degradation --------------------------------------------
     def _finish(self, req, reason):
+        # THE one finish path: req.finish_reason, the
+        # serving_finished_total{reason} counter, the request.finish
+        # span, and the flight-recorder event all derive from the same
+        # `reason` argument here — they cannot disagree (test-pinned)
         req.done = True
         req.finish_reason = reason
         self.finished[req.rid] = req
         _metric("serving_finished_total", reason=reason).inc()
+        if self._tracer.enabled:
+            self._tracer.add_span("request.finish",
+                                  time.perf_counter_ns(), 0,
+                                  trace_id=req.trace_id,
+                                  args={"rid": req.rid, "reason": reason,
+                                        "tokens": len(req.generated)})
+        if self._rec.enabled:
+            self._rec.record("finish", rid=req.rid, reason=reason,
+                             tokens=len(req.generated))
 
     def _retire_lane(self, lane, reason):
         req = self.lanes[lane]
@@ -417,6 +458,9 @@ class ContinuousBatchingEngine:
             for req in self.queue:
                 if req.t_deadline is not None and now >= req.t_deadline:
                     _metric("serving_timeouts_total", where="queue").inc()
+                    if self._rec.enabled:
+                        self._rec.record("timeout", rid=req.rid,
+                                         where="queue")
                     self._finish(req, "timeout")
                 else:
                     kept.append(req)
@@ -425,6 +469,8 @@ class ContinuousBatchingEngine:
             if (req is not None and req.t_deadline is not None
                     and now >= req.t_deadline):
                 _metric("serving_timeouts_total", where="decode").inc()
+                if self._rec.enabled:
+                    self._rec.record("timeout", rid=req.rid, where="decode")
                 self._retire_lane(lane, "timeout")
 
     def _shed(self, active):
@@ -445,6 +491,9 @@ class ContinuousBatchingEngine:
         self._lane_epoch[victim] += 1
         req.shed_count += 1
         _metric("serving_shed_total").inc()
+        if self._rec.enabled:
+            self._rec.record("shed", rid=req.rid, lane=victim,
+                             sheds=req.shed_count)
         if req.shed_count > self.max_sheds:
             self._m_retired.inc()
             self._finish(req, "shed")
@@ -519,6 +568,15 @@ class ContinuousBatchingEngine:
             self._lane_epoch[lane] += 1
             self._prefill_tasks[lane] = _PrefillTask(
                 req, lane, self._chunk_plan(req.prompt.size))
+            if self._tracer.enabled:
+                t0 = int(req.t_arrival * 1e9)
+                self._tracer.add_span(
+                    "request.queued", t0, time.perf_counter_ns() - t0,
+                    trace_id=req.trace_id, tid=LANE_TID_BASE + lane,
+                    tid_name=f"lane {lane}", args={"rid": req.rid})
+            if self._rec.enabled:
+                self._rec.record("admit", rid=req.rid, lane=lane,
+                                 epoch=int(self._lane_epoch[lane]))
 
     def _chunk_plan(self, s):
         """(start, width) pieces covering a prompt of s tokens: full
@@ -605,8 +663,15 @@ class ContinuousBatchingEngine:
             self.stacked, self.embed_w, self.norm_w, self._out_w,
             self.pool.k, self.pool.v, jnp.asarray(ids), jnp.int32(start),
             jnp.int32(last_idx), jnp.asarray(table))
-        self._m_prefill.observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._m_prefill.observe(dt)
         self._m_chunks.inc()
+        if self._tracer.enabled:
+            self._tracer.add_span(
+                "request.prefill.chunk", int(t0 * 1e9), int(dt * 1e9),
+                trace_id=req.trace_id, tid=LANE_TID_BASE + task.lane,
+                tid_name=f"lane {task.lane}",
+                args={"rid": req.rid, "chunk": task.idx, "width": width})
         if self.compile_reports.get(f"prefill.b{width}") is None:
             self.compile_reports[f"prefill.b{width}"] = \
                 getattr(fn, "report", None)
@@ -622,7 +687,10 @@ class ContinuousBatchingEngine:
         self.lane_tok[lane] = first_tok
         self._dirty = True
         self._m_admitted.inc()
-        self._m_ttft.observe(time.perf_counter() - req.t_arrival)
+        # the exemplar ties this observation's bucket to the exact trace
+        # that produced it (bad p99 -> exact request)
+        self._m_ttft.observe(time.perf_counter() - req.t_arrival,
+                             exemplar=req.trace_id)
         self._emit(lane, first_tok)
         return True
 
@@ -691,8 +759,15 @@ class ContinuousBatchingEngine:
         active_set = set(active)
         snap = [self.lanes[i] if i in active_set else None
                 for i in range(self.max_batch)]
+        tile_id = self._tile_seq
+        self._tile_seq += 1
         self._inflight.append(_Inflight(
-            tile, t0, snap, self._lane_epoch.copy(), K, covers_all))
+            tile, t0, snap, self._lane_epoch.copy(), K, covers_all,
+            tile_id))
+        if self._rec.enabled:
+            self._rec.record("dispatch", tile=tile_id, lanes=list(active),
+                             epochs=[int(self._lane_epoch[i])
+                                     for i in active], k=K)
         # overlapped host bookkeeping: process the PREVIOUS tile while
         # the device runs this one (compat mode drains its own tile too:
         # the old engine blocked on every token)
@@ -760,10 +835,47 @@ class ContinuousBatchingEngine:
         self._inflight.popleft()
         self._m_hostsync.observe(t1 - t0)
         # one fused dispatch advances every active lane K tokens, so the
-        # dispatch->readback wall time over K IS the per-token latency
-        self._m_tpot.observe((t1 - infl.t_dispatch) / infl.k)
+        # dispatch->readback wall time over K IS the per-token latency.
+        # Exemplar: the first live lane's trace id stands for the tile
+        # (one tile serves many lanes; the span links carry all of them)
+        ex = None
+        if self._reg.enabled:
+            for r in infl.reqs:
+                if r is not None and not r.done:
+                    ex = r.trace_id
+                    break
+        self._m_tpot.observe((t1 - infl.t_dispatch) / infl.k, exemplar=ex)
+        if self._rec.enabled:
+            self._rec.record("readback", tile=infl.tile_id,
+                             wait_ms=round((t1 - t0) * 1e3, 3))
+        if self._tracer.enabled:
+            self._trace_tile(infl, t1)
         self._process_tile(arr, infl)
         return True
+
+    def _trace_tile(self, infl, t1):
+        """Span-link a drained tile: one engine-side serving.decode_tile
+        span linking every request it advanced, plus a request-side
+        request.decode.tile span in each lane's trace group (the lanes
+        here match _process_tile's crediting rules exactly)."""
+        t0_ns = int(infl.t_dispatch * 1e9)
+        dur_ns = int((t1 - infl.t_dispatch) * 1e9)
+        links = []
+        for lane, req in enumerate(infl.reqs):
+            if (req is None or req.done
+                    or self.lanes[lane] is not req
+                    or self._lane_epoch[lane] != infl.epochs[lane]):
+                continue
+            links.append(req.trace_id)
+            self._tracer.add_span(
+                "request.decode.tile", t0_ns, dur_ns,
+                trace_id=req.trace_id, tid=LANE_TID_BASE + lane,
+                tid_name=f"lane {lane}",
+                args={"rid": req.rid, "tile": infl.tile_id, "k": infl.k})
+        self._tracer.add_span(
+            "serving.decode_tile", t0_ns, dur_ns,
+            args={"tile": infl.tile_id, "k": infl.k},
+            links=links or None)
 
     def _process_tile(self, tile, infl):
         """Credit a [B, K] token tile: walk each lane's K tokens with the
@@ -830,6 +942,9 @@ class ContinuousBatchingEngine:
         self._dev = dev
         self._dirty = False
         self._m_uploads.inc()
+        if self._rec.enabled:
+            self._rec.record("membership", active=list(active),
+                             variant=dev["variant"])
 
     # --- compiled programs ------------------------------------------------
     def _make_prefill_chunk(self):

@@ -25,32 +25,55 @@ documented in OBSERVABILITY.md (drift is test-pinned).
 
 from __future__ import annotations
 
-from . import catalog, export, metrics, tracing  # noqa: F401
+from . import (  # noqa: F401
+    catalog, export, metrics, quantiles, recorder, slo, tracing)
 from .catalog import CATALOG, metric, register_all  # noqa: F401
 from .export import prometheus_text, snapshot  # noqa: F401
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricRegistry, get_registry,
     load_snapshot, to_prometheus_text)
+from .quantiles import (  # noqa: F401
+    quantile_from_cumulative, quantiles_from_cumulative)
+from .recorder import FlightRecorder, get_recorder  # noqa: F401
+from .slo import DEFAULT_SLOS, SLOEngine, SLOSpec  # noqa: F401
 from .stepwatch import StepWatch, current_round  # noqa: F401
-from .tracing import Tracer, get_tracer, span, trace  # noqa: F401
+from .tracing import (  # noqa: F401
+    Tracer, get_tracer, new_trace_id, span, trace)
 
 __all__ = ["enable", "disable", "enabled", "MetricRegistry", "Counter",
            "Gauge", "Histogram", "get_registry", "snapshot",
            "to_prometheus_text", "load_snapshot", "Tracer", "get_tracer",
-           "span", "trace", "StepWatch", "current_round", "CATALOG",
-           "metric", "register_all", "catalog", "export", "metrics",
+           "span", "trace", "new_trace_id", "StepWatch", "current_round",
+           "CATALOG", "metric", "register_all", "FlightRecorder",
+           "get_recorder", "SLOEngine", "SLOSpec", "DEFAULT_SLOS",
+           "quantile_from_cumulative", "quantiles_from_cumulative",
+           "catalog", "export", "metrics", "quantiles", "recorder", "slo",
            "tracing"]
 
 
+def _count_dropped(n):
+    # tracing.py is standalone and cannot name the catalog itself; the
+    # package wires the ring-wrap casualties into the metric here
+    try:
+        metric("tracer_dropped_spans_total").inc(n)
+    except Exception:  # noqa: BLE001 — tracing never raises
+        pass
+
+
 def enable():
-    """Turn the whole layer on (metrics + spans) for this process."""
+    """Turn the whole layer on (metrics + spans + recorder)."""
     get_registry().enable()
-    get_tracer().enable()
+    tr = get_tracer()
+    tr.enable()
+    if tr.on_drop is None:
+        tr.on_drop = _count_dropped
+    get_recorder().enable()
 
 
 def disable():
     get_registry().disable()
     get_tracer().disable()
+    get_recorder().disable()
 
 
 def enabled() -> bool:

@@ -197,6 +197,21 @@ CATALOG = {
         "gauge", "compile-cache directory size after the last write",
         (), None),
 
+    # -- telemetry loop (tracing ring, flight recorder, SLO engine) ----------
+    "tracer_dropped_spans_total": (
+        "counter", "finished spans evicted when the bounded tracer ring "
+        "wrapped (raise Tracer(maxlen=...) or export more often)", (), None),
+    "flight_recorder_dumps_total": (
+        "counter", "flight-recorder postmortem dumps written, by reason "
+        "(unhandled_error/preempt/drill:<site>/manual)", ("reason",), None),
+    "slo_compliance": (
+        "gauge", "1.0 when the named SLO currently meets its objective, "
+        "else 0.0 (slo.SLOEngine.evaluate)", ("slo",), None),
+    "slo_burn_rate": (
+        "gauge", "error-budget burn rate of the named SLO (1.0 = burning "
+        "exactly the budget; >1 exhausts it early); for quantile SLOs, "
+        "observed/target ratio", ("slo",), None),
+
     # -- bench orchestration (bench.py parent; stage = probe/configN/...) ----
     "bench_attempts_total": (
         "counter", "bench worker subprocess attempts by stage and outcome",

@@ -10,10 +10,12 @@ from __future__ import annotations
 import os
 
 from . import metrics as _metrics
+from . import recorder as _recorder
 from . import tracing as _tracing
 
 __all__ = ["prometheus_text", "snapshot", "write_snapshot_jsonl",
-           "write_prometheus_text", "export_chrome_trace", "dump_all"]
+           "write_prometheus_text", "export_chrome_trace",
+           "dump_flight_recorder", "dump_all"]
 
 
 def prometheus_text(registry=None) -> str:
@@ -40,9 +42,16 @@ def export_chrome_trace(path, tracer=None, marker=0):
         path, marker)
 
 
-def dump_all(dir_name, prefix="obs", registry=None, tracer=None, meta=None):
-    """Write <dir>/<prefix>.metrics.jsonl, .prom, .trace.json; returns the
-    three paths. The one-call exporter for shutdown hooks and debugging."""
+def dump_flight_recorder(path, rec=None, reason="manual", extra=None):
+    return (rec or _recorder.get_recorder()).dump(path, reason=reason,
+                                                  extra=extra)
+
+
+def dump_all(dir_name, prefix="obs", registry=None, tracer=None, meta=None,
+             rec=None):
+    """Write <dir>/<prefix>.metrics.jsonl, .prom, .trace.json,
+    .flight.json; returns the four paths. The one-call exporter for
+    shutdown hooks and debugging."""
     os.makedirs(dir_name, exist_ok=True)
     p1 = write_snapshot_jsonl(
         os.path.join(dir_name, f"{prefix}.metrics.jsonl"), registry, meta)
@@ -50,4 +59,6 @@ def dump_all(dir_name, prefix="obs", registry=None, tracer=None, meta=None):
         os.path.join(dir_name, f"{prefix}.prom"), registry)
     p3 = export_chrome_trace(
         os.path.join(dir_name, f"{prefix}.trace.json"), tracer)
-    return p1, p2, p3
+    p4 = dump_flight_recorder(
+        os.path.join(dir_name, f"{prefix}.flight.json"), rec)
+    return p1, p2, p3, p4

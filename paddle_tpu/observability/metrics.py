@@ -115,9 +115,14 @@ class Gauge(_Child):
 
 
 class Histogram(_Child):
-    """Cumulative-bucket histogram, `le` (<=) semantics like Prometheus."""
+    """Cumulative-bucket histogram, `le` (<=) semantics like Prometheus.
 
-    __slots__ = ("_bounds", "_counts", "_sum", "_count")
+    `observe(v, exemplar=...)` attaches an OpenMetrics-style exemplar —
+    a trace id pinned to the bucket the value landed in — so a bad p99
+    bucket links to the exact request trace that produced it. Last
+    exemplar per bucket wins (bounded memory: at most one per bucket)."""
+
+    __slots__ = ("_bounds", "_counts", "_sum", "_count", "_exemplars")
 
     def __init__(self, state, labels_kv=(), buckets=DEFAULT_BUCKETS):
         super().__init__(state, labels_kv)
@@ -125,14 +130,34 @@ class Histogram(_Child):
         self._counts = [0] * (len(self._bounds) + 1)   # last = +Inf overflow
         self._sum = 0.0
         self._count = 0
+        self._exemplars = None     # lazily {bucket_idx: (trace_id, value)}
 
-    def observe(self, v):
+    def observe(self, v, exemplar=None):
         if not self._state.enabled:
             return
         with self._lock:
-            self._counts[bisect.bisect_left(self._bounds, v)] += 1
+            idx = bisect.bisect_left(self._bounds, v)
+            self._counts[idx] += 1
             self._sum += v
             self._count += 1
+            if exemplar is not None:
+                if self._exemplars is None:
+                    self._exemplars = {}
+                self._exemplars[idx] = (str(exemplar), float(v))
+
+    def exemplars(self):
+        """[(le, trace_id, value), ...] — the last exemplar recorded per
+        bucket ('+Inf' for the overflow bucket)."""
+        with self._lock:
+            if not self._exemplars:
+                return []
+            out = []
+            for idx in sorted(self._exemplars):
+                le = (self._bounds[idx] if idx < len(self._bounds)
+                      else "+Inf")
+                tid, val = self._exemplars[idx]
+                out.append((le, tid, val))
+            return out
 
     @property
     def sum(self):
@@ -213,8 +238,8 @@ class _Metric:
     def dec(self, v=1):
         self._solo().dec(v)
 
-    def observe(self, v):
-        self._solo().observe(v)
+    def observe(self, v, exemplar=None):
+        self._solo().observe(v, exemplar)
 
     @property
     def value(self):
@@ -230,6 +255,9 @@ class _Metric:
 
     def cumulative_buckets(self):
         return self._solo().cumulative_buckets()
+
+    def exemplars(self):
+        return self._solo().exemplars()
 
     def children(self):
         with self._lock:
@@ -333,10 +361,19 @@ def to_prometheus_text(registry: MetricRegistry) -> str:
         for key in sorted(m.children()):
             c = m.children()[key]
             if m.type == "histogram":
+                # OpenMetrics exemplar suffixes ride the bucket lines the
+                # exemplar landed in; plain-Prometheus scrapers treat the
+                # '#' tail as a comment
+                ex = {le: (tid, val) for le, tid, val in c.exemplars()}
                 for le, n in c.cumulative_buckets():
                     ls = _label_str(key, (("le", _fmt(le) if le != "+Inf"
                                            else "+Inf"),))
-                    lines.append(f"{m.name}_bucket{ls} {n}")
+                    suffix = ""
+                    if le in ex:
+                        tid, val = ex[le]
+                        suffix = (f' # {{trace_id="{_esc(tid)}"}} '
+                                  f"{_fmt(val)}")
+                    lines.append(f"{m.name}_bucket{ls} {n}{suffix}")
                 lines.append(f"{m.name}_sum{_label_str(key)} {_fmt(c.sum)}")
                 lines.append(
                     f"{m.name}_count{_label_str(key)} {c.count}")
@@ -353,10 +390,14 @@ def snapshot(registry: MetricRegistry, meta=None) -> dict:
         for key in sorted(m.children()):
             c = m.children()[key]
             if m.type == "histogram":
-                samples.append({"labels": dict(key), "sum": c.sum,
-                                "count": c.count,
-                                "buckets": [[le, n] for le, n in
-                                            c.cumulative_buckets()]})
+                s = {"labels": dict(key), "sum": c.sum,
+                     "count": c.count,
+                     "buckets": [[le, n] for le, n in
+                                 c.cumulative_buckets()]}
+                ex = c.exemplars()
+                if ex:
+                    s["exemplars"] = [[le, tid, val] for le, tid, val in ex]
+                samples.append(s)
             else:
                 samples.append({"labels": dict(key), "value": c.value})
         metrics.append({"name": m.name, "type": m.type, "help": m.help,
@@ -403,6 +444,12 @@ def load_snapshot(doc) -> MetricRegistry:
                 child._count = int(s.get("count", prev))
                 child._counts[-1] = child._count - prev
                 child._sum = float(s.get("sum", 0.0))
+                for le, tid, val in s.get("exemplars", []):
+                    idx = (len(child._bounds) if le == "+Inf"
+                           else child._bounds.index(float(le)))
+                    if child._exemplars is None:
+                        child._exemplars = {}
+                    child._exemplars[idx] = (str(tid), float(val))
             else:
                 child._value = float(s.get("value", 0.0))
     return reg

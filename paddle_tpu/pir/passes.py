@@ -279,4 +279,13 @@ class PassManager:
                             **{"pass": p.name}).inc(result.edits)
                 report[p.name] = {"seconds": dt, "edits": result.edits,
                                   "notes": result.notes}
+        try:
+            from ..observability.recorder import get_recorder
+            rec = get_recorder()
+            if rec.enabled:
+                rec.record("pir_pipeline", program=prog.name,
+                           passes=len(self.passes),
+                           edits=sum(r["edits"] for r in report.values()))
+        except Exception:  # noqa: BLE001 — black box never breaks a compile
+            pass
         return report

@@ -132,8 +132,22 @@ def compile_flat(flat_fn: Callable, flat_args: list, *, name: str,
         report.cache = "miss"
     _bump("miss")
     _metric("compile_cache_miss_total").inc()
+    _flight("miss", report.name)
     _cache_write(cache, report, jitted, flat_args, vjp_order)
     return jitted, report
+
+
+def _flight(status, name):
+    """Flight-recorder compile-cache probe event (hit/miss/corrupt/
+    store) — black-box context for a postmortem ('was the engine cold-
+    compiling when it died?'). Guarded: never breaks a compile."""
+    try:
+        from ..observability.recorder import get_recorder
+        rec = get_recorder()
+        if rec.enabled:
+            rec.record("compile_cache", status=status, program=name)
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def _make_evaluator(prog):
@@ -162,6 +176,7 @@ def _cache_read(cache, report):
     except CompileCacheCorruptionError as e:
         _bump("corrupt")
         _metric("compile_cache_corrupt_total").inc()
+        _flight("corrupt", report.name)
         warnings.warn(f"{e}; recompiling", RuntimeWarning, stacklevel=3)
         cache.drop(report.key)
         return None
@@ -179,6 +194,7 @@ def _cache_read(cache, report):
     except Exception as e:  # noqa: BLE001 — undeserializable == corrupt
         _bump("corrupt")
         _metric("compile_cache_corrupt_total").inc()
+        _flight("corrupt", report.name)
         warnings.warn(
             f"compile-cache artifact {report.key[:12]} verified but did "
             f"not deserialize ({e!r}); recompiling", RuntimeWarning,
@@ -188,6 +204,7 @@ def _cache_read(cache, report):
     report.cache = "hit"
     _bump("hit")
     _metric("compile_cache_hit_total").inc()
+    _flight("hit", report.name)
 
     def warm(*flat):
         return exported.call(*flat)
@@ -216,6 +233,7 @@ def _cache_write(cache, report, jitted, flat_args, vjp_order):
         return
     _bump("write")
     _metric("compile_cache_write_total").inc()
+    _flight("store", report.name)
 
 
 # --------------------------------------------------------------------------

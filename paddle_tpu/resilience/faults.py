@@ -139,11 +139,18 @@ class _Plan:
 _active: _Plan | None = None
 
 
-def _count_injected(site):
+def _count_injected(site, hit):
     try:
         from ..observability.catalog import metric
         metric("fault_injected_total", site=site).inc()
     except Exception:  # noqa: BLE001 — injection never fails over metrics
+        pass
+    try:
+        from ..observability.recorder import get_recorder
+        rec = get_recorder()
+        if rec.enabled:
+            rec.record("fault", site=site, hit=hit)
+    except Exception:  # noqa: BLE001 — nor over the flight recorder
         pass
 
 
@@ -165,7 +172,7 @@ def _fire(site, raise_exc):
             return None if raise_exc else False
         spec.fired += 1
         plan.injected[site] = plan.injected.get(site, 0) + 1
-    _count_injected(site)
+    _count_injected(site, hit)
     if not raise_exc:
         return True
     return spec.exc(f"injected fault at {site} (hit {hit})")

@@ -112,6 +112,14 @@ class TrainSupervisor:
         if self._save_fn is not None:
             self._save_fn(self.step_count)
         _count("train_preemptions_total")
+        try:
+            from ..observability.recorder import get_recorder
+            rec = get_recorder()
+            if rec.enabled:
+                rec.record("preempt", step=self.step_count)
+                rec.dump(reason="preempt")
+        except Exception:  # noqa: BLE001 — the black box never blocks exit
+            pass
         raise Preempted(self.step_count)
 
     # -- resume ------------------------------------------------------------

@@ -1,0 +1,569 @@
+"""The closed telemetry loop: request-scoped tracing with exemplars, the
+flight recorder, and the SLO engine (PR 6 satellites).
+
+Pins the cross-layer contracts:
+  * ONE quantile estimator (observability/quantiles.py) behind
+    tools/metrics_dump.py, the SLO engine, and tools/slo_report.py;
+  * exemplars round-trip trace ids through prometheus text and
+    snapshot/load_snapshot, and the engine's TTFT/TPOT exemplars are
+    real request trace ids;
+  * the flight-recorder ring is bounded, its postmortem dump is
+    schema-valid (including under an injected serve.decode_oom fault);
+  * serving_finished_total{reason}, the request.finish span, and the
+    recorder finish event all derive from the engine's ONE finish path;
+  * disabled mode allocates nothing (PR 2 noop guard extended to the
+    recorder and the exemplar path).
+"""
+
+import json
+import subprocess
+import sys
+import time
+import tracemalloc
+import os
+from collections import Counter as _Counter
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import quantiles as obs_quantiles
+from paddle_tpu.observability import recorder as obs_recorder
+from paddle_tpu.observability import slo as obs_slo
+from paddle_tpu.observability.tracing import LANE_TID_BASE
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+@pytest.fixture
+def enabled_obs():
+    """Enable the process-wide layer for one test, scoped and cleaned."""
+    obs.get_registry().reset()
+    obs.enable()
+    marker = obs.get_tracer().marker()
+    yield marker
+    obs.disable()
+
+
+def _tiny_model():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=256)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+def _engine(model, **kw):
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("prefill_buckets", (16,))
+    return ContinuousBatchingEngine(model, **kw)
+
+
+# ---------------------------------------------------------------------------
+# one instrumented engine run shared by the span-tree / exemplar tests
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def engine_run(tmp_path_factory):
+    """Run the engine once with the full layer on; capture everything the
+    read-only assertions need (chrome events, exemplars, recorder events,
+    request trace ids) eagerly so later tests can reset the singletons."""
+    obs.get_registry().reset()
+    rec = obs.get_recorder()
+    rec.clear()
+    obs.enable()
+    marker = obs.get_tracer().marker()
+    try:
+        model = _tiny_model()
+        eng = _engine(model)
+        rs = np.random.RandomState(0)
+        rids = [eng.add_request(rs.randint(0, 128, (7,)), max_new_tokens=4)
+                for _ in range(3)]
+        out = eng.run()
+        path = obs.get_tracer().export_chrome_trace(
+            str(tmp_path_factory.mktemp("trace") / "serving.json"),
+            marker=marker)
+        regd = obs.get_registry()
+        data = {
+            "out": out,
+            "trace_ids": {rid: eng.finished[rid].trace_id for rid in rids},
+            "events": json.load(open(path))["traceEvents"],
+            "ttft_exemplars": regd.get("serving_ttft_seconds").exemplars(),
+            "tpot_exemplars": regd.get("serving_tpot_seconds").exemplars(),
+            "prom": obs.prometheus_text(),
+            "recorder_kinds": set(rec.counts_by_kind()),
+        }
+    finally:
+        obs.disable()
+    return data
+
+
+# ---------------------------------------------------------------------------
+# quantile estimator (satellite: shared estimator, correctness vs exact)
+# ---------------------------------------------------------------------------
+
+class TestQuantileEstimator:
+    def test_matches_exact_on_synthetic_data(self):
+        """Against numpy's exact quantiles on uniform synthetic data the
+        bucket interpolation must land within one bucket width."""
+        rs = np.random.RandomState(7)
+        vals = rs.uniform(0.0, 10.0, 2000)
+        width = 0.25
+        reg = obs_metrics.MetricRegistry(enabled=True)
+        h = reg.histogram("lat", buckets=tuple(
+            np.arange(width, 10.0 + width, width)))
+        for v in vals:
+            h.observe(float(v))
+        for q in (0.5, 0.9, 0.95, 0.99):
+            est = obs_quantiles.quantile_from_cumulative(
+                h.cumulative_buckets(), q)
+            exact = float(np.percentile(vals, q * 100))
+            assert abs(est - exact) <= width + 1e-9, (q, est, exact)
+
+    def test_prometheus_interpolation_semantics(self):
+        # rank 15 of 30 falls in (1, 2]: 10 below, 20 inside -> 1.25
+        buckets = [(1.0, 10), (2.0, 30), ("+Inf", 30)]
+        assert obs_quantiles.quantile_from_cumulative(buckets, 0.5) == 1.25
+        # lowest bucket interpolates from 0
+        assert obs_quantiles.quantile_from_cumulative(buckets, 0.1) == \
+            pytest.approx(0.3)
+
+    def test_overflow_clamps_and_empty_is_none(self):
+        # rank in the +Inf overflow clamps to the largest finite bound
+        assert obs_quantiles.quantile_from_cumulative(
+            [(1.0, 5), ("+Inf", 10)], 0.99) == 1.0
+        assert obs_quantiles.quantile_from_cumulative([], 0.5) is None
+        assert obs_quantiles.quantile_from_cumulative(
+            [("+Inf", 5)], 0.5) is None
+        with pytest.raises(ValueError):
+            obs_quantiles.quantile_from_cumulative([(1.0, 1)], 1.5)
+
+    def test_slo_engine_uses_the_same_estimator_object(self):
+        """The satellite contract: ONE estimator. The SLO engine calls
+        the very function quantiles.py defines, not a copy."""
+        assert obs_slo.quantile_from_cumulative is \
+            obs_quantiles.quantile_from_cumulative
+
+
+# ---------------------------------------------------------------------------
+# exemplars (satellite: exemplar <-> trace-id round trip; disabled noop)
+# ---------------------------------------------------------------------------
+
+class TestExemplars:
+    def test_round_trip_through_prom_text_and_snapshot(self):
+        reg = obs_metrics.MetricRegistry(enabled=True)
+        h = reg.histogram("lat", buckets=(0.1, 1.0))
+        h.observe(0.05, exemplar="req-abc")
+        h.observe(0.5)                          # no exemplar on this bucket
+        assert h.exemplars() == [(0.1, "req-abc", 0.05)]
+        text = obs_metrics.to_prometheus_text(reg)
+        assert 'lat_bucket{le="0.1"} 1 # {trace_id="req-abc"} 0.05' in text
+        # the suffix rides ONLY the bucket the exemplar landed in
+        assert text.count(" # {") == 1
+        # snapshot -> json -> load_snapshot keeps it
+        doc = json.loads(json.dumps(obs_metrics.snapshot(reg)))
+        reg2 = obs_metrics.load_snapshot(doc)
+        assert reg2.get("lat").exemplars() == [(0.1, "req-abc", 0.05)]
+
+    def test_disabled_exemplar_path_allocates_nothing(self):
+        """PR 2 noop guard extended: observe(v, exemplar=...) on a
+        disabled registry must not touch the exemplar store either."""
+        dreg = obs_metrics.MetricRegistry(enabled=False)
+        h = dreg.histogram("h")
+        for _ in range(10):                     # warm up outside the trace
+            h.observe(0.5, exemplar="t-1")
+        tracemalloc.start()
+        snap1 = tracemalloc.take_snapshot()
+        for _ in range(1000):
+            h.observe(0.5, exemplar="t-1")
+        snap2 = tracemalloc.take_snapshot()
+        tracemalloc.stop()
+        leaked = [s for s in snap2.compare_to(snap1, "filename")
+                  if "metrics.py" in (s.traceback[0].filename or "")
+                  and s.size_diff > 0]
+        assert not leaked, leaked
+        assert h.count == 0 and h.exemplars() == []
+
+    def test_engine_exemplars_are_request_trace_ids(self, engine_run):
+        ids = set(engine_run["trace_ids"].values())
+        assert len(ids) == 3 and all(t.startswith("req-") for t in ids)
+        assert engine_run["ttft_exemplars"], "TTFT grew no exemplars"
+        for _le, tid, _val in engine_run["ttft_exemplars"]:
+            assert tid in ids
+        for _le, tid, _val in engine_run["tpot_exemplars"]:
+            assert tid in ids
+        # and they survive into the exposition text
+        assert "# {trace_id=\"req-" in engine_run["prom"]
+
+
+# ---------------------------------------------------------------------------
+# flight recorder (satellite: bounded ring, schema-valid dumps, noop)
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_wraps_bounded_with_total_order(self):
+        rec = obs_recorder.FlightRecorder(enabled=True, capacity=8)
+        for i in range(20):
+            rec.record("note", i=i)
+        assert len(rec) == 8
+        assert rec.total_recorded == 20
+        evs = rec.events()
+        assert [e["seq"] for e in evs] == list(range(12, 20))
+        assert [e["i"] for e in evs] == list(range(12, 20))
+
+    def test_unknown_kind_raises(self):
+        rec = obs_recorder.FlightRecorder(enabled=True, capacity=8)
+        with pytest.raises(KeyError, match="unknown flight-recorder"):
+            rec.record("warp_core_breach")
+
+    def test_disabled_record_allocates_nothing(self):
+        """The noop guard extended to the recorder. An unguarded call is
+        still swallowed, and the documented hot-path pattern — guard with
+        `if rec.enabled:` before packing kwargs, as serving.py does —
+        leaves zero allocations attributable to the recorder."""
+        rec = obs_recorder.FlightRecorder(enabled=False, capacity=8)
+        rec.record("note", i=1)                 # direct call: swallowed
+        assert rec.total_recorded == 0 and rec.events() == []
+        for _ in range(10):                     # warm up outside the trace
+            if rec.enabled:
+                rec.record("note", i=1)
+        tracemalloc.start()
+        snap1 = tracemalloc.take_snapshot()
+        for _ in range(1000):
+            if rec.enabled:
+                rec.record("note", i=1)
+        snap2 = tracemalloc.take_snapshot()
+        tracemalloc.stop()
+        leaked = [s for s in snap2.compare_to(snap1, "filename")
+                  if "recorder.py" in (s.traceback[0].filename or "")
+                  and s.size_diff > 0]
+        assert not leaked, leaked
+        assert rec.total_recorded == 0 and rec.events() == []
+
+    def test_dump_and_validate(self, tmp_path):
+        rec = obs_recorder.FlightRecorder(enabled=True, capacity=16)
+        rec.record("note", tag="a")
+        rec.record("fault", site="serve.decode_oom", hit=1)
+        path = rec.dump(str(tmp_path / "flight.json"), reason="test",
+                        extra={"who": "pytest"})
+        doc = obs_recorder.validate_dump(path)
+        assert doc["reason"] == "test" and doc["extra"] == {"who": "pytest"}
+        assert doc["total_recorded"] == 2 and doc["dropped"] == 0
+        assert [e["kind"] for e in doc["events"]] == ["note", "fault"]
+        assert rec.dumps == 1
+
+    def test_validate_rejects_corruption(self, tmp_path):
+        rec = obs_recorder.FlightRecorder(enabled=True, capacity=4)
+        rec.record("note")
+        rec.record("note")
+        good = json.load(open(rec.dump(str(tmp_path / "ok.json"))))
+
+        def broken(mutate):
+            doc = json.loads(json.dumps(good))
+            mutate(doc)
+            p = str(tmp_path / "bad.json")
+            json.dump(doc, open(p, "w"))
+            return p
+
+        for mutate, why in [
+                (lambda d: d.update(format=99), "format"),
+                (lambda d: d.pop("events"), "missing"),
+                (lambda d: d["events"][0].update(kind="nope"), "kind"),
+                (lambda d: d["events"][1].update(seq=0), "seq")]:
+            with pytest.raises(ValueError):
+                obs_recorder.validate_dump(broken(mutate))
+
+    def test_dump_while_disabled_documents_empty_ring(self, tmp_path):
+        rec = obs_recorder.FlightRecorder(enabled=False, capacity=4)
+        rec.record("note")                      # swallowed
+        doc = obs_recorder.validate_dump(
+            rec.dump(str(tmp_path / "empty.json"), reason="crash"))
+        assert doc["events"] == [] and doc["total_recorded"] == 0
+
+    def test_decode_oom_fault_leaves_readable_dump(self, enabled_obs,
+                                                   tmp_path):
+        """Satellite acceptance: an injected serve.decode_oom drill must
+        leave a schema-valid postmortem containing the fault event."""
+        from paddle_tpu.resilience import faults
+        rec = obs.get_recorder()
+        rec.clear()
+        model = _tiny_model()
+        eng = _engine(model)
+        rid = eng.add_request((np.arange(7) * 3) % 128, max_new_tokens=6)
+        with faults.injected_faults("serve.decode_oom:1:MemoryError"):
+            out = eng.run()
+        assert rid in out                       # engine degraded, not died
+        path = rec.dump(str(tmp_path / "flight.json"),
+                        reason="drill:serve.decode_oom")
+        doc = obs_recorder.validate_dump(path)
+        assert any(e["kind"] == "fault"
+                   and e.get("site") == "serve.decode_oom"
+                   for e in doc["events"])
+        kinds = {e["kind"] for e in doc["events"]}
+        assert {"dispatch", "shed", "finish"} <= kinds
+        assert obs.get_registry().get("flight_recorder_dumps_total").labels(
+            reason="drill:serve.decode_oom").value == 1
+
+
+# ---------------------------------------------------------------------------
+# request-scoped span tree (tentpole: admit -> ... -> finish, tile links)
+# ---------------------------------------------------------------------------
+
+class TestRequestSpanTree:
+    def test_span_tree_covers_request_lifecycle(self, engine_run):
+        names = {e["name"] for e in engine_run["events"] if e["ph"] == "X"}
+        assert {"request.admit", "request.queued", "request.prefill.chunk",
+                "request.decode.tile", "request.finish",
+                "serving.decode_tile"} <= names
+
+    def test_request_spans_carry_their_request_trace_id(self, engine_run):
+        ids = set(engine_run["trace_ids"].values())
+        seen = set()
+        for e in engine_run["events"]:
+            if e["ph"] == "X" and e["name"].startswith("request."):
+                assert e["args"].get("trace_id") in ids, e
+                seen.add(e["args"]["trace_id"])
+        assert seen == ids                      # every request shows up
+
+    def test_finish_spans_name_a_reason(self, engine_run):
+        fins = [e for e in engine_run["events"]
+                if e["ph"] == "X" and e["name"] == "request.finish"]
+        assert len(fins) == 3
+        for e in fins:
+            assert e["args"]["reason"] in ("eos", "length")
+
+    def test_decode_tiles_link_requests_and_group_by_lane(self, engine_run):
+        ids = set(engine_run["trace_ids"].values())
+        tiles = [e for e in engine_run["events"]
+                 if e["ph"] == "X" and e["name"] == "serving.decode_tile"]
+        assert tiles
+        linked = [e for e in tiles if e["args"].get("links")]
+        assert linked, "no decode tile carried span links"
+        for e in linked:
+            assert set(e["args"]["links"]) <= ids
+        # per-request tile shares live on synthetic lane tids...
+        lane_spans = [e for e in engine_run["events"]
+                      if e["ph"] == "X" and e["name"] == "request.decode.tile"]
+        assert lane_spans
+        assert all(e["tid"] >= LANE_TID_BASE for e in lane_spans)
+        # ...which the export names so the viewer groups by lane
+        labels = [e["args"]["name"] for e in engine_run["events"]
+                  if e["ph"] == "M" and e["name"] == "thread_name"]
+        assert labels and all(lbl.startswith("lane ") for lbl in labels)
+
+    def test_recorder_saw_the_same_run(self, engine_run):
+        assert {"admit", "dispatch", "readback", "membership",
+                "finish"} <= engine_run["recorder_kinds"]
+
+
+# ---------------------------------------------------------------------------
+# finish-path agreement (satellite f: one path, three mirrors)
+# ---------------------------------------------------------------------------
+
+class TestFinishAgreement:
+    def test_counter_span_and_recorder_agree(self, enabled_obs):
+        """serving_finished_total{reason}, request.finish spans, and the
+        recorder's finish events all derive from _finish(req, reason) —
+        the three views of a mixed run must be identical."""
+        rec = obs.get_recorder()
+        rec.clear()
+        model = _tiny_model()
+        eng = _engine(model, max_batch=1)
+        eng.add_request(np.arange(7) % 128, max_new_tokens=3)
+        eng.add_request(np.arange(5) % 128, max_new_tokens=3,
+                        deadline_s=3600.0)
+        eng.step()                              # r1 takes the only lane
+        eng.queue[0].t_deadline = time.perf_counter() - 1.0
+        eng.run()
+        counter = {}
+        for m in obs.get_registry().collect():
+            if m.name == "serving_finished_total":
+                for key, c in m.children().items():
+                    counter[dict(key)["reason"]] = int(c.value)
+        spans = _Counter(
+            s.args["reason"]
+            for s in obs.get_tracer().spans_since(enabled_obs)
+            if s.name == "request.finish")
+        events = _Counter(e["reason"] for e in rec.events()
+                          if e["kind"] == "finish")
+        assert counter == dict(spans) == dict(events) \
+            == {"length": 1, "timeout": 1}
+
+
+# ---------------------------------------------------------------------------
+# tracer ring wrap (satellite a: bounded by default, drops counted)
+# ---------------------------------------------------------------------------
+
+class TestTracerDrops:
+    def test_ring_wrap_counts_drops_into_the_catalog(self, enabled_obs):
+        tr = obs.get_tracer()
+        before = tr.dropped_spans
+        old_maxlen = tr._maxlen
+        tr._maxlen = 16
+        try:
+            for _ in range(40):
+                with obs.span("drop.fodder"):
+                    pass
+        finally:
+            tr._maxlen = old_maxlen
+        assert tr.dropped_spans - before >= 24
+        assert obs.get_registry().get(
+            "tracer_dropped_spans_total").value >= 24
+
+
+# ---------------------------------------------------------------------------
+# SLO engine (tentpole: declarative specs, windowed verdicts, gauges)
+# ---------------------------------------------------------------------------
+
+def _finishes_reg(**counts):
+    reg = obs_metrics.MetricRegistry(enabled=True)
+    c = reg.counter("serving_finished_total", labels=("reason",))
+    for reason, n in counts.items():
+        c.labels(reason=reason).inc(n)
+    return reg
+
+
+class TestSLOEngine:
+    def test_quantile_verdict_matches_the_shared_estimator(self):
+        reg = obs_metrics.MetricRegistry(enabled=True)
+        h = reg.histogram("serving_ttft_seconds", buckets=(0.5, 2.5, 10.0))
+        for _ in range(20):
+            h.observe(5.0)
+        spec = obs_slo.SLOSpec("ttft_p95", "quantile",
+                               "serving_ttft_seconds", 2.5, q=0.95)
+        eng = obs_slo.SLOEngine([spec])
+        eng.observe(obs_metrics.snapshot(reg), t=0.0)
+        r = eng.evaluate(emit=False)["slos"][0]
+        expected = obs_quantiles.quantile_from_cumulative(
+            h.cumulative_buckets(), 0.95)
+        assert r["observed"] == pytest.approx(expected)   # 9.625
+        assert r["ok"] is False and r["count"] == 20
+        assert r["burn_rate"] == pytest.approx(expected / 2.5)
+
+    def test_error_budget_burn_rate(self):
+        spec = obs_slo.SLOSpec("availability", "error_budget",
+                               "serving_finished_total", 0.99,
+                               good={"reason": ("eos", "length")})
+        eng = obs_slo.SLOEngine([spec])
+        eng.observe(obs_metrics.snapshot(
+            _finishes_reg(eos=90, length=8, timeout=2)), t=0.0)
+        r = eng.evaluate(emit=False)["slos"][0]
+        assert r["ok"] is False
+        assert r["good"] == 98 and r["total"] == 100
+        # 2% bad against a 1% budget burns at 2x
+        assert r["burn_rate"] == pytest.approx(2.0)
+
+    def test_window_excludes_old_failures(self):
+        """The verdict reflects the window, not process lifetime: early
+        timeouts stop counting once the diff baseline passes them."""
+        spec = obs_slo.SLOSpec("availability", "error_budget",
+                               "serving_finished_total", 0.99,
+                               good={"reason": ("eos",)})
+        eng = obs_slo.SLOEngine([spec], window_s=60.0)
+        eng.observe(obs_metrics.snapshot(
+            _finishes_reg(eos=100, timeout=2)), t=0.0)
+        # single observation: lifetime counts -> 2/102 bad -> MISS
+        assert eng.evaluate(emit=False)["ok"] is False
+        eng.observe(obs_metrics.snapshot(
+            _finishes_reg(eos=300, timeout=2)), t=30.0)
+        # diff vs t=0: +200 eos, +0 timeout -> clean window -> OK
+        v = eng.evaluate(emit=False)
+        assert v["ok"] is True
+        assert v["slos"][0]["total"] == 200
+
+    def test_missing_metric_is_no_data_not_a_breach(self):
+        eng = obs_slo.SLOEngine()               # DEFAULT_SLOS
+        eng.observe(obs_metrics.snapshot(
+            obs_metrics.MetricRegistry(enabled=True)), t=0.0)
+        v = eng.evaluate(emit=False)
+        assert v["ok"] is True
+        assert all(r.get("no_data") for r in v["slos"])
+
+    def test_evaluate_emits_catalog_gauges(self, enabled_obs):
+        eng = obs_slo.SLOEngine()
+        eng.observe(obs_metrics.snapshot(
+            obs_metrics.MetricRegistry(enabled=True)), t=0.0)
+        eng.evaluate(emit=True)
+        regd = obs.get_registry()
+        for spec in obs_slo.DEFAULT_SLOS:
+            assert regd.get("slo_compliance").labels(
+                slo=spec.name).value == 1.0
+            assert regd.get("slo_burn_rate").labels(
+                slo=spec.name).value == 0.0
+
+    def test_spec_parsing_and_validation(self):
+        specs = obs_slo.parse_specs(json.dumps({"slos": [
+            {"name": "p95", "kind": "quantile", "metric": "m",
+             "objective": 1.0, "q": 0.95},
+            {"name": "avail", "kind": "error_budget", "metric": "c",
+             "objective": 0.9, "good": {"reason": ["eos"]}}]}))
+        assert [s.name for s in specs] == ["p95", "avail"]
+        assert specs[0].to_dict()["q"] == 0.95
+        with pytest.raises(ValueError, match="unknown SLO kind"):
+            obs_slo.SLOSpec("x", "latency", "m", 1.0)
+        with pytest.raises(ValueError, match="needs q"):
+            obs_slo.SLOSpec("x", "quantile", "m", 1.0)
+        with pytest.raises(ValueError, match="needs good"):
+            obs_slo.SLOSpec("x", "error_budget", "m", 0.9)
+        with pytest.raises(ValueError, match="objective"):
+            obs_slo.SLOSpec("x", "error_budget", "m", 1.5,
+                            good={"reason": ["eos"]})
+
+
+# ---------------------------------------------------------------------------
+# the operator tools (satellite b/e: shared estimator columns, --check)
+# ---------------------------------------------------------------------------
+
+def _run_tool(name, *argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(TOOLS, name), *argv],
+        capture_output=True, text=True, timeout=120)
+
+
+def _snapshot_file(tmp_path, ttft_value):
+    reg = obs_metrics.MetricRegistry(enabled=True)
+    h = reg.histogram("serving_ttft_seconds", buckets=(0.5, 2.5, 10.0))
+    for _ in range(20):
+        h.observe(ttft_value)
+    reg.counter("serving_finished_total",
+                labels=("reason",)).labels(reason="eos").inc(100)
+    path = str(tmp_path / "obs.metrics.jsonl")
+    obs_metrics.write_snapshot_jsonl(path, reg)
+    return path
+
+
+class TestTools:
+    def test_slo_report_check_flags_a_breach(self, tmp_path):
+        bad = _snapshot_file(tmp_path, ttft_value=5.0)   # p95 -> 9.625s
+        r = _run_tool("slo_report.py", bad, "--check")
+        assert r.returncode == 1, r.stderr
+        assert "verdict: SLO MISS" in r.stdout
+        r = _run_tool("slo_report.py", bad, "--json")
+        verdict = json.loads(r.stdout)
+        assert r.returncode == 0                 # --json alone never gates
+        ttft = next(s for s in verdict["slos"] if s["name"] == "ttft_p95")
+        assert ttft["observed"] == pytest.approx(9.625)
+        assert ttft["ok"] is False
+
+    def test_slo_report_passes_a_healthy_snapshot(self, tmp_path):
+        good = _snapshot_file(tmp_path, ttft_value=0.1)
+        r = _run_tool("slo_report.py", good, "--check")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "verdict: OK" in r.stdout
+
+    def test_metrics_dump_prints_shared_estimator_quantiles(self, tmp_path):
+        path = _snapshot_file(tmp_path, ttft_value=5.0)
+        r = _run_tool("metrics_dump.py", path)
+        assert r.returncode == 0, r.stderr
+        # the very numbers the SLO engine would judge (one estimator):
+        # all 20 obs in (2.5, 10] -> p50=6.25, p95=9.625, p99=9.925
+        assert "p50=6.25" in r.stdout
+        assert "p95=9.625" in r.stdout
+        assert "p99=9.925" in r.stdout

@@ -347,9 +347,12 @@ def main():
 
     sites = args.site or sorted(SCENARIOS)
     obs.enable()
+    from paddle_tpu.observability import recorder as flight
+    rec = obs.get_recorder()
     import tempfile
     rows = []
     escapes = 0
+    bad_dumps = 0
     for site in sites:
         fn = SCENARIOS.get(site)
         if fn is None:
@@ -357,6 +360,7 @@ def main():
                   f"{sorted(SCENARIOS)}", file=sys.stderr)
             return 2
         tmp = tempfile.mkdtemp(prefix=f"chaos_{site.replace('.', '_')}_")
+        rec.clear()     # per-scenario black box
         try:
             outcome, note = fn(tmp)
         except Escape as e:
@@ -369,6 +373,23 @@ def main():
                 traceback.print_exc()
         finally:
             faults.disarm()
+        # black-box gate: EVERY drilled fault must leave a readable,
+        # schema-valid flight-recorder dump containing its fault event —
+        # a postmortem that can't be read is itself a drill failure
+        dump_path = os.path.join(tmp, "flight.json")
+        try:
+            rec.dump(dump_path, reason=f"drill:{site}")
+            doc = flight.validate_dump(dump_path)
+            if not any(ev["kind"] == "fault" and ev.get("site") == site
+                       for ev in doc["events"]):
+                raise ValueError(
+                    f"dump has no fault event for site {site!r}")
+        except Exception as e:  # noqa: BLE001 — missing/corrupt dump
+            bad_dumps += 1
+            note += f" [FLIGHT DUMP BAD: {e}]"
+        else:
+            if args.verbose:
+                note += f" [flight dump ok: {dump_path}]"
         rows.append((site, outcome, note))
 
     w = max(len(s) for s, _, _ in rows)
@@ -382,13 +403,17 @@ def main():
     if fam is not None:
         total_inj = sum(c.value for c in fam.children().values())
     print(f"\n{len(rows)} scenarios, {int(total_inj)} faults injected, "
-          f"{escapes} escapes")
+          f"{escapes} escapes, {bad_dumps} bad flight dumps")
     if escapes:
         print("DRILL FAILED: injected faults escaped unhandled",
               file=sys.stderr)
         return 1
+    if bad_dumps:
+        print("DRILL FAILED: flight-recorder dumps missing or corrupt",
+              file=sys.stderr)
+        return 1
     print("DRILL PASSED: every injected fault was retried, degraded, or "
-          "surfaced typed + counted")
+          "surfaced typed + counted, and left a readable flight dump")
     return 0
 
 

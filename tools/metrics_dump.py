@@ -28,12 +28,16 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _metrics_mod():
-    path = os.path.join(REPO, "paddle_tpu", "observability", "metrics.py")
-    spec = importlib.util.spec_from_file_location("_dump_metrics", path)
+def _obs_mod(stem):
+    path = os.path.join(REPO, "paddle_tpu", "observability", f"{stem}.py")
+    spec = importlib.util.spec_from_file_location(f"_dump_{stem}", path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _metrics_mod():
+    return _obs_mod("metrics")
 
 
 def _find_snapshot(obj):
@@ -75,6 +79,10 @@ def load_any(path, mod):
 
 
 def table(reg, mod):
+    # quantile columns share THE estimator with the SLO engine
+    # (observability/quantiles.py) — a p95 here is the same p95 an
+    # slo_report verdict judged
+    quant = _obs_mod("quantiles")
     lines = []
     header = f"{'metric':<44}{'type':>10}  {'labels':<34}{'value':>14}"
     lines += [header, "-" * len(header)]
@@ -85,6 +93,12 @@ def table(reg, mod):
             if m.type == "histogram":
                 val = (f"n={c.count} sum={c.sum:.6g}"
                        + (f" avg={c.sum / c.count:.6g}" if c.count else ""))
+                qs = quant.quantiles_from_cumulative(
+                    c.cumulative_buckets(), quant.DEFAULT_QS)
+                if c.count:
+                    val += "".join(
+                        f" p{int(q * 100)}={est:.6g}"
+                        for q, est in sorted(qs.items()) if est is not None)
             else:
                 val = f"{c.value:.6g}"
             lines.append(f"{m.name:<44}{m.type:>10}  {labels:<34}{val:>14}")

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Evaluate SLOs against a metrics snapshot and print the verdict — the
+operator/CI half of the SLO engine (OBSERVABILITY.md "SLO specs").
+
+Usage:
+  python tools/slo_report.py obs.metrics.jsonl             # default SLOs,
+                                                           # human table
+  python tools/slo_report.py BENCH_r05.json --json         # machine verdict
+  python tools/slo_report.py snap.jsonl --spec my_slos.json
+  python tools/slo_report.py snap.jsonl --check            # exit 1 on breach
+
+Accepts anything tools/metrics_dump.py accepts (JSONL snapshot, JSON
+embedding one, bench row). `--spec` takes a JSON file of
+{"slos": [{name, kind, metric, objective, q?, good?}, ...]}.
+
+The p95/p99 figures come from observability/quantiles.py — the same
+estimator metrics_dump prints — so this report and an operator's dump
+always agree. Dependency-free: loads the observability modules by file
+path, runs on machines without jax.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from metrics_dump import _obs_mod, load_any  # noqa: E402 — sibling tool
+
+
+def _fmt_val(v):
+    return "-" if v is None else f"{v:.6g}"
+
+
+def render(verdict):
+    lines = []
+    header = (f"{'slo':<16}{'metric':<28}{'objective':>12}{'observed':>12}"
+              f"{'burn':>8}  {'ok':<4}")
+    lines += [header, "-" * len(header)]
+    for r in verdict["slos"]:
+        obj = (f"p{int(r['q'] * 100)}<={r['objective']:g}"
+               if r["kind"] == "quantile" else f">={r['objective']:g}")
+        obs = (r.get("observed") if r["kind"] == "quantile"
+               else r.get("good_fraction"))
+        status = "OK" if r["ok"] else "MISS"
+        if r.get("no_data"):
+            status = "n/a"
+        lines.append(f"{r['name']:<16}{r['metric']:<28}{obj:>12}"
+                     f"{_fmt_val(obs):>12}{r.get('burn_rate', 0):>8.3g}"
+                     f"  {status:<4}")
+    lines.append(f"verdict: {'OK' if verdict['ok'] else 'SLO MISS'} "
+                 f"(window {verdict['window_s']:g}s)")
+    return "\n".join(lines)
+
+
+def main(argv):
+    args = [a for a in argv if not a.startswith("--")]
+    as_json = "--json" in argv
+    check = "--check" in argv
+    spec_path = None
+    if "--spec" in argv:
+        i = argv.index("--spec")
+        if i + 1 >= len(argv):
+            raise SystemExit("--spec needs a file argument")
+        spec_path = argv[i + 1]
+        if spec_path in args:
+            args.remove(spec_path)
+    if not args:
+        raise SystemExit(__doc__)
+
+    metrics = _obs_mod("metrics")
+    slo = _obs_mod("slo")
+    snap = load_any(args[0], metrics)
+    specs = None
+    if spec_path:
+        with open(spec_path) as f:
+            specs = slo.parse_specs(f.read())
+    eng = slo.SLOEngine(specs)
+    eng.observe(snap, t=float(snap.get("recorded_unix", 0)))
+    verdict = eng.evaluate(emit=False)
+    print(json.dumps(verdict, indent=1) if as_json else render(verdict))
+    return 1 if (check and not verdict["ok"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

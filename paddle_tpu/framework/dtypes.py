@@ -46,6 +46,9 @@ NAME2DTYPE = {
     "fp64": jnp.float64,
     "float8_e4m3fn": jnp.float8_e4m3fn,
     "float8_e5m2": jnp.float8_e5m2,
+    # short serving-config spellings (inference kv_cache_dtype et al.)
+    "fp8_e4m3": jnp.float8_e4m3fn,
+    "fp8_e5m2": jnp.float8_e5m2,
 }
 
 _DEFAULT_FLOAT = [jnp.float32]

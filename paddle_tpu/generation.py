@@ -139,7 +139,8 @@ def _llama_layer_prefill(lp, h, pos, cfg):
     return h, (k, v)
 
 
-def _llama_layer_prefill_chunk(lp, h, kc, vc, table_row, start, cfg):
+def _llama_layer_prefill_chunk(lp, h, kc, vc, table_row, start, cfg,
+                               fmt=None, kc_scale=None, vc_scale=None):
     """One layer forward over a prompt CHUNK against the paged pool (the
     serving engine's chunked prefill): rotate the chunk's Q/K at absolute
     positions, scatter the chunk's K/V into the pool (multi-token write),
@@ -149,9 +150,14 @@ def _llama_layer_prefill_chunk(lp, h, kc, vc, table_row, start, cfg):
     h: (1, C, H) chunk hidden states; kc/vc: ONE layer's
     (num_blocks, block_size, KVH, D) pool slice; table_row: (max_blocks,)
     block table of the owning sequence; start: absolute position of the
-    chunk's first token. Returns (h_out, (kc, vc)).
+    chunk's first token. Returns (h_out, (kc, vc)) — with a quantized
+    `fmt` (and its per-(token, head) scale pool slices) the writes encode
+    and the attention read dequantizes in place, and the second element
+    becomes (kc, vc, kc_scale, vc_scale). fmt=None keeps the original
+    trace byte-for-byte.
     """
-    from .ops.paged_attention import (paged_attention_prefill_chunk,
+    from .ops.paged_attention import (kv_write_chunk,
+                                      paged_attention_prefill_chunk,
                                       write_chunk_to_cache)
     eps, theta = cfg["eps"], cfg["theta"]
     nh, nkv, hd = cfg["heads"], cfg["kv_heads"], cfg["head_dim"]
@@ -163,14 +169,24 @@ def _llama_layer_prefill_chunk(lp, h, kc, vc, table_row, start, cfg):
     v = (x @ lp["self_attn.v_proj.weight"]).reshape(b, c, nkv, hd)
     q = _rope(q, pos, theta)
     k = _rope(k, pos, theta)
-    kc, vc = write_chunk_to_cache(kc, vc, k[0], v[0], table_row, start)
+    quant = fmt is not None and fmt.quantized
+    if quant:
+        kc, vc, kc_scale, vc_scale = kv_write_chunk(
+            fmt, kc, vc, kc_scale, vc_scale, k[0], v[0], table_row, start)
+    else:
+        kc, vc = write_chunk_to_cache(kc, vc, k[0], v[0], table_row, start)
     attn = paged_attention_prefill_chunk(q[0], kc, vc, table_row, start,
-                                         scale=1.0 / (hd ** 0.5))
+                                         scale=1.0 / (hd ** 0.5),
+                                         fmt=fmt if quant else None,
+                                         k_scale_cache=kc_scale,
+                                         v_scale_cache=vc_scale)
     h = h + attn.reshape(b, c, nh * hd) @ lp["self_attn.o_proj.weight"]
     x = _rms(h, lp["post_attention_layernorm.weight"], eps)
     gate = x @ lp["mlp.gate_proj.weight"]
     up = x @ lp["mlp.up_proj.weight"]
     h = h + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
+    if quant:
+        return h, (kc, vc, kc_scale, vc_scale)
     return h, (kc, vc)
 
 

@@ -55,8 +55,10 @@ from ..observability.recorder import get_recorder as _get_recorder
 from ..observability.tracing import LANE_TID_BASE
 from ..observability.tracing import get_tracer as _get_tracer
 from ..observability.tracing import new_trace_id as _new_trace_id
-from ..ops.paged_attention import (paged_attention_decode_inner,
-                                   write_to_cache)
+from ..ops.paged_attention import (KVBlockFormat, kv_rollback_tokens,
+                                   kv_write_token, kv_write_tokens,
+                                   paged_attention_decode_inner,
+                                   paged_attention_verify, write_to_cache)
 from ..resilience.faults import FaultInjected, fault_point
 
 __all__ = ["ContinuousBatchingEngine", "Request", "BackpressureError",
@@ -155,12 +157,24 @@ class _LayeredBlockPool:
     One block-id table per sequence, shared by all layers."""
 
     def __init__(self, num_layers, num_blocks, block_size, kv_heads,
-                 head_dim, dtype):
+                 head_dim, dtype, fmt=None):
         self.block_size = block_size
         self.num_blocks = num_blocks
+        # storage format of the blocks (round 11): quantized formats hold
+        # int8/fp8 payloads plus a parallel per-(token, head) scale pool;
+        # passthrough formats ARE the pre-round-11 pool, byte-identical
+        self.fmt = fmt if fmt is not None else KVBlockFormat(
+            "native", native_dtype=dtype)
+        store = self.fmt.store_dtype if self.fmt.quantized else dtype
         shape = (num_layers, num_blocks, block_size, kv_heads, head_dim)
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        self.k = jnp.zeros(shape, store)
+        self.v = jnp.zeros(shape, store)
+        if self.fmt.quantized:
+            sshape = (num_layers, num_blocks, block_size, kv_heads)
+            self.k_scale = jnp.zeros(sshape, self.fmt.scale_dtype)
+            self.v_scale = jnp.zeros(sshape, self.fmt.scale_dtype)
+        else:
+            self.k_scale = self.v_scale = None
         # the LAST block is the scratch target for inactive decode lanes:
         # every lane writes its token's K/V unconditionally inside the
         # compiled step (no data-dependent skips), so masked lanes must
@@ -211,10 +225,10 @@ class _Inflight:
     tile was in flight."""
 
     __slots__ = ("tile", "t_dispatch", "reqs", "epochs", "k", "covers_all",
-                 "tile_id")
+                 "tile_id", "spec")
 
     def __init__(self, tile, t_dispatch, reqs, epochs, k, covers_all,
-                 tile_id=0):
+                 tile_id=0, spec=False):
         self.tile = tile
         self.t_dispatch = t_dispatch
         self.reqs = reqs
@@ -222,6 +236,10 @@ class _Inflight:
         self.k = k
         self.covers_all = covers_all
         self.tile_id = tile_id
+        # speculative tiles are (tokens [B, K, D+1], counts [B, K]) pairs
+        # instead of a [B, K] array; per-tile, not per-engine, so tiles
+        # dispatched before a speculation-off degradation drain correctly
+        self.spec = spec
 
 
 class ContinuousBatchingEngine:
@@ -245,6 +263,27 @@ class ContinuousBatchingEngine:
         re-uploaded EVERY step, every tile drained synchronously (no
         dispatch-ahead). The bench A/B baseline, and a fully-synchronous
         debug mode (nothing in flight between steps).
+
+    Round-11 knobs (PERF.md "Speculative decode + quantized KV"):
+      speculative_decode: each fused scan step proposes draft_depth
+        tokens from the drafter, verifies them in ONE batched forward
+        and commits the accepted run plus a correction token — up to
+        K*(draft_depth+1) tokens per dispatch, greedy streams
+        byte-identical to the non-speculative path.
+      draft_depth: draft tokens per scan step (clamped to block_size-1
+        so one step's writes never alias within a block).
+      draft_ngram: context length of the built-in n-gram/prompt-lookup
+        drafter.
+      drafter: pluggable draft hook `fn(hist, lens, toks, depth) ->
+        [B, depth] int32`, traced inside the compiled program (a cheap
+        draft model goes here); None = the built-in n-gram drafter.
+      kv_cache_dtype: paged-pool block format — "bf16"/"native" (store
+        the model dtype; the PR-5-identical pool), "int8", "fp8_e4m3",
+        "fp8_e5m2" (quantized payloads + per-(token, head) scales,
+        dequant fused into the attention reads).
+      kv_pool_bytes: size the pool by HBM budget instead of num_blocks —
+        int8 fits ~2x the lanes of bf16 in the same bytes (test-pinned
+        >=1.9x).
     """
 
     def __init__(self, model, num_blocks=256, block_size=16, max_batch=8,
@@ -252,7 +291,9 @@ class ContinuousBatchingEngine:
                  prefill_buckets=(64, 128, 256, 512, 1024),
                  max_queue=None, max_sheds=2, decode_steps=4,
                  prefill_chunk=None, prefill_chunks_per_step=1,
-                 compat_step_loop=False):
+                 compat_step_loop=False, speculative_decode=False,
+                 draft_depth=2, draft_ngram=3, drafter=None,
+                 kv_cache_dtype="bf16", kv_pool_bytes=None):
         config = model.config
         self.cfg = dict(eps=config.rms_norm_eps, theta=config.rope_theta,
                         heads=config.num_attention_heads,
@@ -270,16 +311,34 @@ class ContinuousBatchingEngine:
         self._out_w = self.head_w if self.head_w is not None \
             else jnp.asarray(self.embed_w).T
         L = config.num_hidden_layers
+        fmt = KVBlockFormat(kv_cache_dtype, native_dtype=self.embed_w.dtype)
+        if kv_pool_bytes is not None:
+            # size the pool by byte budget: blocks = budget / bytes-per-
+            # block (k AND v, all layers, payload + scales) — the knob
+            # that makes int8's ~2x lane capacity a measurable contract
+            per_block = (L * block_size * 2 *
+                         fmt.bytes_per_token(self.cfg["kv_heads"],
+                                             self.cfg["head_dim"]))
+            num_blocks = max(2, int(kv_pool_bytes) // per_block)
         self.pool = _LayeredBlockPool(L, num_blocks, block_size,
                                       self.cfg["kv_heads"],
                                       self.cfg["head_dim"],
-                                      self.embed_w.dtype)
+                                      self.embed_w.dtype, fmt=fmt)
         self.max_batch = int(max_batch)
         self.max_blocks_per_seq = int(max_blocks_per_seq)
         self.buckets = tuple(sorted(prefill_buckets))
         self.compat_step_loop = bool(compat_step_loop)
         self.decode_steps = (1 if self.compat_step_loop
                              else max(1, int(decode_steps)))
+        # speculative decode rides the fused scan; the compat loop is by
+        # definition the pre-fused engine, so it never speculates
+        self.spec = bool(speculative_decode) and not self.compat_step_loop
+        # depth cap: one step writes draft_depth+1 contiguous slots per
+        # lane; keeping that <= block_size guarantees the write and its
+        # rollback never alias within a block
+        self.draft_depth = max(1, min(int(draft_depth), block_size - 1))
+        self.draft_ngram = max(2, int(draft_ngram))
+        self._drafter = drafter
         self.chunk = int(prefill_chunk or self.buckets[-1])
         self.prefill_chunks_per_step = max(1, int(prefill_chunks_per_step))
         # chunk widths a prefill piece may compile at: every bucket that
@@ -348,6 +407,10 @@ class ContinuousBatchingEngine:
         self._m_hostsync = _metric("serving_hostsync_seconds")
         self._m_hostsync_retries = _metric("serving_hostsync_retries_total")
         self._m_chunks = _metric("serving_prefill_chunks_total")
+        self._m_draft = _metric("serving_draft_tokens_total")
+        self._m_accept = _metric("serving_accepted_tokens_total")
+        self._m_accept_rate = _metric("serving_spec_acceptance_rate")
+        self._m_tok_disp = _metric("serving_tokens_per_dispatch")
         _metric("serving_preempted_total")  # declared: 0 by design
         # request-scoped telemetry handles, bound once; every hot-path
         # use is guarded by a single `.enabled` attribute check so the
@@ -658,11 +721,19 @@ class ContinuousBatchingEngine:
         table[:len(t)] = t
         is_final = task.idx == len(task.pieces) - 1
         last_idx = (s - 1 - start) if is_final else 0
+        args = [self.stacked, self.embed_w, self.norm_w, self._out_w,
+                self.pool.k, self.pool.v]
+        if self.pool.fmt.quantized:
+            args += [self.pool.k_scale, self.pool.v_scale]
+        args += [jnp.asarray(ids), jnp.int32(start), jnp.int32(last_idx),
+                 jnp.asarray(table)]
         t0 = time.perf_counter()
-        logits, self.pool.k, self.pool.v = fn(
-            self.stacked, self.embed_w, self.norm_w, self._out_w,
-            self.pool.k, self.pool.v, jnp.asarray(ids), jnp.int32(start),
-            jnp.int32(last_idx), jnp.asarray(table))
+        out = fn(*args)
+        if self.pool.fmt.quantized:
+            (logits, self.pool.k, self.pool.v,
+             self.pool.k_scale, self.pool.v_scale) = out
+        else:
+            logits, self.pool.k, self.pool.v = out
         dt = time.perf_counter() - t0
         self._m_prefill.observe(dt)
         self._m_chunks.inc()
@@ -712,6 +783,21 @@ class ContinuousBatchingEngine:
         numpy touches the device state)."""
         if self.compat_step_loop:
             self._dirty = True      # pre-fused loop: re-upload every step
+        # round-11 degradation sites fire BEFORE the drain/upload
+        # decision so the membership machinery below drains any in-flight
+        # tile (under its dispatch-time variant) before the lane-state
+        # re-upload switches programs — a mid-flight rewind would
+        # double-emit the tile's tokens
+        if self.spec:
+            try:
+                fault_point("serve.draft_verify", depth=self.draft_depth)
+            except _TRANSIENT_ERRORS:
+                self._disable_spec("draft_verify_fault")
+        if self.pool.fmt.quantized:
+            try:
+                fault_point("serve.kv_dequant", fmt=self.pool.fmt.name)
+            except _TRANSIENT_ERRORS:
+                self._degrade_kv_to_bf16()
         active = self._decode_active()
         if not active:
             if self._inflight:
@@ -763,7 +849,7 @@ class ContinuousBatchingEngine:
         self._tile_seq += 1
         self._inflight.append(_Inflight(
             tile, t0, snap, self._lane_epoch.copy(), K, covers_all,
-            tile_id))
+            tile_id, spec=isinstance(tile, tuple)))
         if self._rec.enabled:
             self._rec.record("dispatch", tile=tile_id, lanes=list(active),
                              epochs=[int(self._lane_epoch[i])
@@ -776,29 +862,86 @@ class ContinuousBatchingEngine:
             if not self._drain_one():
                 break
 
+    def _disable_spec(self, why):
+        """serve.draft_verify degradation: permanently fall back to the
+        non-speculative fused decode. Streams continue byte-identically
+        (speculation never changes the committed tokens); only the
+        tokens-per-dispatch multiplier is lost."""
+        self.spec = False
+        _metric("serving_runtime_degradations_total",
+                what="speculation_off").inc()
+        if self._rec.enabled:
+            self._rec.record("degrade", what="speculation_off", why=why)
+        # _decode_phase drains in-flight tiles (flagged spec per-tile)
+        # before honoring _dirty, so no committed token is re-emitted
+        self._dirty = True
+
+    def _degrade_kv_to_bf16(self):
+        """serve.kv_dequant degradation: dequantize the WHOLE pool to the
+        native dtype once (timed into serving_kv_dequant_seconds) and
+        drop the quantized block format for the engine's lifetime. Every
+        compiled program embedded the quantized pool dtypes, so the jit
+        caches are cleared and programs recompile against the bf16 pool."""
+        t0 = time.perf_counter()
+        fmt = self.pool.fmt
+        self.pool.k = fmt.decode(self.pool.k, self.pool.k_scale)
+        self.pool.v = fmt.decode(self.pool.v, self.pool.v_scale)
+        self.pool.k_scale = self.pool.v_scale = None
+        self.pool.fmt = KVBlockFormat("native",
+                                      native_dtype=self.embed_w.dtype)
+        self._prefill_jit.clear()
+        self._decode_jit.clear()
+        _metric("serving_kv_dequant_seconds").observe(
+            time.perf_counter() - t0)
+        _metric("serving_runtime_degradations_total", what="kv_bf16").inc()
+        if self._rec.enabled:
+            self._rec.record("degrade", what="kv_bf16", fmt=fmt.name)
+
     def _dispatch(self):
         d = self._dev
         variant = d["variant"]
+        spec = variant.endswith(".spec")
+        sampled = variant.startswith("sampled")
+        quant = self.pool.fmt.quantized
         fn = self._decode_jit.get(variant)
         if fn is None:
             # decode keeps donation (the KV pools must not double-buffer),
             # so the pipeline runs but the artifact store is bypassed
             # (pir reports cache="bypass:donate")
             from ..pir import pir_jit
-            name = ("serving.decode" if variant == "greedy"
-                    else "serving.decode.sampled")
-            fn = pir_jit(self._make_decode(variant == "sampled"),
-                         name=name, donate_argnums=(4, 5))
+            name = ("serving.decode" + (".sampled" if sampled else "")
+                    + (".spec" if spec else ""))
+            maker = self._make_decode_spec if spec else self._make_decode
+            fn = pir_jit(maker(sampled), name=name,
+                         donate_argnums=(4, 5, 6, 7) if quant else (4, 5))
             self._decode_jit[variant] = fn
         args = [self.stacked, self.embed_w, self.norm_w, self._out_w,
-                self.pool.k, self.pool.v, d["toks"], d["lens"], d["alive"],
-                d["rem"], d["eos"], d["tables"]]
-        if variant == "sampled":
+                self.pool.k, self.pool.v]
+        if quant:
+            args += [self.pool.k_scale, self.pool.v_scale]
+        args += [d["toks"], d["lens"], d["alive"], d["rem"], d["eos"],
+                 d["tables"]]
+        if spec:
+            args.append(d["hist"])
+        if sampled:
             args += [d["seeds"], d["do_sample"], d["temp"], d["top_k"],
                      d["top_p"]]
-        (tile, d["toks"], d["lens"], d["alive"], d["rem"],
-         self.pool.k, self.pool.v) = fn(*args)
-        key = "decode" if variant == "greedy" else "decode.sampled"
+        out = fn(*args)
+        if spec:
+            (tile, counts, d["toks"], d["lens"], d["alive"], d["rem"],
+             d["hist"]) = out[:7]
+            rest = out[7:]
+            tile = (tile, counts)
+        else:
+            tile, d["toks"], d["lens"], d["alive"], d["rem"] = out[:5]
+            rest = out[5:]
+        if quant:
+            (self.pool.k, self.pool.v,
+             self.pool.k_scale, self.pool.v_scale) = rest
+        else:
+            self.pool.k, self.pool.v = rest
+        key = ("decode" + (".sampled" if sampled else "")
+               + (".spec" if spec else ""))
         if self.compile_reports.get(key) is None:
             self.compile_reports[key] = getattr(fn, "report", None)
         return tile
@@ -817,7 +960,10 @@ class ContinuousBatchingEngine:
         try:
             fault_point("serve.hostsync_read")
             t0 = time.perf_counter()
-            arr = np.asarray(infl.tile)
+            if infl.spec:
+                arr = (np.asarray(infl.tile[0]), np.asarray(infl.tile[1]))
+            else:
+                arr = np.asarray(infl.tile)
         except MemoryError:
             self._inflight.popleft()
             self._shed(self._decode_active())
@@ -844,13 +990,18 @@ class ContinuousBatchingEngine:
                 if r is not None and not r.done:
                     ex = r.trace_id
                     break
-        self._m_tpot.observe((t1 - infl.t_dispatch) / infl.k, exemplar=ex)
+        if not infl.spec:
+            self._m_tpot.observe((t1 - infl.t_dispatch) / infl.k,
+                                 exemplar=ex)
         if self._rec.enabled:
             self._rec.record("readback", tile=infl.tile_id,
                              wait_ms=round((t1 - t0) * 1e3, 3))
         if self._tracer.enabled:
             self._trace_tile(infl, t1)
-        self._process_tile(arr, infl)
+        if infl.spec:
+            self._process_tile_spec(arr[0], arr[1], infl, t1, ex)
+        else:
+            self._process_tile(arr, infl)
         return True
 
     def _trace_tile(self, infl, t1):
@@ -881,6 +1032,7 @@ class ContinuousBatchingEngine:
         """Credit a [B, K] token tile: walk each lane's K tokens with the
         SAME eos/length rules the device applied, so host mirrors and
         device carry stay in lockstep without reading lens/alive back."""
+        credited = 0
         for lane in range(self.max_batch):
             req = infl.reqs[lane]
             if (req is None or req.done
@@ -891,9 +1043,66 @@ class ContinuousBatchingEngine:
                 self.lane_len[lane] += 1
                 tok = int(tile[lane, k])
                 self.lane_tok[lane] = tok
+                credited += 1
                 self._emit(lane, tok)
                 if req.done or self.lanes[lane] is not req:
                     break
+        self._m_tok_disp.set(credited)
+
+    def _process_tile_spec(self, tile, counts, infl, t1, ex):
+        """Credit a speculative tile: tokens [B, K, D+1] + counts [B, K].
+        Row k of a lane commits its first counts[lane, k] tokens (the
+        accepted draft run plus one correction token); counts drops to 0
+        the step after the lane died on device. The host walk applies
+        the same eos/length rules as the device, and the draft/accept
+        accounting plus the acceptance-rate exemplar (worst-accepting
+        request in the tile) are credited here, once per drained tile."""
+        D = tile.shape[2] - 1
+        credited = 0
+        lanes_credited = 0
+        drafted = accepted = 0
+        worst = None
+        for lane in range(self.max_batch):
+            req = infl.reqs[lane]
+            if (req is None or req.done
+                    or self.lanes[lane] is not req
+                    or self._lane_epoch[lane] != infl.epochs[lane]):
+                continue            # occupancy changed while in flight
+            lanes_credited += 1
+            lane_drafted = lane_accepted = 0
+            for k in range(infl.k):
+                c = int(counts[lane, k])
+                if c <= 0:
+                    break
+                lane_drafted += D
+                lane_accepted += c - 1
+                for i in range(c):
+                    self.lane_len[lane] += 1
+                    tok = int(tile[lane, k, i])
+                    self.lane_tok[lane] = tok
+                    credited += 1
+                    self._emit(lane, tok)
+                    if req.done or self.lanes[lane] is not req:
+                        break
+                if req.done or self.lanes[lane] is not req:
+                    break
+            drafted += lane_drafted
+            accepted += lane_accepted
+            if lane_drafted:
+                rate = lane_accepted / lane_drafted
+                if worst is None or rate < worst[0]:
+                    worst = (rate, req.trace_id)
+        if drafted:
+            self._m_draft.inc(drafted)
+            self._m_accept.inc(accepted)
+            self._m_accept_rate.observe(
+                accepted / drafted, exemplar=worst[1] if worst else None)
+        self._m_tok_disp.set(credited)
+        # effective per-token latency: the dispatch->readback wall over
+        # the tokens one lane actually committed (> K with acceptance)
+        eff = credited / max(1, lanes_credited)
+        self._m_tpot.observe((t1 - infl.t_dispatch) / max(1.0, eff),
+                             exemplar=ex)
 
     # --- device-resident lane state ---------------------------------------
     def _upload_lane_state(self, active):
@@ -931,10 +1140,29 @@ class ContinuousBatchingEngine:
                 temp[i] = max(r.temperature, 1e-6)
                 top_k[i] = r.top_k
                 top_p[i] = r.top_p
-        dev = dict(variant="sampled" if sampled else "greedy",
+        variant = ("sampled" if sampled else "greedy") + \
+            (".spec" if self.spec else "")
+        dev = dict(variant=variant,
                    toks=jnp.asarray(toks), lens=jnp.asarray(lens),
                    alive=jnp.asarray(alive), rem=jnp.asarray(rem),
                    eos=jnp.asarray(eos), tables=jnp.asarray(tables))
+        if self.spec:
+            # device-resident token history per lane (prompt + committed
+            # tokens up to the cached length) — the drafter's lookup
+            # corpus; extended ON DEVICE inside the scan, so like the
+            # rest of the lane state it is only rebuilt here on
+            # membership change
+            hmax = self.max_blocks_per_seq * self.pool.block_size
+            hist = np.zeros((B, hmax), np.int32)
+            for i in active:
+                r = self.lanes[i]
+                seq = (np.concatenate([r.prompt,
+                                       np.asarray(r.generated[:-1],
+                                                  np.int32)])
+                       if r.generated else r.prompt)
+                n = min(seq.size, hmax)
+                hist[i, :n] = seq[:n]
+            dev["hist"] = jnp.asarray(hist)
         if sampled:
             dev.update(seeds=jnp.asarray(seeds), do_sample=jnp.asarray(do_s),
                        temp=jnp.asarray(temp), top_k=jnp.asarray(top_k),
@@ -949,23 +1177,35 @@ class ContinuousBatchingEngine:
     # --- compiled programs ------------------------------------------------
     def _make_prefill_chunk(self):
         cfg = self.cfg
+        fmt = self.pool.fmt
+        quant = fmt.quantized
 
-        def run(stacked, embed_w, norm_w, head_w, kpool, vpool, ids,
-                start, last_idx, table_row):
+        def run(stacked, embed_w, norm_w, head_w, kpool, vpool, *rest):
+            if quant:
+                kspool, vspool, ids, start, last_idx, table_row = rest
+            else:
+                ids, start, last_idx, table_row = rest
             h = jnp.take(embed_w, ids, axis=0)       # (1, C, H)
 
             def layer(hh, xs):
-                lp, kc, vc = xs
-                hh, (kc, vc) = _llama_layer_prefill_chunk(
-                    lp, hh, kc, vc, table_row, start, cfg)
-                return hh, (kc, vc)
+                if quant:
+                    lp, kc, vc, ks, vs = xs
+                    hh, pools = _llama_layer_prefill_chunk(
+                        lp, hh, kc, vc, table_row, start, cfg,
+                        fmt=fmt, kc_scale=ks, vc_scale=vs)
+                else:
+                    lp, kc, vc = xs
+                    hh, pools = _llama_layer_prefill_chunk(
+                        lp, hh, kc, vc, table_row, start, cfg)
+                return hh, pools
 
-            h, (kpool, vpool) = jax.lax.scan(layer, h,
-                                             (stacked, kpool, vpool))
+            xs = ((stacked, kpool, vpool, kspool, vspool) if quant
+                  else (stacked, kpool, vpool))
+            h, pools = jax.lax.scan(layer, h, xs)
             h_last = h[0, last_idx]     # dynamic index: traced position
             logits = (_rms(h_last, norm_w, cfg["eps"]) @ head_w).astype(
                 jnp.float32)
-            return logits, kpool, vpool
+            return (logits,) + tuple(pools)
 
         return run
 
@@ -973,9 +1213,17 @@ class ContinuousBatchingEngine:
         cfg = self.cfg
         K = self.decode_steps
         scratch = self.pool.scratch_block
+        fmt = self.pool.fmt
+        quant = fmt.quantized
 
-        def run(stacked, embed_w, norm_w, head_w, kpool, vpool, toks,
-                lens, alive, rem, eos_ids, tables, *sample_state):
+        def run(stacked, embed_w, norm_w, head_w, kpool, vpool, *rest):
+            if quant:
+                (kspool, vspool, toks, lens, alive, rem, eos_ids, tables,
+                 *sample_state) = rest
+            else:
+                toks, lens, alive, rem, eos_ids, tables, *sample_state = \
+                    rest
+                kspool = vspool = None
             eps, theta = cfg["eps"], cfg["theta"]
             nh, nkv, hd = cfg["heads"], cfg["kv_heads"], cfg["head_dim"]
             B = toks.shape[0]
@@ -983,12 +1231,21 @@ class ContinuousBatchingEngine:
                 seeds, do_sample, temp, top_k, top_p = sample_state
 
             def step(carry, _):
-                toks, lens, alive, rem, kpool, vpool = carry
+                if quant:
+                    (toks, lens, alive, rem, kpool, vpool,
+                     kspool, vspool) = carry
+                else:
+                    toks, lens, alive, rem, kpool, vpool = carry
+                    kspool = vspool = None
                 h = jnp.take(embed_w, toks[:, None], axis=0)  # (B, 1, H)
                 pos = lens[:, None]                            # write pos
 
                 def layer(hh, xs):
-                    lp, kc, vc = xs
+                    if quant:
+                        lp, kc, vc, ks, vs = xs
+                    else:
+                        lp, kc, vc = xs
+                        ks = vs = None
                     x = _rms(hh, lp["input_layernorm.weight"], eps)
                     q = (x @ lp["self_attn.q_proj.weight"]
                          ).reshape(B, 1, nh, hd)
@@ -999,12 +1256,17 @@ class ContinuousBatchingEngine:
                     q = _rope(q, pos, theta)[:, 0]
                     k = _rope(k, pos, theta)[:, 0]
                     v = v[:, 0]
-                    kc, vc = write_to_cache(kc, vc, k, v, tables, lens,
-                                            active=alive,
-                                            scratch_block=scratch)
+                    # passthrough formats route through write_to_cache
+                    # with the exact pre-round-11 ops (byte-identical
+                    # trace); quantized formats also update the scales
+                    kc, vc, ks, vs = kv_write_token(
+                        fmt if quant else None, kc, vc, ks, vs, k, v,
+                        tables, lens, active=alive, scratch_block=scratch)
                     attn = paged_attention_decode_inner(
                         q, kc, vc, tables, lens + 1,
-                        scale=1.0 / (hd ** 0.5))
+                        scale=1.0 / (hd ** 0.5),
+                        fmt=fmt if quant else None,
+                        k_scale_cache=ks, v_scale_cache=vs)
                     hh = hh + (attn.reshape(B, 1, nh * hd)
                                @ lp["self_attn.o_proj.weight"])
                     x = _rms(hh, lp["post_attention_layernorm.weight"],
@@ -1013,10 +1275,15 @@ class ContinuousBatchingEngine:
                     up = x @ lp["mlp.up_proj.weight"]
                     hh = hh + ((jax.nn.silu(gate) * up)
                                @ lp["mlp.down_proj.weight"])
-                    return hh, (kc, vc)
+                    return hh, ((kc, vc, ks, vs) if quant else (kc, vc))
 
-                h, (kpool, vpool) = jax.lax.scan(layer, h,
-                                                 (stacked, kpool, vpool))
+                xs = ((stacked, kpool, vpool, kspool, vspool) if quant
+                      else (stacked, kpool, vpool))
+                h, pools = jax.lax.scan(layer, h, xs)
+                if quant:
+                    kpool, vpool, kspool, vspool = pools
+                else:
+                    kpool, vpool = pools
                 logits = (_rms(h[:, 0], norm_w, eps) @ head_w).astype(
                     jnp.float32)
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -1030,15 +1297,212 @@ class ContinuousBatchingEngine:
                 rem = rem - alive.astype(rem.dtype)
                 alive_next = alive & (nxt != eos_ids) & (rem > 0)
                 lens = lens + alive.astype(lens.dtype)
-                return (nxt, lens, alive_next, rem, kpool, vpool), nxt
+                out = (nxt, lens, alive_next, rem, kpool, vpool)
+                if quant:
+                    out = out + (kspool, vspool)
+                return out, nxt
 
-            (toks, lens, alive, rem, kpool, vpool), tile = jax.lax.scan(
-                step, (toks, lens, alive, rem, kpool, vpool), None,
-                length=K)
-            return (jnp.moveaxis(tile, 0, 1), toks, lens, alive, rem,
-                    kpool, vpool)
+            carry0 = (toks, lens, alive, rem, kpool, vpool)
+            if quant:
+                carry0 = carry0 + (kspool, vspool)
+            carry, tile = jax.lax.scan(step, carry0, None, length=K)
+            toks, lens, alive, rem = carry[:4]
+            return (jnp.moveaxis(tile, 0, 1), toks, lens, alive, rem
+                    ) + tuple(carry[4:])
 
         return run
+
+    def _make_decode_spec(self, sampled: bool):
+        """The speculative fused decode program: each of the K scan steps
+        proposes draft_depth tokens from the drafter, verifies the step
+        token + drafts in ONE batched forward (C = draft_depth+1 queries
+        per lane against the paged pool), accepts the leading run of
+        drafts that match what the sequential policy would emit, rolls
+        back the rejected slots' cache writes, and commits the accepted
+        run plus one correction token — up to K*(draft_depth+1) tokens
+        per dispatch, with the committed stream exactly equal to the
+        non-speculative path (greedy by argmax equality; sampled lanes
+        by the position-keyed PRNG, which makes the sequential sample at
+        every position a pure function of (seed, position))."""
+        cfg = self.cfg
+        K = self.decode_steps
+        D = self.draft_depth
+        C = D + 1
+        scratch = self.pool.scratch_block
+        fmt = self.pool.fmt
+        quant = fmt.quantized
+        hmax = self.max_blocks_per_seq * self.pool.block_size
+        drafter = self._drafter
+        ngram = self.draft_ngram
+
+        def run(stacked, embed_w, norm_w, head_w, kpool, vpool, *rest):
+            if quant:
+                (kspool, vspool, toks, lens, alive, rem, eos_ids, tables,
+                 hist, *sample_state) = rest
+            else:
+                (toks, lens, alive, rem, eos_ids, tables, hist,
+                 *sample_state) = rest
+                kspool = vspool = None
+            eps, theta = cfg["eps"], cfg["theta"]
+            nh, nkv, hd = cfg["heads"], cfg["kv_heads"], cfg["head_dim"]
+            B = toks.shape[0]
+            rows = jnp.arange(B)
+            if sampled:
+                seeds, do_sample, temp, top_k, top_p = sample_state
+
+            def step(carry, _):
+                if quant:
+                    (toks, lens, alive, rem, hist, kpool, vpool,
+                     kspool, vspool) = carry
+                else:
+                    toks, lens, alive, rem, hist, kpool, vpool = carry
+                    kspool = vspool = None
+                # record the step token into the running history (dead
+                # lanes scatter out of bounds, which JAX drops)
+                hidx = jnp.where(alive, lens, hmax)
+                hist = hist.at[rows, hidx].set(toks)
+                if drafter is not None:
+                    drafts = drafter(hist, lens, toks, D).astype(jnp.int32)
+                else:
+                    drafts = _ngram_draft(hist, lens, toks, D, ngram)
+                u = jnp.concatenate([toks[:, None], drafts], axis=1)
+                didx = jnp.where(alive[:, None],
+                                 lens[:, None] + 1 + jnp.arange(D)[None, :],
+                                 hmax)
+                hist = hist.at[rows[:, None], didx].set(drafts)
+                h = jnp.take(embed_w, u, axis=0)               # (B, C, H)
+                pos = lens[:, None] + jnp.arange(C)[None, :]   # (B, C)
+
+                def layer(hh, xs):
+                    if quant:
+                        lp, kc, vc, ks, vs = xs
+                    else:
+                        lp, kc, vc = xs
+                        ks = vs = None
+                    x = _rms(hh, lp["input_layernorm.weight"], eps)
+                    q = (x @ lp["self_attn.q_proj.weight"]
+                         ).reshape(B, C, nh, hd)
+                    k = (x @ lp["self_attn.k_proj.weight"]
+                         ).reshape(B, C, nkv, hd)
+                    v = (x @ lp["self_attn.v_proj.weight"]
+                         ).reshape(B, C, nkv, hd)
+                    q = _rope(q, pos, theta)
+                    k = _rope(k, pos, theta)
+                    kc, vc, ks, vs, saved = kv_write_tokens(
+                        fmt if quant else None, kc, vc, ks, vs, k, v,
+                        tables, lens, active=alive, scratch_block=scratch)
+                    attn = paged_attention_verify(
+                        q, kc, vc, tables, lens, scale=1.0 / (hd ** 0.5),
+                        fmt=fmt if quant else None,
+                        k_scale_cache=ks, v_scale_cache=vs)
+                    hh = hh + (attn.reshape(B, C, nh * hd)
+                               @ lp["self_attn.o_proj.weight"])
+                    x = _rms(hh, lp["post_attention_layernorm.weight"],
+                             eps)
+                    gate = x @ lp["mlp.gate_proj.weight"]
+                    up = x @ lp["mlp.up_proj.weight"]
+                    hh = hh + ((jax.nn.silu(gate) * up)
+                               @ lp["mlp.down_proj.weight"])
+                    out = (kc, vc, ks, vs) if quant else (kc, vc)
+                    return hh, (out, saved)
+
+                xs = ((stacked, kpool, vpool, kspool, vspool) if quant
+                      else (stacked, kpool, vpool))
+                h, (pools, saved) = jax.lax.scan(layer, h, xs)
+                logits = (_rms(h, norm_w, eps) @ head_w).astype(
+                    jnp.float32)                               # (B, C, V)
+                # g[:, i] is the token the sequential policy emits at
+                # position lens+i+1 GIVEN the drafts up to i were right —
+                # so the committed tokens are exactly a prefix of g
+                g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                if sampled:
+                    samp = jnp.stack(
+                        [_device_sample(logits[:, i], seeds, lens + i,
+                                        temp, top_k, top_p)
+                         for i in range(C)], axis=1)
+                    g = jnp.where(do_sample[:, None], samp, g)
+                # leading-run acceptance; +1 = the correction token
+                matches = (drafts == g[:, :D]).astype(jnp.int32)
+                n_acc = jnp.cumprod(matches, axis=1).sum(axis=1)
+                commits = jnp.minimum(n_acc + 1, rem)
+                iseos = g == eos_ids[:, None]
+                eos_clip = jnp.where(iseos.any(axis=1),
+                                     jnp.argmax(iseos, axis=1) + 1, C)
+                commits = jnp.minimum(commits, eos_clip)
+                commits = jnp.where(alive, commits, 0)
+                # roll back the rejected slots' writes layer by layer
+                # (kept and dead-lane restores are routed to scratch)
+                keep = ((jnp.arange(C)[None, :] < commits[:, None])
+                        & alive[:, None])
+
+                def restore(_, xs):
+                    if quant:
+                        (kc, vc, ks, vs), sv = xs
+                    else:
+                        (kc, vc), sv = xs
+                        ks = vs = None
+                    kc, vc, ks, vs = kv_rollback_tokens(
+                        fmt if quant else None, kc, vc, ks, vs, sv,
+                        tables, lens, keep, active=alive,
+                        scratch_block=scratch)
+                    return None, ((kc, vc, ks, vs) if quant
+                                  else (kc, vc))
+
+                _, pools = jax.lax.scan(restore, None, (pools, saved))
+                if quant:
+                    kpool, vpool, kspool, vspool = pools
+                else:
+                    kpool, vpool = pools
+                last = jnp.clip(commits - 1, 0, C - 1)
+                g_last = g[rows, last]
+                toks_next = jnp.where(alive, g_last, toks)
+                ended_eos = alive & (commits > 0) & (g_last == eos_ids)
+                rem = rem - commits
+                alive_next = alive & ~ended_eos & (rem > 0)
+                lens = lens + commits
+                out = (toks_next, lens, alive_next, rem, hist,
+                       kpool, vpool)
+                if quant:
+                    out = out + (kspool, vspool)
+                return out, (g, commits.astype(jnp.int32))
+
+            carry0 = (toks, lens, alive, rem, hist, kpool, vpool)
+            if quant:
+                carry0 = carry0 + (kspool, vspool)
+            carry, (tile, counts) = jax.lax.scan(step, carry0, None,
+                                                 length=K)
+            toks, lens, alive, rem, hist = carry[:5]
+            return (jnp.moveaxis(tile, 0, 1), jnp.moveaxis(counts, 0, 1),
+                    toks, lens, alive, rem, hist) + tuple(carry[5:])
+
+        return run
+
+
+def _ngram_draft(hist, lens, toks, depth, ngram):
+    """Default self-drafter: prompt-lookup decoding. For each lane, find
+    the most recent earlier occurrence of the trailing `ngram`-token
+    suffix of (history + step token) and propose the `depth` tokens that
+    followed it; lanes with no match propose `depth` copies of the step
+    token (a valid — if rarely accepted — draft). Pure jnp over the
+    device-resident history buffer, so it traces into the fused scan."""
+    hmax = hist.shape[1]
+    cand = jnp.arange(hmax)
+
+    def one(h, n, t):
+        # h[n] is the step token (scattered by the caller); compare the
+        # ngram ending at each candidate position against the one at n.
+        # Candidates must leave the whole continuation in the PAST
+        # (cand + depth < n): a more recent match would read positions
+        # >= n, which hold the previous step's rejected-draft leftovers
+        ok = (cand >= ngram - 1) & (cand + depth < n)
+        for gback in range(ngram):
+            ok &= (h[jnp.clip(cand - gback, 0, hmax - 1)]
+                   == h[jnp.clip(n - gback, 0, hmax - 1)])
+        j = jnp.max(jnp.where(ok, cand, -1))
+        cont = h[jnp.clip(j + 1 + jnp.arange(depth), 0, hmax - 1)]
+        return jnp.where(j >= 0, cont, jnp.full((depth,), t))
+
+    return jax.vmap(one)(hist, lens, toks).astype(jnp.int32)
 
 
 def _device_sample(logits, seeds, lens, temperature, top_k, top_p):

@@ -46,12 +46,23 @@ from ...framework.core import Tensor, execute
 from ..layer.layers import Layer
 
 __all__ = ["LinearQuanter", "LinearDequanter", "LinearQuanterDequanter",
-           "fake_fp8_quant", "fake_fp8_dequant"]
+           "fake_fp8_quant", "fake_fp8_dequant", "fp8_limits"]
 
 _FP8 = {
     "e4m3": (448.0, "float8_e4m3fn"),
     "e5m2": (57344.0, "float8_e5m2"),
 }
+
+
+def fp8_limits(type="e4m3"):
+    """(finite_max, storage dtype name) of an fp8 format — THE grid
+    constants every fp8 consumer in the framework scales against (the
+    fake-quant layers here and the quantized paged-KV block format in
+    ops/paged_attention share them, so serialized fp8 tensors and
+    KV blocks reproduce the same values)."""
+    if type not in _FP8:
+        raise NotImplementedError("only e4m3 / e5m2 fp8 formats exist")
+    return _FP8[type]
 
 
 def _axis_shape(scale, ndim, axis):

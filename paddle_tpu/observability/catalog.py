@@ -22,6 +22,8 @@ _TPOT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                  0.025, 0.05, 0.1, 0.25, 1.0)
 _STEP_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                  1.0, 2.5, 5.0, 15.0, 60.0)
+# ratio buckets (0..1) — acceptance rates and other fractions
+_RATE_BUCKETS = (0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0)
 
 CATALOG = {
     # -- serving (inference/serving.py ContinuousBatchingEngine) ------------
@@ -98,6 +100,31 @@ CATALOG = {
     "serving_prefill_chunks_total": (
         "counter", "prefill chunk program calls (long prompts interleave "
         "with decode instead of head-of-line blocking)", (), None),
+    "serving_draft_tokens_total": (
+        "counter", "draft tokens proposed by the speculative decoder "
+        "(draft_depth per lane per scan step)", (), None),
+    "serving_accepted_tokens_total": (
+        "counter", "draft tokens accepted by the batched verify forward "
+        "(accepted/drafted is the acceptance rate; the committed stream "
+        "also gets one correction token per step on top)", (), None),
+    "serving_spec_acceptance_rate": (
+        "histogram", "per-drained-tile draft acceptance rate (0..1); the "
+        "exemplar carries the trace id of the WORST-accepting request in "
+        "the tile, so a low bucket links to the request to turn "
+        "speculation off for", (), _RATE_BUCKETS),
+    "serving_kv_dequant_seconds": (
+        "histogram", "wall time of a whole-pool KV dequantization (the "
+        "serve.kv_dequant drop-to-bf16 degradation path)", (),
+        _STEP_BUCKETS),
+    "serving_tokens_per_dispatch": (
+        "gauge", "tokens credited from the last drained decode tile (one "
+        "dispatch): K per lane without speculation, up to K*(draft_depth"
+        "+1) per lane with it", (), None),
+    "serving_runtime_degradations_total": (
+        "counter", "permanent runtime degradations taken by the engine "
+        "(speculation_off: draft/verify fault -> non-speculative decode; "
+        "kv_bf16: dequant fault -> pool dequantized to the native dtype)",
+        ("what",), None),
 
     # -- generation (generation.py) -----------------------------------------
     "generation_requests_total": (

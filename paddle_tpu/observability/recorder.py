@@ -54,6 +54,8 @@ EVENT_KINDS = {
     "compile_cache": "PIR compile-cache probe (hit|miss|corrupt|store)",
     "pir_pipeline": "PIR pass pipeline ran (pass count, cache status)",
     "retry": "resilient retry of a transient failure",
+    "degrade": "serving runtime permanently dropped a feature "
+               "(speculation_off | kv_bf16) after a fault",
     "error": "unhandled error captured by a crash handler",
     "note": "free-form marker (drills, tests)",
 }

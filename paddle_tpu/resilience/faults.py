@@ -62,6 +62,15 @@ FAULT_SITES = {
     "serve.hostsync_read": "serving decode: token-tile device->host "
                            "readback (transient failure keeps the tile "
                            "in flight and retries next step)",
+    "serve.draft_verify": "serving speculative decode: draft/verify "
+                          "dispatch (failure permanently degrades the "
+                          "engine to non-speculative decode; streams "
+                          "continue byte-identically)",
+    "serve.kv_dequant": "serving quantized KV pool: dequant-fused "
+                        "attention read (failure dequantizes the whole "
+                        "pool to the native dtype once and drops the "
+                        "quantized block format for the engine's "
+                        "lifetime)",
     "train.step_nonfinite": "train supervisor: force a non-finite loss "
                             "for this step (consulted via check())",
     "compile.cache_read": "PIR compile cache: artifact read (verified "

@@ -27,3 +27,31 @@ def pytest_configure(config):
         "Deterministic and fast, so they ride tier-1; select just them "
         "with -m chaos, or exclude with -m 'not chaos' if a platform's "
         "signal/timing semantics misbehave")
+
+
+def measured_leaks(body, module_file, attempts=3):
+    """tracemalloc disabled-noop guard, flake-hardened for in-suite runs.
+
+    In a warm many-hundred-test process, GC cycles and leftover daemon
+    threads can allocate inside the watched module during the trace
+    window, so a single measurement can report a phantom leak. Only a
+    leak that reproduces on every attempt is the fast path actually
+    allocating. `body` is the hot loop; `module_file` the filename
+    fragment allocations are attributed to (e.g. "metrics.py").
+    """
+    import gc
+    import tracemalloc
+    last = None
+    for _ in range(attempts):
+        gc.collect()
+        tracemalloc.start()
+        snap1 = tracemalloc.take_snapshot()
+        body()
+        snap2 = tracemalloc.take_snapshot()
+        tracemalloc.stop()
+        last = [s for s in snap2.compare_to(snap1, "filename")
+                if module_file in (s.traceback[0].filename or "")
+                and s.size_diff > 0]
+        if not last:
+            return []
+    return last

@@ -51,6 +51,12 @@ class TestElasticRecovery:
             lp = tmp / f"worker.{r}.log"
             if lp.exists():
                 logs += f"\n--- worker {r} ---\n" + lp.read_text()[-3000:]
+        if p.returncode != 0 and (
+                "Multiprocess computations aren't implemented"
+                in p.stderr + logs):
+            pytest.skip("jaxlib CPU backend on this host lacks "
+                        "multiprocess collectives; the elastic drill "
+                        "needs a runtime with cross-process all-reduce")
         assert p.returncode == 0, (
             f"drill failed rc={p.returncode}: {p.stderr[-1000:]}{logs}")
         return {"dir": str(tmp), "stderr": p.stderr,

@@ -201,19 +201,29 @@ MODEL_THUNKS = [
     ("resnet34", lambda M: M.resnet34(num_classes=4)),
     ("resnet50", lambda M: M.resnet50(num_classes=4)),
     ("resnext50", lambda M: M.resnext50_32x4d(num_classes=4)),
-    ("DenseNet121", lambda M: M.DenseNet(layers=121, num_classes=4)),
-    ("GoogLeNet", lambda M: M.GoogLeNet(num_classes=4)),
-    ("InceptionV3", lambda M: M.InceptionV3(num_classes=4)),
+    # the deep/branchy archs cost 25-60s of XLA compile each on one CPU;
+    # they stay in the full tier but out of tier-1's wall-clock budget
+    pytest.param("DenseNet121",
+                 lambda M: M.DenseNet(layers=121, num_classes=4),
+                 marks=pytest.mark.slow),
+    pytest.param("GoogLeNet", lambda M: M.GoogLeNet(num_classes=4),
+                 marks=pytest.mark.slow),
+    pytest.param("InceptionV3", lambda M: M.InceptionV3(num_classes=4),
+                 marks=pytest.mark.slow),
     ("MobileNetV1", lambda M: M.MobileNetV1(num_classes=4)),
     ("MobileNetV2", lambda M: M.MobileNetV2(num_classes=4)),
-    ("MobileNetV3Small", lambda M: M.MobileNetV3Small(num_classes=4)),
+    pytest.param("MobileNetV3Small",
+                 lambda M: M.MobileNetV3Small(num_classes=4),
+                 marks=pytest.mark.slow),
     ("ShuffleNetV2", lambda M: M.shufflenet_v2_x0_5(num_classes=4)),
     ("SqueezeNet", lambda M: M.squeezenet1_0(num_classes=4)),
 ]
 
 
-@pytest.mark.parametrize("name,thunk", MODEL_THUNKS,
-                         ids=[m[0] for m in MODEL_THUNKS])
+@pytest.mark.parametrize(
+    "name,thunk", MODEL_THUNKS,
+    ids=[m.values[0] if hasattr(m, "values") else m[0]
+         for m in MODEL_THUNKS])
 def test_vision_model_forward_and_grad(name, thunk):
     from paddle_tpu.vision import models as M
     paddle.seed(0)
@@ -229,7 +239,9 @@ def test_vision_model_forward_and_grad(name, thunk):
     assert params and any(p.grad is not None for p in params)
 
 
+@pytest.mark.slow
 def test_model_zoo_aliases_exist_and_build():
+    # ~55s of parameter-init work building 20 zoo archs: full tier only
     from paddle_tpu.vision import models as M
     # constructor aliases resolve and build (no forward: keep it fast)
     for name in ["resnet101", "resnet152", "densenet169", "densenet201",

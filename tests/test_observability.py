@@ -132,15 +132,13 @@ class TestRegistry:
         h = dreg.histogram("h")
         for _ in range(10):     # warm up method caches outside the trace
             c.inc(); g.set(1.0); h.observe(0.5)   # noqa: E702
-        tracemalloc.start()
-        snap1 = tracemalloc.take_snapshot()
-        for _ in range(1000):
-            c.inc(); g.set(1.0); h.observe(0.5)   # noqa: E702
-        snap2 = tracemalloc.take_snapshot()
-        tracemalloc.stop()
-        leaked = [s for s in snap2.compare_to(snap1, "filename")
-                  if "metrics.py" in (s.traceback[0].filename or "")
-                  and s.size_diff > 0]
+
+        def body():
+            for _ in range(1000):
+                c.inc(); g.set(1.0); h.observe(0.5)   # noqa: E702
+
+        from conftest import measured_leaks
+        leaked = measured_leaks(body, "metrics.py")
         assert not leaked, leaked
         assert c.value == 0 and h.count == 0    # and nothing was recorded
 

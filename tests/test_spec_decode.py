@@ -1,0 +1,250 @@
+"""Speculative fused decode + quantized paged-KV blocks (round 11).
+
+Contracts:
+  * speculation is invisible: greedy streams with speculative_decode=True
+    are byte-identical to the non-speculative engine across decode_steps
+    x draft_depth tilings (and match the dense reference);
+  * seeded sampled lanes reproduce the same stream no matter the tiling —
+    the position-keyed PRNG makes every sample a function of
+    (seed, position), so verify-accepted samples ARE the sequential ones;
+  * kv_rollback_tokens restores rejected draft writes byte-exactly (the
+    cache after write+rollback equals sequential writes of the kept
+    prefix alone), for passthrough and quantized formats;
+  * int8/fp8 KV blocks stay numerically close to the bf16 path through
+    the GQA paged-attention read, and int8 fits >=1.9x the lanes of bf16
+    in the same pool bytes before KVPoolExhaustedError.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.generation import generate
+from paddle_tpu.inference import (ContinuousBatchingEngine,
+                                  KVPoolExhaustedError)
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+def _model(kv_heads=None, hidden=64):
+    cfg = LlamaConfig(vocab_size=128, hidden_size=hidden,
+                      intermediate_size=2 * hidden,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=kv_heads or 4,
+                      max_position_embeddings=256)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+def _dense_reference(model, prompt, n):
+    ids = paddle.to_tensor(np.asarray(prompt, np.int32)[None])
+    out = generate(model, ids, max_new_tokens=n, do_sample=False)
+    return np.asarray(out._data)[0, len(prompt):].tolist()
+
+
+def _engine(model, **kw):
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("prefill_buckets", (16,))
+    return ContinuousBatchingEngine(model, **kw)
+
+
+def _run(model, prompts, n, sample=False, **kw):
+    eng = _engine(model, **kw)
+    skw = (dict(do_sample=True, temperature=0.8, top_k=20, seed=11)
+           if sample else {})
+    rids = [eng.add_request(p, max_new_tokens=n, **skw) for p in prompts]
+    out = eng.run()
+    return [out[r] for r in rids]
+
+
+@pytest.fixture
+def enabled_obs():
+    from paddle_tpu import observability as obs
+    obs.get_registry().reset()
+    obs.enable()
+    yield obs
+    obs.disable()
+    obs.get_registry().reset()
+
+
+class TestSpecGreedyIdentity:
+    def test_byte_identical_across_steps_and_depths(self):
+        """ON vs OFF across decode_steps x draft_depth: committed greedy
+        streams never change — speculation only changes how many forward
+        positions one dispatch verifies."""
+        model = _model()
+        rs = np.random.RandomState(0)
+        prompts = [rs.randint(0, 128, (7,)), rs.randint(0, 128, (13,))]
+        ref = [_dense_reference(model, p, 18) for p in prompts]
+        for k in (1, 3, 8):
+            base = _run(model, prompts, 18, decode_steps=k)
+            assert base == ref, f"non-spec K={k} diverged from dense"
+            for d in (1, 2, 4):
+                spec = _run(model, prompts, 18, decode_steps=k,
+                            speculative_decode=True, draft_depth=d)
+                assert spec == base, f"spec K={k} D={d} changed the stream"
+
+    def test_spec_metrics_move(self, enabled_obs):
+        """A speculative run counts drafts/accepts and lands an
+        acceptance-rate observation with a trace-id exemplar."""
+        model = _model()
+        _run(model, [np.arange(9) % 128], 16, decode_steps=4,
+             speculative_decode=True, draft_depth=2)
+        drafted = enabled_obs.metric("serving_draft_tokens_total").value
+        accepted = enabled_obs.metric("serving_accepted_tokens_total").value
+        assert drafted > 0 and 0 <= accepted <= drafted
+        hist = enabled_obs.get_registry().get("serving_spec_acceptance_rate")
+        assert hist.count >= 1
+        assert any(tid for _, tid, _ in hist.exemplars())
+
+
+class TestSpecSampled:
+    def test_sampled_reproducible_across_tilings(self):
+        """Seeded sampled lanes: spec at any tiling == non-spec at any
+        tiling (every accepted draft equals the position-keyed sample the
+        sequential path would have drawn)."""
+        model = _model(kv_heads=2)
+        rs = np.random.RandomState(3)
+        prompts = [rs.randint(0, 128, (7,)), rs.randint(0, 128, (11,))]
+        base = _run(model, prompts, 14, sample=True, decode_steps=3)
+        for k, d in ((1, 2), (8, 2), (4, 4)):
+            spec = _run(model, prompts, 14, sample=True, decode_steps=k,
+                        speculative_decode=True, draft_depth=d)
+            assert spec == base, f"sampled spec K={k} D={d} diverged"
+
+
+class TestRollbackExactness:
+    @pytest.mark.parametrize("fmt_name", ["native", "int8"])
+    def test_write_plus_rollback_equals_sequential(self, fmt_name):
+        """Cache bytes after a C-token speculative write + rollback of
+        the rejected tail equal sequential single-token writes of the
+        kept prefix alone — for every kept-prefix length."""
+        import jax.numpy as jnp
+        from paddle_tpu.ops.paged_attention import (
+            KVBlockFormat, kv_rollback_tokens, kv_write_token,
+            kv_write_tokens)
+        fmt = KVBlockFormat(fmt_name, native_dtype=jnp.float32)
+        rs = np.random.RandomState(5)
+        NB, BS, KVH, D, B, C = 6, 4, 2, 8, 2, 3
+        scratch = NB - 1
+        tables = jnp.asarray([[0, 1, scratch], [2, 3, scratch]], jnp.int32)
+        start = jnp.asarray([3, 5], jnp.int32)     # crosses block edges
+        active = jnp.asarray([True, True])
+        store = fmt.store_dtype
+
+        k0 = rs.randint(-3, 4, (NB, BS, KVH, D)).astype(np.float32)
+        s0 = rs.rand(NB, BS, KVH).astype(np.float32)
+
+        def pools():
+            kc = jnp.asarray(k0).astype(store)
+            vc = jnp.asarray(k0[::-1].copy()).astype(store)
+            if fmt.quantized:
+                ks = jnp.asarray(s0).astype(fmt.scale_dtype)
+                vs = ks + jnp.asarray(0.5, fmt.scale_dtype)
+            else:
+                ks = vs = None
+            return kc, vc, ks, vs
+
+        k_new = jnp.asarray(rs.randn(B, C, KVH, D).astype(np.float32))
+        v_new = jnp.asarray(rs.randn(B, C, KVH, D).astype(np.float32))
+        for m in range(C + 1):
+            keep = (jnp.arange(C)[None, :] < m) & active[:, None]
+            kc, vc, ks, vs = pools()
+            wk, wv, wks, wvs, saved = kv_write_tokens(
+                fmt, kc, vc, ks, vs, k_new, v_new, tables, start,
+                active=active, scratch_block=scratch)
+            rk, rv, rks, rvs = kv_rollback_tokens(
+                fmt, wk, wv, wks, wvs, saved, tables, start, keep,
+                active=active, scratch_block=scratch)
+            sk, sv, sks, svs = pools()
+            for i in range(m):
+                sk, sv, sks, svs = kv_write_token(
+                    fmt, sk, sv, sks, svs, k_new[:, i], v_new[:, i],
+                    tables, start + i, active=active, scratch_block=scratch)
+            live = np.arange(NB) != scratch    # scratch holds garbage
+            for a, b in ((rk, sk), (rv, sv)):
+                assert np.array_equal(np.asarray(a)[live],
+                                      np.asarray(b)[live]), f"m={m}"
+            if fmt.quantized:
+                for a, b in ((rks, sks), (rvs, svs)):
+                    assert np.array_equal(
+                        np.asarray(a).astype(np.float32)[live],
+                        np.asarray(b).astype(np.float32)[live]), f"m={m}"
+
+
+class TestQuantizedKV:
+    @pytest.mark.parametrize("fmt_name,tol",
+                             [("int8", 0.03), ("fp8_e4m3", 0.06),
+                              ("fp8_e5m2", 0.12)])
+    def test_gqa_attention_read_close_to_native(self, fmt_name, tol):
+        """Dequant-fused paged decode attention on a GQA block layout
+        stays within quantization tolerance of the bf16-native read."""
+        import jax.numpy as jnp
+        from paddle_tpu.ops.paged_attention import (
+            KVBlockFormat, kv_write_chunk, paged_attention_decode_inner)
+        rs = np.random.RandomState(1)
+        NB, BS, NH, KVH, D, L = 5, 4, 4, 2, 16, 10
+        fmt = KVBlockFormat(fmt_name, native_dtype=jnp.float32)
+        table = jnp.asarray([[0, 1, 2, 4]], jnp.int32)
+        k_seq = jnp.asarray(rs.randn(L, KVH, D).astype(np.float32))
+        v_seq = jnp.asarray(rs.randn(L, KVH, D).astype(np.float32))
+        q = jnp.asarray(rs.randn(1, NH, D).astype(np.float32))
+
+        kc = jnp.zeros((NB, BS, KVH, D), jnp.float32)
+        vc = jnp.zeros((NB, BS, KVH, D), jnp.float32)
+        kc, vc, _, _ = kv_write_chunk(None, kc, vc, None, None, k_seq,
+                                      v_seq, table[0], 0)
+        ref = paged_attention_decode_inner(
+            q, kc, vc, table, jnp.asarray([L]), scale=D ** -0.5)
+
+        qkc = jnp.zeros((NB, BS, KVH, D), fmt.store_dtype)
+        qvc = jnp.zeros((NB, BS, KVH, D), fmt.store_dtype)
+        ks = jnp.zeros((NB, BS, KVH), fmt.scale_dtype)
+        vs = jnp.zeros((NB, BS, KVH), fmt.scale_dtype)
+        qkc, qvc, ks, vs = kv_write_chunk(fmt, qkc, qvc, ks, vs, k_seq,
+                                          v_seq, table[0], 0)
+        got = paged_attention_decode_inner(
+            q, qkc, qvc, table, jnp.asarray([L]), scale=D ** -0.5,
+            fmt=fmt, k_scale_cache=ks, v_scale_cache=vs)
+        err = float(np.max(np.abs(np.asarray(got) - np.asarray(ref))))
+        assert err < tol, f"{fmt_name} attention error {err}"
+
+    def test_engine_quantized_gqa_streams(self):
+        """int8/fp8 engines on a GQA llama complete full streams, and
+        speculation stays invisible WITHIN a format (the acceptance rule
+        compares against the quantized-path logits, not bf16's)."""
+        model = _model(kv_heads=2)
+        rs = np.random.RandomState(9)
+        prompts = [rs.randint(0, 128, (7,)), rs.randint(0, 128, (10,))]
+        for fmt_name in ("int8", "fp8_e4m3"):
+            base = _run(model, prompts, 12, decode_steps=3,
+                        kv_cache_dtype=fmt_name)
+            assert [len(s) for s in base] == [12, 12]
+            spec = _run(model, prompts, 12, decode_steps=3,
+                        kv_cache_dtype=fmt_name,
+                        speculative_decode=True, draft_depth=2)
+            assert spec == base, f"spec changed the {fmt_name} stream"
+
+
+class TestCapacity:
+    def test_int8_fits_1p9x_lanes_in_same_bytes(self):
+        """Same kv_pool_bytes budget: the int8 pool admits >=1.9x the
+        concurrent sequences before KVPoolExhaustedError (head_dim 64:
+        128 payload + 4 scale bytes/token/array vs bf16's 256)."""
+        model = _model(kv_heads=2, hidden=256)   # head_dim 64
+        budget = 1 << 20
+
+        def lanes(fmt_name):
+            eng = _engine(model, kv_cache_dtype=fmt_name,
+                          kv_pool_bytes=budget, num_blocks=None)
+            n = 0
+            try:
+                while True:
+                    eng.pool.ensure(n, 64)       # one 64-token sequence
+                    n += 1
+            except KVPoolExhaustedError:
+                return n
+
+        bf16, int8 = lanes("bf16"), lanes("int8")
+        assert int8 >= 1.9 * bf16, f"int8={int8} bf16={bf16}"

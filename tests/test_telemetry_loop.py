@@ -179,15 +179,13 @@ class TestExemplars:
         h = dreg.histogram("h")
         for _ in range(10):                     # warm up outside the trace
             h.observe(0.5, exemplar="t-1")
-        tracemalloc.start()
-        snap1 = tracemalloc.take_snapshot()
-        for _ in range(1000):
-            h.observe(0.5, exemplar="t-1")
-        snap2 = tracemalloc.take_snapshot()
-        tracemalloc.stop()
-        leaked = [s for s in snap2.compare_to(snap1, "filename")
-                  if "metrics.py" in (s.traceback[0].filename or "")
-                  and s.size_diff > 0]
+
+        def body():
+            for _ in range(1000):
+                h.observe(0.5, exemplar="t-1")
+
+        from conftest import measured_leaks
+        leaked = measured_leaks(body, "metrics.py")
         assert not leaked, leaked
         assert h.count == 0 and h.exemplars() == []
 
@@ -234,16 +232,14 @@ class TestFlightRecorder:
         for _ in range(10):                     # warm up outside the trace
             if rec.enabled:
                 rec.record("note", i=1)
-        tracemalloc.start()
-        snap1 = tracemalloc.take_snapshot()
-        for _ in range(1000):
-            if rec.enabled:
-                rec.record("note", i=1)
-        snap2 = tracemalloc.take_snapshot()
-        tracemalloc.stop()
-        leaked = [s for s in snap2.compare_to(snap1, "filename")
-                  if "recorder.py" in (s.traceback[0].filename or "")
-                  and s.size_diff > 0]
+
+        def body():
+            for _ in range(1000):
+                if rec.enabled:
+                    rec.record("note", i=1)
+
+        from conftest import measured_leaks
+        leaked = measured_leaks(body, "recorder.py")
         assert not leaked, leaked
         assert rec.total_recorded == 0 and rec.events() == []
 
@@ -382,11 +378,14 @@ class TestFinishAgreement:
         eng.step()                              # r1 takes the only lane
         eng.queue[0].t_deadline = time.perf_counter() - 1.0
         eng.run()
+        # registry.reset() keeps zeroed label children from earlier tests
+        # in the process; agreement is about finishes that happened
         counter = {}
         for m in obs.get_registry().collect():
             if m.name == "serving_finished_total":
                 for key, c in m.children().items():
-                    counter[dict(key)["reason"]] = int(c.value)
+                    if c.value:
+                        counter[dict(key)["reason"]] = int(c.value)
         spans = _Counter(
             s.args["reason"]
             for s in obs.get_tracer().spans_since(enabled_obs)

@@ -46,6 +46,12 @@ class TestTwoProcessIntegration:
             lp = tmp / f"worker.{r}.log"
             if lp.exists():
                 logs += f"\n--- worker {r} ---\n" + lp.read_text()[-2000:]
+        if p.returncode != 0 and (
+                "Multiprocess computations aren't implemented"
+                in p.stderr + logs):
+            pytest.skip("jaxlib CPU backend on this host lacks "
+                        "multiprocess collectives; the two-process drill "
+                        "needs a runtime with cross-process all-reduce")
         assert p.returncode == 0, f"launch failed: {p.stderr[-500:]}{logs}"
         res = {}
         for r in range(2):

@@ -232,6 +232,45 @@ def drill_serve_hostsync_read(tmp):
                          "flight; retried next step; output exact")
 
 
+def drill_serve_draft_verify(tmp):
+    model, eng = _tiny_engine(decode_steps=3, speculative_decode=True,
+                              draft_depth=2)
+    p = (np.arange(9) * 5) % 128
+    rid = eng.add_request(p, max_new_tokens=12)
+    with faults.injected_faults("serve.draft_verify:2:TimeoutError"):
+        out = eng.run()
+    _expect(out[rid] == _dense_ref(model, p, 12),
+            "stream diverged after mid-flight speculation-off degradation")
+    _expect(not eng.spec, "engine still speculative after the fault")
+    _expect(_counter("serving_runtime_degradations_total",
+                     what="speculation_off") >= 1,
+            "degradation not counted")
+    _expect(eng.pool.tables == {}, "pool blocks leaked")
+    return "degraded", ("draft/verify fault dropped speculation for good; "
+                        "in-flight spec tile drained, stream byte-exact")
+
+
+def drill_serve_kv_dequant(tmp):
+    model, eng = _tiny_engine(decode_steps=3, kv_cache_dtype="int8")
+    p = (np.arange(9) * 5) % 128
+    rid = eng.add_request(p, max_new_tokens=12)
+    with faults.injected_faults("serve.kv_dequant:2:TimeoutError"):
+        out = eng.run()
+    _expect(len(out[rid]) == 12,
+            "request did not complete after drop-to-bf16 degradation")
+    _expect(not eng.pool.fmt.quantized,
+            "pool still quantized after the fault")
+    _expect(eng.pool.k_scale is None, "scale pool not released")
+    _expect(_counter("serving_runtime_degradations_total",
+                     what="kv_bf16") >= 1, "degradation not counted")
+    hist = obs.get_registry().get("serving_kv_dequant_seconds")
+    _expect(hist is not None and hist.count >= 1,
+            "whole-pool dequant not timed")
+    _expect(eng.pool.tables == {}, "pool blocks leaked")
+    return "degraded", ("dequant fault converted the pool to bf16 once; "
+                        "decode recompiled and the request completed")
+
+
 def drill_train_step_nonfinite(tmp):
     losses = {"n": 0}
 
@@ -328,6 +367,8 @@ SCENARIOS = {
     "serve.decode_oom": drill_serve_decode_oom,
     "serve.prefill_chunk": drill_serve_prefill_chunk,
     "serve.hostsync_read": drill_serve_hostsync_read,
+    "serve.draft_verify": drill_serve_draft_verify,
+    "serve.kv_dequant": drill_serve_kv_dequant,
     "train.step_nonfinite": drill_train_step_nonfinite,
     "compile.cache_read": drill_compile_cache_read,
     "compile.cache_write": drill_compile_cache_write,

@@ -118,6 +118,7 @@ define_flag("jit_donate_buffers", True, "donate param/opt buffers in compiled tr
 # PIR-lite compiler layer (paddle_tpu/pir/; ref: paddle/pir + FLAGS_enable_pir_api)
 define_flag("pir", True, "route to_static/serving compilation through the PIR pass pipeline (ref FLAGS_enable_pir_api); off = plain jax.jit")
 define_flag("pir_passes", "fold,cse,pattern,dce", "ordered comma list of PIR passes to run (registered: dce,fold,cse,pattern); each individually toggleable by omission")
+define_flag("pir_verify", "boundary", "structural IR verifier (pir/verifier.py): off | boundary (after capture + after the final pass) | on (after capture + after every pass; tests/tools). A rejection degrades the compile to plain jax.jit, counted pir_fallback_total{stage=verify}")
 define_flag("compile_cache_dir", "", "persistent PIR compile-cache directory ('' = off): sha256-verified StableHLO artifacts keyed by canonical IR hash + sharding + flags + jax version")
 define_flag("compile_cache_max_bytes", 1 << 28, "PIR compile-cache size cap; least-recently-read artifacts are evicted past it")
 define_flag("jit_signature_cache_size", 64, "max compiled input signatures kept per StaticFunction (LRU); shape churn past it shows up in jit_retrace_total")
@@ -126,6 +127,7 @@ define_flag("prim_all", False, "ref FLAGS_prim_all: decompose big ops before aut
 define_flag("cinn_bucket_compile", False, "ref FLAGS_cinn_bucket_compile; XLA owns fusion (informational)")
 # profiler / debug
 define_flag("observability", False, "runtime observability layer (paddle_tpu.observability): metrics registry + span tracing + SLO telemetry; off = zero-cost no-op fast path")
+define_flag("flight_recorder_dir", "", "directory flight-recorder postmortem dumps land in ('' = the tempdir); read from the environment by observability/recorder.py so standalone loads see it too")
 define_flag("fault_injection", "", "chaos harness spec (paddle_tpu.resilience.faults): 'site:nth:Exc' / 'site:rand(p)@seed:Exc' entries joined by ';'; '' = disarmed (one global load per site)")
 define_flag("enable_host_event_recorder_hook", False, "ref FLAGS_enable_host_event_recorder_hook: record host events in profiler")
 define_flag("call_stack_level", 1, "ref FLAGS_call_stack_level: error-message stack detail")

@@ -943,7 +943,18 @@ class ContinuousBatchingEngine:
         key = ("decode" + (".sampled" if sampled else "")
                + (".spec" if spec else ""))
         if self.compile_reports.get(key) is None:
-            self.compile_reports[key] = getattr(fn, "report", None)
+            rep = getattr(fn, "report", None)
+            self.compile_reports[key] = rep
+            if rep is not None and rep.fallback == "verify":
+                # the IR verifier statically rejected the decode program
+                # (donation-alias or a structural rule): the engine keeps
+                # serving on plain jax.jit, but donation safety of the
+                # pool buffers is no longer *proven* — loud, not silent
+                warnings.warn(
+                    f"decode program {key!r} was rejected by the PIR "
+                    f"verifier and fell back to plain jax.jit; see "
+                    f"pir_verify_failures_total{{rule}} for the rule",
+                    RuntimeWarning, stacklevel=2)
         return tile
 
     def _drain_all(self):
@@ -1388,6 +1399,10 @@ class ContinuousBatchingEngine:
                          ).reshape(B, C, nkv, hd)
                     q = _rope(q, pos, theta)
                     k = _rope(k, pos, theta)
+                    # kv.write effect scope (stamped inside the callee):
+                    # the verify-write must stay ordered before the
+                    # rollback below — the PIR effect-order rule rejects
+                    # any pass that migrates one past the other
                     kc, vc, ks, vs, saved = kv_write_tokens(
                         fmt if quant else None, kc, vc, ks, vs, k, v,
                         tables, lens, active=alive, scratch_block=scratch)

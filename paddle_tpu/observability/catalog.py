@@ -201,7 +201,17 @@ CATALOG = {
         "rewritten), by pass", ("pass",), None),
     "pir_fallback_total": (
         "counter", "pipeline degradations to plain jax.jit, by stage "
-        "(capture/passes/evaluator)", ("stage",), None),
+        "(capture/verify/passes/evaluator)", ("stage",), None),
+    "pir_verify_seconds": (
+        "histogram", "wall time of one structural verifier run over a "
+        "captured program (pir/verifier.py; after capture and after "
+        "passes per FLAGS_pir_verify)", (), _STEP_BUCKETS),
+    "pir_verify_failures_total": (
+        "counter", "programs rejected by the IR verifier, by rule "
+        "(def-before-use/single-def/arity/dangling-value/dead-code/"
+        "effect-order/type-mismatch/donation-alias/sharding-conflict/"
+        "verifier-error); each rejection degrades that compile to "
+        "plain jax.jit", ("rule",), None),
     "jit_retrace_total": (
         "counter", "StaticFunction traces for a new input signature "
         "(shape churn past the LRU signature cache is visible here)",

@@ -136,16 +136,20 @@ def write_to_cache(k_cache, v_cache, k_new, v_new, block_tables, write_pos,
     decode keeps dead lanes scribbling somewhere no live sequence owns
     without data-dependent control flow. Returns (k_cache, v_cache).
     """
-    block_size = k_cache.shape[1]
-    block_idx = write_pos // block_size                       # [B]
-    in_block = write_pos % block_size                         # [B]
-    block_ids = jnp.take_along_axis(block_tables, block_idx[:, None],
-                                    axis=1)[:, 0]             # [B]
-    if active is not None:
-        block_ids = jnp.where(active, block_ids, scratch_block)
-    k_cache = k_cache.at[block_ids, in_block].set(k_new)
-    v_cache = v_cache.at[block_ids, in_block].set(v_new)
-    return k_cache, v_cache
+    # kv.write scope: marks the pool scatters as stateful for the PIR
+    # verifier's effect-order rule (COMPILER.md "Verifier & dataflow
+    # analysis") — a pass may drop a dead write, never reorder live ones
+    with jax.named_scope("kv.write"):
+        block_size = k_cache.shape[1]
+        block_idx = write_pos // block_size                   # [B]
+        in_block = write_pos % block_size                     # [B]
+        block_ids = jnp.take_along_axis(block_tables, block_idx[:, None],
+                                        axis=1)[:, 0]         # [B]
+        if active is not None:
+            block_ids = jnp.where(active, block_ids, scratch_block)
+        k_cache = k_cache.at[block_ids, in_block].set(k_new)
+        v_cache = v_cache.at[block_ids, in_block].set(v_new)
+        return k_cache, v_cache
 
 
 def write_chunk_to_cache(k_cache, v_cache, k_new, v_new, table_row, start):
@@ -157,13 +161,14 @@ def write_chunk_to_cache(k_cache, v_cache, k_new, v_new, table_row, start):
     token. Positions past the row's allocated entries land in whatever
     the row is padded with (the engine pads with its scratch block).
     """
-    block_size = k_cache.shape[1]
-    pos = start + jnp.arange(k_new.shape[0])
-    block_ids = jnp.take(table_row, pos // block_size)
-    in_block = pos % block_size
-    k_cache = k_cache.at[block_ids, in_block].set(k_new)
-    v_cache = v_cache.at[block_ids, in_block].set(v_new)
-    return k_cache, v_cache
+    with jax.named_scope("kv.write"):
+        block_size = k_cache.shape[1]
+        pos = start + jnp.arange(k_new.shape[0])
+        block_ids = jnp.take(table_row, pos // block_size)
+        in_block = pos % block_size
+        k_cache = k_cache.at[block_ids, in_block].set(k_new)
+        v_cache = v_cache.at[block_ids, in_block].set(v_new)
+        return k_cache, v_cache
 
 
 def _token_slots(block_tables, start_pos, count, block_size,
@@ -194,23 +199,24 @@ def kv_write_tokens(fmt, k_cache, v_cache, k_scale, v_scale,
     rejected draft positions byte-exactly. Scale caches are [NB, BS, KVH]
     (None for passthrough formats, passed through unchanged).
     """
-    block_size = k_cache.shape[1]
-    bids, inb = _token_slots(block_tables, start_pos, k_new.shape[1],
-                             block_size, active, scratch_block)
-    saved_k = k_cache[bids, inb]                               # [B, C, KVH, D]
-    saved_v = v_cache[bids, inb]
-    if fmt is not None and fmt.quantized:
-        qk, sk = fmt.encode(k_new)
-        qv, sv = fmt.encode(v_new)
-        saved = (saved_k, saved_v, k_scale[bids, inb], v_scale[bids, inb])
-        k_scale = k_scale.at[bids, inb].set(sk)
-        v_scale = v_scale.at[bids, inb].set(sv)
-    else:
-        qk, qv = k_new, v_new
-        saved = (saved_k, saved_v)
-    k_cache = k_cache.at[bids, inb].set(qk.astype(k_cache.dtype))
-    v_cache = v_cache.at[bids, inb].set(qv.astype(v_cache.dtype))
-    return k_cache, v_cache, k_scale, v_scale, saved
+    with jax.named_scope("kv.write"):
+        block_size = k_cache.shape[1]
+        bids, inb = _token_slots(block_tables, start_pos, k_new.shape[1],
+                                 block_size, active, scratch_block)
+        saved_k = k_cache[bids, inb]                           # [B, C, KVH, D]
+        saved_v = v_cache[bids, inb]
+        if fmt is not None and fmt.quantized:
+            qk, sk = fmt.encode(k_new)
+            qv, sv = fmt.encode(v_new)
+            saved = (saved_k, saved_v, k_scale[bids, inb], v_scale[bids, inb])
+            k_scale = k_scale.at[bids, inb].set(sk)
+            v_scale = v_scale.at[bids, inb].set(sv)
+        else:
+            qk, qv = k_new, v_new
+            saved = (saved_k, saved_v)
+        k_cache = k_cache.at[bids, inb].set(qk.astype(k_cache.dtype))
+        v_cache = v_cache.at[bids, inb].set(qv.astype(v_cache.dtype))
+        return k_cache, v_cache, k_scale, v_scale, saved
 
 
 def kv_rollback_tokens(fmt, k_cache, v_cache, k_scale, v_scale, saved,
@@ -221,19 +227,22 @@ def kv_rollback_tokens(fmt, k_cache, v_cache, k_scale, v_scale, saved,
     are redirected to `scratch_block` instead of being masked out — the
     scatter stays dense and branch-free, and scratch contents are
     garbage by contract. Returns (k_cache, v_cache, k_scale, v_scale)."""
-    block_size = k_cache.shape[1]
-    bids, inb = _token_slots(block_tables, start_pos, keep.shape[1],
-                             block_size, active, scratch_block)
-    bids = jnp.where(keep, scratch_block, bids)
-    if fmt is not None and fmt.quantized:
-        saved_k, saved_v, saved_ks, saved_vs = saved
-        k_scale = k_scale.at[bids, inb].set(saved_ks)
-        v_scale = v_scale.at[bids, inb].set(saved_vs)
-    else:
-        saved_k, saved_v = saved
-    k_cache = k_cache.at[bids, inb].set(saved_k)
-    v_cache = v_cache.at[bids, inb].set(saved_v)
-    return k_cache, v_cache, k_scale, v_scale
+    # kv.rollback scope: same effect-order contract as kv.write — a
+    # rollback must never migrate past the write it undoes
+    with jax.named_scope("kv.rollback"):
+        block_size = k_cache.shape[1]
+        bids, inb = _token_slots(block_tables, start_pos, keep.shape[1],
+                                 block_size, active, scratch_block)
+        bids = jnp.where(keep, scratch_block, bids)
+        if fmt is not None and fmt.quantized:
+            saved_k, saved_v, saved_ks, saved_vs = saved
+            k_scale = k_scale.at[bids, inb].set(saved_ks)
+            v_scale = v_scale.at[bids, inb].set(saved_vs)
+        else:
+            saved_k, saved_v = saved
+        k_cache = k_cache.at[bids, inb].set(saved_k)
+        v_cache = v_cache.at[bids, inb].set(saved_v)
+        return k_cache, v_cache, k_scale, v_scale
 
 
 def kv_write_token(fmt, k_cache, v_cache, k_scale, v_scale, k_new, v_new,
@@ -252,10 +261,11 @@ def kv_write_token(fmt, k_cache, v_cache, k_scale, v_scale, k_new, v_new,
     k_cache, v_cache = write_to_cache(k_cache, v_cache, qk, qv,
                                       block_tables, write_pos,
                                       active, scratch_block)
-    bids, inb = _token_slots(block_tables, write_pos, 1,
-                             k_cache.shape[1], active, scratch_block)
-    k_scale = k_scale.at[bids[:, 0], inb[:, 0]].set(sk)
-    v_scale = v_scale.at[bids[:, 0], inb[:, 0]].set(sv)
+    with jax.named_scope("kv.write"):
+        bids, inb = _token_slots(block_tables, write_pos, 1,
+                                 k_cache.shape[1], active, scratch_block)
+        k_scale = k_scale.at[bids[:, 0], inb[:, 0]].set(sk)
+        v_scale = v_scale.at[bids[:, 0], inb[:, 0]].set(sv)
     return k_cache, v_cache, k_scale, v_scale
 
 
@@ -272,12 +282,13 @@ def kv_write_chunk(fmt, k_cache, v_cache, k_scale, v_scale, k_new, v_new,
     qv, sv = fmt.encode(v_new)
     k_cache, v_cache = write_chunk_to_cache(k_cache, v_cache, qk, qv,
                                             table_row, start)
-    block_size = k_cache.shape[1]
-    pos = start + jnp.arange(k_new.shape[0])
-    block_ids = jnp.take(table_row, pos // block_size)
-    in_block = pos % block_size
-    k_scale = k_scale.at[block_ids, in_block].set(sk)
-    v_scale = v_scale.at[block_ids, in_block].set(sv)
+    with jax.named_scope("kv.write"):
+        block_size = k_cache.shape[1]
+        pos = start + jnp.arange(k_new.shape[0])
+        block_ids = jnp.take(table_row, pos // block_size)
+        in_block = pos % block_size
+        k_scale = k_scale.at[block_ids, in_block].set(sk)
+        v_scale = v_scale.at[block_ids, in_block].set(sv)
     return k_cache, v_cache, k_scale, v_scale
 
 

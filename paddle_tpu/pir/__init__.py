@@ -22,15 +22,21 @@ jit.to_static and the serving engine compile through
 ``pipeline.compile_flat`` / ``pipeline.pir_jit``.
 """
 
+from .analysis import (DataflowAnalysis, FlatLattice, Lattice, Liveness,
+                       ShapeDtypeInference, ShardingConsistency,
+                       check_donation_safety)
 from .cache import (CompileCache, CompileCacheCorruptionError, cache_key,
                     default_cache, stats_snapshot)
 from .capture import capture, from_closed_jaxpr
 from .ir import Operation, Program, Value
+from .mutate import CORRUPTIONS, SkipCorruption, corrupt
 from .passes import (CommonSubexprElimination, ConstantFolding,
                      DeadCodeElimination, Pass, PassManager, PassResult)
 from .patterns import (PatternRewriter, RewritePattern, RmsEpiloguePattern,
                        SdpaRoutePattern)
 from .pipeline import CompileReport, compile_flat, pir_jit
+from .verifier import (EFFECT_SCOPES, RULES, IRVerificationError,
+                       verify_mode, verify_program)
 
 __all__ = [
     "Program", "Operation", "Value",
@@ -42,4 +48,9 @@ __all__ = [
     "CompileCache", "CompileCacheCorruptionError", "cache_key",
     "default_cache", "stats_snapshot",
     "CompileReport", "compile_flat", "pir_jit",
+    "RULES", "EFFECT_SCOPES", "IRVerificationError", "verify_program",
+    "verify_mode",
+    "DataflowAnalysis", "Lattice", "FlatLattice", "ShapeDtypeInference",
+    "Liveness", "ShardingConsistency", "check_donation_safety",
+    "CORRUPTIONS", "SkipCorruption", "corrupt",
 ]

@@ -1,0 +1,371 @@
+"""Dataflow analysis framework over pir.Program.
+
+reference: paddle/pir/include/pass/analysis_manager.h (pir analyses
+feeding passes) — here a generic join-semilattice worklist engine over
+the straight-line SSA op list, so future passes (the ROADMAP's
+GSPMD-style sharding propagation, collective-overlap scheduling) are
+written as pure transfer functions instead of ad-hoc graph walks.
+
+Three concrete analyses ship with the framework:
+
+* **ShapeDtypeInference** (forward): re-derives every Value's abstract
+  type from the program inputs/constants — eqn-backed ops from the
+  jaxpr avals they replay, fused ``pt.*`` ops through ``jax.eval_shape``
+  of their callable. Backs the verifier's ``type-mismatch`` rule.
+* **Liveness** (backward): live-Value sets per program point plus
+  use/def indices; feeds ``check_donation_safety`` which statically
+  rejects the donated-double-buffer hazard COMPILER.md previously only
+  documented (a donated buffer read again after the in-place-style op
+  that aliases over it).
+* **ShardingConsistency** (forward): propagates optional per-Value
+  sharding annotations (``Value.sharding``) and reports conflicts —
+  the seed of the sharding-propagation pass: that pass will *choose*
+  shardings; this analysis already proves a chosen assignment coherent.
+
+Programs here are topologically-ordered straight-line SSA (no control
+flow at this level — scans/whiles are single ops), so the fixpoint
+converges in one sweep; the worklist engine still re-enqueues dependents
+so transfer functions may be written without ordering assumptions.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Optional
+
+from .ir import Operation, Program, Value
+
+__all__ = ["Lattice", "FlatLattice", "DataflowAnalysis",
+           "ShapeDtypeInference", "Liveness", "ShardingConsistency",
+           "DonationHazard", "check_donation_safety", "CONFLICT"]
+
+
+class _Conflict:
+    """Lattice top: irreconcilable facts met."""
+
+    def __repr__(self):
+        return "<CONFLICT>"
+
+
+CONFLICT = _Conflict()
+
+
+class Lattice:
+    """Join-semilattice interface: ``bottom`` (no information) joined
+    upward toward ``CONFLICT`` (contradictory information)."""
+
+    def bottom(self):
+        return None
+
+    def join(self, a, b):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class FlatLattice(Lattice):
+    """bottom (None) < any concrete fact < CONFLICT. Two distinct
+    concrete facts join to CONFLICT — the shape every annotation-
+    consistency analysis (sharding, layout, memory space) starts from."""
+
+    def join(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        if a is CONFLICT or b is CONFLICT:
+            return CONFLICT
+        return a if a == b else CONFLICT
+
+
+class DataflowAnalysis:
+    """Worklist fixpoint over a Program's op list.
+
+    Subclasses set ``direction`` ("forward" | "backward") and implement
+    ``boundary(prog)`` (seed facts) and ``transfer(op, facts)`` which
+    updates ``facts`` in place and returns True when anything changed.
+    ``run`` returns the fact map after convergence. Facts are keyed by
+    ``id(Value)`` (or anything else the subclass chooses — the engine
+    only re-enqueues dependent ops on change).
+    """
+
+    direction = "forward"
+    name = "analysis"
+
+    def boundary(self, prog: Program) -> dict:
+        return {}
+
+    def transfer(self, op: Operation, facts: dict) -> bool:
+        raise NotImplementedError  # pragma: no cover - interface
+
+    def run(self, prog: Program) -> dict:
+        facts = self.boundary(prog)
+        forward = self.direction == "forward"
+        order = prog.ops if forward else list(reversed(prog.ops))
+        # dependents: forward -> ops consuming my outputs; backward ->
+        # ops defining my inputs
+        users = prog.users()
+        dependents: dict[int, list[Operation]] = {}
+        for op in prog.ops:
+            if forward:
+                deps = [u for o in op.outputs for u in users.get(o, ())
+                        if u is not None]
+            else:
+                deps = [v.op for v in op.inputs if v.op is not None]
+            dependents[id(op)] = deps
+        worklist = deque(order)
+        queued = {id(op) for op in order}
+        steps = 0
+        budget = max(16, len(prog.ops)) * 8    # straight-line: 1 sweep;
+        while worklist:                        # budget guards bad transfers
+            op = worklist.popleft()
+            queued.discard(id(op))
+            steps += 1
+            if steps > budget:
+                raise RuntimeError(
+                    f"dataflow analysis {self.name!r} did not converge "
+                    f"on {prog.name!r} within {budget} steps")
+            if self.transfer(op, facts):
+                for dep in dependents[id(op)]:
+                    if id(dep) not in queued:
+                        worklist.append(dep)
+                        queued.add(id(dep))
+        return facts
+
+
+# --------------------------------------------------------------------------
+# shape/dtype inference
+# --------------------------------------------------------------------------
+
+class ShapeDtypeInference(DataflowAnalysis):
+    """facts: id(Value) -> (shape tuple, dtype str). Inputs/constants
+    seed from their stamped types (the program boundary is trusted);
+    eqn ops derive outputs from the replayed jaxpr's avals; fused ops
+    re-derive through jax.eval_shape of the fused callable (cached per
+    op). The verifier compares these derived facts against the stamped
+    ``Value.shape/dtype`` (rule ``type-mismatch``)."""
+
+    direction = "forward"
+    name = "shape_dtype"
+
+    def __init__(self):
+        self._fused_cache: dict[int, Optional[list]] = {}
+
+    @staticmethod
+    def _key(shape, dtype):
+        return (tuple(shape), str(dtype))
+
+    def boundary(self, prog: Program) -> dict:
+        facts = {}
+        for v in prog.inputs:
+            facts[id(v)] = self._key(v.shape, v.dtype)
+        for v in prog.constants:
+            facts[id(v)] = self._key(v.shape, v.dtype)
+        return facts
+
+    def derived_out_types(self, op: Operation, facts: dict):
+        """[(shape, dtype_str)] for op's outputs, or None when underived
+        (fused op whose abstract eval is unavailable)."""
+        if op.eqn is not None:
+            return [self._key(tuple(getattr(ov.aval, "shape", ())),
+                              getattr(ov.aval, "dtype", None))
+                    for ov in op.eqn.outvars]
+        cached = self._fused_cache.get(id(op), False)
+        if cached is not False:
+            return cached
+        import jax
+        try:
+            in_avals = [jax.ShapeDtypeStruct(facts[id(v)][0],
+                                             facts[id(v)][1])
+                        for v in op.inputs]
+            outs = jax.eval_shape(lambda *a: op.evaluate(list(a)), *in_avals)
+            derived = [self._key(o.shape, o.dtype) for o in outs]
+        except Exception:  # noqa: BLE001 — an un-abstractable fused op
+            derived = None  # just opts out of derivation (stays checkable
+        self._fused_cache[id(op)] = derived   # structurally, not by type)
+        return derived
+
+    def derived_in_types(self, op: Operation):
+        """Expected operand types, or None (only eqn ops pin operands)."""
+        if op.eqn is None:
+            return None
+        return [self._key(tuple(getattr(iv.aval, "shape", ())),
+                          getattr(iv.aval, "dtype", None))
+                for iv in op.eqn.invars]
+
+    def transfer(self, op: Operation, facts: dict) -> bool:
+        if any(id(v) not in facts for v in op.inputs):
+            return False            # operands not yet derived
+        derived = self.derived_out_types(op, facts)
+        if derived is None:
+            derived = [self._key(o.shape, o.dtype) for o in op.outputs]
+        changed = False
+        for v, d in zip(op.outputs, derived):
+            if facts.get(id(v)) != d:
+                facts[id(v)] = d
+                changed = True
+        return changed
+
+
+# --------------------------------------------------------------------------
+# liveness + donation safety
+# --------------------------------------------------------------------------
+
+class Liveness(DataflowAnalysis):
+    """Backward liveness. After ``run``, facts map ``("after", i)`` (op
+    index) -> frozenset of Value ids live *after* op i executes; the
+    boundary ``("after", len(ops)-1)``... is seeded from the program
+    outputs. Also exposes ``last_use``/``uses`` index maps (computed in
+    run()) for clients that want ranges rather than sets."""
+
+    direction = "backward"
+    name = "liveness"
+
+    def __init__(self):
+        self.index: dict[int, int] = {}
+        self.uses: dict[int, list[int]] = {}       # id(Value) -> op idxs
+        self.last_use: dict[int, int] = {}         # id(Value) -> op idx
+
+    def boundary(self, prog: Program) -> dict:
+        self.index = {id(op): i for i, op in enumerate(prog.ops)}
+        self.uses = {}
+        for i, op in enumerate(prog.ops):
+            for v in op.inputs:
+                self.uses.setdefault(id(v), []).append(i)
+        self.last_use = {vid: idxs[-1] for vid, idxs in self.uses.items()}
+        out_live = frozenset(id(v) for v in prog.outputs)
+        n = len(prog.ops)
+        facts = {("after", n - 1): out_live} if n else {}
+        facts["exit"] = out_live
+        return facts
+
+    def transfer(self, op: Operation, facts: dict) -> bool:
+        i = self.index[id(op)]
+        live_after = facts.get(("after", i), frozenset())
+        live_before = (live_after - {id(o) for o in op.outputs}) \
+            | {id(v) for v in op.inputs}
+        changed = False
+        if facts.get(("before", i)) != live_before:
+            facts[("before", i)] = live_before
+            changed = True
+        if i > 0:
+            prev = facts.get(("after", i - 1), frozenset())
+            merged = prev | live_before
+            if merged != prev:
+                facts[("after", i - 1)] = merged
+                changed = True
+        return changed
+
+
+# ops that alias an operand's buffer into a same-typed output under
+# donation — the "in-place" shapes XLA folds a donated input into. A
+# donated Value must be DEAD after the first of these consumes it;
+# elementwise reuse (x*2) is not an overwrite and stays unrestricted.
+_OVERWRITE_OPS = ("dynamic_update_slice", "dynamic-update-slice",
+                  "scatter", "scatter-add", "scatter_add", "scan", "while")
+
+
+class DonationHazard:
+    __slots__ = ("value", "overwrite_op", "overwrite_index", "use_index")
+
+    def __init__(self, value, overwrite_op, overwrite_index, use_index):
+        self.value = value
+        self.overwrite_op = overwrite_op
+        self.overwrite_index = overwrite_index
+        self.use_index = use_index
+
+    def __repr__(self):
+        return (f"DonationHazard(%{self.value.vid} overwritten by "
+                f"{self.overwrite_op.name!r} at op {self.overwrite_index}, "
+                f"read again at op {self.use_index})")
+
+
+def check_donation_safety(prog: Program, donate_argnums) -> list:
+    """Statically reject the donated-double-buffer hazard: a donated
+    program input consumed by an overwrite-shaped op (its buffer aliased
+    into a same-shape/dtype output) and then *read again* later — on
+    device the second read would see the overwritten buffer. Returns
+    [DonationHazard]; empty = safe. The real serving decode programs
+    pass (each donated KV pool feeds exactly its fused scan, last use ==
+    overwrite point)."""
+    lv = Liveness()
+    lv.run(prog)
+    hazards = []
+    for argnum in donate_argnums or ():
+        if argnum >= len(prog.inputs):
+            continue
+        d = prog.inputs[argnum]
+        use_idxs = lv.uses.get(id(d), [])
+        if len(use_idxs) < 2:
+            continue                    # single consumer: trivially safe
+        for i in use_idxs:
+            op = prog.ops[i]
+            bare = op.name.split(".")[-1]
+            if op.name not in _OVERWRITE_OPS and bare not in _OVERWRITE_OPS:
+                continue
+            dkey = (tuple(d.shape), str(d.dtype))
+            if not any((tuple(o.shape), str(o.dtype)) == dkey
+                       for o in op.outputs):
+                continue
+            later = [j for j in use_idxs if j > i]
+            if later:
+                hazards.append(DonationHazard(d, op, i, later[0]))
+                break
+    return hazards
+
+
+# --------------------------------------------------------------------------
+# sharding-annotation consistency
+# --------------------------------------------------------------------------
+
+class ShardingConsistency(DataflowAnalysis):
+    """Forward propagation of optional ``Value.sharding`` annotations
+    over a FlatLattice: an op whose annotated operands agree propagates
+    that sharding to unannotated outputs; operands that disagree (and
+    shape-preserving ops whose stamped output annotation contradicts the
+    propagated one) join to CONFLICT. ``conflicts`` lists (op, detail)
+    after ``run``. This is deliberately the *consistency* half of GSPMD
+    propagation — the future sharding-propagation pass supplies the
+    decision procedure, then re-runs this to prove its assignment."""
+
+    direction = "forward"
+    name = "sharding"
+
+    def __init__(self):
+        self.lattice = FlatLattice()
+        self.conflicts: list[tuple[Operation, str]] = []
+        self._flagged: set[int] = set()
+
+    @staticmethod
+    def _annot(v: Value):
+        return getattr(v, "sharding", None)
+
+    def boundary(self, prog: Program) -> dict:
+        facts = {}
+        for v in list(prog.inputs) + list(prog.constants):
+            facts[id(v)] = self._annot(v)
+        return facts
+
+    def transfer(self, op: Operation, facts: dict) -> bool:
+        joined = None
+        for v in op.inputs:
+            fact = self.lattice.join(facts.get(id(v)), self._annot(v))
+            joined = self.lattice.join(joined, fact)
+        if joined is CONFLICT and id(op) not in self._flagged:
+            self._flagged.add(id(op))
+            annots = [(v.vid, facts.get(id(v), self._annot(v)))
+                      for v in op.inputs]
+            self.conflicts.append(
+                (op, f"operands carry irreconcilable shardings: "
+                     f"{[(f'%{vid}', s) for vid, s in annots if s]}"))
+        changed = False
+        for o in op.outputs:
+            fact = self.lattice.join(joined, self._annot(o))
+            if fact is CONFLICT and joined is not CONFLICT \
+                    and id(op) not in self._flagged:
+                self._flagged.add(id(op))
+                self.conflicts.append(
+                    (op, f"output %{o.vid} annotated {self._annot(o)!r} "
+                         f"but operands propagate {joined!r}"))
+            if facts.get(id(o), None) != fact:
+                facts[id(o)] = fact
+                changed = True
+        return changed

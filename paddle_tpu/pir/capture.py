@@ -23,6 +23,25 @@ def _aval_of(var):
     return tuple(getattr(av, "shape", ())), getattr(av, "dtype", None)
 
 
+def _effect_scope(eqn):
+    """The paged-KV effect scope this eqn was traced under, or None.
+    ops/paged_attention.py wraps its cache-mutating entry points in
+    ``jax.named_scope("kv.write" | "kv.rollback")``; the scope survives
+    tracing in ``eqn.source_info.name_stack`` and marks the lowered op
+    as stateful for the verifier's effect-order rule."""
+    from .verifier import EFFECT_SCOPES
+    try:
+        ns = eqn.source_info.name_stack
+        if not getattr(ns, "stack", None):
+            return None
+        for part in str(ns).split("/"):
+            if part in EFFECT_SCOPES:
+                return part
+    except Exception:  # noqa: BLE001 — scope detection is best-effort
+        return None
+    return None
+
+
 def from_closed_jaxpr(closed, name: str = "program") -> Program:
     """Lower a ClosedJaxpr to a Program. Literals become constants so
     every operand is a first-class Value."""
@@ -50,6 +69,7 @@ def from_closed_jaxpr(closed, name: str = "program") -> Program:
             return prog.add_constant(var.val)
         return env[id(var)]
 
+    eff_seq = 0
     for eqn in jaxpr.eqns:
         ins = [read(v) for v in eqn.invars]
         outs = []
@@ -59,7 +79,17 @@ def from_closed_jaxpr(closed, name: str = "program") -> Program:
             outs.append(val)
             if not isinstance(ov, DropVar):
                 env[id(ov)] = val
-        prog.ops.append(Operation(eqn.primitive.name, ins, outs, eqn=eqn))
+        op = Operation(eqn.primitive.name, ins, outs, eqn=eqn)
+        scope = _effect_scope(eqn)
+        if scope is not None:
+            # stateful paged-KV op: stamp the captured program order so
+            # the verifier's effect-order rule can hold every pass to it.
+            # attrs on eqn-backed ops stay out of attr_text()/canonical
+            # hashing — the stamp never perturbs compile-cache keys.
+            op.attrs["effect"] = scope
+            op.attrs["effect_seq"] = eff_seq
+            eff_seq += 1
+        prog.ops.append(op)
 
     prog.outputs = [read(v) for v in jaxpr.outvars]
     return prog

@@ -69,15 +69,20 @@ def canonical_attr_text(v) -> str:
 
 class Value:
     """One SSA value: produced by exactly one Operation (or a program
-    input / constant), consumed by any number."""
+    input / constant), consumed by any number. ``sharding`` is an
+    optional annotation (mesh-axes spec) consumed by the sharding
+    consistency analysis (pir/analysis.py) — None everywhere until a
+    sharding-propagation pass stamps it; it does not participate in
+    canonical hashing."""
 
-    __slots__ = ("vid", "shape", "dtype", "op")
+    __slots__ = ("vid", "shape", "dtype", "op", "sharding")
 
     def __init__(self, vid: int, shape, dtype, op: Optional["Operation"] = None):
         self.vid = vid
         self.shape = tuple(shape)
         self.dtype = dtype
         self.op = op          # defining op; None for inputs / constants
+        self.sharding = None  # optional sharding annotation
 
     @property
     def type_str(self) -> str:

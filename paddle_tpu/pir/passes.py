@@ -263,11 +263,22 @@ class PassManager:
 
     def run(self, prog: Program) -> dict:
         """Run all passes in order; returns {pass_name: PassResult} plus
-        per-pass seconds in PassResult.notes-adjacent ``report`` dict."""
+        per-pass seconds in PassResult.notes-adjacent ``report`` dict.
+
+        Under ``FLAGS_pir_verify`` the structural verifier
+        (pir/verifier.py) gates the pipeline: mode "on" re-verifies the
+        program after every pass (the dead-code rule turns strict right
+        after a dce run); mode "boundary" verifies once after the final
+        pass. An ``IRVerificationError`` propagates to the caller —
+        pipeline.compile_flat catches it and degrades to plain jax.jit
+        under ``pir_fallback_total{stage="verify"}``."""
         from ..observability import span as _span
         from ..observability.catalog import metric as _metric
+        from .verifier import verify_mode, verify_program
+        mode = verify_mode()
         report: dict[str, dict] = {}
         with _span("pir.pipeline", program=prog.name, ops=len(prog.ops)):
+            last_name = None
             for p in self.passes:
                 t0 = time.perf_counter()
                 with _span(f"pir.pass.{p.name}"):
@@ -279,6 +290,13 @@ class PassManager:
                             **{"pass": p.name}).inc(result.edits)
                 report[p.name] = {"seconds": dt, "edits": result.edits,
                                   "notes": result.notes}
+                last_name = p.name
+                if mode == "on":
+                    verify_program(prog, strict_dead=(p.name == "dce"),
+                                   where=p.name)
+            if mode == "boundary" and last_name is not None:
+                verify_program(prog, strict_dead=(last_name == "dce"),
+                               where=last_name)
         try:
             from ..observability.recorder import get_recorder
             rec = get_recorder()

@@ -26,6 +26,7 @@ from .cache import (CompileCacheCorruptionError, _bump, _metric, cache_key,
                     default_cache)
 from .capture import capture
 from .passes import PassManager
+from .verifier import IRVerificationError, verify_mode, verify_program
 
 __all__ = ["CompileReport", "compile_flat", "pir_jit"]
 
@@ -89,6 +90,18 @@ def compile_flat(flat_fn: Callable, flat_args: list, *, name: str,
         return _fallback(flat_fn, donate_argnums, report, "capture", e)
 
     try:
+        if verify_mode() != "off":
+            # capture-boundary verify; donated compiles also get the
+            # static donation-alias check here (the program the passes
+            # rewrite must already be double-buffer safe)
+            verify_program(prog, donate_argnums=donate_argnums,
+                           where="capture")
+    except Exception as e:  # noqa: BLE001 — IRVerificationError, or a bad
+        # FLAGS_pir_verify value: rejecting a program may only ever cost
+        # the pir path, never the compile
+        return _fallback(flat_fn, donate_argnums, report, "verify", e)
+
+    try:
         pm = PassManager.default()
         report.pass_report = pm.run(prog)
         report.final_ops = prog.num_ops()
@@ -99,6 +112,12 @@ def compile_flat(flat_fn: Callable, flat_args: list, *, name: str,
             if "=" in p)
         report.pattern_counts = {k: int(v)
                                  for k, v in report.pattern_counts.items()}
+    except IRVerificationError as e:
+        # a pass produced a malformed program: the verifier caught it
+        # before the evaluator could compile it — distinct stage so the
+        # chaos drill and dashboards separate "pass crashed" from "pass
+        # produced bad IR"
+        return _fallback(flat_fn, donate_argnums, report, "verify", e)
     except Exception as e:  # noqa: BLE001
         return _fallback(flat_fn, donate_argnums, report, "passes", e)
 

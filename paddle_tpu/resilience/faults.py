@@ -79,6 +79,11 @@ FAULT_SITES = {
     "compile.cache_write": "PIR compile cache: artifact write (atomic "
                            "tmp+rename; failure degrades to an uncached "
                            "but working compile)",
+    "compile.verify": "PIR structural verifier entry (pir/verifier.py): "
+                      "an injected fault is wrapped as the "
+                      "verifier-error rule and the compile degrades to "
+                      "plain jax.jit, counted "
+                      "pir_fallback_total{stage=verify}",
 }
 
 

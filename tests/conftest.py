@@ -9,6 +9,11 @@ override via jax.config here, before any backend is used.
 
 import os
 
+# tests run the PIR structural verifier after capture AND after every
+# enabled pass (prod default is "boundary"): any pass producing
+# malformed IR fails loudly here instead of degrading silently
+os.environ.setdefault("FLAGS_pir_verify", "on")
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,311 @@
+"""PIR structural verifier + dataflow analyses (pir/verifier.py,
+pir/analysis.py) — the mutation matrix is the contract: every seeded
+corruption in pir.CORRUPTIONS must be rejected with exactly the rule
+it names, and every *legitimate* captured program must verify clean
+through the whole pass pipeline (zero false positives)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import pir
+from paddle_tpu.framework import flags as _flags
+from paddle_tpu.pir.analysis import (CONFLICT, FlatLattice, Liveness,
+                                     ShapeDtypeInference,
+                                     ShardingConsistency,
+                                     check_donation_safety)
+
+
+# ---------------------------------------------------------------------------
+# fixture programs
+# ---------------------------------------------------------------------------
+
+def _plain_fn(x, y):
+    h = jnp.tanh(x @ y)
+    return (h * 2.0 + x, jnp.sum(h))
+
+
+def _plain_args():
+    rng = np.random.RandomState(0)
+    return [jnp.asarray(rng.randn(4, 4), jnp.float32),
+            jnp.asarray(rng.randn(4, 4), jnp.float32)]
+
+
+def _kv_fn(x, pool):
+    """Two effect-scoped writes + a rollback, directly traced (the
+    serving engine's writes sit inside lax.scan bodies; this exercises
+    the top-level effect-order rule the way a hand-written or unrolled
+    program would)."""
+    a, b, z = x * 2.0, x + 1.0, x * 0.0   # traced OUTSIDE the scopes
+    with jax.named_scope("kv.write"):
+        pool = jax.lax.dynamic_update_slice(pool, a, (0, 0))
+    with jax.named_scope("kv.write"):
+        pool = jax.lax.dynamic_update_slice(pool, b, (4, 0))
+    with jax.named_scope("kv.rollback"):
+        pool = jax.lax.dynamic_update_slice(pool, z, (0, 0))
+    return (pool, jnp.sum(pool).astype(jnp.int32))
+
+
+def _kv_args():
+    rng = np.random.RandomState(1)
+    return [jnp.asarray(rng.randn(4, 4), jnp.float32),
+            jnp.zeros((8, 4), jnp.float32)]
+
+
+def _capture_kv():
+    prog, _ = pir.capture(_kv_fn, *_kv_args(), name="kv_fixture")
+    return prog
+
+
+# ---------------------------------------------------------------------------
+# zero false positives
+# ---------------------------------------------------------------------------
+
+def test_clean_program_verifies_after_every_pass():
+    prog, _ = pir.capture(_plain_fn, *_plain_args(), name="clean")
+    pir.verify_program(prog, where="capture")
+    pm = pir.PassManager.default()
+    for p in pm.passes:
+        p.run(prog)
+        pir.verify_program(prog, strict_dead=(p.name == "dce"),
+                           where=p.name)
+
+
+def test_kv_program_verifies_and_is_effect_stamped():
+    prog = _capture_kv()
+    eff = [(op.attrs["effect"], op.attrs["effect_seq"])
+           for op in prog.ops if op.attrs.get("effect") is not None]
+    assert [e for e, _ in eff] == ["kv.write", "kv.write", "kv.rollback"]
+    seqs = [s for _, s in eff]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    pir.verify_program(prog, where="capture")
+    # and it survives the pass pipeline: effects are liveness roots
+    pm = pir.PassManager.default()
+    for p in pm.passes:
+        p.run(prog)
+        pir.verify_program(prog, strict_dead=(p.name == "dce"),
+                           where=p.name)
+    assert [op.attrs.get("effect") for op in prog.ops
+            if op.attrs.get("effect")] \
+        == ["kv.write", "kv.write", "kv.rollback"]
+
+
+def test_verified_program_still_replays_correctly():
+    args = _kv_args()
+    prog = _capture_kv()
+    pir.verify_program(prog)
+    want = _kv_fn(*args)
+    got = prog.bind(*args)
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want[0]),
+                               rtol=1e-6)
+    assert int(got[1]) == int(want[1])
+
+
+# ---------------------------------------------------------------------------
+# the mutation matrix: every corruption caught, with exactly its rule
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", sorted(pir.CORRUPTIONS))
+def test_mutation_matrix(kind):
+    _, expected_rule = pir.CORRUPTIONS[kind]
+    prog = _capture_kv()
+    pir.verify_program(prog)            # sanity: clean before corruption
+    try:
+        note = pir.corrupt(prog, kind, seed=0)
+    except pir.SkipCorruption as e:     # fixture must support the matrix
+        pytest.fail(f"kv fixture offers no target for {kind}: {e}")
+    with pytest.raises(pir.IRVerificationError) as ei:
+        pir.verify_program(prog)
+    assert ei.value.rule == expected_rule, \
+        f"{kind} ({note}) caught as {ei.value.rule!r}, " \
+        f"expected {expected_rule!r}"
+
+
+def test_error_carries_rule_op_and_excerpt():
+    prog = _capture_kv()
+    pir.corrupt(prog, "bad-arity", seed=0)
+    with pytest.raises(pir.IRVerificationError) as ei:
+        pir.verify_program(prog)
+    e = ei.value
+    assert e.rule == "arity" and e.rule in pir.RULES
+    assert e.op_name
+    assert e.excerpt and "program" in e.excerpt.splitlines()[0]
+    assert e.op_name in str(e)
+
+
+def test_corruption_registry_is_closed():
+    prog = _capture_kv()
+    with pytest.raises(KeyError):
+        pir.corrupt(prog, "not-a-corruption")
+    # every corruption names a registered verifier rule
+    for _, rule in pir.CORRUPTIONS.values():
+        assert rule in pir.RULES
+
+
+# ---------------------------------------------------------------------------
+# donation safety
+# ---------------------------------------------------------------------------
+
+def _donated_double_buffer(x):
+    upd = jnp.ones((2, 2), x.dtype)
+    y = jax.lax.dynamic_update_slice(x, upd, (0, 0))
+    return (y, jnp.sum(x))              # reads x AFTER the overwrite
+
+
+def _donated_safe(x):
+    upd = jnp.ones((2, 2), x.dtype)
+    return (jax.lax.dynamic_update_slice(x, upd, (0, 0)),)
+
+
+def test_donated_double_buffer_rejected():
+    x = jnp.zeros((4, 4), jnp.float32)
+    prog, _ = pir.capture(_donated_double_buffer, x, name="donate_bad")
+    hazards = check_donation_safety(prog, (0,))
+    assert len(hazards) == 1
+    assert "dynamic_update_slice" in hazards[0].overwrite_op.name
+    with pytest.raises(pir.IRVerificationError) as ei:
+        pir.verify_program(prog, donate_argnums=(0,))
+    assert ei.value.rule == "donation-alias"
+
+
+def test_donated_single_consumer_is_safe():
+    x = jnp.zeros((4, 4), jnp.float32)
+    prog, _ = pir.capture(_donated_safe, x, name="donate_ok")
+    assert check_donation_safety(prog, (0,)) == []
+    pir.verify_program(prog, donate_argnums=(0,))
+
+
+def test_elementwise_reuse_is_not_a_hazard():
+    def fn(x):
+        return (x * 2.0, x + 1.0)       # two reads, no overwrite op
+    prog, _ = pir.capture(fn, jnp.ones((4,), jnp.float32), name="ew")
+    assert check_donation_safety(prog, (0,)) == []
+    pir.verify_program(prog, donate_argnums=(0,))
+
+
+# ---------------------------------------------------------------------------
+# analyses
+# ---------------------------------------------------------------------------
+
+def test_shape_inference_rederives_every_value():
+    prog, _ = pir.capture(_plain_fn, *_plain_args(), name="infer")
+    inf = ShapeDtypeInference()
+    facts = inf.run(prog)
+    for op in prog.ops:
+        for o in op.outputs:
+            assert facts[id(o)] == (tuple(o.shape), str(o.dtype))
+    for v in prog.outputs:
+        assert id(v) in facts
+
+
+def test_shape_inference_covers_fused_ops():
+    from paddle_tpu.framework import core as _core  # noqa: F401
+    prog, _ = pir.capture(_plain_fn, *_plain_args(), name="fusedinf")
+    pir.PassManager.default().run(prog)
+    inf = ShapeDtypeInference()
+    facts = inf.run(prog)
+    for op in prog.ops:
+        for o in op.outputs:
+            assert facts[id(o)] == (tuple(o.shape), str(o.dtype))
+
+
+def test_liveness_last_use_and_exit_set():
+    prog, _ = pir.capture(_plain_fn, *_plain_args(), name="live")
+    lv = Liveness()
+    facts = lv.run(prog)
+    assert facts["exit"] == frozenset(id(v) for v in prog.outputs)
+    # every consumed Value has a recorded final consumer, in range
+    for vid, idx in lv.last_use.items():
+        assert 0 <= idx < len(prog.ops)
+        assert vid in {id(v) for op in prog.ops for v in op.inputs}
+    # program inputs are live before their first use
+    first_op_live = facts[("before", 0)]
+    used_inputs = {id(v) for op in prog.ops for v in op.inputs} \
+        & {id(v) for v in prog.inputs}
+    assert used_inputs <= first_op_live
+
+
+def test_flat_lattice_join():
+    lat = FlatLattice()
+    assert lat.join(None, None) is None
+    assert lat.join(None, "data") == "data"
+    assert lat.join("data", "data") == "data"
+    assert lat.join("data", "model") is CONFLICT
+    assert lat.join(CONFLICT, "data") is CONFLICT
+
+
+def test_sharding_consistency_propagates_and_conflicts():
+    prog, _ = pir.capture(_plain_fn, *_plain_args(), name="shard")
+    # agreeing annotations propagate with no conflict
+    prog.inputs[0].sharding = ("data", None)
+    prog.inputs[1].sharding = ("data", None)
+    sc = ShardingConsistency()
+    facts = sc.run(prog)
+    assert sc.conflicts == []
+    assert any(f == ("data", None) for f in facts.values())
+    pir.verify_program(prog)
+    # clashing annotations are a verifier rejection
+    prog2, _ = pir.capture(_plain_fn, *_plain_args(), name="shard2")
+    pir.corrupt(prog2, "sharding-clash", seed=0)
+    sc2 = ShardingConsistency()
+    sc2.run(prog2)
+    assert sc2.conflicts
+    with pytest.raises(pir.IRVerificationError) as ei:
+        pir.verify_program(prog2)
+    assert ei.value.rule == "sharding-conflict"
+
+
+# ---------------------------------------------------------------------------
+# flag plumbing + pipeline degradation
+# ---------------------------------------------------------------------------
+
+def test_verify_mode_validates_flag():
+    prev = _flags.flag_value("pir_verify")
+    try:
+        _flags.set_flags({"pir_verify": "on"})
+        assert pir.verify_mode() == "on"
+        _flags.set_flags({"pir_verify": "bogus"})
+        with pytest.raises(ValueError):
+            pir.verify_mode()
+    finally:
+        _flags.set_flags({"pir_verify": prev})
+
+
+def test_injected_verify_fault_degrades_to_jit(tmp_path):
+    from paddle_tpu.resilience import faults
+    prev_dir = _flags.flag_value("compile_cache_dir")
+    _flags.set_flags({"compile_cache_dir": str(tmp_path / "cc")})
+    try:
+        args = _plain_args()
+        want = [np.asarray(o) for o in _plain_fn(*args)]
+        with pytest.warns(RuntimeWarning, match="stage 'verify'"):
+            with faults.injected_faults("compile.verify:1:RuntimeError"):
+                compiled, rep = pir.compile_flat(
+                    _plain_fn, args, name="verify_fault")
+                assert faults.injected_counts().get("compile.verify") == 1
+        assert rep.fallback == "verify"
+        got = [np.asarray(o) for o in compiled(*args)]
+        for w, g in zip(want, got):
+            np.testing.assert_allclose(w, g, rtol=1e-6)
+        # fault cleared: the same compile takes the verified PIR path
+        _, rep2 = pir.compile_flat(_plain_fn, args, name="verify_fault")
+        assert rep2.fallback is None
+    finally:
+        _flags.set_flags({"compile_cache_dir": prev_dir})
+
+
+def test_rejection_counts_rule_metric():
+    from paddle_tpu import observability as obs
+    obs.enable()
+
+    def val():
+        fam = obs.get_registry().get("pir_verify_failures_total")
+        return fam.labels(rule="arity").value if fam is not None else 0.0
+
+    before = val()
+    prog = _capture_kv()
+    pir.corrupt(prog, "bad-arity", seed=0)
+    with pytest.raises(pir.IRVerificationError):
+        pir.verify_program(prog)
+    assert val() == before + 1

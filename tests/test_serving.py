@@ -124,7 +124,8 @@ class TestScheduler:
 
 
 class TestSampling:
-    def test_topk1_equals_greedy_and_seed_reproducible(self):
+    @pytest.mark.slow  # ~14s: 3 engine runs; top_p/parity tests keep
+    def test_topk1_equals_greedy_and_seed_reproducible(self):  # coverage
         model = _model()
         p = np.arange(6) % 128
         greedy = _dense_reference(model, p, 5)

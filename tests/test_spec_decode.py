@@ -69,7 +69,8 @@ def enabled_obs():
 
 
 class TestSpecGreedyIdentity:
-    def test_byte_identical_across_steps_and_depths(self):
+    @pytest.mark.slow  # ~20s: the full K x D sweep; tier-1 keeps the
+    def test_byte_identical_across_steps_and_depths(self):  # sampled one
         """ON vs OFF across decode_steps x draft_depth: committed greedy
         streams never change — speculation only changes how many forward
         positions one dispatch verifies."""

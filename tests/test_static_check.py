@@ -1,0 +1,73 @@
+"""tools/static_check.py — the repo-contract linter IS a tier-1 gate:
+the repo must lint clean, and an injected violation must be caught.
+Runs the tool as a subprocess (it is pure stdlib — no jax — so each
+run is fast) exactly the way CI invokes it."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "static_check.py")
+
+
+def _run(*argv):
+    return subprocess.run(
+        [sys.executable, TOOL, *argv], cwd=REPO,
+        capture_output=True, text=True, timeout=120)
+
+
+def test_repo_is_clean():
+    r = _run()
+    assert r.returncode == 0, \
+        f"repo-contract violations:\n{r.stdout}{r.stderr}"
+
+
+def test_list_rules_names_the_closed_registry():
+    r = _run("--list-rules")
+    assert r.returncode == 0
+    for rule in ("metrics-in-catalog", "catalog-docs-sync", "fault-sites",
+                 "recorder-kinds", "flags-registered", "host-sync"):
+        assert rule in r.stdout
+
+
+def test_unknown_rule_is_a_usage_error():
+    r = _run("--rule", "no-such-rule")
+    assert r.returncode == 2
+    assert "unknown rule" in r.stderr
+
+
+@pytest.mark.parametrize("source,rule", [
+    ('from paddle_tpu.observability.catalog import metric\n'
+     'metric("nonexistent_metric_xyz").inc()\n', "metrics-in-catalog"),
+    ('from paddle_tpu.resilience.faults import fault_point\n'
+     'fault_point("no.such_site")\n', "fault-sites"),
+    ('rec.record("not_a_kind", x=1)\n', "recorder-kinds"),
+    ('import os\n'
+     'os.environ.get("FLAGS_totally_unregistered")\n', "flags-registered"),
+])
+def test_injected_violation_fails(tmp_path, source, rule):
+    bad = tmp_path / "bad_module.py"
+    bad.write_text(source)
+    r = _run("--paths", str(bad), "--json")
+    assert r.returncode == 1, f"violation not caught:\n{r.stdout}"
+    found = json.loads(r.stdout)
+    assert any(v["rule"] == rule for v in found), found
+
+
+def test_host_sync_rule_catches_new_sync(tmp_path):
+    # a file masquerading as serving.py with an unallowlisted sync
+    bad = tmp_path / "paddle_tpu" / "inference"
+    bad.mkdir(parents=True)
+    f = bad / "serving.py"
+    f.write_text("import numpy as np\n"
+                 "def _hot_loop(x):\n"
+                 "    return np.asarray(x)\n")
+    r = _run("--paths", str(f), "--json")
+    assert r.returncode == 1
+    found = json.loads(r.stdout)
+    assert any(v["rule"] == "host-sync" and "_hot_loop" in v["message"]
+               for v in found), found

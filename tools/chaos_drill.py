@@ -357,6 +357,40 @@ def drill_compile_cache_write(tmp):
                         "working; next compile wrote + verified")
 
 
+def drill_compile_verify(tmp):
+    from paddle_tpu.framework import flags as _flags
+    pir, fn, args, want, prev = _pir_compile_setup(tmp)
+    prev_v = _flags.flag_value("pir_verify")
+    _flags.set_flags({"pir_verify": "boundary"})
+    try:
+        with faults.injected_faults("compile.verify:1:RuntimeError"):
+            compiled, rep = pir.compile_flat(fn, args, name="drill_verify")
+            inj = faults.injected_counts().get("compile.verify", 0)
+        _expect(inj == 1, "fault never reached the verifier entry")
+        _expect(rep.fallback == "verify",
+                f"verifier fault not degraded: fallback={rep.fallback}")
+        out = float(np.asarray(compiled(*args)[0]))
+        _expect(abs(out - want) < 1e-5,
+                f"fallback jit result wrong: {out}")
+        _expect(_counter("pir_fallback_total", stage="verify") >= 1,
+                "verify fallback not counted")
+        _expect(_counter("fault_injected_total",
+                         site="compile.verify") >= 1,
+                "injection not counted")
+        # with the fault gone the same program verifies + compiles PIR
+        clean, rep2 = pir.compile_flat(fn, args, name="drill_verify")
+        _expect(rep2.fallback is None,
+                f"still degraded after fault cleared: {rep2.fallback}")
+        out2 = float(np.asarray(clean(*args)[0]))
+        _expect(abs(out2 - want) < 1e-5, f"clean recompile wrong: {out2}")
+    finally:
+        _flags.set_flags({"compile_cache_dir": prev,
+                          "pir_verify": prev_v})
+    return "degraded", ("verifier fault degraded that compile to plain "
+                        "jax.jit (correct numerics); next compile "
+                        "verified and took the PIR path")
+
+
 SCENARIOS = {
     "ckpt.chunk_write": drill_ckpt_chunk_write,
     "ckpt.metadata_replace": drill_ckpt_metadata_replace,
@@ -372,6 +406,7 @@ SCENARIOS = {
     "train.step_nonfinite": drill_train_step_nonfinite,
     "compile.cache_read": drill_compile_cache_read,
     "compile.cache_write": drill_compile_cache_write,
+    "compile.verify": drill_compile_verify,
 }
 
 

@@ -118,6 +118,17 @@ EXAMPLES = {
 
 # ---------------------------------------------------------------------------
 
+def _verify(prog, name, where, strict_dead=False):
+    """One verifier run; --check treats a rejection as a gate failure
+    (a named rule + IR excerpt print instead of a numerics diff)."""
+    try:
+        pir.verify_program(prog, strict_dead=strict_dead, where=where)
+        return True
+    except pir.IRVerificationError as e:
+        print(f"  !! verifier rejected {name} after {where}: {e}")
+        return False
+
+
 def run_example(name, diff=False, check=False, verbose=True):
     """Returns True when --check passed (or wasn't requested)."""
     fn, flat = EXAMPLES[name]()
@@ -129,7 +140,7 @@ def run_example(name, diff=False, check=False, verbose=True):
     if diff:
         print(prog.to_string())
 
-    ok = True
+    ok = _verify(prog, name, "capture") if check else True
     pm = pir.PassManager.default()
     for p in pm.passes:
         before_ops = prog.num_ops()
@@ -139,6 +150,9 @@ def run_example(name, diff=False, check=False, verbose=True):
               f"ops {before_ops} -> {prog.num_ops()}  [{result.notes}]")
         if diff and result.changed:
             _print_diff(before_txt, prog.to_string())
+        if check:
+            ok &= _verify(prog, name, p.name,
+                          strict_dead=(p.name == "dce"))
         if check and result.changed:
             got = [np.asarray(o) for o in prog.bind(*flat)]
             for e, g in zip(eager, got):
@@ -152,7 +166,8 @@ def run_example(name, diff=False, check=False, verbose=True):
     if fused:
         print(f"  fused ops: {fused}")
     if check and ok:
-        print(f"  check OK: final program matches eager on the fixed seed")
+        print(f"  check OK: final program verifies and matches eager "
+              f"on the fixed seed")
     return ok
 
 
@@ -181,7 +196,8 @@ def main():
     for n in names:
         ok &= run_example(n, diff=args.diff, check=args.check)
     if args.check and not ok:
-        print("IR CHECK FAILED: a pass changed numerics")
+        print("IR CHECK FAILED: a pass changed numerics or produced "
+              "IR the verifier rejects")
         return 1
     return 0
 

@@ -1,0 +1,455 @@
+#!/usr/bin/env python
+"""Repo-contract linter: pins the registries to the code that uses them.
+
+The repo's observability/resilience/flags surfaces are all *closed
+registries* (a metric must be in the catalog, a fault site in
+FAULT_SITES, ...). Runtime enforcement exists (``catalog.metric``
+raises on unknown names) but only fires on the code path that runs;
+this tool proves the containments **statically**, over every call
+site, by parsing the source with ``ast`` — no jax import, no device,
+<1s. STATIC_ANALYSIS.md is the runbook.
+
+Rules (closed registry, like everything else here):
+
+  metrics-in-catalog   metric("name") literals  ⊆ catalog.py CATALOG
+  catalog-docs-sync    CATALOG keys            == OBSERVABILITY.md rows
+  fault-sites          fault_point("s") ⊆ FAULT_SITES ⊆ chaos_drill
+                       SCENARIOS; every site backticked in RESILIENCE.md
+  recorder-kinds       record("kind") literals  ⊆ recorder EVENT_KINDS
+  flags-registered     os.environ FLAGS_* accesses and flag_value("x")
+                       args ⊆ define_flag names (collected repo-wide)
+  host-sync            device->host syncs (np.asarray / .item() /
+                       jax.device_get / .block_until_ready) in the
+                       serving hot path outside the audited allowlist
+
+Usage:
+  python tools/static_check.py                 # whole repo, all rules
+  python tools/static_check.py --rule host-sync
+  python tools/static_check.py --paths f.py    # scan these files only
+                                               # (registries still come
+                                               # from the repo)
+  python tools/static_check.py --list-rules
+  python tools/static_check.py --json
+
+Exit 0 clean, 1 violations, 2 usage error (unknown rule).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# source roots scanned for *call sites* (tests are excluded on purpose:
+# they assert that unknown names raise, which would be false positives)
+SCAN_ROOTS = ("paddle_tpu", "tools")
+
+# registry source locations (parsed as AST / text, never imported)
+CATALOG_PY = "paddle_tpu/observability/catalog.py"
+FAULTS_PY = "paddle_tpu/resilience/faults.py"
+RECORDER_PY = "paddle_tpu/observability/recorder.py"
+FLAGS_PY = "paddle_tpu/framework/flags.py"
+CHAOS_PY = "tools/chaos_drill.py"
+OBS_MD = "OBSERVABILITY.md"
+RES_MD = "RESILIENCE.md"
+
+# host-sync rule scope + allowlist: methods audited as intentional
+# host syncs (see STATIC_ANALYSIS.md "Host-sync allowlist policy").
+# "Cls.*" allowlists every method of the class.
+HOST_SYNC_FILES = ("paddle_tpu/inference/serving.py",
+                   "paddle_tpu/ops/paged_attention.py")
+HOST_SYNC_ALLOW = {
+    "paddle_tpu/inference/serving.py": (
+        "Request.__init__",            # host-side prompt normalization
+        "Request.choose",              # sampling on already-fetched logits
+        "ContinuousBatchingEngine._prefill_one_chunk",  # first-token read
+        "ContinuousBatchingEngine._drain_one",          # the one readback
+        "ContinuousBatchingEngine._upload_lane_state",  # admission repack
+    ),
+    "paddle_tpu/ops/paged_attention.py": (
+        "BlockKVCacheManager.*",       # host-side block-table bookkeeping
+    ),
+}
+HOST_SYNC_CALLS = {"asarray", "array", "device_get", "block_until_ready",
+                   "item"}
+
+
+class Violation:
+    __slots__ = ("rule", "path", "line", "message")
+
+    def __init__(self, rule, path, line, message):
+        self.rule, self.path, self.line, self.message = \
+            rule, path, line, message
+
+    def as_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+    def __str__(self):
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.rule}] {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# registry extraction (AST / text; no imports)
+# ---------------------------------------------------------------------------
+
+def _parse(relpath):
+    path = os.path.join(REPO, relpath)
+    with open(path, encoding="utf-8") as f:
+        return ast.parse(f.read(), filename=relpath)
+
+
+def _read(relpath):
+    with open(os.path.join(REPO, relpath), encoding="utf-8") as f:
+        return f.read()
+
+
+def _dict_keys(relpath, var):
+    """String keys of a module-level ``var = {...}`` dict literal."""
+    for node in _parse(relpath).body:
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name) and t.id == var
+                        for t in node.targets) \
+                and isinstance(node.value, ast.Dict):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    raise RuntimeError(f"{relpath}: no dict literal named {var!r}")
+
+
+def _defined_flags():
+    """First-arg literals of every define_flag(...) call under
+    paddle_tpu/ — the registry is distributed: flags.py holds the core
+    set, and kernel modules (ops/pallas/*) register their own on
+    import. Collected from a fixed repo walk so --paths can't shrink
+    the registry out from under the rule."""
+    names = set()
+    for dirpath, _, files in os.walk(os.path.join(REPO, "paddle_tpu")):
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, f), REPO)
+            for node in ast.walk(_parse(rel)):
+                if isinstance(node, ast.Call) \
+                        and _callee(node) == "define_flag" \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Constant):
+                    names.add(node.args[0].value)
+    return names
+
+
+def _callee(call):
+    """Trailing name of a call target: f(...) and o.f(...) both -> 'f'."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+class Context:
+    """Parsed registries + the scanned source files (path -> AST)."""
+
+    def __init__(self, paths=None):
+        self.catalog = _dict_keys(CATALOG_PY, "CATALOG")
+        self.fault_sites = _dict_keys(FAULTS_PY, "FAULT_SITES")
+        self.event_kinds = _dict_keys(RECORDER_PY, "EVENT_KINDS")
+        self.scenarios = _dict_keys(CHAOS_PY, "SCENARIOS")
+        self.flags = _defined_flags()
+        self.obs_rows = set(re.findall(r"^\| `([a-z0-9_]+)` \|",
+                                       _read(OBS_MD), re.M))
+        self.res_ticks = set(re.findall(r"`([a-z_]+\.[a-z_]+)`",
+                                        _read(RES_MD)))
+        self.sources = {}
+        for rel in (paths if paths is not None else self._default_paths()):
+            try:
+                self.sources[rel] = _parse(rel) if not os.path.isabs(rel) \
+                    else ast.parse(open(rel, encoding="utf-8").read(),
+                                   filename=rel)
+            except SyntaxError as e:
+                raise RuntimeError(f"{rel}: unparseable: {e}") from None
+
+    @staticmethod
+    def _default_paths():
+        out = []
+        for root in SCAN_ROOTS:
+            for dirpath, _, files in os.walk(os.path.join(REPO, root)):
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.relpath(
+                            os.path.join(dirpath, f), REPO))
+        return sorted(out)
+
+
+# ---------------------------------------------------------------------------
+# rules: fn(ctx) -> [Violation]
+# ---------------------------------------------------------------------------
+
+def _str_arg_calls(ctx, callee_names):
+    """(path, line, literal) for every call f("literal") whose trailing
+    callee name is in `callee_names`."""
+    for path, tree in ctx.sources.items():
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and _callee(node) in callee_names \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                yield path, node.lineno, node.args[0].value
+
+
+def rule_metrics_in_catalog(ctx):
+    return [Violation("metrics-in-catalog", p, ln,
+                      f"metric({name!r}) is not in {CATALOG_PY} CATALOG")
+            for p, ln, name in _str_arg_calls(ctx, {"metric"})
+            if name not in ctx.catalog]
+
+
+def rule_catalog_docs_sync(ctx):
+    out = []
+    for name in sorted(ctx.catalog - ctx.obs_rows):
+        out.append(Violation("catalog-docs-sync", OBS_MD, 0,
+                             f"CATALOG metric {name!r} has no "
+                             f"`| `{name}` |` row in {OBS_MD}"))
+    for name in sorted(ctx.obs_rows - ctx.catalog):
+        out.append(Violation("catalog-docs-sync", OBS_MD, 0,
+                             f"{OBS_MD} documents {name!r} which is not "
+                             f"in {CATALOG_PY} CATALOG"))
+    return out
+
+
+def rule_fault_sites(ctx):
+    out = []
+    for p, ln, name in _str_arg_calls(ctx, {"fault_point"}):
+        if name not in ctx.fault_sites:
+            out.append(Violation(
+                "fault-sites", p, ln,
+                f"fault_point({name!r}) is not in {FAULTS_PY} FAULT_SITES"))
+    for name in sorted(ctx.fault_sites - ctx.scenarios):
+        out.append(Violation(
+            "fault-sites", CHAOS_PY, 0,
+            f"FAULT_SITES entry {name!r} has no chaos_drill SCENARIOS "
+            "drill (every registered site must be drillable)"))
+    for name in sorted(ctx.fault_sites - ctx.res_ticks):
+        out.append(Violation(
+            "fault-sites", RES_MD, 0,
+            f"FAULT_SITES entry {name!r} is never mentioned (backticked) "
+            f"in {RES_MD}"))
+    return out
+
+
+def rule_recorder_kinds(ctx):
+    return [Violation("recorder-kinds", p, ln,
+                      f"record({kind!r}) is not in {RECORDER_PY} "
+                      "EVENT_KINDS")
+            for p, ln, kind in _str_arg_calls(ctx, {"record"})
+            if kind not in ctx.event_kinds]
+
+
+def rule_flags_registered(ctx):
+    """Two access shapes must resolve against flags.py:
+
+    * environment reads/writes of a ``FLAGS_*`` literal — via
+      ``os.environ.get/.setdefault`` or subscripting — which is how
+      standalone-importable modules (metrics, recorder, tracing) see
+      flags without importing the framework;
+    * ``flag_value("name")`` / ``set_flags({"name": ...})`` calls.
+
+    Flag *help texts* routinely mention reference-paddle ``FLAGS_*``
+    names that are deliberately not registered here, so the rule only
+    looks at access expressions, never at arbitrary string literals.
+    """
+    out = []
+    for path, tree in ctx.sources.items():
+        for node in ast.walk(tree):
+            name = None
+            if isinstance(node, ast.Call) and _callee(node) in \
+                    ("get", "setdefault") and node.args \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Attribute) \
+                    and node.func.value.attr == "environ" \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str) \
+                    and node.args[0].value.startswith("FLAGS_"):
+                name = node.args[0].value
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.value, ast.Attribute) \
+                    and node.value.attr == "environ" \
+                    and isinstance(node.slice, ast.Constant) \
+                    and isinstance(node.slice.value, str) \
+                    and node.slice.value.startswith("FLAGS_"):
+                name = node.slice.value
+            if name is not None \
+                    and name.removeprefix("FLAGS_") not in ctx.flags:
+                out.append(Violation(
+                    "flags-registered", path, node.lineno,
+                    f"environment access to {name!r} but "
+                    f"{name.removeprefix('FLAGS_')!r} is not "
+                    "define_flag()ed anywhere under paddle_tpu/"))
+    for p, ln, name in _str_arg_calls(ctx, {"flag_value"}):
+        short = name.removeprefix("FLAGS_")
+        if short not in ctx.flags:
+            out.append(Violation(
+                "flags-registered", p, ln,
+                f"flag_value({name!r}) but {short!r} is not "
+                "define_flag()ed anywhere under paddle_tpu/"))
+    # set_flags({"name": v}) / get_flags(["name"]) dict/list literals
+    for path, tree in ctx.sources.items():
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and _callee(node) in ("set_flags", "get_flags")
+                    and node.args):
+                continue
+            arg = node.args[0]
+            lits = []
+            if isinstance(arg, ast.Dict):
+                lits = [k for k in arg.keys if isinstance(k, ast.Constant)]
+            elif isinstance(arg, (ast.List, ast.Tuple)):
+                lits = [e for e in arg.elts if isinstance(e, ast.Constant)]
+            for k in lits:
+                if not isinstance(k.value, str):
+                    continue
+                short = k.value.removeprefix("FLAGS_")
+                if short not in ctx.flags:
+                    out.append(Violation(
+                        "flags-registered", path, node.lineno,
+                        f"{_callee(node)}({k.value!r}) but {short!r} is "
+                        "not define_flag()ed anywhere under paddle_tpu/"))
+    return out
+
+
+def _qualnames(tree):
+    """(node, 'Cls.meth'/'fn') for every function, walked with scope."""
+    out = []
+
+    def visit(node, stack):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append((node, ".".join(stack)))
+        for ch in ast.iter_child_nodes(node):
+            nxt = stack
+            if isinstance(ch, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                nxt = stack + [ch.name]
+            visit(ch, nxt)
+
+    visit(tree, [])
+    return out
+
+
+def _allowed(qual, allow):
+    for a in allow:
+        if a.endswith(".*"):
+            if qual.startswith(a[:-1]) or qual == a[:-2]:
+                return True
+        elif qual == a:
+            return True
+    return False
+
+
+def rule_host_sync(ctx):
+    """A device->host sync in the serving hot path stalls the whole
+    batch (SERVING.md's single-readback design) — any new one must be
+    audited into HOST_SYNC_ALLOW, not merged silently. jnp.asarray is
+    host->device (an upload) and is not flagged."""
+    out = []
+    for path, tree in ctx.sources.items():
+        norm = path.replace(os.sep, "/")
+        scope = next((f for f in HOST_SYNC_FILES if norm.endswith(f)),
+                     None)
+        if scope is None:
+            continue
+        allow = HOST_SYNC_ALLOW.get(scope, ())
+        for fn, qual in _qualnames(tree):
+            if _allowed(qual, allow):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _callee(node)
+                if callee not in HOST_SYNC_CALLS:
+                    continue
+                # np.asarray / np.array are syncs; jnp.* is an upload
+                if callee in ("asarray", "array"):
+                    f = node.func
+                    if not (isinstance(f, ast.Attribute)
+                            and isinstance(f.value, ast.Name)
+                            and f.value.id == "np"):
+                        continue
+                out.append(Violation(
+                    "host-sync", path, node.lineno,
+                    f"device->host sync `{callee}` in {qual} (not in the "
+                    "audited allowlist; see STATIC_ANALYSIS.md)"))
+    return out
+
+
+RULES = {
+    "metrics-in-catalog": (rule_metrics_in_catalog,
+                           "metric() literals are catalog entries"),
+    "catalog-docs-sync": (rule_catalog_docs_sync,
+                          "CATALOG == OBSERVABILITY.md rows, both ways"),
+    "fault-sites": (rule_fault_sites,
+                    "fault_point ⊆ FAULT_SITES ⊆ chaos drills ⊆ docs"),
+    "recorder-kinds": (rule_recorder_kinds,
+                       "record() kinds are EVENT_KINDS entries"),
+    "flags-registered": (rule_flags_registered,
+                         "FLAGS_* env accesses and flag_value args are "
+                         "define_flag()ed"),
+    "host-sync": (rule_host_sync,
+                  "no unaudited device->host syncs in the serving path"),
+}
+
+
+def run(rules=None, paths=None):
+    ctx = Context(paths=paths)
+    out = []
+    for name in (rules or sorted(RULES)):
+        fn, _ = RULES[name]
+        out.extend(fn(ctx))
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="repo-contract linter (see STATIC_ANALYSIS.md)")
+    ap.add_argument("--rule", action="append",
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--paths", nargs="+",
+                    help="scan these source files instead of the repo "
+                         "roots (registries still come from the repo)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(RULES):
+            print(f"{name:20s} {RULES[name][1]}")
+        return 0
+    for r in args.rule or ():
+        if r not in RULES:
+            print(f"unknown rule {r!r}; --list-rules shows the registry",
+                  file=sys.stderr)
+            return 2
+
+    violations = run(rules=args.rule, paths=args.paths)
+    if args.json:
+        print(json.dumps([v.as_dict() for v in violations], indent=2))
+    else:
+        for v in violations:
+            print(v)
+    if violations:
+        ran = ", ".join(args.rule) if args.rule else "all rules"
+        print(f"static_check: {len(violations)} violation(s) ({ran})",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -628,6 +628,66 @@ def secondary_worker(force_cpu: bool, which: str):
     return 0
 
 
+def loadgen_worker(force_cpu: bool, scenario="chat", seed=0):
+    """--loadgen leg: drive the serving engine with a seeded traffic
+    scenario (inference/loadgen.py, same harness as tools/loadgen.py)
+    and emit goodput, p95 TTFT, the SLO verdict, and the profiler's
+    phase-attribution coverage as one bench JSON row."""
+    import jax
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu import observability as _obs
+    _obs.enable()
+    from paddle_tpu.profiler.phases import get_phase_accountant
+    get_phase_accountant().enabled = True
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ContinuousBatchingEngine, loadgen
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=8,
+                          num_attention_heads=16,
+                          max_position_embeddings=2048, dtype="bfloat16")
+        eng_kw = dict(num_blocks=1024, block_size=16, max_batch=8,
+                      prefill_buckets=(32, 64, 128), max_queue=256)
+    else:
+        cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                          intermediate_size=128, num_hidden_layers=2,
+                          num_attention_heads=4,
+                          max_position_embeddings=256)
+        eng_kw = dict(num_blocks=128, block_size=8, max_batch=4,
+                      prefill_buckets=(16, 32), max_queue=64)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    eng = ContinuousBatchingEngine(model, **eng_kw)
+    rep = loadgen.run_scenario(eng, scenario, seed=seed)
+    problems = loadgen.check_report(rep)
+    detail = {
+        "device": str(jax.devices()[0]),
+        "scenario": rep["scenario"], "seed": rep["seed"],
+        "schedule_digest": rep["schedule"]["digest"],
+        "issued": rep["issued"], "finished": rep["finished"],
+        "goodput": rep["goodput"], "goodput_rps": rep["goodput_rps"],
+        "ttft_p95_s": rep["ttft"]["p95"], "tpot_p95_s": rep["tpot"]["p95"],
+        "slo_ok": rep["slo"].get("ok"),
+        "slo": [{k: r.get(k) for k in ("name", "ok", "observed",
+                                       "burn_rate")}
+                for r in rep["slo"].get("slos", [])],
+        "attribution_coverage": rep["coverage"],
+        "cost_ratio": rep["cost"]["ratio"],
+        "headroom_floor": rep["headroom_floor"],
+        "check_problems": problems,
+    }
+    detail["metrics_snapshot"] = _obs.snapshot(
+        meta={"which": "loadgen", "round": _current_round()})
+    print(json.dumps({"metric": "loadgen_goodput", "unit": "req/s",
+                      "value": rep["goodput_rps"],
+                      "vs_baseline": 1.0 if rep["slo"].get("ok") else 0.0,
+                      "detail": detail}))
+    return 0 if not problems else 1
+
+
 def probe():
     """Minimal TPU liveness check: backend init + one tiny matmul."""
     import jax
@@ -984,6 +1044,16 @@ def _attempt_raw(args, timeout_s):
 
 
 def main():
+    if "--loadgen" in sys.argv:
+        # standalone leg (works with or without --worker): traffic
+        # harness row — goodput, p95 TTFT, SLO verdict, attribution
+        # coverage (see OBSERVABILITY.md load-testing runbook)
+        scen = "chat"
+        i = sys.argv.index("--loadgen")
+        if i + 1 < len(sys.argv) and not sys.argv[i + 1].startswith("-"):
+            scen = sys.argv[i + 1]
+        return loadgen_worker(force_cpu="--cpu" in sys.argv,
+                              scenario=scen)
     if "--worker" in sys.argv:
         if "--probe" in sys.argv:
             return probe()

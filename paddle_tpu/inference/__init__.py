@@ -17,12 +17,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .loadgen import (  # noqa: E402,F401
+    SCENARIOS, Scenario, build_schedule, check_report, run_scenario)
 from .serving import (  # noqa: E402,F401
     BackpressureError, ContinuousBatchingEngine, KVPoolExhaustedError,
     Request)
 
 __all__ = ["ContinuousBatchingEngine", "Request", "BackpressureError",
            "KVPoolExhaustedError",
+           "Scenario", "SCENARIOS", "build_schedule", "run_scenario",
+           "check_report",
            "Config", "Predictor", "Tensor", "create_predictor",
            "PrecisionType", "PlaceType", "get_version"]
 

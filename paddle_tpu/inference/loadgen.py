@@ -1,0 +1,457 @@
+"""Deterministic open-loop traffic harness for the serving engine.
+
+reference capability: the reference validates its serving stack with ad
+hoc client scripts; load behaviour (tail latency under bursts, shed
+onset, SLO compliance at a target rate) is folklore. Here traffic is a
+SCENARIO: a named, seeded arrival process plus a distribution over
+prompt/output lengths, tenants and sampling knobs. `build_schedule`
+turns (scenario, seed) into an explicit arrival list — the same pair
+always yields byte-identical arrivals, so a load test is replayable
+evidence, not a weather report.
+
+The runner is OPEN LOOP: arrivals are issued by the schedule clock, not
+by completion of earlier requests, so overload actually overloads (a
+closed loop self-throttles and hides saturation — the coordinated-
+omission trap). Each clock tick passes the `serve.loadgen_tick` fault
+site; an injected failure models clock skew / a stalled driver — the
+tick is skipped and counted (`loadgen_ticks_skipped_total`) and its
+arrivals are re-issued on the next tick, because issuance is "everything
+scheduled at or before now", not "this tick's quantum".
+
+While driving the engine the runner samples a timeline: goodput, shed
+fraction, offered rate, and the capacity signal
+``headroom = 1 - offered_rate x predicted_service_seconds`` from the
+PIR cost model (pir/analysis.py CostModel, calibrated by the engine's
+first measured dispatch). The `slo_headroom` / `serving_overload`
+gauges therefore cross into alarm BEFORE goodput collapses — the
+leading indicator the SLO engine's burn rate (a trailing indicator)
+cannot provide. The run report carries per-scenario TTFT/TPOT quantiles
+(histogram bucket deltas over the run window), finish reasons, the
+phase accountant's attribution coverage, the predicted-vs-measured cost
+ratios, and an `SLOEngine` verdict.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+import time
+
+import numpy as np
+
+from ..observability.catalog import metric as _metric
+from ..observability.metrics import get_registry as _get_registry
+from ..observability.metrics import snapshot as _snapshot
+from ..observability.quantiles import quantiles_from_cumulative
+from ..observability.recorder import get_recorder as _get_recorder
+from ..observability.slo import SLOEngine
+from ..profiler.phases import get_phase_accountant as _get_phases
+from ..resilience.faults import fault_point
+from .serving import BackpressureError
+
+__all__ = ["Scenario", "SCENARIOS", "build_schedule", "run_scenario",
+           "check_report", "REPORT_FORMAT"]
+
+REPORT_FORMAT = 1
+
+# finish reasons that count as goodput (mirrors the availability SLO's
+# good set in observability/slo.py DEFAULT_SLOS)
+GOOD_REASONS = ("eos", "length")
+
+
+class Scenario:
+    """One named traffic shape: an arrival process (poisson rate, burst
+    trains, or a linear ramp) over a distribution of prompt/output
+    lengths, tenants (weighted), sampling knobs and deadlines. All
+    randomness is drawn from one seeded stream in build_schedule — a
+    Scenario itself is immutable configuration."""
+
+    __slots__ = ("name", "arrival", "rate_rps", "duration_s",
+                 "rate_end_rps", "burst_n", "burst_every_s",
+                 "prompt_len", "output_tokens", "tenants", "do_sample",
+                 "temperature", "top_k", "top_p", "deadline_s",
+                 "description")
+
+    def __init__(self, name, arrival="poisson", rate_rps=10.0,
+                 duration_s=1.0, rate_end_rps=None, burst_n=4,
+                 burst_every_s=0.25, prompt_len=(4, 16),
+                 output_tokens=(4, 12), tenants=(("-", 1.0),),
+                 do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+                 deadline_s=None, description=""):
+        if arrival not in ("poisson", "burst", "ramp"):
+            raise ValueError(f"unknown arrival process {arrival!r}")
+        self.name = str(name)
+        self.arrival = arrival
+        self.rate_rps = float(rate_rps)
+        self.duration_s = float(duration_s)
+        self.rate_end_rps = (None if rate_end_rps is None
+                             else float(rate_end_rps))
+        self.burst_n = int(burst_n)
+        self.burst_every_s = float(burst_every_s)
+        self.prompt_len = (int(prompt_len[0]), int(prompt_len[1]))
+        self.output_tokens = (int(output_tokens[0]), int(output_tokens[1]))
+        self.tenants = tuple((str(t), float(w)) for t, w in tenants)
+        self.do_sample = bool(do_sample)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self.description = str(description)
+
+
+# The scenario matrix. Sizes are tier-1-friendly (tens of requests on a
+# tiny model); production sweeps scale rate_rps/duration_s via the
+# run_scenario overrides without touching the distributions.
+SCENARIOS = {
+    "chat": Scenario(
+        "chat", arrival="poisson", rate_rps=20.0, duration_s=1.5,
+        prompt_len=(4, 24), output_tokens=(4, 12),
+        tenants=(("acme", 3.0), ("zee", 1.0), ("-", 1.0)),
+        deadline_s=10.0,
+        description="interactive chat: short prompts, short replies, "
+                    "Poisson arrivals, tight TTFT expectations"),
+    "long_document": Scenario(
+        "long_document", arrival="poisson", rate_rps=4.0, duration_s=1.5,
+        prompt_len=(32, 96), output_tokens=(4, 8),
+        tenants=(("lawfirm", 1.0), ("-", 1.0)), deadline_s=20.0,
+        description="long-document QA: chunked-prefill-heavy prompts, "
+                    "few output tokens"),
+    "offline_batch": Scenario(
+        "offline_batch", arrival="burst", rate_rps=16.0, duration_s=1.5,
+        burst_n=8, burst_every_s=0.5, prompt_len=(8, 32),
+        output_tokens=(8, 16), tenants=(("batch", 1.0),),
+        description="offline batch: burst trains (a queue worker "
+                    "flushing), throughput over latency, no deadlines"),
+    "structured_output": Scenario(
+        "structured_output", arrival="ramp", rate_rps=2.0,
+        rate_end_rps=24.0, duration_s=2.0, prompt_len=(6, 20),
+        output_tokens=(4, 10), tenants=(("jsonsvc", 1.0),),
+        do_sample=True, temperature=0.8, top_p=0.95, deadline_s=15.0,
+        description="structured-output extraction: sampled decode, "
+                    "arrival rate ramping into saturation"),
+}
+
+
+def _pick_tenant(rng, tenants):
+    names = [t for t, _ in tenants]
+    weights = [w for _, w in tenants]
+    return rng.choices(names, weights=weights, k=1)[0]
+
+
+def _arrival(scenario, rng, t):
+    lo, hi = scenario.prompt_len
+    olo, ohi = scenario.output_tokens
+    return {
+        "t": round(float(t), 6),
+        "prompt_len": rng.randint(lo, hi),
+        "output_tokens": rng.randint(olo, ohi),
+        "tenant": _pick_tenant(rng, scenario.tenants),
+        "prompt_seed": rng.randrange(1 << 30),
+        "sample_seed": rng.randrange(1 << 30),
+    }
+
+
+def build_schedule(scenario, seed=0, rate_rps=None, duration_s=None):
+    """(scenario, seed) -> ordered arrival list. Deterministic: one
+    `random.Random(f"{name}:{seed}")` stream drives inter-arrival gaps,
+    lengths, tenants and per-request seeds, so equal inputs produce an
+    equal schedule (test-pinned). `rate_rps`/`duration_s` override the
+    scenario's defaults (the overload-sweep knob)."""
+    if isinstance(scenario, str):
+        scenario = SCENARIOS[scenario]
+    rng = random.Random(f"{scenario.name}:{int(seed)}")
+    rate = float(rate_rps if rate_rps is not None else scenario.rate_rps)
+    dur = float(duration_s if duration_s is not None
+                else scenario.duration_s)
+    out = []
+    if scenario.arrival == "poisson":
+        t = 0.0
+        while True:
+            t += rng.expovariate(rate)
+            if t >= dur:
+                break
+            out.append(_arrival(scenario, rng, t))
+    elif scenario.arrival == "burst":
+        # burst trains: every burst_every_s a worker flushes burst_n
+        # requests nearly at once (small jitter keeps ordering honest)
+        t = 0.0
+        while t < dur:
+            for _ in range(scenario.burst_n):
+                out.append(_arrival(scenario, rng,
+                                    t + rng.uniform(0.0, 0.01)))
+            t += scenario.burst_every_s
+    else:   # ramp — Poisson thinning against the envelope rate
+        r_end = (scenario.rate_end_rps if scenario.rate_end_rps is not None
+                 else rate)
+        r_max = max(rate, r_end)
+        t = 0.0
+        while True:
+            t += rng.expovariate(r_max)
+            if t >= dur:
+                break
+            r_t = rate + (r_end - rate) * (t / dur)
+            if rng.random() < r_t / r_max:
+                out.append(_arrival(scenario, rng, t))
+    out.sort(key=lambda a: a["t"])
+    return out
+
+
+def schedule_digest(schedule):
+    """Stable content hash of a schedule — the replay check two runs
+    compare before trusting a latency diff."""
+    blob = json.dumps(schedule, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def _prompt_tokens(prompt_seed, length, vocab):
+    """Deterministic pseudo-prompt: a Weyl sequence over the vocab
+    (never token 0, so padding stays distinguishable)."""
+    lo, span = 1, max(1, int(vocab) - 1)
+    idx = np.arange(int(length), dtype=np.int64)
+    return ((int(prompt_seed) + idx * 2654435761) % span + lo).astype(
+        np.int32)
+
+
+# -- snapshot helpers (the slo.py windowing idea, localized) ---------------
+
+def _hist_cum(snapshot_doc, name):
+    """Histogram family -> merged {le: cumulative count} across label
+    children (per-tenant siblings roll up into the scenario view)."""
+    merged = {}
+    for m in snapshot_doc.get("metrics", []):
+        if m.get("name") != name:
+            continue
+        for s in m.get("samples", []):
+            for le, cum in s.get("buckets", []):
+                key = ("+Inf" if (isinstance(le, str) or le == float("inf"))
+                       else float(le))
+                merged[key] = merged.get(key, 0) + int(cum)
+    return merged
+
+
+def _hist_delta(new, old):
+    finite = sorted(k for k in new if k != "+Inf")
+    buckets = [(le, max(0, new.get(le, 0) - old.get(le, 0)))
+               for le in finite]
+    buckets.append(("+Inf", max(0, new.get("+Inf", 0)
+                                - old.get("+Inf", 0))))
+    return buckets
+
+
+def _quantile_block(snap0, snap1, name):
+    buckets = _hist_delta(_hist_cum(snap1, name), _hist_cum(snap0, name))
+    count = buckets[-1][1] if buckets else 0
+    qs = quantiles_from_cumulative(buckets)
+    return {"count": int(count),
+            "p50": qs.get(0.5), "p95": qs.get(0.95), "p99": qs.get(0.99)}
+
+
+def _gauge_samples(snapshot_doc, name):
+    out = {}
+    for m in snapshot_doc.get("metrics", []):
+        if m.get("name") != name:
+            continue
+        for s in m.get("samples", []):
+            labels = s.get("labels") or {}
+            key = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            out[key or "-"] = float(s.get("value", 0.0))
+    return out
+
+
+# -- the runner ------------------------------------------------------------
+
+def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
+                 max_wall_s=None, sample_every_s=0.2, slo_engine=None,
+                 drain=True):
+    """Drive `engine` with the scenario's schedule in real time; returns
+    the run report (REPORT_FORMAT). Open loop: every tick issues all
+    arrivals scheduled at or before now, then advances the engine one
+    step. `drain` keeps stepping after the last arrival until the engine
+    idles (False = stop at schedule end, for saturation sweeps where the
+    backlog would never drain)."""
+    if isinstance(scenario, str):
+        scenario = SCENARIOS[scenario]
+    schedule = build_schedule(scenario, seed, rate_rps=rate_rps,
+                              duration_s=duration_s)
+    dur = float(duration_s if duration_s is not None
+                else scenario.duration_s)
+    max_wall = float(max_wall_s) if max_wall_s is not None else dur + 30.0
+    vocab = int(engine.embed_w.shape[0])
+    mean_out = (sum(a["output_tokens"] for a in schedule)
+                / max(1, len(schedule)))
+
+    reg = _get_registry()
+    phases = _get_phases()
+    slo_eng = slo_engine if slo_engine is not None \
+        else SLOEngine(window_s=max_wall + 60.0)
+    snap0 = _snapshot(reg)
+    t0 = time.perf_counter()
+    slo_eng.observe(snap0, t0)
+
+    m_arrivals = _metric("loadgen_arrivals_total", scenario=scenario.name)
+    m_skipped = _metric("loadgen_ticks_skipped_total")
+    m_headroom = _metric("slo_headroom")
+    m_overload = _metric("serving_overload")
+
+    idx = 0
+    issued = 0
+    rejected = 0
+    ticks = 0
+    ticks_skipped = 0
+    offered_t = []      # schedule-clock time of every issue ATTEMPT
+    timeline = []
+    next_sample = 0.0
+    headroom_floor = None
+
+    def sample(now):
+        nonlocal headroom_floor
+        fin = engine.finished
+        done = len(fin)
+        good = sum(1 for r in fin.values()
+                   if r.finish_reason in GOOD_REASONS)
+        sheds = rejected + sum(1 for r in fin.values()
+                               if r.finish_reason == "shed")
+        attempts = issued + rejected
+        shed_frac = sheds / attempts if attempts else 0.0
+        # trailing offered rate (the open-loop demand, rejected included)
+        win = 0.5
+        recent = sum(1 for ta in offered_t if ta > now - win)
+        rate = recent / min(win, now) if now > 0 else 0.0
+        svc = engine.predicted_service_seconds(
+            output_tokens=max(1, int(round(mean_out))))
+        headroom = None if svc is None else 1.0 - rate * svc
+        if headroom is not None:
+            m_headroom.set(headroom)
+            m_overload.set(1.0 if headroom <= 0.0 else 0.0)
+            headroom_floor = (headroom if headroom_floor is None
+                              else min(headroom_floor, headroom))
+        timeline.append({
+            "t": round(now, 4), "issued": issued, "rejected": rejected,
+            "finished": done, "good": good, "shed_frac": round(
+                shed_frac, 4),
+            "offered_rps": round(rate, 2),
+            "service_s": svc, "headroom": headroom,
+        })
+
+    while True:
+        now = time.perf_counter() - t0
+        ticks += 1
+        try:
+            fault_point("serve.loadgen_tick", scenario=scenario.name)
+        except Exception:   # noqa: BLE001 — clock skew model: skip + count
+            ticks_skipped += 1
+            m_skipped.inc()
+            continue        # arrivals with t <= now re-issue next tick
+        while idx < len(schedule) and schedule[idx]["t"] <= now:
+            a = schedule[idx]
+            idx += 1
+            offered_t.append(now)
+            prompt = _prompt_tokens(a["prompt_seed"], a["prompt_len"],
+                                    vocab)
+            try:
+                engine.add_request(
+                    prompt, max_new_tokens=a["output_tokens"],
+                    do_sample=scenario.do_sample,
+                    temperature=scenario.temperature,
+                    top_k=scenario.top_k, top_p=scenario.top_p,
+                    seed=a["sample_seed"],
+                    deadline_s=scenario.deadline_s, tenant=a["tenant"])
+                issued += 1
+                m_arrivals.inc()
+            except BackpressureError:
+                rejected += 1
+        if engine.has_work():
+            engine.step()
+        elif idx < len(schedule):
+            # ahead of the schedule: yield briefly instead of spinning
+            time.sleep(min(0.002,
+                           max(0.0, schedule[idx]["t"] - now)))
+        if now >= next_sample:
+            sample(now)
+            next_sample = now + float(sample_every_s)
+        if idx >= len(schedule) and not (drain and engine.has_work()):
+            break
+        if now > max_wall:
+            break
+
+    t1 = time.perf_counter()
+    sample(t1 - t0)
+    snap1 = _snapshot(reg)
+    slo_eng.observe(snap1, t1)
+    verdict = slo_eng.evaluate(emit=True)
+
+    finished = {}
+    tenants = {}
+    for r in engine.finished.values():
+        finished[r.finish_reason] = finished.get(r.finish_reason, 0) + 1
+        trow = tenants.setdefault(r.tenant, {"finished": 0, "good": 0})
+        trow["finished"] += 1
+        trow["good"] += int(r.finish_reason in GOOD_REASONS)
+    total_done = sum(finished.values())
+    good = sum(finished.get(rn, 0) for rn in GOOD_REASONS)
+
+    phases_report = phases.report() if phases.enabled else None
+    cost = {"programs": engine.predicted_costs(),
+            "ratio": _gauge_samples(snap1, "pir_cost_ratio")}
+
+    report = {
+        "format": REPORT_FORMAT,
+        "scenario": scenario.name,
+        "seed": int(seed),
+        "schedule": {"arrivals": len(schedule),
+                     "digest": schedule_digest(schedule),
+                     "duration_s": dur,
+                     "mean_output_tokens": round(mean_out, 2)},
+        "wall_s": round(t1 - t0, 4),
+        "issued": issued,
+        "rejected": rejected,
+        "ticks": ticks,
+        "ticks_skipped": ticks_skipped,
+        "finished": finished,
+        "goodput": round(good / total_done, 4) if total_done else None,
+        "goodput_rps": round(good / (t1 - t0), 2),
+        "shed": finished.get("shed", 0) + rejected,
+        "timeouts": finished.get("timeout", 0),
+        "ttft": _quantile_block(snap0, snap1, "serving_ttft_seconds"),
+        "tpot": _quantile_block(snap0, snap1, "serving_tpot_seconds"),
+        "tenants": tenants,
+        "slo": verdict,
+        "phases": phases_report,
+        "coverage": (phases_report or {}).get("coverage"),
+        "cost": cost,
+        "headroom_floor": headroom_floor,
+        "timeline": timeline,
+    }
+    rec = _get_recorder()
+    if rec.enabled:
+        rec.record("profile", scenario=scenario.name, seed=int(seed),
+                   issued=issued, goodput=report["goodput"],
+                   coverage=report["coverage"],
+                   slo_ok=verdict.get("ok"))
+    return report
+
+
+def check_report(report, min_coverage=0.95):
+    """Acceptance gate over a run report -> list of problems (empty =
+    pass). Checked: an SLO verdict exists, phase attribution covers at
+    least `min_coverage` of engine wall time, and the cost model priced
+    at least one dispatched program (predicted-vs-measured gauge is
+    populated)."""
+    problems = []
+    slo_v = report.get("slo")
+    if not isinstance(slo_v, dict) or "ok" not in slo_v:
+        problems.append("no SLO verdict in report")
+    cov = report.get("coverage")
+    if cov is None:
+        problems.append("no phase-attribution coverage "
+                        "(profiler disabled?)")
+    elif cov < float(min_coverage):
+        problems.append(f"phase attribution coverage {cov:.3f} "
+                        f"< {min_coverage}")
+    if not report.get("cost", {}).get("ratio"):
+        problems.append("pir_cost_ratio gauge not populated "
+                        "(no measured dispatch priced)")
+    if not report.get("issued"):
+        problems.append("no requests issued")
+    return problems

@@ -59,6 +59,7 @@ from ..ops.paged_attention import (KVBlockFormat, kv_rollback_tokens,
                                    kv_write_token, kv_write_tokens,
                                    paged_attention_decode_inner,
                                    paged_attention_verify, write_to_cache)
+from ..profiler.phases import get_phase_accountant as _get_phases
 from ..resilience.faults import FaultInjected, fault_point
 
 __all__ = ["ContinuousBatchingEngine", "Request", "BackpressureError",
@@ -87,12 +88,16 @@ class Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
                  "generated", "done", "do_sample", "temperature", "top_k",
                  "top_p", "rng", "sample_seed", "t_arrival", "deadline_s",
-                 "t_deadline", "finish_reason", "shed_count", "trace_id")
+                 "t_deadline", "finish_reason", "shed_count", "trace_id",
+                 "tenant")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                 seed=None, deadline_s=None):
+                 seed=None, deadline_s=None, tenant="-"):
         self.rid = rid
+        # per-tenant telemetry label; "-" = unattributed (the default
+        # keeps every pre-tenant caller's label sets unchanged)
+        self.tenant = str(tenant) if tenant else "-"
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
@@ -225,10 +230,10 @@ class _Inflight:
     tile was in flight."""
 
     __slots__ = ("tile", "t_dispatch", "reqs", "epochs", "k", "covers_all",
-                 "tile_id", "spec")
+                 "tile_id", "spec", "key")
 
     def __init__(self, tile, t_dispatch, reqs, epochs, k, covers_all,
-                 tile_id=0, spec=False):
+                 tile_id=0, spec=False, key=None):
         self.tile = tile
         self.t_dispatch = t_dispatch
         self.reqs = reqs
@@ -240,6 +245,7 @@ class _Inflight:
         # instead of a [B, K] array; per-tile, not per-engine, so tiles
         # dispatched before a speculation-off degradation drain correctly
         self.spec = spec
+        self.key = key      # compile_reports key of the dispatched program
 
 
 class ContinuousBatchingEngine:
@@ -419,14 +425,30 @@ class ContinuousBatchingEngine:
         self._reg = _get_registry()
         self._rec = _get_recorder()
         self._tile_seq = 0              # decode tile ids for span links
+        # per-phase wall-time accountant (profiler/phases.py): every
+        # mutation is disabled-noop, so the engine marks unconditionally
+        self._phases = _get_phases()
+        # bounded-cardinality tenant label set: past the cap new tenants
+        # collapse to "overflow" so a label-per-user bug cannot blow up
+        # the registry (MAX_LABEL_SETS)
+        self._tenants: set[str] = set()
+        self._max_tenants = 32
+        # cost-model calibration: raw roofline seconds are TPU-ledger
+        # priced; the first measured dispatch fixes the platform +
+        # overhead scale so later predicted-vs-measured ratios are
+        # relative-accuracy signals on any backend
+        self._cost_scale = None
+        self._m_cost_err = _metric("pir_cost_model_error")
 
     # --- public API -------------------------------------------------------
     def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
                     do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                    seed=0, deadline_s=None):
+                    seed=0, deadline_s=None, tenant="-"):
         """Queue a request. `deadline_s` is a per-request wall-clock
         budget from arrival: once exceeded the request finishes with
-        whatever it has and finish_reason='timeout'. Raises
+        whatever it has and finish_reason='timeout'. `tenant` labels the
+        request's per-tenant telemetry (bounded cardinality; unknown
+        tenants past the cap collapse to 'overflow'). Raises
         BackpressureError when the admission queue is at max_queue."""
         if self.max_queue is not None and len(self.queue) >= self.max_queue:
             _metric("serving_backpressure_total").inc()
@@ -436,11 +458,17 @@ class ContinuousBatchingEngine:
             raise BackpressureError(
                 f"admission queue full ({len(self.queue)}/{self.max_queue}); "
                 "retry later")
+        tenant = str(tenant) if tenant else "-"
+        if tenant != "-" and tenant not in self._tenants:
+            if len(self._tenants) >= self._max_tenants:
+                tenant = "overflow"
+            else:
+                self._tenants.add(tenant)
         rid = self._next_rid
         self._next_rid += 1
         req = Request(rid, prompt, max_new_tokens, eos_token_id,
                       do_sample, temperature, top_k, top_p,
-                      seed, deadline_s)
+                      seed, deadline_s, tenant=tenant)
         self.queue.append(req)
         if self._tracer.enabled:
             # root of the request's span tree (instant: arrival moment)
@@ -463,15 +491,19 @@ class ContinuousBatchingEngine:
 
     # --- scheduling -------------------------------------------------------
     def step(self):
+        ph = self._phases
+        ph.begin_step()
         with _span("serving.step"):
             self._expire_deadlines()
             self._m_queue.set(len(self.queue))
             self._admit()
+            ph.mark("admit")
             self._run_prefill_tasks()
             self._decode_phase()
             self._m_occ.set(sum(r is not None for r in self.lanes)
                             / self.max_batch)
             self._m_free.set(len(self.pool._free))
+        ph.end_step()
 
     def _decode_active(self):
         """Lanes the fused decode advances: occupied AND past prefill."""
@@ -488,6 +520,8 @@ class ContinuousBatchingEngine:
         req.finish_reason = reason
         self.finished[req.rid] = req
         _metric("serving_finished_total", reason=reason).inc()
+        _metric("serving_tenant_finished_total",
+                tenant=req.tenant, reason=reason).inc()
         if self._tracer.enabled:
             self._tracer.add_span("request.finish",
                                   time.perf_counter_ns(), 0,
@@ -712,6 +746,7 @@ class ContinuousBatchingEngine:
                          name=f"serving.prefill.b{width}")
             self._prefill_jit[width] = fn
             self.compile_reports[f"prefill.b{width}"] = None
+        cold = fn._compiled is None     # first call traces + compiles
         n_real = min(width, s - start)
         ids = np.zeros((1, width), np.int32)
         ids[0, :n_real] = req.prompt[start:start + n_real]
@@ -737,6 +772,11 @@ class ContinuousBatchingEngine:
         dt = time.perf_counter() - t0
         self._m_prefill.observe(dt)
         self._m_chunks.inc()
+        if self._phases.enabled:
+            self._phases.mark("compile" if cold else "prefill.chunk",
+                              tenant=req.tenant)
+        if not cold:        # a cold call's wall is compile, not the program
+            self._cost_observe(f"prefill.b{width}", dt)
         if self._tracer.enabled:
             self._tracer.add_span(
                 "request.prefill.chunk", int(t0 * 1e9), int(dt * 1e9),
@@ -760,8 +800,10 @@ class ContinuousBatchingEngine:
         self._m_admitted.inc()
         # the exemplar ties this observation's bucket to the exact trace
         # that produced it (bad p99 -> exact request)
-        self._m_ttft.observe(time.perf_counter() - req.t_arrival,
-                             exemplar=req.trace_id)
+        ttft = time.perf_counter() - req.t_arrival
+        self._m_ttft.observe(ttft, exemplar=req.trace_id)
+        _metric("serving_tenant_ttft_seconds",
+                tenant=req.tenant).observe(ttft)
         self._emit(lane, first_tok)
         return True
 
@@ -813,6 +855,7 @@ class ContinuousBatchingEngine:
                 return
         if self._dirty or self._dev is None:
             self._upload_lane_state(active)
+            self._phases.mark("lane_upload")
         t0 = time.perf_counter()
         try:
             fault_point("serve.decode_oom", active=len(active))
@@ -847,9 +890,13 @@ class ContinuousBatchingEngine:
                 for i in range(self.max_batch)]
         tile_id = self._tile_seq
         self._tile_seq += 1
+        d_variant = self._dev["variant"]
+        key = ("decode" + (".sampled" if d_variant.startswith("sampled")
+                           else "") + (".spec" if d_variant.endswith(".spec")
+                                       else ""))
         self._inflight.append(_Inflight(
             tile, t0, snap, self._lane_epoch.copy(), K, covers_all,
-            tile_id, spec=isinstance(tile, tuple)))
+            tile_id, spec=isinstance(tile, tuple), key=key))
         if self._rec.enabled:
             self._rec.record("dispatch", tile=tile_id, lanes=list(active),
                              epochs=[int(self._lane_epoch[i])
@@ -904,6 +951,7 @@ class ContinuousBatchingEngine:
         sampled = variant.startswith("sampled")
         quant = self.pool.fmt.quantized
         fn = self._decode_jit.get(variant)
+        cold = fn is None or fn._compiled is None
         if fn is None:
             # decode keeps donation (the KV pools must not double-buffer),
             # so the pipeline runs but the artifact store is bypassed
@@ -955,6 +1003,7 @@ class ContinuousBatchingEngine:
                     f"verifier and fell back to plain jax.jit; see "
                     f"pir_verify_failures_total{{rule}} for the rule",
                     RuntimeWarning, stacklevel=2)
+        self._phases.mark("compile" if cold else "decode.dispatch")
         return tile
 
     def _drain_all(self):
@@ -971,6 +1020,7 @@ class ContinuousBatchingEngine:
         try:
             fault_point("serve.hostsync_read")
             t0 = time.perf_counter()
+            self._phases.mark("decode.readback")
             if infl.spec:
                 arr = (np.asarray(infl.tile[0]), np.asarray(infl.tile[1]))
             else:
@@ -991,6 +1041,8 @@ class ContinuousBatchingEngine:
         t1 = time.perf_counter()
         self._inflight.popleft()
         self._m_hostsync.observe(t1 - t0)
+        self._phases.mark("hostsync")
+        self._cost_observe(infl.key, t1 - infl.t_dispatch)
         # one fused dispatch advances every active lane K tokens, so the
         # dispatch->readback wall time over K IS the per-token latency.
         # Exemplar: the first live lane's trace id stands for the tile
@@ -1002,8 +1054,12 @@ class ContinuousBatchingEngine:
                     ex = r.trace_id
                     break
         if not infl.spec:
-            self._m_tpot.observe((t1 - infl.t_dispatch) / infl.k,
-                                 exemplar=ex)
+            per_tok = (t1 - infl.t_dispatch) / infl.k
+            self._m_tpot.observe(per_tok, exemplar=ex)
+            for t in sorted({r.tenant for r in infl.reqs
+                             if r is not None and not r.done}):
+                _metric("serving_tenant_tpot_seconds",
+                        tenant=t).observe(per_tok)
         if self._rec.enabled:
             self._rec.record("readback", tile=infl.tile_id,
                              wait_ms=round((t1 - t0) * 1e3, 3))
@@ -1013,6 +1069,14 @@ class ContinuousBatchingEngine:
             self._process_tile_spec(arr[0], arr[1], infl, t1, ex)
         else:
             self._process_tile(arr, infl)
+        ph = self._phases
+        if ph.enabled:
+            # token crediting/emission since the hostsync mark is the
+            # commit phase; the tile's device time splits evenly across
+            # the tenants it served (one dispatch advances all lanes)
+            ph.mark("commit")
+            tenants = sorted({r.tenant for r in infl.reqs if r is not None})
+            ph.credit_tenants(tenants, t1 - infl.t_dispatch)
         return True
 
     def _trace_tile(self, infl, t1):
@@ -1038,6 +1102,61 @@ class ContinuousBatchingEngine:
             "serving.decode_tile", t0_ns, dur_ns,
             args={"tile": infl.tile_id, "k": infl.k},
             links=links or None)
+
+    # --- static cost model (pir/analysis.py CostModel) --------------------
+    def _cost_observe(self, key, dt):
+        """Predicted-vs-measured cost of one dispatch of the program
+        compile_reports[key]. The FIRST measured dispatch calibrates the
+        platform scale (its ratio is 1.0 by construction); every later
+        one updates the per-program ratio gauge and the pooled error
+        histogram whose exemplar carries the worst-predicted program."""
+        rep = self.compile_reports.get(key)
+        cost = getattr(rep, "cost", None)
+        if cost is None or cost.raw_seconds <= 0 or dt <= 0:
+            return
+        if self._cost_scale is None:
+            self._cost_scale = dt / cost.raw_seconds
+        ratio = dt / (cost.raw_seconds * self._cost_scale)
+        _metric("pir_cost_ratio", program=key).set(ratio)
+        self._m_cost_err.observe(ratio, exemplar=key)
+
+    def predicted_costs(self):
+        """{program key: {flops, bytes, raw_seconds, seconds}} for every
+        compiled program with a stamped ProgramCost; `seconds` is the
+        calibrated prediction (None until a dispatch calibrated the
+        scale). The loadgen harness derives its slo_headroom capacity
+        signal from this."""
+        out = {}
+        for key, rep in self.compile_reports.items():
+            cost = getattr(rep, "cost", None)
+            if cost is None:
+                continue
+            out[key] = {"flops": cost.flops, "bytes": cost.bytes,
+                        "raw_seconds": cost.raw_seconds,
+                        "seconds": (cost.raw_seconds * self._cost_scale
+                                    if self._cost_scale else None)}
+        return out
+
+    def predicted_service_seconds(self, output_tokens=32):
+        """Calibrated engine seconds one request of `output_tokens`
+        consumes: its share of the fused decode dispatches (a tile
+        advances all max_batch lanes together) plus one prefill chunk.
+        None until the cost model is calibrated — callers fall back to
+        measured throughput."""
+        if self._cost_scale is None:
+            return None
+        costs = self.predicted_costs()
+        decode = next((c for k, c in sorted(costs.items())
+                       if k.startswith("decode")), None)
+        if decode is None or decode["seconds"] is None:
+            return None
+        t = (output_tokens / self.decode_steps) \
+            * decode["seconds"] / self.max_batch
+        prefill = next((c for k, c in sorted(costs.items())
+                        if k.startswith("prefill")), None)
+        if prefill is not None and prefill["seconds"] is not None:
+            t += prefill["seconds"]
+        return t
 
     def _process_tile(self, tile, infl):
         """Credit a [B, K] token tile: walk each lane's K tokens with the
@@ -1112,8 +1231,11 @@ class ContinuousBatchingEngine:
         # effective per-token latency: the dispatch->readback wall over
         # the tokens one lane actually committed (> K with acceptance)
         eff = credited / max(1, lanes_credited)
-        self._m_tpot.observe((t1 - infl.t_dispatch) / max(1.0, eff),
-                             exemplar=ex)
+        per_tok = (t1 - infl.t_dispatch) / max(1.0, eff)
+        self._m_tpot.observe(per_tok, exemplar=ex)
+        for t in sorted({r.tenant for r in infl.reqs
+                         if r is not None and not r.done}):
+            _metric("serving_tenant_tpot_seconds", tenant=t).observe(per_tok)
 
     # --- device-resident lane state ---------------------------------------
     def _upload_lane_state(self, active):

@@ -24,6 +24,11 @@ _STEP_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                  1.0, 2.5, 5.0, 15.0, 60.0)
 # ratio buckets (0..1) — acceptance rates and other fractions
 _RATE_BUCKETS = (0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0)
+# phase segments span sub-ms marks to multi-second cold compiles
+_PHASE_BUCKETS = (0.00005, 0.0002, 0.001, 0.005, 0.025, 0.1, 0.5, 2.5,
+                  10.0, 60.0)
+# measured/predicted cost ratios, log-ish around the ideal 1.0
+_COST_RATIO_BUCKETS = (0.1, 0.2, 0.5, 0.8, 1.0, 1.25, 2.0, 5.0, 10.0)
 
 CATALOG = {
     # -- serving (inference/serving.py ContinuousBatchingEngine) ------------
@@ -125,6 +130,32 @@ CATALOG = {
         "(speculation_off: draft/verify fault -> non-speculative decode; "
         "kv_bf16: dequant fault -> pool dequantized to the native dtype)",
         ("what",), None),
+    "serving_phase_seconds": (
+        "histogram", "one phase-attributed segment of engine step wall "
+        "time, by profiler phase (closed registry in "
+        "paddle_tpu/profiler/phases.py; segments partition the step)",
+        ("phase",), _PHASE_BUCKETS),
+    "serving_phase_coverage_ratio": (
+        "gauge", "cumulative phase-attributed time / measured engine "
+        "step wall time (0..1); the harness gates on >= 0.95", (), None),
+    "serving_tenant_ttft_seconds": (
+        "histogram", "per-tenant time to first token (bounded-cardinality "
+        "sibling of serving_ttft_seconds; unattributed tenant is '-', "
+        "overflow past the cap collapses to 'overflow')",
+        ("tenant",), _TTFT_BUCKETS),
+    "serving_tenant_tpot_seconds": (
+        "histogram", "per-tenant per-token decode latency "
+        "(bounded-cardinality sibling of serving_tpot_seconds)",
+        ("tenant",), _TPOT_BUCKETS),
+    "serving_tenant_finished_total": (
+        "counter", "requests finished, by tenant and finish_reason "
+        "(bounded-cardinality sibling of serving_finished_total)",
+        ("tenant", "reason"), None),
+    "serving_overload": (
+        "gauge", "1.0 while the engine is saturated (predicted service "
+        "demand exceeds capacity: slo_headroom <= 0), else 0.0 — the "
+        "shed-before-collapse early-warning the loadgen harness asserts "
+        "on", (), None),
 
     # -- generation (generation.py) -----------------------------------------
     "generation_requests_total": (
@@ -233,6 +264,15 @@ CATALOG = {
     "compile_cache_bytes": (
         "gauge", "compile-cache directory size after the last write",
         (), None),
+    "pir_cost_ratio": (
+        "gauge", "measured / roofline-predicted wall time of the last "
+        "dispatch of the named compiled program (pir/analysis.py "
+        "CostModel; 1.0 = the static price was exact)", ("program",), None),
+    "pir_cost_model_error": (
+        "histogram", "measured/predicted cost ratio per dispatch, all "
+        "programs pooled; the exemplar carries the PROGRAM NAME, so the "
+        "top bucket's exemplar names the worst-predicted program",
+        (), _COST_RATIO_BUCKETS),
 
     # -- telemetry loop (tracing ring, flight recorder, SLO engine) ----------
     "tracer_dropped_spans_total": (
@@ -248,6 +288,21 @@ CATALOG = {
         "gauge", "error-budget burn rate of the named SLO (1.0 = burning "
         "exactly the budget; >1 exhausts it early); for quantile SLOs, "
         "observed/target ratio", ("slo",), None),
+    "slo_headroom": (
+        "gauge", "remaining serving capacity as a fraction of capacity: "
+        "1 - arrival_rate * predicted_seconds_per_request (cost-model "
+        "calibrated); <= 0 means offered load exceeds what the engine "
+        "can serve and goodput will collapse unless load sheds", (), None),
+
+    # -- load generator (inference/loadgen.py + tools/loadgen.py) ------------
+    "loadgen_arrivals_total": (
+        "counter", "requests injected by the open-loop traffic harness, "
+        "by scenario", ("scenario",), None),
+    "loadgen_ticks_skipped_total": (
+        "counter", "harness clock ticks skipped after a "
+        "serve.loadgen_tick fault (arrivals from the skipped tick are "
+        "re-issued on the next one — open-loop schedule preserved)",
+        (), None),
 
     # -- bench orchestration (bench.py parent; stage = probe/configN/...) ----
     "bench_attempts_total": (

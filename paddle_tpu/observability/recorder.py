@@ -58,6 +58,8 @@ EVENT_KINDS = {
                "(speculation_off | kv_bf16) after a fault",
     "error": "unhandled error captured by a crash handler",
     "note": "free-form marker (drills, tests)",
+    "profile": "profiler/loadgen summary (phase coverage, scenario, "
+               "goodput) recorded at the end of a harness run",
 }
 
 

@@ -37,7 +37,8 @@ from .ir import Operation, Program, Value
 
 __all__ = ["Lattice", "FlatLattice", "DataflowAnalysis",
            "ShapeDtypeInference", "Liveness", "ShardingConsistency",
-           "DonationHazard", "check_donation_safety", "CONFLICT"]
+           "DonationHazard", "check_donation_safety", "CONFLICT",
+           "CostModel", "ProgramCost", "OpCost", "DEFAULT_ROOFLINE"]
 
 
 class _Conflict:
@@ -315,6 +316,193 @@ def check_donation_safety(prog: Program, donate_argnums) -> list:
 # --------------------------------------------------------------------------
 # sharding-annotation consistency
 # --------------------------------------------------------------------------
+
+# --------------------------------------------------------------------------
+# static cost model (FLOPs / bytes / roofline seconds)
+# --------------------------------------------------------------------------
+
+# PR 1 hardware ledger numbers (ops/pallas/attention_router.py _PROXY /
+# attention_ledger.json, TPU v5 lite): peak dense throughput, the
+# measured dense-matmul efficiency fraction, and HBM bandwidth. Kept as
+# a literal so the analysis stays importable without the router.
+DEFAULT_ROOFLINE = {
+    "peak_flops": 197e12,
+    "efficiency": 0.068,
+    "hbm_bps": 820e9,
+}
+
+_DTYPE_BYTES = {
+    "float64": 8, "int64": 8, "uint64": 8, "complex64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1, "float8_e4m3fn": 1,
+    "float8_e5m2": 1,
+}
+
+
+def _numel(shape):
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _aval_bytes(aval):
+    return _numel(getattr(aval, "shape", ())) \
+        * _DTYPE_BYTES.get(str(getattr(aval, "dtype", "float32")), 4)
+
+
+def _inner_jaxprs(params):
+    """Closed/open jaxprs nested in an eqn's params (scan's `jaxpr`,
+    while's cond/body, pjit's `jaxpr`, custom-call `call_jaxpr`, ...)."""
+    found = []
+    for v in params.values():
+        inner = getattr(v, "jaxpr", None)      # ClosedJaxpr
+        if inner is not None and hasattr(inner, "eqns"):
+            found.append(inner)
+        elif hasattr(v, "eqns"):               # bare Jaxpr
+            found.append(v)
+    return found
+
+
+def _jaxpr_cost(jaxpr, depth=0):
+    """(flops, bytes) for one jaxpr body; recurses into control-flow
+    primitives (scan multiplied by its trip count)."""
+    flops = 0.0
+    nbytes = 0.0
+    if depth > 8:           # pathological nesting: stop pricing, stay finite
+        return flops, nbytes
+    for eqn in jaxpr.eqns:
+        f, b = _eqn_cost(eqn, depth)
+        flops += f
+        nbytes += b
+    return flops, nbytes
+
+
+def _eqn_cost(eqn, depth=0):
+    name = eqn.primitive.name
+    out_elems = sum(_numel(getattr(ov.aval, "shape", ()))
+                    for ov in eqn.outvars)
+    io_bytes = float(sum(_aval_bytes(iv.aval) for iv in eqn.invars
+                         if hasattr(iv, "aval"))
+                     + sum(_aval_bytes(ov.aval) for ov in eqn.outvars))
+    inner = _inner_jaxprs(eqn.params)
+    if inner:
+        trips = float(eqn.params.get("length", 1) or 1)
+        f = b = 0.0
+        for j in inner:
+            jf, jb = _jaxpr_cost(j, depth + 1)
+            f += jf
+            b += jb
+        return f * trips, b * trips
+    if name == "dot_general":
+        try:
+            (lc, _rc), _batch = eqn.params["dimension_numbers"]
+            lhs_shape = eqn.invars[0].aval.shape
+            k = _numel([lhs_shape[d] for d in lc])
+            first_out = _numel(eqn.outvars[0].aval.shape)
+            return 2.0 * first_out * k, io_bytes
+        except Exception:  # noqa: BLE001 — odd dnums: elementwise floor
+            pass
+    if name in ("conv_general_dilated",):
+        # not emitted by the llama stack; price as heavy elementwise
+        return 10.0 * out_elems, io_bytes
+    return float(out_elems), io_bytes
+
+
+class OpCost:
+    __slots__ = ("flops", "bytes")
+
+    def __init__(self, flops=0.0, bytes=0.0):
+        self.flops = float(flops)
+        self.bytes = float(bytes)
+
+    def __repr__(self):
+        return f"OpCost(flops={self.flops:.3g}, bytes={self.bytes:.3g})"
+
+
+class ProgramCost:
+    """Aggregate static price of one compiled program, stamped on its
+    CompileReport so every dispatch carries predicted-vs-measured cost.
+    ``raw_seconds`` is the uncalibrated roofline estimate; callers apply
+    a measured calibration scale (platform + overhead) on top."""
+
+    __slots__ = ("name", "flops", "bytes", "raw_seconds", "per_op")
+
+    def __init__(self, name, flops, bytes, raw_seconds, per_op):
+        self.name = name
+        self.flops = flops
+        self.bytes = bytes
+        self.raw_seconds = raw_seconds
+        self.per_op = per_op        # [(op name, OpCost)] heaviest-first
+
+    def summary(self):
+        return {"name": self.name, "flops": self.flops,
+                "bytes": self.bytes, "raw_seconds": self.raw_seconds,
+                "top_ops": [(n, c.flops, c.bytes)
+                            for n, c in self.per_op[:5]]}
+
+    def __repr__(self):
+        return (f"ProgramCost({self.name!r}, {self.flops:.3g} flops, "
+                f"{self.bytes:.3g} B, {self.raw_seconds:.3g}s raw)")
+
+
+class CostModel(DataflowAnalysis):
+    """Forward pricing pass: facts map id(op) -> OpCost computed from
+    the op's stamped operand/result types (eqn-backed ops price from
+    their jaxpr avals, control flow recursively with scan trip counts;
+    fused ``pt.*`` ops are priced memory-bound from value byte traffic).
+    ``analyze`` folds the facts into a ProgramCost with a roofline time
+    estimate t = max(flops / (peak * eff), bytes / hbm_bps)."""
+
+    direction = "forward"
+    name = "cost"
+
+    def __init__(self, roofline=None):
+        self.roofline = dict(DEFAULT_ROOFLINE)
+        if roofline:
+            self.roofline.update(roofline)
+
+    @staticmethod
+    def _value_bytes(values):
+        return float(sum(
+            _numel(v.shape) * _DTYPE_BYTES.get(str(v.dtype), 4)
+            for v in values))
+
+    def _op_cost(self, op: Operation) -> OpCost:
+        try:
+            if op.eqn is not None:
+                f, b = _eqn_cost(op.eqn)
+                return OpCost(f, b)
+        except Exception:  # noqa: BLE001 — never fail a compile over pricing
+            pass
+        # fused pt.* op (or unpriceable eqn): memory-bound estimate from
+        # the stamped value types; 2 flops/output element keeps the
+        # compute axis populated
+        out_b = self._value_bytes(op.outputs)
+        in_b = self._value_bytes(op.inputs)
+        out_elems = sum(_numel(v.shape) for v in op.outputs)
+        return OpCost(2.0 * out_elems, in_b + out_b)
+
+    def transfer(self, op: Operation, facts: dict) -> bool:
+        if id(op) in facts:
+            return False
+        facts[id(op)] = self._op_cost(op)
+        return True
+
+    def analyze(self, prog: Program) -> ProgramCost:
+        facts = self.run(prog)
+        flops = sum(c.flops for c in facts.values())
+        nbytes = sum(c.bytes for c in facts.values())
+        eff_flops = self.roofline["peak_flops"] * self.roofline["efficiency"]
+        raw = max(flops / eff_flops if eff_flops > 0 else 0.0,
+                  nbytes / self.roofline["hbm_bps"]
+                  if self.roofline["hbm_bps"] > 0 else 0.0)
+        per_op = sorted(
+            ((op.name, facts[id(op)]) for op in prog.ops),
+            key=lambda nc: -(nc[1].flops + nc[1].bytes))
+        return ProgramCost(prog.name, flops, nbytes, raw, per_op)
+
 
 class ShardingConsistency(DataflowAnalysis):
     """Forward propagation of optional ``Value.sharding`` annotations

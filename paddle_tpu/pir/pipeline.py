@@ -36,7 +36,8 @@ class CompileReport:
     StaticFunction/_PirJit for tests, bench rows and the IR dump tool."""
 
     __slots__ = ("name", "key", "cache", "pass_report", "program",
-                 "captured_ops", "final_ops", "pattern_counts", "fallback")
+                 "captured_ops", "final_ops", "pattern_counts", "fallback",
+                 "cost")
 
     def __init__(self, name):
         self.name = name
@@ -48,6 +49,7 @@ class CompileReport:
         self.final_ops = 0
         self.pattern_counts = {}
         self.fallback = None        # stage name when pir fell back
+        self.cost = None            # analysis.ProgramCost of the final IR
 
     def summary(self) -> dict:
         return {"name": self.name, "cache": self.cache,
@@ -57,6 +59,7 @@ class CompileReport:
                 "passes": {k: {"edits": v["edits"],
                                "seconds": round(v["seconds"], 6)}
                            for k, v in self.pass_report.items()},
+                "cost": self.cost.summary() if self.cost else None,
                 "fallback": self.fallback}
 
 
@@ -106,6 +109,11 @@ def compile_flat(flat_fn: Callable, flat_args: list, *, name: str,
         report.pass_report = pm.run(prog)
         report.final_ops = prog.num_ops()
         report.program = prog
+        try:
+            from .analysis import CostModel
+            report.cost = CostModel().analyze(prog)
+        except Exception:  # noqa: BLE001 — pricing may never cost a compile
+            report.cost = None
         pat = report.pass_report.get("pattern", {})
         report.pattern_counts = dict(
             p.split("=") for p in (pat.get("notes") or "").split()

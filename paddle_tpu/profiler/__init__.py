@@ -18,10 +18,12 @@ import time
 import jax
 
 from ..observability.tracing import get_tracer as _host_tracer
+from .phases import PHASES, PhaseAccountant, get_phase_accountant
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "SortedKeys", "SummaryView", "benchmark"]
+           "SortedKeys", "SummaryView", "benchmark",
+           "PHASES", "PhaseAccountant", "get_phase_accountant"]
 
 
 class ProfilerState(enum.Enum):

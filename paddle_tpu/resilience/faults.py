@@ -71,6 +71,10 @@ FAULT_SITES = {
                         "pool to the native dtype once and drops the "
                         "quantized block format for the engine's "
                         "lifetime)",
+    "serve.loadgen_tick": "traffic harness: one open-loop clock tick "
+                          "(injected failure models clock skew / a "
+                          "stalled driver; the tick is skipped and "
+                          "counted, its arrivals re-issued next tick)",
     "train.step_nonfinite": "train supervisor: force a non-finite loss "
                             "for this step (consulted via check())",
     "compile.cache_read": "PIR compile cache: artifact read (verified "

@@ -1,0 +1,362 @@
+"""Traffic harness + per-phase profiler + PIR cost model (round 13).
+
+Contracts pinned here:
+  * load schedules are a pure function of (scenario, seed) — same seed,
+    same arrivals, same digest; different seeds differ;
+  * a real chat run passes the check_report gate: SLO verdict present,
+    phase attribution coverage >= 95%, cost ratios populated, and the
+    per-tenant sibling metrics carry the scenario's tenants;
+  * the PIR cost model transfers across programs — calibrate the
+    roofline scale on one compiled block, predict another, and the
+    measured/predicted ratio stays within [0.2, 5];
+  * per-tenant histograms survive the snapshot -> load_snapshot round
+    trip with per-label counts/sums intact;
+  * pushed past saturation, `slo_headroom` flips non-positive at (or
+    before) the sample where shed fraction first exceeds 10% — the
+    leading indicator fires before the lagging one;
+  * the phase registry is closed (unknown mark raises) and a disabled
+    accountant is a noop.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import ContinuousBatchingEngine
+from paddle_tpu.inference.loadgen import (SCENARIOS, build_schedule,
+                                          check_report, run_scenario)
+from paddle_tpu.inference.loadgen import schedule_digest
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.profiler.phases import (PHASES, PhaseAccountant,
+                                        get_phase_accountant)
+
+
+def _model():
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=256)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+def _engine(model, **kw):
+    kw.setdefault("num_blocks", 128)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("prefill_buckets", (16, 32))
+    kw.setdefault("max_queue", 64)
+    return ContinuousBatchingEngine(model, **kw)
+
+
+def _saturable_engine(model):
+    """An engine the chat scenario can actually drown: one lane, one
+    decode step per dispatch, a short admission queue — so the cost
+    model's predicted capacity sits well below the overload rates the
+    saturation tests offer."""
+    return _engine(model, max_batch=1, decode_steps=1, max_queue=8)
+
+
+def _warm(eng):
+    """Calibrate the cost model (first measured warm dispatch) and
+    compile BOTH chat prefill buckets up front, so a mid-run compile
+    stall can't shed requests while headroom still reads healthy."""
+    eng.add_request(np.arange(7) % 128, max_new_tokens=4)
+    eng.add_request(np.arange(20) % 128, max_new_tokens=4)
+    eng.run()
+
+
+@pytest.fixture
+def enabled_obs():
+    obs.get_registry().reset()
+    obs.enable()
+    acct = get_phase_accountant()
+    acct.reset()
+    acct.enable()
+    yield obs
+    acct.disable()
+    acct.reset()
+    obs.disable()
+    obs.get_registry().reset()
+
+
+class TestScheduleDeterminism:
+    def test_same_seed_same_schedule(self):
+        for name in sorted(SCENARIOS):
+            s1 = build_schedule(SCENARIOS[name], seed=7)
+            s2 = build_schedule(SCENARIOS[name], seed=7)
+            assert s1 == s2, name
+            assert schedule_digest(s1) == schedule_digest(s2)
+            assert s1, f"{name}: empty schedule"
+            assert all(a["t"] <= b["t"] for a, b in zip(s1, s1[1:]))
+
+    def test_different_seeds_differ(self):
+        a = build_schedule(SCENARIOS["chat"], seed=0)
+        b = build_schedule(SCENARIOS["chat"], seed=1)
+        assert schedule_digest(a) != schedule_digest(b)
+
+    def test_overrides_shape_the_schedule(self):
+        short = build_schedule(SCENARIOS["chat"], seed=0, duration_s=0.5)
+        full = build_schedule(SCENARIOS["chat"], seed=0)
+        assert max(a["t"] for a in short) < 0.5
+        assert len(short) < len(full)
+        dense = build_schedule(SCENARIOS["chat"], seed=0, rate_rps=60.0)
+        assert len(dense) > len(full)
+
+    def test_scenario_fields_flow_into_arrivals(self):
+        sched = build_schedule(SCENARIOS["chat"], seed=3)
+        sc = SCENARIOS["chat"]
+        tenants = {t for t, _w in sc.tenants}
+        for a in sched:
+            assert sc.prompt_len[0] <= a["prompt_len"] <= sc.prompt_len[1]
+            assert (sc.output_tokens[0] <= a["output_tokens"]
+                    <= sc.output_tokens[1])
+            assert a["tenant"] in tenants
+
+
+@pytest.fixture(scope="module")
+def chat_report():
+    """One real harness run shared by the report-shape assertions."""
+    obs.get_registry().reset()
+    obs.enable()
+    acct = get_phase_accountant()
+    acct.reset()
+    acct.enable()
+    try:
+        eng = _engine(_model())
+        report = run_scenario(eng, "chat", seed=0, duration_s=1.0,
+                              sample_every_s=0.1)
+        snap = obs.metrics.snapshot(obs.get_registry())
+        yield report, snap
+    finally:
+        acct.disable()
+        acct.reset()
+        obs.disable()
+        obs.get_registry().reset()
+
+
+class TestChatRun:
+    def test_check_report_passes(self, chat_report):
+        report, _snap = chat_report
+        assert check_report(report) == []
+        assert report["issued"] > 0
+        assert report["goodput"] == 1.0
+
+    def test_slo_verdict_present(self, chat_report):
+        report, _snap = chat_report
+        assert isinstance(report["slo"], dict)
+        assert "ok" in report["slo"]
+        assert {s["name"] for s in report["slo"]["slos"]} >= {
+            "ttft_p95", "tpot_p99"}
+
+    def test_attribution_coverage(self, chat_report):
+        report, _snap = chat_report
+        assert report["coverage"] >= 0.95
+        marked = set(report["phases"]["phases"])
+        assert marked <= set(PHASES)
+        # the serving hot path must exercise the core phases
+        assert {"admit", "decode.dispatch", "commit", "compile"} <= marked
+
+    def test_cost_ratio_populated(self, chat_report):
+        report, _snap = chat_report
+        assert report["cost"]["ratio"], "no pir_cost_ratio samples"
+        assert report["cost"]["programs"]
+
+    def test_tenant_metrics_emitted(self, chat_report):
+        report, snap = chat_report
+        assert set(report["tenants"]) >= {"acme", "zee"}
+        labelled = set()
+        for m in snap["metrics"]:
+            if m["name"] == "serving_tenant_finished_total":
+                for s in m["samples"]:
+                    labelled.add(s["labels"].get("tenant"))
+        assert {"acme", "zee"} <= labelled
+
+
+class TestCostModelTransfer:
+    def test_ratio_within_band_across_blocks(self):
+        """Calibrate the roofline scale on one llama-ish block, predict a
+        wider one: measured/predicted must land in [0.2, 5]."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.pir.pipeline import compile_flat
+
+        def make(width, name):
+            rs = np.random.RandomState(width)
+            x = jnp.asarray(rs.randn(width, width), jnp.float32)
+            w1 = jnp.asarray(rs.randn(width, width), jnp.float32)
+            w2 = jnp.asarray(rs.randn(width, width), jnp.float32)
+
+            def block(x, w1, w2):
+                h = jnp.tanh(x @ w1)
+                return (h @ w2,)
+
+            fn, rep = compile_flat(block, [x, w1, w2], name=name)
+            assert rep.cost is not None and rep.cost.raw_seconds > 0
+
+            def measure():
+                jax.block_until_ready(fn(x, w1, w2))  # warm
+                best = float("inf")
+                for _ in range(5):
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(fn(x, w1, w2))
+                    best = min(best, time.perf_counter() - t0)
+                return best
+
+            return rep.cost.raw_seconds, measure()
+
+        raw_a, meas_a = make(256, "cost_block_a")
+        raw_b, meas_b = make(512, "cost_block_b")
+        scale = meas_a / raw_a          # calibrate on A
+        predicted_b = raw_b * scale     # transfer to B
+        ratio = meas_b / predicted_b
+        assert 0.2 <= ratio <= 5.0, (
+            f"cost model transfer off the rails: ratio={ratio:.3f} "
+            f"(raw_a={raw_a:.3g} meas_a={meas_a:.3g} "
+            f"raw_b={raw_b:.3g} meas_b={meas_b:.3g})")
+
+
+class TestTenantSnapshotRoundTrip:
+    def test_per_label_counts_survive(self):
+        reg = obs.get_registry()
+        reg.reset()
+        obs.enable()
+        try:
+            from paddle_tpu.observability.catalog import metric
+            for _ in range(3):
+                metric("serving_tenant_ttft_seconds",
+                       tenant="acme").observe(0.05)
+            metric("serving_tenant_ttft_seconds", tenant="zee").observe(1.5)
+            metric("serving_tenant_finished_total",
+                   tenant="acme", reason="eos").inc()
+            doc = obs.metrics.snapshot(reg)
+            reg2 = obs.metrics.load_snapshot(doc)
+            by_name = {m.name: m for m in reg2.collect()}
+            hist = by_name["serving_tenant_ttft_seconds"].children()
+            acme = hist[(("tenant", "acme"),)]
+            zee = hist[(("tenant", "zee"),)]
+            assert acme.count == 3 and abs(acme.sum - 0.15) < 1e-9
+            assert zee.count == 1 and abs(zee.sum - 1.5) < 1e-9
+            ctr = by_name["serving_tenant_finished_total"].children()
+            assert ctr[(("reason", "eos"),
+                        ("tenant", "acme"))].value == 1
+        finally:
+            obs.disable()
+            reg.reset()
+
+    def test_tenant_cardinality_is_bounded(self):
+        eng = _engine(_model(), max_queue=None)
+        eng._max_tenants = 4
+        prompt = np.arange(5) % 128
+        for i in range(6):
+            eng.add_request(prompt, max_new_tokens=1, tenant=f"t{i}")
+        seen = {r.tenant for r in eng.queue}
+        assert "overflow" in seen
+        assert len({t for t in seen if t != "overflow"}) == 4
+
+
+class TestOverloadOrdering:
+    def test_headroom_flips_before_shed(self, enabled_obs):
+        """Leading vs lagging: past saturation the cost-model headroom
+        goes non-positive no later than shed fraction crossing 10%."""
+        eng = _saturable_engine(_model())
+        _warm(eng)
+        assert eng.predicted_service_seconds(output_tokens=8) is not None
+
+        report = run_scenario(eng, "chat", seed=2, rate_rps=400.0,
+                              duration_s=0.5, drain=False,
+                              sample_every_s=0.05)
+        assert report["headroom_floor"] is not None
+        assert report["headroom_floor"] <= 0.0
+        tl = report["timeline"]
+        over_idx = next(i for i, s in enumerate(tl)
+                        if s["headroom"] is not None
+                        and s["headroom"] <= 0.0)
+        shed_idx = next((i for i, s in enumerate(tl)
+                         if s["shed_frac"] > 0.10), len(tl))
+        assert over_idx <= shed_idx, (
+            f"overload gauge lagged the shed signal: headroom flipped at "
+            f"sample {over_idx}, shed>10% at {shed_idx}")
+        assert report["shed"] > 0      # the overload was real
+
+
+class TestPhaseAccountant:
+    def test_unknown_phase_raises(self):
+        acct = PhaseAccountant(enabled=True)
+        acct.begin_step()
+        with pytest.raises(KeyError):
+            acct.mark("not_a_phase")
+
+    def test_disabled_is_noop(self):
+        acct = PhaseAccountant(enabled=False)
+        acct.begin_step()
+        acct.mark("admit")
+        acct.mark("totally_bogus")     # disabled: not even validated
+        acct.end_step()
+        rep = acct.report()
+        assert rep["steps"] == 0 and rep["wall_s"] == 0.0
+
+    def test_marks_partition_the_step(self):
+        acct = PhaseAccountant(enabled=True)
+        acct.begin_step()
+        time.sleep(0.002)
+        acct.mark("admit")
+        time.sleep(0.002)
+        acct.mark("commit", tenant="acme")
+        acct.end_step()
+        rep = acct.report()
+        assert rep["steps"] == 1
+        assert set(rep["phases"]) == {"admit", "commit"}
+        assert rep["coverage"] > 0.9
+        assert rep["tenants"]["acme"] > 0.0
+
+    def test_registry_matches_docs_contract(self):
+        # the static checker enforces the doc side; here: non-empty,
+        # dotted lowercase names only
+        assert PHASES
+        for p in PHASES:
+            assert p == p.lower() and " " not in p
+
+
+@pytest.mark.slow
+class TestSaturationSweep:
+    def test_goodput_degrades_after_headroom(self):
+        """Sweep offered rate across saturation: once headroom has gone
+        negative at some rate, higher rates shed more — and headroom
+        flipped at a rate no higher than where shedding took off."""
+        obs.get_registry().reset()
+        obs.enable()
+        acct = get_phase_accountant()
+        acct.reset()
+        acct.enable()
+        try:
+            model = _model()
+            rows = []
+            for rate in (5.0, 25.0, 400.0):
+                eng = _saturable_engine(model)
+                _warm(eng)
+                rep = run_scenario(eng, "chat", seed=0, rate_rps=rate,
+                                   duration_s=0.5, drain=(rate <= 5.0),
+                                   sample_every_s=0.05)
+                attempts = rep["issued"] + rep["rejected"]
+                rows.append({
+                    "rate": rate,
+                    "shed_frac": rep["shed"] / max(1, attempts),
+                    "floor": rep["headroom_floor"],
+                })
+            assert rows[0]["shed_frac"] <= 0.05     # healthy at low rate
+            assert rows[-1]["shed_frac"] > rows[0]["shed_frac"]
+            over = [r["rate"] for r in rows
+                    if r["floor"] is not None and r["floor"] <= 0.0]
+            shedding = [r["rate"] for r in rows if r["shed_frac"] > 0.10]
+            assert over, "headroom never went non-positive in the sweep"
+            if shedding:
+                assert min(over) <= min(shedding)
+        finally:
+            acct.disable()
+            acct.reset()
+            obs.disable()
+            obs.get_registry().reset()

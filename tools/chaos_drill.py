@@ -271,6 +271,52 @@ def drill_serve_kv_dequant(tmp):
                         "decode recompiled and the request completed")
 
 
+def drill_serve_loadgen_tick(tmp):
+    from paddle_tpu.inference import loadgen
+    from paddle_tpu.profiler.phases import get_phase_accountant
+    acct = get_phase_accountant()
+    prev = acct.enabled
+    p = (np.arange(8) * 5) % 128
+    try:
+        # off-path proof first: the phase accountant toggled on/off must
+        # not change one byte of greedy output (fresh engine each leg;
+        # paddle.seed(0) in _tiny_engine makes the weights identical)
+        acct.enabled = False
+        model_off, eng_off = _tiny_engine()
+        rid = eng_off.add_request(p, max_new_tokens=6)
+        out_off = eng_off.run()[rid]
+        acct.enabled = True
+        model_on, eng_on = _tiny_engine()
+        rid = eng_on.add_request(p, max_new_tokens=6)
+        out_on = eng_on.run()[rid]
+        _expect(out_off == out_on,
+                "profiler on/off changed greedy output bytes")
+        _expect(out_on == _dense_ref(model_on, p, 6),
+                "greedy output diverged from the dense reference")
+        # now the tick fault: one injected clock blip mid-run — the tick
+        # is skipped + counted, its arrivals re-issued on the next tick
+        skipped0 = _counter("loadgen_ticks_skipped_total")
+        model, eng = _tiny_engine(num_blocks=128, max_batch=2)
+        with faults.injected_faults("serve.loadgen_tick:2:TimeoutError"):
+            rep = loadgen.run_scenario(eng, "chat", seed=1, rate_rps=30.0,
+                                       duration_s=0.3, sample_every_s=0.1)
+            inj = faults.injected_counts().get("serve.loadgen_tick", 0)
+        _expect(inj == 1, "fault never reached the loadgen tick site")
+        _expect(rep["ticks_skipped"] == 1,
+                f"skipped ticks {rep['ticks_skipped']} != 1")
+        _expect(_counter("loadgen_ticks_skipped_total") - skipped0 >= 1,
+                "skipped tick not counted")
+        _expect(rep["issued"] + rep["rejected"]
+                == rep["schedule"]["arrivals"],
+                "skipped tick dropped arrivals (re-issue broken)")
+        _expect(rep["goodput"] == 1.0,
+                f"requests lost across the skipped tick: {rep['finished']}")
+    finally:
+        acct.enabled = prev
+    return "recovered", ("tick fault skipped + counted; arrivals re-issued "
+                         "next tick; profiler off-path byte-identical")
+
+
 def drill_train_step_nonfinite(tmp):
     losses = {"n": 0}
 
@@ -403,6 +449,7 @@ SCENARIOS = {
     "serve.hostsync_read": drill_serve_hostsync_read,
     "serve.draft_verify": drill_serve_draft_verify,
     "serve.kv_dequant": drill_serve_kv_dequant,
+    "serve.loadgen_tick": drill_serve_loadgen_tick,
     "train.step_nonfinite": drill_train_step_nonfinite,
     "compile.cache_read": drill_compile_cache_read,
     "compile.cache_write": drill_compile_cache_write,

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Traffic harness CLI: drive the serving engine with a seeded scenario
+and print the machine-readable run report (OBSERVABILITY.md's
+load-testing runbook entry point).
+
+Usage:
+  python tools/loadgen.py --scenario chat --seed 0            # report JSON
+  python tools/loadgen.py --scenario chat --seed 0 --check    # acceptance
+          gate: exit 0 iff an SLO verdict exists, phase attribution covers
+          >=95% of engine wall time, and the predicted-vs-measured cost
+          gauge is populated
+  python tools/loadgen.py --list                              # scenarios
+  python tools/loadgen.py --scenario chat --rate 400 --no-drain   # overload
+  python tools/loadgen.py --scenario chat --out report.json   # then:
+  python tools/profile_report.py report.json                  # phase table
+
+The engine under test is a tiny in-process llama (the chaos-drill
+shape) on whatever backend jax finds — the harness measures the SERVING
+RUNTIME (scheduler, chunked prefill, fused decode, readback), not model
+quality. Point --scenario at a real deployment by importing
+paddle_tpu.inference.loadgen and passing your own engine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] = (os.environ["XLA_FLAGS"]
+                               + " --xla_force_host_platform_device_count=2"
+                               ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS") or "cpu")
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import observability as obs  # noqa: E402
+from paddle_tpu.inference import loadgen  # noqa: E402
+from paddle_tpu.profiler.phases import get_phase_accountant  # noqa: E402
+
+
+def build_engine(max_batch=4, num_blocks=128, block_size=8,
+                 prefill_buckets=(16, 32), max_queue=64, **kw):
+    """The harness's default engine under test: tiny llama, small paged
+    pool, bounded admission queue (so overload sweeps exercise
+    backpressure instead of unbounded memory)."""
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=256)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    return ContinuousBatchingEngine(
+        model, num_blocks=num_blocks, block_size=block_size,
+        max_batch=max_batch, prefill_buckets=prefill_buckets,
+        max_queue=max_queue, **kw)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", default="chat",
+                    choices=sorted(loadgen.SCENARIOS))
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--rate", type=float, default=None,
+                    help="override the scenario's arrival rate (rps)")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="override the scenario's duration (s)")
+    ap.add_argument("--max-wall", type=float, default=None,
+                    help="hard wall-clock cap on the run (s)")
+    ap.add_argument("--no-drain", action="store_true",
+                    help="stop at schedule end instead of draining the "
+                         "backlog (saturation sweeps)")
+    ap.add_argument("--check", action="store_true",
+                    help="acceptance gate: exit nonzero unless the report "
+                         "has an SLO verdict, >=95%% phase attribution, "
+                         "and a populated cost gauge")
+    ap.add_argument("--min-coverage", type=float, default=0.95)
+    ap.add_argument("--out", default=None, help="write the report JSON here "
+                    "(default: stdout)")
+    ap.add_argument("--list", action="store_true",
+                    help="list scenarios and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in sorted(loadgen.SCENARIOS):
+            sc = loadgen.SCENARIOS[name]
+            print(f"{name:18s} {sc.arrival:8s} {sc.rate_rps:6.1f} rps "
+                  f"x {sc.duration_s:4.1f}s  {sc.description}")
+        return 0
+
+    obs.enable()
+    get_phase_accountant().enabled = True
+    engine = build_engine()
+    report = loadgen.run_scenario(
+        engine, args.scenario, seed=args.seed, rate_rps=args.rate,
+        duration_s=args.duration, max_wall_s=args.max_wall,
+        drain=not args.no_drain)
+
+    text = json.dumps(report, indent=1, default=str)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"wrote {args.out}")
+    else:
+        print(text)
+
+    slo_state = "PASS" if report["slo"].get("ok") else "BREACH"
+    cov = report.get("coverage")
+    print(f"\n# scenario={report['scenario']} seed={report['seed']} "
+          f"issued={report['issued']} goodput={report['goodput']} "
+          f"ttft_p95={report['ttft']['p95']} slo={slo_state} "
+          f"coverage={cov if cov is None else round(cov, 4)}",
+          file=sys.stderr)
+
+    if args.check:
+        problems = loadgen.check_report(report,
+                                        min_coverage=args.min_coverage)
+        for p in problems:
+            print(f"CHECK FAIL: {p}", file=sys.stderr)
+        if problems:
+            return 1
+        print("CHECK PASS: SLO verdict present, attribution "
+              f">={args.min_coverage:.0%}, cost gauge populated",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

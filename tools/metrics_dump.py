@@ -6,6 +6,10 @@ OBSERVABILITY.md's exporter runbook.
 Usage:
   python tools/metrics_dump.py obs.metrics.jsonl          # table view
   python tools/metrics_dump.py obs.metrics.jsonl --prom   # Prometheus text
+  python tools/metrics_dump.py obs.metrics.jsonl \
+                               --label tenant=acme        # only children
+                                                          # with that label
+                                                          # pair (repeatable)
   python tools/metrics_dump.py BENCH_r05.json             # bench row: digs
                                                           # out detail.*.metrics_snapshot
   python tools/metrics_dump.py --live                     # this process's
@@ -78,7 +82,7 @@ def load_any(path, mod):
                      "JSONL snapshot or JSON embedding one)")
 
 
-def table(reg, mod):
+def table(reg, mod, label_filters=()):
     # quantile columns share THE estimator with the SLO engine
     # (observability/quantiles.py) — a p95 here is the same p95 an
     # slo_report verdict judged
@@ -89,6 +93,9 @@ def table(reg, mod):
     for m in reg.collect():
         for key in sorted(m.children()):
             c = m.children()[key]
+            if label_filters and not all(
+                    dict(key).get(k) == v for k, v in label_filters):
+                continue    # child lacks the label or has another value
             labels = ",".join(f"{k}={v}" for k, v in key) or "-"
             if m.type == "histogram":
                 val = (f"n={c.count} sum={c.sum:.6g}"
@@ -106,7 +113,27 @@ def table(reg, mod):
 
 
 def main(argv):
-    args = [a for a in argv if not a.startswith("--")]
+    # --label k=v (repeatable): only children carrying that exact label
+    # pair are shown — the per-tenant triage view (`--label tenant=acme`)
+    label_filters = []
+    args = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        pair = None
+        if a.startswith("--label="):
+            pair = a.split("=", 1)[1]
+        elif a == "--label":
+            i += 1
+            pair = argv[i] if i < len(argv) else None
+        elif not a.startswith("--"):
+            args.append(a)
+        if a.startswith("--label"):
+            if not pair or "=" not in pair:
+                raise SystemExit("--label needs k=v (e.g. tenant=acme)")
+            k, v = pair.split("=", 1)
+            label_filters.append((k, v))
+        i += 1
     prom = "--prom" in argv
     mod = _metrics_mod()
     if "--live" in argv:
@@ -115,7 +142,8 @@ def main(argv):
         if not args:
             raise SystemExit(__doc__)
         reg = mod.load_snapshot(load_any(args[0], mod))
-    print(mod.to_prometheus_text(reg) if prom else table(reg, mod))
+    print(mod.to_prometheus_text(reg) if prom
+          else table(reg, mod, label_filters))
     return 0
 
 

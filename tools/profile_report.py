@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Where the milliseconds go: render a phase table (+ ASCII flame) from
+a loadgen run report or a Chrome-trace JSON.
+
+Usage:
+  python tools/loadgen.py --scenario chat --out report.json
+  python tools/profile_report.py report.json            # phase table
+  python tools/profile_report.py report.json --tenants  # + tenant split
+  python tools/profile_report.py host_trace.1234.json   # chrome trace:
+                                                        # aggregate "X"
+                                                        # events by name
+
+Reads two shapes, auto-detected:
+  * a paddle_tpu.inference.loadgen run report (its `phases` block is the
+    PhaseAccountant's attribution: per-phase seconds/marks plus the
+    coverage ratio against measured engine wall time), or
+  * a chrome-trace JSON (the profiler.export_chrome_tracing host trace,
+    or any {"traceEvents": [...]} / bare event list) — complete "X"
+    duration events aggregated by name.
+
+Dependency-free by design (stdlib json only) so it runs where the
+report landed, not where jax is installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+BAR_W = 30
+
+
+def _bar(frac):
+    n = max(0, min(BAR_W, int(round(frac * BAR_W))))
+    return "#" * n + "." * (BAR_W - n)
+
+
+def _fmt_s(s):
+    return f"{s * 1e3:10.3f}"
+
+
+def render_phases(report, show_tenants=False):
+    """Loadgen-report phase table -> printable string."""
+    ph = report.get("phases") or {}
+    phases = ph.get("phases") or {}
+    wall = float(ph.get("wall_s") or 0.0)
+    attr = float(ph.get("attributed_s") or 0.0)
+    cov = ph.get("coverage")
+    lines = []
+    head = (f"{'phase':<18}{'total(ms)':>12}{'marks':>8}{'avg(us)':>10}"
+            f"{'% wall':>8}  {'share':<{BAR_W}}")
+    lines.append(head)
+    lines.append("-" * len(head))
+    rows = sorted(phases.items(), key=lambda kv: -kv[1].get("seconds", 0.0))
+    for name, row in rows:
+        sec = float(row.get("seconds", 0.0))
+        marks = int(row.get("marks", 0))
+        avg_us = sec / marks * 1e6 if marks else 0.0
+        frac = sec / wall if wall > 0 else 0.0
+        lines.append(f"{name:<18}{_fmt_s(sec):>12}{marks:>8}"
+                     f"{avg_us:>10.1f}{frac:>7.1%}  {_bar(frac)}")
+    lines.append("-" * len(head))
+    unattr = max(0.0, wall - attr)
+    lines.append(f"{'(unattributed)':<18}{_fmt_s(unattr):>12}{'':>8}{'':>10}"
+                 f"{(unattr / wall if wall > 0 else 0.0):>7.1%}")
+    lines.append(f"engine wall {wall * 1e3:.3f} ms over "
+                 f"{ph.get('steps', '?')} steps; attribution coverage "
+                 f"{cov if cov is None else format(cov, '.4f')}")
+    if show_tenants:
+        tenants = ph.get("tenants") or {}
+        if tenants:
+            lines.append("")
+            lines.append(f"{'tenant':<18}{'decode(ms)':>12}{'share':>8}")
+            tot = sum(tenants.values()) or 1.0
+            for t, sec in sorted(tenants.items(), key=lambda kv: -kv[1]):
+                lines.append(f"{t:<18}{_fmt_s(sec):>12}"
+                             f"{sec / tot:>7.1%}")
+    return "\n".join(lines)
+
+
+def render_trace(doc):
+    """Chrome-trace "X" events aggregated by name -> printable string."""
+    events = doc.get("traceEvents", doc) if isinstance(doc, dict) else doc
+    agg = {}
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("ph") != "X":
+            continue
+        name = str(ev.get("name", "?"))
+        dur_s = float(ev.get("dur", 0)) / 1e6    # chrome traces are in us
+        n, tot, mx = agg.get(name, (0, 0.0, 0.0))
+        agg[name] = (n + 1, tot + dur_s, max(mx, dur_s))
+    if not agg:
+        return "no complete ('X') duration events found"
+    total = sum(t for _, t, _ in agg.values()) or 1.0
+    lines = []
+    head = (f"{'span':<40}{'calls':>7}{'total(ms)':>12}{'avg(us)':>10}"
+            f"{'max(us)':>10}{'% total':>9}  {'share':<{BAR_W}}")
+    lines.append(head)
+    lines.append("-" * len(head))
+    for name, (n, tot, mx) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+        frac = tot / total
+        lines.append(f"{name:<40}{n:>7}{_fmt_s(tot):>12}"
+                     f"{tot / n * 1e6:>10.1f}{mx * 1e6:>10.1f}"
+                     f"{frac:>8.1%}  {_bar(frac)}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="loadgen report JSON or chrome-trace JSON")
+    ap.add_argument("--tenants", action="store_true",
+                    help="include the per-tenant decode-time split")
+    args = ap.parse_args(argv)
+    with open(args.path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and isinstance(doc.get("phases"), dict) \
+            and "coverage" in doc.get("phases", {}):
+        out = render_phases(doc, show_tenants=args.tenants)
+        cost = (doc.get("cost") or {}).get("ratio") or {}
+        if cost:
+            out += "\n\npredicted-vs-measured cost ratio (1.0 = model "
+            out += "matches the clock):\n"
+            out += "\n".join(f"  {k:<24}{v:8.3f}"
+                            for k, v in sorted(cost.items()))
+    elif isinstance(doc, (list, dict)):
+        out = render_trace(doc)
+    else:
+        raise SystemExit(f"{args.path}: unrecognized JSON shape")
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,8 @@ Rules (closed registry, like everything else here):
   fault-sites          fault_point("s") ⊆ FAULT_SITES ⊆ chaos_drill
                        SCENARIOS; every site backticked in RESILIENCE.md
   recorder-kinds       record("kind") literals  ⊆ recorder EVENT_KINDS
+  profiler-phases      mark("phase") literals in profiler/ + serving.py
+                       ⊆ phases.py PHASES == OBSERVABILITY.md phase rows
   flags-registered     os.environ FLAGS_* accesses and flag_value("x")
                        args ⊆ define_flag names (collected repo-wide)
   host-sync            device->host syncs (np.asarray / .item() /
@@ -54,9 +56,15 @@ CATALOG_PY = "paddle_tpu/observability/catalog.py"
 FAULTS_PY = "paddle_tpu/resilience/faults.py"
 RECORDER_PY = "paddle_tpu/observability/recorder.py"
 FLAGS_PY = "paddle_tpu/framework/flags.py"
+PHASES_PY = "paddle_tpu/profiler/phases.py"
 CHAOS_PY = "tools/chaos_drill.py"
 OBS_MD = "OBSERVABILITY.md"
 RES_MD = "RESILIENCE.md"
+
+# profiler-phases rule scope: the files whose mark("...") literals must
+# resolve against the PHASES registry (`mark` is too generic a name to
+# scan repo-wide)
+PHASE_MARK_FILES = ("paddle_tpu/profiler/", "paddle_tpu/inference/serving.py")
 
 # host-sync rule scope + allowlist: methods audited as intentional
 # host syncs (see STATIC_ANALYSIS.md "Host-sync allowlist policy").
@@ -162,9 +170,12 @@ class Context:
         self.fault_sites = _dict_keys(FAULTS_PY, "FAULT_SITES")
         self.event_kinds = _dict_keys(RECORDER_PY, "EVENT_KINDS")
         self.scenarios = _dict_keys(CHAOS_PY, "SCENARIOS")
+        self.phases = _dict_keys(PHASES_PY, "PHASES")
         self.flags = _defined_flags()
         self.obs_rows = set(re.findall(r"^\| `([a-z0-9_]+)` \|",
                                        _read(OBS_MD), re.M))
+        self.phase_rows = set(re.findall(r"^\| `phase/([a-z_.]+)` \|",
+                                         _read(OBS_MD), re.M))
         self.res_ticks = set(re.findall(r"`([a-z_]+\.[a-z_]+)`",
                                         _read(RES_MD)))
         self.sources = {}
@@ -251,6 +262,50 @@ def rule_recorder_kinds(ctx):
                       "EVENT_KINDS")
             for p, ln, kind in _str_arg_calls(ctx, {"record"})
             if kind not in ctx.event_kinds]
+
+
+def rule_profiler_phases(ctx):
+    """The per-phase profiler's registry (profiler/phases.py PHASES) is
+    closed like the metric catalog: every mark("...") literal in the
+    profiler and the serving engine must name a registered phase, and
+    every registered phase must have a `| \\`phase/NAME\\` |` row in
+    OBSERVABILITY.md — both directions, so the docs can't drift."""
+    out = []
+    for path, tree in ctx.sources.items():
+        norm = path.replace(os.sep, "/")
+        # dir entries (trailing /) match by containment so --paths runs
+        # on copies still resolve; file entries match by suffix
+        if not any((s.endswith("/") and s in norm) or norm.endswith(s)
+                   for s in PHASE_MARK_FILES):
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and _callee(node) == "mark"
+                    and node.args):
+                continue
+            arg = node.args[0]
+            # plain literal, or both arms of mark("a" if c else "b")
+            lits = [arg] if isinstance(arg, ast.Constant) else \
+                ([arg.body, arg.orelse] if isinstance(arg, ast.IfExp)
+                 else [])
+            for lit in lits:
+                if isinstance(lit, ast.Constant) \
+                        and isinstance(lit.value, str) \
+                        and lit.value not in ctx.phases:
+                    out.append(Violation(
+                        "profiler-phases", path, node.lineno,
+                        f"mark({lit.value!r}) is not in "
+                        f"{PHASES_PY} PHASES"))
+    for name in sorted(ctx.phases - ctx.phase_rows):
+        out.append(Violation(
+            "profiler-phases", OBS_MD, 0,
+            f"PHASES entry {name!r} has no `| `phase/{name}` |` row in "
+            f"{OBS_MD}"))
+    for name in sorted(ctx.phase_rows - ctx.phases):
+        out.append(Violation(
+            "profiler-phases", OBS_MD, 0,
+            f"{OBS_MD} documents phase {name!r} which is not in "
+            f"{PHASES_PY} PHASES"))
+    return out
 
 
 def rule_flags_registered(ctx):
@@ -398,6 +453,9 @@ RULES = {
                     "fault_point ⊆ FAULT_SITES ⊆ chaos drills ⊆ docs"),
     "recorder-kinds": (rule_recorder_kinds,
                        "record() kinds are EVENT_KINDS entries"),
+    "profiler-phases": (rule_profiler_phases,
+                        "mark() literals ⊆ profiler PHASES == "
+                        "OBSERVABILITY.md phase rows"),
     "flags-registered": (rule_flags_registered,
                          "FLAGS_* env accesses and flag_value args are "
                          "define_flag()ed"),

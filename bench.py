@@ -660,7 +660,10 @@ def loadgen_worker(force_cpu: bool, scenario="chat", seed=0):
                       prefill_buckets=(16, 32), max_queue=64)
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
-    eng = ContinuousBatchingEngine(model, **eng_kw)
+    # scheduler=True: the bench leg runs the closed SLO loop, so
+    # check_report additionally gates brownout-recovered-to-0 and
+    # known-finish-reasons on every row
+    eng = ContinuousBatchingEngine(model, scheduler=True, **eng_kw)
     rep = loadgen.run_scenario(eng, scenario, seed=seed)
     problems = loadgen.check_report(rep)
     detail = {
@@ -677,6 +680,10 @@ def loadgen_worker(force_cpu: bool, scenario="chat", seed=0):
         "attribution_coverage": rep["coverage"],
         "cost_ratio": rep["cost"]["ratio"],
         "headroom_floor": rep["headroom_floor"],
+        "classes": rep.get("classes"),
+        "brownout_level_end": rep.get("brownout_level_end"),
+        "brownout_transitions": rep.get("brownout_transitions"),
+        "preemptions": rep.get("preemptions"),
         "check_problems": problems,
     }
     detail["metrics_snapshot"] = _obs.snapshot(

@@ -19,6 +19,8 @@ import numpy as np
 
 from .loadgen import (  # noqa: E402,F401
     SCENARIOS, Scenario, build_schedule, check_report, run_scenario)
+from .scheduler import (  # noqa: E402,F401
+    BROWNOUT_LEVELS, PRIORITY_CLASSES, SLOScheduler)
 from .serving import (  # noqa: E402,F401
     BackpressureError, ContinuousBatchingEngine, KVPoolExhaustedError,
     Request)
@@ -27,6 +29,7 @@ __all__ = ["ContinuousBatchingEngine", "Request", "BackpressureError",
            "KVPoolExhaustedError",
            "Scenario", "SCENARIOS", "build_schedule", "run_scenario",
            "check_report",
+           "SLOScheduler", "PRIORITY_CLASSES", "BROWNOUT_LEVELS",
            "Config", "Predictor", "Tensor", "create_predictor",
            "PrecisionType", "PlaceType", "get_version"]
 

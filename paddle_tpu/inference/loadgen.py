@@ -48,16 +48,22 @@ from ..observability.recorder import get_recorder as _get_recorder
 from ..observability.slo import SLOEngine
 from ..profiler.phases import get_phase_accountant as _get_phases
 from ..resilience.faults import fault_point
+from .scheduler import PRIORITY_CLASSES
 from .serving import BackpressureError
 
 __all__ = ["Scenario", "SCENARIOS", "build_schedule", "run_scenario",
-           "check_report", "REPORT_FORMAT"]
+           "check_report", "REPORT_FORMAT", "KNOWN_FINISH_REASONS"]
 
-REPORT_FORMAT = 1
+REPORT_FORMAT = 2
 
 # finish reasons that count as goodput (mirrors the availability SLO's
 # good set in observability/slo.py DEFAULT_SLOS)
 GOOD_REASONS = ("eos", "length")
+
+# every reason a request may legally finish with (serving.py _finish);
+# check_report flags anything outside this set — a request must never
+# end in an unclassifiable state, scheduler or no scheduler
+KNOWN_FINISH_REASONS = ("eos", "length", "timeout", "shed", "rejected")
 
 
 class Scenario:
@@ -69,18 +75,24 @@ class Scenario:
 
     __slots__ = ("name", "arrival", "rate_rps", "duration_s",
                  "rate_end_rps", "burst_n", "burst_every_s",
-                 "prompt_len", "output_tokens", "tenants", "do_sample",
-                 "temperature", "top_k", "top_p", "deadline_s",
-                 "description")
+                 "prompt_len", "output_tokens", "tenants", "priorities",
+                 "do_sample", "temperature", "top_k", "top_p",
+                 "deadline_s", "description")
 
     def __init__(self, name, arrival="poisson", rate_rps=10.0,
                  duration_s=1.0, rate_end_rps=None, burst_n=4,
                  burst_every_s=0.25, prompt_len=(4, 16),
                  output_tokens=(4, 12), tenants=(("-", 1.0),),
+                 priorities=(("interactive", 1.0),),
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                  deadline_s=None, description=""):
         if arrival not in ("poisson", "burst", "ramp"):
             raise ValueError(f"unknown arrival process {arrival!r}")
+        for p, _w in priorities:
+            if p not in PRIORITY_CLASSES:
+                raise ValueError(
+                    f"unknown priority class {p!r}; registered: "
+                    f"{list(PRIORITY_CLASSES)}")
         self.name = str(name)
         self.arrival = arrival
         self.rate_rps = float(rate_rps)
@@ -92,6 +104,7 @@ class Scenario:
         self.prompt_len = (int(prompt_len[0]), int(prompt_len[1]))
         self.output_tokens = (int(output_tokens[0]), int(output_tokens[1]))
         self.tenants = tuple((str(t), float(w)) for t, w in tenants)
+        self.priorities = tuple((str(p), float(w)) for p, w in priorities)
         self.do_sample = bool(do_sample)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
@@ -121,21 +134,25 @@ SCENARIOS = {
         "offline_batch", arrival="burst", rate_rps=16.0, duration_s=1.5,
         burst_n=8, burst_every_s=0.5, prompt_len=(8, 32),
         output_tokens=(8, 16), tenants=(("batch", 1.0),),
+        priorities=(("batch", 1.0),),
         description="offline batch: burst trains (a queue worker "
                     "flushing), throughput over latency, no deadlines"),
     "structured_output": Scenario(
         "structured_output", arrival="ramp", rate_rps=2.0,
         rate_end_rps=24.0, duration_s=2.0, prompt_len=(6, 20),
         output_tokens=(4, 10), tenants=(("jsonsvc", 1.0),),
+        priorities=(("interactive", 2.0), ("batch", 1.0)),
         do_sample=True, temperature=0.8, top_p=0.95, deadline_s=15.0,
         description="structured-output extraction: sampled decode, "
-                    "arrival rate ramping into saturation"),
+                    "mixed interactive/batch classes, arrival rate "
+                    "ramping into saturation — the scheduler's chaos "
+                    "probe"),
 }
 
 
-def _pick_tenant(rng, tenants):
-    names = [t for t, _ in tenants]
-    weights = [w for _, w in tenants]
+def _pick_weighted(rng, pairs):
+    names = [t for t, _ in pairs]
+    weights = [w for _, w in pairs]
     return rng.choices(names, weights=weights, k=1)[0]
 
 
@@ -146,7 +163,8 @@ def _arrival(scenario, rng, t):
         "t": round(float(t), 6),
         "prompt_len": rng.randint(lo, hi),
         "output_tokens": rng.randint(olo, ohi),
-        "tenant": _pick_tenant(rng, scenario.tenants),
+        "tenant": _pick_weighted(rng, scenario.tenants),
+        "priority": _pick_weighted(rng, scenario.priorities),
         "prompt_seed": rng.randrange(1 << 30),
         "sample_seed": rng.randrange(1 << 30),
     }
@@ -259,6 +277,18 @@ def _gauge_samples(snapshot_doc, name):
     return out
 
 
+def _counter_total(snapshot_doc, name):
+    """Sum of a counter family across label children (0.0 when the
+    metric never fired or the registry is disabled)."""
+    total = 0.0
+    for m in snapshot_doc.get("metrics", []):
+        if m.get("name") != name:
+            continue
+        for s in m.get("samples", []):
+            total += float(s.get("value", 0.0))
+    return total
+
+
 # -- the runner ------------------------------------------------------------
 
 def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
@@ -326,12 +356,16 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
             m_overload.set(1.0 if headroom <= 0.0 else 0.0)
             headroom_floor = (headroom if headroom_floor is None
                               else min(headroom_floor, headroom))
+        sched = getattr(engine, "scheduler", None)
         timeline.append({
             "t": round(now, 4), "issued": issued, "rejected": rejected,
             "finished": done, "good": good, "shed_frac": round(
                 shed_frac, 4),
             "offered_rps": round(rate, 2),
             "service_s": svc, "headroom": headroom,
+            "brownout": None if sched is None else int(sched.level),
+            "preemptions": (None if sched is None
+                            else int(sched.preempt_requests)),
         })
 
     while True:
@@ -356,7 +390,8 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
                     temperature=scenario.temperature,
                     top_k=scenario.top_k, top_p=scenario.top_p,
                     seed=a["sample_seed"],
-                    deadline_s=scenario.deadline_s, tenant=a["tenant"])
+                    deadline_s=scenario.deadline_s, tenant=a["tenant"],
+                    priority=a.get("priority", "interactive"))
                 issued += 1
                 m_arrivals.inc()
             except BackpressureError:
@@ -383,11 +418,24 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
 
     finished = {}
     tenants = {}
+    classes = {}
+    class_ttfts: dict[str, list] = {}
     for r in engine.finished.values():
         finished[r.finish_reason] = finished.get(r.finish_reason, 0) + 1
         trow = tenants.setdefault(r.tenant, {"finished": 0, "good": 0})
         trow["finished"] += 1
         trow["good"] += int(r.finish_reason in GOOD_REASONS)
+        cls = getattr(r, "priority", "interactive")
+        crow = classes.setdefault(cls, {"finished": 0, "good": 0})
+        crow["finished"] += 1
+        crow["good"] += int(r.finish_reason in GOOD_REASONS)
+        if r.t_first is not None:
+            class_ttfts.setdefault(cls, []).append(
+                r.t_first - r.t_arrival)
+    for cls, ts in class_ttfts.items():
+        ts.sort()
+        classes[cls]["ttft_p50"] = round(ts[int(0.5 * (len(ts) - 1))], 6)
+        classes[cls]["ttft_p95"] = round(ts[int(0.95 * (len(ts) - 1))], 6)
     total_done = sum(finished.values())
     good = sum(finished.get(rn, 0) for rn in GOOD_REASONS)
 
@@ -416,12 +464,31 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
         "ttft": _quantile_block(snap0, snap1, "serving_ttft_seconds"),
         "tpot": _quantile_block(snap0, snap1, "serving_tpot_seconds"),
         "tenants": tenants,
+        "classes": classes,
         "slo": verdict,
         "phases": phases_report,
         "coverage": (phases_report or {}).get("coverage"),
         "cost": cost,
         "headroom_floor": headroom_floor,
         "timeline": timeline,
+        # scheduler evidence (all zero/None for a scheduler-less engine):
+        # end-of-run brownout level must be 0 — check_report gates it
+        "brownout_level_end": _gauge_samples(
+            snap1, "serving_brownout_level").get("-", 0.0),
+        "brownout_transitions": (
+            _counter_total(snap1, "serving_brownout_transitions_total")
+            - _counter_total(snap0, "serving_brownout_transitions_total")),
+        "preemptions": (
+            _counter_total(snap1, "serving_preemptions_total")
+            - _counter_total(snap0, "serving_preemptions_total")),
+        "quota_deferrals": (
+            _counter_total(snap1, "serving_quota_deferrals_total")
+            - _counter_total(snap0, "serving_quota_deferrals_total")),
+        "scheduler": (None if getattr(engine, "scheduler", None) is None
+                      else {"level_end": int(engine.scheduler.level),
+                            "fifo": bool(engine.scheduler.fifo),
+                            "preempts": int(
+                                engine.scheduler.preempt_requests)}),
     }
     rec = _get_recorder()
     if rec.enabled:
@@ -435,9 +502,11 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
 def check_report(report, min_coverage=0.95):
     """Acceptance gate over a run report -> list of problems (empty =
     pass). Checked: an SLO verdict exists, phase attribution covers at
-    least `min_coverage` of engine wall time, and the cost model priced
-    at least one dispatched program (predicted-vs-measured gauge is
-    populated)."""
+    least `min_coverage` of engine wall time, the cost model priced at
+    least one dispatched program (predicted-vs-measured gauge is
+    populated), every finished request carries a known finish reason,
+    and the brownout ladder returned to level 0 by end of run (a run
+    that leaves the engine degraded is not a pass)."""
     problems = []
     slo_v = report.get("slo")
     if not isinstance(slo_v, dict) or "ok" not in slo_v:
@@ -454,4 +523,16 @@ def check_report(report, min_coverage=0.95):
                         "(no measured dispatch priced)")
     if not report.get("issued"):
         problems.append("no requests issued")
+    unknown = sorted(set(report.get("finished") or {})
+                     - set(KNOWN_FINISH_REASONS))
+    if unknown:
+        problems.append(
+            f"requests finished with unknown reason(s): {unknown}")
+    sched = report.get("scheduler")
+    level_end = (sched or {}).get("level_end",
+                                  report.get("brownout_level_end"))
+    if level_end:
+        problems.append(
+            f"serving_brownout_level did not return to 0 by end of run "
+            f"(level {level_end})")
     return problems

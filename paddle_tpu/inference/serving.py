@@ -61,6 +61,7 @@ from ..ops.paged_attention import (KVBlockFormat, kv_rollback_tokens,
                                    paged_attention_verify, write_to_cache)
 from ..profiler.phases import get_phase_accountant as _get_phases
 from ..resilience.faults import FaultInjected, fault_point
+from .scheduler import PRIORITY_CLASSES, SLOScheduler
 
 __all__ = ["ContinuousBatchingEngine", "Request", "BackpressureError",
            "KVPoolExhaustedError"]
@@ -89,15 +90,20 @@ class Request:
                  "generated", "done", "do_sample", "temperature", "top_k",
                  "top_p", "rng", "sample_seed", "t_arrival", "deadline_s",
                  "t_deadline", "finish_reason", "shed_count", "trace_id",
-                 "tenant")
+                 "tenant", "priority", "t_first")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                 seed=None, deadline_s=None, tenant="-"):
+                 seed=None, deadline_s=None, tenant="-",
+                 priority="interactive"):
         self.rid = rid
         # per-tenant telemetry label; "-" = unattributed (the default
         # keeps every pre-tenant caller's label sets unchanged)
         self.tenant = str(tenant) if tenant else "-"
+        # scheduling class (closed registry: scheduler.PRIORITY_CLASSES);
+        # validated at add_request, defaulted here so direct Request
+        # construction in tests keeps working
+        self.priority = priority
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
@@ -119,6 +125,7 @@ class Request:
                             np.uint32(int.from_bytes(os.urandom(4),
                                                      "little")))
         self.t_arrival = time.perf_counter()   # TTFT anchor
+        self.t_first = None                    # first-token wall time
         # degraded completions are distinguishable: finish_reason is one
         # of eos / length / timeout / shed / rejected (None while live)
         self.deadline_s = None if deadline_s is None else float(deadline_s)
@@ -290,6 +297,14 @@ class ContinuousBatchingEngine:
       kv_pool_bytes: size the pool by HBM budget instead of num_blocks —
         int8 fits ~2x the lanes of bf16 in the same bytes (test-pinned
         >=1.9x).
+
+    Round-14 knob (RESILIENCE.md "Overload runbook"):
+      scheduler: the closed-loop SLO scheduler (scheduler.SLOScheduler)
+        — priority classes with decode-lane preemption, per-tenant DRR
+        fairness + lane quotas, and the reversible brownout ladder.
+        None (default) = plain FIFO admission, exactly the
+        pre-scheduler engine; True = an SLOScheduler with defaults; or
+        pass a configured instance.
     """
 
     def __init__(self, model, num_blocks=256, block_size=16, max_batch=8,
@@ -299,7 +314,8 @@ class ContinuousBatchingEngine:
                  prefill_chunk=None, prefill_chunks_per_step=1,
                  compat_step_loop=False, speculative_decode=False,
                  draft_depth=2, draft_ngram=3, drafter=None,
-                 kv_cache_dtype="bf16", kv_pool_bytes=None):
+                 kv_cache_dtype="bf16", kv_pool_bytes=None,
+                 scheduler=None):
         config = model.config
         self.cfg = dict(eps=config.rms_norm_eps, theta=config.rope_theta,
                         heads=config.num_attention_heads,
@@ -417,7 +433,7 @@ class ContinuousBatchingEngine:
         self._m_accept = _metric("serving_accepted_tokens_total")
         self._m_accept_rate = _metric("serving_spec_acceptance_rate")
         self._m_tok_disp = _metric("serving_tokens_per_dispatch")
-        _metric("serving_preempted_total")  # declared: 0 by design
+        _metric("serving_preempted_total")  # incremented by _try_preempt
         # request-scoped telemetry handles, bound once; every hot-path
         # use is guarded by a single `.enabled` attribute check so the
         # disabled engine pays no allocation (kwargs pack at call sites)
@@ -439,17 +455,43 @@ class ContinuousBatchingEngine:
         # relative-accuracy signals on any backend
         self._cost_scale = None
         self._m_cost_err = _metric("pir_cost_model_error")
+        # round 14: the closed-loop SLO scheduler. Base knob values are
+        # captured here so the brownout ladder's degradations are
+        # REVERSIBLE (level 0 restores them); _spec_allowed separates
+        # the reversible brownout switch from the permanent
+        # draft_verify-fault degradation.
+        self._base_decode_steps = self.decode_steps
+        self._base_draft_depth = self.draft_depth
+        self._spec_allowed = self.spec
+        # rid -> (request, cached length, next token): decode lanes
+        # parked by preemption. Pool blocks stay allocated — resuming is
+        # a lane-state re-upload, not a re-prefill.
+        self._preempted: dict[int, tuple[Request, int, int]] = {}
+        # arrival timestamps (trailing window) — the scheduler's offered-
+        # rate estimate, independent of any load harness
+        self._arrivals: deque[float] = deque(maxlen=256)
+        if scheduler is True:
+            scheduler = SLOScheduler()
+        self.scheduler = scheduler
 
     # --- public API -------------------------------------------------------
     def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
                     do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                    seed=0, deadline_s=None, tenant="-"):
+                    seed=0, deadline_s=None, tenant="-",
+                    priority="interactive"):
         """Queue a request. `deadline_s` is a per-request wall-clock
         budget from arrival: once exceeded the request finishes with
         whatever it has and finish_reason='timeout'. `tenant` labels the
         request's per-tenant telemetry (bounded cardinality; unknown
-        tenants past the cap collapse to 'overflow'). Raises
-        BackpressureError when the admission queue is at max_queue."""
+        tenants past the cap collapse to 'overflow'). `priority` is the
+        scheduling class (closed registry scheduler.PRIORITY_CLASSES:
+        interactive / batch / best_effort) — only consulted when the
+        engine has a scheduler. Raises BackpressureError when the
+        admission queue is at max_queue."""
+        if priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"unknown priority class {priority!r}; registered: "
+                f"{list(PRIORITY_CLASSES)}")
         if self.max_queue is not None and len(self.queue) >= self.max_queue:
             _metric("serving_backpressure_total").inc()
             if self._rec.enabled:
@@ -468,8 +510,9 @@ class ContinuousBatchingEngine:
         self._next_rid += 1
         req = Request(rid, prompt, max_new_tokens, eos_token_id,
                       do_sample, temperature, top_k, top_p,
-                      seed, deadline_s, tenant=tenant)
+                      seed, deadline_s, tenant=tenant, priority=priority)
         self.queue.append(req)
+        self._arrivals.append(req.t_arrival)
         if self._tracer.enabled:
             # root of the request's span tree (instant: arrival moment)
             self._tracer.add_span("request.admit",
@@ -479,7 +522,7 @@ class ContinuousBatchingEngine:
 
     def has_work(self):
         return (bool(self.queue) or any(r is not None for r in self.lanes)
-                or bool(self._inflight))
+                or bool(self._inflight) or bool(self._preempted))
 
     def run(self, max_steps=10_000):
         """Drive to completion; returns {rid: [generated tokens]}."""
@@ -496,6 +539,11 @@ class ContinuousBatchingEngine:
         with _span("serving.step"):
             self._expire_deadlines()
             self._m_queue.set(len(self.queue))
+            if self.scheduler is not None:
+                # the closed-loop decision (brownout ladder + at most
+                # one preemption); its wall time lands in the "admit"
+                # phase — it IS admission policy
+                self.scheduler.on_step(self)
             self._admit()
             ph.mark("admit")
             self._run_prefill_tasks()
@@ -569,6 +617,20 @@ class ContinuousBatchingEngine:
                 if self._rec.enabled:
                     self._rec.record("timeout", rid=req.rid, where="decode")
                 self._retire_lane(lane, "timeout")
+        # parked (preempted) requests keep their deadline: one that
+        # expires before a lane frees up finishes with the tokens it has
+        # and releases its still-resident pool blocks
+        for rid in [rid for rid, (req, _ln, _tok)
+                    in self._preempted.items()
+                    if req.t_deadline is not None
+                    and now >= req.t_deadline]:
+            req, _ln, _tok = self._preempted.pop(rid)
+            self.pool.release(rid)
+            _metric("serving_timeouts_total", where="preempted").inc()
+            if self._rec.enabled:
+                self._rec.record("timeout", rid=rid, where="preempted")
+            self._m_retired.inc()
+            self._finish(req, "timeout")
 
     def _shed(self, active):
         """Decode OOM: preempt the lane with the least work done (fewest
@@ -601,29 +663,117 @@ class ContinuousBatchingEngine:
         req.generated = []
         self.queue.appendleft(req)
 
+    # --- priority preemption (round 14) ----------------------------------
+    def _try_preempt(self, lane, why="slo"):
+        """Park a decode-active lane so a higher-priority request can
+        take it. Unlike _shed, the paged-KV blocks STAY resident and the
+        host decode cursor (lane_len / lane_tok) is saved: resuming is a
+        lane-state re-upload through the membership-change path, so the
+        stream continues byte-identically (greedy is deterministic;
+        sampled lanes key the device PRNG on absolute position). Any
+        tokens of the lane still in a dropped in-flight tile are
+        regenerated identically after resume — the epoch bump below
+        prevents double-crediting. Returns False when the lane is not
+        preemptible (empty / still prefilling) or the serve.preempt
+        fault site fires: a failed preemption aborts cleanly and the
+        victim keeps decoding."""
+        req = self.lanes[lane]
+        if req is None or lane in self._prefill_tasks:
+            return False
+        try:
+            fault_point("serve.preempt", rid=req.rid, lane=lane)
+        except _TRANSIENT_ERRORS:
+            _metric("serving_deferred_total", reason="preempt_fault").inc()
+            return False
+        self._preempted[req.rid] = (req, int(self.lane_len[lane]),
+                                    int(self.lane_tok[lane]))
+        self.lanes[lane] = None
+        self.lane_len[lane] = 0
+        self._lane_epoch[lane] += 1
+        self._dirty = True
+        _metric("serving_preempted_total").inc()
+        _metric("serving_preemptions_total",
+                **{"class": req.priority}).inc()
+        if self._rec.enabled:
+            self._rec.record("sched", action="preempt", rid=req.rid,
+                             lane=lane, why=why,
+                             tokens=len(req.generated))
+        if self._tracer.enabled:
+            self._tracer.add_span("request.preempt",
+                                  time.perf_counter_ns(), 0,
+                                  trace_id=req.trace_id,
+                                  args={"rid": req.rid, "why": why})
+        return True
+
+    def _resume_preempted(self):
+        """Re-admit parked requests into free lanes (oldest first). The
+        pool blocks never left, so this is just the host mirror restore
+        + an epoch bump; the next _decode_phase re-uploads lane state
+        and the stream picks up exactly where it was parked."""
+        for rid in list(self._preempted):
+            lane = next((i for i, r in enumerate(self.lanes)
+                         if r is None and i not in self._prefill_tasks),
+                        None)
+            if lane is None:
+                return
+            req, lane_len, lane_tok = self._preempted.pop(rid)
+            self.lanes[lane] = req
+            self.lane_len[lane] = lane_len
+            self.lane_tok[lane] = lane_tok
+            self._lane_epoch[lane] += 1
+            self._dirty = True
+            if self._rec.enabled:
+                self._rec.record("sched", action="resume", rid=rid,
+                                 lane=lane, tokens=len(req.generated))
+
     # --- admission / chunked prefill -------------------------------------
     def _admit(self):
         """Reserve lanes + pool blocks for queued requests; the prompts
         themselves prefill chunk-by-chunk in _run_prefill_tasks so a long
-        admission never head-of-line-blocks the decode lanes."""
+        admission never head-of-line-blocks the decode lanes. With a
+        scheduler attached, parked (preempted) requests resume first
+        when the scheduler allows, and queue order comes from its
+        priority-class + tenant-DRR pick instead of FIFO."""
+        if self._preempted and (self.scheduler is None
+                                or self.scheduler.should_resume(self)):
+            self._resume_preempted()
         while self.queue:
             free_lanes = [i for i, r in enumerate(self.lanes) if r is None]
             if not free_lanes:
                 return
-            req = self.queue[0]
+            if self.scheduler is not None:
+                idx = self.scheduler.pick_index(self)
+                if idx is None:
+                    return
+            else:
+                idx = 0
+            req = self.queue[idx]
+            if (self.scheduler is not None
+                    and self.scheduler.shed_best_effort
+                    and req.priority == "best_effort"):
+                # deepest brownout rung: best_effort is not served at
+                # all; a typed, counted shed — not a silent drop
+                del self.queue[idx]
+                req.generated = []
+                _metric("serving_shed_total").inc()
+                if self._rec.enabled:
+                    self._rec.record("sched", action="shed_best_effort",
+                                     rid=req.rid)
+                self._finish(req, "shed")
+                continue
             total = req.prompt.size + req.max_new_tokens
             if total > self.max_blocks_per_seq * self.pool.block_size:
                 # cannot ever serve: reject with an empty result instead
                 # of crashing the engine mid-step (prompts longer than
                 # the largest bucket are now served via chunking; only
                 # the per-sequence block budget is a hard wall)
-                self.queue.popleft()
+                del self.queue[idx]
                 req.generated = []
                 self._finish(req, "rejected")
                 _metric("serving_rejected_total", reason="oversized").inc()
                 continue
             if req.max_new_tokens <= 0:
-                self.queue.popleft()
+                del self.queue[idx]
                 self._finish(req, "length")
                 continue
             # admit only if the WHOLE sequence fits: no mid-flight
@@ -632,7 +782,7 @@ class ContinuousBatchingEngine:
             if not self.pool.can_fit(total):
                 _metric("serving_deferred_total", reason="pool_full").inc()
                 return
-            self.queue.popleft()
+            del self.queue[idx]
             lane = free_lanes[0]
             try:
                 fault_point("serve.admit", rid=req.rid)
@@ -801,9 +951,12 @@ class ContinuousBatchingEngine:
         # the exemplar ties this observation's bucket to the exact trace
         # that produced it (bad p99 -> exact request)
         ttft = time.perf_counter() - req.t_arrival
+        req.t_first = req.t_arrival + ttft
         self._m_ttft.observe(ttft, exemplar=req.trace_id)
         _metric("serving_tenant_ttft_seconds",
                 tenant=req.tenant).observe(ttft)
+        if self.scheduler is not None:
+            self.scheduler.note_ttft(ttft)
         self._emit(lane, first_tok)
         return True
 
@@ -913,8 +1066,12 @@ class ContinuousBatchingEngine:
         """serve.draft_verify degradation: permanently fall back to the
         non-speculative fused decode. Streams continue byte-identically
         (speculation never changes the committed tokens); only the
-        tokens-per-dispatch multiplier is lost."""
+        tokens-per-dispatch multiplier is lost. Unlike the brownout
+        ladder's reversible switch, this is permanent: _spec_allowed
+        goes False so a later brownout recovery cannot re-enable a
+        faulted drafter."""
         self.spec = False
+        self._spec_allowed = False
         _metric("serving_runtime_degradations_total",
                 what="speculation_off").inc()
         if self._rec.enabled:
@@ -944,13 +1101,49 @@ class ContinuousBatchingEngine:
         if self._rec.enabled:
             self._rec.record("degrade", what="kv_bf16", fmt=fmt.name)
 
+    # --- brownout knobs (round 14) ---------------------------------------
+    # The ladder's setters are REVERSIBLE, unlike the fault degradations
+    # above: they only flip the knob and mark lane state dirty. The
+    # membership machinery drains any in-flight tile under its dispatch-
+    # time program before the next dispatch compiles/reuses the new
+    # (variant, K, D)-keyed program — so a mid-flight knob change can
+    # never double-emit or drop a token, and byte-identity is exactly
+    # the already-pinned across-K stream invariance.
+    def _set_decode_steps(self, k):
+        k = 1 if self.compat_step_loop else max(1, int(k))
+        if k == self.decode_steps:
+            return
+        self.decode_steps = k
+        self._dirty = True
+
+    def _set_draft_depth(self, d):
+        d = max(1, min(int(d), self.pool.block_size - 1))
+        if d == self.draft_depth:
+            return
+        self.draft_depth = d
+        self._dirty = True
+
+    def _set_speculation(self, on):
+        want = bool(on) and self._spec_allowed \
+            and not self.compat_step_loop
+        if want == self.spec:
+            return
+        self.spec = want
+        self._dirty = True
+
     def _dispatch(self):
         d = self._dev
         variant = d["variant"]
         spec = variant.endswith(".spec")
         sampled = variant.startswith("sampled")
         quant = self.pool.fmt.quantized
-        fn = self._decode_jit.get(variant)
+        # the compiled program closes over K (decode_steps) and D
+        # (draft_depth) at make time, so the cache key carries them:
+        # a brownout transition swaps programs without clearing the
+        # cache, and recovery swaps straight back to the warm base one
+        jit_key = (variant, self.decode_steps,
+                   self.draft_depth if spec else 0)
+        fn = self._decode_jit.get(jit_key)
         cold = fn is None or fn._compiled is None
         if fn is None:
             # decode keeps donation (the KV pools must not double-buffer),
@@ -962,7 +1155,7 @@ class ContinuousBatchingEngine:
             maker = self._make_decode_spec if spec else self._make_decode
             fn = pir_jit(maker(sampled), name=name,
                          donate_argnums=(4, 5, 6, 7) if quant else (4, 5))
-            self._decode_jit[variant] = fn
+            self._decode_jit[jit_key] = fn
         args = [self.stacked, self.embed_w, self.norm_w, self._out_w,
                 self.pool.k, self.pool.v]
         if quant:
@@ -1056,6 +1249,8 @@ class ContinuousBatchingEngine:
         if not infl.spec:
             per_tok = (t1 - infl.t_dispatch) / infl.k
             self._m_tpot.observe(per_tok, exemplar=ex)
+            if self.scheduler is not None:
+                self.scheduler.note_tpot(per_tok)
             for t in sorted({r.tenant for r in infl.reqs
                              if r is not None and not r.done}):
                 _metric("serving_tenant_tpot_seconds",
@@ -1150,7 +1345,11 @@ class ContinuousBatchingEngine:
                        if k.startswith("decode")), None)
         if decode is None or decode["seconds"] is None:
             return None
-        t = (output_tokens / self.decode_steps) \
+        # priced against the BASE decode program (the calibrated report
+        # belongs to it): the estimate stays a stable capacity signal
+        # for the undegraded engine even while the brownout ladder has
+        # decode_steps temporarily shrunk
+        t = (output_tokens / self._base_decode_steps) \
             * decode["seconds"] / self.max_batch
         prefill = next((c for k, c in sorted(costs.items())
                         if k.startswith("prefill")), None)
@@ -1233,6 +1432,8 @@ class ContinuousBatchingEngine:
         eff = credited / max(1, lanes_credited)
         per_tok = (t1 - infl.t_dispatch) / max(1.0, eff)
         self._m_tpot.observe(per_tok, exemplar=ex)
+        if self.scheduler is not None:
+            self.scheduler.note_tpot(per_tok)
         for t in sorted({r.tenant for r in infl.reqs
                          if r is not None and not r.done}):
             _metric("serving_tenant_tpot_seconds", tenant=t).observe(per_tok)

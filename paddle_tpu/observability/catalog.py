@@ -59,9 +59,25 @@ CATALOG = {
         "counter", "admissions deferred (request stays queued)",
         ("reason",), None),
     "serving_preempted_total": (
-        "counter", "mid-flight preemptions (0 by design: whole-sequence "
-        "admission; counted so a future preempting scheduler is visible)",
-        (), None),
+        "counter", "mid-flight decode-lane preemptions by the SLO "
+        "scheduler (unlabelled total; serving_preemptions_total is the "
+        "by-class sibling)", (), None),
+    "serving_preemptions_total": (
+        "counter", "decode-lane preemptions by priority class of the "
+        "preempted request — paged-KV blocks stay resident and the "
+        "stream resumes byte-identically", ("class",), None),
+    "serving_brownout_level": (
+        "gauge", "current brownout-ladder level index (0 = normal; the "
+        "closed, ordered registry is inference/scheduler.py "
+        "BROWNOUT_LEVELS, documented in RESILIENCE.md)", (), None),
+    "serving_brownout_transitions_total": (
+        "counter", "brownout-ladder level transitions by direction (up "
+        "= escalate under SLO pressure, down = recover with "
+        "hysteresis)", ("direction",), None),
+    "serving_quota_deferrals_total": (
+        "counter", "admissions deferred because the tenant sits at its "
+        "lane quota (the DRR pick skips it; the request stays queued)",
+        ("tenant",), None),
     "serving_tokens_total": (
         "counter", "tokens emitted across all requests", (), None),
     "serving_finished_total": (
@@ -70,7 +86,7 @@ CATALOG = {
         "distinguishable", ("reason",), None),
     "serving_timeouts_total": (
         "counter", "per-request deadlines expired, by where the request "
-        "was (queue/decode)", ("where",), None),
+        "was (queue/decode/preempted)", ("where",), None),
     "serving_shed_total": (
         "counter", "decode-OOM lane sheds (request requeued for a fresh "
         "prefill, or finished 'shed' past max_sheds)", (), None),
@@ -128,7 +144,8 @@ CATALOG = {
     "serving_runtime_degradations_total": (
         "counter", "permanent runtime degradations taken by the engine "
         "(speculation_off: draft/verify fault -> non-speculative decode; "
-        "kv_bf16: dequant fault -> pool dequantized to the native dtype)",
+        "kv_bf16: dequant fault -> pool dequantized to the native dtype; "
+        "sched_fifo: scheduler decision fault -> plain FIFO admission)",
         ("what",), None),
     "serving_phase_seconds": (
         "histogram", "one phase-attributed segment of engine step wall "

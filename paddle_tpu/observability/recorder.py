@@ -55,7 +55,9 @@ EVENT_KINDS = {
     "pir_pipeline": "PIR pass pipeline ran (pass count, cache status)",
     "retry": "resilient retry of a transient failure",
     "degrade": "serving runtime permanently dropped a feature "
-               "(speculation_off | kv_bf16) after a fault",
+               "(speculation_off | kv_bf16 | sched_fifo) after a fault",
+    "sched": "SLO scheduler action (brownout level transition, lane "
+             "preempt/resume, best_effort shed)",
     "error": "unhandled error captured by a crash handler",
     "note": "free-form marker (drills, tests)",
     "profile": "profiler/loadgen summary (phase coverage, scenario, "

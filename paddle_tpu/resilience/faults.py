@@ -75,6 +75,16 @@ FAULT_SITES = {
                           "(injected failure models clock skew / a "
                           "stalled driver; the tick is skipped and "
                           "counted, its arrivals re-issued next tick)",
+    "serve.sched_decide": "SLO scheduler: the per-step closed-loop "
+                          "decision (brownout ladder + preemption "
+                          "choice); ANY failure degrades scheduling to "
+                          "plain FIFO for the engine's lifetime — "
+                          "knobs restored, parked lanes resumed, no "
+                          "deadlock, no dropped request",
+    "serve.preempt": "SLO scheduler: one decode-lane preemption "
+                     "(paged-KV stays resident); failure aborts that "
+                     "attempt, counted, and the victim lane keeps "
+                     "decoding",
     "train.step_nonfinite": "train supervisor: force a non-finite loss "
                             "for this step (consulted via check())",
     "compile.cache_read": "PIR compile cache: artifact read (verified "

@@ -282,6 +282,35 @@ class TestOverloadOrdering:
             f"sample {over_idx}, shed>10% at {shed_idx}")
         assert report["shed"] > 0      # the overload was real
 
+    def test_scheduler_engages_before_shed(self, enabled_obs):
+        """Round 14: with the SLO scheduler attached, the closed loop
+        ACTS (brownout level > 0 or a preemption) no later than the
+        sample where shed fraction crosses 10% — degradation is chosen
+        before work is dropped."""
+        from paddle_tpu.inference.scheduler import SLOScheduler
+        eng = _engine(_model(), max_batch=1, decode_steps=1, max_queue=8,
+                      scheduler=SLOScheduler(ttft_target=1e9,
+                                             tpot_target=1e9,
+                                             escalate_after=1,
+                                             min_dwell=0))
+        _warm(eng)
+        assert eng.predicted_service_seconds(output_tokens=8) is not None
+
+        report = run_scenario(eng, "chat", seed=2, rate_rps=400.0,
+                              duration_s=0.5, drain=False,
+                              sample_every_s=0.05)
+        tl = report["timeline"]
+        engage_idx = next((i for i, s in enumerate(tl)
+                           if (s.get("brownout") or 0) > 0
+                           or (s.get("preemptions") or 0) > 0), len(tl))
+        shed_idx = next((i for i, s in enumerate(tl)
+                         if s["shed_frac"] > 0.10), len(tl))
+        assert engage_idx < len(tl), "scheduler never engaged"
+        assert engage_idx <= shed_idx, (
+            f"scheduler lagged the shed signal: engaged at sample "
+            f"{engage_idx}, shed>10% at {shed_idx}")
+        assert eng.scheduler.transitions_up > 0
+
 
 class TestPhaseAccountant:
     def test_unknown_phase_raises(self):

@@ -1,0 +1,437 @@
+"""Overload-safe SLO scheduler (round 14).
+
+Contracts pinned here:
+  * the priority-class and brownout-level registries are closed and
+    ordered; level_index/level_name round-trip and unknown names raise;
+  * the brownout ladder moves one rung at a time with hysteresis:
+    escalation needs `escalate_after` consecutive bad decisions,
+    recovery needs `recover_after` consecutive good ones, and every
+    transition starts a `min_dwell` cooldown; a single bad step resets
+    the recovery streak;
+  * the ladder's knob changes are cumulative and REVERSIBLE: level 0
+    restores the constructor-time decode_steps/draft_depth/speculation —
+    except across a permanent fault degradation (_disable_spec), which
+    the setters respect;
+  * preempting a decode lane keeps its paged-KV resident and parks the
+    host cursor; the resumed stream is byte-identical (greedy AND
+    sampled) to an unpreempted run;
+  * a parked request's deadline still expires: finish_reason='timeout',
+    blocks released;
+  * admission order is deficit-round-robin over tenants within a
+    priority class: one tenant's flood of long requests cannot starve
+    another's short ones, and a tenant at its lane quota is skipped with
+    a counted deferral;
+  * any exception out of the per-step decision (the serve.sched_decide
+    fault site) degrades scheduling to plain FIFO for the engine's
+    lifetime: knobs restored, pick_index becomes 0, requests finish
+    normally.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import ContinuousBatchingEngine
+from paddle_tpu.inference.loadgen import KNOWN_FINISH_REASONS, run_scenario
+from paddle_tpu.inference.scheduler import (BROWNOUT_LEVELS, MAX_LEVEL,
+                                            PRIORITY_CLASSES, SLOScheduler,
+                                            _Signals, level_index,
+                                            level_name)
+from paddle_tpu.inference.serving import Request
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.resilience.faults import injected_faults
+
+BAD = _Signals(headroom=-0.5)
+GOOD = _Signals(headroom=0.9)
+
+
+def _model():
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=256)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+def _engine(model, **kw):
+    kw.setdefault("num_blocks", 128)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("prefill_buckets", (16,))
+    return ContinuousBatchingEngine(model, **kw)
+
+
+@pytest.fixture
+def enabled_obs():
+    obs.get_registry().reset()
+    obs.enable()
+    yield obs
+
+
+class TestRegistries:
+    def test_priority_classes_closed_and_ordered(self):
+        assert list(PRIORITY_CLASSES) == ["interactive", "batch",
+                                          "best_effort"]
+        assert all(isinstance(v, str) and v for v in
+                   PRIORITY_CLASSES.values())
+
+    def test_brownout_levels_closed_and_ordered(self):
+        assert list(BROWNOUT_LEVELS) == [
+            "normal", "shrink_decode_steps", "reduce_draft_depth",
+            "disable_speculation", "shed_best_effort"]
+        assert MAX_LEVEL == len(BROWNOUT_LEVELS) - 1
+
+    def test_level_index_roundtrip(self):
+        for i, name in enumerate(BROWNOUT_LEVELS):
+            assert level_index(name) == i
+            assert level_name(i) == name
+
+    def test_unknown_level_raises(self):
+        with pytest.raises(KeyError):
+            level_index("panic")
+
+    def test_unknown_priority_rejected_at_admission(self):
+        eng = _engine(_model())
+        with pytest.raises(ValueError):
+            eng.add_request(np.arange(4), max_new_tokens=2,
+                            priority="urgent")
+
+
+class TestBrownoutLadder:
+    """Pure decide() tests: no engine, no model — _Signals in,
+    transitions out."""
+
+    def test_escalates_one_rung_at_a_time(self):
+        sched = SLOScheduler(escalate_after=2, recover_after=4, min_dwell=2)
+        levels = []
+        for _ in range(40):
+            sched.decide(BAD)
+            levels.append(sched.level)
+        assert levels[-1] == MAX_LEVEL
+        diffs = [b - a for a, b in zip(levels, levels[1:])]
+        assert all(d in (0, 1) for d in diffs), diffs
+        # hysteresis: strictly fewer transitions than decisions — at
+        # least escalate_after decisions separate consecutive rungs
+        assert sum(diffs) == MAX_LEVEL
+        assert len([d for d in diffs if d == 1]) < len(diffs) / 2
+
+    def test_never_exceeds_max_level(self):
+        sched = SLOScheduler(escalate_after=1, min_dwell=0)
+        for _ in range(50):
+            sched.decide(BAD)
+        assert sched.level == MAX_LEVEL
+        assert sched.transitions_up == MAX_LEVEL
+
+    def test_recovery_is_slower_than_escalation(self):
+        sched = SLOScheduler(escalate_after=1, recover_after=4, min_dwell=0)
+        while sched.level < MAX_LEVEL:
+            sched.decide(BAD)
+        up_decisions = sched.transitions_up
+        n = 0
+        while sched.level > 0:
+            sched.decide(GOOD)
+            n += 1
+            assert n < 200
+        assert sched.transitions_down == MAX_LEVEL
+        # recover_after=4 vs escalate_after=1: descent takes more
+        # consecutive-good decisions than ascent took bad ones
+        assert n >= 4 * MAX_LEVEL > up_decisions
+
+    def test_one_bad_step_resets_recovery_streak(self):
+        sched = SLOScheduler(escalate_after=1, recover_after=4, min_dwell=0)
+        sched.decide(BAD)
+        assert sched.level == 1
+        for _ in range(3):
+            assert not sched.decide(GOOD)
+        sched.decide(BAD)            # resets _good; escalates to 2
+        assert sched.level == 2
+        for _ in range(3):
+            assert not sched.decide(GOOD)   # streak restarted from zero
+        assert sched.level == 2
+        assert sched.decide(GOOD)
+        assert sched.level == 1
+
+    def test_ttft_and_tpot_breaches_count_as_bad(self):
+        sched = SLOScheduler(ttft_target=0.1, tpot_target=0.01,
+                             escalate_after=1, min_dwell=0)
+        sched.decide(_Signals(headroom=0.9, ttft_p95=0.5))
+        assert sched.level == 1
+        sched2 = SLOScheduler(ttft_target=0.1, tpot_target=0.01,
+                              escalate_after=1, min_dwell=0)
+        sched2.decide(_Signals(headroom=0.9, tpot_p99=0.5))
+        assert sched2.level == 1
+
+    def test_no_signals_is_not_bad(self):
+        sched = SLOScheduler(escalate_after=1, min_dwell=0)
+        for _ in range(5):
+            assert not sched.decide(_Signals())
+        assert sched.level == 0
+
+
+class TestBrownoutKnobs:
+    def test_ladder_knobs_cumulative_and_reversible(self):
+        eng = _engine(_model(), decode_steps=4, speculative_decode=True,
+                      draft_depth=2)
+        sched = SLOScheduler()
+        base = (eng.decode_steps, eng.draft_depth, eng.spec)
+        assert base == (4, 2, True)
+        want = {
+            "normal": (4, 2, True, False),
+            "shrink_decode_steps": (2, 2, True, False),
+            "reduce_draft_depth": (2, 1, True, False),
+            "disable_speculation": (2, 1, False, False),
+            "shed_best_effort": (2, 1, False, True),
+        }
+        for name, (k, d, spec, shed) in want.items():
+            sched.level = level_index(name)
+            sched._apply(eng)
+            assert (eng.decode_steps, eng.draft_depth, eng.spec,
+                    sched.shed_best_effort) == (k, d, spec, shed), name
+        sched.level = 0
+        sched._apply(eng)
+        assert (eng.decode_steps, eng.draft_depth, eng.spec) == base
+
+    def test_recovery_respects_permanent_spec_degradation(self):
+        eng = _engine(_model(), decode_steps=4, speculative_decode=True,
+                      draft_depth=2)
+        sched = SLOScheduler()
+        sched.level = MAX_LEVEL
+        sched._apply(eng)
+        eng._disable_spec("drill")      # fault path: permanent
+        sched.level = 0
+        sched._apply(eng)
+        assert eng.decode_steps == 4 and eng.draft_depth == 2
+        assert not eng.spec             # stays off: fault wins over ladder
+
+
+class TestPreemptResume:
+    def _drive_to_decode(self, eng):
+        for _ in range(50):
+            if eng._decode_active():
+                return eng._decode_active()[0]
+            eng.step()
+        raise AssertionError("request never reached a decode lane")
+
+    def test_greedy_stream_byte_identical(self):
+        model = _model()
+        p = (np.arange(6) * 5) % 128
+        base = _engine(model, max_batch=1)
+        rid = base.add_request(p, max_new_tokens=10)
+        ref = base.run()[rid]
+        assert len(ref) == 10
+
+        eng = _engine(model, max_batch=1)
+        rid = eng.add_request(p, max_new_tokens=10, priority="batch")
+        lane = self._drive_to_decode(eng)
+        eng.step()
+        eng.step()
+        assert eng._try_preempt(lane, why="test")
+        assert eng._preempted          # parked, KV resident
+        assert eng.pool.tables         # blocks NOT released
+        out = eng.run()[rid]
+        assert out == ref
+        assert eng._preempted == {} and eng.pool.tables == {}
+
+    def test_sampled_stream_byte_identical(self):
+        model = _model()
+        p = (np.arange(8) * 3) % 128
+        kw = dict(max_new_tokens=12, do_sample=True, temperature=0.8,
+                  top_p=0.9, seed=7)
+        base = _engine(model, max_batch=1)
+        rid = base.add_request(p, **kw)
+        ref = base.run()[rid]
+
+        eng = _engine(model, max_batch=1)
+        rid = eng.add_request(p, priority="batch", **kw)
+        lane = self._drive_to_decode(eng)
+        eng.step()
+        assert eng._try_preempt(lane, why="test")
+        out = eng.run()[rid]
+        assert out == ref              # device PRNG keys on absolute pos
+
+    def test_parked_deadline_expires_with_timeout(self):
+        eng = _engine(_model(), max_batch=1)
+        p = (np.arange(6) * 5) % 128
+        rid = eng.add_request(p, max_new_tokens=64, priority="batch",
+                              deadline_s=30.0)
+        lane = self._drive_to_decode(eng)
+        eng.step()
+        assert eng._try_preempt(lane, why="test")
+        # expire the parked request without sleeping through compiles
+        req, _len, _tok = eng._preempted[rid]
+        req.t_deadline = time.perf_counter() - 1.0
+        eng.run()
+        req = eng.finished[rid]
+        assert req.finish_reason == "timeout"
+        assert eng._preempted == {} and eng.pool.tables == {}
+
+    def test_preempt_refuses_empty_and_prefilling_lanes(self):
+        eng = _engine(_model(), max_batch=2)
+        assert not eng._try_preempt(0, why="test")      # empty lane
+        eng.add_request((np.arange(20) * 7) % 128, max_new_tokens=4)
+        eng.step()                                      # mid-prefill
+        busy = [i for i, r in enumerate(eng.lanes) if r is not None]
+        if busy and busy[0] in eng._prefill_tasks:
+            assert not eng._try_preempt(busy[0], why="test")
+        eng.run()
+
+
+class TestDRRFairness:
+    def test_flood_cannot_starve_short_tenant(self):
+        eng = _engine(_model(), scheduler=SLOScheduler(quantum=8))
+        p = np.arange(6) % 128
+        for _ in range(4):
+            eng.add_request(p, max_new_tokens=50, tenant="A",
+                            priority="batch")
+        for _ in range(2):
+            eng.add_request(p, max_new_tokens=4, tenant="B",
+                            priority="batch")
+        order = []
+        while eng.queue:
+            idx = eng.scheduler.pick_index(eng)
+            order.append(eng.queue[idx].tenant)
+            del eng.queue[idx]
+        # B's cheap requests (cost 10) earn credit faster than A's
+        # floods (cost 56): both drain before A monopolizes the lanes
+        assert order == ["B", "B", "A", "A", "A", "A"]
+
+    def test_priority_classes_strictly_dominate(self):
+        eng = _engine(_model(), scheduler=SLOScheduler())
+        p = np.arange(4) % 128
+        eng.add_request(p, max_new_tokens=4, priority="best_effort")
+        eng.add_request(p, max_new_tokens=4, priority="batch")
+        eng.add_request(p, max_new_tokens=4, priority="interactive")
+        picks = []
+        while eng.queue:
+            idx = eng.scheduler.pick_index(eng)
+            picks.append(eng.queue[idx].priority)
+            del eng.queue[idx]
+        assert picks == ["interactive", "batch", "best_effort"]
+
+    def test_tenant_quota_defers_and_counts(self, enabled_obs):
+        eng = _engine(_model(),
+                      scheduler=SLOScheduler(tenant_quota=1))
+        # tenant A already owns a lane
+        eng.lanes[0] = Request(99, np.arange(4), 4, None, tenant="A")
+        eng.add_request(np.arange(4) % 128, max_new_tokens=4, tenant="A")
+        eng.add_request(np.arange(4) % 128, max_new_tokens=4, tenant="B")
+        idx = eng.scheduler.pick_index(eng)
+        assert eng.queue[idx].tenant == "B"
+        fam = obs.get_registry().get("serving_quota_deferrals_total")
+        assert fam.labels(tenant="A").value == 1.0
+        eng.lanes[0] = None
+
+    def test_quota_counts_parked_lanes(self):
+        eng = _engine(_model(),
+                      scheduler=SLOScheduler(tenant_quota=1))
+        parked = Request(98, np.arange(4), 4, None, tenant="A")
+        eng._preempted[98] = (parked, 4, 0)
+        eng.add_request(np.arange(4) % 128, max_new_tokens=4, tenant="A")
+        eng.add_request(np.arange(4) % 128, max_new_tokens=4, tenant="B")
+        idx = eng.scheduler.pick_index(eng)
+        assert eng.queue[idx].tenant == "B"
+        eng._preempted.clear()
+
+
+class TestFifoDegrade:
+    def test_decision_fault_degrades_to_fifo(self, enabled_obs):
+        eng = _engine(_model(), scheduler=True)
+        p = (np.arange(6) * 5) % 128
+        rid = eng.add_request(p, max_new_tokens=6, priority="batch")
+        with injected_faults("serve.sched_decide:1:RuntimeError"):
+            out = eng.run()
+        assert eng.scheduler.fifo
+        assert eng.finished[rid].finish_reason in KNOWN_FINISH_REASONS
+        assert len(out[rid]) == 6
+        # knobs restored, ladder forced back to 0
+        assert eng.decode_steps == eng._base_decode_steps
+        assert eng.scheduler.level == 0
+        assert not eng.scheduler.shed_best_effort
+        fam = obs.get_registry().get("serving_runtime_degradations_total")
+        assert fam.labels(what="sched_fifo").value == 1.0
+        # admission is plain FIFO from now on
+        eng.add_request(p, max_new_tokens=2, priority="best_effort")
+        eng.add_request(p, max_new_tokens=2, priority="interactive")
+        assert eng.scheduler.pick_index(eng) == 0
+        assert eng.scheduler.should_resume(eng)
+        eng.run()
+
+    def test_scheduler_true_builds_default(self):
+        eng = _engine(_model(), scheduler=True)
+        assert isinstance(eng.scheduler, SLOScheduler)
+        assert not eng.scheduler.fifo
+
+
+class TestShedBestEffort:
+    def test_deepest_rung_sheds_best_effort_at_admission(self, enabled_obs):
+        sched = SLOScheduler()
+        eng = _engine(_model(), scheduler=sched)
+        sched.level = MAX_LEVEL
+        sched._apply(eng)
+        p = np.arange(4) % 128
+        rid_be = eng.add_request(p, max_new_tokens=4,
+                                 priority="best_effort")
+        rid_ia = eng.add_request(p, max_new_tokens=4,
+                                 priority="interactive")
+        out = eng.run()
+        assert eng.finished[rid_be].finish_reason == "shed"
+        assert out[rid_be] == []
+        assert eng.finished[rid_ia].finish_reason in ("eos", "length")
+        assert len(out[rid_ia]) >= 1
+
+
+@pytest.mark.slow
+class TestSaturation:
+    def test_scheduler_engages_and_recovers_under_ramp(self):
+        obs.get_registry().reset()
+        obs.enable()
+        model = _model()
+        # saturable: one decode step per dispatch, 2 lanes; headroom
+        # goes non-positive as the structured_output ramp climbs to
+        # 24 rps. Targets are effectively disabled so engagement and
+        # recovery are driven by the headroom signal alone (the
+        # TTFT/TPOT windows are not time-decayed, so stale breach
+        # observations would otherwise pin the ladder up after drain).
+        eng = _engine(model, max_batch=2, decode_steps=1, max_queue=32,
+                      prefill_buckets=(16, 32),
+                      scheduler=SLOScheduler(ttft_target=1e9,
+                                             tpot_target=1e9,
+                                             escalate_after=1,
+                                             min_dwell=0))
+        eng.add_request(np.arange(7) % 128, max_new_tokens=4)
+        eng.add_request(np.arange(20) % 128, max_new_tokens=4)
+        eng.run()       # calibrate cost model + compile both buckets
+        assert eng.predicted_service_seconds(output_tokens=8) is not None
+
+        rep = run_scenario(eng, "structured_output", seed=3,
+                           duration_s=1.5, sample_every_s=0.1)
+        sched = eng.scheduler
+        assert not sched.fifo
+        # the loop actually acted under saturation
+        assert sched.transitions_up + sched.preempt_requests > 0
+        # interactive TTFT p95 held within the DEFAULT_SLOS objective
+        # while batch took the pressure
+        cls = rep["classes"].get("interactive")
+        assert cls and cls["finished"] > 0
+        assert cls["ttft_p95"] <= 2.5
+        # reversal: once arrivals stop and the headroom window ages
+        # out, consecutive good decisions walk the ladder back to 0
+        # (idle steps — the drain itself may finish inside the trailing
+        # rate window, before recovery hysteresis can complete)
+        deadline = time.time() + 30.0
+        while sched.level > 0 and time.time() < deadline:
+            eng.step()
+            time.sleep(0.01)
+        assert sched.level == 0
+        assert eng.decode_steps == eng._base_decode_steps
+        fam = obs.get_registry().get("serving_brownout_level")
+        assert fam.value == 0.0
+        # no request lost, every finish reason known (the finished
+        # histogram also counts the two warm-up requests, so the
+        # no-loss proof is engine state, not issued == finished)
+        assert set(rep["finished"]) <= set(KNOWN_FINISH_REASONS)
+        assert not eng.has_work()
+        assert eng._preempted == {} and eng.pool.tables == {}

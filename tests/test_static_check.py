@@ -30,7 +30,8 @@ def test_list_rules_names_the_closed_registry():
     r = _run("--list-rules")
     assert r.returncode == 0
     for rule in ("metrics-in-catalog", "catalog-docs-sync", "fault-sites",
-                 "recorder-kinds", "flags-registered", "host-sync"):
+                 "recorder-kinds", "flags-registered", "host-sync",
+                 "profiler-phases", "scheduler-actions"):
         assert rule in r.stdout
 
 
@@ -56,6 +57,25 @@ def test_injected_violation_fails(tmp_path, source, rule):
     assert r.returncode == 1, f"violation not caught:\n{r.stdout}"
     found = json.loads(r.stdout)
     assert any(v["rule"] == rule for v in found), found
+
+
+def test_scheduler_actions_rule_catches_unregistered_literals(tmp_path):
+    # a file masquerading as the scheduler with literals outside the
+    # closed PRIORITY_CLASSES / BROWNOUT_LEVELS registries
+    bad = tmp_path / "paddle_tpu" / "inference"
+    bad.mkdir(parents=True)
+    f = bad / "scheduler.py"
+    f.write_text("_IDX = level_index('panic')\n"
+                 "def admit(req, priority='vip'):\n"
+                 "    if req.priority == 'urgent':\n"
+                 "        return submit(req, priority='turbo')\n")
+    r = _run("--paths", str(f), "--json")
+    assert r.returncode == 1
+    found = [v for v in json.loads(r.stdout)
+             if v["rule"] == "scheduler-actions"]
+    msgs = " | ".join(v["message"] for v in found)
+    for lit in ("panic", "vip", "urgent", "turbo"):
+        assert f"'{lit}'" in msgs, (lit, found)
 
 
 def test_host_sync_rule_catches_new_sync(tmp_path):

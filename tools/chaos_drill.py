@@ -317,6 +317,69 @@ def drill_serve_loadgen_tick(tmp):
                          "next tick; profiler off-path byte-identical")
 
 
+def drill_serve_sched_decide(tmp):
+    from paddle_tpu.inference import loadgen
+    model, eng = _tiny_engine(num_blocks=128, max_batch=2, scheduler=True)
+    with faults.injected_faults("serve.sched_decide:1:RuntimeError"):
+        rep = loadgen.run_scenario(eng, "structured_output", seed=1,
+                                   duration_s=0.4, sample_every_s=0.1)
+        inj = faults.injected_counts().get("serve.sched_decide", 0)
+    _expect(inj == 1, "fault never reached the scheduler decision site")
+    _expect(eng.scheduler.fifo,
+            "scheduler did not degrade to FIFO after the decision fault")
+    _expect(_counter("serving_runtime_degradations_total",
+                     what="sched_fifo") >= 1, "degradation not counted")
+    unknown = set(rep["finished"]) - set(loadgen.KNOWN_FINISH_REASONS)
+    _expect(not unknown, f"unknown finish reasons under FIFO: {unknown}")
+    _expect(rep["issued"] == sum(rep["finished"].values()),
+            f"requests lost across the degrade: issued={rep['issued']} "
+            f"finished={rep['finished']}")
+    _expect(eng._preempted == {}, "lane left parked after FIFO degrade")
+    _expect(eng.pool.tables == {}, "pool blocks leaked")
+    _expect(eng.decode_steps == eng._base_decode_steps,
+            "brownout knobs not restored on FIFO degrade")
+    return "degraded", ("RuntimeError in the scheduler decision degraded "
+                        "admission to plain FIFO; every in-flight request "
+                        "finished with a known reason, no lane stranded")
+
+
+def drill_serve_preempt(tmp):
+    model, eng = _tiny_engine(max_batch=1, scheduler=True)
+    p = (np.arange(6) * 5) % 128
+    ref = _dense_ref(model, p, 10)
+    rid = eng.add_request(p, max_new_tokens=10, priority="batch")
+    while not eng._decode_active():
+        eng.step()
+    lane = eng._decode_active()[0]
+    with faults.injected_faults("serve.preempt:1:TimeoutError"):
+        ok = eng._try_preempt(lane, why="drill")
+        inj = faults.injected_counts().get("serve.preempt", 0)
+    _expect(inj == 1, "fault never reached the preempt site")
+    _expect(not ok, "preemption reported success despite the fault")
+    _expect(_counter("serving_deferred_total", reason="preempt_fault") >= 1,
+            "preempt fault not counted")
+    out = eng.run()
+    _expect(out[rid] == ref,
+            "victim stream diverged after the aborted preemption")
+    # clean preempt mid-decode: paged-KV stays resident, lane resumes,
+    # and the stream is byte-identical to the dense reference
+    rid2 = eng.add_request(p, max_new_tokens=10, priority="batch")
+    while not eng._decode_active():
+        eng.step()
+    eng.step()
+    eng.step()
+    _expect(eng._try_preempt(eng._decode_active()[0], why="drill"),
+            "clean preemption refused")
+    _expect(eng._preempted, "preempted lane not parked")
+    out2 = eng.run()
+    _expect(out2[rid2] == ref, "stream diverged across preempt/resume")
+    _expect(eng._preempted == {}, "parked lane never resumed")
+    _expect(eng.pool.tables == {}, "pool blocks leaked")
+    return "recovered", ("preempt fault aborted the attempt (victim kept "
+                         "decoding, exact stream); clean preempt/resume "
+                         "also byte-identical")
+
+
 def drill_train_step_nonfinite(tmp):
     losses = {"n": 0}
 
@@ -450,6 +513,8 @@ SCENARIOS = {
     "serve.draft_verify": drill_serve_draft_verify,
     "serve.kv_dequant": drill_serve_kv_dequant,
     "serve.loadgen_tick": drill_serve_loadgen_tick,
+    "serve.sched_decide": drill_serve_sched_decide,
+    "serve.preempt": drill_serve_preempt,
     "train.step_nonfinite": drill_train_step_nonfinite,
     "compile.cache_read": drill_compile_cache_read,
     "compile.cache_write": drill_compile_cache_write,

@@ -7,8 +7,11 @@ Usage:
   python tools/loadgen.py --scenario chat --seed 0            # report JSON
   python tools/loadgen.py --scenario chat --seed 0 --check    # acceptance
           gate: exit 0 iff an SLO verdict exists, phase attribution covers
-          >=95% of engine wall time, and the predicted-vs-measured cost
-          gauge is populated
+          >=95% of engine wall time, the predicted-vs-measured cost
+          gauge is populated, every finish reason is known, and the
+          brownout ladder ended back at level 0
+  python tools/loadgen.py --scenario structured_output --scheduler --check
+          # same, with the SLO scheduler closed loop engaged
   python tools/loadgen.py --list                              # scenarios
   python tools/loadgen.py --scenario chat --rate 400 --no-drain   # overload
   python tools/loadgen.py --scenario chat --out report.json   # then:
@@ -79,10 +82,16 @@ def main(argv=None):
     ap.add_argument("--no-drain", action="store_true",
                     help="stop at schedule end instead of draining the "
                          "backlog (saturation sweeps)")
+    ap.add_argument("--scheduler", action="store_true",
+                    help="run the engine under the closed-loop SLO "
+                         "scheduler (priority preemption + tenant DRR + "
+                         "brownout ladder)")
     ap.add_argument("--check", action="store_true",
                     help="acceptance gate: exit nonzero unless the report "
-                         "has an SLO verdict, >=95%% phase attribution, "
-                         "and a populated cost gauge")
+                         "has an SLO verdict, >=95%% phase attribution, a "
+                         "populated cost gauge, only known finish reasons, "
+                         "and (with --scheduler) the brownout ladder "
+                         "back at 0")
     ap.add_argument("--min-coverage", type=float, default=0.95)
     ap.add_argument("--out", default=None, help="write the report JSON here "
                     "(default: stdout)")
@@ -99,7 +108,7 @@ def main(argv=None):
 
     obs.enable()
     get_phase_accountant().enabled = True
-    engine = build_engine()
+    engine = build_engine(scheduler=True if args.scheduler else None)
     report = loadgen.run_scenario(
         engine, args.scenario, seed=args.seed, rate_rps=args.rate,
         duration_s=args.duration, max_wall_s=args.max_wall,

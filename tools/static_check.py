@@ -18,6 +18,11 @@ Rules (closed registry, like everything else here):
   recorder-kinds       record("kind") literals  ⊆ recorder EVENT_KINDS
   profiler-phases      mark("phase") literals in profiler/ + serving.py
                        ⊆ phases.py PHASES == OBSERVABILITY.md phase rows
+  scheduler-actions    brownout-level literals (level_index("x")) and
+                       priority-class literals (priority= defaults /
+                       keywords, .priority comparisons) in the serving +
+                       scheduler code ⊆ scheduler.py BROWNOUT_LEVELS /
+                       PRIORITY_CLASSES == RESILIENCE.md rows
   flags-registered     os.environ FLAGS_* accesses and flag_value("x")
                        args ⊆ define_flag names (collected repo-wide)
   host-sync            device->host syncs (np.asarray / .item() /
@@ -57,6 +62,7 @@ FAULTS_PY = "paddle_tpu/resilience/faults.py"
 RECORDER_PY = "paddle_tpu/observability/recorder.py"
 FLAGS_PY = "paddle_tpu/framework/flags.py"
 PHASES_PY = "paddle_tpu/profiler/phases.py"
+SCHEDULER_PY = "paddle_tpu/inference/scheduler.py"
 CHAOS_PY = "tools/chaos_drill.py"
 OBS_MD = "OBSERVABILITY.md"
 RES_MD = "RESILIENCE.md"
@@ -65,6 +71,12 @@ RES_MD = "RESILIENCE.md"
 # resolve against the PHASES registry (`mark` is too generic a name to
 # scan repo-wide)
 PHASE_MARK_FILES = ("paddle_tpu/profiler/", "paddle_tpu/inference/serving.py")
+
+# scheduler-actions rule scope: the files whose brownout-level /
+# priority-class literals must resolve against the scheduler registries
+# (`priority` is too generic a keyword to scan repo-wide)
+SCHED_ACTION_FILES = ("paddle_tpu/inference/serving.py",
+                      "paddle_tpu/inference/scheduler.py")
 
 # host-sync rule scope + allowlist: methods audited as intentional
 # host syncs (see STATIC_ANALYSIS.md "Host-sync allowlist policy").
@@ -178,6 +190,12 @@ class Context:
                                          _read(OBS_MD), re.M))
         self.res_ticks = set(re.findall(r"`([a-z_]+\.[a-z_]+)`",
                                         _read(RES_MD)))
+        self.priority_classes = _dict_keys(SCHEDULER_PY, "PRIORITY_CLASSES")
+        self.brownout_levels = _dict_keys(SCHEDULER_PY, "BROWNOUT_LEVELS")
+        self.res_brownout_rows = set(re.findall(
+            r"^\| `brownout/([a-z_]+)` \|", _read(RES_MD), re.M))
+        self.res_priority_rows = set(re.findall(
+            r"^\| `priority/([a-z_]+)` \|", _read(RES_MD), re.M))
         self.sources = {}
         for rel in (paths if paths is not None else self._default_paths()):
             try:
@@ -305,6 +323,88 @@ def rule_profiler_phases(ctx):
             "profiler-phases", OBS_MD, 0,
             f"{OBS_MD} documents phase {name!r} which is not in "
             f"{PHASES_PY} PHASES"))
+    return out
+
+
+def rule_scheduler_actions(ctx):
+    """The SLO scheduler's registries (scheduler.py BROWNOUT_LEVELS /
+    PRIORITY_CLASSES) are closed like the metric catalog: every
+    brownout-level literal (``level_index("x")``) and priority-class
+    literal (a ``priority=`` default or call keyword, or a string
+    compared against a ``.priority`` attribute) in the serving +
+    scheduler code must name a registered entry, and every entry must
+    have a `| \\`brownout/NAME\\` |` / `| \\`priority/NAME\\` |` row in
+    RESILIENCE.md's overload runbook — both directions."""
+    out = []
+
+    def bad_level(path, line, name):
+        out.append(Violation(
+            "scheduler-actions", path, line,
+            f"level_index({name!r}) is not in {SCHEDULER_PY} "
+            "BROWNOUT_LEVELS"))
+
+    def bad_prio(path, line, name, how):
+        out.append(Violation(
+            "scheduler-actions", path, line,
+            f"{how} {name!r} is not in {SCHEDULER_PY} PRIORITY_CLASSES"))
+
+    for path, tree in ctx.sources.items():
+        norm = path.replace(os.sep, "/")
+        if not any(norm.endswith(s) for s in SCHED_ACTION_FILES):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                if _callee(node) == "level_index" and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str) \
+                        and node.args[0].value not in ctx.brownout_levels:
+                    bad_level(path, node.lineno, node.args[0].value)
+                for kw in node.keywords:
+                    if kw.arg == "priority" \
+                            and isinstance(kw.value, ast.Constant) \
+                            and isinstance(kw.value.value, str) \
+                            and kw.value.value not in ctx.priority_classes:
+                        bad_prio(path, node.lineno, kw.value.value,
+                                 "priority= keyword")
+            elif isinstance(node, ast.Compare):
+                sides = [node.left, *node.comparators]
+                if not any(isinstance(s, ast.Attribute)
+                           and s.attr == "priority" for s in sides):
+                    continue
+                for s in sides:
+                    if isinstance(s, ast.Constant) \
+                            and isinstance(s.value, str) \
+                            and s.value not in ctx.priority_classes:
+                        bad_prio(path, node.lineno, s.value,
+                                 ".priority compared against")
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                a = node.args
+                pos = a.posonlyargs + a.args
+                pairs = list(zip(pos[len(pos) - len(a.defaults):],
+                                 a.defaults))
+                pairs += [(p, d) for p, d in
+                          zip(a.kwonlyargs, a.kw_defaults) if d is not None]
+                for param, default in pairs:
+                    if param.arg == "priority" \
+                            and isinstance(default, ast.Constant) \
+                            and isinstance(default.value, str) \
+                            and default.value not in ctx.priority_classes:
+                        bad_prio(path, node.lineno, default.value,
+                                 "priority= default")
+    for reg, rows, kind in ((ctx.brownout_levels, ctx.res_brownout_rows,
+                             "brownout"),
+                            (ctx.priority_classes, ctx.res_priority_rows,
+                             "priority")):
+        for name in sorted(reg - rows):
+            out.append(Violation(
+                "scheduler-actions", RES_MD, 0,
+                f"{kind} registry entry {name!r} has no "
+                f"`| `{kind}/{name}` |` row in {RES_MD}"))
+        for name in sorted(rows - reg):
+            out.append(Violation(
+                "scheduler-actions", RES_MD, 0,
+                f"{RES_MD} documents {kind}/{name} which is not in "
+                f"{SCHEDULER_PY}"))
     return out
 
 
@@ -456,6 +556,9 @@ RULES = {
     "profiler-phases": (rule_profiler_phases,
                         "mark() literals ⊆ profiler PHASES == "
                         "OBSERVABILITY.md phase rows"),
+    "scheduler-actions": (rule_scheduler_actions,
+                          "brownout/priority literals ⊆ scheduler "
+                          "registries == RESILIENCE.md rows"),
     "flags-registered": (rule_flags_registered,
                          "FLAGS_* env accesses and flag_value args are "
                          "define_flag()ed"),

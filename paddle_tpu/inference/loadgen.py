@@ -443,6 +443,26 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
     cost = {"programs": engine.predicted_costs(),
             "ratio": _gauge_samples(snap1, "pir_cost_ratio")}
 
+    # speculative-decode evidence: this run's draft/accept deltas (None
+    # when the engine isn't speculative). One run = one scenario, so
+    # this IS the per-scenario acceptance the drafting table is tuned on
+    drafted = (_counter_total(snap1, "serving_draft_tokens_total")
+               - _counter_total(snap0, "serving_draft_tokens_total"))
+    accepted = (_counter_total(snap1, "serving_accepted_tokens_total")
+                - _counter_total(snap0, "serving_accepted_tokens_total"))
+    custom = getattr(engine, "_drafter", None)
+    speculative = None
+    if drafted > 0:
+        speculative = {
+            "drafter": (getattr(custom, "label", "custom")
+                        if custom is not None
+                        else f"ngram:{getattr(engine, 'draft_ngram', '?')}"),
+            "draft_depth": int(getattr(engine, "draft_depth", 0)),
+            "draft_tokens": int(drafted),
+            "accepted_tokens": int(accepted),
+            "acceptance": round(accepted / drafted, 4),
+        }
+
     report = {
         "format": REPORT_FORMAT,
         "scenario": scenario.name,
@@ -469,6 +489,7 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
         "phases": phases_report,
         "coverage": (phases_report or {}).get("coverage"),
         "cost": cost,
+        "speculative": speculative,
         "headroom_floor": headroom_floor,
         "timeline": timeline,
         # scheduler evidence (all zero/None for a scheduler-less engine):
@@ -499,15 +520,26 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
     return report
 
 
-def check_report(report, min_coverage=0.95):
+def check_report(report, min_coverage=0.95, min_acceptance=None):
     """Acceptance gate over a run report -> list of problems (empty =
     pass). Checked: an SLO verdict exists, phase attribution covers at
     least `min_coverage` of engine wall time, the cost model priced at
     least one dispatched program (predicted-vs-measured gauge is
     populated), every finished request carries a known finish reason,
     and the brownout ladder returned to level 0 by end of run (a run
-    that leaves the engine degraded is not a pass)."""
+    that leaves the engine degraded is not a pass). `min_acceptance`
+    (speculative runs only) additionally requires a speculative block
+    with draft acceptance at or above the floor."""
     problems = []
+    if min_acceptance is not None:
+        spec = report.get("speculative")
+        if not spec:
+            problems.append("no speculative block in report "
+                            "(engine not speculative / no drafts issued)")
+        elif spec.get("acceptance", 0.0) < float(min_acceptance):
+            problems.append(
+                f"draft acceptance {spec.get('acceptance')} < "
+                f"{min_acceptance} (drafter {spec.get('drafter')})")
     slo_v = report.get("slo")
     if not isinstance(slo_v, dict) or "ok" not in slo_v:
         problems.append("no SLO verdict in report")

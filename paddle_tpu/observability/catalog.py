@@ -290,6 +290,20 @@ CATALOG = {
         "programs pooled; the exemplar carries the PROGRAM NAME, so the "
         "top bucket's exemplar names the worst-predicted program",
         (), _COST_RATIO_BUCKETS),
+    "pir_sharding_annotations_total": (
+        "counter", "Value.sharding annotations committed by the "
+        "sharding-propagation pass (pir/shard_prop.py), by program — "
+        "fixpoint output, not user input: input annotations spread "
+        "through the whole IR land here", ("program",), None),
+    "pir_shard_search_seconds": (
+        "histogram", "wall time of one cost-driven sharding search "
+        "(pir/shard_search.py; bounded candidate enumeration priced "
+        "by the CostModel roofline+ICI estimate)", (), _STEP_BUCKETS),
+    "pir_exposed_comm_seconds": (
+        "gauge", "CostModel exposed-communication seconds of the named "
+        "program after the collective-overlap pass committed a "
+        "schedule (pir/overlap.py; comm the overlap credit did not "
+        "hide)", ("program",), None),
 
     # -- telemetry loop (tracing ring, flight recorder, SLO engine) ----------
     "tracer_dropped_spans_total": (

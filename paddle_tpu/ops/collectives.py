@@ -1,0 +1,92 @@
+"""Collective-bearing primitive tags for the PIR scheduler.
+
+The collective-overlap pass (pir/overlap.py) and the CostModel's
+exposed-communication term (pir/analysis.py) need to know which ops
+move bytes over the interconnect rather than HBM. In captured programs
+jax collectives show up either as top-level eqns (``psum`` inside a
+pmap'd body) or nested inside a ``shard_map``/``pjit`` eqn's jaxpr —
+``collective_traffic`` walks both.
+
+Traffic factors approximate ring-algorithm bytes-on-wire per element of
+the op's payload: an all-reduce moves ~2x the buffer (reduce-scatter
+phase + all-gather phase), one-phase collectives ~1x, ppermute exactly
+one hop. The factor multiplies the LARGER of the eqn's input/output
+footprint, so gather-like ops are priced on their wide side.
+"""
+
+from __future__ import annotations
+
+__all__ = ["COLLECTIVE_PRIMITIVES", "collective_traffic",
+           "is_collective_eqn"]
+
+# closed registry: primitive name -> ring traffic factor (bytes moved on
+# the interconnect per payload byte). Names cover every collective the
+# distributed layer emits (paddle_tpu/distributed/collective.py wraps
+# exactly these lax primitives) plus the shard_map-era aliases.
+COLLECTIVE_PRIMITIVES = {
+    "psum": 2.0,            # all-reduce: reduce-scatter + all-gather
+    "psum2": 2.0,           # shard_map's all-reduce primitive
+    "pmax": 2.0,
+    "pmin": 2.0,
+    "all_gather": 1.0,
+    "all_gather_invariant": 1.0,
+    "reduce_scatter": 1.0,
+    "psum_scatter": 1.0,
+    "all_to_all": 1.0,
+    "ppermute": 1.0,        # one hop
+}
+
+_DTYPE_BYTES = {
+    "float64": 8, "int64": 8, "uint64": 8, "complex64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1, "float8_e4m3fn": 1,
+    "float8_e5m2": 1,
+}
+
+
+def _aval_bytes(aval):
+    n = 1
+    for d in getattr(aval, "shape", ()):
+        n *= int(d)
+    return n * _DTYPE_BYTES.get(str(getattr(aval, "dtype", "float32")), 4)
+
+
+def _inner_jaxprs(params):
+    found = []
+    for v in params.values():
+        inner = getattr(v, "jaxpr", None)          # ClosedJaxpr
+        if inner is not None and hasattr(inner, "eqns"):
+            found.append(inner)
+        elif hasattr(v, "eqns"):                   # bare Jaxpr
+            found.append(v)
+    return found
+
+
+def is_collective_eqn(eqn) -> bool:
+    return eqn.primitive.name in COLLECTIVE_PRIMITIVES
+
+
+def collective_traffic(eqn, depth: int = 0) -> list:
+    """[(primitive name, wire bytes)] for every collective reachable
+    from this eqn — the eqn itself, or collectives nested in its
+    sub-jaxprs (shard_map / pjit / scan bodies; scan trips multiply)."""
+    if depth > 8:           # pathological nesting: stop walking, stay finite
+        return []
+    name = eqn.primitive.name
+    if name in COLLECTIVE_PRIMITIVES:
+        payload = max(
+            sum(_aval_bytes(iv.aval) for iv in eqn.invars
+                if hasattr(iv, "aval")),
+            sum(_aval_bytes(ov.aval) for ov in eqn.outvars))
+        return [(name, float(payload) * COLLECTIVE_PRIMITIVES[name])]
+    found = []
+    inner = _inner_jaxprs(eqn.params)
+    if inner:
+        trips = float(eqn.params.get("length", 1) or 1)
+        for j in inner:
+            body = j.jaxpr if hasattr(j, "jaxpr") else j
+            for sub in getattr(body, "eqns", ()):
+                for cname, nbytes in collective_traffic(sub, depth + 1):
+                    found.append((cname, nbytes * trips))
+    return found

@@ -38,7 +38,8 @@ from .ir import Operation, Program, Value
 __all__ = ["Lattice", "FlatLattice", "DataflowAnalysis",
            "ShapeDtypeInference", "Liveness", "ShardingConsistency",
            "DonationHazard", "check_donation_safety", "CONFLICT",
-           "CostModel", "ProgramCost", "OpCost", "DEFAULT_ROOFLINE"]
+           "CostModel", "ProgramCost", "OpCost", "DEFAULT_ROOFLINE",
+           "DEFAULT_INTERCONNECT"]
 
 
 class _Conflict:
@@ -331,6 +332,17 @@ DEFAULT_ROOFLINE = {
     "hbm_bps": 820e9,
 }
 
+# Interconnect row of the same baked ledger (TPU v5 lite ICI): effective
+# per-direction link bandwidth and per-collective launch latency. Feeds
+# the CostModel's exposed-communication term — comm seconds for a
+# collective-bearing op are wire_bytes / ici_bps + latency, and compute
+# scheduled between the collective and its first consumer earns overlap
+# credit against them (pir/overlap.py maximizes that credit).
+DEFAULT_INTERCONNECT = {
+    "ici_bps": 4.5e10,
+    "link_latency_s": 1e-6,
+}
+
 _DTYPE_BYTES = {
     "float64": 8, "int64": 8, "uint64": 8, "complex64": 8,
     "float32": 4, "int32": 4, "uint32": 4,
@@ -427,20 +439,31 @@ class ProgramCost:
     ``raw_seconds`` is the uncalibrated roofline estimate; callers apply
     a measured calibration scale (platform + overhead) on top."""
 
-    __slots__ = ("name", "flops", "bytes", "raw_seconds", "per_op")
+    __slots__ = ("name", "flops", "bytes", "raw_seconds", "per_op",
+                 "comm_seconds", "exposed_comm_seconds")
 
-    def __init__(self, name, flops, bytes, raw_seconds, per_op):
+    def __init__(self, name, flops, bytes, raw_seconds, per_op,
+                 comm_seconds=0.0, exposed_comm_seconds=0.0):
         self.name = name
         self.flops = flops
         self.bytes = bytes
         self.raw_seconds = raw_seconds
         self.per_op = per_op        # [(op name, OpCost)] heaviest-first
+        # interconnect traffic of collective-bearing ops (0.0 for the
+        # common single-chip program); "exposed" is what overlap credit
+        # did not hide — the objective pir/overlap.py minimizes
+        self.comm_seconds = float(comm_seconds)
+        self.exposed_comm_seconds = float(exposed_comm_seconds)
 
     def summary(self):
-        return {"name": self.name, "flops": self.flops,
-                "bytes": self.bytes, "raw_seconds": self.raw_seconds,
-                "top_ops": [(n, c.flops, c.bytes)
-                            for n, c in self.per_op[:5]]}
+        out = {"name": self.name, "flops": self.flops,
+               "bytes": self.bytes, "raw_seconds": self.raw_seconds,
+               "top_ops": [(n, c.flops, c.bytes)
+                           for n, c in self.per_op[:5]]}
+        if self.comm_seconds:
+            out["comm_seconds"] = self.comm_seconds
+            out["exposed_comm_seconds"] = self.exposed_comm_seconds
+        return out
 
     def __repr__(self):
         return (f"ProgramCost({self.name!r}, {self.flops:.3g} flops, "
@@ -458,10 +481,13 @@ class CostModel(DataflowAnalysis):
     direction = "forward"
     name = "cost"
 
-    def __init__(self, roofline=None):
+    def __init__(self, roofline=None, interconnect=None):
         self.roofline = dict(DEFAULT_ROOFLINE)
         if roofline:
             self.roofline.update(roofline)
+        self.interconnect = dict(DEFAULT_INTERCONNECT)
+        if interconnect:
+            self.interconnect.update(interconnect)
 
     @staticmethod
     def _value_bytes(values):
@@ -501,7 +527,67 @@ class CostModel(DataflowAnalysis):
         per_op = sorted(
             ((op.name, facts[id(op)]) for op in prog.ops),
             key=lambda nc: -(nc[1].flops + nc[1].bytes))
-        return ProgramCost(prog.name, flops, nbytes, raw, per_op)
+        comm = exposed = 0.0
+        try:
+            rep = self.exposed_comm_seconds(prog, facts)
+            comm, exposed = rep["comm_seconds"], rep["exposed_seconds"]
+        except Exception:  # noqa: BLE001 — pricing may never cost a compile
+            pass
+        return ProgramCost(prog.name, flops, nbytes, raw, per_op,
+                           comm_seconds=comm, exposed_comm_seconds=exposed)
+
+    # -- exposed-communication term (interconnect ledger row) ---------------
+    def comm_seconds(self, op: Operation) -> float:
+        """Interconnect seconds this op spends moving bytes: every
+        collective reachable from its eqn (ops/collectives.py tags),
+        priced on the baked ICI ledger row. 0.0 for pure-compute ops."""
+        if op.eqn is None:
+            return 0.0
+        from ..ops.collectives import collective_traffic
+        hits = collective_traffic(op.eqn)
+        if not hits:
+            return 0.0
+        bps = self.interconnect["ici_bps"]
+        lat = self.interconnect["link_latency_s"]
+        return sum(nbytes / bps + lat for _, nbytes in hits if bps > 0)
+
+    def _compute_seconds(self, cost: OpCost) -> float:
+        eff = self.roofline["peak_flops"] * self.roofline["efficiency"]
+        return max(cost.flops / eff if eff > 0 else 0.0,
+                   cost.bytes / self.roofline["hbm_bps"]
+                   if self.roofline["hbm_bps"] > 0 else 0.0)
+
+    def exposed_comm_seconds(self, prog: Program, facts=None) -> dict:
+        """Schedule-aware communication price of the program as ordered:
+        for each collective-bearing op, the compute ops scheduled between
+        it and the first consumer of any of its results earn overlap
+        credit (async dispatch hides comm under them); what the credit
+        does not cover is *exposed*. Windows are credited independently
+        (optimistic: interconnect contention between overlapping windows
+        is ignored, but other collectives never count as credit)."""
+        if facts is None:
+            facts = self.run(prog)
+        comm_s = [self.comm_seconds(op) for op in prog.ops]
+        compute_s = [self._compute_seconds(facts[id(op)])
+                     for op in prog.ops]
+        first_use = {}
+        for i, op in enumerate(prog.ops):
+            for v in op.inputs:
+                first_use.setdefault(id(v), i)
+        total = exposed = 0.0
+        n = 0
+        for i, op in enumerate(prog.ops):
+            if comm_s[i] <= 0.0:
+                continue
+            n += 1
+            total += comm_s[i]
+            j = min((first_use.get(id(o), len(prog.ops))
+                     for o in op.outputs), default=len(prog.ops))
+            credit = sum(compute_s[k] for k in range(i + 1, j)
+                         if comm_s[k] <= 0.0)
+            exposed += max(0.0, comm_s[i] - credit)
+        return {"comm_seconds": total, "exposed_seconds": exposed,
+                "collectives": n}
 
 
 class ShardingConsistency(DataflowAnalysis):
@@ -509,10 +595,20 @@ class ShardingConsistency(DataflowAnalysis):
     over a FlatLattice: an op whose annotated operands agree propagates
     that sharding to unannotated outputs; operands that disagree (and
     shape-preserving ops whose stamped output annotation contradicts the
-    propagated one) join to CONFLICT. ``conflicts`` lists (op, detail)
-    after ``run``. This is deliberately the *consistency* half of GSPMD
-    propagation — the future sharding-propagation pass supplies the
-    decision procedure, then re-runs this to prove its assignment."""
+    propagated one) join to CONFLICT. A join conflict only becomes a
+    reported inconsistency once the op's outputs are annotated —
+    annotated inputs feeding a not-yet-propagated interior (the window
+    between annotate_inputs and the shard_prop pass, which every
+    earlier pass's verifier run observes) are pending constraints, not
+    an error. ``conflicts`` lists (op, detail) after ``run``. This is deliberately the *consistency* half of GSPMD
+    propagation — the sharding-propagation pass (pir/shard_prop.py)
+    supplies the decision procedure, then re-runs this to prove its
+    assignment. Ops stamped with an ``attrs["sharding_rule"]`` contract
+    (a contracting dot, a transpose, a cost-chosen reshard point) are
+    their own boundary: operands legitimately carry different shardings
+    there and the outputs take exactly their stamped annotation — but a
+    declared rule whose outputs are NOT all annotated is itself flagged,
+    so a forged or half-applied stamp cannot silence the check."""
 
     direction = "forward"
     name = "sharding"
@@ -533,11 +629,35 @@ class ShardingConsistency(DataflowAnalysis):
         return facts
 
     def transfer(self, op: Operation, facts: dict) -> bool:
+        rule = op.attrs.get("sharding_rule") if op.attrs else None
+        if rule is not None:
+            # declared operand->result contract: no operand join; the
+            # stamped output annotations ARE the facts (and must exist)
+            if any(self._annot(o) is None for o in op.outputs) \
+                    and id(op) not in self._flagged:
+                self._flagged.add(id(op))
+                self.conflicts.append(
+                    (op, f"sharding_rule {rule!r} declared but not every "
+                         f"output carries an annotation"))
+            changed = False
+            for o in op.outputs:
+                fact = self._annot(o)
+                if facts.get(id(o), None) != fact:
+                    facts[id(o)] = fact
+                    changed = True
+            return changed
         joined = None
         for v in op.inputs:
             fact = self.lattice.join(facts.get(id(v)), self._annot(v))
             joined = self.lattice.join(joined, fact)
-        if joined is CONFLICT and id(op) not in self._flagged:
+        # a join conflict is an ERROR only once this op's outputs carry
+        # annotations — i.e. somebody claims propagation committed
+        # through here without declaring a sharding_rule. Annotated
+        # inputs feeding a not-yet-propagated interior (the state
+        # between annotate_inputs and the shard_prop pass) are pending
+        # constraints, not an inconsistency.
+        committed = any(self._annot(o) is not None for o in op.outputs)
+        if joined is CONFLICT and committed and id(op) not in self._flagged:
             self._flagged.add(id(op))
             annots = [(v.vid, facts.get(id(v), self._annot(v)))
                       for v in op.inputs]

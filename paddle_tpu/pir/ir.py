@@ -67,6 +67,15 @@ def canonical_attr_text(v) -> str:
     return _scrub(repr(v))
 
 
+def _constrained(v, x):
+    """Replay hook for annotated values: re-assert ``v.sharding``
+    through with_sharding_constraint while a shard_prop mesh scope is
+    active (no scope / any failure -> x unchanged). Lazy import: only
+    programs the propagation pass annotated ever reach this."""
+    from .shard_prop import apply_constraint
+    return apply_constraint(x, v.sharding)
+
+
 class Value:
     """One SSA value: produced by exactly one Operation (or a program
     input / constant), consumed by any number. ``sharding`` is an
@@ -88,8 +97,19 @@ class Value:
     def type_str(self) -> str:
         return f"{self.dtype}[{','.join(str(s) for s in self.shape)}]"
 
+    @property
+    def sharding_str(self) -> str:
+        """Printable sharding suffix (``<dp,*>`` style; empty when
+        unannotated). Display only — NEVER part of canonical_text:
+        identical programs must hash identically whether or not the
+        propagation pass annotated them."""
+        if self.sharding is None:
+            return ""
+        return ("<" + ",".join("*" if a is None else str(a)
+                               for a in self.sharding) + ">")
+
     def __repr__(self):
-        return f"%{self.vid}: {self.type_str}"
+        return f"%{self.vid}: {self.type_str}{self.sharding_str}"
 
 
 class Operation:
@@ -208,13 +228,13 @@ class Program:
                             f"args, got {len(args)}")
         env: dict[int, Any] = {}
         for v, a in zip(self.inputs, args):
-            env[id(v)] = a
+            env[id(v)] = a if v.sharding is None else _constrained(v, a)
         for v, c in self.constants.items():
             env[id(v)] = c
         for op in self.ops:
             in_vals = [env[id(v)] for v in op.inputs]
             for v, o in zip(op.outputs, op.evaluate(in_vals)):
-                env[id(v)] = o
+                env[id(v)] = o if v.sharding is None else _constrained(v, o)
         return tuple(env[id(v)] for v in self.outputs)
 
     # -- printing / hashing -------------------------------------------------

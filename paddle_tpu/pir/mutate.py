@@ -126,16 +126,48 @@ def _reorder_kv_write(prog: Program, rng) -> str:
 
 
 def _sharding_clash(prog: Program, rng) -> str:
-    """Contradictory sharding annotations on one op's operands."""
+    """A propagation bug committed output shardings across an op whose
+    operands irreconcilably disagree — without declaring the
+    ``sharding_rule`` contract that would make the divergence legal.
+    (Annotating only the operands is NOT a corruption: that is the
+    legitimate pending state between annotate_inputs and the
+    shard_prop pass.)"""
     cands = [op for op in prog.ops
-             if len(op.inputs) >= 2
+             if len(op.inputs) >= 2 and op.outputs
              and op.inputs[0] is not op.inputs[1]]
     if not cands:
         raise SkipCorruption("no op with two distinct operands")
     op = rng.choice(cands)
     op.inputs[0].sharding = ("data", None)
     op.inputs[1].sharding = ("model", None)
-    return f"annotated operands of {op.name!r} with clashing shardings"
+    for o in op.outputs:
+        o.sharding = ("data",) + (None,) * max(0, len(o.shape) - 1)
+    return (f"committed output shardings of {op.name!r} over clashing "
+            f"operand annotations")
+
+
+def _sharding_rule_forge(prog: Program, rng) -> str:
+    """A half-applied propagation stamp: an op claims a
+    ``sharding_rule`` boundary (operands may legally diverge there) but
+    its outputs never received the annotations the contract requires —
+    the forged stamp must not silence the consistency check."""
+    cands = [op for op in prog.ops
+             if op.outputs and not op.attrs.get("sharding_rule")]
+    if not cands:
+        raise SkipCorruption("no op to stamp")
+    op = rng.choice(cands)
+    op.attrs["sharding_rule"] = "forged(data,model)"
+    for o in op.outputs:
+        o.sharding = None
+    # make the check reachable: some annotation must exist in the
+    # program for the verifier to engage the sharding analysis at all
+    if op.inputs:
+        op.inputs[0].sharding = \
+            ("data",) + (None,) * max(0, len(op.inputs[0].shape) - 1)
+    elif prog.inputs:
+        prog.inputs[0].sharding = \
+            ("data",) + (None,) * max(0, len(prog.inputs[0].shape) - 1)
+    return f"stamped forged sharding_rule on {op.name!r} with bare outputs"
 
 
 # corruption name -> (mutator, verifier rule that must reject it)
@@ -148,6 +180,7 @@ CORRUPTIONS = {
     "dangling-output": (_dangling_output, "dangling-value"),
     "reorder-kv-write": (_reorder_kv_write, "effect-order"),
     "sharding-clash": (_sharding_clash, "sharding-conflict"),
+    "sharding-rule-forge": (_sharding_rule_forge, "sharding-conflict"),
 }
 
 

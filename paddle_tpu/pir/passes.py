@@ -10,7 +10,8 @@ catalog, and the whole pipeline is wrapped in spans — the pass layer is
 born observable, same discipline as serving/train.
 
 Passes are individually toggleable through ``FLAGS_pir_passes`` (an
-ordered comma list; default "fold,cse,pattern,dce").
+ordered comma list; default
+"fold,cse,pattern,dce,shard_search,shard_prop,overlap").
 """
 
 from __future__ import annotations
@@ -21,11 +22,27 @@ from typing import Optional
 from .ir import Program
 
 __all__ = ["Pass", "PassResult", "PassManager", "DeadCodeElimination",
-           "ConstantFolding", "CommonSubexprElimination", "PIPELINE_VERSION"]
+           "ConstantFolding", "CommonSubexprElimination", "PASSES",
+           "PIPELINE_VERSION"]
 
 # bump when pass semantics change in a way that invalidates cached
-# artifacts compiled from the rewritten programs
-PIPELINE_VERSION = 1
+# artifacts compiled from the rewritten programs (2: sharded replay —
+# annotated programs trace with_sharding_constraint into the evaluator)
+PIPELINE_VERSION = 2
+
+# The closed pass registry: every name FLAGS_pir_passes may list, with
+# its one-line role. tools/static_check.py pins this dict against the
+# flag default and the COMPILER.md pass-catalog rows, both directions;
+# _registry() maps the same names to classes (and asserts it agrees).
+PASSES = {
+    "fold": "constant folding (host-evaluates const subgraphs)",
+    "cse": "common-subexpression elimination",
+    "pattern": "DRR pattern rewriter (fused pt.* ops)",
+    "dce": "dead code elimination",
+    "shard_search": "cost-driven sharding search (argmin strategy)",
+    "shard_prop": "GSPMD-style sharding propagation to fixpoint",
+    "overlap": "collective-overlap scheduling (hide comm under compute)",
+}
 
 # outputs larger than this are not materialized by constant folding
 _FOLD_MAX_ELEMS = 1 << 20
@@ -230,13 +247,21 @@ class CommonSubexprElimination(Pass):
 
 
 def _registry():
+    from .overlap import CollectiveOverlap
     from .patterns import PatternRewriter
-    return {
+    from .shard_prop import ShardingPropagation
+    from .shard_search import ShardingSearch
+    reg = {
         "dce": DeadCodeElimination,
         "fold": ConstantFolding,
         "cse": CommonSubexprElimination,
         "pattern": PatternRewriter,
+        "shard_search": ShardingSearch,
+        "shard_prop": ShardingPropagation,
+        "overlap": CollectiveOverlap,
     }
+    assert set(reg) == set(PASSES), "pass registry drifted from PASSES"
+    return reg
 
 
 class PassManager:

@@ -37,7 +37,7 @@ class CompileReport:
 
     __slots__ = ("name", "key", "cache", "pass_report", "program",
                  "captured_ops", "final_ops", "pattern_counts", "fallback",
-                 "cost")
+                 "cost", "shard_decision", "shard_predicted_s")
 
     def __init__(self, name):
         self.name = name
@@ -50,17 +50,23 @@ class CompileReport:
         self.pattern_counts = {}
         self.fallback = None        # stage name when pir fell back
         self.cost = None            # analysis.ProgramCost of the final IR
+        self.shard_decision = None  # shard_search argmin (e.g. "dp+tp")
+        self.shard_predicted_s = None
 
     def summary(self) -> dict:
-        return {"name": self.name, "cache": self.cache,
-                "captured_ops": self.captured_ops,
-                "final_ops": self.final_ops,
-                "patterns": dict(self.pattern_counts),
-                "passes": {k: {"edits": v["edits"],
-                               "seconds": round(v["seconds"], 6)}
-                           for k, v in self.pass_report.items()},
-                "cost": self.cost.summary() if self.cost else None,
-                "fallback": self.fallback}
+        out = {"name": self.name, "cache": self.cache,
+               "captured_ops": self.captured_ops,
+               "final_ops": self.final_ops,
+               "patterns": dict(self.pattern_counts),
+               "passes": {k: {"edits": v["edits"],
+                              "seconds": round(v["seconds"], 6)}
+                          for k, v in self.pass_report.items()},
+               "cost": self.cost.summary() if self.cost else None,
+               "fallback": self.fallback}
+        if self.shard_decision is not None:
+            out["shard_decision"] = self.shard_decision
+            out["shard_predicted_s"] = self.shard_predicted_s
+        return out
 
 
 def _avals(flat_args):
@@ -71,12 +77,28 @@ def _avals(flat_args):
 
 def compile_flat(flat_fn: Callable, flat_args: list, *, name: str,
                  sharding: str = "replicated", donate_argnums=None,
-                 vjp_order: int = 1, extra_key: Optional[dict] = None):
+                 vjp_order: int = 1, extra_key: Optional[dict] = None,
+                 input_shardings: Optional[list] = None):
     """Compile ``flat_fn(*flat_leaves) -> tuple`` through the pipeline.
     Returns (callable, CompileReport). Raises only what tracing raises
     (e.g. ConcretizationTypeError); pipeline-internal failures degrade
-    to plain jax.jit with the fallback stage recorded."""
+    to plain jax.jit with the fallback stage recorded.
+
+    ``input_shardings`` optionally carries one sharding spec (mesh-axis
+    tuple) or None per flat leaf: the sharding-propagation pass spreads
+    them through the program, and replay re-asserts them under the
+    active ``shard_prop.mesh_scope``. Annotated compiles (and compiles
+    under a mesh scope, whose search pass may annotate) fold the specs
+    + mesh shape into the cache key — sharded artifacts are never
+    shared across meshes."""
     report = CompileReport(name)
+    try:
+        from .shard_prop import current_mesh, sharding_cache_tag
+        if input_shardings or current_mesh() is not None:
+            sharding = (f"{sharding}|"
+                        f"{sharding_cache_tag(input_shardings or [])}")
+    except Exception:  # noqa: BLE001 — key tagging may never break compile
+        pass
     try:
         prog, _ = capture(flat_fn, *flat_args, name=name)
         report.captured_ops = prog.num_ops()
@@ -91,6 +113,17 @@ def compile_flat(flat_fn: Callable, flat_args: list, *, name: str,
         raise                       # graph-break contract: caller handles
     except Exception as e:  # noqa: BLE001 — degrade, never break compile
         return _fallback(flat_fn, donate_argnums, report, "capture", e)
+
+    if input_shardings:
+        try:
+            from .shard_prop import annotate_inputs
+            annotate_inputs(prog, input_shardings)
+        except Exception as e:  # noqa: BLE001 — bad specs drop the hints,
+            # not the compile: the program stays valid, just unannotated
+            for v in prog.inputs:
+                v.sharding = None
+            warnings.warn(f"input shardings for {name!r} dropped: {e!r}",
+                          RuntimeWarning, stacklevel=2)
 
     try:
         if verify_mode() != "off":
@@ -120,6 +153,10 @@ def compile_flat(flat_fn: Callable, flat_args: list, *, name: str,
             if "=" in p)
         report.pattern_counts = {k: int(v)
                                  for k, v in report.pattern_counts.items()}
+        decision = getattr(prog, "_shard_search", None)
+        if decision is not None:
+            report.shard_decision = decision["decision"]
+            report.shard_predicted_s = decision["predicted_seconds"]
     except IRVerificationError as e:
         # a pass produced a malformed program: the verifier caught it
         # before the evaluator could compile it — distinct stage so the
@@ -178,8 +215,19 @@ def _flight(status, name):
 
 
 def _make_evaluator(prog):
-    def evaluate(*flat):
-        return prog.bind(*flat)
+    mesh = getattr(prog, "_mesh", None)
+    if mesh is None:
+        def evaluate(*flat):
+            return prog.bind(*flat)
+    else:
+        # the propagation pass pinned the scope mesh on the program:
+        # trace (and replay) under it so every annotated value's
+        # with_sharding_constraint lands in the XLA program even when
+        # the caller dispatches outside the original mesh scope
+        def evaluate(*flat):
+            from .shard_prop import mesh_scope
+            with mesh_scope(mesh):
+                return prog.bind(*flat)
     evaluate.__name__ = f"pir_eval_{prog.name}"
     return evaluate
 
@@ -274,13 +322,20 @@ class pir_jit:
     tree structure (the jax.jit contract serving already relies on)."""
 
     def __init__(self, fn, *, name=None, sharding="replicated",
-                 donate_argnums=None, vjp_order=0, extra_key=None):
+                 donate_argnums=None, vjp_order=0, extra_key=None,
+                 input_shardings=None, sharding_rules=None):
         self._fn = fn
         self.name = name or getattr(fn, "__name__", "pir_jit")
         self._sharding = sharding
         self._donate = donate_argnums
         self._vjp_order = vjp_order
         self._extra = extra_key
+        # sharding annotations for the propagation pass: either a flat
+        # per-leaf spec list (input_shardings) or SNIPPETS-style
+        # [(regex, spec)] rules matched on the args tree paths at the
+        # first call (sharding_rules); rules win if both are given
+        self._input_shardings = input_shardings
+        self._sharding_rules = sharding_rules
         self._compiled = None
         self._in_treedef = None
         self._out_treedef = None
@@ -308,6 +363,16 @@ class pir_jit:
                 if i in self._donate:
                     donate_flat.extend(range(off, off + len(leaves)))
                 off += len(leaves)
+        specs = self._input_shardings
+        if self._sharding_rules is not None:
+            try:
+                from .shard_prop import flat_input_specs
+                specs = flat_input_specs(args, self._sharding_rules)
+            except Exception as e:  # noqa: BLE001 — hints degrade
+                warnings.warn(f"sharding rules for {self.name!r} "
+                              f"dropped: {e!r}", RuntimeWarning,
+                              stacklevel=2)
+                specs = None
         if not _flags.flag_value("pir"):
             report = CompileReport(self.name)
             report.cache = "disabled"
@@ -318,7 +383,7 @@ class pir_jit:
             compiled, self.report = compile_flat(
                 flat_fn, flat, name=self.name, sharding=self._sharding,
                 donate_argnums=donate_flat, vjp_order=self._vjp_order,
-                extra_key=self._extra)
+                extra_key=self._extra, input_shardings=specs)
         if "tree" not in out_box:
             # warm hit / fallback never ran flat_fn's python: learn the
             # out tree from an abstract trace of the original fn

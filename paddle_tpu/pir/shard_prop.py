@@ -1,0 +1,530 @@
+"""GSPMD-style sharding propagation over pir programs.
+
+reference: GSPMD (arXiv:2105.04663) annotation propagation, and the
+SNIPPETS.md ``match_partition_rules`` param-tree idiom. The user
+annotates *program inputs* sparsely (regex rules over the param-tree
+path, exactly like parallel/spmd.py's rule tables); this pass pushes
+``Value.sharding`` forward AND backward through the dataflow until
+fixpoint, so a captured llama train step or fused decode comes out
+mesh-sharded with no hand annotation inside the program.
+
+Division of labor with the analysis layer (COMPILER.md): the
+``ShardingConsistency`` lattice is the *consistency* half of GSPMD —
+this pass is the *decision* half. Where operand annotations genuinely
+diverge (a contracting dot, a transpose, two user annotations meeting
+at an add), the pass either derives the op-specific output sharding or
+resolves the conflict by CostModel reshard price, stamps the op with an
+``attrs["sharding_rule"]`` contract, and then the consistency analysis
+re-runs as proof. Interior annotations the pass did NOT derive are
+never resolved away — a forged stamp is left for the verifier's
+sharding-conflict rule to reject.
+
+Constraint emission happens at replay: ``Program.bind`` re-asserts
+every annotated value through ``jax.lax.with_sharding_constraint``
+whenever a mesh scope is active (the pass pins the scope's mesh on the
+program so the pipeline's jitted evaluator traces under it). Axes that
+are missing from the mesh or do not divide the dimension are dropped —
+sharding hints may never change numerics or break a compile.
+
+Fixpoint bound: ``MAX_SWEEPS`` (8) forward+backward sweeps; facts are
+monotone (a value is annotated at most once, never overwritten), so
+the bound is a guard rail, not a tuning knob.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from contextlib import contextmanager
+from typing import Any, Optional
+
+from .analysis import CONFLICT, CostModel, FlatLattice
+from .ir import Operation, Program, Value
+from .passes import Pass, PassResult
+
+__all__ = ["ShardingPropagation", "mesh_scope", "current_mesh",
+           "current_search", "match_partition_rules", "flat_input_specs",
+           "annotate_inputs", "apply_constraint", "propagate_facts",
+           "sharding_cache_tag", "MAX_SWEEPS"]
+
+MAX_SWEEPS = 8
+
+# active mesh (jax.sharding.Mesh) + optional search space for the
+# cost-driven sharding search (pir/shard_search.py reads it)
+_SCOPE: list = [None, None]
+
+
+@contextmanager
+def mesh_scope(mesh, search=None):
+    """Activate a mesh for the pipeline: the propagation/search passes
+    pick it up, and annotated programs replay their values through
+    with_sharding_constraint while (and after) the scope is entered —
+    the propagation pass pins the mesh on the program, so the jitted
+    evaluator stays sharded once compiled under a scope. ``search``
+    optionally carries the strategy space for pir/shard_search.py:
+    ``[(name, rules)]`` with rules in match_partition_rules form."""
+    prev = list(_SCOPE)
+    _SCOPE[0], _SCOPE[1] = mesh, search
+    try:
+        yield mesh
+    finally:
+        _SCOPE[0], _SCOPE[1] = prev
+
+
+def current_mesh():
+    return _SCOPE[0]
+
+
+def current_search():
+    return _SCOPE[1]
+
+
+# --------------------------------------------------------------------------
+# user annotation front door (SNIPPETS.md match_partition_rules style)
+# --------------------------------------------------------------------------
+
+def _path_name(path) -> str:
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is None:
+            key = getattr(p, "name", None)
+        if key is None:
+            key = getattr(p, "idx", None)
+        parts.append(str(p) if key is None else str(key))
+    return "/".join(parts)
+
+
+def match_partition_rules(rules, tree, *, default="raise"):
+    """First rule whose regex ``re.search``-matches the '/'-joined tree
+    path wins (the SNIPPETS.md exemplar); scalars replicate to ``()``.
+    Returns the flat ``[(name, spec)]`` list in tree_flatten leaf order.
+    ``default`` is used for unmatched leaves; the exemplar's behavior
+    (raise) is kept as the default."""
+    import jax
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        name = _path_name(path)
+        shape = tuple(getattr(leaf, "shape", ()))
+        if len(shape) == 0:
+            out.append((name, ()))
+            continue
+        for pat, spec in rules:
+            if re.search(pat, name):
+                out.append((name, None if spec is None else tuple(spec)))
+                break
+        else:
+            if default == "raise":
+                raise ValueError(
+                    f"no sharding rule matches param {name!r}")
+            out.append((name, default))
+    return out
+
+
+def flat_input_specs(args, rules, *, default=None):
+    """Specs for ``tree_flatten(args)`` leaf order — what compile_flat's
+    ``input_shardings=`` wants. ``rules`` is a ``[(regex, spec)]`` list
+    matched on '/'-joined tree paths; unmatched leaves get ``default``
+    (None = unannotated) rather than the exemplar's raise."""
+    return [spec for _, spec in
+            match_partition_rules(rules, args, default=default)]
+
+
+def annotate_inputs(prog: Program, specs) -> int:
+    """Stamp sanitized sharding specs onto ``prog.inputs`` (None entries
+    skip; list may be shorter than the input count). Returns the number
+    of inputs annotated."""
+    mesh_axes = _mesh_axis_sizes(current_mesh())
+    n = 0
+    for v, spec in zip(prog.inputs, specs):
+        if spec is None:
+            continue
+        v.sharding = _sanitize(spec, v.shape, mesh_axes)
+        n += 1
+    return n
+
+
+def sharding_cache_tag(specs) -> str:
+    """Compile-cache key tag for an annotated compile: the input specs
+    plus the scope mesh's axis sizes (the traced-in constraints differ
+    per mesh, so artifacts must not be shared across them)."""
+    mesh = current_mesh()
+    axes = sorted(_mesh_axis_sizes(mesh).items()) if mesh else []
+    text = repr([None if s is None else tuple(s) for s in specs]) \
+        + repr(axes)
+    return "spec:" + hashlib.sha256(text.encode()).hexdigest()[:16]
+
+
+def _mesh_axis_sizes(mesh) -> dict:
+    if mesh is None:
+        return {}
+    try:
+        return {str(k): int(v) for k, v in dict(mesh.shape).items()}
+    except Exception:  # noqa: BLE001 — duck-typed test meshes
+        return {}
+
+
+# --------------------------------------------------------------------------
+# spec plumbing
+# --------------------------------------------------------------------------
+
+def _pad(spec, ndim):
+    if spec is None:
+        return None
+    spec = tuple(spec)[:ndim]
+    return spec + (None,) * (ndim - len(spec))
+
+
+def _sanitize(spec, shape, mesh_axes: Optional[dict] = None):
+    """Full-rank spec with duplicate axes dropped and (when the mesh is
+    known) axes that are absent or do not divide the dim dropped — the
+    same discipline as parallel/spmd.py shard_params_by_rules."""
+    if spec is None:
+        return None
+    spec = _pad(spec, len(shape))
+    seen: set = set()
+    out = []
+    for d, a in enumerate(spec):
+        if a is None or a in seen:
+            out.append(None)
+            continue
+        if mesh_axes:
+            size = mesh_axes.get(a)
+            if size is None or int(shape[d]) % int(size) != 0:
+                out.append(None)
+                continue
+        seen.add(a)
+        out.append(a)
+    return tuple(out)
+
+
+def _spec_str(spec) -> str:
+    if spec is None:
+        return "?"
+    return "<" + ",".join("*" if a is None else str(a) for a in spec) + ">"
+
+
+def apply_constraint(x, spec):
+    """with_sharding_constraint(x) for the active mesh scope — a layout
+    hint only: unknown/non-dividing axes are dropped, and ANY failure
+    returns x unchanged (constraint emission may never change numerics
+    or break a replay)."""
+    mesh = current_mesh()
+    if mesh is None or spec is None:
+        return x
+    try:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        shape = tuple(getattr(x, "shape", ()))
+        clean = _sanitize(spec, shape, _mesh_axis_sizes(mesh))
+        if clean is None:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, PartitionSpec(*clean)))
+    except Exception:  # noqa: BLE001 — hints degrade, never break
+        return x
+
+
+# --------------------------------------------------------------------------
+# per-op derivation rules (the decision half of GSPMD)
+# --------------------------------------------------------------------------
+
+def _dot_dims(op: Operation):
+    (lc, rc), (lb, rb) = op.eqn.params["dimension_numbers"]
+    lhs_nd = len(op.inputs[0].shape)
+    rhs_nd = len(op.inputs[1].shape)
+    lfree = [d for d in range(lhs_nd) if d not in lc and d not in lb]
+    rfree = [d for d in range(rhs_nd) if d not in rc and d not in rb]
+    return (tuple(lc), tuple(rc), tuple(lb), tuple(rb), lfree, rfree,
+            lhs_nd, rhs_nd)
+
+
+def _dot_forward(op: Operation, ls, rs):
+    lc, rc, lb, rb, lfree, rfree, lnd, rnd = _dot_dims(op)
+    ls = _pad(ls, lnd) or (None,) * lnd
+    rs = _pad(rs, rnd) or (None,) * rnd
+    out = [ls[bl] if ls[bl] is not None else rs[br]
+           for bl, br in zip(lb, rb)]
+    out += [ls[d] for d in lfree]
+    out += [rs[d] for d in rfree]
+    return tuple(out)
+
+
+def _dot_backward(op: Operation, ospec):
+    lc, rc, lb, rb, lfree, rfree, lnd, rnd = _dot_dims(op)
+    nb = len(lb)
+    ospec = _pad(ospec, nb + len(lfree) + len(rfree))
+    ls: list = [None] * lnd
+    rs: list = [None] * rnd
+    for i, (bl, br) in enumerate(zip(lb, rb)):
+        ls[bl] = rs[br] = ospec[i]
+    for j, d in enumerate(lfree):
+        ls[d] = ospec[nb + j]
+    for j, d in enumerate(rfree):
+        rs[d] = ospec[nb + len(lfree) + j]
+    return tuple(ls), tuple(rs)
+
+
+def _reduce_axes(op: Operation):
+    """Reduced-out dims for single-input rank-dropping reductions
+    (reduce_sum & friends carry an ``axes`` param)."""
+    if op.eqn is None or len(op.inputs) != 1:
+        return None
+    axes = op.eqn.params.get("axes")
+    if axes is None:
+        return None
+    axes = tuple(int(a) for a in axes)
+    if len(op.outputs) == 1 and \
+            len(op.outputs[0].shape) == len(op.inputs[0].shape) - len(axes):
+        return axes
+    return None
+
+
+class _Deriver:
+    """Forward/backward per-op spec derivation with CostModel conflict
+    resolution. Pure over a facts dict keyed by id(Value) — the search
+    pass prices candidate strategies through the same machinery without
+    touching the program."""
+
+    def __init__(self, cost_model: Optional[CostModel] = None):
+        self.cost = cost_model or CostModel()
+        self.lattice = FlatLattice()
+        self.resolved: list = []
+
+    def _resolve(self, op: Operation, annotated):
+        """Pick the winner among clashing operand specs: the candidate
+        with the cheapest reshard (bytes of the operands that would have
+        to move over the ICI row to agree), ties broken textually."""
+        candidates = sorted({spec for _, spec in annotated}, key=repr)
+
+        def reshard_bytes(c):
+            return sum(CostModel._value_bytes([v])
+                       for v, s in annotated if s != c)
+        win = min(candidates, key=lambda c: (reshard_bytes(c), repr(c)))
+        self.resolved.append((op, win))
+        return win
+
+    def _join_inputs(self, op: Operation, facts: dict):
+        annotated = [(v, facts[id(v)]) for v in op.inputs
+                     if facts.get(id(v)) is not None]
+        if not annotated:
+            return None, []
+        joined = None
+        for _, s in annotated:
+            joined = self.lattice.join(joined, s)
+        if joined is CONFLICT:
+            joined = self._resolve(op, annotated)
+        return joined, annotated
+
+    def forward(self, op: Operation, facts: dict) -> bool:
+        if all(facts.get(id(o)) is not None for o in op.outputs):
+            return False
+        prim = op.eqn.primitive.name if op.eqn is not None else op.name
+        specs = None
+        if prim == "dot_general":
+            ls, rs = facts.get(id(op.inputs[0])), facts.get(id(op.inputs[1]))
+            if ls is not None or rs is not None:
+                specs = [_dot_forward(op, ls, rs)]
+        elif prim == "transpose":
+            s = facts.get(id(op.inputs[0]))
+            if s is not None:
+                perm = op.eqn.params["permutation"]
+                s = _pad(s, len(op.inputs[0].shape))
+                specs = [tuple(s[p] for p in perm)]
+        elif prim == "broadcast_in_dim":
+            s = facts.get(id(op.inputs[0]))
+            if s is not None:
+                bd = op.eqn.params["broadcast_dimensions"]
+                s = _pad(s, len(op.inputs[0].shape))
+                out: list = [None] * len(op.outputs[0].shape)
+                for i, d in enumerate(bd):
+                    out[d] = s[i]
+                specs = [tuple(out)]
+        elif _reduce_axes(op) is not None:
+            s = facts.get(id(op.inputs[0]))
+            if s is not None:
+                axes = _reduce_axes(op)
+                s = _pad(s, len(op.inputs[0].shape))
+                specs = [tuple(a for d, a in enumerate(s) if d not in axes)]
+        else:
+            # join rule: annotated operands agree (or are resolved), and
+            # every output whose shape matches an operand inherits
+            joined, annotated = self._join_inputs(op, facts)
+            if joined is not None:
+                in_shapes = {tuple(v.shape) for v, _ in annotated}
+                specs = [joined if tuple(o.shape) in in_shapes
+                         or len(joined) == len(o.shape) else None
+                         for o in op.outputs]
+        if specs is None:
+            return False
+        if len(specs) == 1 and len(op.outputs) > 1:
+            specs = specs * len(op.outputs)
+        changed = False
+        for o, s in zip(op.outputs, specs):
+            if s is None or facts.get(id(o)) is not None:
+                continue
+            facts[id(o)] = _sanitize(s, o.shape)
+            changed = True
+        return changed
+
+    def backward(self, op: Operation, facts: dict) -> bool:
+        outs = [facts.get(id(o)) for o in op.outputs]
+        if all(s is None for s in outs):
+            return False
+        prim = op.eqn.primitive.name if op.eqn is not None else op.name
+        ins = None
+        if prim == "dot_general" and outs[0] is not None:
+            ins = list(_dot_backward(op, outs[0]))
+        elif prim == "transpose" and outs[0] is not None:
+            perm = op.eqn.params["permutation"]
+            s = _pad(outs[0], len(op.outputs[0].shape))
+            inv: list = [None] * len(perm)
+            for i, p in enumerate(perm):
+                inv[p] = s[i]
+            ins = [tuple(inv)]
+        elif prim == "broadcast_in_dim" and outs[0] is not None:
+            bd = op.eqn.params["broadcast_dimensions"]
+            s = _pad(outs[0], len(op.outputs[0].shape))
+            ins = [tuple(s[d] for d in bd)]
+        elif _reduce_axes(op) is not None and outs[0] is not None:
+            axes = _reduce_axes(op)
+            s = list(outs[0])
+            for d in sorted(axes):
+                s.insert(d, None)
+            ins = [tuple(s)]
+        else:
+            # same-shape mirror of the join rule
+            by_shape = {tuple(o.shape): s
+                        for o, s in zip(op.outputs, outs) if s is not None}
+            ins = [by_shape.get(tuple(v.shape)) for v in op.inputs]
+        if ins is None:
+            return False
+        changed = False
+        for v, s in zip(op.inputs, ins):
+            if s is None or facts.get(id(v)) is not None:
+                continue
+            facts[id(v)] = _sanitize(s, v.shape)
+            changed = True
+        return changed
+
+
+def propagate_facts(prog: Program, seed: dict,
+                    cost_model: Optional[CostModel] = None):
+    """Run the forward+backward fixpoint over a facts dict (no program
+    mutation). Returns ``(facts, stamps, resolved, sweeps)``: stamps is
+    ``{id(op): rule_text}`` for every op whose operand/result specs
+    legitimately diverge and therefore needs a ``sharding_rule``
+    contract for the consistency analysis."""
+    deriver = _Deriver(cost_model)
+    facts = dict(seed)
+    sweeps = 0
+    for sweeps in range(1, MAX_SWEEPS + 1):
+        changed = False
+        for op in prog.ops:
+            changed |= deriver.forward(op, facts)
+        for op in reversed(prog.ops):
+            changed |= deriver.backward(op, facts)
+        if not changed:
+            break
+    lattice = FlatLattice()
+    stamps: dict = {}
+    for op in prog.ops:
+        outs = [facts.get(id(o)) for o in op.outputs]
+        ins = [facts.get(id(v)) for v in op.inputs
+               if facts.get(id(v)) is not None]
+        if not ins or all(s is None for s in outs):
+            continue
+        joined = None
+        for s in ins:
+            joined = lattice.join(joined, s)
+        if joined is CONFLICT:
+            win = deriver._resolve(op, [
+                (v, facts[id(v)]) for v in op.inputs
+                if facts.get(id(v)) is not None])
+            stamps[id(op)] = f"reshard{_spec_str(win)}"
+        elif joined is not None and any(
+                s is not None and s != joined for s in outs):
+            prim = op.eqn.primitive.name if op.eqn is not None else op.name
+            stamps[id(op)] = f"{prim}{_spec_str(outs[0])}"
+    return facts, stamps, list(deriver.resolved), sweeps
+
+
+# --------------------------------------------------------------------------
+# the pass
+# --------------------------------------------------------------------------
+
+class ShardingPropagation(Pass):
+    """Fill ``Value.sharding`` from the program-input annotations and
+    stamp ``sharding_rule`` contracts where operand specs legitimately
+    diverge; pin the scope mesh on the program for constraint emission.
+    No annotations anywhere -> no-op (the single-chip fast path costs
+    one scan of the inputs). The ``compile.shard_prop`` fault site wraps
+    the entry: an injected failure propagates to pipeline.compile_flat,
+    which degrades that compile to plain unsharded jax.jit under
+    ``pir_fallback_total{stage="passes"}``."""
+
+    name = "shard_prop"
+
+    def run(self, prog: Program) -> PassResult:
+        from ..resilience.faults import fault_point
+        fault_point("compile.shard_prop", program=prog.name)
+        mesh_axes = _mesh_axis_sizes(current_mesh())
+        seed: dict = {}
+        pinned: set = set()
+        for v in list(prog.inputs) + list(prog.constants):
+            if v.sharding is not None:
+                seed[id(v)] = _sanitize(v.sharding, v.shape, mesh_axes)
+        for op in prog.ops:
+            for o in op.outputs:
+                if o.sharding is not None:
+                    # interior pre-stamp: a source for propagation but
+                    # never ours to resolve or rule-stamp over — if it
+                    # contradicts the flow, the verifier rejects it
+                    seed[id(o)] = _sanitize(o.sharding, o.shape, mesh_axes)
+                    pinned.add(id(op))
+        if not seed:
+            return PassResult(0, "no-annotations")
+        facts, stamps, resolved, sweeps = propagate_facts(prog, seed)
+        values = 0
+        for v in self._all_values(prog):
+            s = facts.get(id(v))
+            if s is not None and v.sharding is None:
+                v.sharding = s
+                values += 1
+        rules = 0
+        for op in prog.ops:
+            rule = stamps.get(id(op))
+            if rule is None or id(op) in pinned \
+                    or "sharding_rule" in op.attrs:
+                continue
+            op.attrs["sharding_rule"] = rule
+            rules += 1
+            for o in op.outputs:     # contract ops declare every output
+                if o.sharding is None:
+                    o.sharding = (None,) * len(o.shape)
+                    values += 1
+        mesh = current_mesh()
+        if mesh is not None and (values or seed):
+            prog._mesh = mesh        # evaluator traces under this mesh
+        if values:
+            try:
+                from ..observability.catalog import metric as _metric
+                _metric("pir_sharding_annotations_total",
+                        program=prog.name).inc(values)
+            except Exception:  # noqa: BLE001 — metrics never cost a compile
+                pass
+        return PassResult(
+            values + rules,
+            f"values={values} rules={rules} resolved={len(resolved)} "
+            f"sweeps={sweeps}")
+
+    @staticmethod
+    def _all_values(prog: Program):
+        for v in prog.inputs:
+            yield v
+        for v in prog.constants:
+            yield v
+        for op in prog.ops:
+            for o in op.outputs:
+                yield o

@@ -98,6 +98,12 @@ FAULT_SITES = {
                       "verifier-error rule and the compile degrades to "
                       "plain jax.jit, counted "
                       "pir_fallback_total{stage=verify}",
+    "compile.shard_prop": "PIR sharding-propagation pass entry "
+                          "(pir/shard_prop.py): an injected fault "
+                          "aborts the pass pipeline and the compile "
+                          "degrades to plain UNSHARDED jax.jit with "
+                          "identical numerics, counted "
+                          "pir_fallback_total{stage=passes}",
 }
 
 

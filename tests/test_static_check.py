@@ -31,7 +31,7 @@ def test_list_rules_names_the_closed_registry():
     assert r.returncode == 0
     for rule in ("metrics-in-catalog", "catalog-docs-sync", "fault-sites",
                  "recorder-kinds", "flags-registered", "host-sync",
-                 "profiler-phases", "scheduler-actions"):
+                 "profiler-phases", "scheduler-actions", "pir-passes"):
         assert rule in r.stdout
 
 
@@ -76,6 +76,30 @@ def test_scheduler_actions_rule_catches_unregistered_literals(tmp_path):
     msgs = " | ".join(v["message"] for v in found)
     for lit in ("panic", "vip", "urgent", "turbo"):
         assert f"'{lit}'" in msgs, (lit, found)
+
+
+def test_pir_passes_rule_catches_drift():
+    # the rule compares repo registries (not scanned --paths sources),
+    # so drift is injected by calling it on a stub context in-process
+    import importlib.util
+    from types import SimpleNamespace
+    spec = importlib.util.spec_from_file_location("_sc", TOOL)
+    sc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sc)
+
+    aligned = {"fold", "dce"}
+    assert sc.rule_pir_passes(SimpleNamespace(
+        pir_passes=aligned, pir_flag_default=set(aligned),
+        compiler_pass_rows=set(aligned))) == []
+    drifted = sc.rule_pir_passes(SimpleNamespace(
+        pir_passes=aligned | {"undocumented"},
+        pir_flag_default=aligned | {"unregistered"},
+        compiler_pass_rows=aligned - {"dce"}))
+    msgs = " | ".join(v.message for v in drifted)
+    # registry entry missing from both mirrors, phantom flag name,
+    # registry entry missing from the doc table: all directions fire
+    assert "'undocumented'" in msgs and "'unregistered'" in msgs \
+        and "'dce'" in msgs, msgs
 
 
 def test_host_sync_rule_catches_new_sync(tmp_path):

@@ -500,6 +500,37 @@ def drill_compile_verify(tmp):
                         "verified and took the PIR path")
 
 
+def drill_compile_shard_prop(tmp):
+    from paddle_tpu.framework import flags as _flags
+    pir, fn, args, want, prev = _pir_compile_setup(tmp)
+    try:
+        with faults.injected_faults("compile.shard_prop:1:RuntimeError"):
+            compiled, rep = pir.compile_flat(fn, args, name="drill_sprop")
+            inj = faults.injected_counts().get("compile.shard_prop", 0)
+        _expect(inj == 1, "fault never reached the shard_prop pass entry")
+        _expect(rep.fallback == "passes",
+                f"shard_prop fault not degraded: fallback={rep.fallback}")
+        out = float(np.asarray(compiled(*args)[0]))
+        _expect(abs(out - want) < 1e-5,
+                f"unsharded fallback jit result wrong: {out}")
+        _expect(_counter("pir_fallback_total", stage="passes") >= 1,
+                "passes fallback not counted")
+        _expect(_counter("fault_injected_total",
+                         site="compile.shard_prop") >= 1,
+                "injection not counted")
+        # with the fault gone the same program takes the PIR path again
+        clean, rep2 = pir.compile_flat(fn, args, name="drill_sprop")
+        _expect(rep2.fallback is None,
+                f"still degraded after fault cleared: {rep2.fallback}")
+        out2 = float(np.asarray(clean(*args)[0]))
+        _expect(abs(out2 - want) < 1e-5, f"clean recompile wrong: {out2}")
+    finally:
+        _flags.set_flags({"compile_cache_dir": prev})
+    return "degraded", ("sharding-propagation fault degraded that "
+                        "compile to plain UNSHARDED jax.jit (correct "
+                        "numerics); next compile took the PIR path")
+
+
 SCENARIOS = {
     "ckpt.chunk_write": drill_ckpt_chunk_write,
     "ckpt.metadata_replace": drill_ckpt_metadata_replace,
@@ -519,6 +550,7 @@ SCENARIOS = {
     "compile.cache_read": drill_compile_cache_read,
     "compile.cache_write": drill_compile_cache_write,
     "compile.verify": drill_compile_verify,
+    "compile.shard_prop": drill_compile_shard_prop,
 }
 
 

@@ -25,6 +25,14 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# the sharded example needs >=4 devices; on the host-CPU platform force
+# virtual devices BEFORE jax import (the flag is read once at backend init)
+if os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
+    _xf = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _xf:
+        os.environ["XLA_FLAGS"] = \
+            (_xf + " --xla_force_host_platform_device_count=4").strip()
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
@@ -109,10 +117,27 @@ def ex_sdpa_epilogue():
     return fn, [q, k, v, res, w]
 
 
+def ex_sharded_mlp():
+    """Annotated-input example for the sharding passes: inputs carry
+    sparse mesh-axis specs and shard_prop must propagate them through
+    the whole program (the printed IR shows ``<dp,*>`` suffixes)."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8, 16), jnp.float32)
+    w1 = jnp.asarray(rng.randn(16, 32) * 0.1, jnp.float32)
+    w2 = jnp.asarray(rng.randn(32, 16) * 0.1, jnp.float32)
+
+    def fn(x_, w1_, w2_):
+        return ((jnp.tanh(x_ @ w1_) @ w2_).sum(-1),)
+
+    return fn, [x, w1, w2], {
+        "input_shardings": [("dp", None), (None, "mp"), ("mp", None)]}
+
+
 EXAMPLES = {
     "mlp": ex_mlp,
     "llama_block": ex_llama_block,
     "sdpa_epilogue": ex_sdpa_epilogue,
+    "sharded_mlp": ex_sharded_mlp,
 }
 
 
@@ -129,14 +154,51 @@ def _verify(prog, name, where, strict_dead=False):
         return False
 
 
+def _host_mesh():
+    """2x2 (dp, mp) mesh over the first 4 devices; None when the
+    platform has fewer (the sharded example then degrades to the plain
+    unannotated path — same contract as the compile pipeline)."""
+    devs = jax.devices()
+    if len(devs) < 4:
+        return None
+    from jax.sharding import Mesh
+    return Mesh(np.array(devs[:4]).reshape(2, 2), ("dp", "mp"))
+
+
 def run_example(name, diff=False, check=False, verbose=True):
     """Returns True when --check passed (or wasn't requested)."""
-    fn, flat = EXAMPLES[name]()
+    import contextlib
+    got = EXAMPLES[name]()
+    fn, flat = got[0], got[1]
+    extras = got[2] if len(got) > 2 else {}
     eager = [np.asarray(o) for o in fn(*flat)]
 
+    scope = contextlib.nullcontext()
+    specs = extras.get("input_shardings")
+    if specs is not None:
+        from paddle_tpu.pir import shard_prop
+        mesh = _host_mesh()
+        if mesh is None:
+            print(f"== {name}: <4 devices, running unannotated")
+            specs = None
+        else:
+            scope = shard_prop.mesh_scope(mesh)
+    with scope:
+        return _run_example_inner(name, fn, flat, eager, specs,
+                                  diff=diff, check=check)
+
+
+def _run_example_inner(name, fn, flat, eager, specs, diff, check):
     prog, _ = pir.capture(fn, *flat, name=name)
-    print(f"== {name}: captured {prog.num_ops()} ops "
-          f"(hash {prog.canonical_hash()[:16]})")
+    if specs is not None:
+        from paddle_tpu.pir import shard_prop
+        n = shard_prop.annotate_inputs(prog, specs)
+        print(f"== {name}: captured {prog.num_ops()} ops, "
+              f"{n} inputs annotated "
+              f"(hash {prog.canonical_hash()[:16]})")
+    else:
+        print(f"== {name}: captured {prog.num_ops()} ops "
+              f"(hash {prog.canonical_hash()[:16]})")
     if diff:
         print(prog.to_string())
 
@@ -189,7 +251,12 @@ def main():
     ap.add_argument("--check", action="store_true",
                     help="exit nonzero if any enabled pass changes "
                          "numerics vs eager on the fixed seed")
+    ap.add_argument("--sharded", action="store_true",
+                    help="shorthand for --example sharded_mlp (the "
+                         "annotated-input sharding-propagation demo)")
     args = ap.parse_args()
+    if args.sharded and not args.example:
+        args.example = "sharded_mlp"
     names = sorted(EXAMPLES) if args.all or not args.example \
         else [args.example]
     ok = True

@@ -92,6 +92,18 @@ def main(argv=None):
                          "populated cost gauge, only known finish reasons, "
                          "and (with --scheduler) the brownout ladder "
                          "back at 0")
+    ap.add_argument("--speculative", action="store_true",
+                    help="run the engine with speculative fused decode, "
+                         "feeding the scenario's tuned n-gram statistics "
+                         "(inference/drafting.py) into the drafter= hook; "
+                         "the report gains a per-scenario acceptance block")
+    ap.add_argument("--flat-drafter", action="store_true",
+                    help="with --speculative: use the engine's built-in "
+                         "flat n-gram drafter instead of the per-scenario "
+                         "statistics (the A/B baseline)")
+    ap.add_argument("--min-acceptance", type=float, default=None,
+                    help="with --check on a speculative run: fail unless "
+                         "draft acceptance reaches this floor")
     ap.add_argument("--min-coverage", type=float, default=0.95)
     ap.add_argument("--out", default=None, help="write the report JSON here "
                     "(default: stdout)")
@@ -108,7 +120,14 @@ def main(argv=None):
 
     obs.enable()
     get_phase_accountant().enabled = True
-    engine = build_engine(scheduler=True if args.scheduler else None)
+    kw = {}
+    if args.speculative:
+        from paddle_tpu.inference import drafting
+        kw["speculative_decode"] = True
+        kw["draft_depth"] = drafting.scenario_draft_depth(args.scenario)
+        if not args.flat_drafter:
+            kw["drafter"] = drafting.scenario_drafter(args.scenario)
+    engine = build_engine(scheduler=True if args.scheduler else None, **kw)
     report = loadgen.run_scenario(
         engine, args.scenario, seed=args.seed, rate_rps=args.rate,
         duration_s=args.duration, max_wall_s=args.max_wall,
@@ -124,21 +143,30 @@ def main(argv=None):
 
     slo_state = "PASS" if report["slo"].get("ok") else "BREACH"
     cov = report.get("coverage")
+    spec = report.get("speculative")
+    spec_str = "" if not spec else (
+        f" drafter={spec['drafter']} acceptance={spec['acceptance']}"
+        f" ({spec['accepted_tokens']}/{spec['draft_tokens']})")
     print(f"\n# scenario={report['scenario']} seed={report['seed']} "
           f"issued={report['issued']} goodput={report['goodput']} "
           f"ttft_p95={report['ttft']['p95']} slo={slo_state} "
-          f"coverage={cov if cov is None else round(cov, 4)}",
+          f"coverage={cov if cov is None else round(cov, 4)}{spec_str}",
           file=sys.stderr)
 
     if args.check:
-        problems = loadgen.check_report(report,
-                                        min_coverage=args.min_coverage)
+        problems = loadgen.check_report(
+            report, min_coverage=args.min_coverage,
+            min_acceptance=((args.min_acceptance
+                             if args.min_acceptance is not None else 0.0)
+                            if args.speculative else None))
         for p in problems:
             print(f"CHECK FAIL: {p}", file=sys.stderr)
         if problems:
             return 1
+        extra = "" if not spec else (
+            f", per-scenario acceptance {spec['acceptance']}")
         print("CHECK PASS: SLO verdict present, attribution "
-              f">={args.min_coverage:.0%}, cost gauge populated",
+              f">={args.min_coverage:.0%}, cost gauge populated{extra}",
               file=sys.stderr)
     return 0
 

@@ -28,6 +28,8 @@ Rules (closed registry, like everything else here):
   host-sync            device->host syncs (np.asarray / .item() /
                        jax.device_get / .block_until_ready) in the
                        serving hot path outside the audited allowlist
+  pir-passes           pir/passes.py PASSES == FLAGS_pir_passes
+                       default == COMPILER.md pass-catalog rows
 
 Usage:
   python tools/static_check.py                 # whole repo, all rules
@@ -64,8 +66,10 @@ FLAGS_PY = "paddle_tpu/framework/flags.py"
 PHASES_PY = "paddle_tpu/profiler/phases.py"
 SCHEDULER_PY = "paddle_tpu/inference/scheduler.py"
 CHAOS_PY = "tools/chaos_drill.py"
+PASSES_PY = "paddle_tpu/pir/passes.py"
 OBS_MD = "OBSERVABILITY.md"
 RES_MD = "RESILIENCE.md"
+COMPILER_MD = "COMPILER.md"
 
 # profiler-phases rule scope: the files whose mark("...") literals must
 # resolve against the PHASES registry (`mark` is too generic a name to
@@ -164,6 +168,34 @@ def _defined_flags():
     return names
 
 
+def _pir_flag_default():
+    """The pass names in the FLAGS_pir_passes default — the comma list
+    in ``define_flag("pir_passes", "<literal>", ...)`` in flags.py."""
+    for node in ast.walk(_parse(FLAGS_PY)):
+        if isinstance(node, ast.Call) and _callee(node) == "define_flag" \
+                and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and node.args[0].value == "pir_passes" \
+                and len(node.args) > 1 \
+                and isinstance(node.args[1], ast.Constant) \
+                and isinstance(node.args[1].value, str):
+            return {n for n in node.args[1].value.split(",") if n}
+    raise RuntimeError(
+        f"{FLAGS_PY}: no define_flag('pir_passes', <string literal>, ...)")
+
+
+def _compiler_pass_rows():
+    """Backticked first-cell names of the COMPILER.md pass-catalog
+    table rows, scoped to the '## Pass catalog' section (the next
+    '## ' heading ends it; '### ' sub-headings don't)."""
+    text = _read(COMPILER_MD)
+    m = re.search(r"^## Pass catalog$(.*?)(?=^## |\Z)", text,
+                  re.M | re.S)
+    if not m:
+        raise RuntimeError(f"{COMPILER_MD}: no '## Pass catalog' section")
+    return set(re.findall(r"^\| `([a-z_]+)` \|", m.group(1), re.M))
+
+
 def _callee(call):
     """Trailing name of a call target: f(...) and o.f(...) both -> 'f'."""
     f = call.func
@@ -196,6 +228,9 @@ class Context:
             r"^\| `brownout/([a-z_]+)` \|", _read(RES_MD), re.M))
         self.res_priority_rows = set(re.findall(
             r"^\| `priority/([a-z_]+)` \|", _read(RES_MD), re.M))
+        self.pir_passes = _dict_keys(PASSES_PY, "PASSES")
+        self.pir_flag_default = _pir_flag_default()
+        self.compiler_pass_rows = _compiler_pass_rows()
         self.sources = {}
         for rel in (paths if paths is not None else self._default_paths()):
             try:
@@ -544,6 +579,32 @@ def rule_host_sync(ctx):
     return out
 
 
+def rule_pir_passes(ctx):
+    """The PIR pass registry (pir/passes.py PASSES) is closed like the
+    metric catalog, and it has two mirrors that must not drift: the
+    FLAGS_pir_passes default (every registered pass ships enabled — a
+    pass that shouldn't run by default must be *removed* deliberately,
+    in both places) and the COMPILER.md pass-catalog table (every pass
+    documented, nothing phantom documented). All pairwise, both
+    directions."""
+    out = []
+    pairs = ((ctx.pir_flag_default, FLAGS_PY,
+              "the FLAGS_pir_passes default"),
+             (ctx.compiler_pass_rows, COMPILER_MD,
+              f"the {COMPILER_MD} pass-catalog table"))
+    for other, where, desc in pairs:
+        for name in sorted(ctx.pir_passes - other):
+            out.append(Violation(
+                "pir-passes", where, 0,
+                f"PASSES entry {name!r} is missing from {desc}"))
+        for name in sorted(other - ctx.pir_passes):
+            out.append(Violation(
+                "pir-passes", where, 0,
+                f"{desc} lists {name!r} which is not in "
+                f"{PASSES_PY} PASSES"))
+    return out
+
+
 RULES = {
     "metrics-in-catalog": (rule_metrics_in_catalog,
                            "metric() literals are catalog entries"),
@@ -564,6 +625,9 @@ RULES = {
                          "define_flag()ed"),
     "host-sync": (rule_host_sync,
                   "no unaudited device->host syncs in the serving path"),
+    "pir-passes": (rule_pir_passes,
+                   "pir PASSES == FLAGS_pir_passes default == "
+                   "COMPILER.md pass-catalog rows"),
 }
 
 

@@ -791,6 +791,80 @@ def secondary_worker(force_cpu: bool, which: str):
     return 0
 
 
+def _mesh_scaling_rows(paddle, cfg, eng_kw, n_requests=16, max_new=16):
+    """CPU-proxy mesh scaling evidence for the loadgen row: drive the
+    SAME deterministic request set through (a) a 1-replica mesh, (b) a
+    2-replica data-parallel mesh, (c) a 2-replica disaggregated
+    (prefill + decode) mesh, and compare aggregate tok/s over the
+    simulated-parallel wall (per-round max of in-process replica step
+    walls — labeled simulated; nproc=1 serializes the real clock).
+    Greedy streams must be byte-identical across all three topologies."""
+    import numpy as np
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.inference.mesh import MeshRouter, ReplicaPool
+    from paddle_tpu.models.llama import LlamaForCausalLM
+
+    def factory():
+        paddle.seed(0)   # identical weights on every replica
+        return ContinuousBatchingEngine(LlamaForCausalLM(cfg), **eng_kw)
+
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           size=int(rng.randint(6, 14))).tolist()
+               for _ in range(n_requests)]
+
+    def drive(n, disaggregate, port):
+        pool = ReplicaPool(factory, n=n, disaggregate=disaggregate,
+                           store_port=port)
+        router = MeshRouter(pool)
+        # warm every replica's compiled programs (prefill bucket,
+        # decode tile, lane upload, handoff import) so the measured
+        # wall is steady-state serving, not per-replica compile
+        for p in prompts[: 2 * n]:
+            router.add_request(list(p), max_new_tokens=max_new)
+        router.run()
+        w0 = router.sim_parallel_wall_s
+        c0 = sum(len(r.generated) for r in router.finished.values())
+        for p in prompts:
+            router.add_request(list(p), max_new_tokens=max_new)
+        streams = router.run()
+        rep = router.mesh_report()
+        rep["measured_tokens"] = rep["committed_tokens"] - c0
+        rep["measured_wall_s"] = rep["sim_parallel_wall_s"] - w0
+        # measured streams only (warmup rids excluded) for identity
+        measured = {rid: toks for rid, toks in streams.items()
+                    if rid >= 2 * n}
+        return measured, rep
+
+    s1, r1 = drive(1, False, 47101)
+    s2, r2 = drive(2, False, 47102)
+    sd, rd = drive(2, True, 47103)
+
+    def agg(rep):
+        w = rep["measured_wall_s"]
+        return rep["measured_tokens"] / w if w > 0 else 0.0
+
+    def streams_eq(a, b):
+        # mesh rids differ by warmup count across topologies; identity
+        # is positional — i-th measured request, same prompt each time
+        return list(a.values()) == list(b.values())
+
+    t1, t2, td = agg(r1), agg(r2), agg(rd)
+    return {
+        "sim_parallel": True,   # nproc=1: wall is the simulated clock
+        "requests": n_requests,
+        "tokens": r1["measured_tokens"],
+        "tok_per_s_1replica": round(t1, 1),
+        "tok_per_s_2replica": round(t2, 1),
+        "tok_per_s_2replica_disagg": round(td, 1),
+        "speedup_2replica": round(t2 / t1, 3) if t1 > 0 else None,
+        "speedup_disagg": round(td / t1, 3) if t1 > 0 else None,
+        "dp_byte_identical": streams_eq(s2, s1),
+        "disagg_byte_identical": streams_eq(sd, s1),
+        "disagg_handoffs": rd["handoffs"],
+    }
+
+
 def loadgen_worker(force_cpu: bool, scenario="chat", seed=0):
     """--loadgen leg: drive the serving engine with a seeded traffic
     scenario (inference/loadgen.py, same harness as tools/loadgen.py)
@@ -829,6 +903,22 @@ def loadgen_worker(force_cpu: bool, scenario="chat", seed=0):
     eng = ContinuousBatchingEngine(model, scheduler=True, **eng_kw)
     rep = loadgen.run_scenario(eng, scenario, seed=seed)
     problems = loadgen.check_report(rep)
+    mesh_row = None
+    if not on_tpu:
+        # disaggregated-mesh scaling row (CPU proxy): 1 vs 2 replicas,
+        # byte-identity + >=1.6x aggregate tok/s gates (RESILIENCE.md
+        # mesh runbook)
+        mesh_row = _mesh_scaling_rows(paddle, cfg, eng_kw)
+        if not mesh_row["dp_byte_identical"]:
+            problems.append("2-replica mesh streams diverge from the "
+                            "1-replica reference")
+        if not mesh_row["disagg_byte_identical"]:
+            problems.append("disaggregated mesh streams diverge from the "
+                            "1-replica reference")
+        sp = mesh_row["speedup_2replica"]
+        if sp is None or sp < 1.6:
+            problems.append(f"2-replica mesh aggregate tok/s speedup "
+                            f"{sp} < 1.6x over 1 replica")
     detail = {
         "device": str(jax.devices()[0]),
         "scenario": rep["scenario"], "seed": rep["seed"],
@@ -847,6 +937,7 @@ def loadgen_worker(force_cpu: bool, scenario="chat", seed=0):
         "brownout_level_end": rep.get("brownout_level_end"),
         "brownout_transitions": rep.get("brownout_transitions"),
         "preemptions": rep.get("preemptions"),
+        "mesh_scaling": mesh_row,
         "check_problems": problems,
     }
     detail["metrics_snapshot"] = _obs.snapshot(

@@ -24,12 +24,15 @@ from .scheduler import (  # noqa: E402,F401
 from .serving import (  # noqa: E402,F401
     BackpressureError, ContinuousBatchingEngine, KVPoolExhaustedError,
     Request)
+from .mesh import (  # noqa: E402,F401
+    KVHandoffError, MeshRouter, ReplicaPool)
 
 __all__ = ["ContinuousBatchingEngine", "Request", "BackpressureError",
            "KVPoolExhaustedError",
            "Scenario", "SCENARIOS", "build_schedule", "run_scenario",
            "check_report",
            "SLOScheduler", "PRIORITY_CLASSES", "BROWNOUT_LEVELS",
+           "MeshRouter", "ReplicaPool", "KVHandoffError",
            "Config", "Predictor", "Tensor", "create_predictor",
            "PrecisionType", "PlaceType", "get_version"]
 

@@ -510,6 +510,12 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
                             "fifo": bool(engine.scheduler.fifo),
                             "preempts": int(
                                 engine.scheduler.preempt_requests)}),
+        # mesh evidence (None for a single engine): per-replica
+        # goodput/headroom snapshots + handoff/failover accounting from
+        # MeshRouter.mesh_report() — the engine surface is identical,
+        # so the harness only needs this one hook
+        "mesh": (engine.mesh_report()
+                 if hasattr(engine, "mesh_report") else None),
     }
     rec = _get_recorder()
     if rec.enabled:

@@ -1,0 +1,30 @@
+"""Disaggregated serving mesh (round 16).
+
+Turns the single-process ContinuousBatchingEngine into a cluster of
+in-process worker replicas:
+
+- `replica.ReplicaPool` — N engine replicas (optionally TP-sharded via
+  the PR-12 auto-parallel passes) with lease-based membership over
+  TCPStore + ElasticManager; killing one tombstones its lease.
+- `handoff` — byte-exact serialized paged-KV transfer between prefill
+  and decode workers, in the pool's raw block-storage format (native
+  and int8/fp8 quantized alike), with retry-then-re-prefill semantics
+  at the `mesh.kv_handoff` fault site.
+- `router.MeshRouter` — the front door: DRR/priority admission over a
+  mesh-wide view, headroom-ranked replica choice behind the
+  `mesh.route` fault site and per-replica CircuitBreakers, at-most-once
+  stream commit, and replica-failover re-prefill that keeps greedy
+  streams byte-identical to a single-replica run.
+
+Operational story: RESILIENCE.md "Mesh runbook"; metrics:
+OBSERVABILITY.md "serving mesh" rows.
+"""
+
+from .handoff import (KVHandoffError, hand_off, pack_record,
+                      unpack_record, wire_size)
+from .replica import Replica, ReplicaPool, ROLES
+from .router import MeshRequest, MeshRouter
+
+__all__ = ["KVHandoffError", "hand_off", "pack_record", "unpack_record",
+           "wire_size", "Replica", "ReplicaPool", "ROLES",
+           "MeshRequest", "MeshRouter"]

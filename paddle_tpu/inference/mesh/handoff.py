@@ -1,0 +1,152 @@
+"""Serialized paged-KV handoff between disaggregated serving workers.
+
+A prefill worker's `engine.export_kv` record — the prompt's KV blocks in
+the pool's RAW storage representation (payload + scales when quantized)
+plus the stream's identity (trace id, PRNG seed, arrival anchors) — is
+packed into one self-describing wire buffer, transferred, and installed
+on a decode worker through `engine.import_kv`. Because the payload is
+the stored bytes (never dequantized values), the round trip is
+byte-exact for every KVBlockFormat: native/bf16 passthrough and
+int8/fp8 quantized alike, so the decode side continues the stream
+byte-identically to a single-process engine.
+
+Failure contract (`mesh.kv_handoff` fault site): transient transfer
+failures retry under the caller's RetryPolicy; exhaustion raises
+KVHandoffError and the router falls back to RE-PREFILLING the request
+on the decode side — slower, never wrong (greedy decode is
+deterministic and sampled lanes key the device PRNG on (seed, absolute
+position), so the re-prefilled stream is the same stream).
+
+Wire format (version 1): little-endian u32 header length, a sorted-key
+JSON header (scalar metadata + per-array dtype/shape manifest), then the
+arrays' raw bytes concatenated in sorted key order. Deterministic — the
+same record packs to the same bytes.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+
+import numpy as np
+
+from ...resilience.faults import FaultInjected, fault_point
+
+__all__ = ["KVHandoffError", "pack_record", "unpack_record", "wire_size",
+           "hand_off"]
+
+_TRANSIENT = (TimeoutError, ConnectionError, OSError, FaultInjected)
+
+WIRE_VERSION = 1
+
+
+class KVHandoffError(RuntimeError):
+    """A paged-KV handoff that could not be delivered (transient
+    failures past the retry budget, or the receiving engine rejected
+    the record). The router's recovery is re-prefill, not a crash."""
+
+
+def _py(v):
+    """JSON-safe scalar: numpy ints/floats/bools -> Python builtins."""
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, (np.bool_,)):
+        return bool(v)
+    return v
+
+
+def _resolve_dtype(name):
+    """np.dtype by name, falling back to ml_dtypes for the extended
+    float formats (bfloat16 / float8_*) jax stores KV payloads in."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def pack_record(record):
+    """Serialize an export_kv record to one wire buffer (bytes)."""
+    meta, arrays = {}, {}
+    for key, val in record.items():
+        if isinstance(val, np.ndarray):
+            arrays[key] = np.ascontiguousarray(val)
+        else:
+            meta[key] = _py(val)
+    meta["wire_version"] = WIRE_VERSION
+    manifest = {k: [str(a.dtype), list(a.shape)]
+                for k, a in arrays.items()}
+    head = json.dumps({"meta": meta, "arrays": manifest},
+                      sort_keys=True).encode()
+    out = io.BytesIO()
+    out.write(struct.pack("<I", len(head)))
+    out.write(head)
+    for key in sorted(arrays):
+        out.write(arrays[key].tobytes())
+    return out.getvalue()
+
+
+def unpack_record(buf):
+    """Inverse of pack_record; array bytes round-trip exactly."""
+    (hlen,) = struct.unpack_from("<I", buf, 0)
+    head = json.loads(buf[4:4 + hlen].decode())
+    meta = head["meta"]
+    if meta.get("wire_version") != WIRE_VERSION:
+        raise KVHandoffError(
+            f"unknown handoff wire version {meta.get('wire_version')!r}")
+    record = {k: v for k, v in meta.items() if k != "wire_version"}
+    off = 4 + hlen
+    for key in sorted(head["arrays"]):
+        dtype_name, shape = head["arrays"][key]
+        dt = _resolve_dtype(dtype_name)
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        end = off + n * dt.itemsize
+        record[key] = np.frombuffer(
+            buf[off:end], dtype=dt).reshape(shape).copy()
+        off = end
+    if "prompt" in record:
+        record["prompt"] = np.asarray(record["prompt"], np.int32)
+    return record
+
+
+def wire_size(record):
+    """Wire bytes this record serializes to (the handoff-bytes
+    histogram's unit) without keeping the buffer."""
+    return len(pack_record(record))
+
+
+def hand_off(record, engine, retry=None):
+    """Deliver one prefill->decode handoff: pass the `mesh.kv_handoff`
+    fault site, pack + unpack the record over the wire, and install it
+    on `engine` via import_kv. Returns (local_rid, wire_bytes,
+    retries). Raises KVHandoffError when the transfer cannot be
+    delivered (caller re-prefills); engine-side rejections
+    (format mismatch, pool exhausted) propagate as KVHandoffError too.
+
+    The transfer itself is host-side bytes — on the in-process CPU
+    proxy it runs between engine steps while the decode engine's
+    double-buffered tiles are still in flight, i.e. overlapped with
+    decode exactly as a NIC transfer would be; import_kv only parks the
+    request, so no device work serializes behind the copy."""
+    def _xfer():
+        fault_point("mesh.kv_handoff", trace=record.get("trace_id"))
+        return pack_record(record)
+
+    try:
+        if retry is not None:
+            wire = retry.call(_xfer, op="mesh.kv_handoff")
+            retries = retry.last_retries
+        else:
+            wire = _xfer()
+            retries = 0
+    except _TRANSIENT as e:
+        raise KVHandoffError(f"handoff transfer failed: {e!r}") from e
+    try:
+        rid = engine.import_kv(unpack_record(wire))
+    except (ValueError, MemoryError) as e:
+        raise KVHandoffError(f"receiving engine rejected handoff: "
+                             f"{e!r}") from e
+    return rid, len(wire), retries

@@ -1,0 +1,553 @@
+"""Headroom-aware request router for the disaggregated serving mesh.
+
+The MeshRouter fronts a ReplicaPool with the same duck-type surface the
+load harness drives a single engine through (add_request / step /
+has_work / finished / predicted_service_seconds / predicted_costs), so
+`loadgen.run_scenario(router, ...)` works unchanged — the mesh IS an
+engine from the harness's point of view.
+
+Routing: requests queue at the router and place onto replicas in DRR
+order when an SLOScheduler is attached (the PR-11 priority/tenant
+machinery over a mesh-wide admission view), FIFO otherwise. Replica
+choice ranks candidates by exported slo_headroom (1 - offered rate x
+predicted_service_seconds, per replica) with queue/lane load as the
+uncalibrated tiebreaker; every pick passes the `mesh.route` fault site
+and the target's CircuitBreaker — a fault or open breaker fails the
+pick over to the next-best replica and counts a failover.
+
+Disaggregation: prefill-role replicas carry a prefill_sink, so a
+routed request prefills there, exports its paged-KV blocks, and the
+router delivers the serialized record to a decode replica
+(handoff.hand_off -> import_kv) with retry-then-re-prefill semantics.
+The transfer is host bytes between engine steps, overlapped with the
+decode replica's in-flight double-buffered tiles.
+
+Correctness contract: tokens commit to the mesh result AT MOST ONCE per
+stream — a stream is committed only when it finishes on some replica,
+and a mesh request is never committed twice (kill a replica mid-decode
+and the re-routed re-prefill regenerates the same stream: greedy decode
+is deterministic, sampled lanes key the device PRNG on (seed, absolute
+position)). Greedy mesh streams are byte-identical to a single-replica
+run (test-pinned).
+
+Simulated-parallel clock: replicas are in-process workers stepped
+round-robin, so real wall time is serial. Each pump records every
+replica's step wall; `sim_parallel_wall_s` sums the per-round MAXIMUM —
+the wall clock N separate chips stepping concurrently would see — and
+is labeled as simulated wherever it is reported (bench scaling row).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from ...observability.catalog import metric as _metric
+from ...observability.recorder import get_recorder as _get_recorder
+from ...observability.tracing import get_tracer as _get_tracer
+from ...observability.tracing import new_trace_id as _new_trace_id
+from ...resilience.faults import FaultInjected, check, fault_point
+from ...resilience.retry import RetryPolicy
+from ..serving import BackpressureError
+from ..scheduler import PRIORITY_CLASSES
+from .handoff import KVHandoffError, hand_off
+
+__all__ = ["MeshRequest", "MeshRouter"]
+
+_TRANSIENT = (TimeoutError, ConnectionError, OSError, FaultInjected)
+
+
+class MeshRequest:
+    """One stream tracked mesh-wide: the original admission parameters
+    (identity survives re-routing: trace id, sampling seed, arrival
+    anchor) plus routing state. Doubles as the finished record for
+    requests that never reach a replica (router-side timeout), so it
+    carries the same reporting fields a serving Request does."""
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
+                 "do_sample", "temperature", "top_k", "top_p", "seed",
+                 "deadline_s", "tenant", "priority", "trace_id",
+                 "t_arrival", "t_deadline", "t_first", "generated",
+                 "done", "finish_reason", "phase", "replica",
+                 "local_rid", "hops", "force_local")
+
+    def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
+                 do_sample, temperature, top_k, top_p, seed, deadline_s,
+                 tenant, priority):
+        import numpy as np
+        self.rid = rid
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.do_sample = bool(do_sample)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = seed
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self.tenant = str(tenant) if tenant else "-"
+        self.priority = priority
+        self.trace_id = _new_trace_id("req-")
+        self.t_arrival = time.perf_counter()
+        self.t_deadline = (None if self.deadline_s is None
+                           else self.t_arrival + self.deadline_s)
+        self.t_first = None
+        self.generated = []
+        self.done = False
+        self.finish_reason = None
+        self.phase = "queued"       # queued -> placed -> handoff -> done
+        self.replica = None
+        self.local_rid = None
+        self.hops = 0               # times routed (1 = no failover)
+        self.force_local = False    # re-prefill fallback: serve fully
+                                    # on a decode replica, no handoff
+
+
+class _AdmissionView:
+    """The mesh-wide facade SLOScheduler.pick_index walks: the router's
+    front queue plus every alive replica's lanes and parked requests,
+    so tenant lane quotas count cluster-wide occupancy."""
+
+    __slots__ = ("queue", "lanes", "_preempted")
+
+    def __init__(self, router):
+        self.queue = router.queue
+        self.lanes = []
+        self._preempted = {}
+        for rep in router.pool.alive():
+            self.lanes.extend(rep.engine.lanes)
+            self._preempted.update(rep.engine._preempted)
+
+
+class MeshRouter:
+    """router = MeshRouter(ReplicaPool(build_engine, n=2))
+    rid = router.add_request(prompt, max_new_tokens=16)
+    streams = router.run()          # {mesh rid: [tokens]}
+    """
+
+    def __init__(self, pool, scheduler=None, max_queue=None,
+                 handoff_retry=None):
+        self.pool = pool
+        self.scheduler = scheduler  # admission ORDER only (DRR pick);
+                                    # per-replica brownout stays on the
+                                    # replicas' own schedulers
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.queue: deque[MeshRequest] = deque()
+        self.finished: dict[int, object] = {}   # mesh rid -> Request-like
+        self._next_rid = 0
+        self._open: dict[int, MeshRequest] = {}
+        self._by_trace: dict[str, MeshRequest] = {}
+        # (replica name, local rid) -> MeshRequest: the commit map the
+        # harvest walks; first finish wins (at-most-once commit)
+        self._local: dict[tuple[str, int], MeshRequest] = {}
+        self._handoff_q: deque[dict] = deque()
+        self._retry = handoff_retry if handoff_retry is not None else \
+            RetryPolicy(max_attempts=3, base_delay=0.001, max_delay=0.01,
+                        seed=0, sleep=lambda _s: None)
+        self._handoffs = {"ok": 0, "retried": 0, "re_prefill": 0,
+                          "bytes": 0}
+        self._failovers: dict[str, int] = {}
+        self._arrivals: deque[float] = deque(maxlen=256)
+        self._t0 = time.perf_counter()
+        self.sim_parallel_wall_s = 0.0
+        self.serial_wall_s = 0.0
+        self.rounds = 0
+        self._rec = _get_recorder()
+        self._tracer = _get_tracer()
+        # bind export sinks on the prefill workers (disaggregated pools
+        # only; "both"-role replicas serve locally end to end)
+        if pool.disaggregate:
+            for rep in pool:
+                if rep.role == "prefill":
+                    rep.engine.prefill_sink = self._sink
+        self.embed_w = pool[0].engine.embed_w
+
+    # --- harness-facing engine surface -----------------------------------
+    def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
+                    do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+                    seed=0, deadline_s=None, tenant="-",
+                    priority="interactive"):
+        """Queue a request at the mesh front door. Same contract as the
+        engine's add_request (priority registry, BackpressureError at
+        max_queue); returns the MESH rid."""
+        if priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"unknown priority class {priority!r}; registered: "
+                f"{list(PRIORITY_CLASSES)}")
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            _metric("serving_backpressure_total").inc()
+            raise BackpressureError(
+                f"mesh front queue full ({len(self.queue)}/"
+                f"{self.max_queue}); retry later")
+        rid = self._next_rid
+        self._next_rid += 1
+        mreq = MeshRequest(rid, prompt, max_new_tokens, eos_token_id,
+                           do_sample, temperature, top_k, top_p, seed,
+                           deadline_s, tenant, priority)
+        self.queue.append(mreq)
+        self._open[rid] = mreq
+        self._by_trace[mreq.trace_id] = mreq
+        self._arrivals.append(mreq.t_arrival)
+        return rid
+
+    def has_work(self):
+        return bool(self.queue or self._handoff_q
+                    or any(not m.done for m in self._open.values()))
+
+    def step(self):
+        """One mesh pump: membership beat + kill checks, failover of
+        dead replicas' streams, routing, one step per alive replica
+        (per-round max wall feeds the simulated-parallel clock),
+        handoff delivery, and the commit harvest."""
+        self.pool.beat()
+        # behavioral kill site: the chaos drill arms mesh.replica_down
+        # and the Nth pump loses a worker, exactly like a process kill
+        if check("mesh.replica_down") and len(self.pool.alive()) > 1:
+            self.kill_replica(self.pool.alive()[0].name, why="injected")
+        self._expire_queued()
+        self._failover_dead()
+        self._route()
+        dts = [rep.step() for rep in self.pool.alive()]
+        busy = [dt for dt in dts if dt > 0.0]
+        if busy:
+            self.sim_parallel_wall_s += max(busy)
+            self.serial_wall_s += sum(busy)
+            self.rounds += 1
+        self._pump_handoffs()
+        self._harvest()
+
+    def run(self, max_steps=10_000):
+        """Drive to completion; {mesh rid: [tokens]}."""
+        steps = 0
+        while self.has_work() and steps < max_steps:
+            self.step()
+            steps += 1
+        return {rid: list(r.generated)
+                for rid, r in sorted(self.finished.items())}
+
+    def predicted_service_seconds(self, output_tokens=32):
+        """Mesh-level capacity: mean per-replica calibrated service
+        seconds divided by the number of alive replicas that could take
+        the work — N workers serve N requests in one replica's time.
+        None until at least one replica's cost model calibrates."""
+        reps = self.pool.alive()
+        ts = [t for t in (rep.engine.predicted_service_seconds(
+            output_tokens=output_tokens) for rep in reps)
+            if t is not None]
+        if not ts:
+            return None
+        return (sum(ts) / len(ts)) / max(1, len(reps))
+
+    def predicted_costs(self):
+        """Per-replica program costs, replica-prefixed."""
+        out = {}
+        for rep in self.pool.alive():
+            for key, cost in rep.engine.predicted_costs().items():
+                out[f"{rep.name}:{key}"] = cost
+        return out
+
+    # --- routing ---------------------------------------------------------
+    def _offered_rate(self):
+        now = time.perf_counter()
+        win = 0.5
+        recent = sum(1 for t in self._arrivals if t > now - win)
+        return recent / win
+
+    def _ranked(self, reps):
+        """Candidates best-first: lightest observed backlog (queued +
+        occupied + parked — immune to cost-model noise, guarantees
+        balance across identical replicas), then predicted time-to-
+        drain (calibrated service seconds x backlog; uncalibrated
+        replicas priced at the calibrated mean, 1s cold, so new workers
+        still draw traffic and calibrate), then name. The slo_headroom
+        gauge (1 - offered rate x svc) is exported per pick."""
+        rate = self._offered_rate() / max(1, len(reps))
+        svcs = {rep: rep.engine.predicted_service_seconds()
+                for rep in reps}
+        known = [s for s in svcs.values() if s is not None]
+        fallback = sum(known) / len(known) if known else 1.0
+        scored = []
+        for rep in reps:
+            svc = svcs[rep]
+            if svc is not None:
+                _metric("mesh_replica_headroom",
+                        replica=rep.name).set(1.0 - rate * svc)
+            drain = (svc if svc is not None else fallback) \
+                * (rep.load() + 1)
+            scored.append((rep, drain))
+        return [rep for rep, _d in sorted(
+            scored, key=lambda t: (t[0].load(), t[1], t[0].name))]
+
+    def _failover(self, reason, mreq=None):
+        self._failovers[reason] = self._failovers.get(reason, 0) + 1
+        _metric("mesh_failovers_total", reason=reason).inc()
+        if self._rec.enabled:
+            self._rec.record("mesh", action="failover", reason=reason,
+                             trace=None if mreq is None else mreq.trace_id)
+
+    def _place(self, mreq):
+        """Try to place one mesh request on a replica; True on success.
+        Targets the prefill pool for disaggregated requests, the decode
+        pool for re-prefill fallbacks, everything alive otherwise."""
+        if self.pool.disaggregate and not mreq.force_local:
+            cands = self.pool.prefill_targets() or self.pool.decode_targets()
+        elif mreq.force_local:
+            # re-prefill fallback: a decode replica serves the stream
+            # end to end (role is routing policy; every worker can)
+            cands = self.pool.decode_targets() or self.pool.alive()
+        else:
+            cands = self.pool.alive()
+        for rep in self._ranked(cands):
+            if not rep.breaker.allow():
+                self._failover("circuit_open", mreq)
+                continue
+            try:
+                fault_point("mesh.route", rid=mreq.rid, replica=rep.name)
+            except _TRANSIENT:
+                rep.breaker.record_failure()
+                self._failover("route_fault", mreq)
+                continue
+            try:
+                local_rid = rep.engine.add_request(
+                    mreq.prompt, max_new_tokens=mreq.max_new_tokens,
+                    eos_token_id=mreq.eos_token_id,
+                    do_sample=mreq.do_sample,
+                    temperature=mreq.temperature, top_k=mreq.top_k,
+                    top_p=mreq.top_p, seed=mreq.seed,
+                    deadline_s=mreq.deadline_s, tenant=mreq.tenant,
+                    priority=mreq.priority)
+            except BackpressureError:
+                self._failover("admit_failed", mreq)
+                continue
+            rep.breaker.record_success()
+            # the replica-local Request adopts the mesh identity so
+            # spans, exemplars, and the handoff all join one trace, and
+            # TTFT/deadlines stay anchored at TRUE arrival
+            req = rep.engine.queue[-1]
+            req.trace_id = mreq.trace_id
+            req.t_arrival = mreq.t_arrival
+            if req.deadline_s is not None:
+                req.t_deadline = req.t_arrival + req.deadline_s
+            mreq.phase = "placed"
+            mreq.replica = rep.name
+            mreq.local_rid = local_rid
+            mreq.hops += 1
+            rep.routed += 1
+            self._local[(rep.name, local_rid)] = mreq
+            _metric("mesh_routed_total", replica=rep.name).inc()
+            if self._rec.enabled:
+                self._rec.record("mesh", action="route", rid=mreq.rid,
+                                 replica=rep.name, hop=mreq.hops,
+                                 trace=mreq.trace_id)
+            if self._tracer.enabled:
+                self._tracer.add_span(
+                    "mesh.route", time.perf_counter_ns(), 0,
+                    trace_id=mreq.trace_id,
+                    args={"replica": rep.name, "hop": mreq.hops})
+            return True
+        return False
+
+    def _route(self):
+        """Move front-queue requests onto replicas. With a scheduler,
+        admission order is its DRR/priority pick over the mesh-wide
+        view; a pick that cannot place anywhere stops routing for this
+        pump (ordering is preserved, retried next pump)."""
+        while self.queue:
+            if self.scheduler is not None:
+                idx = self.scheduler.pick_index(_AdmissionView(self))
+                if idx is None:
+                    return
+            else:
+                idx = 0
+            mreq = self.queue[idx]
+            if not self._place(mreq):
+                return
+            del self.queue[idx]
+
+    def _expire_queued(self):
+        """Router-side deadline expiry for requests still in the front
+        queue (all replicas saturated / breakers open): same degraded
+        'timeout' completion the engine gives its own queue."""
+        now = time.perf_counter()
+        if not any(m.t_deadline is not None and now >= m.t_deadline
+                   for m in self.queue):
+            return
+        kept = deque()
+        for mreq in self.queue:
+            if mreq.t_deadline is not None and now >= mreq.t_deadline:
+                self._commit(mreq, mreq, "timeout")
+            else:
+                kept.append(mreq)
+        self.queue = kept
+
+    # --- disaggregated handoff -------------------------------------------
+    def _sink(self, record):
+        """prefill_sink bound on prefill workers: the exported record
+        queues for delivery on the next pump — i.e. while the decode
+        replica's in-flight tiles drain, not blocking either engine."""
+        self._handoff_q.append(record)
+
+    def _pump_handoffs(self):
+        for _ in range(len(self._handoff_q)):
+            record = self._handoff_q.popleft()
+            self._deliver(record)
+
+    def _deliver(self, record):
+        mreq = self._by_trace.get(record["trace_id"])
+        if mreq is None or mreq.done:
+            return
+        rejected = False
+        for rep in self._ranked(self.pool.decode_targets()):
+            if not rep.breaker.allow():
+                self._failover("circuit_open", mreq)
+                continue
+            try:
+                local_rid, nbytes, retries = hand_off(
+                    record, rep.engine, retry=self._retry)
+            except KVHandoffError as e:
+                if isinstance(e.__cause__, (ValueError, MemoryError)):
+                    # THIS target rejected the record (format mismatch /
+                    # pool full) — the transfer itself is fine, try the
+                    # next-best decode worker
+                    rejected = True
+                    continue
+                rep.breaker.record_failure()
+                break       # transfer failed past the retry budget
+            rep.breaker.record_success()
+            self._handoffs["ok"] += 1
+            self._handoffs["bytes"] += nbytes
+            if retries:
+                self._handoffs["retried"] += 1
+                _metric("mesh_handoffs_total", outcome="retried").inc()
+            _metric("mesh_handoffs_total", outcome="ok").inc()
+            _metric("mesh_handoff_bytes").observe(nbytes)
+            mreq.phase = "handoff"
+            mreq.replica = rep.name
+            mreq.local_rid = local_rid
+            rep.routed += 1
+            self._local[(rep.name, local_rid)] = mreq
+            if self._rec.enabled:
+                self._rec.record("mesh", action="handoff",
+                                 replica=rep.name, bytes=nbytes,
+                                 retries=retries, trace=mreq.trace_id)
+            if self._tracer.enabled:
+                self._tracer.add_span(
+                    "mesh.handoff", time.perf_counter_ns(), 0,
+                    trace_id=mreq.trace_id,
+                    args={"replica": rep.name, "bytes": nbytes})
+            return
+        # retry-then-re-prefill: the serialized blocks never arrived (or
+        # no decode worker could hold them) — re-run prefill from the
+        # prompt on the decode side. Slower, byte-identical.
+        self._handoffs["re_prefill"] += 1
+        _metric("mesh_handoffs_total", outcome="re_prefill").inc()
+        self._requeue(mreq, front=True, force_local=True)
+        if self._rec.enabled:
+            self._rec.record("mesh", action="re_prefill",
+                             rejected=rejected, trace=mreq.trace_id)
+
+    # --- failover --------------------------------------------------------
+    def kill_replica(self, name, why="drill"):
+        """Lose a worker: tombstone its lease (pool.kill) and re-route
+        every uncommitted stream it held — each re-prefills from its
+        prompt on a survivor and regenerates the same tokens."""
+        rep = self.pool.by_name(name)
+        if not rep.alive:
+            return
+        self.pool.kill(name)
+        if self._rec.enabled:
+            self._rec.record("mesh", action="kill", replica=name, why=why)
+        self._failover_dead()
+
+    def _failover_dead(self):
+        """Re-route uncommitted streams assigned to dead replicas, and
+        drop exported-but-undelivered handoff records that originated
+        on one (they lived in the dead process's memory)."""
+        dead = {rep.name for rep in self.pool if not rep.alive}
+        if not dead:
+            return
+        moved = set()
+        for (rname, _lrid), mreq in list(self._local.items()):
+            if (rname in dead and not mreq.done
+                    and mreq.replica == rname
+                    and mreq.rid not in moved):
+                moved.add(mreq.rid)
+                self._failover("replica_down", mreq)
+                self._requeue(mreq, front=True,
+                              force_local=not self.pool.disaggregate
+                              or not self.pool.prefill_targets())
+        if self._handoff_q:
+            survivors = deque()
+            for record in self._handoff_q:
+                mreq = self._by_trace.get(record["trace_id"])
+                if mreq is not None and not mreq.done \
+                        and mreq.rid not in moved:
+                    survivors.append(record)
+            self._handoff_q = survivors
+
+    def _requeue(self, mreq, front=False, force_local=False):
+        mreq.phase = "queued"
+        mreq.replica = None
+        mreq.local_rid = None
+        mreq.force_local = force_local or mreq.force_local
+        if front:
+            self.queue.appendleft(mreq)
+        else:
+            self.queue.append(mreq)
+
+    # --- commit (at most once per stream) --------------------------------
+    def _commit(self, mreq, rec, reason=None):
+        if mreq.done:
+            return
+        mreq.done = True
+        mreq.phase = "done"
+        if rec is mreq:
+            mreq.finish_reason = reason
+        self.finished[mreq.rid] = rec
+        self._open.pop(mreq.rid, None)
+        self._by_trace.pop(mreq.trace_id, None)
+
+    def _harvest(self):
+        """Pull finished requests off alive replicas into the mesh
+        result. A stream commits exactly once: the commit map's first
+        finish wins, later duplicates (a re-routed stream whose original
+        replica was thought dead) are dropped unread."""
+        for rep in self.pool.alive():
+            eng = rep.engine
+            if not eng.finished:
+                continue
+            for local_rid in list(eng.finished):
+                mreq = self._local.get((rep.name, local_rid))
+                if mreq is None:
+                    continue
+                req = eng.finished.pop(local_rid)
+                rep.finished_count += 1
+                rep.tokens_out += len(req.generated)
+                self._commit(mreq, req)
+
+    # --- telemetry aggregation -------------------------------------------
+    def mesh_report(self):
+        """One mesh-level report: per-replica phase/SLO snapshots plus
+        routing, handoff, failover, and simulated-parallel wall
+        accounting. `sim_parallel_wall_s` is the concurrent-worker
+        clock (per-round max of the in-process replica step walls) —
+        simulated, and labeled as such wherever bench reports it."""
+        committed_tokens = sum(len(r.generated)
+                               for r in self.finished.values())
+        sim = self.sim_parallel_wall_s
+        return {
+            "replicas": {rep.name: rep.snapshot() for rep in self.pool},
+            "membership": self.pool.alive_nodes(),
+            "disaggregate": self.pool.disaggregate,
+            "routed": sum(rep.routed for rep in self.pool),
+            "handoffs": dict(self._handoffs),
+            "failovers": dict(self._failovers),
+            "open": sum(1 for m in self._open.values() if not m.done),
+            "committed_tokens": committed_tokens,
+            "rounds": self.rounds,
+            "serial_wall_s": round(self.serial_wall_s, 4),
+            "sim_parallel_wall_s": round(sim, 4),
+            "sim_parallel": True,
+            "sim_tok_per_s": (round(committed_tokens / sim, 1)
+                              if sim > 0 else None),
+        }

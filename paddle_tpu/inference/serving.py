@@ -467,6 +467,13 @@ class ContinuousBatchingEngine:
         # parked by preemption. Pool blocks stay allocated — resuming is
         # a lane-state re-upload, not a re-prefill.
         self._preempted: dict[int, tuple[Request, int, int]] = {}
+        # round 16 (mesh disaggregation): a prefill-pool worker sets
+        # this to a callable; the final prefill chunk then serializes
+        # the request's paged-KV state through export_kv and hands the
+        # record to the sink INSTEAD of activating a local decode lane.
+        # None (default) = the single-process engine, byte-identical to
+        # every earlier round.
+        self.prefill_sink = None
         # arrival timestamps (trailing window) — the scheduler's offered-
         # rate estimate, independent of any load harness
         self._arrivals: deque[float] = deque(maxlen=256)
@@ -726,6 +733,116 @@ class ContinuousBatchingEngine:
                 self._rec.record("sched", action="resume", rid=rid,
                                  lane=lane, tokens=len(req.generated))
 
+    # --- disaggregated paged-KV handoff (round 16) -----------------------
+    def export_kv(self, req, first_tok):
+        """Handoff record for a just-prefilled request: the prompt's
+        paged-KV blocks in the pool's RAW storage representation
+        (payload + scales when quantized) plus everything the decode
+        side needs to continue the stream byte-identically. Copying
+        stored bytes — not dequantized values — makes the round trip
+        exact for native and quantized block formats alike; the device
+        PRNG keys on (sample_seed, absolute position), so sampled
+        streams survive the hop too."""
+        s = int(req.prompt.size)
+        nb = self.pool.blocks_needed(s)
+        ids = jnp.asarray(self.pool.tables[req.rid][:nb], jnp.int32)
+        rec = {
+            "version": 1,
+            "fmt": self.pool.fmt.name,
+            "prompt": np.asarray(req.prompt, np.int32),
+            "first_token": int(first_tok),
+            "max_new_tokens": int(req.max_new_tokens),
+            "eos_token_id": req.eos_token_id,
+            "do_sample": bool(req.do_sample),
+            "temperature": float(req.temperature),
+            "top_k": int(req.top_k),
+            "top_p": float(req.top_p),
+            "sample_seed": int(req.sample_seed),
+            "tenant": req.tenant,
+            "priority": req.priority,
+            "trace_id": req.trace_id,
+            "t_arrival": float(req.t_arrival),
+            "t_first": None if req.t_first is None else float(req.t_first),
+            "deadline_s": req.deadline_s,
+            "k": np.asarray(self.pool.k[:, ids]),
+            "v": np.asarray(self.pool.v[:, ids]),
+        }
+        if self.pool.fmt.quantized:
+            rec["k_scale"] = np.asarray(self.pool.k_scale[:, ids])
+            rec["v_scale"] = np.asarray(self.pool.v_scale[:, ids])
+        return rec
+
+    def import_kv(self, record):
+        """Install a handed-off prefill on THIS engine: reserve the full
+        sequence footprint, write the stored block payload verbatim, and
+        park the request through the preemption path — resuming is the
+        same lane-state re-upload as a preempt/resume, so the stream
+        continues exactly where the prefill worker left it (no
+        re-prefill, no host recompute). Returns the local rid. Raises
+        ValueError on a block-format mismatch and KVPoolExhaustedError
+        (via pool.ensure) when the blocks do not fit — callers treat
+        both as a failed handoff and fall back to re-prefill."""
+        if record["fmt"] != self.pool.fmt.name:
+            raise ValueError(
+                f"handoff block format {record['fmt']!r} != pool format "
+                f"{self.pool.fmt.name!r}; mesh replicas must share "
+                "kv_cache_dtype")
+        prompt = np.asarray(record["prompt"], np.int32).reshape(-1)
+        s = int(prompt.size)
+        total = s + int(record["max_new_tokens"])
+        if total > self.max_blocks_per_seq * self.pool.block_size:
+            raise ValueError("handoff exceeds the per-sequence block "
+                             "budget of the receiving engine")
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(rid, prompt, record["max_new_tokens"],
+                      record["eos_token_id"], record["do_sample"],
+                      record["temperature"], record["top_k"],
+                      record["top_p"], seed=None,
+                      tenant=record["tenant"],
+                      priority=record["priority"])
+        # stream identity crosses the hop unchanged: trace id (span
+        # joins), PRNG lane key (sampled decode continuity), arrival +
+        # deadline anchors (TTFT/e2e stay measured from true arrival)
+        req.trace_id = record["trace_id"]
+        req.sample_seed = np.uint32(record["sample_seed"] & 0xFFFFFFFF)
+        req.t_arrival = record["t_arrival"]
+        req.t_first = record.get("t_first")
+        if record.get("deadline_s") is not None:
+            req.deadline_s = float(record["deadline_s"])
+            req.t_deadline = req.t_arrival + req.deadline_s
+        first_tok = int(record["first_token"])
+        req.generated = [first_tok]
+        self._m_admitted.inc()
+        self._m_tokens.inc()        # the handed-off first token
+        if (req.eos_token_id is not None and first_tok == req.eos_token_id) \
+                or req.max_new_tokens <= 1:
+            # the prefill worker's first token already ended the stream:
+            # nothing to decode, no blocks needed
+            reason = ("eos" if req.eos_token_id is not None
+                      and first_tok == req.eos_token_id else "length")
+            self._m_retired.inc()
+            self._finish(req, reason)
+            return rid
+        self.pool.ensure(rid, total)
+        nb = self.pool.blocks_needed(s)
+        ids = jnp.asarray(self.pool.tables[rid][:nb], jnp.int32)
+        self.pool.k = self.pool.k.at[:, ids].set(
+            jnp.asarray(record["k"], self.pool.k.dtype))
+        self.pool.v = self.pool.v.at[:, ids].set(
+            jnp.asarray(record["v"], self.pool.v.dtype))
+        if self.pool.fmt.quantized:
+            self.pool.k_scale = self.pool.k_scale.at[:, ids].set(
+                jnp.asarray(record["k_scale"], self.pool.k_scale.dtype))
+            self.pool.v_scale = self.pool.v_scale.at[:, ids].set(
+                jnp.asarray(record["v_scale"], self.pool.v_scale.dtype))
+        # park exactly like a preempted lane: (req, cached length, next
+        # token). _resume_preempted + the next lane-state upload then
+        # continue decode with no further handoff-specific machinery.
+        self._preempted[rid] = (req, s, first_tok)
+        self._dirty = True
+        return rid
+
     # --- admission / chunked prefill -------------------------------------
     def _admit(self):
         """Reserve lanes + pool blocks for queued requests; the prompts
@@ -944,10 +1061,6 @@ class ContinuousBatchingEngine:
         first_tok = req.choose(np.asarray(logits).reshape(-1))
         lane = task.lane
         self._prefill_tasks.pop(lane, None)
-        self.lane_len[lane] = s
-        self.lane_tok[lane] = first_tok
-        self._dirty = True
-        self._m_admitted.inc()
         # the exemplar ties this observation's bucket to the exact trace
         # that produced it (bad p99 -> exact request)
         ttft = time.perf_counter() - req.t_arrival
@@ -957,6 +1070,25 @@ class ContinuousBatchingEngine:
                 tenant=req.tenant).observe(ttft)
         if self.scheduler is not None:
             self.scheduler.note_ttft(ttft)
+        if self.prefill_sink is not None:
+            # disaggregated prefill worker: serialize the prompt's KV
+            # state and hand the stream to the decode pool. The lane +
+            # blocks free immediately; admitted/token accounting happens
+            # exactly once mesh-wide, on the decode engine's import.
+            record = self.export_kv(req, first_tok)
+            if self._phases.enabled:   # export = device->host KV readback
+                self._phases.mark("hostsync", tenant=req.tenant)
+            self.pool.release(req.rid)
+            self.lanes[lane] = None
+            self.lane_len[lane] = 0
+            self._lane_epoch[lane] += 1
+            self._dirty = True
+            self.prefill_sink(record)
+            return True
+        self.lane_len[lane] = s
+        self.lane_tok[lane] = first_tok
+        self._dirty = True
+        self._m_admitted.inc()
         self._emit(lane, first_tok)
         return True
 

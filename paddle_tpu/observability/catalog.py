@@ -29,6 +29,9 @@ _PHASE_BUCKETS = (0.00005, 0.0002, 0.001, 0.005, 0.025, 0.1, 0.5, 2.5,
                   10.0, 60.0)
 # measured/predicted cost ratios, log-ish around the ideal 1.0
 _COST_RATIO_BUCKETS = (0.1, 0.2, 0.5, 0.8, 1.0, 1.25, 2.0, 5.0, 10.0)
+# wire bytes of one paged-KV handoff: tiny CPU-proxy prompts land in the
+# low KB buckets, production-shape blocks in the MB range
+_HANDOFF_BUCKETS = (1e3, 4e3, 16e3, 64e3, 256e3, 1e6, 4e6, 16e6, 64e6)
 
 CATALOG = {
     # -- serving (inference/serving.py ContinuousBatchingEngine) ------------
@@ -334,6 +337,30 @@ CATALOG = {
         "serve.loadgen_tick fault (arrivals from the skipped tick are "
         "re-issued on the next one — open-loop schedule preserved)",
         (), None),
+
+    # -- serving mesh (inference/mesh/: router, disaggregated handoff) -------
+    "mesh_routed_total": (
+        "counter", "requests the mesh router committed to the named "
+        "replica (after the mesh.route fault site and the replica's "
+        "CircuitBreaker both let the pick through)", ("replica",), None),
+    "mesh_handoffs_total": (
+        "counter", "serialized paged-KV prefill->decode handoffs, by "
+        "outcome (ok / retried / re_prefill — re_prefill means the "
+        "wire transfer was abandoned and the decode side re-ran "
+        "prefill from the prompt)", ("outcome",), None),
+    "mesh_failovers_total": (
+        "counter", "requests re-routed off a replica, by reason "
+        "(replica_down / circuit_open / route_fault / admit_failed)",
+        ("reason",), None),
+    "mesh_handoff_bytes": (
+        "histogram", "serialized wire size of one paged-KV handoff "
+        "(payload + scales + prompt metadata; quantized block formats "
+        "shrink this ~2-4x at identical streams)", (), _HANDOFF_BUCKETS),
+    "mesh_replica_headroom": (
+        "gauge", "per-replica slo_headroom snapshot the router balanced "
+        "on at its last pick (1 - offered_load * predicted service "
+        "seconds; <=0 = saturated, routed around when possible)",
+        ("replica",), None),
 
     # -- bench orchestration (bench.py parent; stage = probe/configN/...) ----
     "bench_attempts_total": (

@@ -62,6 +62,9 @@ EVENT_KINDS = {
     "note": "free-form marker (drills, tests)",
     "profile": "profiler/loadgen summary (phase coverage, scenario, "
                "goodput) recorded at the end of a harness run",
+    "mesh": "serving-mesh action (route pick, paged-KV handoff, "
+            "replica failover/tombstone) with the request trace id so "
+            "cross-replica timelines join",
 }
 
 

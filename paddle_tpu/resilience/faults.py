@@ -104,6 +104,22 @@ FAULT_SITES = {
                           "degrades to plain UNSHARDED jax.jit with "
                           "identical numerics, counted "
                           "pir_fallback_total{stage=passes}",
+    "mesh.route": "mesh router: one replica pick for a queued request "
+                  "(failure counts a failover and the request is "
+                  "re-routed to the next-best replica; CircuitBreaker "
+                  "per replica keeps a flapping target out of the "
+                  "rotation)",
+    "mesh.kv_handoff": "mesh disaggregation: serialized paged-KV block "
+                       "transfer from a prefill worker to a decode "
+                       "worker (retry-then-re-prefill: transient "
+                       "failure retries the transfer, exhaustion "
+                       "re-prefills the request on the decode side — "
+                       "streams stay byte-identical either way)",
+    "mesh.replica_down": "mesh membership: a replica is killed "
+                         "(consulted via check(); the router tombstones "
+                         "it, opens its breaker, and re-routes + "
+                         "re-prefills its in-flight requests on the "
+                         "survivors)",
 }
 
 

@@ -1,0 +1,296 @@
+"""Disaggregated serving mesh (inference/mesh/) — round 16.
+
+Contract under test: N in-process replicas behind the MeshRouter serve
+greedy streams BYTE-IDENTICAL to a single engine, across data-parallel
+and prefill/decode-disaggregated topologies, through handoff faults
+(retry-then-re-prefill) and replica kills (failover re-prefill). The
+paged-KV handoff wire format round-trips the stored block bytes exactly
+for native and quantized pool formats alike.
+
+Each pool gets its own in-process store port (the _PyStore fallback is
+keyed by (host, port), so a reused port would alias memberships across
+tests); the 465xx range here is disjoint from chaos_drill (4618x/46282)
+and bench (4710x).
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.generation import generate
+from paddle_tpu.inference import ContinuousBatchingEngine
+from paddle_tpu.inference.mesh import MeshRouter, ReplicaPool
+from paddle_tpu.inference.mesh.handoff import (
+    KVHandoffError, pack_record, unpack_record, wire_size, hand_off)
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.resilience import faults
+
+_PORTS = itertools.count(46500)
+
+
+def _model():
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=256)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+def _factory(**kw):
+    """Zero-arg engine builder: reseeds per build so every replica holds
+    identical weights (the disaggregation precondition)."""
+    def build():
+        eng_kw = dict(num_blocks=64, block_size=8, max_batch=2,
+                      prefill_buckets=(16,))
+        eng_kw.update(kw)
+        return ContinuousBatchingEngine(_model(), **eng_kw)
+    return build
+
+
+def _dense_reference(model, prompt, n):
+    ids = paddle.to_tensor(np.asarray(prompt, np.int32)[None])
+    out = generate(model, ids, max_new_tokens=n, do_sample=False)
+    arr = np.asarray(out._data if hasattr(out, "_data") else out)
+    return arr[0, len(prompt):].tolist()
+
+
+def _prompts(n, rs=None):
+    rs = rs or np.random.RandomState(3)
+    return [rs.randint(0, 128, (int(s),))
+            for s in rs.randint(5, 14, size=n)]
+
+
+def _capture_record(kv_cache_dtype="bf16"):
+    """Prefill one request on a sink-bound engine and return the
+    export_kv record it hands off."""
+    eng = _factory(kv_cache_dtype=kv_cache_dtype)()
+    records = []
+    eng.prefill_sink = records.append
+    eng.add_request(_prompts(1)[0], max_new_tokens=6)
+    for _ in range(50):
+        if records:
+            break
+        eng.step()
+    assert records, "prefill sink never fired"
+    return records[0]
+
+
+class TestHandoffWire:
+    @pytest.mark.parametrize("fmt", ["bf16", "int8", "fp8_e4m3"])
+    def test_round_trip_byte_exact(self, fmt):
+        rec = _capture_record(kv_cache_dtype=fmt)
+        wire = pack_record(rec)
+        back = unpack_record(wire)
+        # the stored payload (and scales, when quantized) survives the
+        # wire byte-for-byte — repacking reproduces the identical buffer
+        assert pack_record(back) == wire
+        assert wire_size(rec) == len(wire)
+        for key, val in rec.items():
+            if isinstance(val, np.ndarray):
+                assert back[key].tobytes() == \
+                    np.ascontiguousarray(val).tobytes(), key
+            else:
+                assert back[key] == val or (val is None
+                                            and back[key] is None), key
+        if fmt != "bf16":
+            assert "k_scale" in back and "v_scale" in back
+
+    def test_unknown_wire_version_rejected(self):
+        # pack_record stamps the version itself, so tamper the wire:
+        # rewrite the header with a future version the decoder must
+        # refuse rather than misinterpret
+        import json
+        import struct
+        wire = pack_record(_capture_record())
+        (hlen,) = struct.unpack_from("<I", wire, 0)
+        head = json.loads(wire[4:4 + hlen])
+        head["meta"]["wire_version"] = 99
+        new_head = json.dumps(head, sort_keys=True).encode()
+        tampered = struct.pack("<I", len(new_head)) + new_head \
+            + wire[4 + hlen:]
+        with pytest.raises(KVHandoffError, match="wire version"):
+            unpack_record(tampered)
+
+    def test_format_mismatch_is_handoff_error(self):
+        # a bf16 record cannot install into an int8 pool: the receiving
+        # engine's ValueError surfaces as KVHandoffError (the router's
+        # cue to try the next decode worker / re-prefill)
+        rec = _capture_record(kv_cache_dtype="bf16")
+        other = _factory(kv_cache_dtype="int8")()
+        with pytest.raises(KVHandoffError, match="rejected"):
+            hand_off(rec, other)
+
+
+class TestMeshParity:
+    def test_dp_streams_byte_identical(self):
+        prompts = _prompts(4)
+        single = _factory()()
+        refs = {}
+        for p in prompts:
+            refs[single.add_request(p, max_new_tokens=8)] = p
+        want = single.run()
+
+        pool = ReplicaPool(_factory(), n=2, store_port=next(_PORTS))
+        router = MeshRouter(pool)
+        for p in prompts:
+            router.add_request(p, max_new_tokens=8)
+        got = router.run()
+        assert got == want
+        # both replicas actually took traffic (the balance contract)
+        assert all(rep.routed >= 1 for rep in pool)
+
+    def test_disaggregated_streams_byte_identical(self):
+        prompts = _prompts(4)
+        model = _model()
+        refs = [_dense_reference(model, p, 8) for p in prompts]
+
+        pool = ReplicaPool(_factory(), n=2, disaggregate=True,
+                           store_port=next(_PORTS))
+        router = MeshRouter(pool)
+        rids = [router.add_request(p, max_new_tokens=8) for p in prompts]
+        out = router.run()
+        for rid, ref in zip(rids, refs):
+            assert out[rid] == ref, rid
+        rep = router.mesh_report()
+        assert rep["handoffs"]["ok"] == len(prompts)
+        assert rep["handoffs"]["bytes"] > 0
+        assert rep["open"] == 0
+        assert rep["sim_parallel"] is True
+
+    def test_trace_id_continuity_across_handoff(self):
+        # the mesh request's trace id must survive router -> prefill ->
+        # handoff -> decode and come back on the committed Request
+        pool = ReplicaPool(_factory(), n=2, disaggregate=True,
+                           store_port=next(_PORTS))
+        router = MeshRouter(pool)
+        rid = router.add_request(_prompts(1)[0], max_new_tokens=6)
+        tid = router._open[rid].trace_id
+        router.run()
+        assert router.finished[rid].trace_id == tid
+        assert router.mesh_report()["handoffs"]["ok"] == 1
+
+
+class TestHandoffFaults:
+    def test_transient_fault_retries_then_identical(self):
+        prompts = _prompts(3)
+        model = _model()
+        refs = [_dense_reference(model, p, 6) for p in prompts]
+        pool = ReplicaPool(_factory(), n=2, disaggregate=True,
+                           store_port=next(_PORTS))
+        router = MeshRouter(pool)
+        rids = [router.add_request(p, max_new_tokens=6) for p in prompts]
+        with faults.injected_faults("mesh.kv_handoff:1:ConnectionError"):
+            out = router.run()
+        assert router._handoffs["retried"] >= 1
+        for rid, ref in zip(rids, refs):
+            assert out[rid] == ref, rid
+
+    def test_exhausted_handoff_reprefills_identical(self):
+        # three consecutive transfer failures exhaust the retry budget:
+        # the stream re-prefills on the decode side, byte-identically
+        prompts = _prompts(3)
+        model = _model()
+        refs = [_dense_reference(model, p, 6) for p in prompts]
+        pool = ReplicaPool(_factory(), n=2, disaggregate=True,
+                           store_port=next(_PORTS))
+        router = MeshRouter(pool)
+        rids = [router.add_request(p, max_new_tokens=6) for p in prompts]
+        with faults.injected_faults(
+                "mesh.kv_handoff:1:ConnectionError;"
+                "mesh.kv_handoff:2:ConnectionError;"
+                "mesh.kv_handoff:3:ConnectionError"):
+            out = router.run()
+        assert router._handoffs["re_prefill"] >= 1
+        for rid, ref in zip(rids, refs):
+            assert out[rid] == ref, rid
+        assert router.mesh_report()["open"] == 0
+
+
+class TestFailover:
+    def test_kill_replica_streams_complete_identical(self):
+        prompts = _prompts(4)
+        model = _model()
+        refs = [_dense_reference(model, p, 8) for p in prompts]
+        pool = ReplicaPool(_factory(), n=2, store_port=next(_PORTS))
+        router = MeshRouter(pool)
+        rids = [router.add_request(p, max_new_tokens=8) for p in prompts]
+        router.step()
+        router.step()       # streams in flight on both replicas
+        router.kill_replica("replica0", why="test")
+        out = router.run()
+        assert len(pool.alive()) == 1
+        assert pool.alive_nodes() == ["replica1"]   # lease tombstoned
+        assert router._failovers.get("replica_down", 0) >= 1
+        for rid, ref in zip(rids, refs):
+            assert out[rid] == ref, rid
+        assert router.mesh_report()["open"] == 0
+
+    def test_open_breaker_routes_to_healthy_replica(self):
+        prompts = _prompts(3)
+        pool = ReplicaPool(_factory(), n=2, store_port=next(_PORTS))
+        bad = pool.by_name("replica0")
+        for _ in range(bad.breaker.failure_threshold):
+            bad.breaker.record_failure()
+        router = MeshRouter(pool)
+        rids = [router.add_request(p, max_new_tokens=6) for p in prompts]
+        out = router.run()
+        assert bad.routed == 0
+        assert pool.by_name("replica1").routed == len(prompts)
+        assert router._failovers.get("circuit_open", 0) >= 1
+        assert sorted(out) == rids
+
+    def test_front_queue_backpressure(self):
+        from paddle_tpu.inference.serving import BackpressureError
+        pool = ReplicaPool(_factory(), n=1, store_port=next(_PORTS))
+        router = MeshRouter(pool, max_queue=1)
+        router.add_request(np.arange(5) % 128, max_new_tokens=4)
+        with pytest.raises(BackpressureError):
+            router.add_request(np.arange(5) % 128, max_new_tokens=4)
+
+    def test_unknown_priority_rejected(self):
+        pool = ReplicaPool(_factory(), n=1, store_port=next(_PORTS))
+        router = MeshRouter(pool)
+        with pytest.raises(ValueError, match="priority"):
+            router.add_request(np.arange(5) % 128, priority="turbo")
+
+
+@pytest.mark.slow
+class TestMeshSweeps:
+    def test_saturation_sweep_accounting_closes(self):
+        # more streams than the mesh has lanes: everything admitted
+        # completes exactly once and the mesh report closes
+        pool = ReplicaPool(_factory(), n=3, store_port=next(_PORTS))
+        router = MeshRouter(pool)
+        prompts = _prompts(12, np.random.RandomState(11))
+        rids = [router.add_request(p, max_new_tokens=8) for p in prompts]
+        out = router.run()
+        assert sorted(out) == rids
+        rep = router.mesh_report()
+        assert rep["open"] == 0
+        assert rep["committed_tokens"] == sum(len(v) for v in out.values())
+        assert rep["serial_wall_s"] >= rep["sim_parallel_wall_s"]
+
+    @pytest.mark.parametrize("disaggregate", [False, True])
+    def test_failover_sweep_byte_identical(self, disaggregate):
+        # kill a worker mid-run in each topology; every stream still
+        # matches the dense reference
+        prompts = _prompts(6, np.random.RandomState(13))
+        model = _model()
+        refs = [_dense_reference(model, p, 8) for p in prompts]
+        n = 3
+        pool = ReplicaPool(_factory(), n=n, disaggregate=disaggregate,
+                           store_port=next(_PORTS))
+        router = MeshRouter(pool)
+        rids = [router.add_request(p, max_new_tokens=8) for p in prompts]
+        for _ in range(3):
+            router.step()
+        victim = (pool.decode_targets() if disaggregate
+                  else pool.alive())[0].name
+        router.kill_replica(victim, why="sweep")
+        out = router.run()
+        assert len(pool.alive()) == n - 1
+        for rid, ref in zip(rids, refs):
+            assert out[rid] == ref, rid
+        assert router.mesh_report()["open"] == 0

@@ -31,7 +31,8 @@ def test_list_rules_names_the_closed_registry():
     assert r.returncode == 0
     for rule in ("metrics-in-catalog", "catalog-docs-sync", "fault-sites",
                  "recorder-kinds", "flags-registered", "host-sync",
-                 "profiler-phases", "scheduler-actions", "pir-passes"):
+                 "profiler-phases", "scheduler-actions", "pir-passes",
+                 "mesh-wiring"):
         assert rule in r.stdout
 
 
@@ -100,6 +101,24 @@ def test_pir_passes_rule_catches_drift():
     # registry entry missing from the doc table: all directions fire
     assert "'undocumented'" in msgs and "'unregistered'" in msgs \
         and "'dce'" in msgs, msgs
+
+
+def test_mesh_wiring_rule_catches_unregistered_literals(tmp_path):
+    # a file masquerading as mesh code: a check() on a fault site
+    # outside FAULT_SITES and a record() kind outside EVENT_KINDS.
+    # (Not named router.py, so the reverse-containment checks — which
+    # need the real router in the scan set — stay dormant.)
+    bad = tmp_path / "paddle_tpu" / "inference" / "mesh"
+    bad.mkdir(parents=True)
+    f = bad / "bad_worker.py"
+    f.write_text("def pump(inj, rec):\n"
+                 "    inj.check('mesh.bogus_site')\n"
+                 "    rec.record('bogus_mesh_kind', x=1)\n")
+    r = _run("--paths", str(f), "--json")
+    assert r.returncode == 1, f"violation not caught:\n{r.stdout}"
+    found = [v for v in json.loads(r.stdout) if v["rule"] == "mesh-wiring"]
+    msgs = " | ".join(v["message"] for v in found)
+    assert "mesh.bogus_site" in msgs and "bogus_mesh_kind" in msgs, found
 
 
 def test_host_sync_rule_catches_new_sync(tmp_path):

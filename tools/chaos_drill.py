@@ -531,6 +531,113 @@ def drill_compile_shard_prop(tmp):
                         "numerics); next compile took the PIR path")
 
 
+def _tiny_mesh(n=2, disaggregate=False, port=46180, **kw):
+    """N-replica in-process mesh over _tiny_engine workers (identical
+    weights: the factory reseeds per build). Returns (model, pool,
+    router) — the model for _dense_ref comparisons."""
+    from paddle_tpu.inference.mesh import MeshRouter, ReplicaPool
+    holder = {}
+
+    def factory():
+        model, eng = _tiny_engine(**kw)
+        holder.setdefault("model", model)
+        return eng
+
+    pool = ReplicaPool(factory, n=n, disaggregate=disaggregate,
+                       store_port=port)
+    return holder["model"], pool, MeshRouter(pool)
+
+
+def drill_mesh_route(tmp):
+    model, pool, router = _tiny_mesh(port=46181)
+    prompts = [(np.arange(6) * (i + 2)) % 128 for i in range(4)]
+    refs = [_dense_ref(model, p, 6) for p in prompts]
+    with faults.injected_faults("mesh.route:1:TimeoutError"):
+        rids = [router.add_request(p, max_new_tokens=6) for p in prompts]
+        out = router.run()
+        inj = faults.injected_counts().get("mesh.route", 0)
+    _expect(inj == 1, "fault never reached the route site")
+    for rid, ref in zip(rids, refs):
+        _expect(out.get(rid) == ref,
+                "stream diverged after the re-routed replica pick")
+    _expect(router._failovers.get("route_fault", 0) >= 1,
+            "route fault not counted as a failover")
+    _expect(_counter("mesh_failovers_total", reason="route_fault") >= 1,
+            "mesh_failovers_total{route_fault} did not move")
+    _expect(router.mesh_report()["open"] == 0,
+            "mesh accounting left requests open")
+    return "recovered", ("route fault failed the pick over to the "
+                         "next-best replica; every stream byte-exact")
+
+
+def drill_mesh_kv_handoff(tmp):
+    # leg 1: transient — one ConnectionError mid-transfer, the handoff
+    # retry absorbs it and the decode worker imports the same bytes
+    model, pool, router = _tiny_mesh(disaggregate=True, port=46182)
+    prompts = [(np.arange(7) * (i + 3)) % 128 for i in range(3)]
+    refs = [_dense_ref(model, p, 6) for p in prompts]
+    with faults.injected_faults("mesh.kv_handoff:1:ConnectionError"):
+        rids = [router.add_request(p, max_new_tokens=6) for p in prompts]
+        out = router.run()
+        inj = faults.injected_counts().get("mesh.kv_handoff", 0)
+    _expect(inj == 1, "fault never reached the handoff site")
+    for rid, ref in zip(rids, refs):
+        _expect(out.get(rid) == ref,
+                "stream diverged after the retried handoff")
+    _expect(router._handoffs["retried"] >= 1, "handoff retry not recorded")
+    _expect(_counter("mesh_handoffs_total", outcome="retried") >= 1,
+            "mesh_handoffs_total{retried} did not move")
+    # leg 2: exhaustion — every retry attempt of the first handoff
+    # fails; the stream must re-prefill on the decode side and still
+    # come out byte-identical
+    model2, pool2, router2 = _tiny_mesh(disaggregate=True, port=46282)
+    with faults.injected_faults("mesh.kv_handoff:1:ConnectionError;"
+                                "mesh.kv_handoff:2:ConnectionError;"
+                                "mesh.kv_handoff:3:ConnectionError"):
+        rids2 = [router2.add_request(p, max_new_tokens=6) for p in prompts]
+        out2 = router2.run()
+    for rid, ref in zip(rids2, refs):
+        _expect(out2.get(rid) == ref,
+                "stream diverged after handoff exhaustion + re-prefill")
+    _expect(router2._handoffs["re_prefill"] >= 1,
+            "exhausted handoff did not fall back to re-prefill")
+    _expect(_counter("mesh_handoffs_total", outcome="re_prefill") >= 1,
+            "mesh_handoffs_total{re_prefill} did not move")
+    _expect(router.mesh_report()["open"] == 0
+            and router2.mesh_report()["open"] == 0,
+            "mesh accounting left requests open")
+    return "recovered", ("transient handoff fault retried (same bytes); "
+                         "exhaustion re-prefilled on the decode worker; "
+                         "streams byte-exact both ways")
+
+
+def drill_mesh_replica_down(tmp):
+    model, pool, router = _tiny_mesh(n=2, port=46183)
+    prompts = [(np.arange(6) * (i + 5)) % 128 for i in range(4)]
+    refs = [_dense_ref(model, p, 8) for p in prompts]
+    with faults.injected_faults("mesh.replica_down:2:FaultInjected"):
+        rids = [router.add_request(p, max_new_tokens=8) for p in prompts]
+        out = router.run()
+        inj = faults.injected_counts().get("mesh.replica_down", 0)
+    _expect(inj == 1, "fault never reached the replica-down site")
+    _expect(len(pool.alive()) == 1, "kill did not tombstone the replica")
+    _expect(pool.alive_nodes() == [pool.alive()[0].name],
+            "elastic membership disagrees with the pool after the kill")
+    for rid, ref in zip(rids, refs):
+        _expect(out.get(rid) == ref,
+                "re-routed stream diverged from the dense reference")
+    _expect(router._failovers.get("replica_down", 0) >= 1,
+            "replica_down failover not counted")
+    _expect(_counter("mesh_failovers_total", reason="replica_down") >= 1,
+            "mesh_failovers_total{replica_down} did not move")
+    rep = router.mesh_report()
+    _expect(rep["open"] == 0, "mesh accounting left requests open")
+    _expect(len(out) == len(rids), "an admitted request never completed")
+    return "degraded", ("replica killed mid-run; its streams re-routed + "
+                        "re-prefilled on the survivor, byte-identical; "
+                        "accounting closed")
+
+
 SCENARIOS = {
     "ckpt.chunk_write": drill_ckpt_chunk_write,
     "ckpt.metadata_replace": drill_ckpt_metadata_replace,
@@ -551,6 +658,9 @@ SCENARIOS = {
     "compile.cache_write": drill_compile_cache_write,
     "compile.verify": drill_compile_verify,
     "compile.shard_prop": drill_compile_shard_prop,
+    "mesh.route": drill_mesh_route,
+    "mesh.kv_handoff": drill_mesh_kv_handoff,
+    "mesh.replica_down": drill_mesh_replica_down,
 }
 
 

@@ -104,6 +104,15 @@ def main(argv=None):
     ap.add_argument("--min-acceptance", type=float, default=None,
                     help="with --check on a speculative run: fail unless "
                          "draft acceptance reaches this floor")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="run N in-process engine replicas behind the "
+                         "mesh router instead of one engine; the report "
+                         "gains a mesh block with per-replica goodput "
+                         "and headroom columns")
+    ap.add_argument("--disaggregate", action="store_true",
+                    help="with --replicas >= 2: split the pool into "
+                         "prefill and decode workers with serialized "
+                         "paged-KV handoff between them")
     ap.add_argument("--min-coverage", type=float, default=0.95)
     ap.add_argument("--out", default=None, help="write the report JSON here "
                     "(default: stdout)")
@@ -127,7 +136,17 @@ def main(argv=None):
         kw["draft_depth"] = drafting.scenario_draft_depth(args.scenario)
         if not args.flat_drafter:
             kw["drafter"] = drafting.scenario_drafter(args.scenario)
-    engine = build_engine(scheduler=True if args.scheduler else None, **kw)
+    if args.replicas > 1:
+        from paddle_tpu.inference.mesh import MeshRouter, ReplicaPool
+        from paddle_tpu.inference import SLOScheduler
+        pool = ReplicaPool(
+            lambda: build_engine(**kw), n=args.replicas,
+            disaggregate=args.disaggregate, store_port=0)
+        engine = MeshRouter(
+            pool, scheduler=SLOScheduler() if args.scheduler else None)
+    else:
+        engine = build_engine(scheduler=True if args.scheduler else None,
+                              **kw)
     report = loadgen.run_scenario(
         engine, args.scenario, seed=args.seed, rate_rps=args.rate,
         duration_s=args.duration, max_wall_s=args.max_wall,
@@ -152,6 +171,29 @@ def main(argv=None):
           f"ttft_p95={report['ttft']['p95']} slo={slo_state} "
           f"coverage={cov if cov is None else round(cov, 4)}{spec_str}",
           file=sys.stderr)
+    mesh = report.get("mesh")
+    if mesh:
+        print(f"# mesh: replicas={len(mesh['replicas'])} "
+              f"disaggregate={mesh['disaggregate']} "
+              f"handoffs={mesh['handoffs']} "
+              f"failovers={mesh['failovers'] or '{}'} "
+              f"sim_tok_per_s={mesh['sim_tok_per_s']} "
+              f"(simulated-parallel wall)", file=sys.stderr)
+        print(f"# {'replica':10s} {'role':8s} {'alive':5s} {'routed':>6s} "
+              f"{'finished':>8s} {'tok/s':>8s} {'headroom':>9s}",
+              file=sys.stderr)
+        rate = report["issued"] / max(report["wall_s"], 1e-9)
+        for name, row in sorted(mesh["replicas"].items()):
+            svc = row["predicted_service_s"]
+            n_alive = max(1, sum(r["alive"]
+                                 for r in mesh["replicas"].values()))
+            head = (None if svc is None
+                    else round(1.0 - (rate / n_alive) * svc, 3))
+            print(f"# {name:10s} {row['role']:8s} "
+                  f"{str(row['alive']):5s} {row['routed']:6d} "
+                  f"{row['finished']:8d} "
+                  f"{str(row['tok_per_s']):>8s} {str(head):>9s}",
+                  file=sys.stderr)
 
     if args.check:
         problems = loadgen.check_report(

@@ -30,6 +30,11 @@ Rules (closed registry, like everything else here):
                        serving hot path outside the audited allowlist
   pir-passes           pir/passes.py PASSES == FLAGS_pir_passes
                        default == COMPILER.md pass-catalog rows
+  mesh-wiring          serving-mesh fault_point/check site and record()
+                       kind literals ⊆ the closed registries; every
+                       registered mesh.* site armed by mesh code AND
+                       backticked in RESILIENCE.md, no phantom mesh.*
+                       docs — both directions
 
 Usage:
   python tools/static_check.py                 # whole repo, all rules
@@ -82,6 +87,11 @@ PHASE_MARK_FILES = ("paddle_tpu/profiler/", "paddle_tpu/inference/serving.py")
 SCHED_ACTION_FILES = ("paddle_tpu/inference/serving.py",
                       "paddle_tpu/inference/scheduler.py")
 
+# mesh-wiring rule scope: the serving-mesh sources whose fault-site and
+# event-kind literals are pinned to the closed registries (dir entry —
+# matched by containment, like PHASE_MARK_FILES)
+MESH_FILES = ("paddle_tpu/inference/mesh/",)
+
 # host-sync rule scope + allowlist: methods audited as intentional
 # host syncs (see STATIC_ANALYSIS.md "Host-sync allowlist policy").
 # "Cls.*" allowlists every method of the class.
@@ -94,6 +104,8 @@ HOST_SYNC_ALLOW = {
         "ContinuousBatchingEngine._prefill_one_chunk",  # first-token read
         "ContinuousBatchingEngine._drain_one",          # the one readback
         "ContinuousBatchingEngine._upload_lane_state",  # admission repack
+        "ContinuousBatchingEngine.export_kv",   # handoff wire serialization
+        "ContinuousBatchingEngine.import_kv",   # handoff block install
     ),
     "paddle_tpu/ops/paged_attention.py": (
         "BlockKVCacheManager.*",       # host-side block-table bookkeeping
@@ -605,6 +617,72 @@ def rule_pir_passes(ctx):
     return out
 
 
+def rule_mesh_wiring(ctx):
+    """The serving mesh's failure wiring is pinned both ways: every
+    fault site it arms — ``fault_point`` AND the behavioral ``check()``
+    (which the fault-sites rule does not scan) — and every flight-
+    recorder kind it emits must name a registered entry; every
+    registered ``mesh.*`` site must actually be consulted by mesh code
+    and backticked in RESILIENCE.md's mesh runbook; and RESILIENCE.md
+    may not document a phantom ``mesh.*`` site."""
+    out = []
+    used_sites, used_kinds = set(), set()
+    scanned_mesh_core = False
+    for path, tree in ctx.sources.items():
+        norm = path.replace(os.sep, "/")
+        if not any(s in norm for s in MESH_FILES):
+            continue
+        if norm.endswith("inference/mesh/router.py"):
+            scanned_mesh_core = True
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            callee = _callee(node)
+            lit = node.args[0].value
+            if callee in ("fault_point", "check"):
+                used_sites.add(lit)
+                if lit not in ctx.fault_sites:
+                    out.append(Violation(
+                        "mesh-wiring", path, node.lineno,
+                        f"{callee}({lit!r}) is not in {FAULTS_PY} "
+                        "FAULT_SITES"))
+            elif callee == "record":
+                used_kinds.add(lit)
+                if lit not in ctx.event_kinds:
+                    out.append(Violation(
+                        "mesh-wiring", path, node.lineno,
+                        f"record({lit!r}) is not in {RECORDER_PY} "
+                        "EVENT_KINDS"))
+    mesh_sites = {s for s in ctx.fault_sites if s.startswith("mesh.")}
+    if scanned_mesh_core:
+        # reverse containment only when the real mesh sources were in
+        # the scan set (a --paths run on one file must not fire these)
+        for name in sorted(mesh_sites - used_sites):
+            out.append(Violation(
+                "mesh-wiring", FAULTS_PY, 0,
+                f"mesh fault site {name!r} is registered but never "
+                "armed (fault_point/check) under "
+                "paddle_tpu/inference/mesh/"))
+        if "mesh" in ctx.event_kinds and "mesh" not in used_kinds:
+            out.append(Violation(
+                "mesh-wiring", RECORDER_PY, 0,
+                "EVENT_KINDS entry 'mesh' is never emitted by "
+                "paddle_tpu/inference/mesh/ code"))
+    res_mesh = {t for t in ctx.res_ticks if t.startswith("mesh.")}
+    for name in sorted(mesh_sites - res_mesh):
+        out.append(Violation(
+            "mesh-wiring", RES_MD, 0,
+            f"mesh fault site {name!r} is not backticked in {RES_MD}"))
+    for name in sorted(res_mesh - mesh_sites):
+        out.append(Violation(
+            "mesh-wiring", RES_MD, 0,
+            f"{RES_MD} mentions mesh site {name!r} which is not in "
+            f"{FAULTS_PY} FAULT_SITES"))
+    return out
+
+
 RULES = {
     "metrics-in-catalog": (rule_metrics_in_catalog,
                            "metric() literals are catalog entries"),
@@ -628,6 +706,9 @@ RULES = {
     "pir-passes": (rule_pir_passes,
                    "pir PASSES == FLAGS_pir_passes default == "
                    "COMPILER.md pass-catalog rows"),
+    "mesh-wiring": (rule_mesh_wiring,
+                    "mesh site/kind literals ⊆ registries; mesh.* "
+                    "sites armed + in RESILIENCE.md, both ways"),
 }
 
 

@@ -44,8 +44,10 @@ from ..observability.catalog import metric as _metric
 from ..observability.metrics import get_registry as _get_registry
 from ..observability.metrics import snapshot as _snapshot
 from ..observability.quantiles import quantiles_from_cumulative
+from ..observability.autoscale import check_verdict as _check_autoscale
 from ..observability.recorder import get_recorder as _get_recorder
 from ..observability.slo import SLOEngine
+from ..observability.timeseries import RECORDING_RULES, MetricsSampler
 from ..profiler.phases import get_phase_accountant as _get_phases
 from ..resilience.faults import fault_point
 from .scheduler import PRIORITY_CLASSES
@@ -293,13 +295,16 @@ def _counter_total(snapshot_doc, name):
 
 def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
                  max_wall_s=None, sample_every_s=0.2, slo_engine=None,
-                 drain=True):
+                 drain=True, sampler="auto"):
     """Drive `engine` with the scenario's schedule in real time; returns
     the run report (REPORT_FORMAT). Open loop: every tick issues all
     arrivals scheduled at or before now, then advances the engine one
     step. `drain` keeps stepping after the last arrival until the engine
     idles (False = stop at schedule end, for saturation sweeps where the
-    backlog would never drain)."""
+    backlog would never drain). `sampler` is the embedded TSDB hook:
+    "auto" attaches a MetricsSampler ticked on the schedule clock only
+    when the metrics registry is enabled (plane off = zero work), None
+    disables it, or pass your own MetricsSampler."""
     if isinstance(scenario, str):
         scenario = SCENARIOS[scenario]
     schedule = build_schedule(scenario, seed, rate_rps=rate_rps,
@@ -316,8 +321,12 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
     slo_eng = slo_engine if slo_engine is not None \
         else SLOEngine(window_s=max_wall + 60.0)
     snap0 = _snapshot(reg)
+    if sampler == "auto":
+        sampler = MetricsSampler() if reg.enabled else None
     t0 = time.perf_counter()
     slo_eng.observe(snap0, t0)
+    if sampler is not None:
+        sampler.sample(0.0)   # prime the rate/window state at run start
 
     m_arrivals = _metric("loadgen_arrivals_total", scenario=scenario.name)
     m_skipped = _metric("loadgen_ticks_skipped_total")
@@ -367,6 +376,10 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
             "preemptions": (None if sched is None
                             else int(sched.preempt_requests)),
         })
+        if sampler is not None:
+            # TSDB tick on the schedule clock (deterministic per run
+            # timing; a failed tick degrades the plane, never the run)
+            sampler.sample(now)
 
     while True:
         now = time.perf_counter() - t0
@@ -516,6 +529,9 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
         # so the harness only needs this one hook
         "mesh": (engine.mesh_report()
                  if hasattr(engine, "mesh_report") else None),
+        # embedded-TSDB evidence (None when the plane is off): per-rule
+        # latest value + point counts, series/sample totals, degradation
+        "timeseries": sampler.summary() if sampler is not None else None,
     }
     rec = _get_recorder()
     if rec.enabled:
@@ -526,7 +542,8 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
     return report
 
 
-def check_report(report, min_coverage=0.95, min_acceptance=None):
+def check_report(report, min_coverage=0.95, min_acceptance=None,
+                 require_timeseries=False, require_autoscale=False):
     """Acceptance gate over a run report -> list of problems (empty =
     pass). Checked: an SLO verdict exists, phase attribution covers at
     least `min_coverage` of engine wall time, the cost model priced at
@@ -535,8 +552,32 @@ def check_report(report, min_coverage=0.95, min_acceptance=None):
     and the brownout ladder returned to level 0 by end of run (a run
     that leaves the engine degraded is not a pass). `min_acceptance`
     (speculative runs only) additionally requires a speculative block
-    with draft acceptance at or above the floor."""
+    with draft acceptance at or above the floor. `require_timeseries`
+    gates the observability plane: a timeseries block must exist, not
+    be degraded, and every recording rule must have >= 1 populated
+    point. `require_autoscale` (mesh runs) requires an internally
+    consistent autoscale verdict (autoscale.check_verdict)."""
     problems = []
+    if require_timeseries:
+        ts = report.get("timeseries")
+        if not isinstance(ts, dict):
+            problems.append("no timeseries block in report (plane off?)")
+        else:
+            if ts.get("degraded"):
+                problems.append("observability plane degraded during run")
+            rules = ts.get("rules") or {}
+            empty = sorted(n for n in RECORDING_RULES
+                           if not (rules.get(n) or {}).get("points"))
+            if empty:
+                problems.append(
+                    f"recording rules with no populated series: {empty}")
+    if require_autoscale:
+        verdict = (report.get("mesh") or {}).get("autoscale")
+        if verdict is None:
+            problems.append("no autoscale verdict in mesh report")
+        else:
+            problems.extend(f"autoscale: {p}"
+                            for p in _check_autoscale(verdict))
     if min_acceptance is not None:
         spec = report.get("speculative")
         if not spec:

@@ -37,7 +37,7 @@ class Replica:
 
     __slots__ = ("name", "engine", "role", "breaker", "alive",
                  "routed", "step_seconds", "steps", "manager",
-                 "finished_count", "tokens_out")
+                 "finished_count", "tokens_out", "sampler")
 
     def __init__(self, name, engine, role="both",
                  failure_threshold=3, reset_timeout=30.0):
@@ -57,6 +57,10 @@ class Replica:
         self.finished_count = 0  # streams harvested off this worker
         self.tokens_out = 0      # tokens those streams committed
         self.manager = None      # bound by ReplicaPool
+        # per-replica observability sampler: attached by the router's
+        # MeshCollector (federation.py) and ticked from its pump; a dead
+        # replica keeps the sampler so its series freeze, not vanish
+        self.sampler = None
 
     def can_prefill(self):
         return self.role in ("both", "prefill")

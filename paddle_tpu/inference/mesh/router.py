@@ -42,7 +42,10 @@ from __future__ import annotations
 import time
 from collections import deque
 
+from ...observability.autoscale import AutoscaleAdvisor
 from ...observability.catalog import metric as _metric
+from ...observability.federation import MeshCollector
+from ...observability.metrics import get_registry as _get_registry
 from ...observability.recorder import get_recorder as _get_recorder
 from ...observability.tracing import get_tracer as _get_tracer
 from ...observability.tracing import new_trace_id as _new_trace_id
@@ -126,7 +129,7 @@ class MeshRouter:
     """
 
     def __init__(self, pool, scheduler=None, max_queue=None,
-                 handoff_retry=None):
+                 handoff_retry=None, collector="auto", advisor=None):
         self.pool = pool
         self.scheduler = scheduler  # admission ORDER only (DRR pick);
                                     # per-replica brownout stays on the
@@ -161,6 +164,18 @@ class MeshRouter:
                 if rep.role == "prefill":
                     rep.engine.prefill_sink = self._sink
         self.embed_w = pool[0].engine.embed_w
+        # round 17: the mesh observability plane. "auto" attaches a
+        # MeshCollector only when the observability layer is on, so a
+        # disabled-plane mesh (most tests, chaos drills) pays nothing —
+        # the drilled no-op contract. The advisor turns the collector's
+        # recording rules into the autoscale verdict mesh_report() emits.
+        if collector == "auto":
+            collector = (MeshCollector(pool)
+                         if _get_registry().enabled else None)
+        self.collector = collector
+        self.advisor = advisor if advisor is not None else (
+            AutoscaleAdvisor() if collector is not None else None)
+        self._autoscale_verdict = None
 
     # --- harness-facing engine surface -----------------------------------
     def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
@@ -215,6 +230,12 @@ class MeshRouter:
             self.rounds += 1
         self._pump_handoffs()
         self._harvest()
+        if self.collector is not None:
+            # sample the plane LAST so the tick sees this pump's state;
+            # a collector failure degrades the plane, never the pump
+            self.collector.tick()
+            if self.advisor is not None:
+                self._autoscale_verdict = self._advise()
 
     def run(self, max_steps=10_000):
         """Drive to completion; {mesh rid: [tokens]}."""
@@ -526,6 +547,25 @@ class MeshRouter:
                 self._commit(mreq, req)
 
     # --- telemetry aggregation -------------------------------------------
+    def _advise(self):
+        """One deterministic advisory tick: the collector's recording
+        rules (headroom min/sum, burn rate) plus the router's own
+        backlog and per-replica snapshots for drain predictions.
+        Defaults are benign (full headroom, no burn) until the rules
+        have the two ticks they need to evaluate."""
+        alive = self.pool.alive()
+        col = self.collector
+        hm = col.latest("headroom_min")
+        hs = col.latest("headroom_sum")
+        burn = col.latest("slo_burn_rate")
+        return self.advisor.advise(
+            current_replicas=len(alive),
+            headroom_min=1.0 if hm is None else hm,
+            headroom_sum=hs,
+            burn_rate=0.0 if burn is None else burn,
+            backlog=len(self.queue),
+            replica_stats={rep.name: rep.snapshot() for rep in alive})
+
     def mesh_report(self):
         """One mesh-level report: per-replica phase/SLO snapshots plus
         routing, handoff, failover, and simulated-parallel wall
@@ -535,7 +575,7 @@ class MeshRouter:
         committed_tokens = sum(len(r.generated)
                                for r in self.finished.values())
         sim = self.sim_parallel_wall_s
-        return {
+        report = {
             "replicas": {rep.name: rep.snapshot() for rep in self.pool},
             "membership": self.pool.alive_nodes(),
             "disaggregate": self.pool.disaggregate,
@@ -551,3 +591,10 @@ class MeshRouter:
             "sim_tok_per_s": (round(committed_tokens / sim, 1)
                               if sim > 0 else None),
         }
+        if self.collector is not None:
+            report["timeseries"] = self.collector.summary()
+            if self.advisor is not None:
+                report["autoscale"] = (self._autoscale_verdict
+                                       if self._autoscale_verdict is not None
+                                       else self._advise())
+        return report

@@ -480,6 +480,11 @@ class ContinuousBatchingEngine:
         if scheduler is True:
             scheduler = SLOScheduler()
         self.scheduler = scheduler
+        # round 17 (observability plane): an attached MetricsSampler is
+        # ticked once per step (deterministic step-count clock). None
+        # (default) = no sampler, zero overhead; a sampler that fails
+        # degrades ITSELF (obs.sample site) — never the engine.
+        self.sampler = None
 
     # --- public API -------------------------------------------------------
     def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
@@ -559,6 +564,8 @@ class ContinuousBatchingEngine:
                             / self.max_batch)
             self._m_free.set(len(self.pool._free))
         ph.end_step()
+        if self.sampler is not None:
+            self.sampler.sample()
 
     def _decode_active(self):
         """Lanes the fused decode advances: occupied AND past prefill."""

@@ -26,8 +26,11 @@ documented in OBSERVABILITY.md (drift is test-pinned).
 from __future__ import annotations
 
 from . import (  # noqa: F401
-    catalog, export, metrics, quantiles, recorder, slo, tracing)
+    autoscale, catalog, export, federation, metrics, quantiles, recorder,
+    slo, timeseries, tracing)
+from .autoscale import AutoscaleAdvisor  # noqa: F401
 from .catalog import CATALOG, metric, register_all  # noqa: F401
+from .federation import MeshCollector  # noqa: F401
 from .export import prometheus_text, snapshot  # noqa: F401
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricRegistry, get_registry,
@@ -37,6 +40,8 @@ from .quantiles import (  # noqa: F401
 from .recorder import FlightRecorder, get_recorder  # noqa: F401
 from .slo import DEFAULT_SLOS, SLOEngine, SLOSpec  # noqa: F401
 from .stepwatch import StepWatch, current_round  # noqa: F401
+from .timeseries import (  # noqa: F401
+    RECORDING_RULES, MetricsSampler, Series)
 from .tracing import (  # noqa: F401
     Tracer, get_tracer, new_trace_id, span, trace)
 
@@ -47,8 +52,10 @@ __all__ = ["enable", "disable", "enabled", "MetricRegistry", "Counter",
            "CATALOG", "metric", "register_all", "FlightRecorder",
            "get_recorder", "SLOEngine", "SLOSpec", "DEFAULT_SLOS",
            "quantile_from_cumulative", "quantiles_from_cumulative",
-           "catalog", "export", "metrics", "quantiles", "recorder", "slo",
-           "tracing"]
+           "MetricsSampler", "Series", "RECORDING_RULES", "MeshCollector",
+           "AutoscaleAdvisor", "autoscale", "catalog", "export",
+           "federation", "metrics", "quantiles", "recorder", "slo",
+           "timeseries", "tracing"]
 
 
 def _count_dropped(n):

@@ -362,6 +362,15 @@ CATALOG = {
         "seconds; <=0 = saturated, routed around when possible)",
         ("replica",), None),
 
+    # -- observability plane (timeseries.py sampler + mesh federation) -------
+    "obs_samples_total": (
+        "counter", "successful MetricsSampler scrape ticks (timeseries.py; "
+        "one per landed tick across every sampler in the process)", (), None),
+    "obs_plane_degradations_total": (
+        "counter", "observability-plane failures that flipped a sampler or "
+        "collector to degraded (plane off, serving untouched), by failure "
+        "class (obs.sample fault site)", ("what",), None),
+
     # -- bench orchestration (bench.py parent; stage = probe/configN/...) ----
     "bench_attempts_total": (
         "counter", "bench worker subprocess attempts by stage and outcome",

@@ -120,6 +120,12 @@ FAULT_SITES = {
                          "it, opens its breaker, and re-routes + "
                          "re-prefills its in-flight requests on the "
                          "survivors)",
+    "obs.sample": "observability plane: one MetricsSampler scrape tick "
+                  "(timeseries.py); ANY failure flips the sampler to "
+                  "degraded — plane off, counted "
+                  "obs_plane_degradations_total{what} — and serving "
+                  "continues byte-identically (the plane is read-only "
+                  "by construction)",
 }
 
 

@@ -32,7 +32,7 @@ def test_list_rules_names_the_closed_registry():
     for rule in ("metrics-in-catalog", "catalog-docs-sync", "fault-sites",
                  "recorder-kinds", "flags-registered", "host-sync",
                  "profiler-phases", "scheduler-actions", "pir-passes",
-                 "mesh-wiring"):
+                 "mesh-wiring", "recording-rules"):
         assert rule in r.stdout
 
 
@@ -101,6 +101,35 @@ def test_pir_passes_rule_catches_drift():
     # registry entry missing from the doc table: all directions fire
     assert "'undocumented'" in msgs and "'unregistered'" in msgs \
         and "'dce'" in msgs, msgs
+
+
+def test_recording_rules_rule_catches_drift():
+    # the rule compares repo registries (not scanned --paths sources),
+    # so drift is injected by calling it on a stub context in-process
+    import importlib.util
+    from types import SimpleNamespace
+    spec = importlib.util.spec_from_file_location("_sc2", TOOL)
+    sc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sc)
+
+    rules = {"goodput_rate", "shed_fraction"}
+    seam = {"obs.sample"}
+    aligned = SimpleNamespace(
+        recording_rules=set(rules), obs_rule_rows=set(rules),
+        fault_sites=set(seam), scenarios=set(seam), res_ticks=set(seam),
+        sources={})
+    assert sc.rule_recording_rules(aligned) == []
+    drifted = sc.rule_recording_rules(SimpleNamespace(
+        recording_rules=rules | {"undocumented_rule"},
+        obs_rule_rows=rules | {"phantom_rule"},
+        fault_sites=set(), scenarios=set(), res_ticks=set(),
+        sources={}))
+    msgs = " | ".join(v.message for v in drifted)
+    # registry->docs, docs->registry, and all three obs.sample
+    # containments (registered, drilled, documented) fire
+    assert "'undocumented_rule'" in msgs and "phantom_rule" in msgs, msgs
+    assert "FAULT_SITES" in msgs and "SCENARIOS drill" in msgs \
+        and "RESILIENCE.md" in msgs, msgs
 
 
 def test_mesh_wiring_rule_catches_unregistered_literals(tmp_path):

@@ -1,0 +1,551 @@
+"""Observability plane (round 17): embedded TSDB sampler, recording
+rules, mesh federation with freeze semantics, and the autoscale
+advisor. Everything here drives the sampler's deterministic tick by
+hand — no wall clock anywhere — so every assertion is exact.
+"""
+
+import math
+
+import pytest
+
+from paddle_tpu.observability.autoscale import (AutoscaleAdvisor,
+                                                check_verdict)
+from paddle_tpu.observability.federation import (MAX_REPLICA_LABELS,
+                                                 MeshCollector)
+from paddle_tpu.observability.quantiles import quantile_from_cumulative
+from paddle_tpu.observability.timeseries import (RECORDING_RULES,
+                                                 MetricsSampler, load_doc)
+from paddle_tpu.observability import timeseries
+
+
+# ---------------------------------------------------------------------------
+# synthetic scrape sources (metrics snapshot format 1)
+# ---------------------------------------------------------------------------
+
+def _counter_sample(labels, value):
+    return {"labels": dict(labels), "value": float(value)}
+
+
+def _doc(metrics):
+    return {"format": 1, "metrics": metrics}
+
+
+def _counter(name, samples):
+    return {"name": name, "type": "counter", "help": "", "labelnames": (),
+            "samples": samples}
+
+
+def _gauge(name, samples):
+    return {"name": name, "type": "gauge", "help": "", "labelnames": (),
+            "samples": samples}
+
+
+def _hist(name, buckets_by_labels):
+    samples = []
+    for labels, buckets in buckets_by_labels:
+        samples.append({"labels": dict(labels),
+                        "sum": 0.0, "count": buckets[-1][1],
+                        "buckets": [list(b) for b in buckets]})
+    return {"name": name, "type": "histogram", "help": "",
+            "labelnames": (), "samples": samples}
+
+
+class _Source:
+    """Mutable scrape source: tests mutate .metrics between ticks."""
+
+    def __init__(self, metrics=()):
+        self.metrics = list(metrics)
+
+    def __call__(self):
+        return _doc(self.metrics)
+
+
+# ---------------------------------------------------------------------------
+# registry discipline
+# ---------------------------------------------------------------------------
+
+def test_recording_rules_registry_is_closed():
+    # the evaluator table and the public registry must list the same
+    # rules (also pinned by a module-level assert at import time)
+    assert set(timeseries._RULE_EVALUATORS) == set(RECORDING_RULES)
+    assert len(RECORDING_RULES) == 8
+
+
+def test_rule_series_always_populated_from_second_tick():
+    s = MetricsSampler(scrape=_Source())
+    assert s.sample(0.0) is True       # priming tick: no window yet
+    for name in RECORDING_RULES:
+        assert s.rule_latest(name) is None
+    assert s.sample(1.0) is True
+    for name in RECORDING_RULES:
+        assert s.rule_latest(name) is not None, name
+
+
+# ---------------------------------------------------------------------------
+# deterministic tick
+# ---------------------------------------------------------------------------
+
+def test_tick_is_monotone_and_deterministic():
+    s = MetricsSampler(scrape=_Source())
+    assert s.sample(1.0) is True
+    assert s.sample(1.0) is False      # clock did not advance
+    assert s.sample(0.5) is False      # clock went backwards
+    assert s.sample(2.0) is True
+    assert s.samples == 2
+    assert not s.degraded              # non-advancing clock is benign
+
+
+def test_auto_tick_when_caller_owns_no_clock():
+    src = _Source([_gauge("slo_headroom", [_counter_sample({}, 0.5)])])
+    s = MetricsSampler(scrape=src)
+    for _ in range(3):
+        assert s.sample() is True
+    pts = s.series[("slo_headroom", ())].points
+    assert [t for t, _v in pts] == [0.0, 1.0, 2.0]
+
+
+def test_disabled_sampler_is_a_no_op():
+    s = MetricsSampler(scrape=_Source())
+    s.enabled = False
+    assert s.sample(1.0) is False
+    assert s.samples == 0 and s.series == {}
+
+
+# ---------------------------------------------------------------------------
+# counter -> rate conversion
+# ---------------------------------------------------------------------------
+
+def test_counter_rate_math():
+    src = _Source([_counter("serving_finished_total",
+                            [_counter_sample({"reason": "eos"}, 10.0)])])
+    s = MetricsSampler(scrape=src)
+    s.sample(0.0)                      # primes prev=10
+    src.metrics = [_counter("serving_finished_total",
+                            [_counter_sample({"reason": "eos"}, 16.0)])]
+    s.sample(2.0)
+    # rate = (16 - 10) / dt
+    assert s.latest("serving_finished_total", reason="eos") == 3.0
+
+
+def test_counter_child_born_mid_window_deltas_from_zero():
+    src = _Source([_counter("serving_finished_total",
+                            [_counter_sample({"reason": "eos"}, 5.0)])])
+    s = MetricsSampler(scrape=src)
+    s.sample(0.0)
+    # a new labelled child appears between ticks: its whole value is
+    # this window's delta (skipping it would hide e.g. the first shed)
+    src.metrics = [_counter("serving_finished_total",
+                            [_counter_sample({"reason": "eos"}, 5.0),
+                             _counter_sample({"reason": "shed"}, 2.0)])]
+    s.sample(1.0)
+    assert s.latest("serving_finished_total", reason="shed") == 2.0
+    assert s.latest("serving_finished_total", reason="eos") == 0.0
+
+
+def test_counter_reset_clamps_to_zero():
+    src = _Source([_counter("serving_finished_total",
+                            [_counter_sample({}, 100.0)])])
+    s = MetricsSampler(scrape=src)
+    s.sample(0.0)
+    src.metrics = [_counter("serving_finished_total",
+                            [_counter_sample({}, 3.0)])]  # process restart
+    s.sample(1.0)
+    assert s.latest("serving_finished_total") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# retention + cardinality bounds
+# ---------------------------------------------------------------------------
+
+def test_retention_evicts_oldest_points():
+    src = _Source([_gauge("slo_headroom", [_counter_sample({}, 1.0)])])
+    s = MetricsSampler(scrape=src, retention=4)
+    for t in range(10):
+        s.sample(float(t))
+    pts = s.series[("slo_headroom", ())].points
+    assert len(pts) == 4
+    assert [t for t, _v in pts] == [6.0, 7.0, 8.0, 9.0]
+
+
+def test_series_cardinality_cap_drops_and_counts():
+    samples = [_counter_sample({"tenant": f"t{i}"}, float(i))
+               for i in range(10)]
+    src = _Source([_gauge("serving_queue_depth", samples)])
+    s = MetricsSampler(scrape=src, max_series=3)
+    s.sample(0.0)
+    s.sample(1.0)
+    raw = [k for k in s.series if not k[0].startswith("rule/")]
+    assert len(raw) == 3
+    assert s.dropped_series > 0
+    # rule series are exempt from the cap (closed registry, bounded)
+    assert s.rule_latest("goodput_rate") is not None
+
+
+# ---------------------------------------------------------------------------
+# snapshot round-trip
+# ---------------------------------------------------------------------------
+
+def test_snapshot_doc_round_trip():
+    src = _Source([
+        _gauge("slo_headroom", [_counter_sample({}, 0.7)]),
+        _counter("serving_finished_total",
+                 [_counter_sample({"reason": "eos"}, 4.0)]),
+    ])
+    s = MetricsSampler(scrape=src)
+    for t in range(4):
+        s.sample(float(t))
+    doc = s.snapshot_doc()
+    assert doc["format"] == 1 and doc["tick"] == 3.0
+    restored = load_doc(doc)
+    assert restored.snapshot_doc() == doc
+    assert restored.latest("slo_headroom") == 0.7
+
+
+def test_load_doc_rejects_garbage():
+    with pytest.raises(ValueError):
+        load_doc({"format": 2})
+    with pytest.raises(ValueError):
+        load_doc("nope")
+
+
+# ---------------------------------------------------------------------------
+# recording rules vs hand-computed values
+# ---------------------------------------------------------------------------
+
+def test_goodput_and_shed_rules_hand_computed():
+    src = _Source([_counter("serving_finished_total",
+                            [_counter_sample({"reason": "eos"}, 0.0)])])
+    s = MetricsSampler(scrape=src)
+    s.sample(0.0)
+    src.metrics = [_counter("serving_finished_total",
+                            [_counter_sample({"reason": "eos"}, 4.0),
+                             _counter_sample({"reason": "length"}, 1.0),
+                             _counter_sample({"reason": "shed"}, 1.0)])]
+    s.sample(2.0)
+    # good = (4 + 1) finishes / 2 s window
+    assert s.rule_latest("goodput_rate") == 2.5
+    # shed fraction = 1 shed / 6 total finishes
+    assert math.isclose(s.rule_latest("shed_fraction"), 1.0 / 6.0)
+    # idle window: rates fall to 0, fraction to its 0.0 default
+    src.metrics = [_counter("serving_finished_total",
+                            [_counter_sample({"reason": "eos"}, 4.0),
+                             _counter_sample({"reason": "length"}, 1.0),
+                             _counter_sample({"reason": "shed"}, 1.0)])]
+    s.sample(3.0)
+    assert s.rule_latest("goodput_rate") == 0.0
+    assert s.rule_latest("shed_fraction") == 0.0
+
+
+def test_quantile_rules_use_the_shared_estimator_windowed():
+    b0 = [(0.1, 0.0), (0.5, 0.0), ("+Inf", 0.0)]
+    src = _Source([_hist("serving_ttft_seconds", [({}, b0)])])
+    s = MetricsSampler(scrape=src)
+    s.sample(0.0)
+    b1 = [(0.1, 0.0), (0.5, 10.0), ("+Inf", 10.0)]
+    src.metrics = [_hist("serving_ttft_seconds", [({}, b1)])]
+    s.sample(1.0)
+    # the window delta IS b1 here; the rule must agree with THE shared
+    # estimator applied to that delta vector — one quantile definition
+    expected = quantile_from_cumulative(b1, 0.95)
+    assert s.rule_latest("ttft_p95") == expected
+    assert math.isclose(expected, 0.48)  # 0.1 + (9.5/10) * 0.4
+    # empty window: the quantile rule holds its last value (a gap in
+    # traffic must not report "TTFT improved to 0")
+    src.metrics = [_hist("serving_ttft_seconds", [({}, b1)])]
+    s.sample(2.0)
+    assert s.rule_latest("ttft_p95") == expected
+
+
+def test_burn_brownout_and_headroom_rules():
+    src = _Source([
+        _gauge("slo_burn_rate", [_counter_sample({"slo": "a"}, 0.3),
+                                 _counter_sample({"slo": "b"}, 1.7)]),
+        _gauge("serving_brownout_level", [_counter_sample({}, 2.0)]),
+        _gauge("mesh_replica_headroom",
+               [_counter_sample({"replica": "r0"}, 0.4),
+                _counter_sample({"replica": "r1"}, -0.2)]),
+    ])
+    s = MetricsSampler(scrape=src)
+    s.sample(0.0)
+    s.sample(1.0)
+    assert s.rule_latest("slo_burn_rate") == 1.7
+    assert s.rule_latest("brownout_max") == 2.0
+    assert math.isclose(s.rule_latest("headroom_min"), -0.2)
+    assert math.isclose(s.rule_latest("headroom_sum"), 0.2)
+
+
+def test_headroom_rules_respect_alive_filter():
+    # a dead replica's frozen headroom gauge must not poison the mesh
+    # aggregate: the alive_filter (lease membership) excludes it
+    src = _Source([_gauge(
+        "mesh_replica_headroom",
+        [_counter_sample({"replica": "r0"}, 0.4),
+         _counter_sample({"replica": "r1"}, -0.9)])])
+    alive = {"r0", "r1"}
+    s = MetricsSampler(scrape=src, alive_filter=lambda: alive)
+    s.sample(0.0)
+    s.sample(1.0)
+    assert math.isclose(s.rule_latest("headroom_min"), -0.9)
+    alive = {"r0"}                      # r1's lease lapses
+    s.sample(2.0)
+    assert math.isclose(s.rule_latest("headroom_min"), 0.4)
+    assert math.isclose(s.rule_latest("headroom_sum"), 0.4)
+
+
+def test_headroom_rules_fall_back_to_single_engine_gauge():
+    src = _Source([_gauge("slo_headroom", [_counter_sample({}, 0.7)])])
+    s = MetricsSampler(scrape=src)
+    s.sample(0.0)
+    s.sample(1.0)
+    assert math.isclose(s.rule_latest("headroom_min"), 0.7)
+    assert math.isclose(s.rule_latest("headroom_sum"), 0.7)
+    # no headroom signal at all: documented benign defaults
+    empty = MetricsSampler(scrape=_Source())
+    empty.sample(0.0)
+    empty.sample(1.0)
+    assert empty.rule_latest("headroom_min") == 1.0
+    assert empty.rule_latest("headroom_sum") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# failure semantics: plane off, caller untouched
+# ---------------------------------------------------------------------------
+
+def test_scrape_failure_degrades_never_raises():
+    calls = {"n": 0}
+
+    def scrape():
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise RuntimeError("scrape exploded")
+        return _doc([])
+
+    s = MetricsSampler(scrape=scrape)
+    assert s.sample(0.0) is True
+    assert s.sample(1.0) is False      # the failure: absorbed, latched
+    assert s.degraded and not s.enabled
+    assert s.sample(2.0) is False      # plane stays off
+    assert calls["n"] == 2             # no scrape after the latch
+
+
+# ---------------------------------------------------------------------------
+# mesh federation (fake pool — freeze/rejoin/cardinality are pool
+# semantics, not engine semantics)
+# ---------------------------------------------------------------------------
+
+class _FakeReplica:
+    def __init__(self, name):
+        self.name = name
+        self.alive = True
+        self.sampler = None
+        self.routed = 0
+
+    def snapshot(self):
+        return {"alive": self.alive, "load": 2, "routed": self.routed,
+                "finished": 0, "tokens": 0, "steps": 0,
+                "step_seconds": 0.0, "predicted_service_s": 0.25}
+
+
+class _FakePool:
+    def __init__(self, reps):
+        self.replicas = list(reps)
+
+    def alive(self):
+        return [r for r in self.replicas if r.alive]
+
+
+def test_mesh_collector_merges_and_freezes_across_kill_join():
+    reps = [_FakeReplica("r0"), _FakeReplica("r1")]
+    col = MeshCollector(_FakePool(reps))
+    for _ in range(3):
+        assert col.tick() is True
+    n_r1 = len(reps[1].sampler.series[("replica_load", ())].points)
+    assert n_r1 == 3 and col.frozen() == []
+
+    reps[1].alive = False               # kill: series freeze
+    for _ in range(2):
+        col.tick()
+    assert col.frozen() == ["r1"]
+    assert len(reps[1].sampler.series[("replica_load", ())].points) == n_r1
+    assert len(reps[0].sampler.series[("replica_load", ())].points) == 5
+
+    reps[1].alive = True                # rejoin: same series resume
+    col.tick()
+    assert col.frozen() == []
+    assert len(reps[1].sampler.series[("replica_load", ())].points) \
+        == n_r1 + 1
+
+    doc = col.merged_doc()
+    assert doc["format"] == 1
+    assert doc["replicas"] == ["r0", "r1"] and doc["frozen"] == []
+    labels = {row["labels"].get("replica") for row in doc["series"]}
+    assert {"r0", "r1"} <= labels
+
+
+def test_mesh_collector_counter_rates_per_replica():
+    rep = _FakeReplica("r0")
+    col = MeshCollector(_FakePool([rep]))
+    col.tick()                          # primes at routed=0
+    rep.routed = 6
+    col.tick()                          # dt=1 -> rate 6.0
+    assert rep.sampler.latest("replica_routed_total") == 6.0
+
+
+def test_mesh_replica_label_cardinality_bounded():
+    reps = [_FakeReplica(f"r{i}") for i in range(5)]
+    col = MeshCollector(_FakePool(reps), max_replicas=2)
+    col.tick()
+    assert col.label_for("r0") == "r0" and col.label_for("r1") == "r1"
+    for name in ("r2", "r3", "r4"):
+        assert col.label_for(name) == "overflow"
+    assert MAX_REPLICA_LABELS == 16     # documented default
+
+
+def test_mesh_collector_failure_degrades_not_raises():
+    class _BrokenPool:
+        def alive(self):
+            raise ConnectionError("membership store down")
+
+    col = MeshCollector(_BrokenPool())
+    assert col.tick() is False
+    assert col.degraded and not col.enabled
+    assert col.tick() is False          # latched off
+
+
+# ---------------------------------------------------------------------------
+# autoscale advisor: hysteresis, clamping, verdict checking
+# ---------------------------------------------------------------------------
+
+def test_autoscale_scale_up_commits_after_hysteresis():
+    adv = AutoscaleAdvisor(hysteresis_ticks=3)
+    verdicts = [adv.advise(current_replicas=2, headroom_min=0.02)
+                for _ in range(4)]
+    assert [v["action"] for v in verdicts] \
+        == ["hold", "hold", "scale_up", "scale_up"]
+    assert all(v["proposal"] == "scale_up" for v in verdicts)
+    assert verdicts[2]["desired_replicas"] == 3
+    for v in verdicts:
+        assert check_verdict(v) == [], v
+
+
+def test_autoscale_scale_down_requires_absorbable_loss():
+    adv = AutoscaleAdvisor(hysteresis_ticks=2)
+    # plenty of min-headroom but the mesh sum cannot absorb a loss
+    v = adv.advise(current_replicas=2, headroom_min=0.7, headroom_sum=1.2)
+    assert v["proposal"] == "hold"
+    # sum can absorb a loss -> scale_down after the streak
+    adv2 = AutoscaleAdvisor(hysteresis_ticks=2)
+    vs = [adv2.advise(current_replicas=3, headroom_min=0.8,
+                      headroom_sum=2.4, backlog=0) for _ in range(2)]
+    assert vs[0]["action"] == "hold" and vs[1]["action"] == "scale_down"
+    assert vs[1]["desired_replicas"] == 2
+    # a backlog vetoes scale_down no matter the headroom
+    adv3 = AutoscaleAdvisor(hysteresis_ticks=1)
+    v = adv3.advise(current_replicas=3, headroom_min=0.8,
+                    headroom_sum=2.4, backlog=5)
+    assert v["proposal"] == "hold"
+
+
+def test_autoscale_no_flap_on_boundary():
+    # alternating proposals must never commit: the streak resets
+    adv = AutoscaleAdvisor(hysteresis_ticks=2)
+    for i in range(8):
+        if i % 2 == 0:
+            v = adv.advise(current_replicas=2, headroom_min=0.02)
+        else:
+            v = adv.advise(current_replicas=2, headroom_min=0.8,
+                           headroom_sum=1.8)
+        assert v["action"] == "hold", (i, v)
+        assert v["hysteresis"]["streak"] == 1
+
+
+def test_autoscale_clamps_to_replica_bounds():
+    adv = AutoscaleAdvisor(hysteresis_ticks=1, max_replicas=2)
+    v = adv.advise(current_replicas=2, headroom_min=0.0)
+    assert v["proposal"] == "hold"      # at max: cannot lean up
+    assert v["desired_replicas"] == 2
+    adv2 = AutoscaleAdvisor(hysteresis_ticks=1, min_replicas=1)
+    v = adv2.advise(current_replicas=1, headroom_min=0.9,
+                    headroom_sum=1.8)
+    assert v["proposal"] == "hold"      # at min: cannot lean down
+    assert v["desired_replicas"] == 1
+    assert check_verdict(v) == []
+
+
+def test_autoscale_burn_rate_triggers_scale_up():
+    adv = AutoscaleAdvisor(hysteresis_ticks=1)
+    v = adv.advise(current_replicas=2, headroom_min=0.9,
+                   headroom_sum=1.2, burn_rate=2.5)
+    assert v["action"] == "scale_up" and "burn" in v["reason"]
+
+
+def test_autoscale_drain_predictions():
+    adv = AutoscaleAdvisor(hysteresis_ticks=1)
+    stats = {"r0": {"load": 4, "predicted_service_s": 0.5},
+             "r1": {"load": 0, "predicted_service_s": 0.5}}
+    v = adv.advise(current_replicas=2, replica_stats=stats)
+    assert v["drain_s"] == {"r0": 2.0, "r1": 0.0}
+
+
+def test_check_verdict_rejects_malformed():
+    assert check_verdict(None)
+    assert check_verdict({"format": 99})
+    ok = AutoscaleAdvisor(hysteresis_ticks=1).advise(current_replicas=2)
+    assert check_verdict(ok) == []
+    bad = dict(ok, action="scale_up", desired_replicas=1)
+    assert any("scale_up" in p for p in check_verdict(bad))
+    bad = dict(ok, desired_replicas=ok["current_replicas"] + 2,
+               action="scale_up")
+    assert any("incremental" in p for p in check_verdict(bad))
+    bad = dict(ok, hysteresis={"pending": "scale_up", "streak": 1,
+                               "needed": 3}, action="scale_up",
+               desired_replicas=ok["current_replicas"] + 1)
+    assert any("hysteresis" in p for p in check_verdict(bad))
+
+
+# ---------------------------------------------------------------------------
+# slow: rate sweep — the rate series integrates back to the counter
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_counter_rate_integral_matches_total_over_sweep():
+    total = 0.0
+    src = _Source([_counter("serving_tokens_total",
+                            [_counter_sample({}, 0.0)])])
+    s = MetricsSampler(scrape=src, retention=4096)
+    s.sample(0.0)
+    t = 0.0
+    increments = [(i * 7) % 13 for i in range(400)]
+    dts = [0.25, 0.5, 1.0, 2.0]
+    for i, inc in enumerate(increments):
+        total += inc
+        t += dts[i % len(dts)]
+        src.metrics = [_counter("serving_tokens_total",
+                                [_counter_sample({}, total)])]
+        s.sample(t)
+    pts = list(s.series[("serving_tokens_total", ())].points)
+    integral = 0.0
+    prev_t = 0.0
+    for pt, rate in pts:
+        integral += rate * (pt - prev_t)
+        prev_t = pt
+    assert math.isclose(integral, total)
+
+
+@pytest.mark.slow
+def test_autoscale_hysteresis_sweep_never_overshoots():
+    # drive a saw-tooth load pattern for a long horizon: desired must
+    # stay within [min, max] and never move more than 1 per verdict
+    adv = AutoscaleAdvisor(hysteresis_ticks=3, max_replicas=4)
+    current = 2
+    prev_desired = None
+    for i in range(300):
+        head = 0.02 if (i // 25) % 2 == 0 else 0.9
+        v = adv.advise(current_replicas=current, headroom_min=head,
+                       headroom_sum=head * current)
+        assert check_verdict(v) == [], (i, v)
+        if prev_desired is not None:
+            assert abs(v["desired_replicas"] - prev_desired) <= 1
+        prev_desired = v["desired_replicas"]
+        current = v["desired_replicas"]
+        assert 1 <= current <= 4

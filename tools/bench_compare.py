@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""Bench-trajectory regression checker over the repo's BENCH_r*.json
+history (PERF.md "regression gate").
+
+Usage:
+  python tools/bench_compare.py                      # latest vs best prior
+  python tools/bench_compare.py --tolerance 0.10     # tighter gate
+  python tools/bench_compare.py --json               # machine-readable
+  python tools/bench_compare.py --latest BENCH_r05.json   # explicit latest
+
+Each round's record is the tools/bench.py capture: ``{n, cmd, rc, tail,
+parsed}`` where ``parsed`` is the headline bench row (or None when the
+run failed to produce one — round 1 is such a round). The checker
+extracts every known throughput/latency key it can find, compares the
+LATEST round against the BEST prior value per key, and exits nonzero
+when any key regressed past ``--tolerance``. Keys absent from a round
+(the key set grew over time; e.g. decode metrics only exist from round
+5) are skipped, never failed: the gate only fires on evidence.
+
+Pure stdlib — loadable on machines without jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Secondary-key registry: display name -> (candidate dotted paths,
+# direction). Paths are tried in order (the secondary block was renamed
+# detail.secondary -> detail.secondary_cpu_fallback between rounds 4
+# and 5). direction "up" = higher is better, "down" = lower is better.
+# The secondary suite always runs on CPU, so these compare across
+# every round that carries them.
+KEYS = {
+    "bert_tokens_per_s": (
+        ("detail.secondary_cpu_fallback.bert_tokens_per_s",
+         "detail.secondary.bert_tokens_per_s"), "up"),
+    "resnet_images_per_s": (
+        ("detail.secondary_cpu_fallback.resnet_images_per_s",
+         "detail.secondary.resnet_images_per_s"), "up"),
+    "engine_tokens_per_s": (
+        ("detail.secondary_cpu_fallback.engine_tokens_per_s",), "up"),
+    "decode_tokens_per_s": (
+        ("detail.secondary_cpu_fallback.decode_tokens_per_s",), "up"),
+    "decode_per_token_ms": (
+        ("detail.secondary_cpu_fallback.decode_per_token_ms",), "down"),
+    "decode_int8_tokens_per_s": (
+        ("detail.secondary_cpu_fallback.decode_int8_tokens_per_s",), "up"),
+    "decode_prefill_ms": (
+        ("detail.secondary_cpu_fallback.decode_prefill_ms",), "down"),
+}
+
+# Headline train metrics are DEVICE-DEPENDENT (the trajectory mixes
+# TPU rounds and CPU-smoke rounds: a CPU round must not "regress" the
+# TPU best), so they are keyed per device class at extraction time.
+_TRAIN_DIRECTIONS = {"train_tokens_per_s": "up", "train_mfu": "up"}
+
+
+def _dig(doc, dotted):
+    cur = doc
+    for part in dotted.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
+def _num(val):
+    if isinstance(val, (int, float)) and not isinstance(val, bool):
+        return float(val)
+    return None
+
+
+def _device_class(detail):
+    dev = str((detail or {}).get("device") or "")
+    return "tpu" if "TPU" in dev.upper() else "cpu"
+
+
+def directions():
+    """full {key: "up"|"down"} map, device-classed train keys included."""
+    dirs = {key: d for key, (_p, d) in KEYS.items()}
+    for base, d in _TRAIN_DIRECTIONS.items():
+        for dev in ("tpu", "cpu"):
+            dirs[f"{base}[{dev}]"] = d
+    return dirs
+
+
+def extract(parsed):
+    """parsed bench row -> {key: float} for every key present."""
+    out = {}
+    if not isinstance(parsed, dict):
+        return out
+    detail = parsed.get("detail") or {}
+    dev = _device_class(detail)
+    tps = _num(detail.get("tokens_per_s"))
+    if tps is not None:
+        out[f"train_tokens_per_s[{dev}]"] = tps
+    if parsed.get("unit") == "mfu_fraction":
+        mfu = _num(parsed.get("value"))
+        if mfu is not None:
+            out[f"train_mfu[{dev}]"] = mfu
+    for key, (paths, _direction) in KEYS.items():
+        for path in paths:
+            val = _num(_dig(parsed, path))
+            if val is not None:
+                out[key] = val
+                break
+    return out
+
+
+def load_rounds(bench_dir):
+    """-> [(round_number, path, {key: value})] sorted by round, skipping
+    rounds whose record is unreadable or has parsed=None."""
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        rounds.append((int(m.group(1)), path, extract(doc.get("parsed"))))
+    rounds.sort()
+    return rounds
+
+
+def compare(rounds, tolerance):
+    """-> (rows, regressions). rows: per-key comparison of the latest
+    round vs the best prior value (best = max for "up" keys, min for
+    "down" keys). A key missing from the latest round, or never seen
+    before it, is reported but never counted as a regression."""
+    rows, regressions = [], []
+    if len(rounds) < 2:
+        return rows, regressions
+    *prior, (latest_n, _latest_path, latest) = rounds
+    for key, direction in directions().items():
+        history = [(n, vals[key]) for n, _p, vals in prior if key in vals]
+        cur = latest.get(key)
+        if not history:
+            rows.append({"key": key, "latest": cur, "best_prior": None,
+                         "best_round": None, "ratio": None,
+                         "status": "new" if cur is not None else "absent"})
+            continue
+        if direction == "up":
+            best_round, best = max(history, key=lambda t: t[1])
+        else:
+            best_round, best = min(history, key=lambda t: t[1])
+        if cur is None:
+            rows.append({"key": key, "latest": None, "best_prior": best,
+                         "best_round": best_round, "ratio": None,
+                         "status": "missing"})
+            continue
+        # ratio > 1 means the latest round is better, either direction
+        ratio = (cur / best if direction == "up" else best / cur) \
+            if best else None
+        regressed = ratio is not None and ratio < 1.0 - tolerance
+        row = {"key": key, "latest": cur, "best_prior": best,
+               "best_round": best_round,
+               "ratio": None if ratio is None else round(ratio, 4),
+               "status": "REGRESSED" if regressed else "ok"}
+        rows.append(row)
+        if regressed:
+            regressions.append(row)
+    return rows, regressions
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=REPO,
+                    help="directory holding BENCH_r*.json (default: repo "
+                         "root)")
+    ap.add_argument("--latest", default=None,
+                    help="treat this record as the latest round instead "
+                         "of the highest-numbered BENCH_r*.json")
+    ap.add_argument("--tolerance", type=float, default=0.20,
+                    help="allowed fractional drop vs the best prior "
+                         "value before the gate fires (default 0.20; "
+                         "generous because the bench box is shared — "
+                         "PERF.md documents the calibration)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the comparison machine-readable")
+    args = ap.parse_args(argv)
+
+    rounds = load_rounds(args.dir)
+    if args.latest:
+        try:
+            with open(args.latest) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"cannot read --latest {args.latest}: {e}",
+                  file=sys.stderr)
+            return 2
+        rounds = [r for r in rounds
+                  if os.path.abspath(r[1]) != os.path.abspath(args.latest)]
+        rounds.append((10 ** 9, args.latest, extract(doc.get("parsed"))))
+
+    if len(rounds) < 2:
+        print(f"bench_compare: only {len(rounds)} usable round(s) under "
+              f"{args.dir} — nothing to compare", file=sys.stderr)
+        return 0
+
+    rows, regressions = compare(rounds, args.tolerance)
+    latest_n = rounds[-1][0]
+    if args.json:
+        print(json.dumps({"format": 1, "latest_round": latest_n,
+                          "tolerance": args.tolerance, "rows": rows,
+                          "regressed": [r["key"] for r in regressions]},
+                         indent=1))
+    else:
+        print(f"bench trajectory: round r{latest_n:02d} vs best prior "
+              f"(tolerance {args.tolerance:.0%})")
+        print(f"{'key':26s} {'latest':>12s} {'best prior':>12s} "
+              f"{'round':>6s} {'ratio':>7s}  status")
+        for row in rows:
+            def _f(v):
+                return "-" if v is None else f"{v:.4g}"
+            rnd = "-" if row["best_round"] is None \
+                else f"r{row['best_round']:02d}"
+            print(f"{row['key']:26s} {_f(row['latest']):>12s} "
+                  f"{_f(row['best_prior']):>12s} {rnd:>6s} "
+                  f"{_f(row['ratio']):>7s}  {row['status']}")
+    if regressions:
+        for row in regressions:
+            print(f"REGRESSION: {row['key']} {row['latest']:.4g} vs best "
+                  f"r{row['best_round']:02d}={row['best_prior']:.4g} "
+                  f"(ratio {row['ratio']}, tolerance "
+                  f"{args.tolerance:.0%})", file=sys.stderr)
+        return 1
+    print(f"bench_compare: no key regressed past "
+          f"{args.tolerance:.0%}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

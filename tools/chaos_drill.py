@@ -638,6 +638,59 @@ def drill_mesh_replica_down(tmp):
                         "accounting closed")
 
 
+def drill_obs_sample(tmp):
+    from paddle_tpu.observability.timeseries import MetricsSampler
+    p = (np.arange(8) * 5) % 128
+    # off-path proof first: the observability plane attached, disabled,
+    # or absent must not change one byte of greedy output (fresh engine
+    # each leg; paddle.seed(0) in _tiny_engine makes weights identical)
+    model_off, eng_off = _tiny_engine()
+    rid = eng_off.add_request(p, max_new_tokens=6)
+    out_off = eng_off.run()[rid]
+    model_on, eng_on = _tiny_engine()
+    eng_on.sampler = MetricsSampler()
+    rid = eng_on.add_request(p, max_new_tokens=6)
+    out_on = eng_on.run()[rid]
+    _expect(out_off == out_on,
+            "sampler attached changed greedy output bytes")
+    _expect(out_on == _dense_ref(model_on, p, 6),
+            "greedy output diverged from the dense reference")
+    _expect(eng_on.sampler.samples >= 1,
+            "sampler never landed a tick on the engine step clock")
+    model_dis, eng_dis = _tiny_engine()
+    eng_dis.sampler = MetricsSampler()
+    eng_dis.sampler.enabled = False
+    rid = eng_dis.add_request(p, max_new_tokens=6)
+    _expect(eng_dis.run()[rid] == out_off,
+            "disabled-sampler fast path changed greedy output bytes")
+    _expect(eng_dis.sampler.samples == 0,
+            "disabled sampler scraped anyway")
+    # now the fault: a scrape blows up mid-run — the plane flips to
+    # degraded (off, counted) and serving output is untouched
+    deg0 = _counter("obs_plane_degradations_total", what="FaultInjected")
+    model, eng = _tiny_engine()
+    eng.sampler = MetricsSampler()
+    with faults.injected_faults("obs.sample:2:FaultInjected"):
+        rid = eng.add_request(p, max_new_tokens=6)
+        out = eng.run()[rid]
+        inj = faults.injected_counts().get("obs.sample", 0)
+    _expect(inj == 1, "fault never reached the sampler scrape site")
+    _expect(out == out_off, "sampler fault changed serving output bytes")
+    _expect(eng.sampler.degraded, "sampler fault did not mark the plane "
+            "degraded")
+    _expect(not eng.sampler.enabled, "degraded sampler still enabled")
+    _expect(_counter("obs_plane_degradations_total",
+                     what="FaultInjected") - deg0 >= 1,
+            "plane degradation not counted")
+    ticks = eng.sampler.samples
+    eng.sampler.sample()
+    _expect(eng.sampler.samples == ticks,
+            "degraded sampler kept scraping (plane-off not latched)")
+    return "degraded", ("scrape fault mid-run latched the plane off, "
+                        "counted; serving bytes identical with the plane "
+                        "on, off, and mid-run killed")
+
+
 SCENARIOS = {
     "ckpt.chunk_write": drill_ckpt_chunk_write,
     "ckpt.metadata_replace": drill_ckpt_metadata_replace,
@@ -661,6 +714,7 @@ SCENARIOS = {
     "mesh.route": drill_mesh_route,
     "mesh.kv_handoff": drill_mesh_kv_handoff,
     "mesh.replica_down": drill_mesh_replica_down,
+    "obs.sample": drill_obs_sample,
 }
 
 

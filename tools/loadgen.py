@@ -114,6 +114,10 @@ def main(argv=None):
                          "prefill and decode workers with serialized "
                          "paged-KV handoff between them")
     ap.add_argument("--min-coverage", type=float, default=0.95)
+    ap.add_argument("--dashboard", action="store_true",
+                    help="render the run's embedded TSDB as a terminal "
+                         "dashboard (tools/dashboard.py) on stderr at "
+                         "end of run")
     ap.add_argument("--out", default=None, help="write the report JSON here "
                     "(default: stdout)")
     ap.add_argument("--list", action="store_true",
@@ -147,10 +151,14 @@ def main(argv=None):
     else:
         engine = build_engine(scheduler=True if args.scheduler else None,
                               **kw)
+    # the harness owns the loadgen-clock sampler so --dashboard can
+    # render the full TSDB (the report only embeds the summary)
+    from paddle_tpu.observability.timeseries import MetricsSampler
+    sampler = MetricsSampler()
     report = loadgen.run_scenario(
         engine, args.scenario, seed=args.seed, rate_rps=args.rate,
         duration_s=args.duration, max_wall_s=args.max_wall,
-        drain=not args.no_drain)
+        drain=not args.no_drain, sampler=sampler)
 
     text = json.dumps(report, indent=1, default=str)
     if args.out:
@@ -195,20 +203,35 @@ def main(argv=None):
                   f"{str(row['tok_per_s']):>8s} {str(head):>9s}",
                   file=sys.stderr)
 
+    if args.dashboard:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import dashboard as _dash
+        doc = (engine.collector.merged_doc()
+               if getattr(engine, "collector", None) is not None
+               else sampler.snapshot_doc())
+        print(_dash.render(doc, report=report), file=sys.stderr)
+
     if args.check:
         problems = loadgen.check_report(
             report, min_coverage=args.min_coverage,
             min_acceptance=((args.min_acceptance
                              if args.min_acceptance is not None else 0.0)
-                            if args.speculative else None))
+                            if args.speculative else None),
+            require_timeseries=True,
+            require_autoscale=args.replicas > 1)
         for p in problems:
             print(f"CHECK FAIL: {p}", file=sys.stderr)
         if problems:
             return 1
         extra = "" if not spec else (
             f", per-scenario acceptance {spec['acceptance']}")
+        if args.replicas > 1:
+            auto = (report.get("mesh") or {}).get("autoscale") or {}
+            extra += (f", autoscale {auto.get('action')} -> "
+                      f"desired={auto.get('desired_replicas')}")
         print("CHECK PASS: SLO verdict present, attribution "
-              f">={args.min_coverage:.0%}, cost gauge populated{extra}",
+              f">={args.min_coverage:.0%}, cost gauge populated, "
+              f"recording rules populated{extra}",
               file=sys.stderr)
     return 0
 

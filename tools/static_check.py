@@ -35,6 +35,12 @@ Rules (closed registry, like everything else here):
                        registered mesh.* site armed by mesh code AND
                        backticked in RESILIENCE.md, no phantom mesh.*
                        docs — both directions
+  recording-rules      timeseries.py RECORDING_RULES == OBSERVABILITY.md
+                       `rule/NAME` rows (both directions); rule-name
+                       literals at lookup sites ⊆ the registry; the
+                       plane's obs.sample fault seam registered in
+                       FAULT_SITES, drilled, documented in
+                       RESILIENCE.md, and actually armed by the sampler
 
 Usage:
   python tools/static_check.py                 # whole repo, all rules
@@ -72,6 +78,7 @@ PHASES_PY = "paddle_tpu/profiler/phases.py"
 SCHEDULER_PY = "paddle_tpu/inference/scheduler.py"
 CHAOS_PY = "tools/chaos_drill.py"
 PASSES_PY = "paddle_tpu/pir/passes.py"
+TIMESERIES_PY = "paddle_tpu/observability/timeseries.py"
 OBS_MD = "OBSERVABILITY.md"
 RES_MD = "RESILIENCE.md"
 COMPILER_MD = "COMPILER.md"
@@ -243,6 +250,9 @@ class Context:
         self.pir_passes = _dict_keys(PASSES_PY, "PASSES")
         self.pir_flag_default = _pir_flag_default()
         self.compiler_pass_rows = _compiler_pass_rows()
+        self.recording_rules = _dict_keys(TIMESERIES_PY, "RECORDING_RULES")
+        self.obs_rule_rows = set(re.findall(r"^\| `rule/([a-z0-9_]+)` \|",
+                                            _read(OBS_MD), re.M))
         self.sources = {}
         for rel in (paths if paths is not None else self._default_paths()):
             try:
@@ -683,6 +693,84 @@ def rule_mesh_wiring(ctx):
     return out
 
 
+def rule_recording_rules(ctx):
+    """The recording-rule registry (timeseries.py RECORDING_RULES) is
+    closed like the metric catalog, with one documentation mirror:
+    every rule must have a `| \\`rule/NAME\\` |` row in
+    OBSERVABILITY.md's recording-rule table and vice versa. Rule-name
+    literals at lookup sites (``rule_latest("x")`` anywhere; the mesh
+    router's ``collector.latest("x")``) must name a registered rule.
+    And the plane's failure seam is pinned end to end: ``obs.sample``
+    must be registered in FAULT_SITES, drilled by chaos_drill
+    SCENARIOS, backticked in RESILIENCE.md, and actually armed
+    (``fault_point``) by the sampler source."""
+    out = []
+    for name in sorted(ctx.recording_rules - ctx.obs_rule_rows):
+        out.append(Violation(
+            "recording-rules", OBS_MD, 0,
+            f"RECORDING_RULES entry {name!r} has no `| `rule/{name}` |` "
+            f"row in {OBS_MD}"))
+    for name in sorted(ctx.obs_rule_rows - ctx.recording_rules):
+        out.append(Violation(
+            "recording-rules", OBS_MD, 0,
+            f"{OBS_MD} documents rule/{name} which is not in "
+            f"{TIMESERIES_PY} RECORDING_RULES"))
+    for p, ln, name in _str_arg_calls(ctx, {"rule_latest"}):
+        if name not in ctx.recording_rules:
+            out.append(Violation(
+                "recording-rules", p, ln,
+                f"rule_latest({name!r}) is not in {TIMESERIES_PY} "
+                "RECORDING_RULES"))
+    scanned_sampler = False
+    armed = False
+    for path, tree in ctx.sources.items():
+        norm = path.replace(os.sep, "/")
+        if norm.endswith(TIMESERIES_PY):
+            scanned_sampler = True
+            armed = any(
+                isinstance(node, ast.Call)
+                and _callee(node) == "fault_point" and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == "obs.sample"
+                for node in ast.walk(tree))
+        elif norm.endswith("inference/mesh/router.py"):
+            # MeshCollector.latest() takes rule names (the sampler's
+            # own .latest() takes raw metric names, so only the
+            # router's call sites are in scope)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Call) \
+                        and _callee(node) == "latest" and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str) \
+                        and node.args[0].value not in ctx.recording_rules:
+                    out.append(Violation(
+                        "recording-rules", path, node.lineno,
+                        f"collector.latest({node.args[0].value!r}) is "
+                        f"not in {TIMESERIES_PY} RECORDING_RULES"))
+    if "obs.sample" not in ctx.fault_sites:
+        out.append(Violation(
+            "recording-rules", FAULTS_PY, 0,
+            "the observability plane's fault seam 'obs.sample' is not "
+            f"registered in {FAULTS_PY} FAULT_SITES"))
+    if "obs.sample" not in ctx.scenarios:
+        out.append(Violation(
+            "recording-rules", CHAOS_PY, 0,
+            "'obs.sample' has no chaos_drill SCENARIOS drill (the "
+            "plane-off degradation must be drillable)"))
+    if "obs.sample" not in ctx.res_ticks:
+        out.append(Violation(
+            "recording-rules", RES_MD, 0,
+            f"'obs.sample' is never mentioned (backticked) in {RES_MD}"))
+    if scanned_sampler and not armed:
+        # gated on the real sampler source being in the scan set (a
+        # --paths run on another file must not fire this)
+        out.append(Violation(
+            "recording-rules", TIMESERIES_PY, 0,
+            "'obs.sample' is registered but never armed (fault_point) "
+            f"in {TIMESERIES_PY}"))
+    return out
+
+
 RULES = {
     "metrics-in-catalog": (rule_metrics_in_catalog,
                            "metric() literals are catalog entries"),
@@ -709,6 +797,10 @@ RULES = {
     "mesh-wiring": (rule_mesh_wiring,
                     "mesh site/kind literals ⊆ registries; mesh.* "
                     "sites armed + in RESILIENCE.md, both ways"),
+    "recording-rules": (rule_recording_rules,
+                        "RECORDING_RULES == OBSERVABILITY.md rule/ rows; "
+                        "obs.sample registered, drilled, documented, "
+                        "armed"),
 }
 
 

@@ -464,6 +464,13 @@ def _bench_decode(on_tpu):
         spec_tuned = _bench_engine_config(
             model, cfg, prompt, new_eng, batch, fused_k, spec=True,
             draft_depth=tuned_stats["depth"], drafter=tuned_fn)
+        # round 18: suffix-automaton drafter at EQUAL depth vs the tuned
+        # ladder — longest-match lookup should convert the repetitive
+        # motif tail at least as well as the fixed (3,2) rungs
+        suffix_fn = _drafting.suffix_drafter()
+        spec_suffix = _bench_engine_config(
+            model, cfg, prompt, new_eng, batch, fused_k, spec=True,
+            draft_depth=tuned_stats["depth"], drafter=suffix_fn)
         # headline row = the production config (fused); the A/B keeps the
         # baseline next to it plus the overlap evidence per config. Three
         # arms decompose the win: the pre-fused host loop (re-upload +
@@ -495,6 +502,9 @@ def _bench_decode(on_tpu):
             (f"decode_steps={fused_k}+spec_tuned({tuned_fn.label},"
              f"d={tuned_stats['depth']})"):
                 {k: spec_tuned[k] for k in skeys},
+            (f"decode_steps={fused_k}+spec_suffix({suffix_fn.label},"
+             f"d={tuned_stats['depth']})"):
+                {k: spec_suffix[k] for k in skeys},
             "speedup": round(speed, 2),
             "spec_speedup": round(spec_speed, 2),
             # speculation must be invisible in the committed streams; the
@@ -502,8 +512,14 @@ def _bench_decode(on_tpu):
             # round through int8, so it parity-checks against itself only
             "greedy_parity": (base["outputs"] == fused["outputs"]
                               == modern1["outputs"] == specarm["outputs"]
-                              == spec_tuned["outputs"]),
+                              == spec_tuned["outputs"]
+                              == spec_suffix["outputs"]),
         }
+        # round 18: cross-request prefix cache, cold (cache off: every
+        # prompt fully prefilled) vs warm (index pre-populated: only the
+        # per-request tail prefills). One warm engine REUSED across the
+        # warm-up and timed runs — the index must persist to be a cache.
+        out["engine_prefix_ab"] = _bench_engine_prefix(model, cfg, batch)
         if on_tpu:
             # iteration-level scheduling puts the host in the loop every
             # dispatch; through the axon tunnel each dispatch costs
@@ -531,6 +547,82 @@ def _bench_decode(on_tpu):
     except Exception as e:  # noqa: BLE001 — serving leg must not sink decode
         out["engine_error"] = f"{type(e).__name__}: {str(e)[:200]}"
     return out
+
+
+def _bench_engine_prefix(model, cfg, batch):
+    """Round-18 prefix-cache A/B: a shared-prefix request mix (96-token
+    tenant-common head + 4 distinct tail tokens per request) run on a
+    cache-off engine (cold: full prefill per request) and on ONE warm
+    prefix-cache engine whose index was populated by an untimed pass of
+    the same mix. Warm admissions resolve the head from the index and
+    prefill only the tail — with buckets (16, 112) that is a 16-wide
+    tail chunk instead of the 112-wide full chunk, so both the
+    prefill-token count and the wall clock move. Records the
+    prefill-token reduction, the warm speedup, and cold-vs-warm greedy
+    parity (the byte-identity contract, measured not claimed)."""
+    import numpy as np
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference import ContinuousBatchingEngine
+
+    def ctr(name):
+        fam = obs.get_registry().get(name)
+        return fam.value if fam is not None else 0.0
+
+    head_len, tail_len, new = 96, 4, 8
+    s = head_len + tail_len
+    n_req = batch * 3
+    rng = np.random.RandomState(18)
+    head = rng.randint(1, cfg.vocab_size, (head_len,))
+    prompts = [np.concatenate(
+        [head, rng.randint(1, cfg.vocab_size, (tail_len,))])
+        for _ in range(n_req)]
+    blocks_per_seq = (s + new) // 16 + 2
+
+    def build(prefix_cache):
+        return ContinuousBatchingEngine(
+            model,
+            num_blocks=batch * blocks_per_seq + head_len // 16 + 2,
+            block_size=16, max_batch=batch,
+            max_blocks_per_seq=blocks_per_seq,
+            prefill_buckets=(16, 112), decode_steps=8,
+            prefix_cache=prefix_cache)
+
+    def timed(eng):
+        done0 = frozenset(eng.finished)  # run() returns ALL-time finished
+        for p in prompts:
+            eng.add_request(p, max_new_tokens=new)
+        saved0 = ctr("serving_prefix_tokens_saved_total")
+        t0 = time.perf_counter()
+        res = eng.run()
+        dt = time.perf_counter() - t0
+        saved = int(ctr("serving_prefix_tokens_saved_total") - saved0)
+        outs = [v for rid, v in res.items() if rid not in done0]
+        toks = sum(len(v) for v in outs)
+        return {"tokens_per_s": round(toks / dt, 1),
+                "prefill_tokens": n_req * s - saved,
+                "tokens_saved": saved,
+                "outputs": sorted(map(tuple, outs))}
+
+    cold_eng = build(False)
+    cold_eng.add_request(prompts[0], max_new_tokens=new)
+    cold_eng.run()                  # compile outside the timed region
+    cold = timed(cold_eng)
+    warm_eng = build(True)
+    for p in prompts:               # untimed pass: compiles + warms the
+        warm_eng.add_request(p, max_new_tokens=new)     # prefix index
+    warm_eng.run()
+    warm = timed(warm_eng)
+    parity = cold.pop("outputs") == warm.pop("outputs")
+    return {
+        "requests": n_req, "prompt_tokens": n_req * s,
+        "shared_head_tokens": head_len,
+        "cold": cold, "warm": warm,
+        "prefill_token_reduction": round(
+            cold["prefill_tokens"] / max(1, warm["prefill_tokens"]), 2),
+        "warm_speedup": round(
+            warm["tokens_per_s"] / max(cold["tokens_per_s"], 1e-9), 2),
+        "greedy_parity": parity,
+    }
 
 
 def _bench_engine_config(model, cfg, prompt, new, batch, decode_steps,

@@ -79,7 +79,7 @@ class Scenario:
                  "rate_end_rps", "burst_n", "burst_every_s",
                  "prompt_len", "output_tokens", "tenants", "priorities",
                  "do_sample", "temperature", "top_k", "top_p",
-                 "deadline_s", "description")
+                 "deadline_s", "shared_prefix_len", "description")
 
     def __init__(self, name, arrival="poisson", rate_rps=10.0,
                  duration_s=1.0, rate_end_rps=None, burst_n=4,
@@ -87,7 +87,7 @@ class Scenario:
                  output_tokens=(4, 12), tenants=(("-", 1.0),),
                  priorities=(("interactive", 1.0),),
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                 deadline_s=None, description=""):
+                 deadline_s=None, shared_prefix_len=0, description=""):
         if arrival not in ("poisson", "burst", "ramp"):
             raise ValueError(f"unknown arrival process {arrival!r}")
         for p, _w in priorities:
@@ -112,6 +112,9 @@ class Scenario:
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         self.deadline_s = None if deadline_s is None else float(deadline_s)
+        # round 18: tokens of tenant-common system prompt prepended to
+        # every request's (per-request) tail — the prefix-cache workload
+        self.shared_prefix_len = int(shared_prefix_len)
         self.description = str(description)
 
 
@@ -149,6 +152,16 @@ SCENARIOS = {
                     "mixed interactive/batch classes, arrival rate "
                     "ramping into saturation — the scheduler's chaos "
                     "probe"),
+    "shared_prefix": Scenario(
+        "shared_prefix", arrival="poisson", rate_rps=14.0, duration_s=1.5,
+        prompt_len=(4, 12), output_tokens=(4, 10),
+        tenants=(("acme", 2.0), ("zee", 1.0)), shared_prefix_len=32,
+        deadline_s=15.0,
+        description="tenant-common system prompt (32 shared tokens) + "
+                    "short per-request tail: the cross-request prefix "
+                    "cache workload — after one cold prefill per tenant "
+                    "every admission should resolve the shared blocks "
+                    "from the index and prefill only the tail"),
 }
 
 
@@ -231,6 +244,16 @@ def _prompt_tokens(prompt_seed, length, vocab):
     idx = np.arange(int(length), dtype=np.int64)
     return ((int(prompt_seed) + idx * 2654435761) % span + lo).astype(
         np.int32)
+
+
+def _tenant_prefix(scenario_name, tenant, length, vocab):
+    """Round 18: the tenant-common system prompt — a Weyl sequence whose
+    seed is a stable content hash of (scenario, tenant), so every
+    request of one tenant shares byte-identical leading tokens (what
+    the engine's prefix index actually keys on) while tenants never
+    collide with each other."""
+    h = hashlib.sha256(f"{scenario_name}:{tenant}".encode()).digest()
+    return _prompt_tokens(int.from_bytes(h[:4], "big"), length, vocab)
 
 
 # -- snapshot helpers (the slo.py windowing idea, localized) ---------------
@@ -396,6 +419,11 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
             offered_t.append(now)
             prompt = _prompt_tokens(a["prompt_seed"], a["prompt_len"],
                                     vocab)
+            if scenario.shared_prefix_len > 0:
+                prompt = np.concatenate([
+                    _tenant_prefix(scenario.name, a["tenant"],
+                                   scenario.shared_prefix_len, vocab),
+                    prompt])
             try:
                 engine.add_request(
                     prompt, max_new_tokens=a["output_tokens"],
@@ -476,6 +504,35 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
             "acceptance": round(accepted / drafted, 4),
         }
 
+    # prefix-cache evidence: this run's hit/miss/saved deltas (None when
+    # the engine has no prefix index). hit_rate is per-ADMISSION — a
+    # deferred-then-admitted request counts once, a faulted lookup
+    # counts as a miss (the degrade-to-miss contract)
+    prefix = None
+    if getattr(engine, "_prefix", None) is not None:
+        p_hits = (_counter_total(snap1, "serving_prefix_hits_total")
+                  - _counter_total(snap0, "serving_prefix_hits_total"))
+        p_miss = (_counter_total(snap1, "serving_prefix_misses_total")
+                  - _counter_total(snap0, "serving_prefix_misses_total"))
+        p_saved = (
+            _counter_total(snap1, "serving_prefix_tokens_saved_total")
+            - _counter_total(snap0, "serving_prefix_tokens_saved_total"))
+        lookups = p_hits + p_miss
+        prefix = {
+            "hits": int(p_hits),
+            "misses": int(p_miss),
+            "hit_rate": (round(p_hits / lookups, 4) if lookups else None),
+            "tokens_saved": int(p_saved),
+            "shared_blocks": int(_counter_total(
+                snap1, "serving_prefix_shared_blocks")),
+            "evictions": int(
+                _counter_total(snap1, "serving_prefix_evictions_total")
+                - _counter_total(snap0, "serving_prefix_evictions_total")),
+            "cow_forks": int(
+                _counter_total(snap1, "serving_prefix_cow_forks_total")
+                - _counter_total(snap0, "serving_prefix_cow_forks_total")),
+        }
+
     report = {
         "format": REPORT_FORMAT,
         "scenario": scenario.name,
@@ -503,6 +560,7 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
         "coverage": (phases_report or {}).get("coverage"),
         "cost": cost,
         "speculative": speculative,
+        "prefix": prefix,
         "headroom_floor": headroom_floor,
         "timeline": timeline,
         # scheduler evidence (all zero/None for a scheduler-less engine):
@@ -543,7 +601,8 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
 
 
 def check_report(report, min_coverage=0.95, min_acceptance=None,
-                 require_timeseries=False, require_autoscale=False):
+                 require_timeseries=False, require_autoscale=False,
+                 min_prefix_hit_rate=None):
     """Acceptance gate over a run report -> list of problems (empty =
     pass). Checked: an SLO verdict exists, phase attribution covers at
     least `min_coverage` of engine wall time, the cost model priced at
@@ -556,8 +615,24 @@ def check_report(report, min_coverage=0.95, min_acceptance=None,
     gates the observability plane: a timeseries block must exist, not
     be degraded, and every recording rule must have >= 1 populated
     point. `require_autoscale` (mesh runs) requires an internally
-    consistent autoscale verdict (autoscale.check_verdict)."""
+    consistent autoscale verdict (autoscale.check_verdict).
+    `min_prefix_hit_rate` (prefix-cache runs) requires a prefix block
+    with admission hit_rate at or above the floor and tokens actually
+    saved — a warm shared-prefix run that saved nothing is a broken
+    index, not a pass."""
     problems = []
+    if min_prefix_hit_rate is not None:
+        pfx = report.get("prefix")
+        if not pfx:
+            problems.append("no prefix block in report "
+                            "(engine prefix cache off?)")
+        else:
+            if (pfx.get("hit_rate") or 0.0) < float(min_prefix_hit_rate):
+                problems.append(
+                    f"prefix hit_rate {pfx.get('hit_rate')} < "
+                    f"{min_prefix_hit_rate}")
+            if pfx.get("tokens_saved", 0) <= 0:
+                problems.append("prefix cache saved no prefill tokens")
     if require_timeseries:
         ts = report.get("timeseries")
         if not isinstance(ts, dict):

@@ -51,6 +51,7 @@ from ...observability.tracing import get_tracer as _get_tracer
 from ...observability.tracing import new_trace_id as _new_trace_id
 from ...resilience.faults import FaultInjected, check, fault_point
 from ...resilience.retry import RetryPolicy
+from ..prefix_cache import affinity_key
 from ..serving import BackpressureError
 from ..scheduler import PRIORITY_CLASSES
 from .handoff import KVHandoffError, hand_off
@@ -58,6 +59,13 @@ from .handoff import KVHandoffError, hand_off
 __all__ = ["MeshRequest", "MeshRouter"]
 
 _TRANSIENT = (TimeoutError, ConnectionError, OSError, FaultInjected)
+
+# prefix-affinity hint bounds: remembered first-chunk hashes (FIFO
+# evicted past the cap) and how much extra backlog the remembered
+# replica may carry versus the best-ranked candidate before load
+# balance wins over cache warmth
+_AFFINITY_CAP = 512
+_AFFINITY_SLACK = 2
 
 
 class MeshRequest:
@@ -149,6 +157,14 @@ class MeshRouter:
                         seed=0, sleep=lambda _s: None)
         self._handoffs = {"ok": 0, "retried": 0, "re_prefill": 0,
                           "bytes": 0}
+        # round 18: prefix-affinity hint — first-prompt-chunk hash ->
+        # replica that last served it, so requests sharing a system
+        # prompt land where the prefix index is already warm. A HINT
+        # only: consulted when the remembered replica is a live
+        # candidate whose backlog is within _AFFINITY_SLACK of the
+        # best-ranked one; bounded FIFO map, never a correctness input.
+        self._affinity: dict[bytes, str] = {}
+        self._affinity_bs = int(pool[0].engine.pool.block_size)
         self._failovers: dict[str, int] = {}
         self._arrivals: deque[float] = deque(maxlen=256)
         self._t0 = time.perf_counter()
@@ -318,7 +334,18 @@ class MeshRouter:
             cands = self.pool.decode_targets() or self.pool.alive()
         else:
             cands = self.pool.alive()
-        for rep in self._ranked(cands):
+        ranked = self._ranked(cands)
+        akey = affinity_key("mesh", self._affinity_bs, mreq.prompt)
+        if akey is not None and len(ranked) > 1:
+            hint = self._affinity.get(akey)
+            if hint is not None:
+                pref = next((r for r in ranked if r.name == hint), None)
+                if (pref is not None and pref is not ranked[0]
+                        and pref.load()
+                        <= ranked[0].load() + _AFFINITY_SLACK):
+                    ranked.remove(pref)
+                    ranked.insert(0, pref)
+        for rep in ranked:
             if not rep.breaker.allow():
                 self._failover("circuit_open", mreq)
                 continue
@@ -355,6 +382,11 @@ class MeshRouter:
             mreq.hops += 1
             rep.routed += 1
             self._local[(rep.name, local_rid)] = mreq
+            if akey is not None:
+                self._affinity.pop(akey, None)
+                self._affinity[akey] = rep.name
+                while len(self._affinity) > _AFFINITY_CAP:
+                    self._affinity.pop(next(iter(self._affinity)))
             _metric("mesh_routed_total", replica=rep.name).inc()
             if self._rec.enabled:
                 self._rec.record("mesh", action="route", rid=mreq.rid,

@@ -61,6 +61,7 @@ from ..ops.paged_attention import (KVBlockFormat, kv_rollback_tokens,
                                    paged_attention_verify, write_to_cache)
 from ..profiler.phases import get_phase_accountant as _get_phases
 from ..resilience.faults import FaultInjected, fault_point
+from .prefix_cache import PrefixCacheIndex
 from .scheduler import PRIORITY_CLASSES, SLOScheduler
 
 __all__ = ["ContinuousBatchingEngine", "Request", "BackpressureError",
@@ -166,7 +167,19 @@ class Request:
 
 class _LayeredBlockPool:
     """Block allocator over a (L, num_blocks, block_size, KVH, D) pool.
-    One block-id table per sequence, shared by all layers."""
+    One block-id table per sequence, shared by all layers.
+
+    Round 18: blocks are REFCOUNTED. A block's references are (a) each
+    request whose table holds it and (b) an optional prefix-cache pin
+    (`pin`/`unpin`) that keeps a prompt-prefix block resident after its
+    request retires. `release` decrements instead of freeing, so two
+    requests sharing a system-prompt prefix return the block exactly
+    once — when the last holder lets go. Shared blocks are only ever
+    READ (prompt positions are immutable after prefill; decode and
+    speculative writes land at positions >= the prompt length, i.e. in
+    later, private blocks); the one write that can land inside a shared
+    block — the >=1-token prefill tail of a block-aligned full-prefix
+    match — goes through `fork_cow` first."""
 
     def __init__(self, num_layers, num_blocks, block_size, kv_heads,
                  head_dim, dtype, fmt=None):
@@ -194,12 +207,22 @@ class _LayeredBlockPool:
         self.scratch_block = num_blocks - 1
         self._free = list(range(num_blocks - 2, -1, -1))
         self.tables: dict[int, list[int]] = {}
+        # block id -> reference count; absent == free (on self._free)
+        self._ref: dict[int, int] = {}
 
     def blocks_needed(self, n_tokens):
         return (n_tokens + self.block_size - 1) // self.block_size
 
-    def can_fit(self, n_tokens):
-        return len(self._free) >= self.blocks_needed(n_tokens)
+    def can_fit(self, n_tokens, have=0):
+        return len(self._free) >= self.blocks_needed(n_tokens) - have
+
+    def _deref(self, b):
+        n = self._ref.get(b, 1) - 1
+        if n <= 0:
+            self._ref.pop(b, None)
+            self._free.append(b)
+        else:
+            self._ref[b] = n
 
     def ensure(self, rid, n_tokens):
         table = self.tables.setdefault(rid, [])
@@ -208,12 +231,68 @@ class _LayeredBlockPool:
             if not self._free:
                 _metric("serving_pool_exhausted_total").inc()
                 raise KVPoolExhaustedError("paged KV pool exhausted")
-            table.append(self._free.pop())
+            b = self._free.pop()
+            self._ref[b] = 1
+            table.append(b)
         return table
 
     def release(self, rid):
         for b in self.tables.pop(rid, []):
-            self._free.append(b)
+            self._deref(b)
+
+    # --- cross-request prefix sharing (round 18) --------------------------
+    def adopt(self, rid, blocks):
+        """Start rid's table with already-resident shared blocks (a
+        prefix-cache hit): each gains a reference; the tail of the table
+        is filled by the usual ensure()."""
+        table = self.tables.setdefault(rid, [])
+        if table:
+            raise ValueError(f"adopt on rid {rid} with a non-empty table")
+        for b in blocks:
+            self._ref[b] = self._ref.get(b, 0) + 1
+            table.append(int(b))
+        return table
+
+    def pin(self, b):
+        """Prefix-cache reference: keeps the block resident after its
+        request retires."""
+        self._ref[b] = self._ref.get(b, 0) + 1
+
+    def unpin(self, b):
+        """Drop a prefix-cache reference (index eviction / clear). The
+        block frees only when no request still holds it."""
+        self._deref(b)
+
+    def shared_count(self, rid):
+        """How many of rid's blocks are shared (refcount > 1) — the
+        handoff manifest's shared-block marker."""
+        return sum(1 for b in self.tables.get(rid, ())
+                   if self._ref.get(b, 1) > 1)
+
+    def fork_cow(self, rid, idx):
+        """Copy-on-write: give rid a PRIVATE copy of table[idx] before a
+        write lands in it. Device-copies the stored payload (and scales)
+        byte-for-byte into a fresh block, swaps the table entry, and
+        drops the old reference. No-op when the block is already
+        private. Raises KVPoolExhaustedError when no free block exists
+        (callers treat it like any reservation failure)."""
+        old = self.tables[rid][idx]
+        if self._ref.get(old, 1) <= 1:
+            return old
+        if not self._free:
+            _metric("serving_pool_exhausted_total").inc()
+            raise KVPoolExhaustedError(
+                "paged KV pool exhausted (copy-on-write fork)")
+        new = self._free.pop()
+        self._ref[new] = 1
+        self.k = self.k.at[:, new].set(self.k[:, old])
+        self.v = self.v.at[:, new].set(self.v[:, old])
+        if self.fmt.quantized:
+            self.k_scale = self.k_scale.at[:, new].set(self.k_scale[:, old])
+            self.v_scale = self.v_scale.at[:, new].set(self.v_scale[:, old])
+        self.tables[rid][idx] = new
+        self._deref(old)
+        return new
 
 
 class _PrefillTask:
@@ -305,6 +384,22 @@ class ContinuousBatchingEngine:
         None (default) = plain FIFO admission, exactly the
         pre-scheduler engine; True = an SLOScheduler with defaults; or
         pass a configured instance.
+
+    Round-18 knobs (PERF.md "Prefix cache"):
+      prefix_cache: cross-request prompt-prefix sharing (off by
+        default — the pre-round-18 engine). Admission
+        resolves the prompt's leading block-aligned chunks against a
+        chained-hash index of already-resident paged-KV blocks; prefill
+        runs only on the unmatched tail, shared blocks are refcounted,
+        and the one write that could land in a shared block (the tail
+        of a block-aligned full match) forks a private copy first
+        (COW). Greedy/sampled streams are byte-identical with the
+        cache on or off (test-pinned). Index failures degrade to a
+        cache miss (serve.prefix_match fault site) — never a wrong
+        stream.
+      prefix_cache_blocks: optional cap on indexed blocks (LRU-evicted
+        past it). None = bounded only by pool pressure: admission
+        evicts LRU index entries before deferring on a full pool.
     """
 
     def __init__(self, model, num_blocks=256, block_size=16, max_batch=8,
@@ -315,7 +410,8 @@ class ContinuousBatchingEngine:
                  compat_step_loop=False, speculative_decode=False,
                  draft_depth=2, draft_ngram=3, drafter=None,
                  kv_cache_dtype="bf16", kv_pool_bytes=None,
-                 scheduler=None):
+                 scheduler=None, prefix_cache=False,
+                 prefix_cache_blocks=None):
         config = model.config
         self.cfg = dict(eps=config.rms_norm_eps, theta=config.rope_theta,
                         heads=config.num_attention_heads,
@@ -485,6 +581,27 @@ class ContinuousBatchingEngine:
         # (default) = no sampler, zero overhead; a sampler that fails
         # degrades ITSELF (obs.sample site) — never the engine.
         self.sampler = None
+        # round 18: the cross-request prefix index. The identity string
+        # is folded into every chain key, so entries can never resolve
+        # across a block-format or geometry change (the kv_dequant
+        # degradation additionally clears the index outright).
+        if prefix_cache:
+            ident = (f"{self.pool.fmt.name}:{block_size}:"
+                     f"{self.cfg['kv_heads']}x{self.cfg['head_dim']}:"
+                     f"{np.dtype(self.embed_w.dtype).name}")
+            self._prefix = PrefixCacheIndex(ident, block_size,
+                                            max_blocks=prefix_cache_blocks)
+        else:
+            self._prefix = None
+        # rid -> tokens resolved from the index at admission (handoff
+        # manifests + tests read this; entries drop at finish)
+        self._prefix_matched: dict[int, int] = {}
+        self._m_pfx_hits = _metric("serving_prefix_hits_total")
+        self._m_pfx_miss = _metric("serving_prefix_misses_total")
+        self._m_pfx_saved = _metric("serving_prefix_tokens_saved_total")
+        self._m_pfx_shared = _metric("serving_prefix_shared_blocks")
+        self._m_pfx_evict = _metric("serving_prefix_evictions_total")
+        self._m_pfx_cow = _metric("serving_prefix_cow_forks_total")
 
     # --- public API -------------------------------------------------------
     def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
@@ -580,6 +697,7 @@ class ContinuousBatchingEngine:
         # `reason` argument here — they cannot disagree (test-pinned)
         req.done = True
         req.finish_reason = reason
+        self._prefix_matched.pop(req.rid, None)
         self.finished[req.rid] = req
         _metric("serving_finished_total", reason=reason).inc()
         _metric("serving_tenant_finished_total",
@@ -771,6 +889,14 @@ class ContinuousBatchingEngine:
             "t_arrival": float(req.t_arrival),
             "t_first": None if req.t_first is None else float(req.t_first),
             "deadline_s": req.deadline_s,
+            # prefix-cache manifest (round 18): how much of this prompt
+            # was resolved from the sender's index and how many of the
+            # exported blocks are refcount-shared there. The payload
+            # below is a COPY either way — the receiver re-owns (and
+            # re-indexes) the blocks privately.
+            "prefix_matched_tokens": int(
+                self._prefix_matched.get(req.rid, 0)),
+            "prefix_shared_blocks": int(self.pool.shared_count(req.rid)),
             "k": np.asarray(self.pool.k[:, ids]),
             "v": np.asarray(self.pool.v[:, ids]),
         }
@@ -843,6 +969,22 @@ class ContinuousBatchingEngine:
                 jnp.asarray(record["k_scale"], self.pool.k_scale.dtype))
             self.pool.v_scale = self.pool.v_scale.at[:, ids].set(
                 jnp.asarray(record["v_scale"], self.pool.v_scale.dtype))
+        # a handed-off prompt seeds THIS engine's prefix index too: the
+        # next local request with the same prefix shares these blocks.
+        # Same degrade-to-unindexed contract as the prefill-side insert.
+        if self._prefix is not None:
+            try:
+                fault_point("serve.prefix_match", rid=rid)
+                for b in self._prefix.insert(prompt,
+                                             self.pool.tables[rid]):
+                    self.pool.pin(b)
+                for b in self._prefix.trim():
+                    self.pool.unpin(b)
+                    self._m_pfx_evict.inc()
+                self._m_pfx_shared.set(len(self._prefix))
+            except _TRANSIENT_ERRORS:
+                _metric("serving_runtime_degradations_total",
+                        what="prefix_miss").inc()
         # park exactly like a preempted lane: (req, cached length, next
         # token). _resume_preempted + the next lane-state upload then
         # continue decode with no further handoff-specific machinery.
@@ -900,10 +1042,53 @@ class ContinuousBatchingEngine:
                 del self.queue[idx]
                 self._finish(req, "length")
                 continue
+            # prefix-cache lookup (round 18): resolve the prompt's
+            # leading block-aligned chunks to already-resident shared
+            # blocks. ANY index failure is a plain cache miss — full
+            # prefill, byte-identical stream, never a wrong answer
+            # (the serve.prefix_match contract, chaos-drilled).
+            matched, m_tok = [], 0
+            s = int(req.prompt.size)
+            if self._prefix is not None:
+                try:
+                    fault_point("serve.prefix_match", rid=req.rid)
+                    matched, m_tok = self._prefix.lookup(req.prompt)
+                except _TRANSIENT_ERRORS:
+                    matched, m_tok = [], 0
+                    _metric("serving_runtime_degradations_total",
+                            what="prefix_miss").inc()
+                    if self._rec.enabled:
+                        self._rec.record("degrade", what="prefix_miss",
+                                         rid=req.rid)
+            # a block-aligned FULL-prompt match must still prefill the
+            # final position (the first token samples from full-prompt
+            # logits) — that one write lands inside the last shared
+            # block, so the admission below forks it (copy-on-write)
+            need_fork = matched and m_tok >= s
+            if need_fork:
+                m_tok = s - 1
             # admit only if the WHOLE sequence fits: no mid-flight
-            # eviction (the reference engine preempts; we keep the
-            # no-surprise contract and leave the request queued)
-            if not self.pool.can_fit(total):
+            # eviction of LIVE requests (the reference engine preempts;
+            # we keep the no-surprise contract) — but index-only blocks
+            # are reclaimable cache, so LRU-evict those before deferring
+            have = len(matched) - (1 if need_fork else 0)
+
+            def _fits():
+                # 1-arg call when nothing matched: the pre-round-18
+                # can_fit signature is a test-pinned monkeypatch seam
+                return (self.pool.can_fit(total, have) if have
+                        else self.pool.can_fit(total))
+
+            if not _fits() and self._prefix is not None:
+                protect = frozenset(matched)
+                while not _fits():
+                    b = self._prefix.evict(protect)
+                    if b is None:
+                        break
+                    self.pool.unpin(b)
+                    self._m_pfx_evict.inc()
+                self._m_pfx_shared.set(len(self._prefix))
+            if not _fits():
                 _metric("serving_deferred_total", reason="pool_full").inc()
                 return
             del self.queue[idx]
@@ -913,7 +1098,13 @@ class ContinuousBatchingEngine:
                 # reserve the FULL footprint now — lazy per-step
                 # allocation could exhaust the pool mid-decode across
                 # admitted sequences, which the can_fit gate above
-                # promised cannot happen
+                # promised cannot happen. Matched prefix blocks are
+                # adopted (refcount +1) ahead of the fresh-tail ensure.
+                if matched:
+                    self.pool.adopt(req.rid, matched)
+                    if need_fork:
+                        self.pool.fork_cow(req.rid, len(matched) - 1)
+                        self._m_pfx_cow.inc()
                 self.pool.ensure(req.rid, total)
             except MemoryError:
                 # pool exhausted despite the can_fit gate (e.g. blocks
@@ -935,10 +1126,23 @@ class ContinuousBatchingEngine:
                 _metric("serving_deferred_total",
                         reason="admit_fault").inc()
                 return
+            if self._prefix is not None:
+                if m_tok > 0:
+                    self._m_pfx_hits.inc()
+                    self._m_pfx_saved.inc(m_tok)
+                    self._prefix_matched[req.rid] = m_tok
+                    if self._rec.enabled:
+                        self._rec.record("prefix_hit", rid=req.rid,
+                                         tokens=m_tok,
+                                         blocks=len(matched))
+                else:
+                    self._m_pfx_miss.inc()
             self.lanes[lane] = req
             self._lane_epoch[lane] += 1
+            # prefill covers ONLY the unmatched tail: the chunk plan
+            # starts at the first token the index could not resolve
             self._prefill_tasks[lane] = _PrefillTask(
-                req, lane, self._chunk_plan(req.prompt.size))
+                req, lane, self._chunk_plan(req.prompt.size, m_tok))
             if self._tracer.enabled:
                 t0 = int(req.t_arrival * 1e9)
                 self._tracer.add_span(
@@ -949,12 +1153,12 @@ class ContinuousBatchingEngine:
                 self._rec.record("admit", rid=req.rid, lane=lane,
                                  epoch=int(self._lane_epoch[lane]))
 
-    def _chunk_plan(self, s):
-        """(start, width) pieces covering a prompt of s tokens: full
-        chunks, then a tail padded to the smallest chunk width that
-        fits."""
+    def _chunk_plan(self, s, start=0):
+        """(start, width) pieces covering tokens [start, s) of a prompt:
+        full chunks, then a tail padded to the smallest chunk width that
+        fits. A non-zero start is a prefix-cache hit — the matched head
+        is already resident and never recomputed."""
         pieces = []
-        start = 0
         while s - start > self.chunk:
             pieces.append((start, self.chunk))
             start += self.chunk
@@ -1077,6 +1281,26 @@ class ContinuousBatchingEngine:
                 tenant=req.tenant).observe(ttft)
         if self.scheduler is not None:
             self.scheduler.note_ttft(ttft)
+        # index the request's full-prompt blocks for the NEXT sharer
+        # (before the sink path below releases the request's own refs —
+        # the index pin is what keeps a prefix resident). Failures
+        # degrade to "not indexed": streams are never affected.
+        if self._prefix is not None:
+            try:
+                fault_point("serve.prefix_match", rid=req.rid)
+                for b in self._prefix.insert(req.prompt,
+                                             self.pool.tables[req.rid]):
+                    self.pool.pin(b)
+                for b in self._prefix.trim():
+                    self.pool.unpin(b)
+                    self._m_pfx_evict.inc()
+                self._m_pfx_shared.set(len(self._prefix))
+            except _TRANSIENT_ERRORS:
+                _metric("serving_runtime_degradations_total",
+                        what="prefix_miss").inc()
+                if self._rec.enabled:
+                    self._rec.record("degrade", what="prefix_miss",
+                                     rid=req.rid)
         if self.prefill_sink is not None:
             # disaggregated prefill worker: serialize the prompt's KV
             # state and hand the stream to the decode pool. The lane +
@@ -1086,6 +1310,7 @@ class ContinuousBatchingEngine:
             if self._phases.enabled:   # export = device->host KV readback
                 self._phases.mark("hostsync", tenant=req.tenant)
             self.pool.release(req.rid)
+            self._prefix_matched.pop(req.rid, None)
             self.lanes[lane] = None
             self.lane_len[lane] = 0
             self._lane_epoch[lane] += 1
@@ -1232,6 +1457,14 @@ class ContinuousBatchingEngine:
         self.pool.k_scale = self.pool.v_scale = None
         self.pool.fmt = KVBlockFormat("native",
                                       native_dtype=self.embed_w.dtype)
+        # the prefix index promised the OLD byte layout: every entry is
+        # stale the instant the pool re-encodes, so drop them all (the
+        # blocks free once no resident request still holds them)
+        if self._prefix is not None:
+            for b in self._prefix.clear():
+                self.pool.unpin(b)
+                self._m_pfx_evict.inc()
+            self._m_pfx_shared.set(0)
         self._prefill_jit.clear()
         self._decode_jit.clear()
         _metric("serving_kv_dequant_seconds").observe(

@@ -148,8 +148,36 @@ CATALOG = {
         "counter", "permanent runtime degradations taken by the engine "
         "(speculation_off: draft/verify fault -> non-speculative decode; "
         "kv_bf16: dequant fault -> pool dequantized to the native dtype; "
-        "sched_fifo: scheduler decision fault -> plain FIFO admission)",
+        "sched_fifo: scheduler decision fault -> plain FIFO admission; "
+        "prefix_miss: prefix-index fault -> that one lookup/insert "
+        "treated as a cache miss, full prefill, stream unchanged)",
         ("what",), None),
+    "serving_prefix_hits_total": (
+        "counter", "admissions whose prompt resolved >= 1 leading block "
+        "from the cross-request prefix cache (prefill runs only on the "
+        "unmatched tail)", (), None),
+    "serving_prefix_misses_total": (
+        "counter", "admissions (prefix cache enabled) whose prompt "
+        "resolved nothing from the index — including lookups degraded "
+        "by a serve.prefix_match fault", (), None),
+    "serving_prefix_tokens_saved_total": (
+        "counter", "prompt tokens NOT prefilled because their blocks "
+        "were resolved from the prefix cache (hit_rate * mean matched "
+        "length in one number; the bench prefill-skip evidence)",
+        (), None),
+    "serving_prefix_shared_blocks": (
+        "gauge", "paged-KV blocks currently pinned by the prefix index "
+        "(each holds one block-aligned prompt chunk; refcount-shared "
+        "with any resident requests that adopted it)", (), None),
+    "serving_prefix_evictions_total": (
+        "counter", "prefix-index entries evicted (LRU leaf under pool "
+        "pressure or the prefix_cache_blocks cap, plus whole-index "
+        "clears on a block-format degradation)", (), None),
+    "serving_prefix_cow_forks_total": (
+        "counter", "copy-on-write block forks: a block-aligned "
+        "full-prefix match re-prefills its final prompt position into a "
+        "private copy of the last shared block (the only write that can "
+        "target a shared block)", (), None),
     "serving_phase_seconds": (
         "histogram", "one phase-attributed segment of engine step wall "
         "time, by profiler phase (closed registry in "

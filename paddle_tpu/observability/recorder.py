@@ -55,7 +55,10 @@ EVENT_KINDS = {
     "pir_pipeline": "PIR pass pipeline ran (pass count, cache status)",
     "retry": "resilient retry of a transient failure",
     "degrade": "serving runtime permanently dropped a feature "
-               "(speculation_off | kv_bf16 | sched_fifo) after a fault",
+               "(speculation_off | kv_bf16 | sched_fifo) after a fault, "
+               "or degraded one prefix-cache op to a miss (prefix_miss)",
+    "prefix_hit": "admission resolved leading paged-KV blocks from the "
+                  "cross-request prefix cache (rid, tokens, blocks)",
     "sched": "SLO scheduler action (brownout level transition, lane "
              "preempt/resume, best_effort shed)",
     "error": "unhandled error captured by a crash handler",

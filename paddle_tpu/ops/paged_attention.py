@@ -429,7 +429,13 @@ def paged_attention_prefill_chunk(q, k_cache, v_cache, table_row, start,
 
 class BlockKVCacheManager:
     """Host-side block allocator — the analog of the reference's block table
-    management in block_multihead_attention (paged KV serving loop)."""
+    management in block_multihead_attention (paged KV serving loop).
+
+    Round 18: blocks are refcounted so sequences can SHARE a prompt
+    prefix (`share`), with copy-on-write (`fork_cow`) before any write
+    into a shared block. `free` decrements; a block returns to the free
+    list only when its last holder lets go. Sequences that never share
+    behave exactly as before."""
 
     def __init__(self, num_blocks, block_size, num_kv_heads, head_dim,
                  dtype=jnp.bfloat16):
@@ -441,6 +447,7 @@ class BlockKVCacheManager:
         self._free = list(range(num_blocks - 1, -1, -1))
         self._tables = {}   # seq_id -> [block ids]
         self._lens = {}     # seq_id -> length
+        self._ref = {}      # block id -> refcount (absent == free)
 
     def allocate(self, seq_id, num_tokens):
         """Ensure capacity for `num_tokens` total tokens."""
@@ -449,14 +456,57 @@ class BlockKVCacheManager:
         while len(table) < need:
             if not self._free:
                 raise MemoryError("KV cache pool exhausted")
-            table.append(self._free.pop())
+            b = self._free.pop()
+            self._ref[b] = 1
+            table.append(b)
         self._lens[seq_id] = num_tokens
         return table
 
     def free(self, seq_id):
         for b in self._tables.pop(seq_id, []):
-            self._free.append(b)
+            n = self._ref.get(b, 1) - 1
+            if n <= 0:
+                self._ref.pop(b, None)
+                self._free.append(b)
+            else:
+                self._ref[b] = n
         self._lens.pop(seq_id, None)
+
+    def share(self, src_id, dst_id, num_blocks):
+        """Start dst's table with src's first `num_blocks` blocks
+        (refcount +1 each): a prompt-prefix hit. dst must be fresh; its
+        tail grows through the usual allocate()."""
+        if self._tables.get(dst_id):
+            raise ValueError(f"share into non-empty sequence {dst_id!r}")
+        src = self._tables[src_id][:num_blocks]
+        table = self._tables.setdefault(dst_id, [])
+        for b in src:
+            self._ref[b] = self._ref.get(b, 0) + 1
+            table.append(b)
+        self._lens[dst_id] = len(table) * self.block_size
+        return table
+
+    def fork_cow(self, seq_id, idx):
+        """Give seq_id a private copy of its idx-th block before a write
+        lands in it (no-op when already private). Byte-exact device
+        copy; the old block loses one reference."""
+        old = self._tables[seq_id][idx]
+        if self._ref.get(old, 1) <= 1:
+            return old
+        if not self._free:
+            raise MemoryError("KV cache pool exhausted (COW fork)")
+        new = self._free.pop()
+        self._ref[new] = 1
+        self.k_cache = self.k_cache.at[new].set(self.k_cache[old])
+        self.v_cache = self.v_cache.at[new].set(self.v_cache[old])
+        self._tables[seq_id][idx] = new
+        n = self._ref.get(old, 1) - 1
+        if n <= 0:
+            self._ref.pop(old, None)
+            self._free.append(old)
+        else:
+            self._ref[old] = n
+        return new
 
     def prefill(self, seq_id, k, v):
         """Write a whole prompt's K/V ([L, KVH, D]) into fresh blocks."""

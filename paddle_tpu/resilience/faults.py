@@ -120,6 +120,14 @@ FAULT_SITES = {
                          "it, opens its breaker, and re-routes + "
                          "re-prefills its in-flight requests on the "
                          "survivors)",
+    "serve.prefix_match": "serving prefix cache: one index operation "
+                          "(admission-time prompt-prefix lookup, or the "
+                          "post-prefill / post-import block insert); ANY "
+                          "failure degrades to a plain cache miss — full "
+                          "prefill or an unindexed prompt, streams "
+                          "byte-identical, never a wrong hit — counted "
+                          "serving_runtime_degradations_total"
+                          "{what=prefix_miss}",
     "obs.sample": "observability plane: one MetricsSampler scrape tick "
                   "(timeseries.py); ANY failure flips the sampler to "
                   "degraded — plane off, counted "

@@ -1,0 +1,341 @@
+"""Cross-request prefix cache: COW paged-KV sharing (round 18).
+
+Contracts:
+  * the cache is invisible in the streams: greedy and seeded-sampled
+    outputs with prefix_cache=True are byte-identical to the cache-off
+    engine (and the dense reference) whether the index is cold, warm,
+    or evicting — for native and quantized block formats;
+  * a block-aligned full-prefix match copy-on-write-forks the last
+    matched block before the tail token lands, so later requests
+    reading the shared block never see another stream's writes (drilled
+    here under speculative decode, whose rejected drafts roll back);
+  * refcounts close: after every request finishes — including eviction
+    under pool pressure and mesh kill/failover — per-request tables are
+    empty and every remaining reference is an index pin;
+  * the mesh handoff of a shared-block stream carries the
+    prefix_matched_tokens / prefix_shared_blocks manifest fields and
+    the imported stream finishes byte-identically.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.generation import generate
+from paddle_tpu.inference import ContinuousBatchingEngine
+from paddle_tpu.inference.prefix_cache import PrefixCacheIndex, chain_keys
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+def _model(kv_heads=None, hidden=64):
+    cfg = LlamaConfig(vocab_size=128, hidden_size=hidden,
+                      intermediate_size=2 * hidden,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=kv_heads or 4,
+                      max_position_embeddings=256)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+def _dense_reference(model, prompt, n):
+    ids = paddle.to_tensor(np.asarray(prompt, np.int32)[None])
+    out = generate(model, ids, max_new_tokens=n, do_sample=False)
+    return np.asarray(out._data)[0, len(prompt):].tolist()
+
+
+def _engine(model, **kw):
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("prefill_buckets", (16,))
+    return ContinuousBatchingEngine(model, **kw)
+
+
+def _run(model, prompts, n, sample=False, **kw):
+    eng = _engine(model, **kw)
+    skw = (dict(do_sample=True, temperature=0.8, top_k=20, seed=11)
+           if sample else {})
+    rids = [eng.add_request(p, max_new_tokens=n, **skw) for p in prompts]
+    out = eng.run()
+    return [out[r] for r in rids]
+
+
+def _shared_mix(seed=0, head_len=16, tails=(3, 5, 8, 2)):
+    """Four prompts sharing a block-aligned head: with max_batch=2 the
+    first pair admits cold (index empty) and the second pair admits
+    warm (head resolved from the index) within one run."""
+    rs = np.random.RandomState(seed)
+    head = rs.randint(1, 128, (head_len,))
+    return [np.concatenate([head, rs.randint(1, 128, (t,))])
+            for t in tails]
+
+
+def _pool_closed(eng):
+    """Refcount closure: no per-request tables, every block either free
+    or referenced exactly once by the prefix index."""
+    pool = eng.pool
+    assert pool.tables == {}, "per-request tables survived retirement"
+    assert len(pool._free) + len(pool._ref) == pool.num_blocks - 1, \
+        f"blocks leaked: free={len(pool._free)} ref={len(pool._ref)}"
+    idx_blocks = (set() if eng._prefix is None else
+                  {n.block for n in eng._prefix._nodes.values()})
+    assert set(pool._ref) == idx_blocks, \
+        "referenced blocks are not exactly the index pins"
+    assert all(c == 1 for c in pool._ref.values()), \
+        f"dangling extra references: {pool._ref}"
+
+
+@pytest.fixture
+def enabled_obs():
+    from paddle_tpu import observability as obs
+    obs.get_registry().reset()
+    obs.enable()
+    yield obs
+    obs.disable()
+    obs.get_registry().reset()
+
+
+class TestIndexUnit:
+    def test_lookup_insert_evict_roundtrip(self):
+        idx = PrefixCacheIndex("fmt:8", 8)
+        rs = np.random.RandomState(1)
+        p = rs.randint(1, 128, (20,)).astype(np.int32)
+        assert idx.lookup(p) == ([], 0)
+        new = idx.insert(p, [4, 9, 13])     # 2 full blocks at bs=8
+        assert new == [4, 9] and len(idx) == 2
+        blocks, m = idx.lookup(p)
+        assert blocks == [4, 9] and m == 16
+        # a prompt diverging inside block 2 matches only block 1
+        q = p.copy()
+        q[12] = (q[12] % 126) + 1
+        blocks, m = idx.lookup(q)
+        assert blocks == [4] and m == 8
+        # leaf-first LRU: protecting the leaf evicts nothing else first
+        assert idx.evict(protect=frozenset([9])) is None
+        assert idx.evict() == 9
+        assert idx.evict() == 4
+        assert idx.evict() is None and len(idx) == 0
+
+    def test_chain_keys_depend_on_identity_and_history(self):
+        p = np.arange(1, 17, dtype=np.int32)
+        a = [k for k, _c in chain_keys("fmt-a", 8, p)]
+        b = [k for k, _c in chain_keys("fmt-b", 8, p)]
+        assert len(a) == 2 and a[0] != b[0]
+        # the second key chains on the first: same chunk bytes under a
+        # different prefix must produce a different key
+        p2 = np.concatenate([p[8:], p[8:]])
+        c = [k for k, _c in chain_keys("fmt-a", 8, p2)]
+        assert c[1] != a[1]
+
+    def test_trim_to_cap(self):
+        idx = PrefixCacheIndex("fmt:8", 8, max_blocks=1)
+        p = np.arange(1, 25, dtype=np.int32)
+        idx.insert(p, [0, 1, 2])
+        dropped = idx.trim()
+        assert len(idx) == 1 and len(dropped) == 2
+
+
+class TestByteIdentity:
+    def test_greedy_cache_on_off_and_dense(self):
+        model = _model()
+        prompts = _shared_mix()
+        ref = [_dense_reference(model, p, 10) for p in prompts]
+        off = _run(model, prompts, 10)
+        assert off == ref, "cache-off engine diverged from dense"
+        on = _run(model, prompts, 10, prefix_cache=True)
+        assert on == off, "prefix cache changed a greedy stream"
+
+    @pytest.mark.slow  # tier-1 wall is saturated (ROADMAP housekeeping)
+    def test_sampled_cache_on_off(self):
+        model = _model()
+        prompts = _shared_mix(seed=3)
+        off = _run(model, prompts, 8, sample=True)
+        on = _run(model, prompts, 8, sample=True, prefix_cache=True)
+        assert on == off, "prefix cache changed a sampled stream"
+
+    @pytest.mark.slow  # 4 engine compiles; tier-1 keeps the bf16 pair
+    @pytest.mark.parametrize("fmt_name", ["int8", "fp8_e4m3"])
+    def test_quantized_cache_on_off(self, fmt_name):
+        """Quantized sharing is exact: same tokens at same positions in
+        the same format produce the same STORED bytes, so a shared
+        quantized block reads back identically for every request."""
+        model = _model(kv_heads=2)
+        prompts = _shared_mix(seed=5)
+        off = _run(model, prompts, 8, kv_cache_dtype=fmt_name)
+        on = _run(model, prompts, 8, kv_cache_dtype=fmt_name,
+                  prefix_cache=True)
+        assert on == off, f"prefix cache changed the {fmt_name} stream"
+
+    def test_warm_reuse_across_runs(self, enabled_obs):
+        """ONE engine, same mix twice: the second pass hits the warm
+        index, saves prefill tokens, and streams stay byte-identical;
+        refcounts close after both passes."""
+        model = _model()
+        prompts = _shared_mix(seed=7)
+        eng = _engine(model, prefix_cache=True)
+        rids = [eng.add_request(p, max_new_tokens=10) for p in prompts]
+        first = [eng.run()[r] for r in rids]
+        hits0 = enabled_obs.metric("serving_prefix_hits_total").value
+        saved0 = enabled_obs.metric(
+            "serving_prefix_tokens_saved_total").value
+        rids = [eng.add_request(p, max_new_tokens=10) for p in prompts]
+        second = [eng.run()[r] for r in rids]
+        assert second == first, "warm pass changed a stream"
+        hits = enabled_obs.metric("serving_prefix_hits_total").value
+        saved = enabled_obs.metric(
+            "serving_prefix_tokens_saved_total").value
+        assert hits - hits0 == len(prompts), "warm pass missed the index"
+        assert saved - saved0 >= len(prompts) * 16, \
+            "shared head tokens not saved on the warm pass"
+        assert enabled_obs.metric(
+            "serving_prefix_shared_blocks").value >= 2
+        _pool_closed(eng)
+
+
+class TestCopyOnWrite:
+    @pytest.mark.slow  # tier-1 wall is saturated (ROADMAP housekeeping)
+    def test_block_aligned_full_match_forks(self, enabled_obs):
+        """A block-aligned prompt fully covered by the index must fork
+        the last matched block (COW) before its tail token is written —
+        under speculative decode, whose rejected drafts roll back —
+        and later requests reading the shared block stay byte-exact."""
+        model = _model()
+        rs = np.random.RandomState(11)
+        p = rs.randint(1, 128, (16,))       # exactly 2 blocks at bs=8
+        ref = _dense_reference(model, p, 10)
+        eng = _engine(model, prefix_cache=True, decode_steps=3,
+                      speculative_decode=True, draft_depth=2)
+        rid = eng.add_request(p, max_new_tokens=10)
+        assert eng.run()[rid] == ref, "cold spec stream diverged"
+        rid = eng.add_request(p, max_new_tokens=10)
+        assert eng.run()[rid] == ref, "COW-forked stream diverged"
+        assert enabled_obs.metric(
+            "serving_prefix_cow_forks_total").value >= 1, \
+            "full-prefix match did not fork"
+        # the shared block must be untouched by the forked stream's
+        # writes (and its speculative rollbacks): a third pass re-reads
+        # the same shared bytes
+        rid = eng.add_request(p, max_new_tokens=10)
+        assert eng.run()[rid] == ref, "shared block corrupted by fork"
+        _pool_closed(eng)
+
+    @pytest.mark.slow  # tier-1 wall is saturated (ROADMAP housekeeping)
+    def test_suffix_drafter_parity_cold_and_warm(self):
+        """The round-18 suffix-automaton drafter rides the drafter= hook
+        under the prefix cache: cold and warm (index-hit) speculative
+        streams both match the dense reference byte-for-byte."""
+        from paddle_tpu.inference.drafting import suffix_drafter
+        model = _model()
+        rs = np.random.RandomState(12)
+        p = np.tile(rs.randint(1, 128, (5,)), 4)[:16]  # repetitive motif
+        ref = _dense_reference(model, p, 10)
+        eng = _engine(model, prefix_cache=True, decode_steps=3,
+                      speculative_decode=True, draft_depth=2,
+                      drafter=suffix_drafter())
+        rid = eng.add_request(p, max_new_tokens=10)
+        assert eng.run()[rid] == ref, "cold suffix-drafted stream diverged"
+        rid = eng.add_request(p, max_new_tokens=10)
+        assert eng.run()[rid] == ref, "warm suffix-drafted stream diverged"
+        _pool_closed(eng)
+
+
+class TestEviction:
+    @pytest.mark.slow  # tier-1 wall is saturated (ROADMAP housekeeping)
+    def test_pressure_evicts_lru_and_closes(self, enabled_obs):
+        """A pool too small to hold both the index pins and a new
+        request evicts LRU index blocks at admission; the new stream is
+        exact and refcounts close."""
+        model = _model()
+        rs = np.random.RandomState(13)
+        a = rs.randint(1, 128, (16,))
+        b = rs.randint(1, 128, (16,))
+        ref_b = _dense_reference(model, b, 6)
+        # 5 blocks: scratch + 4 usable; one 22-token request needs 3
+        eng = _engine(model, prefix_cache=True, num_blocks=5,
+                      max_batch=1, max_blocks_per_seq=3)
+        rid = eng.add_request(a, max_new_tokens=6)
+        eng.run()
+        assert len(eng._prefix) == 2        # a's head pinned (2 blocks)
+        rid = eng.add_request(b, max_new_tokens=6)
+        assert eng.run()[rid] == ref_b, \
+            "stream diverged after eviction under pressure"
+        assert enabled_obs.metric(
+            "serving_prefix_evictions_total").value >= 1, \
+            "pool pressure did not evict from the index"
+        _pool_closed(eng)
+
+    @pytest.mark.slow  # tier-1 wall is saturated (ROADMAP housekeeping)
+    def test_cap_trims_after_insert(self):
+        model = _model()
+        eng = _engine(model, prefix_cache=True, prefix_cache_blocks=1)
+        p = np.arange(1, 17, dtype=np.int32)
+        eng.add_request(p, max_new_tokens=4)
+        eng.run()
+        assert len(eng._prefix) <= 1, "prefix_cache_blocks cap ignored"
+        _pool_closed(eng)
+
+
+class TestMeshHandoff:
+    @pytest.mark.slow  # tier-1 wall is saturated (ROADMAP housekeeping)
+    def test_manifest_marks_shared_blocks_and_stream_survives(self):
+        """The export_kv manifest of a warm-hit stream carries
+        prefix_matched_tokens / prefix_shared_blocks, and the record
+        imports into a decode engine whose stream finishes exactly."""
+        from paddle_tpu.inference.mesh.handoff import hand_off
+        model = _model()
+        rs = np.random.RandomState(17)
+        p = np.concatenate([rs.randint(1, 128, (16,)),
+                            rs.randint(1, 128, (5,))])
+        ref = _dense_reference(model, p, 8)
+        src = _engine(model, prefix_cache=True)
+        records = []
+        # cold pass warms the index (insert runs before the sink export)
+        src.prefill_sink = records.append
+        src.add_request(p, max_new_tokens=8)
+        while not records:
+            src.step()
+        assert records[0]["prefix_matched_tokens"] == 0
+        # warm pass: admission resolves the 16-token head
+        src.add_request(p, max_new_tokens=8)
+        while len(records) < 2:
+            src.step()
+        warm = records[1]
+        assert warm["prefix_matched_tokens"] == 16
+        assert warm["prefix_shared_blocks"] >= 2
+        _pool_closed(src)
+        dst = _engine(model)
+        local_rid, nbytes, _retries = hand_off(warm, dst)
+        assert nbytes > 0
+        out = dst.run()
+        assert out[local_rid] == ref, \
+            "handed-off shared-block stream diverged"
+        assert dst.pool.tables == {}, "decode pool blocks leaked"
+
+    @pytest.mark.slow  # full 2-replica mesh + mid-run kill (~20s)
+    def test_kill_failover_closes_refcounts(self):
+        """Kill a replica mid-run on a shared-prefix mix: survivors
+        re-prefill the streams byte-identically and every replica's
+        pool closes (index pins are the only remaining references)."""
+        from paddle_tpu.inference.mesh import MeshRouter, ReplicaPool
+        holder = {}
+
+        def factory():
+            model = _model()
+            holder.setdefault("model", model)
+            return _engine(model, prefix_cache=True, num_blocks=64,
+                           max_batch=2)
+
+        pool = ReplicaPool(factory, n=2, store_port=46918)
+        router = MeshRouter(pool)
+        prompts = _shared_mix(seed=19)
+        refs = [_dense_reference(holder["model"], p, 8) for p in prompts]
+        rids = [router.add_request(p, max_new_tokens=8) for p in prompts]
+        for _ in range(3):
+            router.step()
+        router.kill_replica(pool.alive()[0].name, why="test")
+        out = router.run()
+        for rid, ref in zip(rids, refs):
+            assert out.get(rid) == ref, \
+                "re-routed shared-prefix stream diverged"
+        for rep in pool.alive():
+            _pool_closed(rep.engine)

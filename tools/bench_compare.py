@@ -54,6 +54,14 @@ KEYS = {
         ("detail.secondary_cpu_fallback.decode_int8_tokens_per_s",), "up"),
     "decode_prefill_ms": (
         ("detail.secondary_cpu_fallback.decode_prefill_ms",), "down"),
+    # round 18: prefix-cache A/B — warm tok/s and the cold/warm
+    # prefill-token reduction must not regress across rounds
+    "prefix_warm_tokens_per_s": (
+        ("detail.secondary_cpu_fallback.engine_prefix_ab.warm.tokens_per_s",),
+        "up"),
+    "prefix_token_reduction": (
+        ("detail.secondary_cpu_fallback.engine_prefix_ab"
+         ".prefill_token_reduction",), "up"),
 }
 
 # Headline train metrics are DEVICE-DEPENDENT (the trajectory mixes

@@ -271,6 +271,45 @@ def drill_serve_kv_dequant(tmp):
                         "decode recompiled and the request completed")
 
 
+def drill_serve_prefix_match(tmp):
+    model, eng = _tiny_engine(prefix_cache=True)
+    p = (np.arange(20) * 11) % 128   # 2 full blocks + a 4-token tail
+    ref = _dense_ref(model, p, 6)
+    # cold request: a miss that populates the index (2 shared blocks)
+    rid0 = eng.add_request(p, max_new_tokens=6)
+    _expect(eng.run()[rid0] == ref, "cold prefix-cache stream diverged")
+    _expect(len(eng._prefix) == 2, "prompt blocks not indexed after "
+                                   "prefill")
+    hits0 = _counter("serving_prefix_hits_total")
+    deg0 = _counter("serving_runtime_degradations_total",
+                    what="prefix_miss")
+    # fault the warm lookup: the index op degrades to a PLAIN MISS —
+    # full prefill, stream byte-identical, never a wrong hit
+    with faults.injected_faults("serve.prefix_match:1:TimeoutError"):
+        rid1 = eng.add_request(p, max_new_tokens=6)
+        out1 = eng.run()
+        inj = faults.injected_counts().get("serve.prefix_match", 0)
+    _expect(inj == 1, "fault never reached the prefix-match site")
+    _expect(out1[rid1] == ref, "degraded-to-miss stream diverged")
+    _expect(_counter("serving_prefix_hits_total") == hits0,
+            "faulted lookup counted as a hit")
+    _expect(_counter("serving_runtime_degradations_total",
+                     what="prefix_miss") - deg0 >= 1,
+            "prefix degradation not counted")
+    # fault cleared: the same prompt must hit the warm index again and
+    # skip the shared-block prefill, still byte-identical
+    rid2 = eng.add_request(p, max_new_tokens=6)
+    _expect(eng.run()[rid2] == ref, "warm prefix-cache stream diverged")
+    _expect(_counter("serving_prefix_hits_total") - hits0 >= 1,
+            "warm lookup did not hit after the fault cleared")
+    _expect(_counter("serving_prefix_tokens_saved_total") >= 16,
+            "prefill-token savings not counted")
+    _expect(eng.pool.tables == {}, "pool blocks leaked")
+    return "degraded", ("prefix-index fault degraded that lookup to a "
+                        "cache miss (full prefill, bytes exact); next "
+                        "admission hit the warm index again")
+
+
 def drill_serve_loadgen_tick(tmp):
     from paddle_tpu.inference import loadgen
     from paddle_tpu.profiler.phases import get_phase_accountant
@@ -703,6 +742,7 @@ SCENARIOS = {
     "serve.hostsync_read": drill_serve_hostsync_read,
     "serve.draft_verify": drill_serve_draft_verify,
     "serve.kv_dequant": drill_serve_kv_dequant,
+    "serve.prefix_match": drill_serve_prefix_match,
     "serve.loadgen_tick": drill_serve_loadgen_tick,
     "serve.sched_decide": drill_serve_sched_decide,
     "serve.preempt": drill_serve_preempt,

@@ -104,6 +104,16 @@ def main(argv=None):
     ap.add_argument("--min-acceptance", type=float, default=None,
                     help="with --check on a speculative run: fail unless "
                          "draft acceptance reaches this floor")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="enable the engine's cross-request prefix cache "
+                         "(copy-on-write paged-KV sharing keyed by "
+                         "prompt-prefix hash); implied by the "
+                         "shared_prefix scenario — the report gains a "
+                         "prefix block with hit_rate/tokens_saved")
+    ap.add_argument("--min-prefix-hit-rate", type=float, default=None,
+                    help="with --check on a prefix-cache run: fail unless "
+                         "the admission hit rate reaches this floor "
+                         "(default 0.5 for the shared_prefix scenario)")
     ap.add_argument("--replicas", type=int, default=1,
                     help="run N in-process engine replicas behind the "
                          "mesh router instead of one engine; the report "
@@ -134,6 +144,9 @@ def main(argv=None):
     obs.enable()
     get_phase_accountant().enabled = True
     kw = {}
+    prefix_on = args.prefix_cache or args.scenario == "shared_prefix"
+    if prefix_on:
+        kw["prefix_cache"] = True
     if args.speculative:
         from paddle_tpu.inference import drafting
         kw["speculative_decode"] = True
@@ -179,6 +192,14 @@ def main(argv=None):
           f"ttft_p95={report['ttft']['p95']} slo={slo_state} "
           f"coverage={cov if cov is None else round(cov, 4)}{spec_str}",
           file=sys.stderr)
+    pfx = report.get("prefix")
+    if pfx:
+        print(f"# prefix: hit_rate={pfx['hit_rate']} "
+              f"({pfx['hits']}/{pfx['hits'] + pfx['misses']}) "
+              f"tokens_saved={pfx['tokens_saved']} "
+              f"shared_blocks={pfx['shared_blocks']} "
+              f"evictions={pfx['evictions']} cow_forks={pfx['cow_forks']}",
+              file=sys.stderr)
     mesh = report.get("mesh")
     if mesh:
         print(f"# mesh: replicas={len(mesh['replicas'])} "
@@ -218,13 +239,22 @@ def main(argv=None):
                              if args.min_acceptance is not None else 0.0)
                             if args.speculative else None),
             require_timeseries=True,
-            require_autoscale=args.replicas > 1)
+            require_autoscale=args.replicas > 1,
+            min_prefix_hit_rate=(
+                args.min_prefix_hit_rate
+                if args.min_prefix_hit_rate is not None
+                else (0.5 if prefix_on
+                      and loadgen.SCENARIOS[args.scenario].shared_prefix_len
+                      else None)))
         for p in problems:
             print(f"CHECK FAIL: {p}", file=sys.stderr)
         if problems:
             return 1
         extra = "" if not spec else (
             f", per-scenario acceptance {spec['acceptance']}")
+        if pfx:
+            extra += (f", prefix hit_rate {pfx['hit_rate']} "
+                      f"({pfx['tokens_saved']} prefill tokens saved)")
         if args.replicas > 1:
             auto = (report.get("mesh") or {}).get("autoscale") or {}
             extra += (f", autoscale {auto.get('action')} -> "

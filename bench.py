@@ -546,6 +546,13 @@ def _bench_decode(on_tpu):
             out["engine_slo_error"] = f"{type(e).__name__}: {str(e)[:200]}"
     except Exception as e:  # noqa: BLE001 — serving leg must not sink decode
         out["engine_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+    # round 19: auto-fusion A/B (fuse pass on/off over a llama-block
+    # train step + a fused-decode step proxy); its own guard — the
+    # fusion evidence must not sink the decode rows, or vice versa
+    try:
+        out["fusion_ab"] = _bench_fusion_ab()
+    except Exception as e:  # noqa: BLE001
+        out["fusion_ab_error"] = f"{type(e).__name__}: {str(e)[:200]}"
     return out
 
 
@@ -623,6 +630,106 @@ def _bench_engine_prefix(model, cfg, batch):
             warm["tokens_per_s"] / max(cold["tokens_per_s"], 1e-9), 2),
         "greedy_parity": parity,
     }
+
+
+def _bench_fusion_ab():
+    """Round-19 auto-fusion A/B: two programs — a llama-block train
+    step (rmsnorm + attention + gelu-MLP + residuals, fwd + weight
+    grads) and a fused-decode step proxy (block fwd + final rmsnorm +
+    logits matmul + softmax/argmax tail) — compiled through the PIR
+    pipeline with the fuse pass on and off. Records committed groups,
+    predicted bytes saved, and the warm wall ratio. Gate (CPU proxy,
+    where XLA already fuses aggressively so the win is mostly
+    predicted, not walled): fused <= 1.05x unfused and >= 1 committed
+    group per program with bytes saved > 0."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.framework import flags as _flags
+    from paddle_tpu.pir.pipeline import compile_flat
+
+    rng = np.random.RandomState(0)
+    S, D, F, V = 64, 128, 256, 512
+    scale = np.float32(1.0 / np.sqrt(D))   # float32: a python-float
+    # closure would capture a float64 constant the verifier rejects
+
+    def rms(x, g):
+        var = jnp.mean(x * x, axis=-1, keepdims=True)
+        return x * jax.lax.rsqrt(var + 1e-6) * g
+
+    def block(x, wq, wk, wv, wo, w1, w2, g1, g2):
+        h = rms(x, g1)
+        q, k, v = h @ wq, h @ wk, h @ wv
+        a = jax.nn.softmax((q @ k.T) * scale, axis=-1)
+        x = x + (a @ v) @ wo
+        h = rms(x, g2)
+        return x + jax.nn.gelu(h @ w1, approximate=False) @ w2
+
+    p = [jnp.asarray(rng.randn(D, D) * 0.05, jnp.float32)
+         for _ in range(4)]
+    p += [jnp.asarray(rng.randn(D, F) * 0.05, jnp.float32),
+          jnp.asarray(rng.randn(F, D) * 0.05, jnp.float32),
+          jnp.asarray(rng.rand(D), jnp.float32),
+          jnp.asarray(rng.rand(D), jnp.float32)]
+    x = jnp.asarray(rng.randn(S, D), jnp.float32)
+    we = jnp.asarray(rng.randn(D, V) * 0.05, jnp.float32)
+    gf = jnp.asarray(rng.rand(D), jnp.float32)
+
+    def llama_step(x_, *params):
+        def loss(ps):
+            out = block(x_, *ps)
+            return jnp.mean(out * out)
+        l, gs = jax.value_and_grad(loss)(tuple(params))
+        return (l, *gs)
+
+    def fused_decode(x_, we_, gf_, *params):
+        h = rms(block(x_, *params), gf_)
+        logits = h[-1:] @ we_
+        probs = jax.nn.softmax(logits, axis=-1)
+        return (jnp.argmax(probs, axis=-1), jnp.max(probs, axis=-1))
+
+    programs = {
+        "llama_step": (llama_step, [x, *p]),
+        "fused_decode": (fused_decode, [x, we, gf, *p]),
+    }
+    prev = _flags.flag_value("pir_passes")
+    no_fuse = ",".join(s for s in prev.split(",") if s.strip() != "fuse")
+    out = {"programs": {}}
+    try:
+        for name, (fn, args) in programs.items():
+            _flags.set_flags({"pir_passes": no_fuse})
+            off_fn, off_rep = compile_flat(fn, args,
+                                           name=f"fusion_{name}_off")
+            t_off, want = _time_jitted(off_fn, args)
+            _flags.set_flags({"pir_passes": prev})
+            on_fn, on_rep = compile_flat(fn, args, name=f"fusion_{name}")
+            t_on, got = _time_jitted(on_fn, args)
+            ok = all(np.allclose(np.asarray(w), np.asarray(g),
+                                 rtol=2e-5, atol=2e-6)
+                     for w, g in zip(want, got))
+            ratio = t_on / max(t_off, 1e-9)
+            out["programs"][name] = {
+                "unfused_s": round(t_off, 6),
+                "fused_s": round(t_on, 6),
+                "wall_ratio": round(ratio, 3),
+                "fusion_groups": on_rep.fusion_groups,
+                "predicted_bytes_saved": on_rep.fusion_bytes_saved,
+                "fallback": on_rep.fallback or off_rep.fallback,
+                "numerics_ok": bool(ok),
+                "gate_ok": bool(ok and on_rep.fusion_groups >= 1
+                                and on_rep.fusion_bytes_saved > 0
+                                and ratio <= 1.05),
+            }
+    finally:
+        _flags.set_flags({"pir_passes": prev})
+    rows = out["programs"].values()
+    out["fusion_groups_total"] = sum(r["fusion_groups"] for r in rows)
+    out["predicted_bytes_saved_total"] = sum(
+        r["predicted_bytes_saved"] for r in rows)
+    out["max_wall_ratio"] = max(r["wall_ratio"] for r in rows)
+    out["gate_ok"] = all(r["gate_ok"] for r in rows)
+    return out
 
 
 def _bench_engine_config(model, cfg, prompt, new, batch, decode_steps,

@@ -117,7 +117,7 @@ define_flag("jit_cache_dir", "", "persistent XLA compilation cache directory (''
 define_flag("jit_donate_buffers", True, "donate param/opt buffers in compiled train steps")
 # PIR-lite compiler layer (paddle_tpu/pir/; ref: paddle/pir + FLAGS_enable_pir_api)
 define_flag("pir", True, "route to_static/serving compilation through the PIR pass pipeline (ref FLAGS_enable_pir_api); off = plain jax.jit")
-define_flag("pir_passes", "fold,cse,pattern,dce,shard_search,shard_prop,overlap", "ordered comma list of PIR passes to run (registered: dce,fold,cse,pattern,shard_search,shard_prop,overlap); each individually toggleable by omission. The three sharding passes no-op outside a shard_prop.mesh_scope / without input annotations, so the single-chip path is unchanged")
+define_flag("pir_passes", "fold,cse,pattern,fuse,dce,shard_search,shard_prop,overlap", "ordered comma list of PIR passes to run (registered: dce,fold,cse,pattern,fuse,shard_search,shard_prop,overlap); each individually toggleable by omission. The three sharding passes no-op outside a shard_prop.mesh_scope / without input annotations, so the single-chip path is unchanged; fuse runs after pattern (never crosses pt.* boundaries) and before dce (which reaps duplicated layout ops)")
 define_flag("pir_verify", "boundary", "structural IR verifier (pir/verifier.py): off | boundary (after capture + after the final pass) | on (after capture + after every pass; tests/tools). A rejection degrades the compile to plain jax.jit, counted pir_fallback_total{stage=verify}")
 define_flag("compile_cache_dir", "", "persistent PIR compile-cache directory ('' = off): sha256-verified StableHLO artifacts keyed by canonical IR hash + sharding + flags + jax version")
 define_flag("compile_cache_max_bytes", 1 << 28, "PIR compile-cache size cap; least-recently-read artifacts are evicted past it")

@@ -280,7 +280,7 @@ CATALOG = {
         "rewritten), by pass", ("pass",), None),
     "pir_fallback_total": (
         "counter", "pipeline degradations to plain jax.jit, by stage "
-        "(capture/verify/passes/evaluator)", ("stage",), None),
+        "(capture/verify/fuse/passes/evaluator)", ("stage",), None),
     "pir_verify_seconds": (
         "histogram", "wall time of one structural verifier run over a "
         "captured program (pir/verifier.py; after capture and after "
@@ -335,6 +335,18 @@ CATALOG = {
         "program after the collective-overlap pass committed a "
         "schedule (pir/overlap.py; comm the overlap credit did not "
         "hide)", ("program",), None),
+    "pir_fusion_groups_total": (
+        "counter", "pt.fused_region groups committed by the auto-fusion "
+        "pass (pir/fuse.py), by program — each group passed the strict "
+        "predicted bytes-traffic-decrease criterion", ("program",), None),
+    "pir_fusion_bytes_saved": (
+        "counter", "predicted HBM bytes-traffic saved by committed "
+        "fusion groups (CostModel.group_bytes_saved: unfused member "
+        "traffic minus fused boundary traffic), by program",
+        ("program",), None),
+    "pir_fuse_seconds": (
+        "histogram", "wall time of one auto-fusion pass run (planning "
+        "walk + group commits; pir/fuse.py)", (), _STEP_BUCKETS),
 
     # -- telemetry loop (tracing ring, flight recorder, SLO engine) ----------
     "tracer_dropped_spans_total": (

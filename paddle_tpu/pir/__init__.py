@@ -28,6 +28,8 @@ from .analysis import (DataflowAnalysis, FlatLattice, Lattice, Liveness,
 from .cache import (CompileCache, CompileCacheCorruptionError, cache_key,
                     default_cache, stats_snapshot)
 from .capture import capture, from_closed_jaxpr
+from .fuse import (FUSIBLE_ELEMENTWISE, FUSIBLE_LAYOUT, FUSIBLE_REDUCE,
+                   FusionPass, FusionPassError)
 from .ir import Operation, Program, Value
 from .mutate import CORRUPTIONS, SkipCorruption, corrupt
 from .passes import (CommonSubexprElimination, ConstantFolding,
@@ -45,6 +47,8 @@ __all__ = [
     "DeadCodeElimination", "ConstantFolding", "CommonSubexprElimination",
     "RewritePattern", "PatternRewriter", "SdpaRoutePattern",
     "RmsEpiloguePattern",
+    "FusionPass", "FusionPassError", "FUSIBLE_ELEMENTWISE",
+    "FUSIBLE_LAYOUT", "FUSIBLE_REDUCE",
     "CompileCache", "CompileCacheCorruptionError", "cache_key",
     "default_cache", "stats_snapshot",
     "CompileReport", "compile_flat", "pir_jit",

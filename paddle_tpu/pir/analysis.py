@@ -516,6 +516,19 @@ class CostModel(DataflowAnalysis):
         facts[id(op)] = self._op_cost(op)
         return True
 
+    def group_bytes_saved(self, members, boundary_inputs, outputs):
+        """Predicted HBM bytes a fusion group saves: the unfused members'
+        summed operand+result traffic minus the fused op's boundary
+        traffic (each boundary input read once, each result written
+        once). Positive iff intermediates that used to round-trip HBM
+        now die inside the fused kernel — the fuse pass's strict commit
+        criterion. Duplicable members are excluded by the caller (their
+        traffic persists either way and cancels)."""
+        unfused = sum(self._op_cost(op).bytes for op in members)
+        fused = (self._value_bytes(boundary_inputs)
+                 + self._value_bytes(outputs))
+        return unfused - fused
+
     def analyze(self, prog: Program) -> ProgramCost:
         facts = self.run(prog)
         flops = sum(c.flops for c in facts.values())

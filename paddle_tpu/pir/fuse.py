@@ -1,0 +1,375 @@
+"""CINN-lite auto-fusion: cost-guided producer-consumer fusion.
+
+reference: paddle/cinn/ — the reference stack's fifth layer turns PIR
+subgraphs into fused kernels. Until this pass, the only fusions here
+were the two hand-written DRR patterns (sdpa, rms-epilogue); every
+other elementwise/broadcast/reduce chain paid a full HBM round-trip
+per op. This pass generalizes: it walks the captured program, grows
+producer-consumer groups of memory-bound ops, prices each candidate on
+the roofline CostModel, and commits a group only when the predicted
+HBM bytes-traffic strictly decreases — the commit criterion "Operator
+Fusion in XLA: Analysis and Evaluation" (PAPERS.md) identifies as the
+one that pays on memory-bound chains.
+
+Grouping (a dataflow walk over the analysis-engine users map):
+
+* A group grows upward from a single fusible ROOT op: a producer is
+  absorbed as an *internal* member when every user of every one of its
+  results is already inside the group (single-consumer discipline —
+  the intermediate dies inside the fused kernel), or as a *duplicable*
+  member when it is pure layout plumbing (broadcast/reshape/transpose/
+  convert) whose recompute is free: the original op stays in the
+  program for its external users and the group replays a private copy,
+  reading the producer's (never larger) inputs instead of its
+  materialized output. A later DCE sweep removes duplicables that lost
+  their last external user.
+* Fusible ops are elementwise math, layout plumbing, and reduces
+  (reduce epilogues terminate a chain; a reduce may also sit mid-group
+  when its consumers all fused). Never fusible: ops with jax effects
+  or a paged-KV ``attrs["effect"]`` stamp, ``pt.*`` fused dispatch ops
+  (fusion never crosses a routed-kernel boundary), ops carrying
+  nested jaxprs (scan/pjit/custom_* — the pass does not descend into
+  sub-jaxprs), and ops touching sharding-annotated values (fuse runs
+  before the sharding passes; annotated dataflow stays op-granular so
+  shard_search/shard_prop still see every conflict and propagation
+  frontier).
+* Groups are capped at ``MAX_GROUP_OPS`` members so fused bodies stay
+  CSE/cache-friendly, and a group needs >= 2 members — a singleton
+  saves nothing by construction.
+
+Commit criterion (strict): ``CostModel.group_bytes_saved`` compares
+the unfused members' summed operand+result traffic against the fused
+op's boundary traffic (each boundary input read once, each result
+written once; duplicable members cancel — they run either way).
+Compute-bound chains never qualify: dot_general/conv are not fusible,
+and a candidate whose intermediates all escape saves zero bytes and is
+refused.
+
+Each committed group becomes one ``pt.fused_region`` op whose callable
+binds the replayed sub-jaxpr through a single ``jax.jit(inline=True)``
+call under a ``jax.named_scope`` (profiler attribution:
+``pir.fuse.<program>.g<id>``). The op carries
+``attrs["fusion_group"]`` provenance — member op names and predicted
+bytes saved — which the printer shows, the canonical hash keys (fusion
+decisions change compile-cache keys automatically), and
+``CompileReport.summary()`` counts.
+
+Failure contract, same shape as every other pass:
+
+* per-group: any failure while building/validating one group (including
+  an injected ``compile.fuse`` fault) skips THAT group — its ops replay
+  unfused, every other group stays committed, the compile stays on the
+  PIR path;
+* whole-pass: a failure in the planning walk itself (or an injected
+  fault at the pass entry, hit 1) raises the typed ``FusionPassError``
+  and pipeline.compile_flat degrades that compile to plain ``jax.jit``,
+  counted ``pir_fallback_total{stage="fuse"}``.
+
+Every group is additionally verifier-gated twice: a pre-commit
+``jax.eval_shape`` of the fused body must re-derive exactly the
+stamped result types (the type-mismatch rule's check, run per group so
+a bad group falls back alone), and the full PR-9 rule wall runs after
+the pass under ``FLAGS_pir_verify``.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .ir import Operation, Program
+from .passes import Pass, PassResult
+
+__all__ = ["FusionPass", "FusionPassError", "FUSIBLE_ELEMENTWISE",
+           "FUSIBLE_LAYOUT", "FUSIBLE_REDUCE", "MAX_GROUP_OPS"]
+
+
+class FusionPassError(RuntimeError):
+    """The fuse pass failed wholesale (planning-walk bug or an injected
+    ``compile.fuse`` fault at the pass entry). compile_flat catches this
+    type and degrades that compile to plain jax.jit under
+    ``pir_fallback_total{stage="fuse"}`` — per-group failures never
+    raise it."""
+
+
+# elementwise math: one output element reads the aligned input elements
+# only — the memory-bound shapes whose intermediates a fused kernel
+# keeps in registers/VMEM
+FUSIBLE_ELEMENTWISE = frozenset({
+    "add", "sub", "mul", "div", "rem", "max", "min", "pow",
+    "integer_pow", "exp", "exp2", "expm1", "log", "log1p", "tanh",
+    "sin", "cos", "tan", "asin", "acos", "atan", "atan2", "sinh",
+    "cosh", "sqrt", "rsqrt", "cbrt", "logistic", "erf", "erf_inv",
+    "erfc", "abs", "neg", "sign", "floor", "ceil", "round", "clamp",
+    "square", "is_finite", "not", "and", "or", "xor", "shift_left",
+    "shift_right_logical", "shift_right_arithmetic", "eq", "ne", "lt",
+    "le", "gt", "ge", "select_n", "nextafter", "copy",
+})
+
+# layout/dtype plumbing: transparent to the math, free to recompute —
+# the duplicable set (absorbed even with external users, when the
+# replayed read is not wider than the materialized output)
+FUSIBLE_LAYOUT = frozenset({
+    "broadcast_in_dim", "reshape", "transpose", "convert_element_type",
+    "squeeze", "rev", "stop_gradient",
+})
+
+# reduce epilogues: an elementwise chain folding into a (much smaller)
+# reduced result fuses the chain's intermediates away; a reduce may
+# also sit mid-group (rmsnorm) when its consumers all fused
+FUSIBLE_REDUCE = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_and", "reduce_or",
+})
+
+_FUSIBLE = FUSIBLE_ELEMENTWISE | FUSIBLE_LAYOUT | FUSIBLE_REDUCE
+
+# group size cap: fused jaxprs past this stop being CSE/compile-cache
+# friendly (and the greedy walk's win saturates long before it)
+MAX_GROUP_OPS = 24
+
+# minimum members: a singleton group has identical boundary and member
+# traffic — structurally refused before pricing
+_MIN_GROUP_OPS = 2
+
+
+class _Group:
+    """One committed-candidate fusion group (planning output)."""
+
+    __slots__ = ("root", "internal", "dups", "members", "boundary",
+                 "outs", "bytes_saved")
+
+    def __init__(self, root, internal, dups, members, boundary, outs,
+                 bytes_saved):
+        self.root = root
+        self.internal = internal    # [Operation] removed by the splice
+        self.dups = dups            # [Operation] replayed, left in place
+        self.members = members      # internal + dups, program order
+        self.boundary = boundary    # [Value] fused-op operands
+        self.outs = outs            # [Value] fused-op results (root's)
+        self.bytes_saved = bytes_saved
+
+
+class FusionPass(Pass):
+    """Cost-guided producer-consumer auto-fusion (module docstring has
+    the full contract)."""
+
+    name = "fuse"
+
+    def __init__(self, cost_model=None):
+        if cost_model is None:
+            from .analysis import CostModel
+            cost_model = CostModel()
+        self.cost = cost_model
+
+    # -- fusibility ---------------------------------------------------------
+    @staticmethod
+    def _fusible(op: Operation) -> bool:
+        if op.eqn is None or op.fn is not None:
+            return False            # pt.* dispatch ops are walls
+        if op.name not in _FUSIBLE:
+            return False
+        if op.has_effects() or op.attrs.get("effect") is not None:
+            return False            # paged-KV order must stay visible
+        if any(v.sharding is not None
+               for vs in (op.inputs, op.outputs) for v in vs):
+            # fuse runs BEFORE shard_search/shard_prop: annotated
+            # dataflow stays op-granular so those passes still see every
+            # annotation conflict and propagation frontier. Only chains
+            # touching user-annotated inputs refuse — the (unannotated)
+            # rest of a sharded program fuses normally.
+            return False
+        from .analysis import _inner_jaxprs
+        if _inner_jaxprs(op.eqn.params):
+            return False            # no descent into sub-jaxprs
+        return True
+
+    @staticmethod
+    def _value_bytes(values) -> float:
+        from .analysis import CostModel as _CM
+        return _CM._value_bytes(values)
+
+    # -- planning (no mutation) ---------------------------------------------
+    def _plan(self, prog: Program) -> list:
+        users = prog.users()
+        index = {id(op): i for i, op in enumerate(prog.ops)}
+        claimed: set[int] = set()
+        plans = []
+        for root in reversed(prog.ops):
+            if id(root) in claimed or not self._fusible(root):
+                continue
+            g = self._grow(prog, root, users, claimed, index)
+            if g is None:
+                continue
+            # claim EVERY member — dups included. A dup stays in the
+            # program, but if a later-planned group were allowed to
+            # absorb it internally, that group would also internalize
+            # (and remove) the dup's producers, dangling this group's
+            # boundary reads of those producers' outputs.
+            claimed.update(id(op) for op in g.members)
+            plans.append(g)
+        plans.reverse()             # program order -> deterministic gids
+        return plans
+
+    def _grow(self, prog, root, users, claimed, index):
+        internal: dict[int, Operation] = {id(root): root}
+        dups: dict[int, Operation] = {}
+
+        def absorbable(p):
+            return (id(p) not in internal and id(p) not in dups
+                    and id(p) not in claimed and self._fusible(p))
+
+        changed = True
+        while changed and len(internal) + len(dups) < MAX_GROUP_OPS:
+            changed = False
+            frontier = list(internal.values()) + list(dups.values())
+            for op in frontier:
+                for v in op.inputs:
+                    p = v.op
+                    if p is None or not absorbable(p):
+                        continue
+                    if len(internal) + len(dups) >= MAX_GROUP_OPS:
+                        break
+                    if all(u is not None and id(u) in internal
+                           for o in p.outputs for u in users.get(o, ())):
+                        internal[id(p)] = p
+                        changed = True
+                    elif p.name in FUSIBLE_LAYOUT \
+                            and self._value_bytes(p.inputs) \
+                            <= self._value_bytes(p.outputs):
+                        # duplicable: replay privately, original stays
+                        # for its external users (DCE reaps it later if
+                        # they disappear). The byte guard keeps e.g. a
+                        # downcast's wide input off the fused boundary.
+                        dups[id(p)] = p
+                        changed = True
+
+        member_ids = set(internal) | set(dups)
+        if len(member_ids) < _MIN_GROUP_OPS:
+            return None
+        members = [op for op in prog.ops if id(op) in member_ids]
+        internal_ordered = [op for op in members if id(op) in internal]
+        dups_ordered = [op for op in members if id(op) in dups]
+        boundary, seen = [], set()
+        for op in members:
+            for v in op.inputs:
+                if v.op is not None and id(v.op) in member_ids:
+                    continue        # computed inside the replay
+                if id(v) not in seen:
+                    seen.add(id(v))
+                    boundary.append(v)
+        outs = list(root.outputs)
+        saved = self.cost.group_bytes_saved(internal_ordered, boundary,
+                                            outs)
+        if saved <= 0:
+            return None             # strict decrease or no commit
+        return _Group(root, internal_ordered, dups_ordered, members,
+                      boundary, outs, saved)
+
+    # -- commit (one mutation at the end; fallible work first) --------------
+    def _commit(self, prog: Program, gid: int, g: _Group) -> Operation:
+        import jax
+        boundary, outs, members = g.boundary, g.outs, g.members
+        out_ids = [id(v) for v in outs]
+
+        def fused_body(*args):
+            env = {}
+            for v, a in zip(boundary, args):
+                env[id(v)] = a
+            for op in members:
+                ins = [env[id(v)] for v in op.inputs]
+                for v, o in zip(op.outputs, op.evaluate(ins)):
+                    env[id(v)] = o
+            return tuple(env[i] for i in out_ids)
+
+        fused_body.__name__ = f"fused_region_g{gid}"
+        # one inlined jit call: the body lands in the outer XLA program
+        # as a single sub-jaxpr (no separate dispatch), named for the
+        # profiler
+        jitted = jax.jit(fused_body, inline=True)
+        scope = f"pir.fuse.{prog.name}.g{gid}"
+
+        def fn(*args):
+            with jax.named_scope(scope):
+                return jitted(*args)
+
+        fn.__name__ = f"fused_region_g{gid}"
+
+        # per-group verifier gate: the fused body must abstractly
+        # re-derive exactly the stamped result types (the type-mismatch
+        # rule's check, run NOW so a bad group falls back alone instead
+        # of costing the whole compile at the post-pass rule wall)
+        in_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype)
+                    for v in boundary]
+        derived = jax.eval_shape(lambda *a: fn(*a), *in_avals)
+        if len(derived) != len(outs):
+            raise RuntimeError(
+                f"fused group g{gid} derives {len(derived)} results, "
+                f"expected {len(outs)}")
+        for v, d in zip(outs, derived):
+            if (tuple(d.shape), str(d.dtype)) != (tuple(v.shape),
+                                                  str(v.dtype)):
+                raise RuntimeError(
+                    f"fused group g{gid} result %{v.vid} derives "
+                    f"{d.dtype}[{','.join(map(str, d.shape))}], stamped "
+                    f"{v.type_str}")
+
+        new_op = Operation(
+            "pt.fused_region", list(boundary), outs,
+            attrs={"fusion_group": {
+                "id": gid,
+                "ops": [op.name for op in members],
+                "bytes_saved": int(g.bytes_saved)}},
+            fn=fn)
+        prog.replace_region(g.internal, new_op)
+        return new_op
+
+    # -- the pass -----------------------------------------------------------
+    def run(self, prog: Program) -> PassResult:
+        from ..observability import span as _span
+        from ..observability.catalog import metric as _metric
+        from ..resilience.faults import fault_point
+        t0 = time.perf_counter()
+        committed = skipped = member_ops = 0
+        saved_total = 0.0
+        with _span("pir.fuse", program=prog.name, ops=len(prog.ops)):
+            try:
+                # hit 1 of the chaos seam: a fault HERE (or any planning
+                # bug) is a whole-pass failure -> stage="fuse" fallback
+                fault_point("compile.fuse", program=prog.name,
+                            where="pass")
+                plans = self._plan(prog)
+            except Exception as e:  # noqa: BLE001 — typed for the pipeline
+                raise FusionPassError(
+                    f"fuse planning failed for {prog.name!r}: "
+                    f"{type(e).__name__}: {e}") from e
+            for gid, g in enumerate(plans):
+                try:
+                    # hits 2..N+1: per-group seam — a fault here skips
+                    # THIS group only (its ops replay unfused)
+                    fault_point("compile.fuse", program=prog.name,
+                                group=gid)
+                    self._commit(prog, gid, g)
+                except Exception:  # noqa: BLE001 — per-group fallback:
+                    skipped += 1   # nothing was mutated for this group
+                    continue
+                committed += 1
+                member_ops += len(g.members)
+                saved_total += g.bytes_saved
+        dt = time.perf_counter() - t0
+        try:
+            _metric("pir_fuse_seconds").observe(dt)
+            if committed:
+                _metric("pir_fusion_groups_total",
+                        program=prog.name).inc(committed)
+                _metric("pir_fusion_bytes_saved",
+                        program=prog.name).inc(saved_total)
+        except Exception:  # noqa: BLE001 — metrics never cost a compile
+            pass
+        prog._fusion = {"groups": committed,
+                        "bytes_saved": int(saved_total),
+                        "skipped": skipped}
+        notes = (f"groups={committed} member_ops={member_ops} "
+                 f"bytes_saved={int(saved_total)}")
+        if skipped:
+            notes += f" skipped={skipped}"
+        return PassResult(committed, notes)

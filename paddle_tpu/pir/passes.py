@@ -11,7 +11,7 @@ born observable, same discipline as serving/train.
 
 Passes are individually toggleable through ``FLAGS_pir_passes`` (an
 ordered comma list; default
-"fold,cse,pattern,dce,shard_search,shard_prop,overlap").
+"fold,cse,pattern,fuse,dce,shard_search,shard_prop,overlap").
 """
 
 from __future__ import annotations
@@ -38,6 +38,7 @@ PASSES = {
     "fold": "constant folding (host-evaluates const subgraphs)",
     "cse": "common-subexpression elimination",
     "pattern": "DRR pattern rewriter (fused pt.* ops)",
+    "fuse": "cost-guided auto-fusion (pt.fused_region groups)",
     "dce": "dead code elimination",
     "shard_search": "cost-driven sharding search (argmin strategy)",
     "shard_prop": "GSPMD-style sharding propagation to fixpoint",
@@ -247,6 +248,7 @@ class CommonSubexprElimination(Pass):
 
 
 def _registry():
+    from .fuse import FusionPass
     from .overlap import CollectiveOverlap
     from .patterns import PatternRewriter
     from .shard_prop import ShardingPropagation
@@ -256,6 +258,7 @@ def _registry():
         "fold": ConstantFolding,
         "cse": CommonSubexprElimination,
         "pattern": PatternRewriter,
+        "fuse": FusionPass,
         "shard_search": ShardingSearch,
         "shard_prop": ShardingPropagation,
         "overlap": CollectiveOverlap,

@@ -25,6 +25,7 @@ import jax.export as _jax_export
 from .cache import (CompileCacheCorruptionError, _bump, _metric, cache_key,
                     default_cache)
 from .capture import capture
+from .fuse import FusionPassError
 from .passes import PassManager
 from .verifier import IRVerificationError, verify_mode, verify_program
 
@@ -37,7 +38,8 @@ class CompileReport:
 
     __slots__ = ("name", "key", "cache", "pass_report", "program",
                  "captured_ops", "final_ops", "pattern_counts", "fallback",
-                 "cost", "shard_decision", "shard_predicted_s")
+                 "cost", "shard_decision", "shard_predicted_s",
+                 "fusion_groups", "fusion_bytes_saved")
 
     def __init__(self, name):
         self.name = name
@@ -52,6 +54,8 @@ class CompileReport:
         self.cost = None            # analysis.ProgramCost of the final IR
         self.shard_decision = None  # shard_search argmin (e.g. "dp+tp")
         self.shard_predicted_s = None
+        self.fusion_groups = 0      # pt.fused_region groups committed
+        self.fusion_bytes_saved = 0  # predicted HBM bytes saved by fuse
 
     def summary(self) -> dict:
         out = {"name": self.name, "cache": self.cache,
@@ -62,6 +66,8 @@ class CompileReport:
                               "seconds": round(v["seconds"], 6)}
                           for k, v in self.pass_report.items()},
                "cost": self.cost.summary() if self.cost else None,
+               "fusion_groups": self.fusion_groups,
+               "fusion_bytes_saved": self.fusion_bytes_saved,
                "fallback": self.fallback}
         if self.shard_decision is not None:
             out["shard_decision"] = self.shard_decision
@@ -157,6 +163,15 @@ def compile_flat(flat_fn: Callable, flat_args: list, *, name: str,
         if decision is not None:
             report.shard_decision = decision["decision"]
             report.shard_predicted_s = decision["predicted_seconds"]
+        fusion = getattr(prog, "_fusion", None)
+        if fusion is not None:
+            report.fusion_groups = fusion["groups"]
+            report.fusion_bytes_saved = fusion["bytes_saved"]
+    except FusionPassError as e:
+        # the fuse pass failed wholesale (planning walk, not one group):
+        # distinct stage so fusion regressions are separable from other
+        # pass crashes on dashboards and in the chaos drill
+        return _fallback(flat_fn, donate_argnums, report, "fuse", e)
     except IRVerificationError as e:
         # a pass produced a malformed program: the verifier caught it
         # before the evaluator could compile it — distinct stage so the

@@ -98,6 +98,13 @@ FAULT_SITES = {
                       "verifier-error rule and the compile degrades to "
                       "plain jax.jit, counted "
                       "pir_fallback_total{stage=verify}",
+    "compile.fuse": "PIR auto-fusion pass (pir/fuse.py): hit 1 is the "
+                    "planning walk (failure degrades that compile to "
+                    "plain jax.jit with identical numerics, counted "
+                    "pir_fallback_total{stage=fuse}); hits 2+ are "
+                    "per-group commits (failure skips THAT group — its "
+                    "ops replay unfused, every other group stays "
+                    "committed, no fallback)",
     "compile.shard_prop": "PIR sharding-propagation pass entry "
                           "(pir/shard_prop.py): an injected fault "
                           "aborts the pass pipeline and the compile "

@@ -26,9 +26,14 @@ def _run_worker(args, timeout=600):
 
 
 class TestBenchWorkers:
+    @pytest.mark.slow
     def test_secondary_models_cpu(self):
         """BASELINE rows 2-3: ResNet images/sec + BERT tokens/s emitted in
-        one secondary detail dict, with no error field."""
+        one secondary detail dict, with no error field.
+
+        ~45s on one CPU (two full model compiles in a subprocess); out of
+        tier-1's wall budget — test_llama_cpu_smoke keeps the worker JSON
+        contract covered there."""
         obj = _run_worker(["--secondary", "both", "--cpu"])
         assert obj["metric"] == "secondary_models"
         d = obj["detail"]

@@ -1,0 +1,398 @@
+"""Auto-fusion pass (pir/fuse.py): golden group formation, the strict
+bytes-decrease commit criterion, fusion walls (effect ops, pt.*
+dispatch), the per-group/whole-pass failure contract, cache-key
+sensitivity, and serving-stream parity with fusion on vs off.
+
+reference test pattern: paddle/cinn op-fusion unit tests — group
+membership is pinned exactly (golden member lists), and every fused
+program is also pinned byte-identical against its unfused twin on the
+same seed (fusion may regroup, never renumber, the math).
+"""
+
+import os
+import subprocess
+import sys
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import pir
+from paddle_tpu import observability as obs
+from paddle_tpu.framework import flags as _flags
+from paddle_tpu.pir.fuse import FusionPass
+from paddle_tpu.pir.passes import (CommonSubexprElimination,
+                                   ConstantFolding)
+from paddle_tpu.pir.patterns import PatternRewriter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_DEFAULT_PASSES = "fold,cse,pattern,fuse,dce,shard_search,shard_prop,overlap"
+_NO_FUSE_PASSES = ",".join(p for p in _DEFAULT_PASSES.split(",")
+                           if p != "fuse")
+
+
+def _counter(name, **labels):
+    fam = obs.get_registry().get(name)
+    if fam is None:
+        return 0.0
+    return (fam.labels(**labels) if labels else fam).value
+
+
+@contextmanager
+def _passes(value):
+    prev = _flags.flag_value("pir_passes")
+    paddle.set_flags({"pir_passes": value})
+    try:
+        yield
+    finally:
+        paddle.set_flags({"pir_passes": prev})
+
+
+@pytest.fixture
+def cache_dir(tmp_path):
+    d = str(tmp_path / "pirc")
+    prev = _flags.flag_value("compile_cache_dir")
+    paddle.set_flags({"compile_cache_dir": d})
+    yield d
+    paddle.set_flags({"compile_cache_dir": prev})
+
+
+@pytest.fixture
+def enabled_obs():
+    obs.get_registry().reset()
+    obs.enable()
+    yield
+    obs.disable()
+
+
+def _fused_mlp():
+    """The ir_dump fused_mlp example, replicated: gelu-MLP with residual
+    + rmsnorm tail (same seed — the golden groups below are ITS groups)."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8, 32), jnp.float32)
+    w1 = jnp.asarray(rng.randn(32, 64) * 0.1, jnp.float32)
+    w2 = jnp.asarray(rng.randn(64, 32) * 0.1, jnp.float32)
+    g = jnp.asarray(rng.rand(32), jnp.float32)
+
+    def fn(x_, w1_, w2_, g_):
+        h = jax.nn.gelu(x_ @ w1_, approximate=False)
+        y = h @ w2_ + x_
+        var = jnp.mean(y * y, axis=-1, keepdims=True)
+        out = y * jax.lax.rsqrt(var + 1e-6) * g_
+        return (out,)
+
+    return fn, [x, w1, w2, g]
+
+
+def _pre_fuse_program(fn, args, name):
+    """Capture and run the passes that precede fuse in the default
+    pipeline, so group formation is tested on what fuse actually sees."""
+    prog, _ = pir.capture(fn, *args, name=name)
+    for p in (ConstantFolding(), CommonSubexprElimination(),
+              PatternRewriter()):
+        p.run(prog)
+    return prog
+
+
+def _groups(prog):
+    """[(member-name list, bytes_saved)] for committed groups, gid order."""
+    out = []
+    for op in prog.ops:
+        if op.name == "pt.fused_region":
+            fg = op.attrs["fusion_group"]
+            out.append((fg["ops"], fg["bytes_saved"]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# golden group formation
+# ---------------------------------------------------------------------------
+
+class TestGoldenGroups:
+    def test_fused_mlp_exact_member_sets(self):
+        fn, args = _fused_mlp()
+        prog = _pre_fuse_program(fn, args, "fused_mlp")
+        n_before = prog.num_ops()
+        res = FusionPass().run(prog)
+        assert res.edits == 2, res.notes
+        groups = _groups(prog)
+        # g0: the erf-gelu chain between the matmuls; g1: the residual
+        # + rmsnorm epilogue. Exact membership — a planner change that
+        # regroups must retake these goldens deliberately.
+        assert groups == [
+            (["mul", "neg", "mul", "erfc", "mul", "copy"], 22528),
+            (["add", "mul", "reduce_sum", "broadcast_in_dim", "div",
+              "add", "rsqrt", "mul", "broadcast_in_dim", "mul"], 8768),
+        ], groups
+        assert prog._fusion == {"groups": 2, "bytes_saved": 31296,
+                                "skipped": 0}
+        # 16 members collapsed into 2 fused ops; both matmuls survive
+        assert prog.num_ops() == n_before - 16 + 2
+        assert sum(1 for op in prog.ops if op.name == "dot_general") == 2
+        # numerics: the fused program replays byte-identical to eager
+        got = np.asarray(prog.bind(*args)[0])
+        assert np.array_equal(got, np.asarray(fn(*args)[0]))
+
+    def test_printer_shows_provenance(self):
+        fn, args = _fused_mlp()
+        prog = _pre_fuse_program(fn, args, "fused_mlp")
+        FusionPass().run(prog)
+        text = prog.to_string()
+        assert "pt.fused_region" in text
+        assert "fusion_group" in text and "bytes_saved" in text
+
+    def test_compile_report_counts_groups(self, cache_dir):
+        fn, args = _fused_mlp()
+        with _passes(_DEFAULT_PASSES):
+            _, report = pir.compile_flat(fn, args, name="fused_mlp")
+        assert report.fallback is None
+        assert report.fusion_groups == 2
+        assert report.fusion_bytes_saved == 31296
+        s = report.summary()
+        assert s["fusion_groups"] == 2
+        assert s["fusion_bytes_saved"] == 31296
+
+
+# ---------------------------------------------------------------------------
+# numerics: fused vs unfused twins
+# ---------------------------------------------------------------------------
+
+class TestNumerics:
+    def test_forward_byte_identical_fuse_on_off(self, cache_dir):
+        fn, args = _fused_mlp()
+        with _passes(_NO_FUSE_PASSES):
+            f_off, r_off = pir.compile_flat(fn, args, name="ab")
+            ref = np.asarray(f_off(*args)[0])
+        with _passes(_DEFAULT_PASSES):
+            f_on, r_on = pir.compile_flat(fn, args, name="ab")
+        assert r_off.fusion_groups == 0 and r_on.fusion_groups == 2
+        assert np.array_equal(np.asarray(f_on(*args)[0]), ref)
+
+    def test_grad_through_warm_cache_hit(self, cache_dir):
+        # differentiating THROUGH the fused regions (warm artifact) must
+        # match the unfused compiled twin bit-for-bit — fusion regroups
+        # the ops, it never renumbers the math (eager is only ~1-ulp
+        # close: capture replay reassociates mean(), fused or not)
+        fn, args = _fused_mlp()
+        with _passes(_NO_FUSE_PASSES):
+            f_off, _ = pir.compile_flat(fn, args, name="g")
+        with _passes(_DEFAULT_PASSES):
+            pir.compile_flat(fn, args, name="g")
+            f2, r2 = pir.compile_flat(fn, args, name="g")
+        assert r2.cache == "hit"
+        g = jax.grad(lambda x: f2(x, *args[1:])[0].sum())(args[0])
+        ref = jax.grad(lambda x: f_off(x, *args[1:])[0].sum())(args[0])
+        assert np.array_equal(np.asarray(g), np.asarray(ref))
+        ref_e = jax.grad(lambda x: fn(x, *args[1:])[0].sum())(args[0])
+        np.testing.assert_allclose(np.asarray(g), np.asarray(ref_e),
+                                   rtol=2e-6, atol=2e-7)
+
+
+# ---------------------------------------------------------------------------
+# commit criterion: strict bytes decrease
+# ---------------------------------------------------------------------------
+
+class TestCommitCriterion:
+    def test_compute_bound_chain_refused(self):
+        def fn(x, y):
+            return ((x @ y) @ y,)
+
+        args = [jnp.ones((16, 16), jnp.float32),
+                jnp.eye(16, dtype=jnp.float32)]
+        prog = _pre_fuse_program(fn, args, "mm")
+        res = FusionPass().run(prog)
+        assert res.edits == 0
+        assert _groups(prog) == []
+
+    def test_escaping_intermediates_refused(self):
+        # every intermediate is also a program output: fusing saves no
+        # traffic (the boundary equals the member traffic) -> no commit
+        def fn(x):
+            a = x + 1.0
+            b = a * 2.0
+            return (a, b)
+
+        prog = _pre_fuse_program(fn, [jnp.ones((64, 64), jnp.float32)],
+                                 "escape")
+        res = FusionPass().run(prog)
+        assert res.edits == 0
+        assert _groups(prog) == []
+
+    def test_downcast_dup_guard(self):
+        # a convert with an external user is only duplicable when the
+        # replayed read is not wider than its output: an f32->bf16
+        # downcast (4 bytes in, 2 out) must stay OUT of the group and
+        # feed it as a boundary operand instead
+        def fn(x):
+            c = x.astype(jnp.bfloat16)
+            t = jnp.tanh(c) * jnp.bfloat16(2)
+            return (t, c)
+
+        prog = _pre_fuse_program(fn, [jnp.ones((64, 64), jnp.float32)],
+                                 "downcast")
+        FusionPass().run(prog)
+        for members, _saved in _groups(prog):
+            assert "convert_element_type" not in members
+        assert any(op.name == "convert_element_type" for op in prog.ops)
+
+
+# ---------------------------------------------------------------------------
+# fusion walls: effect ops and pt.* dispatch
+# ---------------------------------------------------------------------------
+
+class TestFusionWalls:
+    def test_no_fusion_across_effect_ops(self):
+        def fn(x):
+            a = jnp.tanh(x)
+            b = a * 2.0
+            c = b + 1.0
+            d = jnp.exp(c)
+            return (d,)
+
+        args = [jnp.ones((32, 32), jnp.float32)]
+        prog = _pre_fuse_program(fn, args, "eff")
+        mul = next(op for op in prog.ops if op.name == "mul")
+        # stamp the mul the way capture stamps a paged-KV op: fusion
+        # must treat it as a wall (its program order stays visible)
+        mul.attrs["effect"] = "kv.write"
+        mul.attrs["effect_seq"] = 0
+        FusionPass().run(prog)
+        assert any(op is mul for op in prog.ops)   # never absorbed
+        for members, _saved in _groups(prog):
+            assert "mul" not in members
+        got = np.asarray(prog.bind(*args)[0])
+        assert np.array_equal(got, np.asarray(fn(*args)[0]))
+
+    def test_no_fusion_across_pt_dispatch(self):
+        # after the DRR pattern routes attention to pt.sdpa, the fuse
+        # pass must leave the routed op alone (no group may contain or
+        # remove a pt.* dispatch boundary)
+        from tests.test_pir import _layer_flat, _tiny_llama_layer
+        layer, x = _tiny_llama_layer()
+        fn, flat = _layer_flat(layer, x)
+        prog = _pre_fuse_program(fn, flat, "llama_block")
+        assert any(op.name == "pt.sdpa" for op in prog.ops)
+        res = FusionPass().run(prog)
+        assert res.edits >= 1                    # the rest still fuses
+        assert sum(1 for op in prog.ops if op.name == "pt.sdpa") == 1
+        for members, _saved in _groups(prog):
+            assert not any(m.startswith("pt.") for m in members)
+        got = np.asarray(prog.bind(*flat)[0])
+        np.testing.assert_allclose(got, np.asarray(fn(*flat)[0]),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_sharding_annotated_values_refused(self):
+        def fn(x):
+            t = jnp.tanh(x)
+            return (t * 2.0,)
+
+        prog = _pre_fuse_program(fn, [jnp.ones((8, 8), jnp.float32)],
+                                 "annot")
+        tanh = next(op for op in prog.ops if op.name == "tanh")
+        tanh.outputs[0].sharding = ("dp", None)
+        res = FusionPass().run(prog)
+        assert res.edits == 0                    # chain touches the
+        assert _groups(prog) == []                 # annotated value
+
+
+# ---------------------------------------------------------------------------
+# failure contract
+# ---------------------------------------------------------------------------
+
+class TestFailureContract:
+    def test_per_group_fault_leaves_other_groups_fused(self, cache_dir):
+        from paddle_tpu.resilience.faults import injected_faults
+        fn, args = _fused_mlp()
+        with _passes(_NO_FUSE_PASSES):
+            f_off, _ = pir.compile_flat(fn, args, name="pg")
+            ref = np.asarray(f_off(*args)[0])
+        # hit 1 is the pass entry; hit 2 is group g0's commit seam
+        with _passes(_DEFAULT_PASSES), \
+                injected_faults("compile.fuse:2:RuntimeError"):
+            f, report = pir.compile_flat(fn, args, name="pg")
+        assert report.fallback is None             # PIR path kept
+        assert report.fusion_groups == 1           # g1 committed, g0 not
+        assert np.array_equal(np.asarray(f(*args)[0]), ref)
+
+    def test_whole_pass_fault_degrades_to_jit(self, cache_dir,
+                                              enabled_obs):
+        from paddle_tpu.resilience.faults import injected_faults
+        fn, args = _fused_mlp()
+        before = _counter("pir_fallback_total", stage="fuse")
+        with _passes(_DEFAULT_PASSES), \
+                injected_faults("compile.fuse:1:RuntimeError"):
+            f, report = pir.compile_flat(fn, args, name="wp")
+        assert report.fallback == "fuse"
+        assert report.fusion_groups == 0
+        assert _counter("pir_fallback_total", stage="fuse") == before + 1
+        got = np.asarray(f(*args)[0])
+        assert np.array_equal(got, np.asarray(fn(*args)[0]))
+
+
+# ---------------------------------------------------------------------------
+# cache-key sensitivity
+# ---------------------------------------------------------------------------
+
+class TestCacheKey:
+    def test_fuse_flag_changes_compile_key(self, cache_dir):
+        fn, args = _fused_mlp()
+        with _passes(_DEFAULT_PASSES):
+            _, r_on = pir.compile_flat(fn, args, name="k")
+        with _passes(_NO_FUSE_PASSES):
+            _, r_off = pir.compile_flat(fn, args, name="k")
+        assert r_on.cache == "miss" and r_off.cache == "miss"
+        assert r_on.key != r_off.key               # never cross-served
+        with _passes(_DEFAULT_PASSES):
+            _, r_on2 = pir.compile_flat(fn, args, name="k")
+        assert r_on2.cache == "hit" and r_on2.key == r_on.key
+
+
+# ---------------------------------------------------------------------------
+# verifier wall over every ir_dump example
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # ~40s: full example sweep under the rule wall
+def test_ir_dump_examples_verify_clean():
+    env = dict(os.environ, FLAGS_pir_verify="on", JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ir_dump.py"),
+         "--all", "--check"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"ir_dump --check failed:\n{r.stdout[-2000:]}"
+    assert "check OK" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# serving parity: greedy streams with fusion on vs off
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # ~30s: two engines, fresh compiles per flag setting
+def test_greedy_stream_byte_identical_fuse_on_off(tmp_path):
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from tests.test_serving_fused import _model
+    model = _model()
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, 128, (7,)), rs.randint(0, 128, (13,))]
+
+    def run():
+        eng = ContinuousBatchingEngine(model, num_blocks=64, block_size=8,
+                                       max_batch=4, prefill_buckets=(16,))
+        rids = [eng.add_request(p, max_new_tokens=9) for p in prompts]
+        out = eng.run()
+        return [out[r] for r in rids]
+
+    prev = _flags.flag_value("compile_cache_dir")
+    paddle.set_flags({"compile_cache_dir": str(tmp_path / "pirc")})
+    try:
+        with _passes(_NO_FUSE_PASSES):
+            base = run()
+        with _passes(_DEFAULT_PASSES):
+            fused = run()
+    finally:
+        paddle.set_flags({"compile_cache_dir": prev})
+    assert fused == base

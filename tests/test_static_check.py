@@ -88,19 +88,31 @@ def test_pir_passes_rule_catches_drift():
     sc = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(sc)
 
-    aligned = {"fold", "dce"}
-    assert sc.rule_pir_passes(SimpleNamespace(
-        pir_passes=aligned, pir_flag_default=set(aligned),
-        compiler_pass_rows=set(aligned))) == []
-    drifted = sc.rule_pir_passes(SimpleNamespace(
-        pir_passes=aligned | {"undocumented"},
-        pir_flag_default=aligned | {"unregistered"},
-        compiler_pass_rows=aligned - {"dce"}))
+    order = ["fold", "fuse", "dce"]
+    aligned = set(order)
+
+    def ctx(passes=aligned, flag=order, rows=order):
+        return SimpleNamespace(
+            pir_passes=passes, pir_flag_default=set(flag),
+            pir_flag_default_order=list(flag),
+            compiler_pass_rows=set(rows),
+            compiler_pass_row_order=list(rows))
+
+    assert sc.rule_pir_passes(ctx()) == []
+    drifted = sc.rule_pir_passes(ctx(
+        passes=aligned | {"undocumented"},
+        flag=order + ["unregistered"],
+        rows=["fold"]))
     msgs = " | ".join(v.message for v in drifted)
     # registry entry missing from both mirrors, phantom flag name,
-    # registry entry missing from the doc table: all directions fire
+    # registry entries missing from the doc table: all directions fire
     assert "'undocumented'" in msgs and "'unregistered'" in msgs \
-        and "'dce'" in msgs, msgs
+        and "'dce'" in msgs and "'fuse'" in msgs, msgs
+    # same SETS, doc rows reordered vs the flag default: the order pin
+    # fires (the pass-catalog table documents the real pipeline order)
+    reordered = sc.rule_pir_passes(ctx(rows=["fuse", "fold", "dce"]))
+    assert len(reordered) == 1 and "order" in reordered[0].message, \
+        reordered
 
 
 def test_recording_rules_rule_catches_drift():

@@ -62,6 +62,26 @@ KEYS = {
     "prefix_token_reduction": (
         ("detail.secondary_cpu_fallback.engine_prefix_ab"
          ".prefill_token_reduction",), "up"),
+    # round 19: auto-fusion A/B — committed groups and predicted bytes
+    # saved must not shrink, and the fused/unfused wall ratio must not
+    # grow (fusion may never slow the CPU proxy past its 1.05x gate)
+    "fusion_groups_total": (
+        ("detail.secondary_cpu_fallback.fusion_ab.fusion_groups_total",
+         "detail.secondary.fusion_ab.fusion_groups_total"), "up"),
+    "fusion_bytes_saved": (
+        ("detail.secondary_cpu_fallback.fusion_ab"
+         ".predicted_bytes_saved_total",
+         "detail.secondary.fusion_ab.predicted_bytes_saved_total"), "up"),
+    "fusion_llama_wall_ratio": (
+        ("detail.secondary_cpu_fallback.fusion_ab.programs.llama_step"
+         ".wall_ratio",
+         "detail.secondary.fusion_ab.programs.llama_step.wall_ratio"),
+        "down"),
+    "fusion_decode_wall_ratio": (
+        ("detail.secondary_cpu_fallback.fusion_ab.programs.fused_decode"
+         ".wall_ratio",
+         "detail.secondary.fusion_ab.programs.fused_decode.wall_ratio"),
+        "down"),
 }
 
 # Headline train metrics are DEVICE-DEPENDENT (the trajectory mixes

@@ -570,6 +570,74 @@ def drill_compile_shard_prop(tmp):
                         "numerics); next compile took the PIR path")
 
 
+def drill_compile_fuse(tmp):
+    """Auto-fusion pass faults, both blast radii: hit 1 (planning walk)
+    degrades the whole compile to plain jax.jit counted
+    pir_fallback_total{stage=fuse}; hit 2 (per-group commit) skips that
+    group only — the compile stays on the PIR path with the group's ops
+    replaying unfused. Both paths must be byte-identical vs fusion-off."""
+    from paddle_tpu.framework import flags as _flags
+    pir, fn, args, want, prev = _pir_compile_setup(tmp)
+    prev_passes = _flags.flag_value("pir_passes")
+    no_fuse = ",".join(p for p in prev_passes.split(",")
+                       if p.strip() != "fuse")
+    try:
+        # fusion-off reference: the byte-identity baseline for every leg
+        _flags.set_flags({"pir_passes": no_fuse})
+        off, rep_off = pir.compile_flat(fn, args, name="drill_fuse")
+        _expect(rep_off.fallback is None,
+                f"fusion-off reference degraded: {rep_off.fallback}")
+        ref = np.asarray(off(*args)[0])
+        _flags.set_flags({"pir_passes": prev_passes})
+
+        # per-group fault (hit 2): group skipped, compile NOT degraded
+        with faults.injected_faults("compile.fuse:2:RuntimeError"):
+            part, rep1 = pir.compile_flat(fn, args, name="drill_fuse")
+            inj1 = faults.injected_counts().get("compile.fuse", 0)
+        _expect(inj1 == 1, "fault never reached the per-group seam")
+        _expect(rep1.fallback is None,
+                f"per-group fault degraded the compile: {rep1.fallback}")
+        _expect(rep1.fusion_groups == 0,
+                f"skipped group still counted: {rep1.fusion_groups}")
+        got1 = np.asarray(part(*args)[0])
+        _expect(np.array_equal(got1, ref),
+                "per-group skip not byte-identical vs fusion-off")
+
+        # whole-pass fault (hit 1): compile degrades to plain jax.jit
+        with faults.injected_faults("compile.fuse:1:RuntimeError"):
+            plain, rep2 = pir.compile_flat(fn, args, name="drill_fuse")
+            inj2 = faults.injected_counts().get("compile.fuse", 0)
+        _expect(inj2 == 1, "fault never reached the fuse pass entry")
+        _expect(rep2.fallback == "fuse",
+                f"whole-pass fault not degraded: fallback={rep2.fallback}")
+        got2 = np.asarray(plain(*args)[0])
+        _expect(np.array_equal(got2, ref),
+                "stage=fuse fallback not byte-identical vs fusion-off")
+        _expect(_counter("pir_fallback_total", stage="fuse") >= 1,
+                "fuse fallback not counted")
+        _expect(_counter("fault_injected_total",
+                         site="compile.fuse") >= 2,
+                "injections not counted")
+
+        # with the fault gone the same program fuses on the PIR path
+        clean, rep3 = pir.compile_flat(fn, args, name="drill_fuse")
+        _expect(rep3.fallback is None,
+                f"still degraded after fault cleared: {rep3.fallback}")
+        _expect(rep3.fusion_groups >= 1,
+                f"no group committed on the clean retry: "
+                f"{rep3.fusion_groups}")
+        got3 = np.asarray(clean(*args)[0])
+        _expect(np.array_equal(got3, ref),
+                "fused program not byte-identical vs fusion-off")
+    finally:
+        _flags.set_flags({"compile_cache_dir": prev,
+                          "pir_passes": prev_passes})
+    return "degraded", ("per-group fault skipped the group (PIR path "
+                        "kept), whole-pass fault degraded to plain "
+                        "jax.jit counted stage=fuse; all legs "
+                        "byte-identical vs fusion-off")
+
+
 def _tiny_mesh(n=2, disaggregate=False, port=46180, **kw):
     """N-replica in-process mesh over _tiny_engine workers (identical
     weights: the factory reseeds per build). Returns (model, pool,
@@ -750,6 +818,7 @@ SCENARIOS = {
     "compile.cache_read": drill_compile_cache_read,
     "compile.cache_write": drill_compile_cache_write,
     "compile.verify": drill_compile_verify,
+    "compile.fuse": drill_compile_fuse,
     "compile.shard_prop": drill_compile_shard_prop,
     "mesh.route": drill_mesh_route,
     "mesh.kv_handoff": drill_mesh_kv_handoff,

@@ -117,6 +117,28 @@ def ex_sdpa_epilogue():
     return fn, [q, k, v, res, w]
 
 
+def ex_fused_mlp():
+    """Auto-fusion showcase: a gelu-MLP with residual + rmsnorm tail —
+    elementwise/reduce chains the hand-written DRR patterns can't
+    touch. The fuse pass should commit the erf-gelu chain between the
+    matmuls and the residual+rmsnorm epilogue as pt.fused_region
+    groups (the printed provenance shows members + predicted bytes)."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8, 32), jnp.float32)
+    w1 = jnp.asarray(rng.randn(32, 64) * 0.1, jnp.float32)
+    w2 = jnp.asarray(rng.randn(64, 32) * 0.1, jnp.float32)
+    g = jnp.asarray(rng.rand(32), jnp.float32)
+
+    def fn(x_, w1_, w2_, g_):
+        h = jax.nn.gelu(x_ @ w1_, approximate=False)
+        y = h @ w2_ + x_
+        var = jnp.mean(y * y, axis=-1, keepdims=True)
+        out = y * jax.lax.rsqrt(var + 1e-6) * g_
+        return (out,)
+
+    return fn, [x, w1, w2, g]
+
+
 def ex_sharded_mlp():
     """Annotated-input example for the sharding passes: inputs carry
     sparse mesh-axis specs and shard_prop must propagate them through
@@ -137,6 +159,7 @@ EXAMPLES = {
     "mlp": ex_mlp,
     "llama_block": ex_llama_block,
     "sdpa_epilogue": ex_sdpa_epilogue,
+    "fused_mlp": ex_fused_mlp,
     "sharded_mlp": ex_sharded_mlp,
 }
 
@@ -227,6 +250,11 @@ def _run_example_inner(name, fn, flat, eager, specs, diff, check):
     fused = [op.name for op in prog.ops if op.name.startswith("pt.")]
     if fused:
         print(f"  fused ops: {fused}")
+    for op in prog.ops:
+        fg = op.attrs.get("fusion_group")
+        if fg:
+            print(f"  fusion group g{fg['id']}: {len(fg['ops'])} ops "
+                  f"{fg['ops']} predicted_bytes_saved={fg['bytes_saved']}")
     if check and ok:
         print(f"  check OK: final program verifies and matches eager "
               f"on the fixed seed")

@@ -29,7 +29,9 @@ Rules (closed registry, like everything else here):
                        jax.device_get / .block_until_ready) in the
                        serving hot path outside the audited allowlist
   pir-passes           pir/passes.py PASSES == FLAGS_pir_passes
-                       default == COMPILER.md pass-catalog rows
+                       default == COMPILER.md pass-catalog rows, and
+                       the doc-table row ORDER == the flag default's
+                       pipeline order
   mesh-wiring          serving-mesh fault_point/check site and record()
                        kind literals ⊆ the closed registries; every
                        registered mesh.* site armed by mesh code AND
@@ -189,7 +191,8 @@ def _defined_flags():
 
 def _pir_flag_default():
     """The pass names in the FLAGS_pir_passes default — the comma list
-    in ``define_flag("pir_passes", "<literal>", ...)`` in flags.py."""
+    in ``define_flag("pir_passes", "<literal>", ...)`` in flags.py.
+    Returns the ORDERED list (the default IS the pipeline order)."""
     for node in ast.walk(_parse(FLAGS_PY)):
         if isinstance(node, ast.Call) and _callee(node) == "define_flag" \
                 and node.args \
@@ -198,7 +201,7 @@ def _pir_flag_default():
                 and len(node.args) > 1 \
                 and isinstance(node.args[1], ast.Constant) \
                 and isinstance(node.args[1].value, str):
-            return {n for n in node.args[1].value.split(",") if n}
+            return [n for n in node.args[1].value.split(",") if n]
     raise RuntimeError(
         f"{FLAGS_PY}: no define_flag('pir_passes', <string literal>, ...)")
 
@@ -206,13 +209,14 @@ def _pir_flag_default():
 def _compiler_pass_rows():
     """Backticked first-cell names of the COMPILER.md pass-catalog
     table rows, scoped to the '## Pass catalog' section (the next
-    '## ' heading ends it; '### ' sub-headings don't)."""
+    '## ' heading ends it; '### ' sub-headings don't). Returns the
+    ORDERED list (the table documents the default pipeline order)."""
     text = _read(COMPILER_MD)
     m = re.search(r"^## Pass catalog$(.*?)(?=^## |\Z)", text,
                   re.M | re.S)
     if not m:
         raise RuntimeError(f"{COMPILER_MD}: no '## Pass catalog' section")
-    return set(re.findall(r"^\| `([a-z_]+)` \|", m.group(1), re.M))
+    return re.findall(r"^\| `([a-z_]+)` \|", m.group(1), re.M)
 
 
 def _callee(call):
@@ -248,8 +252,10 @@ class Context:
         self.res_priority_rows = set(re.findall(
             r"^\| `priority/([a-z_]+)` \|", _read(RES_MD), re.M))
         self.pir_passes = _dict_keys(PASSES_PY, "PASSES")
-        self.pir_flag_default = _pir_flag_default()
-        self.compiler_pass_rows = _compiler_pass_rows()
+        self.pir_flag_default_order = _pir_flag_default()
+        self.pir_flag_default = set(self.pir_flag_default_order)
+        self.compiler_pass_row_order = _compiler_pass_rows()
+        self.compiler_pass_rows = set(self.compiler_pass_row_order)
         self.recording_rules = _dict_keys(TIMESERIES_PY, "RECORDING_RULES")
         self.obs_rule_rows = set(re.findall(r"^\| `rule/([a-z0-9_]+)` \|",
                                             _read(OBS_MD), re.M))
@@ -608,7 +614,10 @@ def rule_pir_passes(ctx):
     pass that shouldn't run by default must be *removed* deliberately,
     in both places) and the COMPILER.md pass-catalog table (every pass
     documented, nothing phantom documented). All pairwise, both
-    directions."""
+    directions — and ORDER-pinned: the COMPILER.md table rows must list
+    the flag default's pipeline order (the table documents the order
+    the passes actually run in; a reorder in one place without the
+    other is doc rot)."""
     out = []
     pairs = ((ctx.pir_flag_default, FLAGS_PY,
               "the FLAGS_pir_passes default"),
@@ -624,6 +633,13 @@ def rule_pir_passes(ctx):
                 "pir-passes", where, 0,
                 f"{desc} lists {name!r} which is not in "
                 f"{PASSES_PY} PASSES"))
+    if (not out
+            and ctx.compiler_pass_row_order != ctx.pir_flag_default_order):
+        out.append(Violation(
+            "pir-passes", COMPILER_MD, 0,
+            f"pass-catalog row order {ctx.compiler_pass_row_order} does "
+            f"not match the FLAGS_pir_passes default order "
+            f"{ctx.pir_flag_default_order}"))
     return out
 
 

@@ -191,7 +191,9 @@ class ElasticManager:
                     self._beat, op="elastic.heartbeat",
                     recovery_metric="elastic_heartbeat_recoveries_total")
             except Exception:  # noqa: BLE001 — store down past the retry
-                pass           # budget: keep beating, the lease may survive
+                # budget: keep beating, the lease may survive — counted,
+                # never raised into the owning replica's serving loop
+                _count("elastic_beat_failures_total")
 
     # -- membership decisions ------------------------------------------------
     def health(self):

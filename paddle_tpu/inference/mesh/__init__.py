@@ -1,7 +1,7 @@
-"""Disaggregated serving mesh (round 16).
+"""Disaggregated serving mesh (rounds 16 + 20).
 
 Turns the single-process ContinuousBatchingEngine into a cluster of
-in-process worker replicas:
+worker replicas — in-process or real child processes:
 
 - `replica.ReplicaPool` — N engine replicas (optionally TP-sharded via
   the PR-12 auto-parallel passes) with lease-based membership over
@@ -9,22 +9,40 @@ in-process worker replicas:
 - `handoff` — byte-exact serialized paged-KV transfer between prefill
   and decode workers, in the pool's raw block-storage format (native
   and int8/fp8 quantized alike), with retry-then-re-prefill semantics
-  at the `mesh.kv_handoff` fault site.
+  at the `mesh.kv_handoff` fault site. `hand_off_async` returns a
+  HandoffFuture so the transport copy overlaps the decode pump.
 - `router.MeshRouter` — the front door: DRR/priority admission over a
   mesh-wide view, headroom-ranked replica choice behind the
   `mesh.route` fault site and per-replica CircuitBreakers, at-most-once
   stream commit, and replica-failover re-prefill that keeps greedy
   streams byte-identical to a single-replica run.
+- `transport` — the versioned length-prefixed frame protocol
+  (`mesh.transport_send` fault site), EngineProxy mirroring the engine
+  duck-type over it, and ProcessReplicaPool running each replica as a
+  child process (`worker.py`) holding its own mesh lease.
+- `controller.MeshController` — consumes AutoscaleAdvisor verdicts and
+  ACTS: spawn + lease-register on scale_up, drain-before-tombstone on
+  scale_down; any failure latches it back to advisory-only
+  (`mesh.controller_act` fault site).
 
-Operational story: RESILIENCE.md "Mesh runbook"; metrics:
-OBSERVABILITY.md "serving mesh" rows.
+Operational story: RESILIENCE.md "Mesh runbook" + "Process mesh
+runbook"; metrics: OBSERVABILITY.md "serving mesh" rows.
 """
 
-from .handoff import (KVHandoffError, hand_off, pack_record,
-                      unpack_record, wire_size)
+from .controller import MeshController
+from .handoff import (HandoffFuture, KVHandoffError, hand_off,
+                      hand_off_async, pack_record, unpack_record,
+                      wire_size)
 from .replica import Replica, ReplicaPool, ROLES
 from .router import MeshRequest, MeshRouter
+from .transport import (EngineProxy, LoopbackClient, ProcessReplica,
+                        ProcessReplicaPool, SocketClient, TransportError,
+                        pack_frame, serve_request, unpack_frame)
 
-__all__ = ["KVHandoffError", "hand_off", "pack_record", "unpack_record",
-           "wire_size", "Replica", "ReplicaPool", "ROLES",
-           "MeshRequest", "MeshRouter"]
+__all__ = ["KVHandoffError", "hand_off", "hand_off_async",
+           "HandoffFuture", "pack_record", "unpack_record", "wire_size",
+           "Replica", "ReplicaPool", "ROLES", "MeshRequest",
+           "MeshRouter", "TransportError", "pack_frame", "unpack_frame",
+           "serve_request", "LoopbackClient", "SocketClient",
+           "EngineProxy", "ProcessReplica", "ProcessReplicaPool",
+           "MeshController"]

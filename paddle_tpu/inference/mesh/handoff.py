@@ -34,7 +34,7 @@ import numpy as np
 from ...resilience.faults import FaultInjected, fault_point
 
 __all__ = ["KVHandoffError", "pack_record", "unpack_record", "wire_size",
-           "hand_off"]
+           "hand_off", "hand_off_async", "HandoffFuture"]
 
 _TRANSIENT = (TimeoutError, ConnectionError, OSError, FaultInjected)
 
@@ -149,4 +149,108 @@ def hand_off(record, engine, retry=None):
     except (ValueError, MemoryError) as e:
         raise KVHandoffError(f"receiving engine rejected handoff: "
                              f"{e!r}") from e
+    except _TRANSIENT as e:
+        # a process-backed engine's import crosses the transport — its
+        # death mid-import is a failed transfer, not a rejection
+        raise KVHandoffError(f"handoff transfer failed: {e!r}") from e
     return rid, len(wire), retries
+
+
+class HandoffFuture:
+    """Delivery-complete handle for one asynchronous handoff. done() is
+    a non-blocking poll; result() forces completion and returns
+    (local_rid, wire_bytes, retries) or raises KVHandoffError with the
+    same cause classification as hand_off (rejection cause ValueError/
+    MemoryError -> caller tries the next target; transient cause ->
+    caller re-prefills)."""
+
+    __slots__ = ("_inner", "_nbytes", "_retries", "_resolved", "_value",
+                 "_exc")
+
+    def __init__(self, inner=None, nbytes=0, retries=0):
+        self._inner = inner     # the transport future, when remote
+        self._nbytes = int(nbytes)
+        self._retries = int(retries)
+        self._resolved = False
+        self._value = None
+        self._exc = None
+
+    def _complete(self, value):
+        self._resolved = True
+        self._value = value
+
+    def _fail(self, exc):
+        self._resolved = True
+        self._exc = exc
+
+    def _translate(self, force):
+        if self._resolved or self._inner is None:
+            return
+        if not force and not self._inner.done():
+            return
+        try:
+            out = self._inner.result()
+            if isinstance(out, tuple):      # transport (meta, payload)
+                out = out[0]["rid"]
+            self._complete((int(out), self._nbytes, self._retries))
+        except (ValueError, MemoryError) as e:
+            err = KVHandoffError(
+                f"receiving engine rejected handoff: {e!r}")
+            err.__cause__ = e
+            self._fail(err)
+        except _TRANSIENT as e:
+            err = KVHandoffError(f"handoff transfer failed: {e!r}")
+            err.__cause__ = e
+            self._fail(err)
+
+    def done(self):
+        if not self._resolved and self._inner is not None \
+                and self._inner.done():
+            self._translate(force=True)
+        return self._resolved
+
+    def result(self):
+        self._translate(force=True)
+        if not self._resolved:
+            raise KVHandoffError("handoff future never resolved")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+def hand_off_async(record, engine, retry=None):
+    """hand_off, asynchronously: the `mesh.kv_handoff` fault/retry
+    contract runs NOW (the site arms before bytes move, so a retried
+    pack never double-imports), the transport copy overlaps with the
+    caller's pump, and the returned HandoffFuture completes on
+    delivery. Engines without `import_kv_async` (in-process pools)
+    resolve synchronously through hand_off — behavior byte-identical to
+    every earlier round."""
+    importer = getattr(engine, "import_kv_async", None)
+    if importer is None:
+        fut = HandoffFuture()
+        try:
+            fut._complete(hand_off(record, engine, retry=retry))
+        except KVHandoffError as e:
+            fut._fail(e)
+        return fut
+
+    def _xfer():
+        fault_point("mesh.kv_handoff", trace=record.get("trace_id"))
+        return pack_record(record)
+
+    try:
+        if retry is not None:
+            wire = retry.call(_xfer, op="mesh.kv_handoff")
+            retries = retry.last_retries
+        else:
+            wire = _xfer()
+            retries = 0
+    except _TRANSIENT as e:
+        fut = HandoffFuture()
+        err = KVHandoffError(f"handoff transfer failed: {e!r}")
+        err.__cause__ = e
+        fut._fail(err)
+        return fut
+    return HandoffFuture(inner=importer(unpack_record(wire)),
+                         nbytes=len(wire), retries=retries)

@@ -35,7 +35,7 @@ class Replica:
     """One engine worker in the mesh: engine + role + breaker + the
     accounting the router balances and reports on."""
 
-    __slots__ = ("name", "engine", "role", "breaker", "alive",
+    __slots__ = ("name", "engine", "role", "breaker", "alive", "draining",
                  "routed", "step_seconds", "steps", "manager",
                  "finished_count", "tokens_out", "sampler")
 
@@ -51,6 +51,8 @@ class Replica:
                                       reset_timeout=reset_timeout,
                                       op=f"mesh.replica.{name}")
         self.alive = True
+        self.draining = False    # controller scale-down victim: the
+                                 # router stops placing new work here
         self.routed = 0          # requests the router committed here
         self.step_seconds = 0.0  # cumulative engine.step wall on this worker
         self.steps = 0
@@ -89,6 +91,16 @@ class Replica:
         self.steps += 1
         return dt
 
+    def brownout_level(self):
+        """The worker's current brownout rung (0 = normal): read off
+        its scheduler in-process, mirrored from the last step reply for
+        process-backed workers. The router's ranking DEMOTES browned-out
+        replicas — a hint, never a correctness input."""
+        sch = getattr(self.engine, "scheduler", None)
+        if sch is not None:
+            return int(getattr(sch, "level", 0))
+        return int(getattr(self.engine, "brownout_level", 0))
+
     def snapshot(self):
         """Per-replica slice of the mesh report: liveness, routing and
         SLO-capacity state."""
@@ -100,6 +112,8 @@ class Replica:
         return {
             "role": self.role,
             "alive": self.alive,
+            "draining": self.draining,
+            "serving_brownout_level": self.brownout_level(),
             "breaker": self.breaker.state,
             "routed": self.routed,
             "finished": self.finished_count + len(eng.finished),
@@ -163,11 +177,12 @@ class ReplicaPool:
             if not any(r in ("both", "decode") for r in roles):
                 raise ValueError("disaggregated mesh has no decode worker")
         self.disaggregate = bool(disaggregate) and n >= 2
-        self.replicas = [
-            Replica(f"replica{i}", _build_sharded(build_engine, tp),
-                    role=roles[i], failure_threshold=failure_threshold,
-                    reset_timeout=reset_timeout)
-            for i in range(n)]
+        self._build_engine = build_engine
+        self._tp = bool(tp)
+        self._hb_interval = float(heartbeat_interval)
+        self._failure_threshold = failure_threshold
+        self._reset_timeout = reset_timeout
+        self._next_idx = n      # spawn() names stay unique after retires
         # membership substrate: one shared in-process store, one elastic
         # lease per replica. Heartbeats are synchronous (beat()) so the
         # pool is deterministic under test; production workers would
@@ -176,12 +191,31 @@ class ReplicaPool:
             is_master=True, port=store_port, timeout=2)
         self._retry = RetryPolicy(max_attempts=2, base_delay=0.01,
                                   seed=0, sleep=lambda _s: None)
-        for rep in self.replicas:
-            rep.manager = ElasticManager(
-                self.store, node_id=rep.name, np_range=(1, n),
-                heartbeat_interval=heartbeat_interval,
-                retry_policy=self._retry)
-            rep.manager.register()
+        self.replicas = []
+        for i in range(n):
+            rep = self._make_replica(i, roles[i], failure_threshold,
+                                     reset_timeout)
+            self._bind_membership(rep, n)
+            self.replicas.append(rep)
+
+    def _build_one_engine(self):
+        return _build_sharded(self._build_engine, self._tp)
+
+    def _make_replica(self, i, role, failure_threshold, reset_timeout):
+        """Build one worker (subclass hook: ProcessReplicaPool builds
+        transport-backed proxies here instead of in-process engines)."""
+        return Replica(f"replica{i}", self._build_one_engine(),
+                       role=role, failure_threshold=failure_threshold,
+                       reset_timeout=reset_timeout)
+
+    def _bind_membership(self, rep, n):
+        """Register the replica's lease (subclass hook: socket workers
+        register their OWN lease from the child process)."""
+        rep.manager = ElasticManager(
+            self.store, node_id=rep.name, np_range=(1, n),
+            heartbeat_interval=self._hb_interval,
+            retry_policy=self._retry)
+        rep.manager.register()
 
     def __len__(self):
         return len(self.replicas)
@@ -229,6 +263,34 @@ class ReplicaPool:
         rep.manager.deregister()
         for _ in range(rep.breaker.failure_threshold):
             rep.breaker.record_failure()
+        return rep
+
+    def spawn(self, role="both"):
+        """Grow the pool live: build one new worker, register its
+        lease, and add it to the rotation (the MeshController's
+        scale-up action). The new replica draws traffic as soon as the
+        router's next ranking sees it."""
+        if role not in ROLES:
+            raise ValueError(f"unknown replica role {role!r}; "
+                             f"one of {ROLES}")
+        i = self._next_idx
+        self._next_idx += 1
+        rep = self._make_replica(i, role, self._failure_threshold,
+                                 self._reset_timeout)
+        self._bind_membership(rep, len(self.replicas) + 1)
+        self.replicas.append(rep)
+        return rep
+
+    def retire(self, name):
+        """Clean scale-down exit for a DRAINED worker: tombstone its
+        lease and drop it from the rotation. Unlike kill(), the engine
+        was idle — nothing is lost, no breaker slam, no failover."""
+        rep = self.by_name(name)
+        if not rep.alive:
+            return rep
+        rep.alive = False
+        rep.draining = False
+        rep.manager.deregister()
         return rep
 
     def prefill_targets(self):

@@ -54,7 +54,7 @@ from ...resilience.retry import RetryPolicy
 from ..prefix_cache import affinity_key
 from ..serving import BackpressureError
 from ..scheduler import PRIORITY_CLASSES
-from .handoff import KVHandoffError, hand_off
+from .handoff import KVHandoffError, hand_off_async
 
 __all__ = ["MeshRequest", "MeshRouter"]
 
@@ -152,6 +152,13 @@ class MeshRouter:
         # harvest walks; first finish wins (at-most-once commit)
         self._local: dict[tuple[str, int], MeshRequest] = {}
         self._handoff_q: deque[dict] = deque()
+        # in-flight asynchronous deliveries: (future, record, replica
+        # name, names already tried) — the decode side parks the stream
+        # only on delivery-complete; until then the pump keeps running
+        self._pending_handoffs: list[tuple] = []
+        # round 20: a MeshController (controller.py) acts on autoscale
+        # verdicts when attached; None keeps the advisor advisory-only
+        self.controller = None
         self._retry = handoff_retry if handoff_retry is not None else \
             RetryPolicy(max_attempts=3, base_delay=0.001, max_delay=0.01,
                         seed=0, sleep=lambda _s: None)
@@ -223,6 +230,7 @@ class MeshRouter:
 
     def has_work(self):
         return bool(self.queue or self._handoff_q
+                    or self._pending_handoffs
                     or any(not m.done for m in self._open.values()))
 
     def step(self):
@@ -252,6 +260,10 @@ class MeshRouter:
             self.collector.tick()
             if self.advisor is not None:
                 self._autoscale_verdict = self._advise()
+        if self.controller is not None:
+            # the controller acts AFTER harvest so its idle/drained
+            # reads are stable; any failure latches it advisory-only
+            self.controller.act(self._autoscale_verdict)
 
     def run(self, max_steps=10_000):
         """Drive to completion; {mesh rid: [tokens]}."""
@@ -298,13 +310,16 @@ class MeshRouter:
         replicas priced at the calibrated mean, 1s cold, so new workers
         still draw traffic and calibrate), then name. The slo_headroom
         gauge (1 - offered rate x svc) is exported per pick."""
-        rate = self._offered_rate() / max(1, len(reps))
+        # controller scale-down victims take no NEW work while they
+        # drain — unless they are all that's left (hint, never a wall)
+        active = [r for r in reps if not r.draining] or reps
+        rate = self._offered_rate() / max(1, len(active))
         svcs = {rep: rep.engine.predicted_service_seconds()
-                for rep in reps}
+                for rep in active}
         known = [s for s in svcs.values() if s is not None]
         fallback = sum(known) / len(known) if known else 1.0
         scored = []
-        for rep in reps:
+        for rep in active:
             svc = svcs[rep]
             if svc is not None:
                 _metric("mesh_replica_headroom",
@@ -312,8 +327,12 @@ class MeshRouter:
             drain = (svc if svc is not None else fallback) \
                 * (rep.load() + 1)
             scored.append((rep, drain))
+        # browned-out replicas demote between load and drain-time: a
+        # routing HINT (deterministic tiebreak), never a correctness
+        # input — a fully browned-out pool still serves everywhere
         return [rep for rep, _d in sorted(
-            scored, key=lambda t: (t[0].load(), t[1], t[0].name))]
+            scored, key=lambda t: (t[0].load(), t[0].brownout_level(),
+                                   t[1], t[0].name))]
 
     def _failover(self, reason, mreq=None):
         self._failovers[reason] = self._failovers.get(reason, 0) + 1
@@ -370,12 +389,10 @@ class MeshRouter:
             rep.breaker.record_success()
             # the replica-local Request adopts the mesh identity so
             # spans, exemplars, and the handoff all join one trace, and
-            # TTFT/deadlines stay anchored at TRUE arrival
-            req = rep.engine.queue[-1]
-            req.trace_id = mreq.trace_id
-            req.t_arrival = mreq.t_arrival
-            if req.deadline_s is not None:
-                req.t_deadline = req.t_arrival + req.deadline_s
+            # TTFT/deadlines stay anchored at TRUE arrival — a framed
+            # call for process workers, the same method in-process
+            rep.engine.adopt_identity(local_rid, mreq.trace_id,
+                                      mreq.t_arrival)
             mreq.phase = "placed"
             mreq.replica = rep.name
             mreq.local_rid = local_rid
@@ -441,54 +458,110 @@ class MeshRouter:
         self._handoff_q.append(record)
 
     def _pump_handoffs(self):
+        # poll in-flight deliveries FIRST: any transport copy that
+        # completed while the decode pump ran parks its stream now
+        if self._pending_handoffs:
+            pending, self._pending_handoffs = self._pending_handoffs, []
+            for entry in pending:
+                self._poll_pending(*entry)
         for _ in range(len(self._handoff_q)):
             record = self._handoff_q.popleft()
             self._deliver(record)
 
-    def _deliver(self, record):
+    def _deliver(self, record, tried=None):
         mreq = self._by_trace.get(record["trace_id"])
         if mreq is None or mreq.done:
             return
-        rejected = False
+        tried = set() if tried is None else tried
+        rejected = bool(tried)
         for rep in self._ranked(self.pool.decode_targets()):
+            if rep.name in tried:
+                continue
             if not rep.breaker.allow():
                 self._failover("circuit_open", mreq)
                 continue
+            fut = hand_off_async(record, rep.engine, retry=self._retry)
+            if not fut.done():
+                # delivery in flight: the transport copy overlaps the
+                # decode pump; the stream parks only on completion
+                mreq.phase = "handoff_pending"
+                self._pending_handoffs.append(
+                    (fut, record, rep.name, tried))
+                if self._rec.enabled:
+                    self._rec.record("mesh", action="handoff_async",
+                                     replica=rep.name,
+                                     trace=mreq.trace_id)
+                return
             try:
-                local_rid, nbytes, retries = hand_off(
-                    record, rep.engine, retry=self._retry)
+                local_rid, nbytes, retries = fut.result()
             except KVHandoffError as e:
                 if isinstance(e.__cause__, (ValueError, MemoryError)):
                     # THIS target rejected the record (format mismatch /
                     # pool full) — the transfer itself is fine, try the
                     # next-best decode worker
                     rejected = True
+                    tried.add(rep.name)
                     continue
                 rep.breaker.record_failure()
                 break       # transfer failed past the retry budget
-            rep.breaker.record_success()
-            self._handoffs["ok"] += 1
-            self._handoffs["bytes"] += nbytes
-            if retries:
-                self._handoffs["retried"] += 1
-                _metric("mesh_handoffs_total", outcome="retried").inc()
-            _metric("mesh_handoffs_total", outcome="ok").inc()
-            _metric("mesh_handoff_bytes").observe(nbytes)
-            mreq.phase = "handoff"
-            mreq.replica = rep.name
-            mreq.local_rid = local_rid
-            rep.routed += 1
-            self._local[(rep.name, local_rid)] = mreq
-            if self._rec.enabled:
-                self._rec.record("mesh", action="handoff",
-                                 replica=rep.name, bytes=nbytes,
-                                 retries=retries, trace=mreq.trace_id)
-            if self._tracer.enabled:
-                self._tracer.add_span(
-                    "mesh.handoff", time.perf_counter_ns(), 0,
-                    trace_id=mreq.trace_id,
-                    args={"replica": rep.name, "bytes": nbytes})
+            self._handoff_ok(mreq, rep, local_rid, nbytes, retries)
             return
+        self._re_prefill(mreq, rejected)
+
+    def _poll_pending(self, fut, record, rname, tried):
+        """Progress one in-flight async handoff; unresolved futures go
+        back on the pending list, completed ones settle through the
+        same classification as the synchronous path."""
+        if not fut.done():
+            self._pending_handoffs.append((fut, record, rname, tried))
+            return
+        mreq = self._by_trace.get(record["trace_id"])
+        if mreq is None or mreq.done:
+            return
+        rep = self.pool.by_name(rname)
+        if not rep.alive:
+            # the target died with the copy in flight — a transfer
+            # failure by definition; failover already re-routed nothing
+            # (mreq.replica was never set), so re-prefill here
+            self._re_prefill(mreq, bool(tried))
+            return
+        try:
+            local_rid, nbytes, retries = fut.result()
+        except KVHandoffError as e:
+            if isinstance(e.__cause__, (ValueError, MemoryError)):
+                tried.add(rname)
+                self._deliver(record, tried=tried)
+                return
+            rep.breaker.record_failure()
+            self._re_prefill(mreq, bool(tried))
+            return
+        self._handoff_ok(mreq, rep, local_rid, nbytes, retries)
+
+    def _handoff_ok(self, mreq, rep, local_rid, nbytes, retries):
+        rep.breaker.record_success()
+        self._handoffs["ok"] += 1
+        self._handoffs["bytes"] += nbytes
+        if retries:
+            self._handoffs["retried"] += 1
+            _metric("mesh_handoffs_total", outcome="retried").inc()
+        _metric("mesh_handoffs_total", outcome="ok").inc()
+        _metric("mesh_handoff_bytes").observe(nbytes)
+        mreq.phase = "handoff"
+        mreq.replica = rep.name
+        mreq.local_rid = local_rid
+        rep.routed += 1
+        self._local[(rep.name, local_rid)] = mreq
+        if self._rec.enabled:
+            self._rec.record("mesh", action="handoff",
+                             replica=rep.name, bytes=nbytes,
+                             retries=retries, trace=mreq.trace_id)
+        if self._tracer.enabled:
+            self._tracer.add_span(
+                "mesh.handoff", time.perf_counter_ns(), 0,
+                trace_id=mreq.trace_id,
+                args={"replica": rep.name, "bytes": nbytes})
+
+    def _re_prefill(self, mreq, rejected):
         # retry-then-re-prefill: the serialized blocks never arrived (or
         # no decode worker could hold them) — re-run prefill from the
         # prompt on the decode side. Slower, byte-identical.

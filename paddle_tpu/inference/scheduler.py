@@ -76,6 +76,14 @@ BROWNOUT_LEVELS = {
                           "verify work per dispatch under pressure",
     "disable_speculation": "turn speculation off (reversibly): decode "
                            "reverts to the plain fused program",
+    "force_small_prefill_chunk": "plan new admissions' prefill at the "
+                                 "smallest compiled chunk width so "
+                                 "decode lanes wait behind shorter "
+                                 "prefill pieces (reshape, not shed)",
+    "cap_max_new_tokens": "clamp newly admitted requests' "
+                          "max_new_tokens to the scheduler's mnt_cap: "
+                          "shorter streams drain backlog faster; the "
+                          "stream still serves (reshape, not shed)",
     "shed_best_effort": "stop serving best_effort: queued best_effort "
                         "requests finish with finish_reason='shed' at "
                         "admission",
@@ -111,6 +119,8 @@ def level_name(idx):
 _IDX_SHRINK = level_index("shrink_decode_steps")
 _IDX_DRAFT = level_index("reduce_draft_depth")
 _IDX_NOSPEC = level_index("disable_speculation")
+_IDX_SMALL_CHUNK = level_index("force_small_prefill_chunk")
+_IDX_CAP_MNT = level_index("cap_max_new_tokens")
 _IDX_SHED = level_index("shed_best_effort")
 
 
@@ -171,12 +181,14 @@ class SLOScheduler:
       window: TTFT/TPOT observation window (per-signal deque length).
       rate_window_s: trailing window for the offered-arrival-rate
         estimate that feeds headroom.
+      mnt_cap: max_new_tokens clamp applied to admissions while the
+        cap_max_new_tokens rung is engaged (reshape, not shed).
     """
 
     def __init__(self, ttft_target=None, tpot_target=None, quantum=32.0,
                  tenant_quota=None, escalate_after=2, recover_after=4,
                  min_dwell=2, resume_margin=0.25, window=128,
-                 rate_window_s=0.5):
+                 rate_window_s=0.5, mnt_cap=16):
         self.ttft_target = (float(ttft_target) if ttft_target is not None
                             else _default_target("ttft_p95"))
         self.tpot_target = (float(tpot_target) if tpot_target is not None
@@ -189,6 +201,7 @@ class SLOScheduler:
         self.min_dwell = max(0, int(min_dwell))
         self.resume_margin = float(resume_margin)
         self.rate_window_s = float(rate_window_s)
+        self.mnt_cap = max(1, int(mnt_cap))
         self.level = 0
         self.fifo = False           # True after a sched_decide failure
         self.shed_best_effort = False
@@ -316,6 +329,8 @@ class SLOScheduler:
         engine._set_draft_depth(
             1 if lvl >= _IDX_DRAFT else engine._base_draft_depth)
         engine._set_speculation(lvl < _IDX_NOSPEC)
+        engine._set_prefill_chunk_small(lvl >= _IDX_SMALL_CHUNK)
+        engine._set_mnt_cap(self.mnt_cap if lvl >= _IDX_CAP_MNT else None)
         self.shed_best_effort = lvl >= _IDX_SHED
 
     # --- preemption ------------------------------------------------------

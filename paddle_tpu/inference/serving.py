@@ -558,6 +558,8 @@ class ContinuousBatchingEngine:
         # draft_verify-fault degradation.
         self._base_decode_steps = self.decode_steps
         self._base_draft_depth = self.draft_depth
+        self._base_chunk = self.chunk
+        self._mnt_cap = None
         self._spec_allowed = self.spec
         # rid -> (request, cached length, next token): decode lanes
         # parked by preemption. Pool blocks stay allocated — resuming is
@@ -648,6 +650,22 @@ class ContinuousBatchingEngine:
                                   int(req.t_arrival * 1e9), 0,
                                   trace_id=req.trace_id, args={"rid": rid})
         return rid
+
+    def adopt_identity(self, rid, trace_id, t_arrival=None):
+        """Adopt a mesh-level identity onto a still-queued request:
+        spans, exemplars, and any handoff manifest join the mesh trace,
+        and TTFT/deadline accounting stays anchored at TRUE arrival
+        (router admission time, not replica enqueue time). Returns False
+        when the rid already left the queue."""
+        for req in self.queue:
+            if req.rid == rid:
+                req.trace_id = str(trace_id)
+                if t_arrival is not None:
+                    req.t_arrival = float(t_arrival)
+                    if req.deadline_s is not None:
+                        req.t_deadline = req.t_arrival + req.deadline_s
+                return True
+        return False
 
     def has_work(self):
         return (bool(self.queue) or any(r is not None for r in self.lanes)
@@ -1027,6 +1045,17 @@ class ContinuousBatchingEngine:
                                      rid=req.rid)
                 self._finish(req, "shed")
                 continue
+            if self._mnt_cap is not None \
+                    and req.max_new_tokens > self._mnt_cap:
+                # cap_max_new_tokens rung: reshape the admitted budget —
+                # the stream still serves, just shorter. Capped at
+                # admission so already-running streams keep theirs, and
+                # a request admitted under brownout keeps the cap even
+                # after recovery (budget decisions are admission-final).
+                req.max_new_tokens = self._mnt_cap
+                if self._rec.enabled:
+                    self._rec.record("sched", action="cap_max_new_tokens",
+                                     rid=req.rid, cap=self._mnt_cap)
             total = req.prompt.size + req.max_new_tokens
             if total > self.max_blocks_per_seq * self.pool.block_size:
                 # cannot ever serve: reject with an empty result instead
@@ -1502,6 +1531,21 @@ class ContinuousBatchingEngine:
             return
         self.spec = want
         self._dirty = True
+
+    def _set_prefill_chunk_small(self, on):
+        # force_small_prefill_chunk rung: future admissions plan their
+        # prefill at the smallest compiled chunk width so each piece
+        # holds the dispatch for the shortest possible time. No _dirty:
+        # chunk planning is host-side at admission and every width in
+        # _chunk_widths is already a compiled bucket. Plans already
+        # issued are unchanged (admission-scoped, like every knob).
+        self.chunk = self._chunk_widths[0] if on else self._base_chunk
+
+    def _set_mnt_cap(self, cap):
+        # cap_max_new_tokens rung: requests admitted while engaged are
+        # clamped to `cap` generated tokens (reshaped, not shed). None
+        # restores uncapped admission.
+        self._mnt_cap = None if cap is None else max(1, int(cap))
 
     def _dispatch(self):
         d = self._dev

@@ -254,6 +254,11 @@ CATALOG = {
     "elastic_watch_recoveries_total": (
         "counter", "membership-watch store reads that succeeded after "
         ">=1 retry", (), None),
+    "elastic_beat_failures_total": (
+        "counter", "threaded-heartbeat iterations that failed past the "
+        "retry budget (the daemon beat loop keeps going — the lease may "
+        "still survive within its ttl; never raised into serving)",
+        (), None),
 
     # -- resilience (paddle_tpu/resilience/: faults, retry) ------------------
     "fault_injected_total": (
@@ -401,6 +406,15 @@ CATALOG = {
         "on at its last pick (1 - offered_load * predicted service "
         "seconds; <=0 = saturated, routed around when possible)",
         ("replica",), None),
+    "mesh_transport_frames_total": (
+        "counter", "framed request/response round trips between the "
+        "router and process-backed workers, by frame kind (transport.py; "
+        "loopback and socket transports both count)", ("kind",), None),
+    "mesh_controller_actions_total": (
+        "counter", "autoscale controller actions taken on advisor "
+        "verdicts (scale_up / drain_begin / scale_down / drain_forced / "
+        "latch_off — latch_off means a controller failure flipped it "
+        "back to advisory-only)", ("action",), None),
 
     # -- observability plane (timeseries.py sampler + mesh federation) -------
     "obs_samples_total": (

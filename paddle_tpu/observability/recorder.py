@@ -68,6 +68,9 @@ EVENT_KINDS = {
     "mesh": "serving-mesh action (route pick, paged-KV handoff, "
             "replica failover/tombstone) with the request trace id so "
             "cross-replica timelines join",
+    "controller": "mesh autoscale controller action (scale_up spawn, "
+                  "drain_begin, scale_down retire, drain_forced kill, "
+                  "latch_off back to advisory-only)",
 }
 
 

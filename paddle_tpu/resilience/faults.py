@@ -127,6 +127,22 @@ FAULT_SITES = {
                          "it, opens its breaker, and re-routes + "
                          "re-prefills its in-flight requests on the "
                          "survivors)",
+    "mesh.transport_send": "mesh process transport: one framed "
+                           "request/response round trip between router "
+                           "and worker (transport.py clients; armed "
+                           "BEFORE the frame leaves so a retry is "
+                           "always safe — transient failure retries "
+                           "under the client RetryPolicy, exhaustion "
+                           "surfaces TransportError, and a failed "
+                           "paged-KV import re-prefills on the decode "
+                           "side, streams byte-identical)",
+    "mesh.controller_act": "mesh autoscale controller: one act() on an "
+                           "AutoscaleAdvisor verdict (controller.py); "
+                           "ANY failure latches the controller back to "
+                           "advisory-only — counted "
+                           "mesh_controller_actions_total"
+                           "{action=latch_off} — while serving "
+                           "continues byte-identically",
     "serve.prefix_match": "serving prefix cache: one index operation "
                           "(admission-time prompt-prefix lookup, or the "
                           "post-prefill / post-import block insert); ANY "

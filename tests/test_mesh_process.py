@@ -1,0 +1,415 @@
+"""Process-native serving mesh (inference/mesh/transport + controller)
+— round 20.
+
+Contract under test: replicas behind the versioned frame transport —
+in-process loopback proxies (deterministic tier-1 shape) and REAL child
+processes over TCP (slow-marked) — serve greedy streams BYTE-IDENTICAL
+to the in-process pool; async KV handoff overlaps the decode pump and
+parks the stream only on delivery-complete; the MeshController ACTS on
+autoscale verdicts (spawn + lease-register up, drain-before-tombstone
+down) and latches back to advisory-only on any failure.
+
+Port range 466xx here — disjoint from test_mesh (465xx), chaos_drill
+(4618x/462xx), and bench (4710x); the _PyStore fallback keys stores by
+(host, port), so a reused port would alias memberships across tests.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.generation import generate
+from paddle_tpu.inference import ContinuousBatchingEngine
+from paddle_tpu.inference.mesh import (MeshController, MeshRouter,
+                                       ProcessReplicaPool, ReplicaPool,
+                                       TransportError)
+from paddle_tpu.inference.mesh.transport import (
+    pack_frame, serve_request, unpack_frame)
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.resilience import faults
+
+_PORTS = itertools.count(46600)
+
+_CFG = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=4, max_position_embeddings=256)
+_ENG = dict(num_blocks=64, block_size=8, max_batch=2,
+            prefill_buckets=(16,))
+# the JSON-safe recipe worker.py rebuilds the same engine from
+_SPEC = {"seed": 0, "config": _CFG,
+         "engine": dict(_ENG, prefill_buckets=[16])}
+
+
+def _model():
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig(**_CFG))
+
+
+def _factory(**kw):
+    def build():
+        eng_kw = dict(_ENG)
+        eng_kw.update(kw)
+        return ContinuousBatchingEngine(_model(), **eng_kw)
+    return build
+
+
+def _dense_reference(model, prompt, n):
+    ids = paddle.to_tensor(np.asarray(prompt, np.int32)[None])
+    out = generate(model, ids, max_new_tokens=n, do_sample=False)
+    arr = np.asarray(out._data if hasattr(out, "_data") else out)
+    return arr[0, len(prompt):].tolist()
+
+
+def _prompts(n, rs=None):
+    rs = rs or np.random.RandomState(7)
+    return [rs.randint(0, 128, (int(s),))
+            for s in rs.randint(5, 14, size=n)]
+
+
+def _socket_pool(**kw):
+    """Spawn a real child-process pool, or typed-skip when the host
+    cannot launch the workers (sandboxed CI without subprocess TCP)."""
+    try:
+        return ProcessReplicaPool(transport="socket", engine_spec=_SPEC,
+                                  store_port=next(_PORTS), **kw)
+    except (TransportError, OSError) as e:
+        pytest.skip("this host cannot launch mesh worker processes "
+                    f"over TCP: {e!r}")
+
+
+class TestFrameProtocol:
+    def test_round_trip(self):
+        payload = bytes(range(256)) * 3
+        buf = pack_frame("step", {"a": 1, "b": None}, payload)
+        kind, meta, out = unpack_frame(buf)
+        assert (kind, meta, out) == ("step", {"a": 1, "b": None}, payload)
+        # deterministic: same call packs to the same bytes
+        assert pack_frame("step", {"a": 1, "b": None}, payload) == buf
+
+    def test_unknown_version_rejected(self):
+        import json
+        import struct
+        buf = pack_frame("ping", {})
+        magic, hlen, plen = struct.unpack_from("<4sII", buf, 0)
+        head = json.loads(buf[12:12 + hlen])
+        head["v"] = 99
+        new_head = json.dumps(head, sort_keys=True).encode()
+        tampered = struct.pack("<4sII", magic, len(new_head), plen) \
+            + new_head + buf[12 + hlen:]
+        with pytest.raises(TransportError, match="version"):
+            unpack_frame(tampered)
+
+    def test_bad_magic_and_truncation_rejected(self):
+        buf = pack_frame("ping", {})
+        with pytest.raises(TransportError, match="magic"):
+            unpack_frame(b"XXXX" + buf[4:])
+        with pytest.raises(TransportError, match="truncated"):
+            unpack_frame(buf[:8])
+        with pytest.raises(TransportError, match="length"):
+            unpack_frame(buf + b"junk")
+
+    def test_unknown_op_marshals_typed_error(self):
+        eng = _factory()()
+        kind, meta, _p = serve_request(eng, "frobnicate", {}, b"")
+        assert kind == "error"
+        assert meta["base"] == "ValueError"
+
+
+class TestLoopbackParity:
+    def test_dp_streams_byte_identical_to_in_process_pool(self):
+        prompts = _prompts(4)
+        base_pool = ReplicaPool(_factory(), n=2, store_port=next(_PORTS))
+        base_router = MeshRouter(base_pool)
+        for p in prompts:
+            base_router.add_request(p, max_new_tokens=8)
+        want = base_router.run()
+
+        pool = ProcessReplicaPool(_factory(), n=2, transport="loopback",
+                                  store_port=next(_PORTS))
+        router = MeshRouter(pool)
+        for p in prompts:
+            router.add_request(p, max_new_tokens=8)
+        got = router.run()
+        assert got == want
+        assert all(rep.routed >= 1 for rep in pool)
+
+    def test_disaggregated_streams_byte_identical(self):
+        prompts = _prompts(4)
+        model = _model()
+        refs = [_dense_reference(model, p, 8) for p in prompts]
+        pool = ProcessReplicaPool(_factory(), n=2, transport="loopback",
+                                  disaggregate=True,
+                                  store_port=next(_PORTS))
+        router = MeshRouter(pool)
+        rids = [router.add_request(p, max_new_tokens=8) for p in prompts]
+        out = router.run()
+        for rid, ref in zip(rids, refs):
+            assert out[rid] == ref, rid
+        rep = router.mesh_report()
+        assert rep["handoffs"]["ok"] == len(prompts)
+        assert rep["open"] == 0
+
+    def test_threaded_beats_keep_membership_and_make_beat_noop(self):
+        pool = ProcessReplicaPool(_factory(), n=2, transport="loopback",
+                                  threaded_beats=True,
+                                  store_port=next(_PORTS))
+        assert sorted(pool.alive_nodes()) == ["replica0", "replica1"]
+        # synchronous beat is a no-op: the daemon threads own the leases
+        pool.beat()
+        for rep in pool:
+            assert rep.manager._hb_thread is not None
+            assert rep.manager._hb_thread.is_alive()
+        assert sorted(pool.alive_nodes()) == ["replica0", "replica1"]
+
+    def test_transport_loss_walks_the_replica_down_path(self):
+        # exhaust every send attempt of the first admission: the worker
+        # latches lost and the survivor serves all streams
+        prompts = _prompts(3)
+        model = _model()
+        refs = [_dense_reference(model, p, 6) for p in prompts]
+        pool = ProcessReplicaPool(_factory(), n=2, transport="loopback",
+                                  store_port=next(_PORTS))
+        router = MeshRouter(pool)
+        rids = [router.add_request(p, max_new_tokens=6) for p in prompts]
+        with faults.injected_faults(
+                "mesh.transport_send:1:ConnectionError;"
+                "mesh.transport_send:2:ConnectionError;"
+                "mesh.transport_send:3:ConnectionError"):
+            out = router.run()
+        assert len(pool.alive()) == 1
+        assert router._failovers.get("admit_failed", 0) >= 1
+        for rid, ref in zip(rids, refs):
+            assert out[rid] == ref, rid
+        assert router.mesh_report()["open"] == 0
+
+
+class TestAsyncHandoff:
+    def test_delivery_overlaps_decode_pump(self):
+        # latency_polls delays import completion by N done() polls: the
+        # router must park the handoff as PENDING and keep pumping
+        # decode steps while the copy is "in flight"
+        prompts = _prompts(3)
+        model = _model()
+        refs = [_dense_reference(model, p, 8) for p in prompts]
+        pool = ProcessReplicaPool(_factory(), n=2, transport="loopback",
+                                  disaggregate=True, latency_polls=3,
+                                  store_port=next(_PORTS))
+        router = MeshRouter(pool)
+        rids = [router.add_request(p, max_new_tokens=8) for p in prompts]
+        saw_pending = 0
+        for _ in range(300):
+            router.step()
+            saw_pending = max(saw_pending, len(router._pending_handoffs))
+            if not router.has_work():
+                break
+        out = dict(router.finished)
+        assert saw_pending >= 1, \
+            "async handoff never parked a pending delivery"
+        for rid, ref in zip(rids, refs):
+            assert out[rid].generated == ref, rid
+        assert router._handoffs["ok"] == len(prompts)
+        assert router.mesh_report()["open"] == 0
+
+    def test_sync_pools_resolve_immediately(self):
+        # engines without import_kv_async (plain in-process pool) pass
+        # through hand_off synchronously — nothing ever parks pending
+        prompts = _prompts(2)
+        pool = ReplicaPool(_factory(), n=2, disaggregate=True,
+                           store_port=next(_PORTS))
+        router = MeshRouter(pool)
+        rids = [router.add_request(p, max_new_tokens=6) for p in prompts]
+        saw_pending = 0
+        for _ in range(300):
+            router.step()
+            saw_pending = max(saw_pending, len(router._pending_handoffs))
+            if not router.has_work():
+                break
+        assert saw_pending == 0
+        assert sorted(router.finished) == rids
+
+
+class TestController:
+    def _mesh(self, **kw):
+        pool = ProcessReplicaPool(_factory(), n=2, transport="loopback",
+                                  store_port=next(_PORTS))
+        router = MeshRouter(pool)
+        ctl = MeshController(router, **kw)
+        router.controller = ctl
+        return pool, router, ctl
+
+    def test_scale_up_spawns_and_registers(self):
+        pool, router, ctl = self._mesh(max_replicas=3)
+        ctl.act({"action": "scale_up"})
+        assert len(pool.alive()) == 3
+        assert ctl.actions["scale_up"] == 1
+        assert sorted(pool.alive_nodes()) \
+            == sorted(r.name for r in pool.alive())
+        # ceiling respected: a second verdict is a no-op
+        ctl.act({"action": "scale_up"})
+        assert len(pool.alive()) == 3 and ctl.actions["scale_up"] == 1
+
+    def test_scale_down_drains_before_tombstone(self):
+        pool, router, ctl = self._mesh(min_replicas=1, drain_rounds=50)
+        prompts = _prompts(4)
+        rids = [router.add_request(p, max_new_tokens=6) for p in prompts]
+        router.step()           # streams in flight on both replicas
+        ctl.act({"action": "scale_down"})
+        assert ctl.actions["drain_begin"] == 1
+        victim = next(iter(ctl._drain_waits))
+        assert pool.by_name(victim).draining
+        out = router.run()      # pump: drain completes, THEN retire
+        assert sorted(out) == rids          # no stream lost to the drain
+        assert ctl.actions["scale_down"] == 1
+        assert ctl.actions["drain_forced"] == 0
+        assert not pool.by_name(victim).alive
+        assert victim not in pool.alive_nodes()     # lease tombstoned
+        # accounting closure: every drain_begin resolved exactly once
+        assert ctl.actions["drain_begin"] == \
+            ctl.actions["scale_down"] + ctl.actions["drain_forced"]
+        assert not ctl._drain_waits
+        assert router.mesh_report()["open"] == 0
+
+    def test_stuck_drain_is_forced_through_kill(self):
+        pool, router, ctl = self._mesh(min_replicas=1, drain_rounds=2)
+        prompts = _prompts(3)
+        model = _model()
+        refs = [_dense_reference(model, p, 24) for p in prompts]
+        rids = [router.add_request(p, max_new_tokens=24) for p in prompts]
+        router.step()           # long streams: the drain cannot finish
+        ctl.act({"action": "scale_down"})
+        victim = next(iter(ctl._drain_waits))
+        out = router.run()
+        assert ctl.actions["drain_forced"] == 1
+        assert ctl.actions["drain_begin"] == \
+            ctl.actions["scale_down"] + ctl.actions["drain_forced"]
+        assert not pool.by_name(victim).alive
+        # the forced kill used the drilled failover path: every stream
+        # re-prefilled on the survivor, byte-identical
+        for rid, ref in zip(rids, refs):
+            assert out[rid] == ref, rid
+        assert router.mesh_report()["open"] == 0
+
+    def test_fault_latches_advisory_only_serving_identical(self):
+        pool, router, ctl = self._mesh()
+        prompts = _prompts(3)
+        model = _model()
+        refs = [_dense_reference(model, p, 6) for p in prompts]
+        rids = [router.add_request(p, max_new_tokens=6) for p in prompts]
+        with faults.injected_faults("mesh.controller_act:1:FaultInjected"):
+            out = router.run()
+        assert not ctl.enabled
+        assert ctl.actions["latch_off"] == 1
+        for rid, ref in zip(rids, refs):
+            assert out[rid] == ref, rid
+        # latched: later verdicts are ignored
+        ctl.act({"action": "scale_up"})
+        assert len(pool.alive()) == 2 and ctl.actions["scale_up"] == 0
+
+    def test_min_replicas_floor_respected(self):
+        pool, router, ctl = self._mesh(min_replicas=2)
+        ctl.act({"action": "scale_down"})
+        assert ctl.actions["drain_begin"] == 0
+        assert len(pool.alive()) == 2
+
+
+class TestBrownoutRouting:
+    def test_browned_out_replica_demoted_at_equal_load(self):
+        pool = ReplicaPool(_factory(), n=2, store_port=next(_PORTS))
+        # replica0 reports a browned-out serving plane (no scheduler
+        # attached: the attribute mirror is what process proxies use)
+        pool.by_name("replica0").engine.brownout_level = 3
+        assert pool.by_name("replica0").snapshot()[
+            "serving_brownout_level"] == 3
+        router = MeshRouter(pool)
+        router.add_request(_prompts(1)[0], max_new_tokens=4)
+        router.step()
+        # the healthy replica wins the tie at equal (zero) load
+        assert pool.by_name("replica1").routed == 1
+        assert pool.by_name("replica0").routed == 0
+        # a hint, never a wall: alone, the browned-out replica serves
+        router.kill_replica("replica1", why="test")
+        rid = router.add_request(_prompts(1)[0], max_new_tokens=4)
+        out = router.run()
+        assert rid in out
+
+
+@pytest.mark.slow
+class TestSocketWorkers:
+    def test_two_process_streams_byte_identical(self):
+        prompts = _prompts(4)
+        model = _model()
+        refs = [_dense_reference(model, p, 8) for p in prompts]
+        pool = _socket_pool(n=2)
+        try:
+            router = MeshRouter(pool)
+            rids = [router.add_request(p, max_new_tokens=8)
+                    for p in prompts]
+            out = router.run()
+            for rid, ref in zip(rids, refs):
+                assert out[rid] == ref, rid
+            # both workers hold real leases over the shared store
+            assert sorted(pool.alive_nodes()) == ["replica0", "replica1"]
+            assert router.mesh_report()["open"] == 0
+        finally:
+            pool.close()
+
+    def test_two_process_disaggregated_byte_identical(self):
+        prompts = _prompts(3)
+        model = _model()
+        refs = [_dense_reference(model, p, 6) for p in prompts]
+        pool = _socket_pool(n=2, disaggregate=True)
+        try:
+            router = MeshRouter(pool)
+            rids = [router.add_request(p, max_new_tokens=6)
+                    for p in prompts]
+            out = router.run()
+            for rid, ref in zip(rids, refs):
+                assert out[rid] == ref, rid
+            assert router._handoffs["ok"] == len(prompts)
+        finally:
+            pool.close()
+
+    def test_kill9_mid_decode_survivor_completes(self):
+        prompts = _prompts(4)
+        model = _model()
+        refs = [_dense_reference(model, p, 16) for p in prompts]
+        pool = _socket_pool(n=2)
+        try:
+            router = MeshRouter(pool)
+            rids = [router.add_request(p, max_new_tokens=16)
+                    for p in prompts]
+            router.step()       # streams mid-decode on both workers
+            victim = max(pool.alive(), key=lambda r: r.load()).name
+            router.kill_replica(victim, why="kill9")    # SIGKILL child
+            out = router.run()
+            assert len(pool.alive()) == 1
+            assert victim not in pool.alive_nodes()     # tombstoned
+            assert router._failovers.get("replica_down", 0) >= 1
+            for rid, ref in zip(rids, refs):
+                assert out[rid] == ref, rid
+            assert router.mesh_report()["open"] == 0
+        finally:
+            pool.close()
+
+    def test_controller_drains_real_worker(self):
+        pool = _socket_pool(n=2)
+        try:
+            router = MeshRouter(pool)
+            ctl = MeshController(router, min_replicas=1)
+            router.controller = ctl
+            rids = [router.add_request(p, max_new_tokens=6)
+                    for p in _prompts(3)]
+            router.step()
+            ctl.act({"action": "scale_down"})
+            victim = next(iter(ctl._drain_waits))
+            out = router.run()
+            assert sorted(out) == rids
+            assert ctl.actions["scale_down"] == 1
+            assert not pool.by_name(victim).alive
+            assert victim not in pool.alive_nodes()
+            # the worker process exited cleanly on the shutdown frame
+            assert pool.by_name(victim).proc.returncode is not None
+        finally:
+            pool.close()

@@ -81,7 +81,8 @@ class TestRegistries:
     def test_brownout_levels_closed_and_ordered(self):
         assert list(BROWNOUT_LEVELS) == [
             "normal", "shrink_decode_steps", "reduce_draft_depth",
-            "disable_speculation", "shed_best_effort"]
+            "disable_speculation", "force_small_prefill_chunk",
+            "cap_max_new_tokens", "shed_best_effort"]
         assert MAX_LEVEL == len(BROWNOUT_LEVELS) - 1
 
     def test_level_index_roundtrip(self):
@@ -175,24 +176,34 @@ class TestBrownoutKnobs:
     def test_ladder_knobs_cumulative_and_reversible(self):
         eng = _engine(_model(), decode_steps=4, speculative_decode=True,
                       draft_depth=2)
-        sched = SLOScheduler()
-        base = (eng.decode_steps, eng.draft_depth, eng.spec)
-        assert base == (4, 2, True)
+        sched = SLOScheduler(mnt_cap=16)
+        base = (eng.decode_steps, eng.draft_depth, eng.spec, eng.chunk,
+                eng._mnt_cap)
+        assert base == (4, 2, True, eng._base_chunk, None)
+        small = eng._chunk_widths[0]
+        # (decode_steps, draft_depth, spec, shed, chunk, mnt_cap)
         want = {
-            "normal": (4, 2, True, False),
-            "shrink_decode_steps": (2, 2, True, False),
-            "reduce_draft_depth": (2, 1, True, False),
-            "disable_speculation": (2, 1, False, False),
-            "shed_best_effort": (2, 1, False, True),
+            "normal": (4, 2, True, False, eng._base_chunk, None),
+            "shrink_decode_steps": (2, 2, True, False,
+                                    eng._base_chunk, None),
+            "reduce_draft_depth": (2, 1, True, False,
+                                   eng._base_chunk, None),
+            "disable_speculation": (2, 1, False, False,
+                                    eng._base_chunk, None),
+            "force_small_prefill_chunk": (2, 1, False, False, small, None),
+            "cap_max_new_tokens": (2, 1, False, False, small, 16),
+            "shed_best_effort": (2, 1, False, True, small, 16),
         }
-        for name, (k, d, spec, shed) in want.items():
+        for name, (k, d, spec, shed, chunk, cap) in want.items():
             sched.level = level_index(name)
             sched._apply(eng)
             assert (eng.decode_steps, eng.draft_depth, eng.spec,
-                    sched.shed_best_effort) == (k, d, spec, shed), name
+                    sched.shed_best_effort, eng.chunk, eng._mnt_cap) \
+                == (k, d, spec, shed, chunk, cap), name
         sched.level = 0
         sched._apply(eng)
-        assert (eng.decode_steps, eng.draft_depth, eng.spec) == base
+        assert (eng.decode_steps, eng.draft_depth, eng.spec, eng.chunk,
+                eng._mnt_cap) == base
 
     def test_recovery_respects_permanent_spec_degradation(self):
         eng = _engine(_model(), decode_steps=4, speculative_decode=True,

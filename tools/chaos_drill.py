@@ -745,6 +745,113 @@ def drill_mesh_replica_down(tmp):
                         "accounting closed")
 
 
+def _tiny_process_mesh(n=2, disaggregate=False, port=46185, **kw):
+    """N-replica loopback ProcessReplicaPool: same tiny engines, but
+    every router<->worker interaction marshals through the versioned
+    frame protocol (the round-20 transport)."""
+    from paddle_tpu.inference.mesh import MeshRouter, ProcessReplicaPool
+    holder = {}
+
+    def factory():
+        model, eng = _tiny_engine(**kw)
+        holder.setdefault("model", model)
+        return eng
+
+    pool = ProcessReplicaPool(factory, n=n, disaggregate=disaggregate,
+                              store_port=port)
+    return holder["model"], pool, MeshRouter(pool)
+
+
+def drill_mesh_transport_send(tmp):
+    # leg 1: transient — one ConnectionError as the first frame leaves
+    # the client. The site arms BEFORE dispatch, so the retried send
+    # cannot double-admit; the transport retry absorbs it silently.
+    model, pool, router = _tiny_process_mesh(port=46185)
+    prompts = [(np.arange(6) * (i + 2)) % 128 for i in range(4)]
+    refs = [_dense_ref(model, p, 6) for p in prompts]
+    with faults.injected_faults("mesh.transport_send:1:ConnectionError"):
+        rids = [router.add_request(p, max_new_tokens=6) for p in prompts]
+        out = router.run()
+        inj = faults.injected_counts().get("mesh.transport_send", 0)
+    _expect(inj == 1, "fault never reached the transport send site")
+    for rid, ref in zip(rids, refs):
+        _expect(out.get(rid) == ref,
+                "stream diverged after the retried frame send")
+    _expect(_counter("resilience_retries_total",
+                     op="mesh.transport_send") >= 1,
+            "transport retry not counted")
+    _expect(_counter("mesh_transport_frames_total",
+                     kind="add_request") >= 1,
+            "mesh_transport_frames_total{add_request} did not move")
+    # leg 2: exhaustion — every attempt of the first send fails. The
+    # worker latches LOST (exactly a killed process: admission refuses,
+    # the breaker slams) and the survivor serves every stream
+    # byte-identically through the admit_failed failover.
+    model2, pool2, router2 = _tiny_process_mesh(port=46285)
+    with faults.injected_faults("mesh.transport_send:1:ConnectionError;"
+                                "mesh.transport_send:2:ConnectionError;"
+                                "mesh.transport_send:3:ConnectionError"):
+        rids2 = [router2.add_request(p, max_new_tokens=6) for p in prompts]
+        out2 = router2.run()
+    for rid, ref in zip(rids2, refs):
+        _expect(out2.get(rid) == ref,
+                "stream diverged after transport loss + failover")
+    _expect(len(pool2.alive()) == 1,
+            "exhausted transport did not latch the worker lost")
+    _expect(router2._failovers.get("admit_failed", 0) >= 1,
+            "lost-worker admission not counted as a failover")
+    _expect(router.mesh_report()["open"] == 0
+            and router2.mesh_report()["open"] == 0,
+            "mesh accounting left requests open")
+    return "recovered", ("transient frame fault retried before dispatch "
+                         "(no double-admit); exhaustion latched the "
+                         "worker lost and the survivor served every "
+                         "stream byte-exact")
+
+
+def drill_mesh_controller_act(tmp):
+    from paddle_tpu.inference.mesh import MeshController
+    model, pool, router = _tiny_process_mesh(port=46186)
+    ctl = MeshController(router, min_replicas=1, max_replicas=3)
+    router.controller = ctl
+    prompts = [(np.arange(6) * (i + 4)) % 128 for i in range(3)]
+    refs = [_dense_ref(model, p, 6) for p in prompts]
+    # healthy action first: a scale_up verdict spawns + lease-registers
+    ctl.act({"action": "scale_up"})
+    _expect(len(pool.alive()) == 3, "scale_up did not spawn a worker")
+    _expect(ctl.actions["scale_up"] == 1, "scale_up not counted")
+    _expect(sorted(pool.alive_nodes())
+            == sorted(r.name for r in pool.alive()),
+            "spawned worker not lease-registered")
+    # the fault: the controller tick inside the pump blows up — it must
+    # latch back to advisory-only while serving does not notice
+    with faults.injected_faults("mesh.controller_act:1:FaultInjected"):
+        rids = [router.add_request(p, max_new_tokens=6) for p in prompts]
+        out = router.run()
+        inj = faults.injected_counts().get("mesh.controller_act", 0)
+    _expect(inj == 1, "fault never reached the controller act site")
+    _expect(not ctl.enabled, "controller did not latch advisory-only")
+    _expect(ctl.actions["latch_off"] == 1, "latch_off not counted")
+    _expect(_counter("mesh_controller_actions_total",
+                     action="latch_off") >= 1,
+            "mesh_controller_actions_total{latch_off} did not move")
+    _expect(_counter("serving_runtime_degradations_total",
+                     what="controller_advisory") >= 1,
+            "controller degradation not counted")
+    for rid, ref in zip(rids, refs):
+        _expect(out.get(rid) == ref,
+                "stream diverged after the controller latch")
+    # latched means LATCHED: later verdicts are ignored, the pool holds
+    ctl.act({"action": "scale_down"})
+    _expect(len(pool.alive()) == 3 and ctl.actions["scale_down"] == 0,
+            "latched controller still acted on a verdict")
+    _expect(router.mesh_report()["open"] == 0,
+            "mesh accounting left requests open")
+    return "degraded", ("controller fault latched it back to "
+                        "advisory-only (counted); pool membership held "
+                        "and serving stayed byte-identical")
+
+
 def drill_obs_sample(tmp):
     from paddle_tpu.observability.timeseries import MetricsSampler
     p = (np.arange(8) * 5) % 128
@@ -823,6 +930,8 @@ SCENARIOS = {
     "mesh.route": drill_mesh_route,
     "mesh.kv_handoff": drill_mesh_kv_handoff,
     "mesh.replica_down": drill_mesh_replica_down,
+    "mesh.transport_send": drill_mesh_transport_send,
+    "mesh.controller_act": drill_mesh_controller_act,
     "obs.sample": drill_obs_sample,
 }
 

@@ -123,6 +123,12 @@ def main(argv=None):
                     help="with --replicas >= 2: split the pool into "
                          "prefill and decode workers with serialized "
                          "paged-KV handoff between them")
+    ap.add_argument("--processes", type=int, default=0, metavar="N",
+                    help="run N replicas behind the process-native "
+                         "frame transport (ProcessReplicaPool, loopback "
+                         "clients) instead of bare in-process engines; "
+                         "overrides --replicas; composes with "
+                         "--disaggregate")
     ap.add_argument("--min-coverage", type=float, default=0.95)
     ap.add_argument("--dashboard", action="store_true",
                     help="render the run's embedded TSDB as a terminal "
@@ -153,12 +159,20 @@ def main(argv=None):
         kw["draft_depth"] = drafting.scenario_draft_depth(args.scenario)
         if not args.flat_drafter:
             kw["drafter"] = drafting.scenario_drafter(args.scenario)
-    if args.replicas > 1:
-        from paddle_tpu.inference.mesh import MeshRouter, ReplicaPool
+    if args.processes > 1 or args.replicas > 1:
+        from paddle_tpu.inference.mesh import (MeshRouter,
+                                               ProcessReplicaPool,
+                                               ReplicaPool)
         from paddle_tpu.inference import SLOScheduler
-        pool = ReplicaPool(
-            lambda: build_engine(**kw), n=args.replicas,
-            disaggregate=args.disaggregate, store_port=0)
+        if args.processes > 1:
+            pool = ProcessReplicaPool(
+                lambda: build_engine(**kw), n=args.processes,
+                transport="loopback",
+                disaggregate=args.disaggregate, store_port=0)
+        else:
+            pool = ReplicaPool(
+                lambda: build_engine(**kw), n=args.replicas,
+                disaggregate=args.disaggregate, store_port=0)
         engine = MeshRouter(
             pool, scheduler=SLOScheduler() if args.scheduler else None)
     else:

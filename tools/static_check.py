@@ -646,13 +646,15 @@ def rule_pir_passes(ctx):
 def rule_mesh_wiring(ctx):
     """The serving mesh's failure wiring is pinned both ways: every
     fault site it arms — ``fault_point`` AND the behavioral ``check()``
-    (which the fault-sites rule does not scan) — and every flight-
-    recorder kind it emits must name a registered entry; every
-    registered ``mesh.*`` site must actually be consulted by mesh code
-    and backticked in RESILIENCE.md's mesh runbook; and RESILIENCE.md
-    may not document a phantom ``mesh.*`` site."""
+    (which the fault-sites rule does not scan) — every flight-recorder
+    kind it emits, and every metric it counts must name a registered
+    entry; every registered ``mesh.*`` site must actually be consulted
+    by mesh code and backticked in RESILIENCE.md's mesh runbook; every
+    ``mesh_*`` catalog metric and the mesh-owned event kinds (``mesh``,
+    ``controller``) must actually be emitted by mesh code; and
+    RESILIENCE.md may not document a phantom ``mesh.*`` site."""
     out = []
-    used_sites, used_kinds = set(), set()
+    used_sites, used_kinds, used_metrics = set(), set(), set()
     scanned_mesh_core = False
     for path, tree in ctx.sources.items():
         norm = path.replace(os.sep, "/")
@@ -681,6 +683,15 @@ def rule_mesh_wiring(ctx):
                         "mesh-wiring", path, node.lineno,
                         f"record({lit!r}) is not in {RECORDER_PY} "
                         "EVENT_KINDS"))
+            elif callee in ("metric", "_metric"):
+                # the metrics-in-catalog rule only sees the bare
+                # `metric` callee; mesh sources import it as `_metric`
+                used_metrics.add(lit)
+                if lit not in ctx.catalog:
+                    out.append(Violation(
+                        "mesh-wiring", path, node.lineno,
+                        f"{callee}({lit!r}) is not in {CATALOG_PY} "
+                        "CATALOG"))
     mesh_sites = {s for s in ctx.fault_sites if s.startswith("mesh.")}
     if scanned_mesh_core:
         # reverse containment only when the real mesh sources were in
@@ -691,10 +702,17 @@ def rule_mesh_wiring(ctx):
                 f"mesh fault site {name!r} is registered but never "
                 "armed (fault_point/check) under "
                 "paddle_tpu/inference/mesh/"))
-        if "mesh" in ctx.event_kinds and "mesh" not in used_kinds:
+        for kind in ("mesh", "controller"):
+            if kind in ctx.event_kinds and kind not in used_kinds:
+                out.append(Violation(
+                    "mesh-wiring", RECORDER_PY, 0,
+                    f"EVENT_KINDS entry {kind!r} is never emitted by "
+                    "paddle_tpu/inference/mesh/ code"))
+        mesh_metrics = {m for m in ctx.catalog if m.startswith("mesh_")}
+        for name in sorted(mesh_metrics - used_metrics):
             out.append(Violation(
-                "mesh-wiring", RECORDER_PY, 0,
-                "EVENT_KINDS entry 'mesh' is never emitted by "
+                "mesh-wiring", CATALOG_PY, 0,
+                f"catalog metric {name!r} is never emitted by "
                 "paddle_tpu/inference/mesh/ code"))
     res_mesh = {t for t in ctx.res_ticks if t.startswith("mesh.")}
     for name in sorted(mesh_sites - res_mesh):

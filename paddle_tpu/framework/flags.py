@@ -109,6 +109,8 @@ define_flag("use_pinned_memory", True, "ref FLAGS_use_pinned_memory; jax pins ho
 define_flag("dynamic_static_unified_comm", True, "ref FLAGS_dynamic_static_unified_comm; one comm stack here by design")
 define_flag("nccl_blocking_wait", False, "ref FLAGS_nccl_blocking_wait; XLA collectives are in-program (informational)")
 define_flag("distributed_watchdog_timeout_s", 600, "step-watchdog timeout (ref: comm task watchdog)")
+define_flag("mesh_rpc_timeout_s", 30.0, "per-op reply budget for the serving-mesh transport (inference/mesh/transport.py EngineProxy); an expired wait raises typed TransportTimeout — the worker is treated gray (reply still owed), never latched lost. A request deadline_s tightens the budget per call; the pool's op_timeout_s overrides")
+define_flag("mesh_worker_accept_timeout_s", 120.0, "how long the parent waits for a spawned mesh worker's transport connection (and the worker for its parent's listener) before typed TransportTimeout; engine_spec accept_timeout_s overrides per pool")
 define_flag("stop_check_timeout", 3600, "ref FLAGS_stop_check_timeout: elastic trainer liveness window")
 define_flag("retain_grad_for_all_tensor", False, "ref FLAGS_retain_grad_for_all_tensor: keep .grad on non-leaf tensors")
 # compiled-step behavior

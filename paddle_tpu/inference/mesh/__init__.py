@@ -24,6 +24,14 @@ worker replicas — in-process or real child processes:
   ACTS: spawn + lease-register on scale_up, drain-before-tombstone on
   scale_down; any failure latches it back to advisory-only
   (`mesh.controller_act` fault site).
+- `health` (round 21) — gray-failure immunity: every transport op
+  carries a deadline budget (typed `TransportTimeout` past it, the
+  replica stays gray, never latched lost), a `HealthDetector` scores
+  per-replica progress into healthy / slow / dead verdicts (SLOW is
+  demoted from routing, only DEAD walks the replica_down path), and
+  the router hedges placements that outlive a quantile latency budget
+  — first finish wins through the at-most-once commit map
+  (`mesh.net_delay` / `mesh.net_stall` fault sites).
 
 Operational story: RESILIENCE.md "Mesh runbook" + "Process mesh
 runbook"; metrics: OBSERVABILITY.md "serving mesh" rows.
@@ -33,16 +41,20 @@ from .controller import MeshController
 from .handoff import (HandoffFuture, KVHandoffError, hand_off,
                       hand_off_async, pack_record, unpack_record,
                       wire_size)
+from .health import HealthDetector, LatencyBudget, VERDICTS
 from .replica import Replica, ReplicaPool, ROLES
 from .router import MeshRequest, MeshRouter
 from .transport import (EngineProxy, LoopbackClient, ProcessReplica,
                         ProcessReplicaPool, SocketClient, TransportError,
-                        pack_frame, serve_request, unpack_frame)
+                        TransportTimeout, pack_frame, serve_request,
+                        unpack_frame)
 
 __all__ = ["KVHandoffError", "hand_off", "hand_off_async",
            "HandoffFuture", "pack_record", "unpack_record", "wire_size",
            "Replica", "ReplicaPool", "ROLES", "MeshRequest",
-           "MeshRouter", "TransportError", "pack_frame", "unpack_frame",
+           "MeshRouter", "TransportError", "TransportTimeout",
+           "pack_frame", "unpack_frame",
            "serve_request", "LoopbackClient", "SocketClient",
            "EngineProxy", "ProcessReplica", "ProcessReplicaPool",
-           "MeshController"]
+           "MeshController", "HealthDetector", "LatencyBudget",
+           "VERDICTS"]

@@ -30,6 +30,19 @@ is deterministic, sampled lanes key the device PRNG on (seed, absolute
 position)). Greedy mesh streams are byte-identical to a single-replica
 run (test-pinned).
 
+Gray failure (round 21): slowness and death are distinct signals. A
+HealthDetector (health.py) scores every replica's progress per pump —
+a busy replica whose counters stop moving accrues phi-style suspicion,
+trips SLOW (demoted out of `_ranked`, no new placements, counted) and
+only past a much larger threshold DEAD (the existing replica_down
+path). Placements that outlive a latency budget (quantile of observed
+service via THE shared estimator) are HEDGED: a speculative duplicate
+starts on the next-best replica, first finish wins through the same
+at-most-once commit map, and the loser is withdrawn (engine.cancel).
+Streams parked mid-handoff past their deadline_s finish reason=timeout
+here — the one place that can see them (neither engine holds the
+stream while its bytes are on the wire).
+
 Simulated-parallel clock: replicas are in-process workers stepped
 round-robin, so real wall time is serial. Each pump records every
 replica's step wall; `sim_parallel_wall_s` sums the per-round MAXIMUM —
@@ -55,6 +68,7 @@ from ..prefix_cache import affinity_key
 from ..serving import BackpressureError
 from ..scheduler import PRIORITY_CLASSES
 from .handoff import KVHandoffError, hand_off_async
+from .health import HealthDetector, LatencyBudget
 
 __all__ = ["MeshRequest", "MeshRouter"]
 
@@ -80,7 +94,8 @@ class MeshRequest:
                  "deadline_s", "tenant", "priority", "trace_id",
                  "t_arrival", "t_deadline", "t_first", "generated",
                  "done", "finish_reason", "phase", "replica",
-                 "local_rid", "hops", "force_local")
+                 "local_rid", "hops", "force_local", "t_placed",
+                 "hedges")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
                  do_sample, temperature, top_k, top_p, seed, deadline_s,
@@ -112,6 +127,9 @@ class MeshRequest:
         self.hops = 0               # times routed (1 = no failover)
         self.force_local = False    # re-prefill fallback: serve fully
                                     # on a decode replica, no handoff
+        self.t_placed = None        # when the live placement started
+        self.hedges = []            # speculative duplicate placements:
+                                    # [(replica name, local rid), ...]
 
 
 class _AdmissionView:
@@ -137,7 +155,8 @@ class MeshRouter:
     """
 
     def __init__(self, pool, scheduler=None, max_queue=None,
-                 handoff_retry=None, collector="auto", advisor=None):
+                 handoff_retry=None, collector="auto", advisor=None,
+                 health="auto", hedge_budget_s="auto"):
         self.pool = pool
         self.scheduler = scheduler  # admission ORDER only (DRR pick);
                                     # per-replica brownout stays on the
@@ -173,6 +192,18 @@ class MeshRouter:
         self._affinity: dict[bytes, str] = {}
         self._affinity_bs = int(pool[0].engine.pool.block_size)
         self._failovers: dict[str, int] = {}
+        # round 21: gray-failure machinery. The detector scores every
+        # replica's progress each pump (SLOW names sit in _slow and are
+        # demoted from _ranked); the LatencyBudget turns observed
+        # placed->commit service into the hedging trigger.
+        # health: "auto" -> HealthDetector(), None -> off, or a
+        # preconfigured detector (drills tighten its thresholds).
+        # hedge_budget_s: "auto" -> quantile budget, None -> hedging
+        # off, float -> fixed budget (tests pin it).
+        self.health = HealthDetector() if health == "auto" else health
+        self._slow: set[str] = set()
+        self._hedge_budget_s = hedge_budget_s
+        self._service = LatencyBudget()
         self._arrivals: deque[float] = deque(maxlen=256)
         self._t0 = time.perf_counter()
         self.sim_parallel_wall_s = 0.0
@@ -252,7 +283,9 @@ class MeshRouter:
             self.sim_parallel_wall_s += max(busy)
             self.serial_wall_s += sum(busy)
             self.rounds += 1
+        self._observe_health()
         self._pump_handoffs()
+        self._maybe_hedge()
         self._harvest()
         if self.collector is not None:
             # sample the plane LAST so the tick sees this pump's state;
@@ -310,9 +343,12 @@ class MeshRouter:
         replicas priced at the calibrated mean, 1s cold, so new workers
         still draw traffic and calibrate), then name. The slo_headroom
         gauge (1 - offered rate x svc) is exported per pick."""
-        # controller scale-down victims take no NEW work while they
-        # drain — unless they are all that's left (hint, never a wall)
-        active = [r for r in reps if not r.draining] or reps
+        # controller scale-down victims and SLOW-demoted (health
+        # detector) replicas take no NEW work — unless they are all
+        # that's left (hint, never a wall)
+        active = ([r for r in reps
+                   if not r.draining and r.name not in self._slow]
+                  or [r for r in reps if not r.draining] or reps)
         rate = self._offered_rate() / max(1, len(active))
         svcs = {rep: rep.engine.predicted_service_seconds()
                 for rep in active}
@@ -396,6 +432,7 @@ class MeshRouter:
             mreq.phase = "placed"
             mreq.replica = rep.name
             mreq.local_rid = local_rid
+            mreq.t_placed = time.perf_counter()
             mreq.hops += 1
             rep.routed += 1
             self._local[(rep.name, local_rid)] = mreq
@@ -437,18 +474,34 @@ class MeshRouter:
     def _expire_queued(self):
         """Router-side deadline expiry for requests still in the front
         queue (all replicas saturated / breakers open): same degraded
-        'timeout' completion the engine gives its own queue."""
+        'timeout' completion the engine gives its own queue. ALSO sweeps
+        streams that exist only between replicas — exported records
+        waiting delivery (_handoff_q) and parked async handoffs
+        (_pending_handoffs): the prefill engine already released them
+        and the decode engine has not admitted them, so neither engine's
+        own sweep can see them. A late-landing import for an expired
+        stream is withdrawn by _poll_pending's done-cleanup, releasing
+        the decode side's blocks."""
         now = time.perf_counter()
-        if not any(m.t_deadline is not None and now >= m.t_deadline
-                   for m in self.queue):
-            return
-        kept = deque()
-        for mreq in self.queue:
-            if mreq.t_deadline is not None and now >= mreq.t_deadline:
-                self._commit(mreq, mreq, "timeout")
-            else:
-                kept.append(mreq)
-        self.queue = kept
+        if any(m.t_deadline is not None and now >= m.t_deadline
+               for m in self.queue):
+            kept = deque()
+            for mreq in self.queue:
+                if mreq.t_deadline is not None and now >= mreq.t_deadline:
+                    self._commit(mreq, mreq, "timeout")
+                else:
+                    kept.append(mreq)
+            self.queue = kept
+        for record in list(self._handoff_q) + [e[1] for e
+                                               in self._pending_handoffs]:
+            mreq = self._by_trace.get(record["trace_id"])
+            if (mreq is None or mreq.done or mreq.t_deadline is None
+                    or now < mreq.t_deadline):
+                continue
+            _metric("serving_timeouts_total", where="handoff").inc()
+            if self._rec.enabled:
+                self._rec.record("timeout", rid=mreq.rid, where="handoff")
+            self._commit(mreq, mreq, "timeout")
 
     # --- disaggregated handoff -------------------------------------------
     def _sink(self, record):
@@ -486,7 +539,7 @@ class MeshRouter:
                 # decode pump; the stream parks only on completion
                 mreq.phase = "handoff_pending"
                 self._pending_handoffs.append(
-                    (fut, record, rep.name, tried))
+                    (fut, record, rep.name, tried, time.perf_counter()))
                 if self._rec.enabled:
                     self._rec.record("mesh", action="handoff_async",
                                      replica=rep.name,
@@ -508,15 +561,21 @@ class MeshRouter:
             return
         self._re_prefill(mreq, rejected)
 
-    def _poll_pending(self, fut, record, rname, tried):
+    def _poll_pending(self, fut, record, rname, tried, t0):
         """Progress one in-flight async handoff; unresolved futures go
         back on the pending list, completed ones settle through the
         same classification as the synchronous path."""
         if not fut.done():
-            self._pending_handoffs.append((fut, record, rname, tried))
+            self._pending_handoffs.append((fut, record, rname, tried, t0))
             return
         mreq = self._by_trace.get(record["trace_id"])
         if mreq is None or mreq.done:
+            # the stream no longer needs this import (its hedge sibling
+            # committed first, or its deadline expired while parked) —
+            # if the copy landed anyway, withdraw the duplicate so the
+            # decode side's pool blocks release instead of a ghost
+            # stream decoding to nowhere
+            self._withdraw_import(fut, record, rname, mreq)
             return
         rep = self.pool.by_name(rname)
         if not rep.alive:
@@ -572,6 +631,178 @@ class MeshRouter:
             self._rec.record("mesh", action="re_prefill",
                              rejected=rejected, trace=mreq.trace_id)
 
+    def _withdraw_import(self, fut, record, rname, mreq):
+        """A landed import whose stream is already settled elsewhere:
+        cancel it on the decode worker (idempotent server-side; the
+        commit map would drop its tokens anyway — this just stops the
+        wasted decode and frees the blocks)."""
+        try:
+            local_rid, _nbytes, _retries = fut.result()
+        except Exception:   # noqa: BLE001 — failed delivery, nothing to undo
+            return
+        rep = self.pool.by_name(rname)
+        cancel = getattr(rep.engine, "cancel", None)
+        if rep.alive and cancel is not None:
+            # map the duplicate into the commit graveyard FIRST: if the
+            # cancel races a same-pump finish, harvest still drops it
+            if mreq is not None:
+                self._local[(rname, local_rid)] = mreq
+            try:
+                cancel(local_rid)
+            except _TRANSIENT:
+                pass
+        if self._rec.enabled:
+            self._rec.record("mesh", action="import_withdrawn",
+                             replica=rname, trace=record.get("trace_id"))
+
+    # --- gray failure: progress health + hedged recovery -----------------
+    def _observe_health(self):
+        """Feed the detector one observation per alive replica and act
+        on the verdict: SLOW demotes (reversibly) out of _ranked, DEAD
+        walks the existing replica_down path. Progress is the counters
+        that only move when the worker actually answers (steps credited,
+        streams harvested, tokens committed) — a worker whose step reply
+        is parked past its budget reports dt=0 and freezes all three."""
+        if self.health is None:
+            return
+        now = time.perf_counter()
+        for rep in self.pool.alive():
+            progress = (rep.steps, rep.finished_count, rep.tokens_out)
+            busy = bool(rep.engine.has_work())
+            verdict, phi = self.health.observe(rep.name, now, busy,
+                                               progress)
+            _metric("mesh_replica_suspicion", replica=rep.name).set(phi)
+            if verdict == "dead" and len(self.pool.alive()) > 1:
+                self._slow.discard(rep.name)
+                if self._rec.enabled:
+                    self._rec.record("mesh", action="health_dead",
+                                     replica=rep.name, phi=round(phi, 2))
+                self.kill_replica(rep.name, why="health_dead")
+            elif verdict != "healthy" and rep.name not in self._slow:
+                # "dead" with no survivor also lands here: demote-only
+                # (killing the last replica would serve nobody)
+                self._slow.add(rep.name)
+                _metric("mesh_slow_demotions_total",
+                        replica=rep.name).inc()
+                if self._rec.enabled:
+                    self._rec.record("mesh", action="health_slow",
+                                     replica=rep.name, phi=round(phi, 2))
+            elif verdict == "healthy" and rep.name in self._slow:
+                self._slow.discard(rep.name)
+                if self._rec.enabled:
+                    self._rec.record("mesh", action="health_recovered",
+                                     replica=rep.name)
+
+    def _hedge_budget(self):
+        if self._hedge_budget_s == "auto":
+            return self._service.budget()    # None until calibrated
+        return self._hedge_budget_s          # None = off, float = fixed
+
+    def _maybe_hedge(self):
+        """Speculative duplicates for work that outlived the latency
+        budget: a parked handoff whose copy never completes, or an
+        in-flight placement stuck on a prefill-role or SLOW replica.
+        One hedge per stream; first finish wins through the commit map
+        (the loser is withdrawn), so greedy streams stay byte-identical
+        whether the original or the hedge lands first."""
+        budget = self._hedge_budget()
+        if budget is None:
+            return
+        now = time.perf_counter()
+        for _fut, record, rname, _tried, t0 in list(self._pending_handoffs):
+            if now - t0 <= budget:
+                continue
+            mreq = self._by_trace.get(record["trace_id"])
+            if mreq is None or mreq.done or mreq.hedges:
+                continue
+            self._launch_hedge(mreq, exclude={rname})
+        for mreq in list(self._open.values()):
+            if (mreq.done or mreq.hedges or mreq.phase != "placed"
+                    or mreq.replica is None or mreq.t_placed is None
+                    or now - mreq.t_placed <= budget):
+                continue
+            try:
+                rep = self.pool.by_name(mreq.replica)
+            except KeyError:
+                continue
+            if not rep.alive:
+                continue        # _failover_dead owns dead-replica streams
+            if rep.role == "prefill" or rep.name in self._slow:
+                self._launch_hedge(mreq, exclude={mreq.replica})
+
+    def _launch_hedge(self, mreq, exclude):
+        """Place a full-service duplicate (prompt re-prefill, same
+        identity) on the best replica not in `exclude`; True when one
+        started. The duplicate adopts the same trace so either finish
+        commits the same stream."""
+        cands = [r for r in self._ranked(self.pool.decode_targets()
+                                         or self.pool.alive())
+                 if r.name not in exclude]
+        for rep in cands:
+            if not rep.breaker.allow():
+                continue
+            try:
+                local_rid = rep.engine.add_request(
+                    mreq.prompt, max_new_tokens=mreq.max_new_tokens,
+                    eos_token_id=mreq.eos_token_id,
+                    do_sample=mreq.do_sample,
+                    temperature=mreq.temperature, top_k=mreq.top_k,
+                    top_p=mreq.top_p, seed=mreq.seed,
+                    deadline_s=mreq.deadline_s, tenant=mreq.tenant,
+                    priority=mreq.priority)
+            except BackpressureError:
+                continue
+            rep.engine.adopt_identity(local_rid, mreq.trace_id,
+                                      mreq.t_arrival)
+            rep.routed += 1
+            mreq.hedges.append((rep.name, local_rid))
+            self._local[(rep.name, local_rid)] = mreq
+            _metric("mesh_hedges_total", outcome="launched").inc()
+            if self._rec.enabled:
+                self._rec.record("mesh", action="hedge",
+                                 replica=rep.name, trace=mreq.trace_id)
+            if self._tracer.enabled:
+                self._tracer.add_span(
+                    "mesh.hedge", time.perf_counter_ns(), 0,
+                    trace_id=mreq.trace_id, args={"replica": rep.name})
+            return True
+        return False
+
+    def _settle_hedges(self, mreq, winner):
+        """First finish won: withdraw every losing placement from its
+        worker. The _local entries STAY — if a cancel races a finish,
+        harvest pops the duplicate and _commit's idempotence drops it
+        unread (the original at-most-once contract)."""
+        placements = []
+        if mreq.replica is not None and mreq.local_rid is not None:
+            placements.append((mreq.replica, mreq.local_rid))
+        placements.extend(mreq.hedges)
+        if winner is not None and winner in mreq.hedges:
+            _metric("mesh_hedges_total", outcome="win").inc()
+            if self._rec.enabled:
+                self._rec.record("mesh", action="hedge_win",
+                                 replica=winner[0], trace=mreq.trace_id)
+        for key in placements:
+            if key == winner:
+                continue
+            try:
+                rep = self.pool.by_name(key[0])
+            except KeyError:
+                continue
+            cancel = getattr(rep.engine, "cancel", None)
+            if not rep.alive or cancel is None:
+                continue
+            try:
+                if cancel(key[1]):
+                    _metric("mesh_hedges_total",
+                            outcome="cancelled").inc()
+                    if self._rec.enabled:
+                        self._rec.record("mesh", action="hedge_cancel",
+                                         replica=key[0],
+                                         trace=mreq.trace_id)
+            except _TRANSIENT:
+                pass
+
     # --- failover --------------------------------------------------------
     def kill_replica(self, name, why="drill"):
         """Lose a worker: tombstone its lease (pool.kill) and re-route
@@ -581,6 +812,9 @@ class MeshRouter:
         if not rep.alive:
             return
         self.pool.kill(name)
+        self._slow.discard(name)
+        if self.health is not None:
+            self.health.forget(name)    # a respawn starts clean
         if self._rec.enabled:
             self._rec.record("mesh", action="kill", replica=name, why=why)
         self._failover_dead()
@@ -622,7 +856,7 @@ class MeshRouter:
             self.queue.append(mreq)
 
     # --- commit (at most once per stream) --------------------------------
-    def _commit(self, mreq, rec, reason=None):
+    def _commit(self, mreq, rec, reason=None, winner=None):
         if mreq.done:
             return
         mreq.done = True
@@ -632,12 +866,19 @@ class MeshRouter:
         self.finished[mreq.rid] = rec
         self._open.pop(mreq.rid, None)
         self._by_trace.pop(mreq.trace_id, None)
+        if rec is not mreq and mreq.t_placed is not None:
+            # real service only (router-side timeouts would poison the
+            # quantile the hedging budget is derived from)
+            self._service.observe(time.perf_counter() - mreq.t_placed)
+        if mreq.hedges:
+            self._settle_hedges(mreq, winner)
 
     def _harvest(self):
         """Pull finished requests off alive replicas into the mesh
         result. A stream commits exactly once: the commit map's first
         finish wins, later duplicates (a re-routed stream whose original
-        replica was thought dead) are dropped unread."""
+        replica was thought dead, or a hedge's losing sibling) are
+        dropped unread."""
         for rep in self.pool.alive():
             eng = rep.engine
             if not eng.finished:
@@ -649,7 +890,7 @@ class MeshRouter:
                 req = eng.finished.pop(local_rid)
                 rep.finished_count += 1
                 rep.tokens_out += len(req.generated)
-                self._commit(mreq, req)
+                self._commit(mreq, req, winner=(rep.name, local_rid))
 
     # --- telemetry aggregation -------------------------------------------
     def _advise(self):
@@ -687,6 +928,11 @@ class MeshRouter:
             "routed": sum(rep.routed for rep in self.pool),
             "handoffs": dict(self._handoffs),
             "failovers": dict(self._failovers),
+            "slow": sorted(self._slow),
+            "suspicion": ({rep.name: round(self.health.suspicion(
+                rep.name, time.perf_counter()), 3)
+                for rep in self.pool.alive()}
+                if self.health is not None else {}),
             "open": sum(1 for m in self._open.values() if not m.done),
             "committed_tokens": committed_tokens,
             "rounds": self.rounds,

@@ -46,14 +46,16 @@ from collections import deque
 import numpy as np
 
 from ...distributed.fleet.elastic import ElasticManager
+from ...framework.flags import flag_value
 from ...observability.catalog import metric as _metric
-from ...resilience.faults import FaultInjected, fault_point
+from ...resilience.faults import FaultInjected, check, fault_point
 from ...resilience.retry import RetryPolicy
 from ..serving import BackpressureError
 from .handoff import pack_record, unpack_record
 from .replica import Replica, ReplicaPool
 
-__all__ = ["TRANSPORT_VERSION", "TransportError", "TransportFuture",
+__all__ = ["TRANSPORT_VERSION", "TransportError", "TransportTimeout",
+           "TransportFuture",
            "pack_frame", "unpack_frame", "send_frame", "recv_frame",
            "serve_request", "LoopbackClient", "SocketClient",
            "EngineProxy", "ProcessReplica", "ProcessReplicaPool"]
@@ -63,6 +65,14 @@ _TRANSIENT = (TimeoutError, ConnectionError, OSError, FaultInjected)
 TRANSPORT_VERSION = 1
 _MAGIC = b"PTMW"        # paddle_tpu mesh worker
 
+# network-chaos windows (round 21): how long a held reply stays hostage
+# when the mesh.net_delay / mesh.net_stall sites are armed. The stall is
+# deliberately SHORTER than the health detector's dead_elapsed_s default
+# (2.0s) so a drill proves SLOW trips before DEAD.
+_NET_DELAY_S = 0.05
+_NET_STALL_S = 0.75
+_DRAIN_SLICE_S = 0.02   # select granularity of a blocking drain
+
 
 class TransportError(ConnectionError):
     """A framed round trip that could not be completed (send failed past
@@ -70,6 +80,15 @@ class TransportError(ConnectionError):
     arrived). Subclasses ConnectionError ON PURPOSE: every _TRANSIENT
     classifier in the mesh (handoff re-prefill, router failover) already
     knows how to recover from one."""
+
+
+class TransportTimeout(TransportError):
+    """A reply that did not land within its op budget (round 21). Still
+    a TransportError — every transient classifier absorbs it — but the
+    MEANING differs: the worker is gray (slow, owed a reply that stays
+    pending), not dead, so callers must NOT latch the proxy lost on it.
+    The health detector, not the timeout, decides when gray becomes
+    dead."""
 
 
 # --- frames ----------------------------------------------------------------
@@ -108,22 +127,52 @@ def send_frame(sock, kind, meta=None, payload=b""):
     sock.sendall(pack_frame(kind, meta, payload))
 
 
-def _recv_exact(sock, n):
+def _recv_exact(sock, n, deadline=None):
+    """Read exactly n bytes. `deadline` is an absolute perf_counter
+    time; past it the read raises typed TransportTimeout (a half-open
+    peer can no longer hang the caller forever — the round-20 drain
+    blocked here with no way out)."""
     out = bytearray()
     while len(out) < n:
-        chunk = sock.recv(n - len(out))
+        if deadline is not None:
+            rem = deadline - time.perf_counter()
+            if rem <= 0.0:
+                raise TransportTimeout(
+                    f"frame receive expired mid-frame "
+                    f"({len(out)}/{n} bytes)")
+            sock.settimeout(rem)
+        try:
+            chunk = sock.recv(n - len(out))
+        except socket.timeout:
+            raise TransportTimeout(
+                f"frame receive expired mid-frame "
+                f"({len(out)}/{n} bytes)") from None
         if not chunk:
             raise TransportError("peer closed mid-frame")
         out.extend(chunk)
     return bytes(out)
 
 
-def recv_frame(sock):
-    prefix = _recv_exact(sock, 12)
-    magic, hlen, plen = struct.unpack("<4sII", prefix)
-    if magic != _MAGIC:
-        raise TransportError(f"bad frame magic {magic!r}")
-    return unpack_frame(prefix + _recv_exact(sock, hlen + plen))
+def recv_frame(sock, timeout=None):
+    """Receive one frame; with `timeout` the WHOLE frame (prefix +
+    header + payload) must land within that many seconds or typed
+    TransportTimeout raises. Default stays blocking (the worker's serve
+    loop legitimately waits forever for its parent)."""
+    deadline = (None if timeout is None
+                else time.perf_counter() + float(timeout))
+    try:
+        prefix = _recv_exact(sock, 12, deadline)
+        magic, hlen, plen = struct.unpack("<4sII", prefix)
+        if magic != _MAGIC:
+            raise TransportError(f"bad frame magic {magic!r}")
+        return unpack_frame(prefix + _recv_exact(sock, hlen + plen,
+                                                 deadline))
+    finally:
+        if timeout is not None:
+            try:
+                sock.settimeout(None)
+            except OSError:
+                pass
 
 
 # --- server-side dispatch ---------------------------------------------------
@@ -132,8 +181,11 @@ def recv_frame(sock):
 # SAME op surface and marshalling.
 
 # error bases a client can rehydrate typed; everything else surfaces as
-# TransportError on the caller side
-_ERROR_BASES = (("BackpressureError", BackpressureError),
+# TransportError on the caller side. TimeoutError first: it subclasses
+# OSError/ConnectionError in spirit but none of the bases below, and a
+# worker-side deadline rejection must come back typed, not RuntimeError.
+_ERROR_BASES = (("TimeoutError", TimeoutError),
+                ("BackpressureError", BackpressureError),
                 ("MemoryError", MemoryError),
                 ("ValueError", ValueError),
                 ("KeyError", KeyError))
@@ -147,8 +199,14 @@ def _marshal_error(e):
 
 
 def _rehydrate(meta):
-    cls = dict(_ERROR_BASES).get(meta.get("base"))
+    base = meta.get("base")
     msg = f"{meta.get('etype')}: {meta.get('msg')}"
+    if base == "TimeoutError":
+        # a worker-side deadline rejection lands client-side as the
+        # transport's own timeout type, so one except-clause covers
+        # "reply too late" and "work refused as already expired"
+        return TransportTimeout(msg)
+    cls = dict(_ERROR_BASES).get(base)
     return cls(msg) if cls is not None else TransportError(msg)
 
 
@@ -167,8 +225,21 @@ def serve_request(engine, kind, meta, payload, exports=None):
     frame parts (kind, meta, payload). `exports` is the worker-held
     list its prefill_sink appends to — drained into every step reply so
     handoff records reach the router without a side channel. Exceptions
-    marshal as an error frame (never a torn reply)."""
+    marshal as an error frame (never a torn reply).
+
+    `meta["deadline"]` (round 21) is the REMAINING seconds of the op's
+    client-side budget at send time, popped before dispatch. Work that
+    arrives already expired is rejected typed (TimeoutError base —
+    rehydrates as TransportTimeout) instead of admitted: the engine
+    would only expire it later with the blocks already spent."""
+    meta = dict(meta or {})
+    deadline = meta.pop("deadline", None)
     try:
+        if (deadline is not None and float(deadline) <= 0.0
+                and kind in ("add_request", "import_kv")):
+            _metric("mesh_rpc_timeouts_total", op=kind).inc()
+            raise TimeoutError(
+                f"{kind} rejected: deadline expired before dispatch")
         if kind == "ping":
             return "ok", {"pid": os.getpid(),
                           "vocab": int(engine.embed_w.shape[0]),
@@ -177,6 +248,9 @@ def serve_request(engine, kind, meta, payload, exports=None):
             prompt = np.frombuffer(payload, np.int32)
             rid = engine.add_request(prompt, **meta)
             return "ok", {"rid": int(rid)}, b""
+        if kind == "cancel":
+            ok = bool(engine.cancel(int(meta["rid"])))
+            return "ok", {"cancelled": ok}, b""
         if kind == "adopt":
             ok = engine.adopt_identity(meta["rid"], meta["trace_id"],
                                        meta.get("t_arrival"))
@@ -229,16 +303,26 @@ class TransportFuture:
     """Delivery-complete handle for one asynchronous round trip. done()
     is a non-blocking poll; result() forces completion (draining the
     socket for real workers, counting down the simulated latency for
-    loopback). Exceptions re-raise from result()."""
+    loopback). Exceptions re-raise from result().
 
-    __slots__ = ("_client", "_resolved", "_value", "_exc", "_polls_left")
+    result(timeout=...) bounds the wait: past the budget it raises typed
+    TransportTimeout and counts `mesh_rpc_timeouts_total{op}` — the
+    future stays pending (the reply is still owed; a later drain settles
+    it), which is exactly the gray-failure shape: slow, not dead."""
 
-    def __init__(self, client=None, polls=0):
+    __slots__ = ("_client", "_resolved", "_value", "_exc", "_polls_left",
+                 "_kind", "_ready_at")
+
+    def __init__(self, client=None, polls=0, kind=None):
         self._client = client
         self._resolved = False
         self._value = None
         self._exc = None
         self._polls_left = int(polls)
+        self._kind = kind
+        # wall-clock hold (mesh.net_delay / mesh.net_stall on loopback):
+        # the reply exists but has not "landed" before this time
+        self._ready_at = None
 
     def _complete(self, value):
         self._resolved = True
@@ -251,19 +335,42 @@ class TransportFuture:
     def done(self):
         if not self._resolved and self._client is not None:
             self._client._drain(block=False)
-        if self._resolved and self._polls_left > 0:
+        if not self._resolved:
+            return False
+        if self._ready_at is not None:
+            if time.perf_counter() < self._ready_at:
+                return False
+            self._ready_at = None
+        if self._polls_left > 0:
             # loopback latency model: the copy "lands" only after this
             # many polls — the deterministic stand-in for a NIC transfer
             # overlapping the decode pump
             self._polls_left -= 1
             return False
-        return self._resolved
+        return True
 
-    def result(self):
+    def _timed_out(self, timeout):
+        op = self._kind or "unknown"
+        _metric("mesh_rpc_timeouts_total", op=op).inc()
+        raise TransportTimeout(
+            f"reply for {op!r} still owed past the "
+            f"{timeout}s op budget (gray, not dead)")
+
+    def result(self, timeout=None):
+        deadline = (None if timeout is None
+                    else time.perf_counter() + float(timeout))
         while not self._resolved:
             if self._client is None:
                 raise TransportError("future abandoned with no client")
-            self._client._drain(block=True)
+            self._client._drain(block=True, deadline=deadline)
+        while self._ready_at is not None:
+            now = time.perf_counter()
+            if now >= self._ready_at:
+                self._ready_at = None
+                break
+            if deadline is not None and now >= deadline:
+                self._timed_out(timeout)
+            time.sleep(min(0.0005, self._ready_at - now))
         self._polls_left = 0
         if self._exc is not None:
             raise self._exc
@@ -304,11 +411,12 @@ class _ClientBase:
         else:
             fut._complete((meta, payload))
 
-    def call(self, kind, meta=None, payload=b""):
-        """Synchronous round trip -> (meta, payload)."""
-        return self.call_async(kind, meta, payload).result()
+    def call(self, kind, meta=None, payload=b"", timeout=None):
+        """Synchronous round trip -> (meta, payload). `timeout` bounds
+        the reply wait (typed TransportTimeout past it)."""
+        return self.call_async(kind, meta, payload).result(timeout=timeout)
 
-    def _drain(self, block):
+    def _drain(self, block, deadline=None):
         raise NotImplementedError
 
     def close(self):
@@ -336,13 +444,26 @@ class LoopbackClient(_ClientBase):
 
     def call_async(self, kind, meta=None, payload=b""):
         fut = TransportFuture(polls=(self.latency_polls
-                                     if kind == "import_kv" else 0))
+                                     if kind == "import_kv" else 0),
+                              kind=kind)
         try:
             reply = self._guarded_send(
                 kind, lambda: self._roundtrip(kind, meta, payload))
         except TransportError as e:
             fut._fail(e)
             return fut
+        # network chaos: a delayed reply lands a beat late; a stalled
+        # one is held hostage for a gray-failure window — the loopback
+        # model of a saturated NIC or a paused peer. The dispatch above
+        # already HAPPENED worker-side; only the reply is late, which is
+        # exactly what makes gray failures nastier than crashes.
+        hold = 0.0
+        if check("mesh.net_delay"):
+            hold = _NET_DELAY_S
+        if check("mesh.net_stall"):
+            hold = _NET_STALL_S
+        if hold > 0.0:
+            fut._ready_at = time.perf_counter() + hold
         self._settle(fut, reply)
         return fut
 
@@ -358,9 +479,11 @@ class SocketClient(_ClientBase):
         super().__init__(retry)
         self.sock = sock
         self._pending: deque[TransportFuture] = deque()
+        self._rxbuf = bytearray()     # partial frames survive a timeout
+        self._stall_until = 0.0       # mesh.net_stall hostage window
 
     def call_async(self, kind, meta=None, payload=b""):
-        fut = TransportFuture(client=self)
+        fut = TransportFuture(client=self, kind=kind)
         try:
             self._guarded_send(
                 kind, lambda: send_frame(self.sock, kind, meta, payload))
@@ -370,24 +493,94 @@ class SocketClient(_ClientBase):
         self._pending.append(fut)
         return fut
 
-    def _drain(self, block):
+    def _pop_frame(self):
+        """One complete frame parsed off the receive buffer, else None.
+        A truncated tail STAYS buffered — a timed-out wait never loses
+        mid-frame bytes, so the late reply is still whole when the next
+        drain resumes it (the round-20 blocking recv_frame could only
+        hang or tear here)."""
+        buf = self._rxbuf
+        if len(buf) < 12:
+            return None
+        magic, hlen, plen = struct.unpack_from("<4sII", buf, 0)
+        if magic != _MAGIC:
+            raise TransportError(f"bad frame magic {magic!r}")
+        end = 12 + hlen + plen
+        if len(buf) < end:
+            return None
+        frame = bytes(buf[:end])
+        del buf[:end]
+        return unpack_frame(frame)
+
+    def _fatal(self, err, cause=None):
+        """Hard transport death (peer closed, torn stream): every owed
+        reply is unrecoverable — fail them all. Deadline expiry NEVER
+        comes through here."""
+        if cause is not None:
+            err.__cause__ = cause
         while self._pending:
-            if not block:
-                import select
-                ready, _w, _x = select.select([self.sock], [], [], 0)
-                if not ready:
-                    return
+            self._pending.popleft()._fail(err)
+        raise err
+
+    def _drain(self, block, deadline=None):
+        """Settle owed replies. Non-blocking: consume whatever the
+        kernel already holds. Blocking: wait in short select slices
+        until ONE reply settles or `deadline` (absolute perf_counter)
+        passes — expiry raises typed TransportTimeout with `_pending`
+        PRESERVED (the worker is gray; its replies are still owed and
+        the serial order still holds)."""
+        import select
+        while self._pending:
             try:
-                reply = recv_frame(self.sock)
-            except _TRANSIENT as e:
-                err = TransportError(f"transport receive failed: {e!r}")
-                err.__cause__ = e
-                while self._pending:
-                    self._pending.popleft()._fail(err)
-                raise err
-            self._settle(self._pending.popleft(), reply)
+                frame = self._pop_frame()
+            except TransportError as e:
+                self._fatal(e)
+            if frame is not None:
+                self._settle(self._pending.popleft(), frame)
+                if block:
+                    return
+                continue
+            now = time.perf_counter()
+            if deadline is not None and now >= deadline:
+                op = self._pending[0]._kind or "unknown"
+                _metric("mesh_rpc_timeouts_total", op=op).inc()
+                raise TransportTimeout(
+                    f"reply for {op!r} not delivered within the op "
+                    "budget (worker slow or stalled; replies stay "
+                    "owed — gray, not dead)")
+            wait = 0.0
             if block:
-                return
+                wait = _DRAIN_SLICE_S
+                if deadline is not None:
+                    wait = min(wait, max(0.0, deadline - now))
+            if self._stall_until > now:
+                # a hostage reply (mesh.net_stall): refuse to read until
+                # the stall lifts — bytes wait in the kernel buffer,
+                # exactly a paused peer from this side of the wire
+                if not block:
+                    return
+                time.sleep(min(max(wait, 0.0005),
+                               self._stall_until - now))
+                continue
+            ready, _w, _x = select.select([self.sock], [], [], wait)
+            if ready and check("mesh.net_delay"):
+                ready = []      # this poll sees nothing (late packet)
+            if ready and check("mesh.net_stall"):
+                self._stall_until = now + _NET_STALL_S
+                ready = []
+            if not ready:
+                if not block:
+                    return
+                continue
+            try:
+                data = self.sock.recv(65536)
+            except _TRANSIENT as e:
+                self._fatal(
+                    TransportError(f"transport receive failed: {e!r}"),
+                    cause=e)
+            if not data:
+                self._fatal(TransportError("peer closed mid-stream"))
+            self._rxbuf += data
 
     def close(self):
         try:
@@ -449,7 +642,8 @@ class EngineProxy:
     False) and fires on_lost once so the pool can tombstone the lease —
     from the router's point of view, exactly a killed replica."""
 
-    def __init__(self, client, vocab, block_size, name="worker"):
+    def __init__(self, client, vocab, block_size, name="worker",
+                 op_timeout_s=None):
         self.client = client
         self.name = name
         self.queue = []
@@ -465,6 +659,29 @@ class EngineProxy:
         self.pool = _PoolStub(block_size)
         self._has_work = False
         self._svc = None
+        self.op_timeout_s = (float(flag_value("mesh_rpc_timeout_s"))
+                             if op_timeout_s is None
+                             else float(op_timeout_s))
+        # gray-failure bookkeeping: a step reply that missed its budget
+        # is PARKED (resumed next pump so finished streams and exports
+        # are never lost); a resource-creating RPC that missed its
+        # budget is remembered so the late-admitted work is cancelled
+        self._inflight_step = None
+        self._abandoned = []
+
+    def _budget(self, deadline_s=None, t_arrival=None):
+        """Seconds this op may wait: the per-op flag budget, tightened
+        by the request's REMAINING end-to-end deadline (router →
+        prefill → handoff → decode all draw from the same clock).
+        Clamps at 0 so an already-expired op still ships — the worker
+        rejects it typed server-side, which is the contract under test."""
+        b = self.op_timeout_s
+        if deadline_s is not None:
+            rem = (float(deadline_s) if t_arrival is None
+                   else (float(t_arrival) + float(deadline_s)
+                         - time.perf_counter()))
+            b = min(b, max(0.0, rem))
+        return b
 
     def _mark_lost(self):
         if self.lost:
@@ -477,6 +694,27 @@ class EngineProxy:
         if self.on_lost is not None:
             self.on_lost(self)
 
+    def _reap_abandoned(self):
+        """Resolve RPCs whose client-side budget expired: when the late
+        reply finally lands with a rid, that work was admitted on the
+        worker AFTER the caller gave up — withdraw it so no ghost stream
+        decodes (and no pool blocks leak)."""
+        if not self._abandoned:
+            return
+        keep = []
+        for fut in self._abandoned:
+            if not fut.done():
+                keep.append(fut)
+                continue
+            try:
+                reply, _p = fut.result()
+            except Exception:   # noqa: BLE001 — the op failed anyway
+                continue
+            rid = reply.get("rid")
+            if rid is not None:
+                self.cancel(int(rid))
+        self._abandoned = keep
+
     def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
                     do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                     seed=0, deadline_s=None, tenant="-",
@@ -484,15 +722,23 @@ class EngineProxy:
         if self.lost:
             raise BackpressureError(f"worker {self.name} lost")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        budget = self._budget(deadline_s)
         meta = {"max_new_tokens": int(max_new_tokens),
                 "eos_token_id": eos_token_id, "do_sample": bool(do_sample),
                 "temperature": float(temperature), "top_k": int(top_k),
                 "top_p": float(top_p), "seed": seed,
                 "deadline_s": deadline_s, "tenant": tenant,
-                "priority": priority}
+                "priority": priority, "deadline": budget}
+        fut = self.client.call_async("add_request", meta, prompt.tobytes())
         try:
-            reply, _p = self.client.call("add_request", meta,
-                                         prompt.tobytes())
+            reply, _p = fut.result(timeout=budget)
+        except TransportTimeout:
+            # gray: the worker may still admit late — remember the
+            # future so the eventual rid is withdrawn, and fail THIS
+            # placement without latching the replica lost
+            self._abandoned.append(fut)
+            raise BackpressureError(
+                f"worker {self.name} add_request timed out") from None
         except TransportError:
             self._mark_lost()
             raise BackpressureError(f"worker {self.name} lost") from None
@@ -506,22 +752,43 @@ class EngineProxy:
         try:
             reply, _p = self.client.call(
                 "adopt", {"rid": int(rid), "trace_id": str(trace_id),
-                          "t_arrival": t_arrival})
+                          "t_arrival": t_arrival},
+                timeout=self.op_timeout_s)
+        except TransportTimeout:
+            return False    # late 'adopted' reply drains harmlessly
         except TransportError:
             self._mark_lost()
             return False
         return bool(reply["adopted"])
 
+    def cancel(self, rid):
+        """Withdraw one stream on the worker (a hedge loser, or an RPC
+        that timed out client-side but landed late). Fire-and-forget:
+        the reply settles on a later drain, and a lost transport needs
+        no withdrawal — the work died with the process."""
+        if self.lost:
+            return False
+        self.client.call_async("cancel", {"rid": int(rid)})
+        return True
+
     def import_kv(self, record):
         """Synchronous wire import; rejection rehydrates typed
         (ValueError / MemoryError) so hand_off's classification is
         unchanged; a dead transport surfaces TransportError (transient
-        by construction)."""
+        by construction). The remaining request deadline rides the
+        frame: an import that lands expired is refused server-side
+        (TransportTimeout here → transfer-failure → re-prefill)."""
         if self.lost:
             raise TransportError(f"worker {self.name} lost")
+        budget = self._budget(record.get("deadline_s"),
+                              record.get("t_arrival"))
+        fut = self.client.call_async("import_kv", {"deadline": budget},
+                                     pack_record(record))
         try:
-            reply, _p = self.client.call("import_kv", None,
-                                         pack_record(record))
+            reply, _p = fut.result(timeout=budget)
+        except TransportTimeout:
+            self._abandoned.append(fut)     # late import = ghost stream
+            raise
         except TransportError:
             self._mark_lost()
             raise
@@ -536,18 +803,39 @@ class EngineProxy:
             fut = TransportFuture()
             fut._fail(TransportError(f"worker {self.name} lost"))
             return fut
-        fut = self.client.call_async("import_kv", None, pack_record(record))
+        budget = self._budget(record.get("deadline_s"),
+                              record.get("t_arrival"))
+        fut = self.client.call_async("import_kv", {"deadline": budget},
+                                     pack_record(record))
         self._has_work = True
         return fut
 
     def step(self):
         """One worker step; returns the WORKER-side wall seconds (the
         honest per-chip cost for the simulated-parallel clock — parent
-        IPC overhead excluded on purpose)."""
+        IPC overhead excluded on purpose). A reply that misses the op
+        budget is PARKED and resumed next pump (replies are serial, so
+        nothing is reordered): the pump reports no progress, the health
+        detector accrues suspicion, and no finished stream or export is
+        ever dropped."""
         if self.lost:
             return 0.0
+        self._reap_abandoned()
+        fut = self._inflight_step
+        self._inflight_step = None
+        if fut is None:
+            fut = self.client.call_async("step")
+            budget = self.op_timeout_s
+        else:
+            # resuming a parked reply: poll one short slice only — the
+            # pump must keep cycling so the health detector can accrue
+            # suspicion on this replica instead of the router blocking
+            budget = min(self.op_timeout_s, _DRAIN_SLICE_S)
         try:
-            reply, blob = self.client.call("step")
+            reply, blob = fut.result(timeout=budget)
+        except TransportTimeout:
+            self._inflight_step = fut
+            return 0.0
         except TransportError:
             self._mark_lost()
             return 0.0
@@ -581,7 +869,10 @@ class EngineProxy:
         if self.lost:
             return {}
         try:
-            reply, _p = self.client.call("snapshot")
+            reply, _p = self.client.call("snapshot",
+                                         timeout=self.op_timeout_s)
+        except TransportTimeout:
+            return {}   # advisory data: stale beats blocking the pump
         except TransportError:
             self._mark_lost()
             return {}
@@ -591,7 +882,7 @@ class EngineProxy:
         if self.lost:
             return
         try:
-            self.client.call("shutdown")
+            self.client.call("shutdown", timeout=self.op_timeout_s)
         except TransportError:
             pass
         self.client.close()
@@ -645,12 +936,17 @@ def _spawn_worker(name, spec, listener, worker_env=None):
          "--spec", specfile.name],
         env=env, cwd=os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))))
-    listener.settimeout(120.0)
+    accept_timeout = spec.get("accept_timeout_s")
+    if accept_timeout is None:
+        accept_timeout = flag_value("mesh_worker_accept_timeout_s")
+    listener.settimeout(float(accept_timeout))
     try:
         sock, _addr = listener.accept()
     except socket.timeout:
         proc.kill()
-        raise TransportError(f"worker {name} never connected")
+        raise TransportTimeout(
+            f"worker {name} never connected within "
+            f"{float(accept_timeout):g}s (accept expiry)") from None
     finally:
         try:
             os.unlink(specfile.name)
@@ -658,7 +954,7 @@ def _spawn_worker(name, spec, listener, worker_env=None):
             pass
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     client = SocketClient(sock)
-    hello, _p = client.call("ping")
+    hello, _p = client.call("ping", timeout=float(accept_timeout))
     return proc, client, hello
 
 
@@ -683,7 +979,8 @@ class ProcessReplicaPool(ReplicaPool):
 
     def __init__(self, build_engine=None, n=2, transport="loopback",
                  engine_spec=None, threaded_beats=False, latency_polls=0,
-                 client_retry="default", worker_env=None, **kw):
+                 client_retry="default", worker_env=None,
+                 op_timeout_s=None, **kw):
         if transport not in ("loopback", "socket"):
             raise ValueError(f"unknown transport {transport!r}")
         if transport == "socket" and engine_spec is None:
@@ -696,6 +993,7 @@ class ProcessReplicaPool(ReplicaPool):
         self.threaded_beats = bool(threaded_beats)
         self.latency_polls = int(latency_polls)
         self.worker_env = worker_env
+        self.op_timeout_s = op_timeout_s    # None -> FLAGS_mesh_rpc_timeout_s
         self._client_retry = (RetryPolicy(
             max_attempts=3, base_delay=0.001, max_delay=0.01, seed=0,
             sleep=lambda _s: None) if client_retry == "default"
@@ -723,7 +1021,8 @@ class ProcessReplicaPool(ReplicaPool):
                 LoopbackClient(engine, retry=self._client_retry,
                                latency_polls=self.latency_polls),
                 vocab=engine.embed_w.shape[0],
-                block_size=engine.pool.block_size, name=name)
+                block_size=engine.pool.block_size, name=name,
+                op_timeout_s=self.op_timeout_s)
             if role == "prefill":
                 # prefill workers export instead of decoding locally;
                 # records buffer worker-side and ride the step reply —
@@ -741,7 +1040,8 @@ class ProcessReplicaPool(ReplicaPool):
                                             self.worker_env)
         client._retry = self._client_retry
         proxy = EngineProxy(client, vocab=hello["vocab"],
-                            block_size=hello["block_size"], name=name)
+                            block_size=hello["block_size"], name=name,
+                            op_timeout_s=self.op_timeout_s)
         return ProcessReplica(name, proxy, role=role, proc=proc,
                               failure_threshold=failure_threshold,
                               reset_timeout=reset_timeout)

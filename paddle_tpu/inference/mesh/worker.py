@@ -96,8 +96,18 @@ def main(argv=None):
         except Exception:  # noqa: BLE001 — membership is the parent's
             manager = None  # problem to notice (stale lease), not ours
 
+    # connect budget mirrors the parent's accept budget: spec override,
+    # else the registered FLAGS_mesh_worker_accept_timeout_s default
+    from paddle_tpu.framework.flags import flag_value
+    connect_timeout = spec.get("accept_timeout_s")
+    if connect_timeout is None:
+        connect_timeout = flag_value("mesh_worker_accept_timeout_s")
     host, port = args.connect.rsplit(":", 1)
-    sock = socket.create_connection((host, int(port)), timeout=120)
+    sock = socket.create_connection((host, int(port)),
+                                    timeout=float(connect_timeout))
+    # the serve loop legitimately blocks forever waiting for its parent;
+    # the connect budget must not double as an idle-read timeout
+    sock.settimeout(None)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     try:
         while True:

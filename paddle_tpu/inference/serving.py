@@ -782,6 +782,39 @@ class ContinuousBatchingEngine:
             self._m_retired.inc()
             self._finish(req, "timeout")
 
+    def cancel(self, rid):
+        """Withdraw one request wherever it lives (queued, decoding, or
+        parked) WITHOUT producing a finished record: the caller already
+        has the stream's outcome from somewhere else (a hedge sibling
+        that committed first, or an RPC the client gave up on before the
+        reply landed). Pool blocks release; nothing reaches `finished`,
+        so the router's commit map never sees a duplicate. Returns
+        whether anything was withdrawn."""
+        rid = int(rid)
+        for i, req in enumerate(self.queue):
+            if req.rid == rid:
+                del self.queue[i]
+                break
+        else:
+            for lane, req in enumerate(self.lanes):
+                if req is not None and req.rid == rid:
+                    self._prefill_tasks.pop(lane, None)
+                    self.pool.release(rid)
+                    self.lanes[lane] = None
+                    self.lane_len[lane] = 0
+                    self._lane_epoch[lane] += 1
+                    self._dirty = True
+                    break
+            else:
+                if rid not in self._preempted:
+                    return False
+                self._preempted.pop(rid)
+                self.pool.release(rid)
+        self._prefix_matched.pop(rid, None)
+        if self._rec.enabled:
+            self._rec.record("sched", action="cancel", rid=rid)
+        return True
+
     def _shed(self, active):
         """Decode OOM: preempt the lane with the least work done (fewest
         generated tokens), release its blocks, and requeue the request at

@@ -89,7 +89,9 @@ CATALOG = {
         "distinguishable", ("reason",), None),
     "serving_timeouts_total": (
         "counter", "per-request deadlines expired, by where the request "
-        "was (queue/decode/preempted)", ("where",), None),
+        "was (queue/decode/preempted, plus the router-side 'handoff' "
+        "sweep for streams parked between replicas — invisible to both "
+        "engines' own sweeps)", ("where",), None),
     "serving_shed_total": (
         "counter", "decode-OOM lane sheds (request requeued for a fresh "
         "prefill, or finished 'shed' past max_sheds)", (), None),
@@ -415,6 +417,32 @@ CATALOG = {
         "verdicts (scale_up / drain_begin / scale_down / drain_forced / "
         "latch_off — latch_off means a controller failure flipped it "
         "back to advisory-only)", ("action",), None),
+    "mesh_rpc_timeouts_total": (
+        "counter", "transport op waits that expired past their budget, "
+        "by op (frame kind): client-side result()/drain expiry AND "
+        "worker-side rejection of already-expired work both count — "
+        "every one raises typed TransportTimeout, the gray-failure "
+        "signal (reply still owed, replica NOT latched lost)",
+        ("op",), None),
+    "mesh_replica_suspicion": (
+        "gauge", "per-replica phi-accrual suspicion score from the "
+        "health detector (inter-progress latency while busy; 0 = "
+        "progressing or idle; crosses the SLOW threshold before the "
+        "DEAD one by construction)", ("replica",), None),
+    "mesh_slow_demotions_total": (
+        "counter", "health-detector SLOW verdicts per replica: the "
+        "replica is demoted out of _ranked (no new placements, existing "
+        "streams keep running) until it progresses again — the gray "
+        "middle ground between healthy and the replica_down path",
+        ("replica",), None),
+    "mesh_hedges_total": (
+        "counter", "hedged recoveries, by outcome: launched (a parked "
+        "handoff or in-flight prefill outlived the latency budget and a "
+        "speculative duplicate started on the next-best replica) / win "
+        "(the hedge committed first) / cancelled (the losing duplicate "
+        "was withdrawn from its worker) — first finish wins through the "
+        "at-most-once commit map, streams byte-identical",
+        ("outcome",), None),
 
     # -- observability plane (timeseries.py sampler + mesh federation) -------
     "obs_samples_total": (

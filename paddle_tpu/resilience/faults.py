@@ -136,6 +136,20 @@ FAULT_SITES = {
                            "surfaces TransportError, and a failed "
                            "paged-KV import re-prefills on the decode "
                            "side, streams byte-identical)",
+    "mesh.net_delay": "mesh transport network chaos: one reply held a "
+                      "SHORT extra window before it lands (consulted "
+                      "via check() on the receive path of both "
+                      "transports); the op budget absorbs it — at most "
+                      "a counted TransportTimeout and a late settle, "
+                      "streams byte-identical, nobody demoted",
+    "mesh.net_stall": "mesh transport network chaos: one reply held "
+                      "hostage for a LONG gray-failure window (shorter "
+                      "than the health detector's dead threshold by "
+                      "construction); the detector trips SLOW — "
+                      "demoted in ranking, counted "
+                      "mesh_slow_demotions_total — BEFORE anything "
+                      "trips DEAD, hedged re-prefill covers the stuck "
+                      "work, and streams stay byte-identical",
     "mesh.controller_act": "mesh autoscale controller: one act() on an "
                            "AutoscaleAdvisor verdict (controller.py); "
                            "ANY failure latches the controller back to "

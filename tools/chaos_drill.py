@@ -70,6 +70,14 @@ def _counter(name, **labels):
     return (fam.labels(**labels) if labels else fam).value
 
 
+def _counter_sum(name):
+    """Total across every label set of one family (0.0 if unregistered)."""
+    fam = obs.get_registry().get(name)
+    if fam is None:
+        return 0.0
+    return sum(c.value for c in fam.children().values())
+
+
 # ---------------------------------------------------------------------------
 # scenarios — one per fault site; each returns (outcome, note)
 # ---------------------------------------------------------------------------
@@ -745,10 +753,14 @@ def drill_mesh_replica_down(tmp):
                         "accounting closed")
 
 
-def _tiny_process_mesh(n=2, disaggregate=False, port=46185, **kw):
+def _tiny_process_mesh(n=2, disaggregate=False, port=46185,
+                       op_timeout_s=None, router_kw=None, **kw):
     """N-replica loopback ProcessReplicaPool: same tiny engines, but
     every router<->worker interaction marshals through the versioned
-    frame protocol (the round-20 transport)."""
+    frame protocol (the round-20 transport). op_timeout_s tightens the
+    per-op deadline budget (the gray-failure drills need one shorter
+    than the injected stall); router_kw reaches MeshRouter (health
+    detector, hedge budget)."""
     from paddle_tpu.inference.mesh import MeshRouter, ProcessReplicaPool
     holder = {}
 
@@ -758,8 +770,8 @@ def _tiny_process_mesh(n=2, disaggregate=False, port=46185, **kw):
         return eng
 
     pool = ProcessReplicaPool(factory, n=n, disaggregate=disaggregate,
-                              store_port=port)
-    return holder["model"], pool, MeshRouter(pool)
+                              store_port=port, op_timeout_s=op_timeout_s)
+    return holder["model"], pool, MeshRouter(pool, **(router_kw or {}))
 
 
 def drill_mesh_transport_send(tmp):
@@ -852,6 +864,77 @@ def drill_mesh_controller_act(tmp):
                         "and serving stayed byte-identical")
 
 
+def drill_mesh_net_delay(tmp):
+    # a SHORT hold on one worker reply (~50 ms against a 30 s per-op
+    # budget): the deadline-aware transport absorbs it entirely —
+    # nobody times out, nobody is demoted, nothing re-routes.
+    model, pool, router = _tiny_process_mesh(port=46187)
+    prompts = [(np.arange(6) * (i + 3)) % 128 for i in range(4)]
+    refs = [_dense_ref(model, p, 6) for p in prompts]
+    rpc0 = _counter_sum("mesh_rpc_timeouts_total")
+    slow0 = _counter_sum("mesh_slow_demotions_total")
+    with faults.injected_faults("mesh.net_delay:1:TimeoutError"):
+        rids = [router.add_request(p, max_new_tokens=6) for p in prompts]
+        out = router.run()
+        inj = faults.injected_counts().get("mesh.net_delay", 0)
+    _expect(inj == 1, "fault never reached the net-delay site")
+    for rid, ref in zip(rids, refs):
+        _expect(out.get(rid) == ref,
+                "stream diverged across the delayed reply")
+    _expect(_counter_sum("mesh_rpc_timeouts_total") == rpc0,
+            "a sub-budget delay raised a transport timeout")
+    _expect(_counter_sum("mesh_slow_demotions_total") == slow0,
+            "a sub-budget delay demoted a replica")
+    _expect(len(pool.alive()) == 2, "a sub-budget delay killed a replica")
+    _expect(router.mesh_report()["open"] == 0,
+            "mesh accounting left requests open")
+    return "recovered", ("50 ms reply hold absorbed by the per-op "
+                         "deadline budget: no timeout, no demotion, "
+                         "streams byte-exact")
+
+
+def drill_mesh_net_stall(tmp):
+    # a LONG hold (~0.75 s against a 50 ms budget, well short of the
+    # dead threshold): the op times out TYPED, the health detector
+    # demotes the replica SLOW — never DEAD — the hedger covers its
+    # in-flight streams, and the first finish wins byte-identically.
+    from paddle_tpu.inference.mesh import HealthDetector
+    det = HealthDetector(slow_phi=0.5, dead_phi=50.0, slow_elapsed_s=0.1,
+                         dead_elapsed_s=10.0)
+    model, pool, router = _tiny_process_mesh(
+        port=46188, op_timeout_s=0.05,
+        router_kw={"health": det, "hedge_budget_s": 0.3})
+    prompts = [(np.arange(6) * (i + 7)) % 128 for i in range(4)]
+    refs = [_dense_ref(model, p, 8) for p in prompts]
+    rpc0 = _counter("mesh_rpc_timeouts_total", op="step")
+    slow0 = _counter_sum("mesh_slow_demotions_total")
+    down0 = _counter("mesh_failovers_total", reason="replica_down")
+    rids = [router.add_request(p, max_new_tokens=8) for p in prompts]
+    for _ in range(2):      # calibrate: land real replies first
+        router.step()
+    with faults.injected_faults("mesh.net_stall:1:TimeoutError"):
+        out = router.run()
+        inj = faults.injected_counts().get("mesh.net_stall", 0)
+    _expect(inj == 1, "fault never reached the net-stall site")
+    for rid, ref in zip(rids, refs):
+        _expect(out.get(rid) == ref,
+                "stream diverged across the stalled worker")
+    _expect(_counter("mesh_rpc_timeouts_total", op="step") > rpc0,
+            "stalled step never raised the typed transport timeout")
+    _expect(_counter_sum("mesh_slow_demotions_total") > slow0,
+            "stalled replica was never demoted SLOW")
+    _expect(len(pool.alive()) == 2,
+            "gray stall escalated to a kill (SLOW must trip before DEAD)")
+    _expect(_counter("mesh_failovers_total", reason="replica_down")
+            == down0, "gray stall walked the replica_down path")
+    rep = router.mesh_report()
+    _expect(rep["open"] == 0, "mesh accounting left requests open")
+    _expect(len(out) == len(rids), "an admitted request never completed")
+    return "degraded", ("0.75 s stall went gray: typed step timeouts, "
+                        "SLOW demotion (no kill, no replica_down), "
+                        "hedged placements, streams byte-exact")
+
+
 def drill_obs_sample(tmp):
     from paddle_tpu.observability.timeseries import MetricsSampler
     p = (np.arange(8) * 5) % 128
@@ -931,6 +1014,8 @@ SCENARIOS = {
     "mesh.kv_handoff": drill_mesh_kv_handoff,
     "mesh.replica_down": drill_mesh_replica_down,
     "mesh.transport_send": drill_mesh_transport_send,
+    "mesh.net_delay": drill_mesh_net_delay,
+    "mesh.net_stall": drill_mesh_net_stall,
     "mesh.controller_act": drill_mesh_controller_act,
     "obs.sample": drill_obs_sample,
 }

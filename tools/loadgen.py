@@ -50,6 +50,13 @@ from paddle_tpu.inference import loadgen  # noqa: E402
 from paddle_tpu.profiler.phases import get_phase_accountant  # noqa: E402
 
 
+def _counter_sum(name):
+    fam = obs.get_registry().get(name)
+    if fam is None:
+        return 0.0
+    return sum(c.value for c in fam.children().values())
+
+
 def build_engine(max_batch=4, num_blocks=128, block_size=8,
                  prefill_buckets=(16, 32), max_queue=64, **kw):
     """The harness's default engine under test: tiny llama, small paged
@@ -129,6 +136,21 @@ def main(argv=None):
                          "clients) instead of bare in-process engines; "
                          "overrides --replicas; composes with "
                          "--disaggregate")
+    ap.add_argument("--slow-replica", action="store_true",
+                    help="degrade one worker of a process mesh with a "
+                         "duty-cycled step wedge (parked replies, no "
+                         "progress while busy) so the gray-failure path "
+                         "carries the run: the health detector demotes "
+                         "it SLOW, routing avoids it, and with --check "
+                         "the run must still meet its TTFT SLO with the "
+                         "degraded worker alive; implies --processes 2 "
+                         "when no process mesh was requested")
+    ap.add_argument("--slow-ttft-burn", type=float, default=3.0,
+                    help="with --check --slow-replica: max allowed "
+                         "ttft_p95 burn rate (observed/objective) for "
+                         "the degraded run; the healthy CPU baseline "
+                         "burns ~2, a mesh that keeps placing on the "
+                         "wedged worker burns far past 3")
     ap.add_argument("--min-coverage", type=float, default=0.95)
     ap.add_argument("--dashboard", action="store_true",
                     help="render the run's embedded TSDB as a terminal "
@@ -159,6 +181,10 @@ def main(argv=None):
         kw["draft_depth"] = drafting.scenario_draft_depth(args.scenario)
         if not args.flat_drafter:
             kw["drafter"] = drafting.scenario_drafter(args.scenario)
+    if args.slow_replica and args.processes < 2:
+        # the wedge needs the process transport: only ProcessReplica
+        # freezes its progress counters when a step reply is parked
+        args.processes = 2
     if args.processes > 1 or args.replicas > 1:
         from paddle_tpu.inference.mesh import (MeshRouter,
                                                ProcessReplicaPool,
@@ -173,6 +199,29 @@ def main(argv=None):
             pool = ReplicaPool(
                 lambda: build_engine(**kw), n=args.replicas,
                 disaggregate=args.disaggregate, store_port=0)
+        victim = None
+        if args.slow_replica:
+            import time as _time
+            # wedge the last worker: every 8th real step starts a 0.6 s
+            # episode during which its step reply stays parked (0.0
+            # wall, progress counters frozen) — alive-but-wrong, the
+            # shape the health detector scores; between episodes it
+            # works normally, so the run always drains
+            victim = pool.alive()[-1]
+            _inner = victim.engine.step
+            _wedge = {"until": 0.0, "reals": 0}
+
+            def _wedged_step(_inner=_inner, _wedge=_wedge):
+                now = _time.perf_counter()
+                if now < _wedge["until"]:
+                    return 0.0
+                _wedge["reals"] += 1
+                if _wedge["reals"] % 8 == 0:
+                    _wedge["until"] = now + 0.6
+                    return 0.0
+                return _inner()
+
+            victim.engine.step = _wedged_step
         engine = MeshRouter(
             pool, scheduler=SLOScheduler() if args.scheduler else None)
     else:
@@ -222,6 +271,13 @@ def main(argv=None):
               f"failovers={mesh['failovers'] or '{}'} "
               f"sim_tok_per_s={mesh['sim_tok_per_s']} "
               f"(simulated-parallel wall)", file=sys.stderr)
+        if mesh.get("slow") or args.slow_replica:
+            print(f"# mesh health: slow={mesh.get('slow')} "
+                  f"suspicion={mesh.get('suspicion')} "
+                  f"slow_demotions="
+                  f"{_counter_sum('mesh_slow_demotions_total')} "
+                  f"hedges={_counter_sum('mesh_hedges_total')}",
+                  file=sys.stderr)
         print(f"# {'replica':10s} {'role':8s} {'alive':5s} {'routed':>6s} "
               f"{'finished':>8s} {'tok/s':>8s} {'headroom':>9s}",
               file=sys.stderr)
@@ -260,6 +316,30 @@ def main(argv=None):
                 else (0.5 if prefix_on
                       and loadgen.SCENARIOS[args.scenario].shared_prefix_len
                       else None)))
+        if args.slow_replica:
+            # the gray-failure acceptance: the wedged worker must have
+            # been demoted SLOW (never killed — that would be the crash
+            # path, not gray immunity), every request must finish, and
+            # TTFT p95 must hold within the burn bound — a mesh that
+            # fails to route around the wedge blows far past it
+            if _counter_sum("mesh_slow_demotions_total") < 1:
+                problems.append("slow-replica run never demoted the "
+                                "wedged worker SLOW")
+            if victim is not None and not victim.alive:
+                problems.append("slow-replica run killed the wedged "
+                                "worker (gray must not escalate to "
+                                "dead)")
+            for s in report["slo"].get("slos", ()):
+                if s["name"] == "ttft_p95" \
+                        and s.get("burn_rate", 0.0) > args.slow_ttft_burn:
+                    problems.append(
+                        "TTFT p95 degraded past the slow-replica bound "
+                        f"(burn {s['burn_rate']:.2f} > "
+                        f"{args.slow_ttft_burn}): the mesh did not "
+                        "route around the wedge")
+                if s["name"] == "availability" and not s.get("ok"):
+                    problems.append("requests lost with one degraded "
+                                    "worker (availability SLO breached)")
         for p in problems:
             print(f"CHECK FAIL: {p}", file=sys.stderr)
         if problems:

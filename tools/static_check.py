@@ -36,7 +36,9 @@ Rules (closed registry, like everything else here):
                        kind literals ⊆ the closed registries; every
                        registered mesh.* site armed by mesh code AND
                        backticked in RESILIENCE.md, no phantom mesh.*
-                       docs — both directions
+                       docs — both directions; health verdict literals
+                       == health.py VERDICTS == RESILIENCE.md
+                       verdict/NAME rows, both directions
   recording-rules      timeseries.py RECORDING_RULES == OBSERVABILITY.md
                        `rule/NAME` rows (both directions); rule-name
                        literals at lookup sites ⊆ the registry; the
@@ -79,6 +81,7 @@ FLAGS_PY = "paddle_tpu/framework/flags.py"
 PHASES_PY = "paddle_tpu/profiler/phases.py"
 SCHEDULER_PY = "paddle_tpu/inference/scheduler.py"
 CHAOS_PY = "tools/chaos_drill.py"
+HEALTH_PY = "paddle_tpu/inference/mesh/health.py"
 PASSES_PY = "paddle_tpu/pir/passes.py"
 TIMESERIES_PY = "paddle_tpu/observability/timeseries.py"
 OBS_MD = "OBSERVABILITY.md"
@@ -256,6 +259,9 @@ class Context:
         self.pir_flag_default = set(self.pir_flag_default_order)
         self.compiler_pass_row_order = _compiler_pass_rows()
         self.compiler_pass_rows = set(self.compiler_pass_row_order)
+        self.verdicts = _dict_keys(HEALTH_PY, "VERDICTS")
+        self.res_verdict_rows = set(re.findall(
+            r"^\| `verdict/([a-z_]+)` \|", _read(RES_MD), re.M))
         self.recording_rules = _dict_keys(TIMESERIES_PY, "RECORDING_RULES")
         self.obs_rule_rows = set(re.findall(r"^\| `rule/([a-z0-9_]+)` \|",
                                             _read(OBS_MD), re.M))
@@ -652,10 +658,35 @@ def rule_mesh_wiring(ctx):
     by mesh code and backticked in RESILIENCE.md's mesh runbook; every
     ``mesh_*`` catalog metric and the mesh-owned event kinds (``mesh``,
     ``controller``) must actually be emitted by mesh code; and
-    RESILIENCE.md may not document a phantom ``mesh.*`` site."""
+    RESILIENCE.md may not document a phantom ``mesh.*`` site.
+
+    The round-21 health verdicts close the same way: every string a
+    mesh source assigns to or compares against a ``verdict`` variable
+    must be a ``health.VERDICTS`` key, every key must be exercised by
+    mesh code, and the registry must mirror RESILIENCE.md's
+    ``verdict/NAME`` table rows in both directions."""
     out = []
     used_sites, used_kinds, used_metrics = set(), set(), set()
+    used_verdicts = set()
     scanned_mesh_core = False
+
+    def _verdict_literals(node):
+        # verdict = "slow" / verdict ==|!= "dead" (either operand order)
+        if isinstance(node, ast.Assign):
+            if any(isinstance(t, ast.Name) and t.id == "verdict"
+                   for t in node.targets) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                yield node.value.value
+        elif isinstance(node, ast.Compare):
+            operands = [node.left, *node.comparators]
+            if any(isinstance(o, ast.Name) and o.id == "verdict"
+                   for o in operands):
+                for o in operands:
+                    if isinstance(o, ast.Constant) \
+                            and isinstance(o.value, str):
+                        yield o.value
+
     for path, tree in ctx.sources.items():
         norm = path.replace(os.sep, "/")
         if not any(s in norm for s in MESH_FILES):
@@ -663,6 +694,13 @@ def rule_mesh_wiring(ctx):
         if norm.endswith("inference/mesh/router.py"):
             scanned_mesh_core = True
         for node in ast.walk(tree):
+            for lit in _verdict_literals(node):
+                used_verdicts.add(lit)
+                if lit not in ctx.verdicts:
+                    out.append(Violation(
+                        "mesh-wiring", path, node.lineno,
+                        f"verdict literal {lit!r} is not in {HEALTH_PY} "
+                        "VERDICTS"))
             if not (isinstance(node, ast.Call) and node.args
                     and isinstance(node.args[0], ast.Constant)
                     and isinstance(node.args[0].value, str)):
@@ -714,6 +752,21 @@ def rule_mesh_wiring(ctx):
                 "mesh-wiring", CATALOG_PY, 0,
                 f"catalog metric {name!r} is never emitted by "
                 "paddle_tpu/inference/mesh/ code"))
+        for name in sorted(ctx.verdicts - used_verdicts):
+            out.append(Violation(
+                "mesh-wiring", HEALTH_PY, 0,
+                f"VERDICTS entry {name!r} is never assigned or compared "
+                "by paddle_tpu/inference/mesh/ code"))
+    for name in sorted(ctx.verdicts - ctx.res_verdict_rows):
+        out.append(Violation(
+            "mesh-wiring", RES_MD, 0,
+            f"VERDICTS entry {name!r} has no `| `verdict/{name}` |` row "
+            f"in {RES_MD}"))
+    for name in sorted(ctx.res_verdict_rows - ctx.verdicts):
+        out.append(Violation(
+            "mesh-wiring", RES_MD, 0,
+            f"{RES_MD} documents verdict/{name} which is not in "
+            f"{HEALTH_PY} VERDICTS"))
     res_mesh = {t for t in ctx.res_ticks if t.startswith("mesh.")}
     for name in sorted(mesh_sites - res_mesh):
         out.append(Violation(

@@ -553,6 +553,13 @@ def _bench_decode(on_tpu):
         out["fusion_ab"] = _bench_fusion_ab()
     except Exception as e:  # noqa: BLE001
         out["fusion_ab_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+    # round 22: multi-adapter (LoRA) serving A/B — the slot-0 identity
+    # contract, the mixed-adapter throughput tax, and the
+    # recompile-free hot-swap gate; its own guard like fusion_ab
+    try:
+        out["adapters_ab"] = _bench_engine_adapters(model, cfg, batch)
+    except Exception as e:  # noqa: BLE001
+        out["adapters_ab_error"] = f"{type(e).__name__}: {str(e)[:200]}"
     return out
 
 
@@ -629,6 +636,99 @@ def _bench_engine_prefix(model, cfg, batch):
         "warm_speedup": round(
             warm["tokens_per_s"] / max(cold["tokens_per_s"], 1e-9), 2),
         "greedy_parity": parity,
+    }
+
+
+def _bench_engine_adapters(model, cfg, batch):
+    """Round-22 multi-adapter (LoRA) A/B, three legs on one request mix:
+
+    * identity — the same all-base request mix on a storeless engine
+      and on a store-attached engine (every lane adapter_id=0, the
+      all-zeros slot). Greedy streams must be byte-identical: attaching
+      the store may not perturb base serving.
+    * mixed — the mix re-run with every request under an adapter
+      (round-robin over 4 names, all within the 4-slot pool). Records
+      the throughput ratio vs the base run on the SAME engine (the
+      per-token cost of the batched per-lane delta gathers) and that
+      the adapter streams actually differ from base.
+    * hot-swap — all 8 registered adapters driven serially through the
+      4-slot store, so every acquire past the first four LRU-evicts and
+      hot-loads. ``jit_retrace_total`` over everything after warmup
+      must stay exactly flat: adapter identity is data (a pool slot
+      index), never part of a compile key."""
+    import numpy as np
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference import ContinuousBatchingEngine, make_demo_store
+    from paddle_tpu.inference.loadgen import _counter_total
+
+    def ctr(name):
+        fam = obs.get_registry().get(name)
+        return fam.value if fam is not None else 0.0
+
+    s, new = 16, 24
+    n_req = batch * 3
+    rng = np.random.RandomState(22)
+    prompts = [rng.randint(1, cfg.vocab_size, (s,)) for _ in range(n_req)]
+    blocks_per_seq = (s + new) // 16 + 2
+
+    def build(store):
+        return ContinuousBatchingEngine(
+            model, num_blocks=batch * blocks_per_seq + 4, block_size=16,
+            max_batch=batch, max_blocks_per_seq=blocks_per_seq,
+            prefill_buckets=(16,), decode_steps=8, adapters=store)
+
+    def timed(eng, adapter_of):
+        done0 = frozenset(eng.finished)
+        for i, p in enumerate(prompts):
+            a = adapter_of(i)
+            eng.add_request(p, max_new_tokens=new,
+                            **({"adapter": a} if a else {}))
+        t0 = time.perf_counter()
+        res = eng.run()
+        dt = time.perf_counter() - t0
+        outs = [v for rid, v in res.items() if rid not in done0]
+        return {"tokens_per_s": round(sum(len(v) for v in outs) / dt, 1),
+                "outputs": sorted(map(tuple, outs))}
+
+    plain_eng = build(None)
+    plain_eng.add_request(prompts[0], max_new_tokens=new)
+    plain_eng.run()                 # compile outside the timed region
+    plain = timed(plain_eng, lambda i: None)
+
+    names = ["lora%d" % i for i in range(8)]
+    store_eng = build(make_demo_store(model, names, n_slots=4))
+    store_eng.add_request(prompts[0], max_new_tokens=new)
+    store_eng.run()                 # compile (the lora-tailed programs)
+    retrace0 = ctr("jit_retrace_total")
+    snap0 = obs.snapshot()
+    base = timed(store_eng, lambda i: None)
+    timed(store_eng, lambda i: names[i % 4])   # untimed: hot-loads the
+    mixed = timed(store_eng, lambda i: names[i % 4])    # 4 working set
+    for nm in names:                # hot-swap: every slot churns
+        store_eng.add_request(prompts[0], max_new_tokens=8, adapter=nm)
+        store_eng.run()
+    snap1 = obs.snapshot()
+    swap_retraces = int(ctr("jit_retrace_total") - retrace0)
+    loads = int(_counter_total(snap1, "serving_adapter_loads_total")
+                - _counter_total(snap0, "serving_adapter_loads_total"))
+    evictions = int(
+        _counter_total(snap1, "serving_adapter_evictions_total")
+        - _counter_total(snap0, "serving_adapter_evictions_total"))
+    identity = plain["outputs"] == base["outputs"]
+    differs = mixed["outputs"] != base["outputs"]
+    ratio = mixed["tokens_per_s"] / max(base["tokens_per_s"], 1e-9)
+    return {
+        "requests": n_req, "adapters": len(names), "slots": 4,
+        "base_tokens_per_s": base["tokens_per_s"],
+        "mixed_tokens_per_s": mixed["tokens_per_s"],
+        "mixed_vs_base": round(ratio, 2),
+        "identity_parity": identity,
+        "adapter_streams_differ": differs,
+        "hot_swap_loads": loads,
+        "hot_swap_evictions": evictions,
+        "swap_recompiles": swap_retraces,
+        "gate_ok": bool(identity and differs and swap_retraces == 0
+                        and loads >= len(names) and evictions >= 4),
     }
 
 

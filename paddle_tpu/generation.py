@@ -140,7 +140,8 @@ def _llama_layer_prefill(lp, h, pos, cfg):
 
 
 def _llama_layer_prefill_chunk(lp, h, kc, vc, table_row, start, cfg,
-                               fmt=None, kc_scale=None, vc_scale=None):
+                               fmt=None, kc_scale=None, vc_scale=None,
+                               lora=None):
     """One layer forward over a prompt CHUNK against the paged pool (the
     serving engine's chunked prefill): rotate the chunk's Q/K at absolute
     positions, scatter the chunk's K/V into the pool (multi-token write),
@@ -155,6 +156,13 @@ def _llama_layer_prefill_chunk(lp, h, kc, vc, table_row, start, cfg,
     and the attention read dequantizes in place, and the second element
     becomes (kc, vc, kc_scale, vc_scale). fmt=None keeps the original
     trace byte-for-byte.
+
+    `lora` (round 22, multi-adapter serving): an optional
+    (A_q [H, r], B_q [r, Dq], A_v [H, r], B_v [r, Dv]) tuple of this
+    layer's already-gathered low-rank factors; the q/v projections gain
+    `x @ A @ B` deltas in one batched einsum each. lora=None keeps the
+    original trace byte-for-byte (the all-zeros base slot makes
+    adapter_id 0 numerically identical even when wired).
     """
     from .ops.paged_attention import (kv_write_chunk,
                                       paged_attention_prefill_chunk,
@@ -164,9 +172,19 @@ def _llama_layer_prefill_chunk(lp, h, kc, vc, table_row, start, cfg,
     b, c, _ = h.shape                      # b == 1: one admission at a time
     pos = start + jnp.arange(c)[None]      # (1, C) absolute positions
     x = _rms(h, lp["input_layernorm.weight"], eps)
-    q = (x @ lp["self_attn.q_proj.weight"]).reshape(b, c, nh, hd)
+    q_lin = x @ lp["self_attn.q_proj.weight"]
+    v_lin = x @ lp["self_attn.v_proj.weight"]
+    if lora is not None:
+        a_q, b_q, a_v, b_v = lora
+        q_lin = q_lin + jnp.einsum("bch,hr,rd->bcd", x,
+                                   a_q.astype(x.dtype),
+                                   b_q.astype(x.dtype))
+        v_lin = v_lin + jnp.einsum("bch,hr,rd->bcd", x,
+                                   a_v.astype(x.dtype),
+                                   b_v.astype(x.dtype))
+    q = q_lin.reshape(b, c, nh, hd)
     k = (x @ lp["self_attn.k_proj.weight"]).reshape(b, c, nkv, hd)
-    v = (x @ lp["self_attn.v_proj.weight"]).reshape(b, c, nkv, hd)
+    v = v_lin.reshape(b, c, nkv, hd)
     q = _rope(q, pos, theta)
     k = _rope(k, pos, theta)
     quant = fmt is not None and fmt.quantized

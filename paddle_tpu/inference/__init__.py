@@ -17,6 +17,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .adapters import (  # noqa: E402,F401
+    AdapterLoadError, AdapterStore, LoraWeights, demo_store_for_engine,
+    make_demo_store, per_adapter_slos)
 from .loadgen import (  # noqa: E402,F401
     SCENARIOS, Scenario, build_schedule, check_report, run_scenario)
 from .scheduler import (  # noqa: E402,F401
@@ -29,6 +32,8 @@ from .mesh import (  # noqa: E402,F401
 
 __all__ = ["ContinuousBatchingEngine", "Request", "BackpressureError",
            "KVPoolExhaustedError",
+           "AdapterStore", "AdapterLoadError", "LoraWeights",
+           "make_demo_store", "demo_store_for_engine", "per_adapter_slos",
            "Scenario", "SCENARIOS", "build_schedule", "run_scenario",
            "check_report",
            "SLOScheduler", "PRIORITY_CLASSES", "BROWNOUT_LEVELS",

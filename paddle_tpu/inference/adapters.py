@@ -1,0 +1,348 @@
+"""Multi-adapter (LoRA) serving: the adapter store behind the fused
+decode scan's per-lane batched deltas.
+
+One base model, thousands of per-tenant finetunes — the canonical
+millions-of-users traffic shape. The reference capability is the LoRA
+path of the serving stacks this repo reproduces (per-request adapter
+selection over a shared base); the TPU-native design keeps the delta
+matmuls INSIDE the single fused dispatch instead of branching per
+request, which would shatter batching:
+
+  * ``AdapterStore`` — a closed registry of NAMED LoRA adapter sets.
+    Residency is a device-resident stacked weight pool per target
+    projection: ``A_q [L, n_slots, H, r]`` / ``B_q [L, n_slots, r, Dq]``
+    (and the v-projection pair). Slot 0 is the base model and holds
+    zeros forever, so a lane with ``adapter_id == 0`` computes
+    ``x @ W + (x @ 0) @ 0`` — the delta is exactly zero and greedy
+    streams match the storeless engine token for token.
+  * hot-load / evict — ``acquire`` refcounts a named adapter into a
+    pool slot (LRU-evicting an idle slot when full) and ``release``
+    drops the ref when the request retires. Uploads are plain
+    ``pool.at[:, slot].set(w)`` dispatches: jax's async dispatch
+    overlaps the copy with in-flight decode tiles, so a cold adapter
+    never stalls warm lanes — and because arrays are functional, a tile
+    already dispatched keeps reading the buffer it was given.
+  * recompile-free swap — the serving engine folds ``program_key``
+    (pool SHAPE: n_slots and rank, never contents) into the PIR
+    compile-cache key. Loading, evicting, or overwriting adapters
+    changes only array *contents*, so the base program never recompiles
+    (pinned via ``jit_retrace_total`` delta == 0 across churn).
+
+Degrade contract (house style): the store never half-serves. A failed
+``acquire``/residency check at admission is a typed
+``AdapterLoadError`` (or an injected transient) and the engine rejects
+the request with ``finish_reason='rejected'`` — a wrong-weights stream
+is the one outcome that must be impossible. In-flight lanes on other
+adapters are untouched; their slots were never written.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..observability.catalog import metric as _metric
+from ..observability.recorder import get_recorder as _get_recorder
+
+__all__ = ["AdapterStore", "AdapterLoadError", "LoraWeights",
+           "make_demo_store", "demo_store_for_engine", "per_adapter_slos"]
+
+
+class AdapterLoadError(RuntimeError):
+    """The store could not make a named adapter resident (unknown name,
+    every slot pinned by live lanes, or a store fault). Admission treats
+    it as a typed rejection — never a silent base-model fallback."""
+
+
+class LoraWeights:
+    """One named adapter set: per-layer A/B factors for the q and v
+    projections, host-side until loaded. Shapes (L = layers, H = hidden,
+    r = rank, Dq/Dv = projection output widths):
+
+        a_q [L, H, r]   b_q [L, r, Dq]
+        a_v [L, H, r]   b_v [L, r, Dv]
+    """
+
+    __slots__ = ("name", "a_q", "b_q", "a_v", "b_v")
+
+    def __init__(self, name, a_q, b_q, a_v, b_v):
+        self.name = str(name)
+        self.a_q = np.asarray(a_q)
+        self.b_q = np.asarray(b_q)
+        self.a_v = np.asarray(a_v)
+        self.b_v = np.asarray(b_v)
+        if self.a_q.ndim != 3 or self.b_q.ndim != 3 \
+                or self.a_v.ndim != 3 or self.b_v.ndim != 3:
+            raise ValueError(f"adapter {name!r}: factors must be "
+                             "[L, H, r] / [L, r, D] stacks")
+        if self.a_q.shape[-1] != self.b_q.shape[1] \
+                or self.a_v.shape[-1] != self.b_v.shape[1]:
+            raise ValueError(f"adapter {name!r}: rank mismatch between "
+                             "A and B factors")
+
+
+class AdapterStore:
+    """Closed registry of named LoRA adapter sets over a bounded
+    device-resident slot pool. See the module docstring for the
+    contract; the engine-facing surface is:
+
+        store.acquire(name) -> adapter_id   (refcount++, hot-load)
+        store.check_resident(adapter_id)    (gather-side validation)
+        store.release(adapter_id)           (refcount--)
+        store.can_serve(name)               (router placement check)
+        store.program_key                   (shape-only compile key)
+
+    ``n_slots`` INCLUDES the reserved all-zeros base slot 0, so a store
+    with n_slots=5 serves at most 4 concurrent distinct adapters.
+    """
+
+    def __init__(self, num_layers, hidden, q_out, v_out, rank,
+                 n_slots=8, max_adapters=256):
+        if n_slots < 2:
+            raise ValueError("n_slots must be >= 2 (slot 0 is the base)")
+        self.num_layers = int(num_layers)
+        self.hidden = int(hidden)
+        self.q_out = int(q_out)
+        self.v_out = int(v_out)
+        self.rank = int(rank)
+        self.n_slots = int(n_slots)
+        self.max_adapters = int(max_adapters)
+        L, S, H, r = self.num_layers, self.n_slots, self.hidden, self.rank
+        # the device pools; slot 0 stays all-zeros for the store's life
+        self.A_q = jnp.zeros((L, S, H, r), jnp.float32)
+        self.B_q = jnp.zeros((L, S, r, self.q_out), jnp.float32)
+        self.A_v = jnp.zeros((L, S, H, r), jnp.float32)
+        self.B_v = jnp.zeros((L, S, r, self.v_out), jnp.float32)
+        self._registry: dict[str, LoraWeights] = {}   # closed name set
+        self._slot_of: dict[str, int] = {}            # resident name->slot
+        self._name_of: dict[int, str] = {}
+        self._refs: dict[int, int] = {}               # slot -> refcount
+        self._lru: list[int] = []                     # idle order, old first
+        self._loads = 0
+        self._evictions = 0
+        self._rec = _get_recorder()
+        self._m_resident = _metric("serving_adapter_resident")
+        self._m_upload = _metric("serving_adapter_upload_seconds")
+
+    @classmethod
+    def for_model(cls, model, rank=4, n_slots=8, max_adapters=256):
+        """Dimension a store from a LlamaForCausalLM-style config: the
+        q delta lands on [H, nh*hd] and the v delta on [H, nkv*hd]."""
+        cfg = model.config
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        return cls(cfg.num_hidden_layers, cfg.hidden_size,
+                   cfg.num_attention_heads * hd,
+                   cfg.num_key_value_heads * hd,
+                   rank, n_slots=n_slots, max_adapters=max_adapters)
+
+    # --- registry ---------------------------------------------------------
+    def register(self, name, a_q, b_q, a_v, b_v):
+        """Add a named adapter to the closed registry (host weights;
+        residency comes later via acquire). Shape-checked against the
+        store's dimensions so a bad adapter fails HERE, not as a shape
+        error inside the fused scan."""
+        name = str(name)
+        if not name or name == "base":
+            raise ValueError("adapter name must be non-empty and not "
+                             "'base' (the reserved slot-0 identity)")
+        if name not in self._registry \
+                and len(self._registry) >= self.max_adapters:
+            raise AdapterLoadError(
+                f"adapter registry full ({self.max_adapters}); the id "
+                "space is bounded by construction")
+        w = LoraWeights(name, a_q, b_q, a_v, b_v)
+        want = {
+            "a_q": (self.num_layers, self.hidden, self.rank),
+            "b_q": (self.num_layers, self.rank, self.q_out),
+            "a_v": (self.num_layers, self.hidden, self.rank),
+            "b_v": (self.num_layers, self.rank, self.v_out),
+        }
+        for attr, shape in want.items():
+            got = getattr(w, attr).shape
+            if tuple(got) != shape:
+                raise ValueError(
+                    f"adapter {name!r}: {attr} shape {tuple(got)} != "
+                    f"store shape {shape}")
+        self._registry[name] = w
+        return name
+
+    def names(self):
+        return sorted(self._registry)
+
+    def can_serve(self, name):
+        """Placement check (mesh router): True when the name is in the
+        closed registry — resident now or hot-loadable on demand."""
+        return str(name) in self._registry
+
+    # --- residency --------------------------------------------------------
+    @property
+    def program_key(self):
+        """What the compiled programs depend on: pool SHAPE only. Every
+        load/evict/overwrite leaves this key — and therefore the PIR
+        compile-cache entry — untouched."""
+        return ("lora", self.n_slots, self.rank)
+
+    def resident(self):
+        return dict(self._slot_of)
+
+    def refcount(self, adapter_id):
+        return self._refs.get(int(adapter_id), 0)
+
+    def acquire(self, name):
+        """Refcount the named adapter resident and return its slot id.
+        A cold adapter hot-loads into a free (or LRU idle) slot; the
+        upload is an async device dispatch overlapped with whatever is
+        in flight. Raises AdapterLoadError when the name is unknown or
+        every non-base slot is pinned by live lanes."""
+        name = str(name)
+        if name not in self._registry:
+            raise AdapterLoadError(
+                f"unknown adapter {name!r}; registered: {self.names()}")
+        slot = self._slot_of.get(name)
+        if slot is not None:
+            self._refs[slot] = self._refs.get(slot, 0) + 1
+            if slot in self._lru:
+                self._lru.remove(slot)
+            return slot
+        slot = self._free_slot()
+        if slot is None:
+            raise AdapterLoadError(
+                f"no adapter slot free for {name!r}: all "
+                f"{self.n_slots - 1} slots pinned by live lanes")
+        self._upload(slot, self._registry[name])
+        self._slot_of[name] = slot
+        self._name_of[slot] = name
+        self._refs[slot] = 1
+        self._loads += 1
+        _metric("serving_adapter_loads_total", adapter=name).inc()
+        self._m_resident.set(len(self._slot_of))
+        if self._rec.enabled:
+            self._rec.record("adapter", action="load", adapter=name,
+                             slot=slot)
+        return slot
+
+    def check_resident(self, adapter_id):
+        """Gather-side validation at lane bind time: the slot the lane
+        will gather from must still belong to a live adapter. Raises
+        AdapterLoadError otherwise (the engine rejects typed — never a
+        wrong-weights gather)."""
+        aid = int(adapter_id)
+        if aid == 0:
+            return
+        if aid not in self._name_of or self._refs.get(aid, 0) <= 0:
+            raise AdapterLoadError(
+                f"adapter slot {aid} is not resident (evicted or never "
+                "loaded); refusing to gather stale weights")
+
+    def release(self, adapter_id):
+        """Drop one reference. A slot at refcount 0 stays resident (warm
+        for the next acquire) but becomes LRU-evictable."""
+        slot = int(adapter_id)
+        if slot == 0 or slot not in self._refs:
+            return
+        self._refs[slot] = max(0, self._refs[slot] - 1)
+        if self._refs[slot] == 0 and slot not in self._lru:
+            self._lru.append(slot)
+
+    def _free_slot(self):
+        used = set(self._name_of)
+        for s in range(1, self.n_slots):
+            if s not in used:
+                return s
+        while self._lru:
+            victim = self._lru.pop(0)
+            if self._refs.get(victim, 0) > 0:
+                continue        # re-acquired since it went idle
+            name = self._name_of.pop(victim)
+            self._slot_of.pop(name, None)
+            self._refs.pop(victim, None)
+            self._evictions += 1
+            _metric("serving_adapter_evictions_total", adapter=name).inc()
+            self._m_resident.set(len(self._slot_of))
+            if self._rec.enabled:
+                self._rec.record("adapter", action="evict", adapter=name,
+                                 slot=victim)
+            # no zeroing needed: the incoming upload overwrites the slot
+            # and no live lane can reference it (refcount was 0)
+            return victim
+        return None
+
+    def _upload(self, slot, w):
+        t0 = time.perf_counter()
+        self.A_q = self.A_q.at[:, slot].set(
+            jnp.asarray(w.a_q, jnp.float32))
+        self.B_q = self.B_q.at[:, slot].set(
+            jnp.asarray(w.b_q, jnp.float32))
+        self.A_v = self.A_v.at[:, slot].set(
+            jnp.asarray(w.a_v, jnp.float32))
+        self.B_v = self.B_v.at[:, slot].set(
+            jnp.asarray(w.b_v, jnp.float32))
+        # host-side dispatch wall only: the copy itself overlaps decode
+        # (async dispatch); nothing here blocks on the device
+        self._m_upload.observe(time.perf_counter() - t0)
+
+    def stats(self):
+        return {"loads": self._loads, "evictions": self._evictions,
+                "resident": len(self._slot_of),
+                "registered": len(self._registry)}
+
+
+def _register_demo(store, names, seed, scale):
+    L, H, r = store.num_layers, store.hidden, store.rank
+    for i, name in enumerate(names):
+        rs = np.random.RandomState(seed * 10_007 + i)
+        store.register(
+            name,
+            rs.randn(L, H, r).astype(np.float32) * scale,
+            rs.randn(L, r, store.q_out).astype(np.float32) * scale,
+            rs.randn(L, H, r).astype(np.float32) * scale,
+            rs.randn(L, r, store.v_out).astype(np.float32) * scale)
+    return store
+
+
+def make_demo_store(model, names, rank=4, n_slots=8, seed=0, scale=0.5):
+    """A store populated with small random adapters — the loadgen /
+    bench / chaos-drill fixture. Deterministic in `seed`; `scale` keeps
+    the deltas small enough that decode stays numerically tame while
+    still flipping greedy argmaxes vs the base model (delta std per
+    projection element is about 2·scale²·|x|, so the 0.5 default
+    perturbs logits by a few percent on the tiny test configs)."""
+    store = AdapterStore.for_model(model, rank=rank, n_slots=n_slots)
+    return _register_demo(store, names, seed, scale)
+
+
+def demo_store_for_engine(engine, names, rank=4, n_slots=8, seed=0,
+                          scale=0.5):
+    """make_demo_store for callers that only hold a built engine (the
+    loadgen auto-install path): dimensions the store from the engine's
+    own stacked params instead of a model config. Same seed + same
+    dimensions produce byte-identical weights to make_demo_store."""
+    num_layers = int(next(iter(engine.stacked.values())).shape[0])
+    hidden = int(engine.embed_w.shape[1])
+    cfg = engine.cfg
+    store = AdapterStore(num_layers, hidden,
+                         int(cfg["heads"] * cfg["head_dim"]),
+                         int(cfg["kv_heads"] * cfg["head_dim"]),
+                         rank, n_slots=n_slots)
+    return _register_demo(store, names, seed, scale)
+
+
+def per_adapter_slos(names, ttft_objective=2.5, tpot_objective=0.25):
+    """Per-adapter SLOSpecs over the adapter-labeled serving histograms
+    — the existing SLOEngine evaluates them like any other spec (the
+    `labels=` filter keeps each verdict scoped to one adapter)."""
+    from ..observability.slo import SLOSpec
+    specs = []
+    for n in names:
+        specs.append(SLOSpec(
+            f"adapter_{n}_ttft_p95", "quantile",
+            "serving_adapter_ttft_seconds", objective=ttft_objective,
+            q=0.95, labels={"adapter": str(n)}))
+        specs.append(SLOSpec(
+            f"adapter_{n}_tpot_p99", "quantile",
+            "serving_adapter_tpot_seconds", objective=tpot_objective,
+            q=0.99, labels={"adapter": str(n)}))
+    return specs

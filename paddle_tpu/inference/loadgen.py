@@ -79,7 +79,8 @@ class Scenario:
                  "rate_end_rps", "burst_n", "burst_every_s",
                  "prompt_len", "output_tokens", "tenants", "priorities",
                  "do_sample", "temperature", "top_k", "top_p",
-                 "deadline_s", "shared_prefix_len", "description")
+                 "deadline_s", "shared_prefix_len", "adapter_population",
+                 "adapter_zipf", "description")
 
     def __init__(self, name, arrival="poisson", rate_rps=10.0,
                  duration_s=1.0, rate_end_rps=None, burst_n=4,
@@ -87,7 +88,8 @@ class Scenario:
                  output_tokens=(4, 12), tenants=(("-", 1.0),),
                  priorities=(("interactive", 1.0),),
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                 deadline_s=None, shared_prefix_len=0, description=""):
+                 deadline_s=None, shared_prefix_len=0,
+                 adapter_population=0, adapter_zipf=1.1, description=""):
         if arrival not in ("poisson", "burst", "ramp"):
             raise ValueError(f"unknown arrival process {arrival!r}")
         for p, _w in priorities:
@@ -115,6 +117,12 @@ class Scenario:
         # round 18: tokens of tenant-common system prompt prepended to
         # every request's (per-request) tail — the prefix-cache workload
         self.shared_prefix_len = int(shared_prefix_len)
+        # round 22: every arrival names one of adapter_population demo
+        # LoRA adapters ("lora0".."loraN-1"), drawn Zipf(adapter_zipf)
+        # so a hot head stays resident while the tail churns slots — the
+        # multi-adapter hot-swap workload (0 = base-model traffic only)
+        self.adapter_population = int(adapter_population)
+        self.adapter_zipf = float(adapter_zipf)
         self.description = str(description)
 
 
@@ -162,6 +170,17 @@ SCENARIOS = {
                     "cache workload — after one cold prefill per tenant "
                     "every admission should resolve the shared blocks "
                     "from the index and prefill only the tail"),
+    "multi_adapter": Scenario(
+        "multi_adapter", arrival="poisson", rate_rps=14.0, duration_s=1.5,
+        prompt_len=(4, 14), output_tokens=(4, 10),
+        tenants=(("acme", 2.0), ("zee", 1.0), ("-", 1.0)),
+        adapter_population=6, adapter_zipf=1.1, deadline_s=15.0,
+        description="per-tenant LoRA serving: every request names one "
+                    "of 6 demo adapters (Zipf-skewed, population wider "
+                    "than the slot pool) so hot heads stay resident "
+                    "while the tail hot-loads and evicts through the "
+                    "store — the recompile-free swap workload; the "
+                    "report's swap_recompiles must stay 0"),
 }
 
 
@@ -174,7 +193,7 @@ def _pick_weighted(rng, pairs):
 def _arrival(scenario, rng, t):
     lo, hi = scenario.prompt_len
     olo, ohi = scenario.output_tokens
-    return {
+    a = {
         "t": round(float(t), 6),
         "prompt_len": rng.randint(lo, hi),
         "output_tokens": rng.randint(olo, ohi),
@@ -183,6 +202,16 @@ def _arrival(scenario, rng, t):
         "prompt_seed": rng.randrange(1 << 30),
         "sample_seed": rng.randrange(1 << 30),
     }
+    if scenario.adapter_population > 0:
+        # Zipf over the population: weight 1/(rank+1)^s — adapter-less
+        # scenarios draw nothing here, so their schedules (and digests)
+        # are byte-identical to pre-round-22 runs
+        n = scenario.adapter_population
+        s = scenario.adapter_zipf
+        weights = [1.0 / float(i + 1) ** s for i in range(n)]
+        a["adapter"] = "lora%d" % rng.choices(range(n),
+                                              weights=weights, k=1)[0]
+    return a
 
 
 def build_schedule(scenario, seed=0, rate_rps=None, duration_s=None):
@@ -314,6 +343,97 @@ def _counter_total(snapshot_doc, name):
     return total
 
 
+def _hist_cum_by(snapshot_doc, name, label):
+    """Like _hist_cum, but keyed by one label's value instead of merged
+    across children — the per-adapter latency view."""
+    out = {}
+    for m in snapshot_doc.get("metrics", []):
+        if m.get("name") != name:
+            continue
+        for s in m.get("samples", []):
+            lv = (s.get("labels") or {}).get(label)
+            if lv is None:
+                continue
+            merged = out.setdefault(str(lv), {})
+            for le, cum in s.get("buckets", []):
+                key = ("+Inf" if (isinstance(le, str) or le == float("inf"))
+                       else float(le))
+                merged[key] = merged.get(key, 0) + int(cum)
+    return out
+
+
+# -- multi-adapter plumbing (round 22) -------------------------------------
+
+def _engines_of(engine):
+    """The concrete serving engines behind the harness handle: a plain
+    engine is itself; a MeshRouter contributes every in-process replica
+    engine (RPC proxies have no stacked params and are skipped — a
+    process-worker mesh must arrive with stores pre-installed)."""
+    if hasattr(engine, "mesh_report"):
+        return [rep.engine for rep in engine.pool
+                if hasattr(rep.engine, "stacked")]
+    return [engine]
+
+
+def _ensure_adapter_stores(engine, names):
+    """Install the deterministic demo store on every store-less engine
+    the scenario will touch. Only legal on a COLD engine: programs
+    already compiled without the lora argument tail must never be fed
+    an adapter-carrying dispatch."""
+    from .adapters import demo_store_for_engine
+    n_slots = max(2, len(names))    # one fewer usable slot than names,
+    for eng in _engines_of(engine):  # so the Zipf tail actually evicts
+        store = getattr(eng, "adapters", None)
+        if store is not None:
+            missing = [n for n in names if not store.can_serve(n)]
+            if missing:
+                raise ValueError(
+                    f"engine's adapter store cannot serve {missing}; "
+                    f"registered: {store.names()}")
+            continue
+        if eng._prefill_jit or eng._decode_jit:
+            raise ValueError(
+                "scenario names adapters but the engine is already warm "
+                "and has no adapter store; build it with adapters=... "
+                "(compiled programs lack the lora argument tail)")
+        eng.adapters = demo_store_for_engine(eng, names, n_slots=n_slots)
+
+
+def _warm_adapter_programs(engine, scenario, vocab):
+    """Compile every program the run will need BEFORE the measurement
+    window opens: one prefill per bucket width the scenario's prompts
+    can reach, plus the decode program, each through an adapter-carrying
+    request. The report's `swap_recompiles` is the jit_retrace_total
+    delta over the run window — after this warmup any nonzero delta IS
+    an adapter-churn recompile, which the hot-swap contract forbids."""
+    max_prompt = scenario.prompt_len[1] + scenario.shared_prefix_len
+    for eng in _engines_of(engine):
+        store = getattr(eng, "adapters", None)
+        warm_adapter = (store.names()[0]
+                        if store is not None and store.names() else None)
+        covering = [b for b in eng.buckets if b >= max_prompt]
+        top = covering[0] if covering else eng.buckets[-1]
+        for width in [b for b in eng.buckets if b <= top]:
+            eng.add_request(
+                _prompt_tokens(width, width, vocab), max_new_tokens=2,
+                do_sample=scenario.do_sample,
+                temperature=scenario.temperature, top_k=scenario.top_k,
+                top_p=scenario.top_p, adapter=warm_adapter)
+        while eng.has_work():
+            eng.step()
+        # warmup requests are scaffolding, not traffic: drop them so
+        # finish-reason / tenant accounting sees only the schedule's
+        eng.finished.clear()
+        # warmup used every prefill program exactly once (cold, so
+        # unmeasured) — the first MEASURED dispatch was the decode
+        # tile whose readback wall still contained the compile. That
+        # one-shot calibration would price decode ~100x too high and
+        # pin slo_headroom (and a scheduler's brownout ladder) at the
+        # floor for the whole run. Drop it; the run's first dispatch
+        # re-calibrates against warm programs.
+        eng._cost_scale = None
+
+
 # -- the runner ------------------------------------------------------------
 
 def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
@@ -338,6 +458,17 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
     vocab = int(engine.embed_w.shape[0])
     mean_out = (sum(a["output_tokens"] for a in schedule)
                 / max(1, len(schedule)))
+
+    # round 22: multi-adapter runs — install the demo store on cold
+    # store-less engines, then compile every program (adapter tail
+    # included) BEFORE snap0 so the run window's jit_retrace_total
+    # delta isolates adapter-churn recompiles (contract: zero)
+    wants_adapters = scenario.adapter_population > 0
+    adapter_names = sorted({a["adapter"] for a in schedule
+                            if a.get("adapter")})
+    if wants_adapters:
+        _ensure_adapter_stores(engine, adapter_names)
+        _warm_adapter_programs(engine, scenario, vocab)
 
     reg = _get_registry()
     phases = _get_phases()
@@ -432,7 +563,11 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
                     top_k=scenario.top_k, top_p=scenario.top_p,
                     seed=a["sample_seed"],
                     deadline_s=scenario.deadline_s, tenant=a["tenant"],
-                    priority=a.get("priority", "interactive"))
+                    priority=a.get("priority", "interactive"),
+                    # adapter-less arrivals keep the pre-round-22 call
+                    # frame (engine doubles without the kwarg still work)
+                    **({"adapter": a["adapter"]}
+                       if a.get("adapter") else {}))
                 issued += 1
                 m_arrivals.inc()
             except BackpressureError:
@@ -533,6 +668,56 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
                 - _counter_total(snap0, "serving_prefix_cow_forks_total")),
         }
 
+    # multi-adapter evidence (None unless the scenario names adapters):
+    # run-window hot-load/evict counts, the per-adapter latency split,
+    # and swap_recompiles — the jit_retrace_total delta, which warmup
+    # pins to "adapter churn only" and the hot-swap contract pins to 0
+    adapters_block = None
+    if wants_adapters:
+        t0b = _hist_cum_by(snap0, "serving_adapter_ttft_seconds", "adapter")
+        t1b = _hist_cum_by(snap1, "serving_adapter_ttft_seconds", "adapter")
+        p0b = _hist_cum_by(snap0, "serving_adapter_tpot_seconds", "adapter")
+        p1b = _hist_cum_by(snap1, "serving_adapter_tpot_seconds", "adapter")
+        per = {}
+        for nm in sorted(set(t1b) | set(p1b)):
+            row = {}
+            td = _hist_delta(t1b.get(nm, {}), t0b.get(nm, {}))
+            if td and td[-1][1]:
+                q = quantiles_from_cumulative(td)
+                row.update(ttft_count=int(td[-1][1]),
+                           ttft_p50=q.get(0.5), ttft_p95=q.get(0.95))
+            pd = _hist_delta(p1b.get(nm, {}), p0b.get(nm, {}))
+            if pd and pd[-1][1]:
+                q = quantiles_from_cumulative(pd)
+                row.update(tpot_count=int(pd[-1][1]),
+                           tpot_p50=q.get(0.5), tpot_p95=q.get(0.95))
+            if row:
+                per[nm] = row
+        stats = [s.stats() for s in
+                 (getattr(e, "adapters", None) for e in _engines_of(engine))
+                 if s is not None]
+        adapters_block = {
+            "population": int(scenario.adapter_population),
+            "names": adapter_names,
+            "loads": int(
+                _counter_total(snap1, "serving_adapter_loads_total")
+                - _counter_total(snap0, "serving_adapter_loads_total")),
+            "evictions": int(
+                _counter_total(snap1, "serving_adapter_evictions_total")
+                - _counter_total(snap0,
+                                 "serving_adapter_evictions_total")),
+            "load_failures": int(
+                _counter_total(snap1,
+                               "serving_adapter_load_failures_total")
+                - _counter_total(snap0,
+                                 "serving_adapter_load_failures_total")),
+            "resident": sum(s["resident"] for s in stats),
+            "swap_recompiles": int(
+                _counter_total(snap1, "jit_retrace_total")
+                - _counter_total(snap0, "jit_retrace_total")),
+            "per_adapter": per,
+        }
+
     report = {
         "format": REPORT_FORMAT,
         "scenario": scenario.name,
@@ -561,6 +746,7 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
         "cost": cost,
         "speculative": speculative,
         "prefix": prefix,
+        "adapters": adapters_block,
         "headroom_floor": headroom_floor,
         "timeline": timeline,
         # scheduler evidence (all zero/None for a scheduler-less engine):
@@ -602,7 +788,7 @@ def run_scenario(engine, scenario, seed=0, rate_rps=None, duration_s=None,
 
 def check_report(report, min_coverage=0.95, min_acceptance=None,
                  require_timeseries=False, require_autoscale=False,
-                 min_prefix_hit_rate=None):
+                 min_prefix_hit_rate=None, min_adapter_loads=None):
     """Acceptance gate over a run report -> list of problems (empty =
     pass). Checked: an SLO verdict exists, phase attribution covers at
     least `min_coverage` of engine wall time, the cost model priced at
@@ -619,8 +805,35 @@ def check_report(report, min_coverage=0.95, min_acceptance=None,
     `min_prefix_hit_rate` (prefix-cache runs) requires a prefix block
     with admission hit_rate at or above the floor and tokens actually
     saved — a warm shared-prefix run that saved nothing is a broken
-    index, not a pass."""
+    index, not a pass. `min_adapter_loads` (multi-adapter runs) requires
+    an adapters block whose run-window hot-loads meet the floor, whose
+    per-adapter latency split is populated, and — the hot-swap contract
+    — whose swap_recompiles is exactly 0: adapter churn that recompiles
+    the fused programs is a regression, however good the latency."""
     problems = []
+    if min_adapter_loads is not None:
+        ad = report.get("adapters")
+        if not ad:
+            problems.append("no adapters block in report "
+                            "(scenario not multi-adapter?)")
+        else:
+            # the brownout ladder legally constructs at most one new
+            # decode program per transition (decode_steps is part of
+            # the compile key); only the excess is adapter churn
+            allowed = int(report.get("brownout_transitions") or 0)
+            if ad.get("swap_recompiles", 0) > allowed:
+                problems.append(
+                    f"adapter hot-swap recompiled: jit_retrace_total "
+                    f"moved by {ad['swap_recompiles']} inside the run "
+                    f"window (contract: 0 beyond the {allowed} brownout "
+                    f"program swaps)")
+            if ad.get("loads", 0) < float(min_adapter_loads):
+                problems.append(
+                    f"adapter hot-loads {ad.get('loads')} < "
+                    f"{min_adapter_loads}")
+            if not ad.get("per_adapter"):
+                problems.append("per-adapter latency split is empty "
+                                "(adapter histograms never observed)")
     if min_prefix_hit_rate is not None:
         pfx = report.get("prefix")
         if not pfx:

@@ -95,11 +95,11 @@ class MeshRequest:
                  "t_arrival", "t_deadline", "t_first", "generated",
                  "done", "finish_reason", "phase", "replica",
                  "local_rid", "hops", "force_local", "t_placed",
-                 "hedges")
+                 "hedges", "adapter")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
                  do_sample, temperature, top_k, top_p, seed, deadline_s,
-                 tenant, priority):
+                 tenant, priority, adapter=None):
         import numpy as np
         self.rid = rid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -130,6 +130,7 @@ class MeshRequest:
         self.t_placed = None        # when the live placement started
         self.hedges = []            # speculative duplicate placements:
                                     # [(replica name, local rid), ...]
+        self.adapter = str(adapter) if adapter else None
 
 
 class _AdmissionView:
@@ -235,7 +236,7 @@ class MeshRouter:
     def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
                     do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                     seed=0, deadline_s=None, tenant="-",
-                    priority="interactive"):
+                    priority="interactive", adapter=None):
         """Queue a request at the mesh front door. Same contract as the
         engine's add_request (priority registry, BackpressureError at
         max_queue); returns the MESH rid."""
@@ -252,7 +253,7 @@ class MeshRouter:
         self._next_rid += 1
         mreq = MeshRequest(rid, prompt, max_new_tokens, eos_token_id,
                            do_sample, temperature, top_k, top_p, seed,
-                           deadline_s, tenant, priority)
+                           deadline_s, tenant, priority, adapter=adapter)
         self.queue.append(mreq)
         self._open[rid] = mreq
         self._by_trace[mreq.trace_id] = mreq
@@ -377,6 +378,24 @@ class MeshRouter:
             self._rec.record("mesh", action="failover", reason=reason,
                              trace=None if mreq is None else mreq.trace_id)
 
+    @staticmethod
+    def _adapter_capable(rep, adapter):
+        """Placement gate for adapter-bound requests: the replica's
+        store must know the name (resident or hot-loadable). A replica
+        whose engine is not introspectable — a process-transport proxy —
+        is assumed capable; its own admission rejects typed if not."""
+        if not adapter:
+            return True
+        try:
+            store = getattr(rep.engine, "adapters", None)
+        except Exception:  # noqa: BLE001 — proxy attribute access
+            return True
+        if store is None or not hasattr(store, "can_serve"):
+            # storeless engines reject typed at admission; proxies that
+            # hide the attribute are assumed capable
+            return not hasattr(rep.engine, "lanes")
+        return bool(store.can_serve(adapter))
+
     def _place(self, mreq):
         """Try to place one mesh request on a replica; True on success.
         Targets the prefill pool for disaggregated requests, the decode
@@ -400,7 +419,21 @@ class MeshRouter:
                         <= ranked[0].load() + _AFFINITY_SLACK):
                     ranked.remove(pref)
                     ranked.insert(0, pref)
+        if mreq.adapter and ranked and not any(
+                self._adapter_capable(r, mreq.adapter) for r in ranked):
+            # NO alive replica can serve the adapter: typed mesh-level
+            # rejection now beats spinning the front queue forever
+            self._failover("adapter_missing", mreq)
+            _metric("serving_rejected_total", reason="adapter").inc()
+            self._commit(mreq, mreq, "rejected")
+            return True
         for rep in ranked:
+            if not self._adapter_capable(rep, mreq.adapter):
+                # adapter affinity: never place on a replica whose store
+                # cannot hot-load the name — admission there would only
+                # burn a typed rejection. Counted like any other skip.
+                self._failover("adapter_missing", mreq)
+                continue
             if not rep.breaker.allow():
                 self._failover("circuit_open", mreq)
                 continue
@@ -411,6 +444,9 @@ class MeshRouter:
                 self._failover("route_fault", mreq)
                 continue
             try:
+                # adapter kwarg only when set: storeless process workers
+                # keep their unextended call frame on the wire
+                akw = ({"adapter": mreq.adapter} if mreq.adapter else {})
                 local_rid = rep.engine.add_request(
                     mreq.prompt, max_new_tokens=mreq.max_new_tokens,
                     eos_token_id=mreq.eos_token_id,
@@ -418,7 +454,7 @@ class MeshRouter:
                     temperature=mreq.temperature, top_k=mreq.top_k,
                     top_p=mreq.top_p, seed=mreq.seed,
                     deadline_s=mreq.deadline_s, tenant=mreq.tenant,
-                    priority=mreq.priority)
+                    priority=mreq.priority, **akw)
             except BackpressureError:
                 self._failover("admit_failed", mreq)
                 continue
@@ -737,11 +773,13 @@ class MeshRouter:
         commits the same stream."""
         cands = [r for r in self._ranked(self.pool.decode_targets()
                                          or self.pool.alive())
-                 if r.name not in exclude]
+                 if r.name not in exclude
+                 and self._adapter_capable(r, mreq.adapter)]
         for rep in cands:
             if not rep.breaker.allow():
                 continue
             try:
+                akw = ({"adapter": mreq.adapter} if mreq.adapter else {})
                 local_rid = rep.engine.add_request(
                     mreq.prompt, max_new_tokens=mreq.max_new_tokens,
                     eos_token_id=mreq.eos_token_id,
@@ -749,7 +787,7 @@ class MeshRouter:
                     temperature=mreq.temperature, top_k=mreq.top_k,
                     top_p=mreq.top_p, seed=mreq.seed,
                     deadline_s=mreq.deadline_s, tenant=mreq.tenant,
-                    priority=mreq.priority)
+                    priority=mreq.priority, **akw)
             except BackpressureError:
                 continue
             rep.engine.adopt_identity(local_rid, mreq.trace_id,

@@ -172,6 +172,10 @@ class SLOScheduler:
         predicted_service_seconds prices).
       tenant_quota: max simultaneously-occupied lanes per tenant
         (None = unlimited).
+      adapter_quota: max simultaneously-occupied lanes per NAMED LoRA
+        adapter (None = unlimited; base-weight requests are exempt) —
+        caps how much of the batch one hot finetune can pin, the same
+        way tenant_quota caps a tenant.
       escalate_after / recover_after: consecutive bad/good decisions
         before a level transition (recovery is deliberately slower —
         hysteresis, so the ladder cannot flap).
@@ -186,9 +190,9 @@ class SLOScheduler:
     """
 
     def __init__(self, ttft_target=None, tpot_target=None, quantum=32.0,
-                 tenant_quota=None, escalate_after=2, recover_after=4,
-                 min_dwell=2, resume_margin=0.25, window=128,
-                 rate_window_s=0.5, mnt_cap=16):
+                 tenant_quota=None, adapter_quota=None, escalate_after=2,
+                 recover_after=4, min_dwell=2, resume_margin=0.25,
+                 window=128, rate_window_s=0.5, mnt_cap=16):
         self.ttft_target = (float(ttft_target) if ttft_target is not None
                             else _default_target("ttft_p95"))
         self.tpot_target = (float(tpot_target) if tpot_target is not None
@@ -196,6 +200,8 @@ class SLOScheduler:
         self.quantum = float(quantum)
         self.tenant_quota = (None if tenant_quota is None
                              else max(1, int(tenant_quota)))
+        self.adapter_quota = (None if adapter_quota is None
+                              else max(1, int(adapter_quota)))
         self.escalate_after = max(1, int(escalate_after))
         self.recover_after = max(1, int(recover_after))
         self.min_dwell = max(0, int(min_dwell))
@@ -396,14 +402,22 @@ class SLOScheduler:
         if self.fifo:
             return 0
         lanes_per_tenant: dict[str, int] = {}
+        lanes_per_adapter: dict[str, int] = {}
         for r in engine.lanes:
             if r is not None:
                 lanes_per_tenant[r.tenant] = \
                     lanes_per_tenant.get(r.tenant, 0) + 1
+                if r.adapter:
+                    lanes_per_adapter[r.adapter] = \
+                        lanes_per_adapter.get(r.adapter, 0) + 1
         for _, (req, _ln, _tok) in engine._preempted.items():
             lanes_per_tenant[req.tenant] = \
                 lanes_per_tenant.get(req.tenant, 0) + 1
+            if req.adapter:
+                lanes_per_adapter[req.adapter] = \
+                    lanes_per_adapter.get(req.adapter, 0) + 1
         deferred: set[str] = set()
+        deferred_ad: set[str] = set()
         for cls in PRIORITY_CLASSES:
             heads: dict[str, int] = {}     # tenant -> queue index of head
             for i, r in enumerate(queue):
@@ -416,6 +430,14 @@ class SLOScheduler:
                         deferred.add(r.tenant)
                         _metric("serving_quota_deferrals_total",
                                 tenant=r.tenant).inc()
+                    continue
+                if (self.adapter_quota is not None and r.adapter
+                        and lanes_per_adapter.get(r.adapter, 0)
+                        >= self.adapter_quota):
+                    if r.adapter not in deferred_ad:
+                        deferred_ad.add(r.adapter)
+                        _metric("serving_adapter_quota_deferrals_total",
+                                adapter=r.adapter).inc()
                     continue
                 heads[r.tenant] = i
             if not heads:

@@ -48,6 +48,7 @@ import jax
 import jax.numpy as jnp
 
 from ..generation import _llama_layer_prefill_chunk, _rms, _rope
+from .adapters import AdapterLoadError
 from ..observability import span as _span
 from ..observability.catalog import metric as _metric
 from ..observability.metrics import get_registry as _get_registry
@@ -91,13 +92,18 @@ class Request:
                  "generated", "done", "do_sample", "temperature", "top_k",
                  "top_p", "rng", "sample_seed", "t_arrival", "deadline_s",
                  "t_deadline", "finish_reason", "shed_count", "trace_id",
-                 "tenant", "priority", "t_first")
+                 "tenant", "priority", "t_first", "adapter", "adapter_id")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                  seed=None, deadline_s=None, tenant="-",
-                 priority="interactive"):
+                 priority="interactive", adapter=None):
         self.rid = rid
+        # named LoRA adapter (round 22) — None/"" = the base model.
+        # adapter_id is the device pool slot, bound at admission by the
+        # engine's AdapterStore (0 = base, an exact-zeros delta).
+        self.adapter = str(adapter) if adapter else None
+        self.adapter_id = 0
         # per-tenant telemetry label; "-" = unattributed (the default
         # keeps every pre-tenant caller's label sets unchanged)
         self.tenant = str(tenant) if tenant else "-"
@@ -411,7 +417,7 @@ class ContinuousBatchingEngine:
                  draft_depth=2, draft_ngram=3, drafter=None,
                  kv_cache_dtype="bf16", kv_pool_bytes=None,
                  scheduler=None, prefix_cache=False,
-                 prefix_cache_blocks=None):
+                 prefix_cache_blocks=None, adapters=None):
         config = model.config
         self.cfg = dict(eps=config.rms_norm_eps, theta=config.rope_theta,
                         heads=config.num_attention_heads,
@@ -604,12 +610,31 @@ class ContinuousBatchingEngine:
         self._m_pfx_shared = _metric("serving_prefix_shared_blocks")
         self._m_pfx_evict = _metric("serving_prefix_evictions_total")
         self._m_pfx_cow = _metric("serving_prefix_cow_forks_total")
+        # round 22: the multi-adapter (LoRA) store. None (default) keeps
+        # the engine EXACTLY the storeless engine — no extra program
+        # inputs, no adapter math in the compiled scans, byte-identical
+        # everything. With a store attached, lanes carry an adapter_id
+        # and the decode/prefill programs gather per-lane A/B factors
+        # from the store's device pools (slot 0 = base, zeros).
+        if adapters is not None:
+            nh, nkv, hd = (self.cfg["heads"], self.cfg["kv_heads"],
+                           self.cfg["head_dim"])
+            H = nh * hd
+            if (adapters.num_layers != L or adapters.hidden != H
+                    or adapters.q_out != nh * hd
+                    or adapters.v_out != nkv * hd):
+                raise ValueError(
+                    "AdapterStore dimensions do not match this model: "
+                    f"store (L={adapters.num_layers}, H={adapters.hidden},"
+                    f" q={adapters.q_out}, v={adapters.v_out}) vs model "
+                    f"(L={L}, H={H}, q={nh * hd}, v={nkv * hd})")
+        self.adapters = adapters
 
     # --- public API -------------------------------------------------------
     def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
                     do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                     seed=0, deadline_s=None, tenant="-",
-                    priority="interactive"):
+                    priority="interactive", adapter=None):
         """Queue a request. `deadline_s` is a per-request wall-clock
         budget from arrival: once exceeded the request finishes with
         whatever it has and finish_reason='timeout'. `tenant` labels the
@@ -617,8 +642,12 @@ class ContinuousBatchingEngine:
         tenants past the cap collapse to 'overflow'). `priority` is the
         scheduling class (closed registry scheduler.PRIORITY_CLASSES:
         interactive / batch / best_effort) — only consulted when the
-        engine has a scheduler. Raises BackpressureError when the
-        admission queue is at max_queue."""
+        engine has a scheduler. `adapter` names a LoRA adapter in the
+        engine's AdapterStore (None = base model); a name the store
+        cannot make resident at admission is a typed rejection
+        (finish_reason='rejected'), never a base-weights fallback.
+        Raises BackpressureError when the admission queue is at
+        max_queue."""
         if priority not in PRIORITY_CLASSES:
             raise ValueError(
                 f"unknown priority class {priority!r}; registered: "
@@ -641,7 +670,8 @@ class ContinuousBatchingEngine:
         self._next_rid += 1
         req = Request(rid, prompt, max_new_tokens, eos_token_id,
                       do_sample, temperature, top_k, top_p,
-                      seed, deadline_s, tenant=tenant, priority=priority)
+                      seed, deadline_s, tenant=tenant, priority=priority,
+                      adapter=adapter)
         self.queue.append(req)
         self._arrivals.append(req.t_arrival)
         if self._tracer.enabled:
@@ -730,10 +760,21 @@ class ContinuousBatchingEngine:
             self._rec.record("finish", rid=req.rid, reason=reason,
                              tokens=len(req.generated))
 
+    def _adapter_release(self, req):
+        """Drop the request's adapter reference (idempotent). The ref
+        lifecycle mirrors the pool blocks exactly: acquired at
+        admission, held across preempt/park (blocks stay resident),
+        dropped wherever pool.release retires the request or a requeue
+        will re-acquire at the next admission."""
+        if self.adapters is not None and req.adapter_id:
+            self.adapters.release(req.adapter_id)
+        req.adapter_id = 0
+
     def _retire_lane(self, lane, reason):
         req = self.lanes[lane]
         self._prefill_tasks.pop(lane, None)
         self.pool.release(req.rid)
+        self._adapter_release(req)
         self.lanes[lane] = None
         self.lane_len[lane] = 0
         self._lane_epoch[lane] += 1
@@ -776,6 +817,7 @@ class ContinuousBatchingEngine:
                     and now >= req.t_deadline]:
             req, _ln, _tok = self._preempted.pop(rid)
             self.pool.release(rid)
+            self._adapter_release(req)
             _metric("serving_timeouts_total", where="preempted").inc()
             if self._rec.enabled:
                 self._rec.record("timeout", rid=rid, where="preempted")
@@ -800,6 +842,7 @@ class ContinuousBatchingEngine:
                 if req is not None and req.rid == rid:
                     self._prefill_tasks.pop(lane, None)
                     self.pool.release(rid)
+                    self._adapter_release(req)
                     self.lanes[lane] = None
                     self.lane_len[lane] = 0
                     self._lane_epoch[lane] += 1
@@ -808,8 +851,9 @@ class ContinuousBatchingEngine:
             else:
                 if rid not in self._preempted:
                     return False
-                self._preempted.pop(rid)
+                req, _ln, _tok = self._preempted.pop(rid)
                 self.pool.release(rid)
+                self._adapter_release(req)
         self._prefix_matched.pop(rid, None)
         if self._rec.enabled:
             self._rec.record("sched", action="cancel", rid=rid)
@@ -828,6 +872,7 @@ class ContinuousBatchingEngine:
                      key=lambda i: (-len(self.lanes[i].generated), i))
         req = self.lanes[victim]
         self.pool.release(req.rid)
+        self._adapter_release(req)
         self.lanes[victim] = None
         self.lane_len[victim] = 0
         self._lane_epoch[victim] += 1
@@ -948,6 +993,11 @@ class ContinuousBatchingEngine:
             "prefix_matched_tokens": int(
                 self._prefix_matched.get(req.rid, 0)),
             "prefix_shared_blocks": int(self.pool.shared_count(req.rid)),
+            # adapter identity rides the record as scalar meta (round 22):
+            # the importer must bind the SAME adapter or reject the
+            # handoff — silently continuing on base weights would change
+            # the stream mid-request.
+            "adapter": req.adapter,
             "k": np.asarray(self.pool.k[:, ids]),
             "v": np.asarray(self.pool.v[:, ids]),
         }
@@ -971,6 +1021,16 @@ class ContinuousBatchingEngine:
                 f"handoff block format {record['fmt']!r} != pool format "
                 f"{self.pool.fmt.name!r}; mesh replicas must share "
                 "kv_cache_dtype")
+        adapter = record.get("adapter") or None
+        if adapter is not None and (
+                self.adapters is None
+                or not self.adapters.can_serve(adapter)):
+            # rides the failed-handoff fallback (ValueError): the router
+            # re-prefills on a replica that CAN serve the adapter rather
+            # than silently continuing the stream on base weights
+            raise ValueError(
+                f"handoff names adapter {adapter!r} which this engine "
+                "cannot serve (no store or unregistered adapter)")
         prompt = np.asarray(record["prompt"], np.int32).reshape(-1)
         s = int(prompt.size)
         total = s + int(record["max_new_tokens"])
@@ -984,7 +1044,8 @@ class ContinuousBatchingEngine:
                       record["temperature"], record["top_k"],
                       record["top_p"], seed=None,
                       tenant=record["tenant"],
-                      priority=record["priority"])
+                      priority=record["priority"],
+                      adapter=adapter)
         # stream identity crosses the hop unchanged: trace id (span
         # joins), PRNG lane key (sampled decode continuity), arrival +
         # deadline anchors (TTFT/e2e stay measured from true arrival)
@@ -1009,6 +1070,16 @@ class ContinuousBatchingEngine:
             self._finish(req, reason)
             return rid
         self.pool.ensure(rid, total)
+        if req.adapter:
+            try:
+                req.adapter_id = self.adapters.acquire(req.adapter)
+            except (AdapterLoadError,) + _TRANSIENT_ERRORS as e:
+                # treated like any other failed handoff: give the blocks
+                # back and let the caller fall back to re-prefill
+                self.pool.release(rid)
+                raise ValueError(
+                    f"handoff adapter {req.adapter!r} failed to "
+                    f"hot-load on the receiving engine: {e}") from e
         nb = self.pool.blocks_needed(s)
         ids = jnp.asarray(self.pool.tables[rid][:nb], jnp.int32)
         self.pool.k = self.pool.k.at[:, ids].set(
@@ -1154,6 +1225,39 @@ class ContinuousBatchingEngine:
                 _metric("serving_deferred_total", reason="pool_full").inc()
                 return
             del self.queue[idx]
+            # adapter binding (round 22): make the named adapter
+            # resident and validate the slot the lanes will gather from
+            # before the pool reservation. ANY store failure — unknown
+            # name, slots pinned, injected serve.adapter_load /
+            # serve.adapter_gather fault — is a typed rejection: the
+            # one forbidden outcome is serving the stream with the
+            # wrong weights. Other lanes never notice (their slots are
+            # untouched).
+            req.adapter_id = 0
+            if req.adapter:
+                try:
+                    fault_point("serve.adapter_load", rid=req.rid,
+                                adapter=req.adapter)
+                    if self.adapters is None:
+                        raise AdapterLoadError(
+                            f"request names adapter {req.adapter!r} but "
+                            "the engine has no AdapterStore attached")
+                    req.adapter_id = self.adapters.acquire(req.adapter)
+                    fault_point("serve.adapter_gather", rid=req.rid,
+                                slot=req.adapter_id)
+                    self.adapters.check_resident(req.adapter_id)
+                except (AdapterLoadError,) + _TRANSIENT_ERRORS:
+                    self._adapter_release(req)
+                    req.generated = []
+                    self._finish(req, "rejected")
+                    _metric("serving_rejected_total",
+                            reason="adapter").inc()
+                    _metric("serving_adapter_load_failures_total").inc()
+                    if self._rec.enabled:
+                        self._rec.record("adapter", action="reject",
+                                         rid=req.rid,
+                                         adapter=req.adapter)
+                    continue
             lane = free_lanes[0]
             try:
                 fault_point("serve.admit", rid=req.rid)
@@ -1175,6 +1279,7 @@ class ContinuousBatchingEngine:
                 # the request AT THE FRONT of the queue — never let the
                 # scheduler step die mid-flight
                 self.pool.release(req.rid)
+                self._adapter_release(req)
                 self.queue.appendleft(req)
                 _metric("serving_deferred_total",
                         reason="pool_exhausted").inc()
@@ -1184,6 +1289,7 @@ class ContinuousBatchingEngine:
                 # fault): same counted-deferral contract — requeued at
                 # the front, retried next step, scheduler stays alive
                 self.pool.release(req.rid)
+                self._adapter_release(req)
                 self.queue.appendleft(req)
                 _metric("serving_deferred_total",
                         reason="admit_fault").inc()
@@ -1259,6 +1365,7 @@ class ContinuousBatchingEngine:
         """A chunk failed: give back the blocks + lane and requeue the
         request at the front for a fresh prefill next step."""
         self.pool.release(task.req.rid)
+        self._adapter_release(task.req)
         self.lanes[task.lane] = None
         self.lane_len[task.lane] = 0
         self._lane_epoch[task.lane] += 1
@@ -1283,9 +1390,14 @@ class ContinuousBatchingEngine:
             # compile
             from ..pir import pir_jit
             fn = pir_jit(self._make_prefill_chunk(),
-                         name=f"serving.prefill.b{width}")
+                         name=f"serving.prefill.b{width}",
+                         extra_key=({"lora": self.adapters.program_key}
+                                    if self.adapters is not None else None))
             self._prefill_jit[width] = fn
             self.compile_reports[f"prefill.b{width}"] = None
+            # program construction counts as a retrace: the hot-swap
+            # contract pins this counter's delta to 0 across adapter churn
+            _metric("jit_retrace_total").inc()
         cold = fn._compiled is None     # first call traces + compiles
         n_real = min(width, s - start)
         ids = np.zeros((1, width), np.int32)
@@ -1302,6 +1414,10 @@ class ContinuousBatchingEngine:
             args += [self.pool.k_scale, self.pool.v_scale]
         args += [jnp.asarray(ids), jnp.int32(start), jnp.int32(last_idx),
                  jnp.asarray(table)]
+        if self.adapters is not None:
+            ad = self.adapters
+            args += [ad.A_q, ad.B_q, ad.A_v, ad.B_v,
+                     jnp.int32(req.adapter_id)]
         t0 = time.perf_counter()
         out = fn(*args)
         if self.pool.fmt.quantized:
@@ -1341,6 +1457,9 @@ class ContinuousBatchingEngine:
         self._m_ttft.observe(ttft, exemplar=req.trace_id)
         _metric("serving_tenant_ttft_seconds",
                 tenant=req.tenant).observe(ttft)
+        if self.adapters is not None:
+            _metric("serving_adapter_ttft_seconds",
+                    adapter=req.adapter or "base").observe(ttft)
         if self.scheduler is not None:
             self.scheduler.note_ttft(ttft)
         # index the request's full-prompt blocks for the NEXT sharer
@@ -1372,6 +1491,7 @@ class ContinuousBatchingEngine:
             if self._phases.enabled:   # export = device->host KV readback
                 self._phases.mark("hostsync", tenant=req.tenant)
             self.pool.release(req.rid)
+            self._adapter_release(req)
             self._prefix_matched.pop(req.rid, None)
             self.lanes[lane] = None
             self.lane_len[lane] = 0
@@ -1603,8 +1723,13 @@ class ContinuousBatchingEngine:
                     + (".spec" if spec else ""))
             maker = self._make_decode_spec if spec else self._make_decode
             fn = pir_jit(maker(sampled), name=name,
-                         donate_argnums=(4, 5, 6, 7) if quant else (4, 5))
+                         donate_argnums=(4, 5, 6, 7) if quant else (4, 5),
+                         extra_key=({"lora": self.adapters.program_key}
+                                    if self.adapters is not None else None))
             self._decode_jit[jit_key] = fn
+            # program construction counts as a retrace: the hot-swap
+            # contract pins this counter's delta to 0 across adapter churn
+            _metric("jit_retrace_total").inc()
         args = [self.stacked, self.embed_w, self.norm_w, self._out_w,
                 self.pool.k, self.pool.v]
         if quant:
@@ -1616,6 +1741,11 @@ class ContinuousBatchingEngine:
         if sampled:
             args += [d["seeds"], d["do_sample"], d["temp"], d["top_k"],
                      d["top_p"]]
+        if self.adapters is not None:
+            # adapter pool + per-lane slot ids ride at the very END so
+            # the donated KV-pool argnums above never shift
+            ad = self.adapters
+            args += [ad.A_q, ad.B_q, ad.A_v, ad.B_v, d["adapter_ids"]]
         out = fn(*args)
         if spec:
             (tile, counts, d["toks"], d["lens"], d["alive"], d["rem"],
@@ -1704,6 +1834,11 @@ class ContinuousBatchingEngine:
                              if r is not None and not r.done}):
                 _metric("serving_tenant_tpot_seconds",
                         tenant=t).observe(per_tok)
+            if self.adapters is not None:
+                for a in sorted({(r.adapter or "base") for r in infl.reqs
+                                 if r is not None and not r.done}):
+                    _metric("serving_adapter_tpot_seconds",
+                            adapter=a).observe(per_tok)
         if self._rec.enabled:
             self._rec.record("readback", tile=infl.tile_id,
                              wait_ms=round((t1 - t0) * 1e3, 3))
@@ -1886,6 +2021,11 @@ class ContinuousBatchingEngine:
         for t in sorted({r.tenant for r in infl.reqs
                          if r is not None and not r.done}):
             _metric("serving_tenant_tpot_seconds", tenant=t).observe(per_tok)
+        if self.adapters is not None:
+            for a in sorted({(r.adapter or "base") for r in infl.reqs
+                             if r is not None and not r.done}):
+                _metric("serving_adapter_tpot_seconds",
+                        adapter=a).observe(per_tok)
 
     # --- device-resident lane state ---------------------------------------
     def _upload_lane_state(self, active):
@@ -1950,6 +2090,14 @@ class ContinuousBatchingEngine:
             dev.update(seeds=jnp.asarray(seeds), do_sample=jnp.asarray(do_s),
                        temp=jnp.asarray(temp), top_k=jnp.asarray(top_k),
                        top_p=jnp.asarray(top_p))
+        if self.adapters is not None:
+            # per-lane adapter slot ids: slot 0 (the reserved all-zero
+            # adapter) for empty lanes and base-weight requests, so the
+            # gathered low-rank delta is exactly 0 there
+            aids = np.zeros(B, np.int32)
+            for i in active:
+                aids[i] = self.lanes[i].adapter_id
+            dev["adapter_ids"] = jnp.asarray(aids)
         self._dev = dev
         self._dirty = False
         self._m_uploads.inc()
@@ -1962,8 +2110,16 @@ class ContinuousBatchingEngine:
         cfg = self.cfg
         fmt = self.pool.fmt
         quant = fmt.quantized
+        lora = self.adapters is not None
 
         def run(stacked, embed_w, norm_w, head_w, kpool, vpool, *rest):
+            rest = list(rest)
+            if lora:
+                # adapter pools ride at the END of the arg list (after
+                # every positional the storeless program takes) so the
+                # two programs share their leading signature
+                aq_p, bq_p, av_p, bv_p, aid = rest[-5:]
+                rest = rest[:-5]
             if quant:
                 kspool, vspool, ids, start, last_idx, table_row = rest
             else:
@@ -1971,19 +2127,29 @@ class ContinuousBatchingEngine:
             h = jnp.take(embed_w, ids, axis=0)       # (1, C, H)
 
             def layer(hh, xs):
+                if lora:
+                    aq_l, bq_l, av_l, bv_l = xs[-4:]
+                    xs = xs[:-4]
+                    # single lane per prefill call: one scalar adapter id
+                    # gathers this layer's (A, B) factors from the pool
+                    delta = (aq_l[aid], bq_l[aid], av_l[aid], bv_l[aid])
+                else:
+                    delta = None
                 if quant:
                     lp, kc, vc, ks, vs = xs
                     hh, pools = _llama_layer_prefill_chunk(
                         lp, hh, kc, vc, table_row, start, cfg,
-                        fmt=fmt, kc_scale=ks, vc_scale=vs)
+                        fmt=fmt, kc_scale=ks, vc_scale=vs, lora=delta)
                 else:
                     lp, kc, vc = xs
                     hh, pools = _llama_layer_prefill_chunk(
-                        lp, hh, kc, vc, table_row, start, cfg)
+                        lp, hh, kc, vc, table_row, start, cfg, lora=delta)
                 return hh, pools
 
             xs = ((stacked, kpool, vpool, kspool, vspool) if quant
                   else (stacked, kpool, vpool))
+            if lora:
+                xs = xs + (aq_p, bq_p, av_p, bv_p)
             h, pools = jax.lax.scan(layer, h, xs)
             h_last = h[0, last_idx]     # dynamic index: traced position
             logits = (_rms(h_last, norm_w, cfg["eps"]) @ head_w).astype(
@@ -1998,8 +2164,16 @@ class ContinuousBatchingEngine:
         scratch = self.pool.scratch_block
         fmt = self.pool.fmt
         quant = fmt.quantized
+        lora = self.adapters is not None
 
         def run(stacked, embed_w, norm_w, head_w, kpool, vpool, *rest):
+            rest = list(rest)
+            if lora:
+                # adapter pools + per-lane slot ids ride at the very END
+                # (after sampling state) so the donated KV argnums and
+                # the storeless signature prefix never shift
+                aq_p, bq_p, av_p, bv_p, aids = rest[-5:]
+                rest = rest[:-5]
             if quant:
                 (kspool, vspool, toks, lens, alive, rem, eos_ids, tables,
                  *sample_state) = rest
@@ -2024,18 +2198,36 @@ class ContinuousBatchingEngine:
                 pos = lens[:, None]                            # write pos
 
                 def layer(hh, xs):
+                    if lora:
+                        aq_l, bq_l, av_l, bv_l = xs[-4:]
+                        xs = xs[:-4]
                     if quant:
                         lp, kc, vc, ks, vs = xs
                     else:
                         lp, kc, vc = xs
                         ks = vs = None
                     x = _rms(hh, lp["input_layernorm.weight"], eps)
-                    q = (x @ lp["self_attn.q_proj.weight"]
-                         ).reshape(B, 1, nh, hd)
+                    q_lin = x @ lp["self_attn.q_proj.weight"]
+                    v_lin = x @ lp["self_attn.v_proj.weight"]
+                    if lora:
+                        # per-lane batched low-rank delta: gather each
+                        # lane's (A, B) factors by slot id, one einsum
+                        # over the whole tile. Slot 0 is all-zeros, so
+                        # base lanes add exactly 0.
+                        aq = jnp.take(aq_l, aids, axis=0)   # (B, H, r)
+                        bq = jnp.take(bq_l, aids, axis=0)   # (B, r, Dq)
+                        q_lin = q_lin + jnp.einsum(
+                            "bch,bhr,brd->bcd", x,
+                            aq.astype(x.dtype), bq.astype(x.dtype))
+                        av = jnp.take(av_l, aids, axis=0)
+                        bv = jnp.take(bv_l, aids, axis=0)
+                        v_lin = v_lin + jnp.einsum(
+                            "bch,bhr,brd->bcd", x,
+                            av.astype(x.dtype), bv.astype(x.dtype))
+                    q = q_lin.reshape(B, 1, nh, hd)
                     k = (x @ lp["self_attn.k_proj.weight"]
                          ).reshape(B, 1, nkv, hd)
-                    v = (x @ lp["self_attn.v_proj.weight"]
-                         ).reshape(B, 1, nkv, hd)
+                    v = v_lin.reshape(B, 1, nkv, hd)
                     q = _rope(q, pos, theta)[:, 0]
                     k = _rope(k, pos, theta)[:, 0]
                     v = v[:, 0]
@@ -2062,6 +2254,8 @@ class ContinuousBatchingEngine:
 
                 xs = ((stacked, kpool, vpool, kspool, vspool) if quant
                       else (stacked, kpool, vpool))
+                if lora:
+                    xs = xs + (aq_p, bq_p, av_p, bv_p)
                 h, pools = jax.lax.scan(layer, h, xs)
                 if quant:
                     kpool, vpool, kspool, vspool = pools
@@ -2117,8 +2311,15 @@ class ContinuousBatchingEngine:
         hmax = self.max_blocks_per_seq * self.pool.block_size
         drafter = self._drafter
         ngram = self.draft_ngram
+        lora = self.adapters is not None
 
         def run(stacked, embed_w, norm_w, head_w, kpool, vpool, *rest):
+            rest = list(rest)
+            if lora:
+                # same tail contract as the base decode program: adapter
+                # state last, donated argnums untouched
+                aq_p, bq_p, av_p, bv_p, aids = rest[-5:]
+                rest = rest[:-5]
             if quant:
                 (kspool, vspool, toks, lens, alive, rem, eos_ids, tables,
                  hist, *sample_state) = rest
@@ -2157,18 +2358,34 @@ class ContinuousBatchingEngine:
                 pos = lens[:, None] + jnp.arange(C)[None, :]   # (B, C)
 
                 def layer(hh, xs):
+                    if lora:
+                        aq_l, bq_l, av_l, bv_l = xs[-4:]
+                        xs = xs[:-4]
                     if quant:
                         lp, kc, vc, ks, vs = xs
                     else:
                         lp, kc, vc = xs
                         ks = vs = None
                     x = _rms(hh, lp["input_layernorm.weight"], eps)
-                    q = (x @ lp["self_attn.q_proj.weight"]
-                         ).reshape(B, C, nh, hd)
+                    q_lin = x @ lp["self_attn.q_proj.weight"]
+                    v_lin = x @ lp["self_attn.v_proj.weight"]
+                    if lora:
+                        # x is (B, C, H) here — the same batched einsum
+                        # covers all C verify positions of every lane
+                        aq = jnp.take(aq_l, aids, axis=0)
+                        bq = jnp.take(bq_l, aids, axis=0)
+                        q_lin = q_lin + jnp.einsum(
+                            "bch,bhr,brd->bcd", x,
+                            aq.astype(x.dtype), bq.astype(x.dtype))
+                        av = jnp.take(av_l, aids, axis=0)
+                        bv = jnp.take(bv_l, aids, axis=0)
+                        v_lin = v_lin + jnp.einsum(
+                            "bch,bhr,brd->bcd", x,
+                            av.astype(x.dtype), bv.astype(x.dtype))
+                    q = q_lin.reshape(B, C, nh, hd)
                     k = (x @ lp["self_attn.k_proj.weight"]
                          ).reshape(B, C, nkv, hd)
-                    v = (x @ lp["self_attn.v_proj.weight"]
-                         ).reshape(B, C, nkv, hd)
+                    v = v_lin.reshape(B, C, nkv, hd)
                     q = _rope(q, pos, theta)
                     k = _rope(k, pos, theta)
                     # kv.write effect scope (stamped inside the callee):
@@ -2195,6 +2412,8 @@ class ContinuousBatchingEngine:
 
                 xs = ((stacked, kpool, vpool, kspool, vspool) if quant
                       else (stacked, kpool, vpool))
+                if lora:
+                    xs = xs + (aq_p, bq_p, av_p, bv_p)
                 h, (pools, saved) = jax.lax.scan(layer, h, xs)
                 logits = (_rms(h, norm_w, eps) @ head_w).astype(
                     jnp.float32)                               # (B, C, V)

@@ -180,6 +180,40 @@ CATALOG = {
         "full-prefix match re-prefills its final prompt position into a "
         "private copy of the last shared block (the only write that can "
         "target a shared block)", (), None),
+    "serving_adapter_loads_total": (
+        "counter", "adapter hot-loads into a device pool slot, by "
+        "adapter name (bounded by the store's closed registry)",
+        ("adapter",), None),
+    "serving_adapter_evictions_total": (
+        "counter", "idle adapter slots LRU-evicted to make room for a "
+        "cold acquire, by the evicted adapter's name", ("adapter",),
+        None),
+    "serving_adapter_resident": (
+        "gauge", "named adapters currently resident in the device "
+        "weight pool (slot 0, the all-zeros base, is not counted)",
+        (), None),
+    "serving_adapter_load_failures_total": (
+        "counter", "adapter acquisitions that failed typed (unknown "
+        "name, all slots pinned, or an injected serve.adapter_load / "
+        "serve.adapter_gather fault); each one is a "
+        "finish_reason=rejected admission, never a wrong-weights "
+        "stream", (), None),
+    "serving_adapter_upload_seconds": (
+        "histogram", "host dispatch wall of one adapter's A/B pool "
+        "upload (the copy itself is async and overlaps in-flight "
+        "decode tiles)", (), _STEP_BUCKETS),
+    "serving_adapter_quota_deferrals_total": (
+        "counter", "admission picks skipped because the candidate's "
+        "adapter was at its concurrent-lane quota (adapter DRR riding "
+        "the tenant scheduler)", ("adapter",), None),
+    "serving_adapter_ttft_seconds": (
+        "histogram", "per-adapter time to first token (label 'base' = "
+        "slot-0 requests; cardinality bounded by the store's closed "
+        "registry)", ("adapter",), _TTFT_BUCKETS),
+    "serving_adapter_tpot_seconds": (
+        "histogram", "per-adapter per-token decode latency (same tile "
+        "wall as serving_tpot_seconds, attributed to each adapter the "
+        "tile advanced)", ("adapter",), _TPOT_BUCKETS),
     "serving_phase_seconds": (
         "histogram", "one phase-attributed segment of engine step wall "
         "time, by profiler phase (closed registry in "
@@ -299,8 +333,10 @@ CATALOG = {
         "verifier-error); each rejection degrades that compile to "
         "plain jax.jit", ("rule",), None),
     "jit_retrace_total": (
-        "counter", "StaticFunction traces for a new input signature "
-        "(shape churn past the LRU signature cache is visible here)",
+        "counter", "compiled-program (re)constructions: StaticFunction "
+        "traces for a new input signature, plus serving decode/prefill "
+        "program builds (shape or variant churn is visible here; the "
+        "adapter hot-swap contract pins its delta to 0 across churn)",
         (), None),
     "compile_cache_hit_total": (
         "counter", "persistent compile-cache hits (verified artifact "

@@ -71,6 +71,9 @@ EVENT_KINDS = {
     "controller": "mesh autoscale controller action (scale_up spawn, "
                   "drain_begin, scale_down retire, drain_forced kill, "
                   "latch_off back to advisory-only)",
+    "adapter": "adapter store lifecycle (hot-load into a pool slot, "
+               "LRU evict of an idle slot, typed admission reject on a "
+               "store fault) with the adapter name and slot id",
 }
 
 

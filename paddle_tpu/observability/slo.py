@@ -52,11 +52,16 @@ VERDICT_FORMAT = 1
 
 class SLOSpec:
     """One declarative objective. `good` (error_budget only) maps a
-    label name to the tuple of values that count as good outcomes."""
+    label name to the tuple of values that count as good outcomes.
+    `labels` (optional, any kind) restricts the spec to samples whose
+    labels match every given name=value pair — the per-adapter /
+    per-tenant verdict scoping (round 22)."""
 
-    __slots__ = ("name", "kind", "metric", "q", "objective", "good")
+    __slots__ = ("name", "kind", "metric", "q", "objective", "good",
+                 "labels")
 
-    def __init__(self, name, kind, metric, objective, q=None, good=None):
+    def __init__(self, name, kind, metric, objective, q=None, good=None,
+                 labels=None):
         if kind not in ("quantile", "error_budget"):
             raise ValueError(f"unknown SLO kind {kind!r} "
                              "(want quantile|error_budget)")
@@ -75,11 +80,28 @@ class SLOSpec:
         self.objective = float(objective)
         self.good = ({str(k): tuple(str(x) for x in v)
                       for k, v in good.items()} if good else None)
+        self.labels = ({str(k): str(v) for k, v in labels.items()}
+                       if labels else None)
+
+    def state_key(self):
+        """What one windowed observation is keyed by: two specs over
+        the same metric with different label filters must not share
+        state."""
+        if not self.labels:
+            return self.metric
+        return (self.metric, tuple(sorted(self.labels.items())))
+
+    def matches(self, sample_labels):
+        if not self.labels:
+            return True
+        sl = sample_labels or {}
+        return all(sl.get(k) == v for k, v in self.labels.items())
 
     @classmethod
     def from_dict(cls, d):
         return cls(d["name"], d["kind"], d["metric"], d["objective"],
-                   q=d.get("q"), good=d.get("good"))
+                   q=d.get("q"), good=d.get("good"),
+                   labels=d.get("labels"))
 
     def to_dict(self):
         d = {"name": self.name, "kind": self.kind, "metric": self.metric,
@@ -88,6 +110,8 @@ class SLOSpec:
             d["q"] = self.q
         if self.good is not None:
             d["good"] = {k: list(v) for k, v in self.good.items()}
+        if self.labels is not None:
+            d["labels"] = dict(self.labels)
         return d
 
     def __repr__(self):
@@ -129,11 +153,14 @@ def _find_metric(snapshot_doc, name):
     return None
 
 
-def _hist_state(mdict):
+def _hist_state(mdict, spec=None):
     """Merge a histogram family's samples -> {le_key: cum} (le_key is
-    float or '+Inf'), summing across label children."""
+    float or '+Inf'), summing across label children — optionally only
+    the children matching the spec's label filter."""
     merged = {}
     for s in mdict.get("samples", []):
+        if spec is not None and not spec.matches(s.get("labels")):
+            continue
         for le, cum in s.get("buckets", []):
             key = "+Inf" if (isinstance(le, str) or le == float("inf")) \
                 else float(le)
@@ -141,25 +168,28 @@ def _hist_state(mdict):
     return merged
 
 
-def _counter_state(mdict):
+def _counter_state(mdict, spec=None):
     """Labeled counter family -> {(sorted label items): value}."""
     out = {}
     for s in mdict.get("samples", []):
+        if spec is not None and not spec.matches(s.get("labels")):
+            continue
         key = tuple(sorted((s.get("labels") or {}).items()))
         out[key] = out.get(key, 0.0) + float(s.get("value", 0.0))
     return out
 
 
 def _extract(snapshot_doc, specs):
-    """One windowed observation: per spec metric, the cumulative state
-    needed to diff later."""
+    """One windowed observation: per spec metric (and label filter), the
+    cumulative state needed to diff later."""
     state = {}
     for spec in specs:
         m = _find_metric(snapshot_doc, spec.metric)
         if m is None:
             continue
-        state[spec.metric] = (_hist_state(m) if spec.kind == "quantile"
-                              else _counter_state(m))
+        state[spec.state_key()] = (
+            _hist_state(m, spec) if spec.kind == "quantile"
+            else _counter_state(m, spec))
     return state
 
 
@@ -225,8 +255,10 @@ class SLOEngine:
                  "metric": spec.metric, "objective": spec.objective}
             if spec.q is not None:
                 r["q"] = spec.q
-            new = (newest or {}).get(spec.metric)
-            old = (baseline or {}).get(spec.metric)
+            if spec.labels is not None:
+                r["labels"] = dict(spec.labels)
+            new = (newest or {}).get(spec.state_key())
+            old = (baseline or {}).get(spec.state_key())
             if spec.kind == "quantile":
                 if new is None:
                     r.update(ok=True, no_data=True, observed=None,

@@ -85,6 +85,17 @@ FAULT_SITES = {
                      "(paged-KV stays resident); failure aborts that "
                      "attempt, counted, and the victim lane keeps "
                      "decoding",
+    "serve.adapter_load": "adapter store: hot-load/refcount of a named "
+                          "LoRA adapter at admission; ANY failure is a "
+                          "typed rejection (finish_reason=rejected, "
+                          "serving_rejected_total{reason=adapter}) — "
+                          "never a silent base-weights fallback; lanes "
+                          "on other adapters are untouched",
+    "serve.adapter_gather": "adapter store: lane-bind residency check "
+                            "of the slot the fused scan will gather "
+                            "A/B factors from; failure rejects the "
+                            "request typed + counted instead of "
+                            "gathering stale weights",
     "train.step_nonfinite": "train supervisor: force a non-finite loss "
                             "for this step (consulted via check())",
     "compile.cache_read": "PIR compile cache: artifact read (verified "

@@ -32,7 +32,7 @@ def test_list_rules_names_the_closed_registry():
     for rule in ("metrics-in-catalog", "catalog-docs-sync", "fault-sites",
                  "recorder-kinds", "flags-registered", "host-sync",
                  "profiler-phases", "scheduler-actions", "pir-passes",
-                 "mesh-wiring", "recording-rules"):
+                 "mesh-wiring", "recording-rules", "adapter-wiring"):
         assert rule in r.stdout
 
 
@@ -160,6 +160,46 @@ def test_mesh_wiring_rule_catches_unregistered_literals(tmp_path):
     found = [v for v in json.loads(r.stdout) if v["rule"] == "mesh-wiring"]
     msgs = " | ".join(v["message"] for v in found)
     assert "mesh.bogus_site" in msgs and "bogus_mesh_kind" in msgs, found
+
+
+def test_adapter_wiring_rule_catches_uncataloged_metric(tmp_path):
+    # a file masquerading as the adapter store emitting a metric
+    # outside the catalog through the aliased `_metric` accessor the
+    # generic metrics-in-catalog rule cannot see. (Not the real
+    # adapters.py in the scan set, so the reverse-containment checks
+    # stay dormant.)
+    bad = tmp_path / "paddle_tpu" / "inference"
+    bad.mkdir(parents=True)
+    f = bad / "serving.py"
+    f.write_text("def retire(rid):\n"
+                 "    _metric('serving_adapter_bogus_total').inc()\n")
+    r = _run("--paths", str(f), "--json")
+    assert r.returncode == 1, f"violation not caught:\n{r.stdout}"
+    found = [v for v in json.loads(r.stdout)
+             if v["rule"] == "adapter-wiring"]
+    msgs = " | ".join(v["message"] for v in found)
+    assert "serving_adapter_bogus_total" in msgs, found
+
+
+def test_adapter_wiring_rule_catches_unarmed_site(tmp_path):
+    # the real adapters.py in the scan set arms the reverse checks; a
+    # stand-in serving.py with no fault_point must trip "registered
+    # but never armed" for both adapter seams (and "never emitted" for
+    # the serving-side metrics the stand-in dropped)
+    real = os.path.join(REPO, "paddle_tpu", "inference", "adapters.py")
+    bad = tmp_path / "paddle_tpu" / "inference"
+    bad.mkdir(parents=True)
+    f = bad / "serving.py"
+    f.write_text("def admit(req):\n"
+                 "    return req\n")
+    r = _run("--paths", real, str(f), "--json")
+    assert r.returncode == 1, f"violation not caught:\n{r.stdout}"
+    found = [v for v in json.loads(r.stdout)
+             if v["rule"] == "adapter-wiring"]
+    msgs = " | ".join(v["message"] for v in found)
+    assert "serve.adapter_load" in msgs \
+        and "serve.adapter_gather" in msgs \
+        and "never armed" in msgs, found
 
 
 def test_host_sync_rule_catches_new_sync(tmp_path):

@@ -82,6 +82,15 @@ KEYS = {
          ".wall_ratio",
          "detail.secondary.fusion_ab.programs.fused_decode.wall_ratio"),
         "down"),
+    # round 22: multi-adapter A/B — the mixed-adapter throughput tax
+    # (per-lane delta gathers) must not deepen, and the resident-set
+    # mixed tok/s must not regress across rounds
+    "adapters_mixed_tokens_per_s": (
+        ("detail.secondary_cpu_fallback.adapters_ab.mixed_tokens_per_s",
+         "detail.secondary.adapters_ab.mixed_tokens_per_s"), "up"),
+    "adapters_mixed_vs_base": (
+        ("detail.secondary_cpu_fallback.adapters_ab.mixed_vs_base",
+         "detail.secondary.adapters_ab.mixed_vs_base"), "up"),
 }
 
 # Headline train metrics are DEVICE-DEPENDENT (the trajectory mixes

@@ -427,6 +427,95 @@ def drill_serve_preempt(tmp):
                          "also byte-identical")
 
 
+def _tiny_adapter_engine(names=("lora0", "lora1"), **kw):
+    """_tiny_engine + the deterministic demo AdapterStore (installed on
+    the cold engine, before any program compiles). paddle.seed(0) in
+    _tiny_engine plus the store's fixed weight seed make every build
+    byte-identical, so fresh-engine streams are valid references."""
+    from paddle_tpu.inference.adapters import demo_store_for_engine
+    model, eng = _tiny_engine(**kw)
+    eng.adapters = demo_store_for_engine(eng, list(names))
+    return model, eng
+
+
+def _adapter_ref(adapter, p, n):
+    """Unfaulted reference stream for (adapter, prompt): a fresh engine
+    + store serving exactly one request."""
+    model, eng = _tiny_adapter_engine()
+    rid = eng.add_request(p, max_new_tokens=n, adapter=adapter)
+    return eng.run()[rid]
+
+
+def drill_serve_adapter_load(tmp):
+    p0 = (np.arange(7) * 3) % 128
+    p1 = (np.arange(7) * 5) % 128
+    ref0 = _adapter_ref("lora0", p0, 6)
+    ref1 = _adapter_ref("lora1", p1, 6)
+    model, eng = _tiny_adapter_engine()
+    rej0 = _counter("serving_rejected_total", reason="adapter")
+    fail0 = _counter_sum("serving_adapter_load_failures_total")
+    with faults.injected_faults("serve.adapter_load:1:TimeoutError"):
+        rid_a = eng.add_request(p0, max_new_tokens=6, adapter="lora0")
+        rid_b = eng.add_request(p1, max_new_tokens=6, adapter="lora1")
+        out = eng.run()
+        inj = faults.injected_counts().get("serve.adapter_load", 0)
+    _expect(inj == 1, "fault never reached the adapter-load site")
+    _expect(eng.finished[rid_a].finish_reason == "rejected",
+            "faulted adapter bind did not finish as a typed rejection")
+    _expect(not out.get(rid_a),
+            "rejected request produced tokens (wrong-weights risk)")
+    _expect(out.get(rid_b) == ref1,
+            "other-adapter stream diverged from its unfaulted reference")
+    _expect(_counter("serving_rejected_total", reason="adapter")
+            - rej0 >= 1, "adapter rejection not counted")
+    _expect(_counter_sum("serving_adapter_load_failures_total")
+            - fail0 >= 1, "load failure not counted")
+    _expect(eng.pool.tables == {}, "pool blocks leaked")
+    # fault cleared: the SAME adapter hot-loads and serves byte-exact
+    rid_c = eng.add_request(p0, max_new_tokens=6, adapter="lora0")
+    _expect(eng.run()[rid_c] == ref0,
+            "adapter stream diverged after the fault cleared")
+    _expect(all(v == 0 for v in eng.adapters._refs.values()),
+            "adapter refs leaked across the drill")
+    return "degraded", ("store fault at bind rejected that request "
+                        "typed + counted; the co-queued adapter and the "
+                        "post-clear retry both byte-exact")
+
+
+def drill_serve_adapter_gather(tmp):
+    p = (np.arange(8) * 5) % 128
+    pb = (np.arange(6) * 7) % 128
+    ref0 = _adapter_ref("lora0", p, 6)
+    model, eng = _tiny_adapter_engine()
+    base_ref = _dense_ref(model, pb, 6)
+    rej0 = _counter("serving_rejected_total", reason="adapter")
+    with faults.injected_faults("serve.adapter_gather:1:TimeoutError"):
+        rid_a = eng.add_request(p, max_new_tokens=6, adapter="lora0")
+        rid_b = eng.add_request(pb, max_new_tokens=6)   # base lane
+        out = eng.run()
+        inj = faults.injected_counts().get("serve.adapter_gather", 0)
+    _expect(inj == 1, "fault never reached the adapter-gather site")
+    _expect(eng.finished[rid_a].finish_reason == "rejected",
+            "faulted slot validation did not reject typed")
+    _expect(not out.get(rid_a),
+            "rejected request produced tokens (stale-slot gather risk)")
+    _expect(out.get(rid_b) == base_ref,
+            "base lane diverged across the adapter-gather fault")
+    _expect(_counter("serving_rejected_total", reason="adapter")
+            - rej0 >= 1, "adapter rejection not counted")
+    _expect(all(v == 0 for v in eng.adapters._refs.values()),
+            "gather rejection leaked the acquired adapter ref")
+    _expect(eng.pool.tables == {}, "pool blocks leaked")
+    # fault cleared: the adapter (already resident from the acquire)
+    # serves byte-identically to its unfaulted reference
+    rid_c = eng.add_request(p, max_new_tokens=6, adapter="lora0")
+    _expect(eng.run()[rid_c] == ref0,
+            "adapter stream diverged after the fault cleared")
+    return "degraded", ("slot-validation fault rejected typed with the "
+                        "acquired ref released; base lane untouched; "
+                        "post-clear adapter stream byte-exact")
+
+
 def drill_train_step_nonfinite(tmp):
     losses = {"n": 0}
 
@@ -1004,6 +1093,8 @@ SCENARIOS = {
     "serve.loadgen_tick": drill_serve_loadgen_tick,
     "serve.sched_decide": drill_serve_sched_decide,
     "serve.preempt": drill_serve_preempt,
+    "serve.adapter_load": drill_serve_adapter_load,
+    "serve.adapter_gather": drill_serve_adapter_gather,
     "train.step_nonfinite": drill_train_step_nonfinite,
     "compile.cache_read": drill_compile_cache_read,
     "compile.cache_write": drill_compile_cache_write,

@@ -121,6 +121,12 @@ def main(argv=None):
                     help="with --check on a prefix-cache run: fail unless "
                          "the admission hit rate reaches this floor "
                          "(default 0.5 for the shared_prefix scenario)")
+    ap.add_argument("--min-adapter-loads", type=float, default=None,
+                    help="with --check on a multi-adapter run: fail "
+                         "unless the run window hot-loaded at least this "
+                         "many adapters, the per-adapter latency split "
+                         "is populated, and swap_recompiles is exactly 0 "
+                         "(default 1 for the multi_adapter scenario)")
     ap.add_argument("--replicas", type=int, default=1,
                     help="run N in-process engine replicas behind the "
                          "mesh router instead of one engine; the report "
@@ -263,6 +269,14 @@ def main(argv=None):
               f"shared_blocks={pfx['shared_blocks']} "
               f"evictions={pfx['evictions']} cow_forks={pfx['cow_forks']}",
               file=sys.stderr)
+    adp = report.get("adapters")
+    if adp:
+        print(f"# adapters: population={adp['population']} "
+              f"loads={adp['loads']} evictions={adp['evictions']} "
+              f"load_failures={adp['load_failures']} "
+              f"resident={adp['resident']} "
+              f"swap_recompiles={adp['swap_recompiles']}",
+              file=sys.stderr)
     mesh = report.get("mesh")
     if mesh:
         print(f"# mesh: replicas={len(mesh['replicas'])} "
@@ -315,7 +329,12 @@ def main(argv=None):
                 if args.min_prefix_hit_rate is not None
                 else (0.5 if prefix_on
                       and loadgen.SCENARIOS[args.scenario].shared_prefix_len
-                      else None)))
+                      else None)),
+            min_adapter_loads=(
+                args.min_adapter_loads
+                if args.min_adapter_loads is not None
+                else (1 if loadgen.SCENARIOS[
+                    args.scenario].adapter_population else None)))
         if args.slow_replica:
             # the gray-failure acceptance: the wedged worker must have
             # been demoted SLOW (never killed — that would be the crash
@@ -349,6 +368,10 @@ def main(argv=None):
         if pfx:
             extra += (f", prefix hit_rate {pfx['hit_rate']} "
                       f"({pfx['tokens_saved']} prefill tokens saved)")
+        if adp:
+            extra += (f", {adp['loads']} adapter hot-loads / "
+                      f"{adp['evictions']} evictions, "
+                      f"{adp['swap_recompiles']} swap recompiles")
         if args.replicas > 1:
             auto = (report.get("mesh") or {}).get("autoscale") or {}
             extra += (f", autoscale {auto.get('action')} -> "

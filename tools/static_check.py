@@ -45,6 +45,12 @@ Rules (closed registry, like everything else here):
                        plane's obs.sample fault seam registered in
                        FAULT_SITES, drilled, documented in
                        RESILIENCE.md, and actually armed by the sampler
+  adapter-wiring       serving_adapter_* metric literals (emitted as
+                       `_metric`) ⊆ CATALOG with OBSERVABILITY.md rows
+                       and all actually emitted; the `adapter` recorder
+                       kind registered + emitted + documented; the
+                       serve.adapter_load / serve.adapter_gather seams
+                       registered, armed, drilled, in RESILIENCE.md
 
 Usage:
   python tools/static_check.py                 # whole repo, all rules
@@ -103,6 +109,17 @@ SCHED_ACTION_FILES = ("paddle_tpu/inference/serving.py",
 # event-kind literals are pinned to the closed registries (dir entry —
 # matched by containment, like PHASE_MARK_FILES)
 MESH_FILES = ("paddle_tpu/inference/mesh/",)
+
+# adapter-wiring rule scope: the multi-adapter (LoRA) sources whose
+# metric / event-kind / fault-site literals are pinned to the closed
+# registries. adapters.py is the core gate for the reverse checks
+# (like router.py for mesh-wiring): a --paths run that doesn't include
+# it must not fire "never emitted" violations.
+ADAPTER_FILES = ("paddle_tpu/inference/adapters.py",
+                 "paddle_tpu/inference/serving.py",
+                 "paddle_tpu/inference/scheduler.py",
+                 "paddle_tpu/inference/loadgen.py")
+ADAPTER_SITES = ("serve.adapter_load", "serve.adapter_gather")
 
 # host-sync rule scope + allowlist: methods audited as intentional
 # host syncs (see STATIC_ANALYSIS.md "Host-sync allowlist policy").
@@ -780,6 +797,108 @@ def rule_mesh_wiring(ctx):
     return out
 
 
+def rule_adapter_wiring(ctx):
+    """The multi-adapter (LoRA) serving surface is pinned both ways:
+    every ``serving_adapter_*`` metric literal the adapter sources emit
+    (they import the accessor as ``_metric``, which the
+    metrics-in-catalog rule's bare-``metric`` scan does not see) must
+    be a catalog entry with an OBSERVABILITY.md row; every
+    ``serving_adapter_*`` catalog entry must actually be emitted by
+    the adapter sources; the ``adapter`` flight-recorder kind must be
+    registered, emitted, and described in OBSERVABILITY.md's flight
+    recorder section; and the two admission fault seams
+    (``serve.adapter_load`` / ``serve.adapter_gather``) must be
+    registered in FAULT_SITES, armed (``fault_point``) by the serving
+    engine, drilled by chaos_drill SCENARIOS, and backticked in
+    RESILIENCE.md — the typed-reject degrade contract is only real if
+    every leg of that chain exists."""
+    out = []
+    used_metrics, used_kinds, armed_sites = set(), set(), set()
+    scanned_core = False
+    for path, tree in ctx.sources.items():
+        norm = path.replace(os.sep, "/")
+        if not any(norm.endswith(s) for s in ADAPTER_FILES):
+            continue
+        if norm.endswith("inference/adapters.py"):
+            scanned_core = True
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            callee = _callee(node)
+            lit = node.args[0].value
+            if callee in ("metric", "_metric"):
+                if lit.startswith("serving_adapter_"):
+                    used_metrics.add(lit)
+                if lit not in ctx.catalog:
+                    out.append(Violation(
+                        "adapter-wiring", path, node.lineno,
+                        f"{callee}({lit!r}) is not in {CATALOG_PY} "
+                        "CATALOG"))
+            elif callee == "record" and lit == "adapter":
+                used_kinds.add(lit)
+            elif callee == "fault_point" and lit in ADAPTER_SITES:
+                armed_sites.add(lit)
+    adapter_metrics = {m for m in ctx.catalog
+                       if m.startswith("serving_adapter_")}
+    if not adapter_metrics:
+        out.append(Violation(
+            "adapter-wiring", CATALOG_PY, 0,
+            "no serving_adapter_* metrics in CATALOG (the adapter "
+            "store's evidence surface is gone)"))
+    for name in sorted(adapter_metrics - ctx.obs_rows):
+        out.append(Violation(
+            "adapter-wiring", OBS_MD, 0,
+            f"catalog metric {name!r} has no `| `{name}` |` row in "
+            f"{OBS_MD}"))
+    if "adapter" not in ctx.event_kinds:
+        out.append(Violation(
+            "adapter-wiring", RECORDER_PY, 0,
+            "flight-recorder kind 'adapter' is not in EVENT_KINDS"))
+    elif not re.search(r"`adapter`\s*\(", _read(OBS_MD)):
+        out.append(Violation(
+            "adapter-wiring", OBS_MD, 0,
+            "flight-recorder kind 'adapter' is not described in "
+            f"{OBS_MD}'s flight recorder section"))
+    for site in ADAPTER_SITES:
+        if site not in ctx.fault_sites:
+            out.append(Violation(
+                "adapter-wiring", FAULTS_PY, 0,
+                f"adapter fault site {site!r} is not registered in "
+                f"{FAULTS_PY} FAULT_SITES"))
+        if site not in ctx.scenarios:
+            out.append(Violation(
+                "adapter-wiring", CHAOS_PY, 0,
+                f"adapter fault site {site!r} has no chaos_drill "
+                "SCENARIOS drill"))
+        if site not in ctx.res_ticks:
+            out.append(Violation(
+                "adapter-wiring", RES_MD, 0,
+                f"adapter fault site {site!r} is never mentioned "
+                f"(backticked) in {RES_MD}"))
+    if scanned_core:
+        # reverse containment only when the real adapter sources were
+        # in the scan set (a --paths run on one file must not fire)
+        for name in sorted(adapter_metrics - used_metrics):
+            out.append(Violation(
+                "adapter-wiring", CATALOG_PY, 0,
+                f"catalog metric {name!r} is never emitted by the "
+                "adapter serving sources"))
+        if "adapter" in ctx.event_kinds and "adapter" not in used_kinds:
+            out.append(Violation(
+                "adapter-wiring", RECORDER_PY, 0,
+                "EVENT_KINDS entry 'adapter' is never emitted by the "
+                "adapter serving sources"))
+        for site in ADAPTER_SITES:
+            if site in ctx.fault_sites and site not in armed_sites:
+                out.append(Violation(
+                    "adapter-wiring", FAULTS_PY, 0,
+                    f"adapter fault site {site!r} is registered but "
+                    "never armed (fault_point) by the serving engine"))
+    return out
+
+
 def rule_recording_rules(ctx):
     """The recording-rule registry (timeseries.py RECORDING_RULES) is
     closed like the metric catalog, with one documentation mirror:
@@ -888,6 +1007,10 @@ RULES = {
                         "RECORDING_RULES == OBSERVABILITY.md rule/ rows; "
                         "obs.sample registered, drilled, documented, "
                         "armed"),
+    "adapter-wiring": (rule_adapter_wiring,
+                       "serving_adapter_* metrics emitted + cataloged + "
+                       "documented; adapter sites armed, drilled, in "
+                       "RESILIENCE.md"),
 }
 
 

@@ -733,15 +733,23 @@ def _bench_engine_adapters(model, cfg, batch):
 
 
 def _bench_fusion_ab():
-    """Round-19 auto-fusion A/B: two programs — a llama-block train
-    step (rmsnorm + attention + gelu-MLP + residuals, fwd + weight
-    grads) and a fused-decode step proxy (block fwd + final rmsnorm +
-    logits matmul + softmax/argmax tail) — compiled through the PIR
-    pipeline with the fuse pass on and off. Records committed groups,
-    predicted bytes saved, and the warm wall ratio. Gate (CPU proxy,
-    where XLA already fuses aggressively so the win is mostly
-    predicted, not walled): fused <= 1.05x unfused and >= 1 committed
-    group per program with bytes saved > 0."""
+    """Round-19/23 auto-fusion A/B: three programs — a llama-block
+    train step (rmsnorm + attention + gelu-MLP + residuals, fwd +
+    weight grads), a fused-decode step proxy (block fwd + final
+    rmsnorm + logits matmul + softmax/argmax tail), and a
+    matmul-epilogue shape (dot → bias → gelu → residual → rmsnorm with
+    the residual escaping as a second output — the fusion-v2
+    epilogue-absorption + output-promotion showcase) — compiled
+    through the PIR pipeline with the fuse pass on and off. Records
+    committed groups (total and by provenance kind), predicted bytes
+    saved (with the delta vs the round-19 single-output-planner
+    baseline where one exists), and the warm wall ratio. Gate (CPU
+    proxy, where XLA already fuses aggressively so the win is mostly
+    predicted, not walled): fused <= 1.05x unfused, >= 1 committed
+    group per program with bytes saved > 0, the train step strictly
+    above its round-19 bytes-saved baseline, and at least one
+    committed group of each v2 kind (multi_output, epilogue) across
+    the arms."""
     import numpy as np
 
     import jax
@@ -789,9 +797,27 @@ def _bench_fusion_ab():
         probs = jax.nn.softmax(logits, axis=-1)
         return (jnp.argmax(probs, axis=-1), jnp.max(probs, axis=-1))
 
+    def matmul_epilogue(x_, w_, b_, ge_):
+        h = x_ @ w_ + b_
+        a = jax.nn.gelu(h, approximate=True)
+        y = a + x_
+        return (rms(y, ge_), y)     # y escapes: promoted group output
+
+    # big enough that real work (not dispatch) dominates the warm wall
+    SE, DE = 256, 512
+    xe = jnp.asarray(rng.randn(SE, DE), jnp.float32)
+    we2 = jnp.asarray(rng.randn(DE, DE) * 0.05, jnp.float32)
+    be = jnp.asarray(rng.randn(DE) * 0.05, jnp.float32)
+    ge = jnp.asarray(rng.rand(DE), jnp.float32)
+
+    # round-19 bytes-saved baselines (the single-output v1 planner, PR
+    # 16 — PERF.md round-19 table); v2 must beat them where they exist
+    baseline_r19 = {"llama_step": 2123272, "fused_decode": 1533488}
+
     programs = {
         "llama_step": (llama_step, [x, *p]),
         "fused_decode": (fused_decode, [x, we, gf, *p]),
+        "matmul_epilogue": (matmul_epilogue, [xe, we2, be, ge]),
     }
     prev = _flags.flag_value("pir_passes")
     no_fuse = ",".join(s for s in prev.split(",") if s.strip() != "fuse")
@@ -801,19 +827,19 @@ def _bench_fusion_ab():
             _flags.set_flags({"pir_passes": no_fuse})
             off_fn, off_rep = compile_flat(fn, args,
                                            name=f"fusion_{name}_off")
-            t_off, want = _time_jitted(off_fn, args)
             _flags.set_flags({"pir_passes": prev})
             on_fn, on_rep = compile_flat(fn, args, name=f"fusion_{name}")
-            t_on, got = _time_jitted(on_fn, args)
+            t_off, t_on, want, got = _time_jitted_pair(off_fn, on_fn, args)
             ok = all(np.allclose(np.asarray(w), np.asarray(g),
                                  rtol=2e-5, atol=2e-6)
                      for w, g in zip(want, got))
             ratio = t_on / max(t_off, 1e-9)
-            out["programs"][name] = {
+            row = {
                 "unfused_s": round(t_off, 6),
                 "fused_s": round(t_on, 6),
                 "wall_ratio": round(ratio, 3),
                 "fusion_groups": on_rep.fusion_groups,
+                "fusion_kinds": dict(on_rep.fusion_kinds),
                 "predicted_bytes_saved": on_rep.fusion_bytes_saved,
                 "fallback": on_rep.fallback or off_rep.fallback,
                 "numerics_ok": bool(ok),
@@ -821,14 +847,31 @@ def _bench_fusion_ab():
                                 and on_rep.fusion_bytes_saved > 0
                                 and ratio <= 1.05),
             }
+            base = baseline_r19.get(name)
+            if base is not None:
+                row["r19_bytes_saved"] = base
+                row["bytes_saved_delta_vs_r19"] = \
+                    on_rep.fusion_bytes_saved - base
+                row["gate_ok"] = bool(
+                    row["gate_ok"] and on_rep.fusion_bytes_saved > base)
+            out["programs"][name] = row
     finally:
         _flags.set_flags({"pir_passes": prev})
     rows = out["programs"].values()
     out["fusion_groups_total"] = sum(r["fusion_groups"] for r in rows)
+    kinds_total = {}
+    for r in rows:
+        for k, n in r["fusion_kinds"].items():
+            kinds_total[k] = kinds_total.get(k, 0) + n
+    out["fusion_kinds_total"] = kinds_total
+    out["multi_output_groups_total"] = kinds_total.get("multi_output", 0)
+    out["epilogue_groups_total"] = kinds_total.get("epilogue", 0)
     out["predicted_bytes_saved_total"] = sum(
         r["predicted_bytes_saved"] for r in rows)
     out["max_wall_ratio"] = max(r["wall_ratio"] for r in rows)
-    out["gate_ok"] = all(r["gate_ok"] for r in rows)
+    out["gate_ok"] = bool(all(r["gate_ok"] for r in rows)
+                          and out["multi_output_groups_total"] >= 1
+                          and out["epilogue_groups_total"] >= 1)
     return out
 
 
@@ -929,10 +972,37 @@ def _time_jitted(fn, args, repeats=7):
         t0 = _time.perf_counter()
         out = fn(*args)
         jax.tree_util.tree_map(
-            lambda a: a.block_until_ready()
-            if hasattr(a, "block_until_ready") else a, out)
+            lambda a: a.block_until_ready() if hasattr(a, "block_until_ready")
+            else a, out)
         best = min(best, _time.perf_counter() - t0)
     return best, out
+
+
+def _time_jitted_pair(fa, fb, args, repeats=9):
+    """Interleaved min-of-N A/B wall time of two compiled callables over
+    the same args. Alternating samples instead of two back-to-back
+    min-of-N blocks: clock-frequency drift between the blocks would
+    alias straight into the A/B ratio."""
+    import time as _time
+
+    import jax
+
+    def _sync(out):
+        jax.tree_util.tree_map(
+            lambda a: a.block_until_ready() if hasattr(a, "block_until_ready")
+            else a, out)
+        return out
+
+    out_a, out_b = _sync(fa(*args)), _sync(fb(*args))
+    best_a = best_b = float("inf")
+    for _ in range(repeats):
+        t0 = _time.perf_counter()
+        _sync(fa(*args))
+        best_a = min(best_a, _time.perf_counter() - t0)
+        t0 = _time.perf_counter()
+        _sync(fb(*args))
+        best_b = min(best_b, _time.perf_counter() - t0)
+    return best_a, best_b, out_a, out_b
 
 
 def _bench_multichip_sharding():

@@ -387,6 +387,12 @@ CATALOG = {
         "fusion groups (CostModel.group_bytes_saved: unfused member "
         "traffic minus fused boundary traffic), by program",
         ("program",), None),
+    "pir_fusion_groups_by_kind_total": (
+        "counter", "committed fusion groups by provenance kind — chain "
+        "(v1 single-output), multi_output (promoted sibling-shared "
+        "results), epilogue (dot_general / nested-region anchor "
+        "absorbed) — by program (pir/fuse.py GROUP_KINDS)",
+        ("program", "kind"), None),
     "pir_fuse_seconds": (
         "histogram", "wall time of one auto-fusion pass run (planning "
         "walk + group commits; pir/fuse.py)", (), _STEP_BUCKETS),

@@ -502,8 +502,18 @@ class CostModel(DataflowAnalysis):
                 return OpCost(f, b)
         except Exception:  # noqa: BLE001 — never fail a compile over pricing
             pass
-        # fused pt.* op (or unpriceable eqn): memory-bound estimate from
-        # the stamped value types; 2 flops/output element keeps the
+        # fused regions carry their roofline provenance: the members'
+        # summed flops (the math still happens — an absorbed dot_general
+        # must not look memory-bound to shard_search/overlap) over the
+        # fused boundary traffic
+        fg = op.attrs.get("fusion_group")
+        if isinstance(fg, dict) and "flops" in fg:
+            try:
+                return OpCost(float(fg["flops"]), float(fg["bytes"]))
+            except Exception:  # noqa: BLE001 — malformed attrs: estimate
+                pass
+        # other fused pt.* op (or unpriceable eqn): memory-bound estimate
+        # from the stamped value types; 2 flops/output element keeps the
         # compute axis populated
         out_b = self._value_bytes(op.outputs)
         in_b = self._value_bytes(op.inputs)
@@ -525,6 +535,29 @@ class CostModel(DataflowAnalysis):
         criterion. Duplicable members are excluded by the caller (their
         traffic persists either way and cancels)."""
         unfused = sum(self._op_cost(op).bytes for op in members)
+        fused = (self._value_bytes(boundary_inputs)
+                 + self._value_bytes(outputs))
+        return unfused - fused
+
+    def epilogue_bytes_saved(self, anchor, members, boundary_inputs,
+                             outputs):
+        """Predicted HBM bytes an anchored (epilogue) group saves. Same
+        strict fused-vs-unfused comparison as ``group_bytes_saved`` but
+        the compute anchor (a dot_general or nested fused region) is
+        priced by its STAMPED value traffic, not ``_op_cost``: the
+        anchor's flops happen either way, its operand reads cancel
+        exactly against the fused op's boundary reads (or against an
+        in-group producer's saved intermediate), and what fusion
+        actually eliminates is the anchor's result write — the matmul
+        output that used to round-trip HBM before the epilogue chain
+        re-read it — unless that result is promoted to a group output.
+        Pricing the anchor through ``_eqn_cost`` instead would let its
+        accumulation-traffic estimate leak into the decision and
+        overstate the win."""
+        chain = [op for op in members if op is not anchor]
+        unfused = (sum(self._op_cost(op).bytes for op in chain)
+                   + self._value_bytes(anchor.inputs)
+                   + self._value_bytes(anchor.outputs))
         fused = (self._value_bytes(boundary_inputs)
                  + self._value_bytes(outputs))
         return unfused - fused

@@ -1,58 +1,76 @@
-"""CINN-lite auto-fusion: cost-guided producer-consumer fusion.
+"""CINN-lite auto-fusion v2: cost-guided producer-consumer fusion with
+multi-output groups and dot_general epilogue absorption.
 
 reference: paddle/cinn/ — the reference stack's fifth layer turns PIR
-subgraphs into fused kernels. Until this pass, the only fusions here
-were the two hand-written DRR patterns (sdpa, rms-epilogue); every
-other elementwise/broadcast/reduce chain paid a full HBM round-trip
-per op. This pass generalizes: it walks the captured program, grows
-producer-consumer groups of memory-bound ops, prices each candidate on
-the roofline CostModel, and commits a group only when the predicted
-HBM bytes-traffic strictly decreases — the commit criterion "Operator
-Fusion in XLA: Analysis and Evaluation" (PAPERS.md) identifies as the
-one that pays on memory-bound chains.
+subgraphs into fused kernels. The v1 pass (PR 16) grouped
+single-output elementwise/layout/reduce chains; v2 closes the two
+known limitations COMPILER.md documented: sibling consumers of one
+intermediate no longer force a refusal (the intermediate is *promoted*
+to a group output — the multi-output mechanism "Operator Fusion in
+XLA: Analysis and Evaluation" (PAPERS.md) uses to stop siblings from
+duplicating work), and a fusible consumer chain hanging off a
+``dot_general`` is absorbed into the producer's region so the matmul
+epilogue runs in the output tile instead of round-tripping HBM — the
+across-compute-boundary fusion FlashFuser (PAPERS.md) shows is where
+the remaining bytes are.
 
 Grouping (a dataflow walk over the analysis-engine users map):
 
 * A group grows upward from a single fusible ROOT op: a producer is
   absorbed as an *internal* member when every user of every one of its
-  results is already inside the group (single-consumer discipline —
-  the intermediate dies inside the fused kernel), or as a *duplicable*
-  member when it is pure layout plumbing (broadcast/reshape/transpose/
-  convert) whose recompute is free: the original op stays in the
-  program for its external users and the group replays a private copy,
-  reading the producer's (never larger) inputs instead of its
-  materialized output. A later DCE sweep removes duplicables that lost
-  their last external user.
+  results is in-group OR the result can be **promoted to a group
+  output** — legal only when every external user sits *after* the
+  splice point (the root's program position), so the multi-result
+  fused op still defines every promoted value before its first read.
+  Program outputs count as always-after. Pure layout plumbing
+  (broadcast/reshape/transpose/convert) may instead be absorbed as a
+  *duplicable* member: the original op stays in the program for its
+  external users and the group replays a private copy, reading the
+  producer's (never larger) inputs instead of its materialized output.
+  A later DCE sweep removes duplicables that lost their last external
+  user.
+* One **compute anchor** per group: a ``dot_general`` (or an existing
+  ``pt.fused_region`` — regions compose) whose users all satisfy the
+  same in-group-or-promoted test may be absorbed internally, making
+  the group an *epilogue* region — the anchor's result write dies in
+  the fused kernel's output tile (unless promoted) and growth
+  continues through the anchor's own producers. The anchor is NEVER
+  duplicated (an external pre-splice user keeps it out of the group
+  entirely) and never roots a group.
 * Fusible ops are elementwise math, layout plumbing, and reduces
   (reduce epilogues terminate a chain; a reduce may also sit mid-group
   when its consumers all fused). Never fusible: ops with jax effects
   or a paged-KV ``attrs["effect"]`` stamp, ``pt.*`` fused dispatch ops
-  (fusion never crosses a routed-kernel boundary), ops carrying
-  nested jaxprs (scan/pjit/custom_* — the pass does not descend into
-  sub-jaxprs), and ops touching sharding-annotated values (fuse runs
-  before the sharding passes; annotated dataflow stays op-granular so
+  other than this pass's own ``pt.fused_region`` (fusion never crosses
+  a routed-kernel boundary), ops carrying nested jaxprs
+  (scan/pjit/custom_* — the pass does not descend into sub-jaxprs),
+  and ops touching sharding-annotated values (fuse runs before the
+  sharding passes; annotated dataflow stays op-granular so
   shard_search/shard_prop still see every conflict and propagation
-  frontier).
-* Groups are capped at ``MAX_GROUP_OPS`` members so fused bodies stay
-  CSE/cache-friendly, and a group needs >= 2 members — a singleton
-  saves nothing by construction.
+  frontier) — the sharded wall applies to anchors too.
+* Groups are capped at ``MAX_GROUP_OPS`` members and
+  ``MAX_GROUP_OUTS`` results so fused bodies stay CSE/cache-friendly;
+  a group that would expose more results re-plans under the v1
+  single-output discipline instead. A group needs >= 2 members — a
+  singleton saves nothing by construction.
 
-Commit criterion (strict): ``CostModel.group_bytes_saved`` compares
-the unfused members' summed operand+result traffic against the fused
-op's boundary traffic (each boundary input read once, each result
-written once; duplicable members cancel — they run either way).
-Compute-bound chains never qualify: dot_general/conv are not fusible,
-and a candidate whose intermediates all escape saves zero bytes and is
-refused.
+Commit criterion (strict): ``CostModel.group_bytes_saved`` — extended
+to price multi-result boundaries (each promoted result written once) —
+compares the unfused members' summed operand+result traffic against
+the fused op's boundary traffic; anchored groups price through
+``CostModel.epilogue_bytes_saved`` (the anchor's result write + the
+epilogue chain's reads eliminated, operand reads cancelling). Either
+way a group commits only on a strict predicted bytes decrease.
 
 Each committed group becomes one ``pt.fused_region`` op whose callable
 binds the replayed sub-jaxpr through a single ``jax.jit(inline=True)``
 call under a ``jax.named_scope`` (profiler attribution:
 ``pir.fuse.<program>.g<id>``). The op carries
-``attrs["fusion_group"]`` provenance — member op names and predicted
-bytes saved — which the printer shows, the canonical hash keys (fusion
-decisions change compile-cache keys automatically), and
-``CompileReport.summary()`` counts.
+``attrs["fusion_group"]`` provenance — ``kind`` (``chain`` |
+``multi_output`` | ``epilogue``), member op names, result count and
+predicted bytes saved — which the printer shows, the canonical hash
+keys (fusion decisions change compile-cache keys automatically), and
+``CompileReport.summary()`` counts (total and by kind).
 
 Failure contract, same shape as every other pass:
 
@@ -80,7 +98,8 @@ from .ir import Operation, Program
 from .passes import Pass, PassResult
 
 __all__ = ["FusionPass", "FusionPassError", "FUSIBLE_ELEMENTWISE",
-           "FUSIBLE_LAYOUT", "FUSIBLE_REDUCE", "MAX_GROUP_OPS"]
+           "FUSIBLE_LAYOUT", "FUSIBLE_REDUCE", "FUSIBLE_ANCHORS",
+           "MAX_GROUP_OPS", "MAX_GROUP_OUTS", "GROUP_KINDS"]
 
 
 class FusionPassError(RuntimeError):
@@ -123,9 +142,25 @@ FUSIBLE_REDUCE = frozenset({
 
 _FUSIBLE = FUSIBLE_ELEMENTWISE | FUSIBLE_LAYOUT | FUSIBLE_REDUCE
 
+# compute anchors: compute-intensive (or already-fused) producers whose
+# fusible consumer chain may absorb them — at most ONE per group, never
+# duplicated, never a root. "pt.fused_region" makes regions compose: a
+# chain hanging off an already-committed region joins that region.
+FUSIBLE_ANCHORS = frozenset({"dot_general", "pt.fused_region"})
+
+# provenance kinds a committed group may carry (closed set; bench and
+# chaos key on these literals)
+GROUP_KINDS = ("chain", "multi_output", "epilogue")
+
 # group size cap: fused jaxprs past this stop being CSE/compile-cache
 # friendly (and the greedy walk's win saturates long before it)
 MAX_GROUP_OPS = 24
+
+# result cap: a group promoting more outputs than this re-plans under
+# the v1 single-output discipline (every promoted result is an HBM
+# write — past a handful the multi-output form stops paying and the
+# fused-op signature stops being cache-friendly)
+MAX_GROUP_OUTS = 8
 
 # minimum members: a singleton group has identical boundary and member
 # traffic — structurally refused before pricing
@@ -136,17 +171,20 @@ class _Group:
     """One committed-candidate fusion group (planning output)."""
 
     __slots__ = ("root", "internal", "dups", "members", "boundary",
-                 "outs", "bytes_saved")
+                 "outs", "bytes_saved", "kind", "anchor")
 
     def __init__(self, root, internal, dups, members, boundary, outs,
-                 bytes_saved):
+                 bytes_saved, kind="chain", anchor=None):
         self.root = root
         self.internal = internal    # [Operation] removed by the splice
         self.dups = dups            # [Operation] replayed, left in place
         self.members = members      # internal + dups, program order
         self.boundary = boundary    # [Value] fused-op operands
-        self.outs = outs            # [Value] fused-op results (root's)
+        self.outs = outs            # [Value] fused-op results (root's +
+        #                             promoted intermediates)
         self.bytes_saved = bytes_saved
+        self.kind = kind            # chain | multi_output | epilogue
+        self.anchor = anchor        # the absorbed compute op, or None
 
 
 class FusionPass(Pass):
@@ -184,6 +222,27 @@ class FusionPass(Pass):
         return True
 
     @staticmethod
+    def _anchor_fusible(op: Operation) -> bool:
+        """May ``op`` be absorbed as a group's compute anchor? Only the
+        FUSIBLE_ANCHORS names qualify — a dot_general eqn or one of this
+        pass's own pt.fused_region ops — and the sharding / effect walls
+        hold exactly as for regular members (an annotated or stateful
+        dot stays op-granular)."""
+        if op.name not in FUSIBLE_ANCHORS:
+            return False
+        if op.has_effects() or op.attrs.get("effect") is not None:
+            return False
+        if any(v.sharding is not None
+               for vs in (op.inputs, op.outputs) for v in vs):
+            return False            # sharded values are a hard wall
+        if op.name == "pt.fused_region":
+            return op.fn is not None
+        if op.eqn is None or op.fn is not None:
+            return False
+        from .analysis import _inner_jaxprs
+        return not _inner_jaxprs(op.eqn.params)
+
+    @staticmethod
     def _value_bytes(values) -> float:
         from .analysis import CostModel as _CM
         return _CM._value_bytes(values)
@@ -193,11 +252,18 @@ class FusionPass(Pass):
         users = prog.users()
         index = {id(op): i for i, op in enumerate(prog.ops)}
         claimed: set[int] = set()
+        anchors_ok = self._anchors_allowed()
         plans = []
         for root in reversed(prog.ops):
             if id(root) in claimed or not self._fusible(root):
                 continue
-            g = self._grow(prog, root, users, claimed, index)
+            g = self._grow(prog, root, users, claimed, index,
+                           anchors_ok=anchors_ok)
+            if g is not None and len(g.outs) > MAX_GROUP_OUTS:
+                # too many promoted results: re-plan this root under the
+                # v1 single-output discipline (never worse than PR 16)
+                g = self._grow(prog, root, users, claimed, index,
+                               promote=False, anchors_ok=anchors_ok)
             if g is None:
                 continue
             # claim EVERY member — dups included. A dup stays in the
@@ -210,13 +276,49 @@ class FusionPass(Pass):
         plans.reverse()             # program order -> deterministic gids
         return plans
 
-    def _grow(self, prog, root, users, claimed, index):
+    @staticmethod
+    def _anchors_allowed() -> bool:
+        """Epilogue absorption is disabled while a sharding SEARCH
+        scope is active: the search prices the implied all-reduce of a
+        sharded contraction off ``dot_general`` eqns (shard_search
+        predict_seconds), so absorbing the dot into an opaque region
+        would hide that comm term and skew the argmin toward TP.
+        Anchors stay op-granular for the search to see; the chains
+        around them still fuse."""
+        try:
+            from . import shard_prop as _sp
+            return not (_sp.current_mesh() is not None
+                        and _sp.current_search())
+        except Exception:  # noqa: BLE001 — no scope machinery: allow
+            return True
+
+    def _grow(self, prog, root, users, claimed, index, promote=True,
+              anchors_ok=True):
         internal: dict[int, Operation] = {id(root): root}
         dups: dict[int, Operation] = {}
+        anchor: list = [None]       # at most one compute anchor
+        root_idx = index[id(root)]
 
         def absorbable(p):
             return (id(p) not in internal and id(p) not in dups
-                    and id(p) not in claimed and self._fusible(p))
+                    and id(p) not in claimed)
+
+        def users_ok(p):
+            # internal absorption legality: every user of every result
+            # is in-group, or the result is promotable — every external
+            # user sits AFTER the splice point (the root's position), so
+            # the fused op still defines it before its first read.
+            # Program outputs (the None sentinel) are always-after.
+            # Without promotion (v1 re-plan) external users refuse.
+            for o in p.outputs:
+                for u in users.get(o, ()):
+                    if u is not None and id(u) in internal:
+                        continue
+                    if not promote:
+                        return False
+                    if u is not None and index.get(id(u), -1) <= root_idx:
+                        return False
+            return True
 
         changed = True
         while changed and len(internal) + len(dups) < MAX_GROUP_OPS:
@@ -229,11 +331,10 @@ class FusionPass(Pass):
                         continue
                     if len(internal) + len(dups) >= MAX_GROUP_OPS:
                         break
-                    if all(u is not None and id(u) in internal
-                           for o in p.outputs for u in users.get(o, ())):
+                    if self._fusible(p) and users_ok(p):
                         internal[id(p)] = p
                         changed = True
-                    elif p.name in FUSIBLE_LAYOUT \
+                    elif self._fusible(p) and p.name in FUSIBLE_LAYOUT \
                             and self._value_bytes(p.inputs) \
                             <= self._value_bytes(p.outputs):
                         # duplicable: replay privately, original stays
@@ -241,6 +342,16 @@ class FusionPass(Pass):
                         # they disappear). The byte guard keeps e.g. a
                         # downcast's wide input off the fused boundary.
                         dups[id(p)] = p
+                        changed = True
+                    elif anchors_ok and anchor[0] is None \
+                            and self._anchor_fusible(p) and users_ok(p):
+                        # epilogue absorption: the compute anchor joins
+                        # internally (never duplicated — users_ok means
+                        # no pre-splice external reader needs the
+                        # original), and growth continues through its
+                        # producers
+                        internal[id(p)] = p
+                        anchor[0] = p
                         changed = True
 
         member_ids = set(internal) | set(dups)
@@ -257,13 +368,29 @@ class FusionPass(Pass):
                 if id(v) not in seen:
                     seen.add(id(v))
                     boundary.append(v)
-        outs = list(root.outputs)
-        saved = self.cost.group_bytes_saved(internal_ordered, boundary,
-                                            outs)
+        # group results: every internal result some non-member still
+        # reads (or a program output) is promoted, in program order —
+        # the root's live results plus any sibling-shared intermediate
+        outs = []
+        for op in internal_ordered:
+            for o in op.outputs:
+                if any(u is None or id(u) not in internal
+                       for u in users.get(o, ())):
+                    outs.append(o)
+        if not outs:
+            return None             # fully dead group: DCE's job, not ours
+        if anchor[0] is not None:
+            kind = "epilogue"
+            saved = self.cost.epilogue_bytes_saved(
+                anchor[0], internal_ordered, boundary, outs)
+        else:
+            kind = "multi_output" if len(outs) > 1 else "chain"
+            saved = self.cost.group_bytes_saved(internal_ordered,
+                                                boundary, outs)
         if saved <= 0:
             return None             # strict decrease or no commit
         return _Group(root, internal_ordered, dups_ordered, members,
-                      boundary, outs, saved)
+                      boundary, outs, saved, kind=kind, anchor=anchor[0])
 
     # -- commit (one mutation at the end; fallible work first) --------------
     def _commit(self, prog: Program, gid: int, g: _Group) -> Operation:
@@ -313,11 +440,24 @@ class FusionPass(Pass):
                     f"{d.dtype}[{','.join(map(str, d.shape))}], stamped "
                     f"{v.type_str}")
 
+        # roofline provenance: the members' summed flops still happen
+        # inside the region (dups replay too), while its HBM traffic is
+        # the fused boundary. Stamped here so CostModel._op_cost prices
+        # the region honestly — without this, absorbing a dot_general
+        # would HIDE its flops from shard_search/overlap/report costing
+        # (a fused matmul is not suddenly memory-bound).
+        flops = sum(self.cost._op_cost(op).flops for op in members)
+        fused_bytes = (self._value_bytes(boundary)
+                       + self._value_bytes(outs))
         new_op = Operation(
             "pt.fused_region", list(boundary), outs,
             attrs={"fusion_group": {
                 "id": gid,
+                "kind": g.kind,
                 "ops": [op.name for op in members],
+                "outs": len(outs),
+                "flops": float(flops),
+                "bytes": float(fused_bytes),
                 "bytes_saved": int(g.bytes_saved)}},
             fn=fn)
         prog.replace_region(g.internal, new_op)
@@ -331,6 +471,7 @@ class FusionPass(Pass):
         t0 = time.perf_counter()
         committed = skipped = member_ops = 0
         saved_total = 0.0
+        kinds = {k: 0 for k in GROUP_KINDS}
         with _span("pir.fuse", program=prog.name, ops=len(prog.ops)):
             try:
                 # hit 1 of the chaos seam: a fault HERE (or any planning
@@ -355,6 +496,7 @@ class FusionPass(Pass):
                 committed += 1
                 member_ops += len(g.members)
                 saved_total += g.bytes_saved
+                kinds[g.kind] += 1
         dt = time.perf_counter() - t0
         try:
             _metric("pir_fuse_seconds").observe(dt)
@@ -363,13 +505,21 @@ class FusionPass(Pass):
                         program=prog.name).inc(committed)
                 _metric("pir_fusion_bytes_saved",
                         program=prog.name).inc(saved_total)
+                for k, n in kinds.items():
+                    if n:
+                        _metric("pir_fusion_groups_by_kind_total",
+                                program=prog.name, kind=k).inc(n)
         except Exception:  # noqa: BLE001 — metrics never cost a compile
             pass
         prog._fusion = {"groups": committed,
                         "bytes_saved": int(saved_total),
-                        "skipped": skipped}
+                        "skipped": skipped,
+                        "kinds": {k: n for k, n in kinds.items() if n}}
         notes = (f"groups={committed} member_ops={member_ops} "
                  f"bytes_saved={int(saved_total)}")
+        if committed:
+            notes += " kinds=" + ",".join(
+                f"{k}:{n}" for k, n in kinds.items() if n)
         if skipped:
             notes += f" skipped={skipped}"
         return PassResult(committed, notes)

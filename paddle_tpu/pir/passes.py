@@ -122,15 +122,45 @@ class Pass:
 
 class DeadCodeElimination(Pass):
     """Remove ops none of whose outputs reach a program output (and
-    constants nothing reads). Ops with jax effects are pinned live."""
+    constants nothing reads). Ops with jax effects are pinned live.
+
+    Multi-result ``pt.fused_region`` ops additionally get dead RESULTS
+    pruned in place: when a promoted group output loses its last
+    consumer (the consumer was itself dead code), the region stays but
+    its signature shrinks to the live subset — the fused body is
+    wrapped to return only the kept indices, so the dead intermediate's
+    HBM write disappears with its reader and the strict post-DCE
+    verifier rule (which holds fused regions to per-result liveness)
+    stays satisfiable."""
 
     name = "dce"
 
     def run(self, prog: Program) -> PassResult:
         live = set(id(v) for v in prog.outputs)
         kept = []
+        pruned_results = 0
         for op in reversed(prog.ops):
             if op.has_effects() or any(id(o) in live for o in op.outputs):
+                if (op.name == "pt.fused_region" and op.fn is not None
+                        and not op.has_effects()
+                        and op.attrs.get("effect") is None
+                        and len(op.outputs) > 1
+                        and any(id(o) not in live for o in op.outputs)):
+                    keep = tuple(i for i, o in enumerate(op.outputs)
+                                 if id(o) in live)
+                    pruned_results += len(op.outputs) - len(keep)
+                    op.outputs = [op.outputs[i] for i in keep]
+                    inner = op.fn
+
+                    def fn(*args, _inner=inner, _keep=keep):
+                        res = _inner(*args)
+                        return tuple(res[i] for i in _keep)
+
+                    fn.__name__ = getattr(inner, "__name__", "fused_region")
+                    op.fn = fn
+                    fg = op.attrs.get("fusion_group")
+                    if isinstance(fg, dict):
+                        fg["outs"] = len(keep)
                 kept.append(op)
                 live.update(id(v) for v in op.inputs)
         removed_ops = len(prog.ops) - len(kept)
@@ -139,8 +169,11 @@ class DeadCodeElimination(Pass):
         dead_consts = [v for v in prog.constants if id(v) not in live]
         for v in dead_consts:
             del prog.constants[v]
-        return PassResult(removed_ops + len(dead_consts),
-                          f"ops={removed_ops} consts={len(dead_consts)}")
+        notes = f"ops={removed_ops} consts={len(dead_consts)}"
+        if pruned_results:
+            notes += f" fused_results={pruned_results}"
+        return PassResult(removed_ops + len(dead_consts) + pruned_results,
+                          notes)
 
 
 class ConstantFolding(Pass):

@@ -39,7 +39,7 @@ class CompileReport:
     __slots__ = ("name", "key", "cache", "pass_report", "program",
                  "captured_ops", "final_ops", "pattern_counts", "fallback",
                  "cost", "shard_decision", "shard_predicted_s",
-                 "fusion_groups", "fusion_bytes_saved")
+                 "fusion_groups", "fusion_bytes_saved", "fusion_kinds")
 
     def __init__(self, name):
         self.name = name
@@ -56,6 +56,7 @@ class CompileReport:
         self.shard_predicted_s = None
         self.fusion_groups = 0      # pt.fused_region groups committed
         self.fusion_bytes_saved = 0  # predicted HBM bytes saved by fuse
+        self.fusion_kinds = {}      # committed groups by provenance kind
 
     def summary(self) -> dict:
         out = {"name": self.name, "cache": self.cache,
@@ -68,6 +69,7 @@ class CompileReport:
                "cost": self.cost.summary() if self.cost else None,
                "fusion_groups": self.fusion_groups,
                "fusion_bytes_saved": self.fusion_bytes_saved,
+               "fusion_kinds": dict(self.fusion_kinds),
                "fallback": self.fallback}
         if self.shard_decision is not None:
             out["shard_decision"] = self.shard_decision
@@ -167,6 +169,7 @@ def compile_flat(flat_fn: Callable, flat_args: list, *, name: str,
         if fusion is not None:
             report.fusion_groups = fusion["groups"]
             report.fusion_bytes_saved = fusion["bytes_saved"]
+            report.fusion_kinds = dict(fusion.get("kinds", {}))
     except FusionPassError as e:
         # the fuse pass failed wholesale (planning walk, not one group):
         # distinct stage so fusion regressions are separable from other

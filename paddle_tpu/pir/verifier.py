@@ -46,7 +46,8 @@ RULES = {
     "dangling-value": "program outputs (and operand back-references) "
                       "resolve to a definition inside the program",
     "dead-code": "post-DCE only: no side-effect-free op whose results "
-                 "never reach a program output survives",
+                 "never reach a program output survives; fused regions "
+                 "are held per-result (no dead promoted group output)",
     "effect-order": "stateful paged-KV ops (kv.write / kv.rollback "
                     "scopes) keep their captured program order",
     "type-mismatch": "stamped Value shape/dtype agrees with the "
@@ -271,6 +272,21 @@ def _verify(prog, *, strict_dead, donate_argnums, where):
                     "dead-code",
                     f"{op.name!r} survives DCE but none of its results "
                     f"reach a program output", op=op, program=prog)
+            # multi-result fused regions are held to PER-RESULT
+            # liveness: a region carrying a dead promoted output means
+            # DCE failed to shrink its signature — the dead write would
+            # silently undo the fusion win the group committed on
+            if op.name == "pt.fused_region" \
+                    and not op.has_effects() \
+                    and op.attrs.get("effect") is None:
+                for o in op.outputs:
+                    if id(o) not in live:
+                        raise IRVerificationError(
+                            "dead-code",
+                            f"fused region result %{o.vid} survives DCE "
+                            f"but never reaches a program output "
+                            f"(dead promoted group output)",
+                            op=op, program=prog)
 
     # -- donation-alias -----------------------------------------------------
     if donate_argnums:

@@ -109,6 +109,13 @@ def _groups(prog):
     return out
 
 
+def _group_attrs(prog):
+    """Full fusion_group provenance dicts, gid order."""
+    return [op.attrs["fusion_group"] for op in prog.ops
+            if op.name == "pt.fused_region"
+            and "fusion_group" in op.attrs]
+
+
 # ---------------------------------------------------------------------------
 # golden group formation
 # ---------------------------------------------------------------------------
@@ -119,21 +126,28 @@ class TestGoldenGroups:
         prog = _pre_fuse_program(fn, args, "fused_mlp")
         n_before = prog.num_ops()
         res = FusionPass().run(prog)
-        assert res.edits == 2, res.notes
-        groups = _groups(prog)
-        # g0: the erf-gelu chain between the matmuls; g1: the residual
-        # + rmsnorm epilogue. Exact membership — a planner change that
-        # regroups must retake these goldens deliberately.
-        assert groups == [
-            (["mul", "neg", "mul", "erfc", "mul", "copy"], 22528),
-            (["add", "mul", "reduce_sum", "broadcast_in_dim", "div",
-              "add", "rsqrt", "mul", "broadcast_in_dim", "mul"], 8768),
-        ], groups
-        assert prog._fusion == {"groups": 2, "bytes_saved": 31296,
-                                "skipped": 0}
-        # 16 members collapsed into 2 fused ops; both matmuls survive
-        assert prog.num_ops() == n_before - 16 + 2
-        assert sum(1 for op in prog.ops if op.name == "dot_general") == 2
+        assert res.edits == 1, res.notes
+        # v2: the second matmul is absorbed as the group's compute
+        # anchor, so the erf-gelu chain, the dot, and the residual +
+        # rmsnorm epilogue collapse into ONE 17-member epilogue region
+        # (v1 committed two single-output groups around the dot for
+        # 31296 B). Exact membership — a planner change that regroups
+        # must retake these goldens deliberately.
+        (fg,) = _group_attrs(prog)
+        assert fg["kind"] == "epilogue"
+        assert fg["outs"] == 1
+        assert fg["ops"] == [
+            "mul", "neg", "mul", "erfc", "mul", "copy", "dot_general",
+            "add", "mul", "reduce_sum", "broadcast_in_dim", "div",
+            "add", "rsqrt", "mul", "broadcast_in_dim", "mul"], fg["ops"]
+        assert fg["bytes_saved"] == 37440
+        assert prog._fusion == {"groups": 1, "bytes_saved": 37440,
+                                "skipped": 0, "kinds": {"epilogue": 1}}
+        # 17 members collapsed into 1 fused op; the first matmul (whose
+        # consumer chain feeds the absorbed dot) survives op-granular —
+        # one compute anchor per group, never duplicated
+        assert prog.num_ops() == n_before - 17 + 1
+        assert sum(1 for op in prog.ops if op.name == "dot_general") == 1
         # numerics: the fused program replays byte-identical to eager
         got = np.asarray(prog.bind(*args)[0])
         assert np.array_equal(got, np.asarray(fn(*args)[0]))
@@ -151,11 +165,13 @@ class TestGoldenGroups:
         with _passes(_DEFAULT_PASSES):
             _, report = pir.compile_flat(fn, args, name="fused_mlp")
         assert report.fallback is None
-        assert report.fusion_groups == 2
-        assert report.fusion_bytes_saved == 31296
+        assert report.fusion_groups == 1
+        assert report.fusion_bytes_saved == 37440
+        assert report.fusion_kinds == {"epilogue": 1}
         s = report.summary()
-        assert s["fusion_groups"] == 2
-        assert s["fusion_bytes_saved"] == 31296
+        assert s["fusion_groups"] == 1
+        assert s["fusion_bytes_saved"] == 37440
+        assert s["fusion_kinds"] == {"epilogue": 1}
 
 
 # ---------------------------------------------------------------------------
@@ -170,7 +186,7 @@ class TestNumerics:
             ref = np.asarray(f_off(*args)[0])
         with _passes(_DEFAULT_PASSES):
             f_on, r_on = pir.compile_flat(fn, args, name="ab")
-        assert r_off.fusion_groups == 0 and r_on.fusion_groups == 2
+        assert r_off.fusion_groups == 0 and r_on.fusion_groups == 1
         assert np.array_equal(np.asarray(f_on(*args)[0]), ref)
 
     def test_grad_through_warm_cache_hit(self, cache_dir):
@@ -192,6 +208,53 @@ class TestNumerics:
         np.testing.assert_allclose(np.asarray(g), np.asarray(ref_e),
                                    rtol=2e-6, atol=2e-7)
 
+    def test_multi_output_grad_through_warm_cache_hit(self, cache_dir):
+        # same contract for the v2 multi_output shape: differentiating
+        # through a warm (cache-hit) artifact whose fused region
+        # promotes a sibling-shared intermediate must match the
+        # unfused compiled twin bit-for-bit on every output
+        rng = np.random.RandomState(0)
+        x0 = jnp.asarray(rng.randn(32, 32), jnp.float32)
+
+        def fn(x):
+            a = jnp.tanh(x)
+            b = a * 2.0 + 1.0
+            return (a, b)
+
+        args = [x0]
+        with _passes(_NO_FUSE_PASSES):
+            f_off, _ = pir.compile_flat(fn, args, name="mo")
+        with _passes(_DEFAULT_PASSES):
+            pir.compile_flat(fn, args, name="mo")
+            f2, r2 = pir.compile_flat(fn, args, name="mo")
+        assert r2.cache == "hit"
+        assert r2.fusion_kinds.get("multi_output", 0) >= 1, r2.fusion_kinds
+        for i in (0, 1):
+            got = np.asarray(f2(*args)[i])
+            ref = np.asarray(f_off(*args)[i])
+            assert np.array_equal(got, ref)
+        g = jax.grad(lambda x: sum(o.sum() for o in f2(x)))(x0)
+        ref_g = jax.grad(lambda x: sum(o.sum() for o in f_off(x)))(x0)
+        assert np.array_equal(np.asarray(g), np.asarray(ref_g))
+
+    def test_epilogue_grad_through_warm_cache_hit(self, cache_dir):
+        # and for the epilogue shape: grad THROUGH a warm artifact
+        # whose region absorbed the dot_general anchor, vs the unfused
+        # twin — the matmul inside the region must differentiate
+        # identically to the op-granular one
+        fn, args = _fused_mlp()
+        with _passes(_NO_FUSE_PASSES):
+            f_off, _ = pir.compile_flat(fn, args, name="ep")
+        with _passes(_DEFAULT_PASSES):
+            pir.compile_flat(fn, args, name="ep")
+            f2, r2 = pir.compile_flat(fn, args, name="ep")
+        assert r2.cache == "hit"
+        assert r2.fusion_kinds.get("epilogue", 0) >= 1, r2.fusion_kinds
+        g = jax.grad(lambda w: f2(args[0], w, *args[2:])[0].sum())(args[1])
+        ref = jax.grad(
+            lambda w: f_off(args[0], w, *args[2:])[0].sum())(args[1])
+        assert np.array_equal(np.asarray(g), np.asarray(ref))
+
 
 # ---------------------------------------------------------------------------
 # commit criterion: strict bytes decrease
@@ -209,36 +272,92 @@ class TestCommitCriterion:
         assert res.edits == 0
         assert _groups(prog) == []
 
-    def test_escaping_intermediates_refused(self):
-        # every intermediate is also a program output: fusing saves no
-        # traffic (the boundary equals the member traffic) -> no commit
+    def test_escaping_intermediate_promoted_multi_output(self):
+        # v2: an intermediate that is ALSO a program output no longer
+        # forces a refusal — it is promoted to a second group result
+        # (the interior re-read of `a` is what fusing saves; v1 refused
+        # this exact shape)
         def fn(x):
             a = x + 1.0
             b = a * 2.0
             return (a, b)
 
-        prog = _pre_fuse_program(fn, [jnp.ones((64, 64), jnp.float32)],
-                                 "escape")
+        args = [jnp.ones((64, 64), jnp.float32)]
+        prog = _pre_fuse_program(fn, args, "escape")
         res = FusionPass().run(prog)
-        assert res.edits == 0
+        assert res.edits == 1, res.notes
+        (fg,) = _group_attrs(prog)
+        assert fg["kind"] == "multi_output"
+        assert fg["outs"] == 2
+        assert sorted(fg["ops"]) == ["add", "mul"]
+        assert fg["bytes_saved"] > 0
+        got = [np.asarray(o) for o in prog.bind(*args)]
+        want = [np.asarray(o) for o in fn(*args)]
+        assert all(np.array_equal(g, w) for g, w in zip(got, want))
+
+    def test_promotion_refused_before_splice(self):
+        # promotion is only legal when every external user sits AFTER
+        # the splice point: here the dot reads `a` BEFORE the group
+        # rooted at `b`'s mul would splice, so absorbing tanh would
+        # define `a` after its first read — the planner must refuse
+        # (and with tanh unabsorbable the singleton mul refuses too)
+        def fn(x, w):
+            a = jnp.tanh(x)
+            m = a @ w
+            b = a * 2.0
+            return (m, b)
+
+        args = [jnp.ones((32, 32), jnp.float32),
+                jnp.ones((32, 32), jnp.float32) * 0.5]
+        prog = _pre_fuse_program(fn, args, "presplice")
+        res = FusionPass().run(prog)
+        assert res.edits == 0, res.notes
         assert _groups(prog) == []
 
     def test_downcast_dup_guard(self):
-        # a convert with an external user is only duplicable when the
-        # replayed read is not wider than its output: an f32->bf16
-        # downcast (4 bytes in, 2 out) must stay OUT of the group and
-        # feed it as a boundary operand instead
-        def fn(x):
+        # a convert whose external user sits BEFORE the splice point
+        # cannot be promoted, so the dup path is consulted — and a
+        # downcast is only duplicable when the replayed read is not
+        # wider than its output: an f32->bf16 downcast (4 bytes in, 2
+        # out) must stay OUT of the group and feed it as a boundary
+        # operand instead
+        def fn(x, w):
             c = x.astype(jnp.bfloat16)
+            s = c @ w                    # pre-splice external user of c
             t = jnp.tanh(c) * jnp.bfloat16(2)
-            return (t, c)
+            return (t, s)
 
-        prog = _pre_fuse_program(fn, [jnp.ones((64, 64), jnp.float32)],
-                                 "downcast")
-        FusionPass().run(prog)
+        args = [jnp.ones((64, 64), jnp.float32),
+                jnp.ones((64, 64), jnp.bfloat16)]
+        prog = _pre_fuse_program(fn, args, "downcast")
+        res = FusionPass().run(prog)
+        assert res.edits >= 1          # the tanh*2 chain still fuses
         for members, _saved in _groups(prog):
             assert "convert_element_type" not in members
         assert any(op.name == "convert_element_type" for op in prog.ops)
+
+    def test_dot_never_duplicated(self):
+        # a dot whose result is read by a pre-splice external consumer
+        # (the second matmul) may NOT be absorbed: anchors are never
+        # duplicated, and promotion is illegal before the splice point
+        # — the dot must survive op-granular with the epilogue chain
+        # fusing around it
+        def fn(x, w):
+            m = x @ w
+            s = m @ w                    # pre-splice external user of m
+            t = jnp.tanh(m) * 2.0
+            return (t, s)
+
+        args = [jnp.ones((32, 32), jnp.float32),
+                jnp.ones((32, 32), jnp.float32) * 0.5]
+        prog = _pre_fuse_program(fn, args, "dotdup")
+        FusionPass().run(prog)
+        in_groups = sum(members.count("dot_general")
+                        for members, _ in _groups(prog))
+        standalone = sum(1 for op in prog.ops
+                         if op.name == "dot_general")
+        assert in_groups == 0
+        assert standalone == 2         # both dots intact, neither copied
 
 
 # ---------------------------------------------------------------------------
@@ -299,6 +418,59 @@ class TestFusionWalls:
         assert res.edits == 0                    # chain touches the
         assert _groups(prog) == []                 # annotated value
 
+    def test_sharded_dot_is_an_anchor_wall(self):
+        # epilogue absorption respects the sharding wall too: a dot
+        # whose result carries an annotation stays op-granular (the
+        # chain reading it refuses as well — annotated dataflow must
+        # reach shard_search/shard_prop unfused), while the rest of
+        # the program fuses normally
+        def fn(x, w):
+            m = x @ w
+            t = jnp.tanh(m)
+            u = t * 2.0 + 1.0
+            return (u,)
+
+        args = [jnp.ones((64, 64), jnp.float32),
+                jnp.ones((64, 64), jnp.float32) * 0.5]
+        prog = _pre_fuse_program(fn, args, "sharded_dot")
+        dot = next(op for op in prog.ops if op.name == "dot_general")
+        dot.outputs[0].sharding = ("dp", None)
+        res = FusionPass().run(prog)
+        assert any(op.name == "dot_general" for op in prog.ops)
+        for members, _saved in _groups(prog):
+            assert "dot_general" not in members
+            assert "tanh" not in members         # reads the annotated m
+        assert res.edits == 1                    # {mul, add} still fuses
+
+    def test_fused_region_anchor_composition(self):
+        # regions compose: a fusible chain hanging off an
+        # already-committed pt.fused_region absorbs THAT region as its
+        # compute anchor on a later fuse run. (The first run is walled
+        # off from the tail by a temporary sharding annotation; once it
+        # lifts, the second run must fold region + tail into one.)
+        def fn(x):
+            b = jnp.exp(jnp.tanh(x))
+            m = b * 2.0
+            return (m + 1.0,)
+
+        args = [jnp.ones((64, 64), jnp.float32)]
+        prog = _pre_fuse_program(fn, args, "compose")
+        mul = next(op for op in prog.ops if op.name == "mul")
+        mul.outputs[0].sharding = ("dp", None)
+        res1 = FusionPass().run(prog)
+        assert res1.edits == 1
+        (fg1,) = _group_attrs(prog)
+        assert sorted(fg1["ops"]) == ["exp", "tanh"]
+        mul.outputs[0].sharding = None
+        res2 = FusionPass().run(prog)
+        assert res2.edits == 1, res2.notes
+        fg2 = _group_attrs(prog)[-1]
+        assert fg2["kind"] == "epilogue"
+        assert "pt.fused_region" in fg2["ops"]   # the anchor IS a region
+        assert sorted(fg2["ops"]) == ["add", "mul", "pt.fused_region"]
+        got = np.asarray(prog.bind(*args)[0])
+        assert np.array_equal(got, np.asarray(fn(*args)[0]))
+
 
 # ---------------------------------------------------------------------------
 # failure contract
@@ -307,17 +479,25 @@ class TestFusionWalls:
 class TestFailureContract:
     def test_per_group_fault_leaves_other_groups_fused(self, cache_dir):
         from paddle_tpu.resilience.faults import injected_faults
-        fn, args = _fused_mlp()
+
+        def fn(x, y):
+            a = jnp.tanh(x) * 2.0 + 1.0
+            b = jnp.exp(y) * 3.0 - 1.0
+            return (a, b)
+
+        args = [jnp.ones((64, 64), jnp.float32),
+                jnp.ones((64, 64), jnp.float32) * 0.5]
         with _passes(_NO_FUSE_PASSES):
             f_off, _ = pir.compile_flat(fn, args, name="pg")
-            ref = np.asarray(f_off(*args)[0])
+            ref = [np.asarray(o) for o in f_off(*args)]
         # hit 1 is the pass entry; hit 2 is group g0's commit seam
         with _passes(_DEFAULT_PASSES), \
                 injected_faults("compile.fuse:2:RuntimeError"):
             f, report = pir.compile_flat(fn, args, name="pg")
         assert report.fallback is None             # PIR path kept
         assert report.fusion_groups == 1           # g1 committed, g0 not
-        assert np.array_equal(np.asarray(f(*args)[0]), ref)
+        got = [np.asarray(o) for o in f(*args)]
+        assert all(np.array_equal(g, r) for g, r in zip(got, ref))
 
     def test_whole_pass_fault_degrades_to_jit(self, cache_dir,
                                               enabled_obs):
@@ -332,6 +512,63 @@ class TestFailureContract:
         assert _counter("pir_fallback_total", stage="fuse") == before + 1
         got = np.asarray(f(*args)[0])
         assert np.array_equal(got, np.asarray(fn(*args)[0]))
+
+
+# ---------------------------------------------------------------------------
+# multi-result regions through DCE + the strict verifier rule
+# ---------------------------------------------------------------------------
+
+class TestDeadResultPruning:
+    def test_dce_prunes_dead_promoted_result(self):
+        # `a` is promoted only because the dead mul reads it; when DCE
+        # removes that reader it must also shrink the region's
+        # signature (dead promoted outputs pruned in place, the fused
+        # body wrapped to the kept indices) — otherwise the strict
+        # per-result dead-code rule rejects the program
+        from paddle_tpu.pir.passes import DeadCodeElimination
+
+        def fn(x):
+            a = x + 1.0
+            b = a * 2.0
+            dead = a * 3.0    # traced but never returned
+            return (b,)
+
+        args = [jnp.ones((64, 64), jnp.float32)]
+        prog = _pre_fuse_program(fn, args, "deadres")
+        assert sum(1 for op in prog.ops if op.name == "mul") == 2
+        FusionPass().run(prog)
+        (fg,) = _group_attrs(prog)
+        assert fg["kind"] == "multi_output" and fg["outs"] == 2
+        region = next(op for op in prog.ops
+                      if op.name == "pt.fused_region")
+        assert len(region.outputs) == 2
+        res = DeadCodeElimination().run(prog)
+        assert res.edits >= 1, res.notes
+        assert len(region.outputs) == 1           # dead `a` pruned
+        assert region.attrs["fusion_group"]["outs"] == 1
+        pir.verify_program(prog, strict_dead=True, where="test")
+        got = np.asarray(prog.bind(*args)[0])
+        assert np.array_equal(got, np.asarray(fn(*args)[0]))
+
+    def test_verifier_rejects_dead_region_result(self):
+        # the strict rule itself: hand the verifier a region carrying a
+        # result nothing reads and it must name the dead-code rule
+        def fn(x):
+            a = x + 1.0
+            b = a * 2.0
+            dead = a * 3.0
+            return (b,)
+
+        args = [jnp.ones((64, 64), jnp.float32)]
+        prog = _pre_fuse_program(fn, args, "deadres2")
+        FusionPass().run(prog)
+        # drop the dead consumer WITHOUT the DCE pass's pruning
+        prog.ops = [op for op in prog.ops
+                    if not (op.name == "mul"
+                            and op.outputs[0] not in prog.outputs)]
+        with pytest.raises(pir.IRVerificationError) as ei:
+            pir.verify_program(prog, strict_dead=True, where="test")
+        assert ei.value.rule == "dead-code"
 
 
 # ---------------------------------------------------------------------------

@@ -82,6 +82,21 @@ KEYS = {
          ".wall_ratio",
          "detail.secondary.fusion_ab.programs.fused_decode.wall_ratio"),
         "down"),
+    # round 23: fusion v2 — the new group kinds must stay committed
+    # (multi-output promotion and dot epilogue absorption both live)
+    # and the epilogue arm's wall ratio must not grow
+    "fusion_multi_output_groups": (
+        ("detail.secondary_cpu_fallback.fusion_ab"
+         ".multi_output_groups_total",
+         "detail.secondary.fusion_ab.multi_output_groups_total"), "up"),
+    "fusion_epilogue_groups": (
+        ("detail.secondary_cpu_fallback.fusion_ab.epilogue_groups_total",
+         "detail.secondary.fusion_ab.epilogue_groups_total"), "up"),
+    "fusion_epilogue_wall_ratio": (
+        ("detail.secondary_cpu_fallback.fusion_ab.programs"
+         ".matmul_epilogue.wall_ratio",
+         "detail.secondary.fusion_ab.programs.matmul_epilogue"
+         ".wall_ratio"), "down"),
     # round 22: multi-adapter A/B — the mixed-adapter throughput tax
     # (per-lane delta gathers) must not deepen, and the resident-set
     # mixed tok/s must not regress across rounds

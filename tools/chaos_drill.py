@@ -672,7 +672,15 @@ def drill_compile_fuse(tmp):
     degrades the whole compile to plain jax.jit counted
     pir_fallback_total{stage=fuse}; hit 2 (per-group commit) skips that
     group only — the compile stays on the PIR path with the group's ops
-    replaying unfused. Both paths must be byte-identical vs fusion-off."""
+    replaying unfused. Both paths must be byte-identical vs fusion-off.
+
+    Fusion-v2 legs: a second program commits one multi_output group
+    (promoted sibling-shared intermediate) and one epilogue group
+    (dot_general absorbed as compute anchor) side by side; faulting
+    either group's commit seam must leave the SIBLING group fused with
+    the compile on the PIR path, and every leg — per-group skip of each
+    kind, whole-pass stage=fuse fallback, clean retry — must stay
+    byte-identical vs the fusion-off reference."""
     from paddle_tpu.framework import flags as _flags
     pir, fn, args, want, prev = _pir_compile_setup(tmp)
     prev_passes = _flags.flag_value("pir_passes")
@@ -726,12 +734,73 @@ def drill_compile_fuse(tmp):
         got3 = np.asarray(clean(*args)[0])
         _expect(np.array_equal(got3, ref),
                 "fused program not byte-identical vs fusion-off")
+
+        # ---- fusion-v2 legs: one multi_output + one epilogue group
+        # side by side; a per-group fault leaves the sibling fused
+        def fn2(x, y):
+            a = x + 1.0
+            b = a * 2.0                  # a escapes too -> multi_output
+            c = jnp.tanh(x @ y) * 3.0    # dot absorbed -> epilogue
+            return (a, b, c)
+
+        def _run(f):
+            return [np.asarray(o) for o in f(*args)]
+
+        _flags.set_flags({"pir_passes": no_fuse})
+        off2, _ = pir.compile_flat(fn2, args, name="drill_fuse_v2")
+        ref2 = _run(off2)
+        _flags.set_flags({"pir_passes": prev_passes})
+
+        clean2, rep4 = pir.compile_flat(fn2, args, name="drill_fuse_v2")
+        _expect(rep4.fallback is None,
+                f"v2 program degraded: {rep4.fallback}")
+        _expect(rep4.fusion_kinds.get("multi_output", 0) >= 1
+                and rep4.fusion_kinds.get("epilogue", 0) >= 1,
+                f"expected both v2 kinds committed: {rep4.fusion_kinds}")
+        _expect(all(np.array_equal(g, r)
+                    for g, r in zip(_run(clean2), ref2)),
+                "v2 fused program not byte-identical vs fusion-off")
+
+        # hit 2 = the multi_output group's commit seam (gid 0)
+        with faults.injected_faults("compile.fuse:2:RuntimeError"):
+            p_mo, rep5 = pir.compile_flat(fn2, args, name="drill_fuse_v2")
+        _expect(rep5.fallback is None,
+                f"multi_output group fault degraded the compile: "
+                f"{rep5.fallback}")
+        _expect(rep5.fusion_kinds.get("epilogue", 0) >= 1
+                and "multi_output" not in rep5.fusion_kinds,
+                f"sibling epilogue group lost when the multi_output "
+                f"group faulted: {rep5.fusion_kinds}")
+        _expect(all(np.array_equal(g, r) for g, r in zip(_run(p_mo), ref2)),
+                "multi_output skip not byte-identical vs fusion-off")
+
+        # hit 3 = the epilogue group's commit seam (gid 1)
+        with faults.injected_faults("compile.fuse:3:RuntimeError"):
+            p_ep, rep6 = pir.compile_flat(fn2, args, name="drill_fuse_v2")
+        _expect(rep6.fallback is None,
+                f"epilogue group fault degraded the compile: "
+                f"{rep6.fallback}")
+        _expect(rep6.fusion_kinds.get("multi_output", 0) >= 1
+                and "epilogue" not in rep6.fusion_kinds,
+                f"sibling multi_output group lost when the epilogue "
+                f"group faulted: {rep6.fusion_kinds}")
+        _expect(all(np.array_equal(g, r) for g, r in zip(_run(p_ep), ref2)),
+                "epilogue skip not byte-identical vs fusion-off")
+
+        # whole-pass fault on the v2 program: stage=fuse fallback
+        with faults.injected_faults("compile.fuse:1:RuntimeError"):
+            p_wp, rep7 = pir.compile_flat(fn2, args, name="drill_fuse_v2")
+        _expect(rep7.fallback == "fuse",
+                f"v2 whole-pass fault not degraded: {rep7.fallback}")
+        _expect(all(np.array_equal(g, r) for g, r in zip(_run(p_wp), ref2)),
+                "v2 stage=fuse fallback not byte-identical vs fusion-off")
     finally:
         _flags.set_flags({"compile_cache_dir": prev,
                           "pir_passes": prev_passes})
     return "degraded", ("per-group fault skipped the group (PIR path "
-                        "kept), whole-pass fault degraded to plain "
-                        "jax.jit counted stage=fuse; all legs "
+                        "kept; each v2 kind's fault left the sibling "
+                        "group fused), whole-pass fault degraded to "
+                        "plain jax.jit counted stage=fuse; all legs "
                         "byte-identical vs fusion-off")
 
 

@@ -139,6 +139,30 @@ def ex_fused_mlp():
     return fn, [x, w1, w2, g]
 
 
+def ex_matmul_epilogue():
+    """Fusion-v2 showcase: a matmul whose whole consumer chain (bias →
+    gelu → residual → rmsnorm) hangs off one dot_general. The fuse pass
+    should absorb the dot as the group's compute anchor (kind=epilogue)
+    so the chain runs in the matmul's output tile, and promote the
+    residual sum — returned alongside the normalized output — to a
+    second group result (outs=2) instead of refusing the escape."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 64), jnp.float32)
+    w = jnp.asarray(rng.randn(64, 64) * 0.1, jnp.float32)
+    b = jnp.asarray(rng.randn(64) * 0.1, jnp.float32)
+    g = jnp.asarray(rng.rand(64), jnp.float32)
+
+    def fn(x_, w_, b_, g_):
+        h = x_ @ w_ + b_
+        a = jax.nn.gelu(h, approximate=True)
+        y = a + x_
+        var = jnp.mean(y * y, axis=-1, keepdims=True)
+        out = y * jax.lax.rsqrt(var + 1e-6) * g_
+        return (out, y)
+
+    return fn, [x, w, b, g]
+
+
 def ex_sharded_mlp():
     """Annotated-input example for the sharding passes: inputs carry
     sparse mesh-axis specs and shard_prop must propagate them through
@@ -160,6 +184,7 @@ EXAMPLES = {
     "llama_block": ex_llama_block,
     "sdpa_epilogue": ex_sdpa_epilogue,
     "fused_mlp": ex_fused_mlp,
+    "matmul_epilogue": ex_matmul_epilogue,
     "sharded_mlp": ex_sharded_mlp,
 }
 
@@ -253,7 +278,9 @@ def _run_example_inner(name, fn, flat, eager, specs, diff, check):
     for op in prog.ops:
         fg = op.attrs.get("fusion_group")
         if fg:
-            print(f"  fusion group g{fg['id']}: {len(fg['ops'])} ops "
+            print(f"  fusion group g{fg['id']}: "
+                  f"kind={fg.get('kind', 'chain')} "
+                  f"outs={fg.get('outs', 1)} {len(fg['ops'])} ops "
                   f"{fg['ops']} predicted_bytes_saved={fg['bytes_saved']}")
     if check and ok:
         print(f"  check OK: final program verifies and matches eager "
